@@ -1,0 +1,32 @@
+"""client_tpu — a TPU-native client framework for KServe-v2 inference servers.
+
+A brand-new implementation of the capability surface of the Triton Inference
+Server client stack (see SURVEY.md at the repo root), designed JAX-first.
+Package layout (built out progressively; see README for current status):
+
+- ``client_tpu.http`` / ``client_tpu.grpc``: sync clients for the KServe v2
+  HTTP/REST and gRPC protocols (reference: src/python/library/tritonclient/).
+- ``client_tpu.http.aio`` / ``client_tpu.grpc.aio``: asyncio clients. Unlike
+  the reference (which bolted aio variants onto sync cores), the asyncio
+  implementations here are the primary ones and the sync clients delegate to
+  them through a background event loop.
+- ``client_tpu.utils``: KServe v2 dtype tables with *native* BF16 (via
+  ml_dtypes/jnp.bfloat16 rather than the reference's float32-truncation hack),
+  BYTES tensor serialization, and the client exception type.
+- ``client_tpu.utils.shared_memory``: POSIX system shared-memory data plane.
+- ``client_tpu.utils.tpu_shared_memory``: the TPU replacement for the
+  reference's CUDA-IPC data plane — zero-copy jax.Array staging through
+  shared pinned host buffers + DLPack.
+- ``client_tpu.server``: an in-repo KServe v2 server (HTTP + gRPC) backed by
+  JAX models, used for integration tests, benchmarking, and as the in-process
+  "no network" backend (the analogue of the reference's triton_c_api backend).
+- ``client_tpu.models`` / ``client_tpu.parallel``: JAX model zoo and sharding
+  utilities used by the server runtime and benchmarks.
+"""
+
+__version__ = "0.1.0"
+
+from client_tpu._client import InferenceServerClientBase  # noqa: F401
+from client_tpu._auth import BasicAuth  # noqa: F401
+from client_tpu._plugin import InferenceServerClientPlugin  # noqa: F401
+from client_tpu._request import Request  # noqa: F401

@@ -1,0 +1,292 @@
+"""KServe v2 dtype tables, tensor serialization, and the client exception.
+
+Capability parity with src/python/library/tritonclient/utils/__init__.py in
+the reference, with one deliberate TPU-first difference: **BF16 is a native
+dtype** here (numpy's ``ml_dtypes.bfloat16``, the same storage jax uses),
+whereas the reference only supports BF16 through a float32-truncation hack
+(reference utils/__init__.py:279-320) because numpy alone has no bfloat16.
+"""
+
+import struct
+from typing import Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+try:
+    import ml_dtypes
+
+    bfloat16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    ml_dtypes = None
+    bfloat16 = None
+
+__all__ = [
+    "InferenceServerException",
+    "np_to_triton_dtype",
+    "triton_to_np_dtype",
+    "triton_dtype_byte_size",
+    "serialize_byte_tensor",
+    "deserialize_bytes_tensor",
+    "serialize_bf16_tensor",
+    "deserialize_bf16_tensor",
+    "serialized_byte_size",
+    "num_elements",
+    "bfloat16",
+]
+
+
+class InferenceServerException(Exception):
+    """Exception raised for server- or client-side inference errors.
+
+    Mirrors the surface of the reference exception
+    (reference utils/__init__.py:71-130): ``message()``, ``status()`` and
+    ``debug_details()`` accessors.
+    """
+
+    def __init__(
+        self,
+        msg: str,
+        status: Optional[str] = None,
+        debug_details: Optional[str] = None,
+    ):
+        self._msg = msg
+        self._status = status
+        self._debug_details = debug_details
+        super().__init__(msg)
+
+    def __str__(self) -> str:
+        msg = super().__str__() if self._msg is None else self._msg
+        if self._status is not None:
+            msg = f"[{self._status}] {msg}"
+        return msg
+
+    def message(self) -> str:
+        """The error message."""
+        return self._msg
+
+    def status(self) -> Optional[str]:
+        """The error status code (e.g. gRPC status name), if any."""
+        return self._status
+
+    def debug_details(self) -> Optional[str]:
+        """Low-level debug details (e.g. traceback), if any."""
+        return self._debug_details
+
+
+# ---------------------------------------------------------------------------
+# dtype tables
+#
+# KServe v2 wire dtype string <-> numpy dtype. BF16 maps to ml_dtypes.bfloat16
+# (2-byte storage identical to jnp.bfloat16), so jax.Array buffers round-trip
+# without conversion.
+# ---------------------------------------------------------------------------
+
+_NP_TO_TRITON = {
+    np.dtype(np.bool_): "BOOL",
+    np.dtype(np.int8): "INT8",
+    np.dtype(np.int16): "INT16",
+    np.dtype(np.int32): "INT32",
+    np.dtype(np.int64): "INT64",
+    np.dtype(np.uint8): "UINT8",
+    np.dtype(np.uint16): "UINT16",
+    np.dtype(np.uint32): "UINT32",
+    np.dtype(np.uint64): "UINT64",
+    np.dtype(np.float16): "FP16",
+    np.dtype(np.float32): "FP32",
+    np.dtype(np.float64): "FP64",
+}
+if bfloat16 is not None:
+    _NP_TO_TRITON[bfloat16] = "BF16"
+
+_TRITON_TO_NP = {v: k for k, v in _NP_TO_TRITON.items()}
+_TRITON_TO_NP["BYTES"] = np.dtype(object)
+
+_FIXED_BYTE_SIZES = {
+    "BOOL": 1,
+    "INT8": 1,
+    "UINT8": 1,
+    "INT16": 2,
+    "UINT16": 2,
+    "FP16": 2,
+    "BF16": 2,
+    "INT32": 4,
+    "UINT32": 4,
+    "FP32": 4,
+    "INT64": 8,
+    "UINT64": 8,
+    "FP64": 8,
+}
+
+
+def np_to_triton_dtype(np_dtype) -> Optional[str]:
+    """Map a numpy dtype (or type) to a KServe v2 dtype string.
+
+    Object/str/bytes dtypes map to ``"BYTES"``. Returns ``None`` for
+    unsupported dtypes (matching the reference's contract,
+    reference utils/__init__.py:133-160).
+    """
+    dt = np.dtype(np_dtype)
+    if dt in _NP_TO_TRITON:
+        return _NP_TO_TRITON[dt]
+    if dt == np.dtype(object) or dt.kind in ("S", "U"):
+        return "BYTES"
+    return None
+
+
+def triton_to_np_dtype(dtype: str):
+    """Map a KServe v2 dtype string to a numpy dtype.
+
+    ``"BYTES"`` maps to ``np.object_``; unknown strings return ``None``.
+    """
+    return _TRITON_TO_NP.get(dtype)
+
+
+def triton_dtype_byte_size(dtype: str) -> int:
+    """Per-element byte size of a fixed-size dtype; -1 for BYTES."""
+    if dtype == "BYTES":
+        return -1
+    try:
+        return _FIXED_BYTE_SIZES[dtype]
+    except KeyError:
+        raise InferenceServerException(f"unknown dtype '{dtype}'") from None
+
+
+def num_elements(shape: Sequence[int]) -> int:
+    """Total element count of ``shape`` (1 for rank-0)."""
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+# ---------------------------------------------------------------------------
+# BYTES tensors: each element is a 4-byte little-endian length followed by the
+# element's raw bytes, elements concatenated in row-major order (the KServe v2
+# binary representation; reference utils/__init__.py:193-276).
+# ---------------------------------------------------------------------------
+
+
+def _element_to_bytes(obj) -> bytes:
+    if isinstance(obj, bytes):
+        return obj
+    if isinstance(obj, bytearray):
+        return bytes(obj)
+    if isinstance(obj, str):
+        return obj.encode("utf-8")
+    # Fall back to str() for numbers etc., matching reference leniency.
+    return str(obj).encode("utf-8")
+
+
+def serialize_byte_tensor(input_tensor: np.ndarray) -> np.ndarray:
+    """Serialize a BYTES tensor into its flat binary representation.
+
+    Accepts numpy arrays of dtype object (bytes/str elements), ``S`` or ``U``.
+    Returns a 1-D ``np.uint8`` array (empty for zero-element input).
+    """
+    arr = np.asarray(input_tensor)
+    if arr.size == 0:
+        return np.empty([0], dtype=np.uint8)
+    if not (arr.dtype == np.dtype(object) or arr.dtype.kind in ("S", "U")):
+        raise InferenceServerException(
+            "cannot serialize bytes tensor: invalid dtype "
+            f"{arr.dtype} (expected object/bytes/str)"
+        )
+    chunks: List[bytes] = []
+    for obj in arr.flat:
+        b = _element_to_bytes(obj)
+        chunks.append(struct.pack("<I", len(b)))
+        chunks.append(b)
+    flat = b"".join(chunks)
+    return np.frombuffer(flat, dtype=np.uint8)
+
+
+def deserialize_bytes_tensor(encoded_tensor: Union[bytes, np.ndarray]) -> np.ndarray:
+    """Inverse of :func:`serialize_byte_tensor`.
+
+    Returns a 1-D ``np.object_`` array of ``bytes`` elements (caller reshapes
+    to the wire shape).
+    """
+    if isinstance(encoded_tensor, np.ndarray):
+        buf = encoded_tensor.tobytes()
+    else:
+        buf = bytes(encoded_tensor)
+    elems: List[bytes] = []
+    offset = 0
+    n = len(buf)
+    while offset + 4 <= n:
+        (length,) = struct.unpack_from("<I", buf, offset)
+        offset += 4
+        if offset + length > n:
+            raise InferenceServerException(
+                "malformed BYTES tensor: element length "
+                f"{length} overruns buffer of {n} bytes at offset {offset}"
+            )
+        elems.append(buf[offset : offset + length])
+        offset += length
+    if offset != n:
+        raise InferenceServerException(
+            f"malformed BYTES tensor: {n - offset} trailing bytes"
+        )
+    return np.array(elems, dtype=np.object_)
+
+
+def serialized_byte_size(tensor: np.ndarray) -> int:
+    """Byte size of ``tensor`` as it will appear on the wire.
+
+    For BYTES tensors this is the length-prefixed serialized size; for
+    fixed-size dtypes it is ``nbytes``.
+    """
+    arr = np.asarray(tensor)
+    if arr.dtype == np.dtype(object) or arr.dtype.kind in ("S", "U"):
+        total = 0
+        for obj in arr.flat:
+            total += 4 + len(_element_to_bytes(obj))
+        return total
+    return arr.nbytes
+
+
+# ---------------------------------------------------------------------------
+# BF16 tensors. Native path: ml_dtypes.bfloat16 arrays (or jax.Array exports)
+# are already in wire format — serialization is a raw-bytes view. For
+# compatibility with reference callers that hold float32, a float32 input is
+# converted (round-to-nearest-even, what ml_dtypes implements) rather than
+# bit-truncated like the reference (utils/__init__.py:279-320).
+# ---------------------------------------------------------------------------
+
+
+def serialize_bf16_tensor(input_tensor: np.ndarray) -> np.ndarray:
+    """Serialize a BF16 tensor to its 2-byte-per-element wire form.
+
+    Accepts ``ml_dtypes.bfloat16`` arrays (zero-copy view) or float32/float64
+    arrays (converted). Returns a 1-D ``np.uint8`` array.
+    """
+    if ml_dtypes is None:  # pragma: no cover
+        raise InferenceServerException("BF16 support requires ml_dtypes")
+    arr = np.asarray(input_tensor)
+    if arr.dtype != bfloat16:
+        if arr.dtype.kind != "f":
+            raise InferenceServerException(
+                f"cannot serialize bf16 tensor from dtype {arr.dtype}"
+            )
+        arr = arr.astype(bfloat16)
+    arr = np.ascontiguousarray(arr)
+    return arr.view(np.uint8).reshape(-1)
+
+
+def deserialize_bf16_tensor(encoded_tensor: Union[bytes, np.ndarray]) -> np.ndarray:
+    """Inverse of :func:`serialize_bf16_tensor`.
+
+    Returns a 1-D ``ml_dtypes.bfloat16`` array (the reference returns float32;
+    call ``.astype(np.float32)`` for that behavior).
+    """
+    if ml_dtypes is None:  # pragma: no cover
+        raise InferenceServerException("BF16 support requires ml_dtypes")
+    try:
+        if isinstance(encoded_tensor, np.ndarray):
+            buf = np.ascontiguousarray(encoded_tensor).view(np.uint8)
+            return buf.view(bfloat16).reshape(-1)
+        return np.frombuffer(encoded_tensor, dtype=bfloat16)
+    except ValueError as e:
+        raise InferenceServerException(
+            f"malformed BF16 tensor: {e}"
+        ) from None

@@ -1,0 +1,161 @@
+"""Unit tests for client_tpu.utils (dtypes, serialization, exception).
+
+Modeled on the reference's utils coverage (test strategy: SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from client_tpu.utils import (
+    InferenceServerException,
+    bfloat16,
+    deserialize_bf16_tensor,
+    deserialize_bytes_tensor,
+    np_to_triton_dtype,
+    num_elements,
+    serialize_bf16_tensor,
+    serialize_byte_tensor,
+    serialized_byte_size,
+    triton_dtype_byte_size,
+    triton_to_np_dtype,
+)
+
+
+ALL_FIXED = [
+    ("BOOL", np.bool_),
+    ("INT8", np.int8),
+    ("INT16", np.int16),
+    ("INT32", np.int32),
+    ("INT64", np.int64),
+    ("UINT8", np.uint8),
+    ("UINT16", np.uint16),
+    ("UINT32", np.uint32),
+    ("UINT64", np.uint64),
+    ("FP16", np.float16),
+    ("FP32", np.float32),
+    ("FP64", np.float64),
+]
+
+
+@pytest.mark.parametrize("triton_dtype,np_dtype", ALL_FIXED)
+def test_dtype_round_trip(triton_dtype, np_dtype):
+    assert np_to_triton_dtype(np_dtype) == triton_dtype
+    assert triton_to_np_dtype(triton_dtype) == np.dtype(np_dtype)
+
+
+def test_bf16_is_native():
+    assert bfloat16 is not None
+    assert np_to_triton_dtype(bfloat16) == "BF16"
+    assert triton_to_np_dtype("BF16") == bfloat16
+    assert triton_dtype_byte_size("BF16") == 2
+
+
+def test_bytes_dtype_mapping():
+    assert np_to_triton_dtype(np.object_) == "BYTES"
+    assert np_to_triton_dtype(np.dtype("S10")) == "BYTES"
+    assert np_to_triton_dtype(np.dtype("U4")) == "BYTES"
+    assert triton_to_np_dtype("BYTES") == np.dtype(object)
+    assert triton_dtype_byte_size("BYTES") == -1
+
+
+def test_unknown_dtype():
+    assert np_to_triton_dtype(np.complex64) is None
+    assert triton_to_np_dtype("NOPE") is None
+    with pytest.raises(InferenceServerException):
+        triton_dtype_byte_size("NOPE")
+
+
+def test_num_elements():
+    assert num_elements([]) == 1
+    assert num_elements([3, 4]) == 12
+    assert num_elements([0, 7]) == 0
+
+
+def test_serialize_bytes_round_trip():
+    arr = np.array([b"alpha", "beta", b"", "ünicode"], dtype=object)
+    enc = serialize_byte_tensor(arr)
+    assert enc.dtype == np.uint8
+    dec = deserialize_bytes_tensor(enc.tobytes())
+    expect = [b"alpha", b"beta", b"", "ünicode".encode("utf-8")]
+    assert list(dec) == expect
+    assert serialized_byte_size(arr) == enc.size
+
+
+def test_serialize_bytes_2d_row_major():
+    arr = np.array([[b"a", b"bb"], [b"ccc", b"dddd"]], dtype=object)
+    dec = deserialize_bytes_tensor(serialize_byte_tensor(arr).tobytes())
+    assert list(dec) == [b"a", b"bb", b"ccc", b"dddd"]
+
+
+def test_serialize_bytes_fixed_width_strings():
+    arr = np.array([b"xy", b"z"], dtype="S2")
+    dec = deserialize_bytes_tensor(serialize_byte_tensor(arr).tobytes())
+    assert list(dec) == [b"xy", b"z"]
+
+
+def test_serialize_bytes_empty():
+    enc = serialize_byte_tensor(np.array([], dtype=object))
+    assert enc.size == 0
+    assert list(deserialize_bytes_tensor(b"")) == []
+
+
+def test_serialize_bytes_bad_dtype():
+    with pytest.raises(InferenceServerException):
+        serialize_byte_tensor(np.zeros([2], dtype=np.float32))
+
+
+def test_deserialize_bytes_malformed():
+    with pytest.raises(InferenceServerException):
+        deserialize_bytes_tensor(b"\x05\x00\x00\x00ab")  # overrun
+    with pytest.raises(InferenceServerException):
+        deserialize_bytes_tensor(b"\x01\x00\x00\x00a" + b"\x00\x00")  # trailing
+
+
+def test_bf16_round_trip_native():
+    arr = np.array([1.5, -2.25, 0.0, 3.0], dtype=bfloat16)
+    enc = serialize_bf16_tensor(arr)
+    assert enc.dtype == np.uint8
+    assert enc.size == arr.size * 2
+    dec = deserialize_bf16_tensor(enc.tobytes())
+    np.testing.assert_array_equal(dec, arr)
+
+
+def test_bf16_from_float32():
+    f32 = np.array([1.0, 2.5, -0.125], dtype=np.float32)
+    dec = deserialize_bf16_tensor(serialize_bf16_tensor(f32).tobytes())
+    np.testing.assert_array_equal(dec.astype(np.float32), f32)
+
+
+def test_bf16_matches_jax_storage():
+    jnp = pytest.importorskip("jax.numpy")
+    x = jnp.asarray([1.0, -3.5, 7.0], dtype=jnp.bfloat16)
+    host = np.asarray(x)
+    enc = serialize_bf16_tensor(host)
+    dec = deserialize_bf16_tensor(enc.tobytes())
+    np.testing.assert_array_equal(dec, host)
+
+
+def test_exception_surface():
+    e = InferenceServerException("boom", status="StatusCode.INTERNAL", debug_details="tb")
+    assert e.message() == "boom"
+    assert e.status() == "StatusCode.INTERNAL"
+    assert e.debug_details() == "tb"
+    assert "boom" in str(e) and "INTERNAL" in str(e)
+
+
+def test_plugin_registry():
+    from client_tpu import BasicAuth, InferenceServerClientBase, Request
+
+    c = InferenceServerClientBase()
+    assert c.plugin() is None
+    auth = BasicAuth("user", "pass")
+    c.register_plugin(auth)
+    assert c.plugin() is auth
+    with pytest.raises(ValueError):
+        c.register_plugin(auth)
+    req = Request({"x": "1"})
+    c._call_plugin(req)
+    assert req.headers["Authorization"].startswith("Basic ")
+    c.unregister_plugin()
+    with pytest.raises(ValueError):
+        c.unregister_plugin()
