@@ -1,0 +1,8 @@
+"""Generated protobuf message modules (see tools/gen_protos.sh)."""
+
+from client_tpu.grpc._generated import model_config_pb2  # noqa: F401
+from client_tpu.grpc._generated import grpc_service_pb2  # noqa: F401
+
+# Compatibility aliases matching the reference wheel's module names
+# (service_pb2 / model_config_pb2).
+service_pb2 = grpc_service_pb2

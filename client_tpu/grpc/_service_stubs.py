@@ -1,0 +1,146 @@
+"""Hand-written gRPC stubs/servicer glue for inference.GRPCInferenceService.
+
+grpc_tools (the protoc gRPC plugin) is not available in this environment, so
+the thin service-binding layer normally emitted into ``*_pb2_grpc.py`` is
+written by hand here. It is equivalent in behavior: a ``Stub`` built from a
+channel (works with both ``grpc.Channel`` and ``grpc.aio.Channel``) and an
+``add_*_to_server`` registration helper for servicers.
+
+Method surface parity: the 20 RPCs the reference client uses (reference
+src/python/library/tritonclient/grpc/_client.py) plus the three
+TpuSharedMemory* RPCs of the client_tpu extension.
+"""
+
+import grpc
+
+from client_tpu.grpc._generated import grpc_service_pb2 as pb
+
+_SERVICE = "inference.GRPCInferenceService"
+
+# method name -> (kind, request message, response message)
+# kind: 'uu' unary-unary, 'ss' stream-stream
+_METHODS = {
+    "ServerLive": ("uu", pb.ServerLiveRequest, pb.ServerLiveResponse),
+    "ServerReady": ("uu", pb.ServerReadyRequest, pb.ServerReadyResponse),
+    "ModelReady": ("uu", pb.ModelReadyRequest, pb.ModelReadyResponse),
+    "ServerMetadata": ("uu", pb.ServerMetadataRequest, pb.ServerMetadataResponse),
+    "ModelMetadata": ("uu", pb.ModelMetadataRequest, pb.ModelMetadataResponse),
+    "ModelInfer": ("uu", pb.ModelInferRequest, pb.ModelInferResponse),
+    "ModelStreamInfer": ("ss", pb.ModelInferRequest, pb.ModelStreamInferResponse),
+    "ModelConfig": ("uu", pb.ModelConfigRequest, pb.ModelConfigResponse),
+    "ModelStatistics": ("uu", pb.ModelStatisticsRequest, pb.ModelStatisticsResponse),
+    "RepositoryIndex": ("uu", pb.RepositoryIndexRequest, pb.RepositoryIndexResponse),
+    "RepositoryModelLoad": (
+        "uu",
+        pb.RepositoryModelLoadRequest,
+        pb.RepositoryModelLoadResponse,
+    ),
+    "RepositoryModelUnload": (
+        "uu",
+        pb.RepositoryModelUnloadRequest,
+        pb.RepositoryModelUnloadResponse,
+    ),
+    "SystemSharedMemoryStatus": (
+        "uu",
+        pb.SystemSharedMemoryStatusRequest,
+        pb.SystemSharedMemoryStatusResponse,
+    ),
+    "SystemSharedMemoryRegister": (
+        "uu",
+        pb.SystemSharedMemoryRegisterRequest,
+        pb.SystemSharedMemoryRegisterResponse,
+    ),
+    "SystemSharedMemoryUnregister": (
+        "uu",
+        pb.SystemSharedMemoryUnregisterRequest,
+        pb.SystemSharedMemoryUnregisterResponse,
+    ),
+    "CudaSharedMemoryStatus": (
+        "uu",
+        pb.CudaSharedMemoryStatusRequest,
+        pb.CudaSharedMemoryStatusResponse,
+    ),
+    "CudaSharedMemoryRegister": (
+        "uu",
+        pb.CudaSharedMemoryRegisterRequest,
+        pb.CudaSharedMemoryRegisterResponse,
+    ),
+    "CudaSharedMemoryUnregister": (
+        "uu",
+        pb.CudaSharedMemoryUnregisterRequest,
+        pb.CudaSharedMemoryUnregisterResponse,
+    ),
+    "TpuSharedMemoryStatus": (
+        "uu",
+        pb.TpuSharedMemoryStatusRequest,
+        pb.TpuSharedMemoryStatusResponse,
+    ),
+    "TpuSharedMemoryRegister": (
+        "uu",
+        pb.TpuSharedMemoryRegisterRequest,
+        pb.TpuSharedMemoryRegisterResponse,
+    ),
+    "TpuSharedMemoryUnregister": (
+        "uu",
+        pb.TpuSharedMemoryUnregisterRequest,
+        pb.TpuSharedMemoryUnregisterResponse,
+    ),
+    "TraceSetting": ("uu", pb.TraceSettingRequest, pb.TraceSettingResponse),
+    "LogSettings": ("uu", pb.LogSettingsRequest, pb.LogSettingsResponse),
+}
+
+
+class GRPCInferenceServiceStub:
+    """Client stub; pass a ``grpc.Channel`` or ``grpc.aio.Channel``."""
+
+    def __init__(self, channel):
+        for name, (kind, req, resp) in _METHODS.items():
+            factory = channel.unary_unary if kind == "uu" else channel.stream_stream
+            setattr(
+                self,
+                name,
+                factory(
+                    f"/{_SERVICE}/{name}",
+                    request_serializer=req.SerializeToString,
+                    response_deserializer=resp.FromString,
+                ),
+            )
+
+
+class GRPCInferenceServiceServicer:
+    """Server-side base class; override the RPC methods you implement."""
+
+    def _unimplemented(self, context):
+        context.set_code(grpc.StatusCode.UNIMPLEMENTED)
+        context.set_details("Method not implemented")
+        raise NotImplementedError("Method not implemented")
+
+
+def _make_default(name):
+    def handler(self, request, context):
+        self._unimplemented(context)
+
+    handler.__name__ = name
+    return handler
+
+
+for _name in _METHODS:
+    setattr(GRPCInferenceServiceServicer, _name, _make_default(_name))
+
+
+def add_GRPCInferenceServiceServicer_to_server(servicer, server):
+    handlers = {}
+    for name, (kind, req, resp) in _METHODS.items():
+        make = (
+            grpc.unary_unary_rpc_method_handler
+            if kind == "uu"
+            else grpc.stream_stream_rpc_method_handler
+        )
+        handlers[name] = make(
+            getattr(servicer, name),
+            request_deserializer=req.FromString,
+            response_serializer=resp.SerializeToString,
+        )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_SERVICE, handlers),)
+    )

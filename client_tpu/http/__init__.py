@@ -1,0 +1,195 @@
+"""Synchronous HTTP/REST client for KServe v2 inference servers.
+
+A thin synchronous veneer over the asyncio client in
+``client_tpu.http.aio`` (one private event-loop thread per client). Method
+surface parity with the reference sync HTTP client
+(reference src/python/library/tritonclient/http/_client.py:102-1500),
+including ``async_infer`` which returns an :class:`InferAsyncRequest`.
+
+Unlike the reference client (gevent-based, "not thread safe",
+reference http/_client.py:102-108), this client may be used from multiple
+threads: calls serialize onto the private loop's connection pool.
+"""
+
+import concurrent.futures
+import functools
+from typing import Any, Dict, Optional, Sequence
+
+from client_tpu._sync_runner import EventLoopRunner
+from client_tpu.http import aio as _aio
+from client_tpu.http._infer_input import InferInput
+from client_tpu.http._infer_result import InferResult
+from client_tpu.http._requested_output import InferRequestedOutput
+from client_tpu.utils import InferenceServerException
+
+__all__ = [
+    "InferenceServerClient",
+    "InferAsyncRequest",
+    "InferInput",
+    "InferRequestedOutput",
+    "InferResult",
+]
+
+
+class InferAsyncRequest:
+    """Handle to an in-flight async_infer request."""
+
+    def __init__(self, future: concurrent.futures.Future):
+        self._future = future
+
+    def get_result(self, block: bool = True, timeout: Optional[float] = None):
+        """Wait for and return the :class:`InferResult`.
+
+        Raises
+        ------
+        InferenceServerException
+            If the request failed, or ``block=False`` and it is still
+            in flight.
+        """
+        if not block and not self._future.done():
+            raise InferenceServerException("request is not yet completed")
+        try:
+            return self._future.result(timeout)
+        except concurrent.futures.TimeoutError:
+            raise InferenceServerException(
+                "timeout waiting for async infer result"
+            ) from None
+
+    def cancel(self) -> bool:
+        """Best-effort cancellation of the in-flight request."""
+        return self._future.cancel()
+
+
+def _delegated(name, doc_source=None):
+    """Build a sync method delegating to the aio client's coroutine."""
+
+    def method(self, *args, **kwargs):
+        return self._runner.run(getattr(self._aio_client, name)(*args, **kwargs))
+
+    method.__name__ = name
+    src = doc_source or getattr(_aio.InferenceServerClient, name, None)
+    if src is not None and src.__doc__:
+        method.__doc__ = src.__doc__
+    return method
+
+
+class InferenceServerClient:
+    """Synchronous client for the KServe v2 HTTP/REST protocol."""
+
+    def __init__(
+        self,
+        url: str,
+        verbose: bool = False,
+        concurrency: int = 16,
+        connection_timeout: float = 60.0,
+        network_timeout: float = 60.0,
+        ssl: bool = False,
+        ssl_context=None,
+    ):
+        self._runner = EventLoopRunner(name=f"client-tpu-http[{url}]")
+        self._aio_client = _aio.InferenceServerClient(
+            url,
+            verbose=verbose,
+            concurrency=concurrency,
+            connection_timeout=connection_timeout,
+            network_timeout=network_timeout,
+            ssl=ssl,
+            ssl_context=ssl_context,
+        )
+
+    # plugin registry delegates to the aio client so headers flow through it
+    def register_plugin(self, plugin):
+        self._aio_client.register_plugin(plugin)
+
+    def plugin(self):
+        return self._aio_client.plugin()
+
+    def unregister_plugin(self):
+        self._aio_client.unregister_plugin()
+
+    # health
+    is_server_live = _delegated("is_server_live")
+    is_server_ready = _delegated("is_server_ready")
+    is_model_ready = _delegated("is_model_ready")
+    # metadata / config
+    get_server_metadata = _delegated("get_server_metadata")
+    get_model_metadata = _delegated("get_model_metadata")
+    get_model_config = _delegated("get_model_config")
+    # repository
+    get_model_repository_index = _delegated("get_model_repository_index")
+    load_model = _delegated("load_model")
+    unload_model = _delegated("unload_model")
+    # statistics / settings
+    get_inference_statistics = _delegated("get_inference_statistics")
+    update_trace_settings = _delegated("update_trace_settings")
+    get_trace_settings = _delegated("get_trace_settings")
+    update_log_settings = _delegated("update_log_settings")
+    get_log_settings = _delegated("get_log_settings")
+    # shared memory
+    get_system_shared_memory_status = _delegated("get_system_shared_memory_status")
+    register_system_shared_memory = _delegated("register_system_shared_memory")
+    unregister_system_shared_memory = _delegated("unregister_system_shared_memory")
+    get_cuda_shared_memory_status = _delegated("get_cuda_shared_memory_status")
+    register_cuda_shared_memory = _delegated("register_cuda_shared_memory")
+    unregister_cuda_shared_memory = _delegated("unregister_cuda_shared_memory")
+    get_tpu_shared_memory_status = _delegated("get_tpu_shared_memory_status")
+    register_tpu_shared_memory = _delegated("register_tpu_shared_memory")
+    unregister_tpu_shared_memory = _delegated("unregister_tpu_shared_memory")
+    # inference
+    infer = _delegated("infer")
+
+    generate_request_body = staticmethod(
+        _aio.InferenceServerClient.generate_request_body
+    )
+    parse_response_body = staticmethod(
+        _aio.InferenceServerClient.parse_response_body
+    )
+
+    def async_infer(self, model_name, inputs, **kwargs) -> InferAsyncRequest:
+        """Issue an inference without blocking; returns a request handle.
+
+        ``callback``, if given, is invoked as ``callback(result, error)``
+        from the client's loop thread when the request completes.
+        """
+        callback = kwargs.pop("callback", None)
+        future = self._runner.submit(
+            self._aio_client.infer(model_name, inputs, **kwargs)
+        )
+        if callback is not None:
+
+            def _done(f: concurrent.futures.Future):
+                try:
+                    callback(f.result(), None)
+                except Exception as e:  # noqa: BLE001 - surface to callback
+                    callback(None, e)
+
+            future.add_done_callback(_done)
+        return InferAsyncRequest(future)
+
+    def close(self) -> None:
+        """Close the connection pool and stop the loop thread."""
+        try:
+            self._runner.run(self._aio_client.close())
+        finally:
+            self._runner.close()
+
+    def __enter__(self) -> "InferenceServerClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # best-effort cleanup, mirrors close()
+        try:
+            runner = self.__dict__.get("_runner")
+            aio_client = self.__dict__.get("_aio_client")
+            if runner is None:
+                return
+            if aio_client is not None:
+                try:
+                    runner.run(aio_client.close(), timeout=5)
+                except Exception:
+                    pass
+            runner.close()
+        except Exception:
+            pass

@@ -1,0 +1,495 @@
+"""Asyncio HTTP/REST client for KServe v2 inference servers.
+
+This is the *primary* HTTP implementation (the sync client in
+``client_tpu.http`` delegates to it through a background event loop —
+inverting the reference, which built sync-on-gevent first and bolted aio on;
+reference src/python/library/tritonclient/http/aio/__init__.py:92-775 is the
+surface model).
+
+Method surface parity with the reference HTTP client
+(reference src/python/library/tritonclient/http/_client.py:340-1177), plus
+the TPU shared-memory registration trio that replaces the CUDA one.
+"""
+
+import asyncio
+import json
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import aiohttp
+
+from client_tpu._client import InferenceServerClientBase
+from client_tpu._request import Request
+from client_tpu.http._infer_input import InferInput
+from client_tpu.http._infer_result import InferResult
+from client_tpu.http._requested_output import InferRequestedOutput
+from client_tpu.http._utils import (
+    HEADER_CONTENT_LENGTH,
+    build_query_string,
+    compress_body,
+    get_inference_request_body,
+    model_infer_uri,
+    parse_json_response,
+    raise_if_error,
+)
+from client_tpu.utils import InferenceServerException
+
+__all__ = ["InferenceServerClient", "InferInput", "InferRequestedOutput", "InferResult"]
+
+
+class InferenceServerClient(InferenceServerClientBase):
+    """An asyncio client for the KServe v2 HTTP/REST protocol.
+
+    Parameters
+    ----------
+    url:
+        Host:port of the server, e.g. ``"localhost:8000"``.
+    verbose:
+        Print request/response traffic.
+    concurrency:
+        Connection-pool size (the reference's greenlet concurrency knob).
+    connection_timeout / network_timeout:
+        Connect / total-read timeouts in seconds.
+    ssl:
+        Use https. ``ssl_context`` may carry a preconfigured
+        ``ssl.SSLContext``.
+    """
+
+    def __init__(
+        self,
+        url: str,
+        verbose: bool = False,
+        concurrency: int = 16,
+        connection_timeout: float = 60.0,
+        network_timeout: float = 60.0,
+        ssl: bool = False,
+        ssl_context=None,
+    ):
+        super().__init__()
+        scheme = "https" if ssl else "http"
+        if "://" in url:
+            raise InferenceServerException(
+                f"url should not include the scheme: '{url}'"
+            )
+        self._base_url = f"{scheme}://{url}"
+        self._verbose = verbose
+        self._ssl_context = ssl_context
+        self._timeout = aiohttp.ClientTimeout(
+            connect=connection_timeout, total=network_timeout
+        )
+        self._connector_limit = concurrency
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    # -- session lifecycle -------------------------------------------------
+
+    def _ensure_session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            connector = aiohttp.TCPConnector(
+                limit=self._connector_limit, ssl=self._ssl_context
+            )
+            # auto_decompress off: compression is negotiated and handled by
+            # this client itself (response_compression_algorithm), so the
+            # Content-Encoding header always matches the body we parse.
+            self._session = aiohttp.ClientSession(
+                connector=connector,
+                timeout=self._timeout,
+                auto_decompress=False,
+                headers={"Accept-Encoding": "identity"},
+            )
+        return self._session
+
+    async def close(self) -> None:
+        """Close the underlying connection pool."""
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def __aenter__(self) -> "InferenceServerClient":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    # -- low-level request helpers ----------------------------------------
+
+    def _prepare_headers(
+        self, headers: Optional[Dict[str, str]]
+    ) -> Dict[str, str]:
+        request = Request(headers or {})
+        self._call_plugin(request)
+        return request.headers
+
+    async def _get(self, path, headers, query_params) -> tuple:
+        url = f"{self._base_url}/{path}{build_query_string(query_params)}"
+        if self._verbose:
+            print(f"GET {url}")
+        session = self._ensure_session()
+        async with session.get(
+            url, headers=self._prepare_headers(headers)
+        ) as resp:
+            body = await resp.read()
+            if self._verbose:
+                print(f"-> {resp.status} ({len(body)} bytes)")
+            return resp.status, body, dict(resp.headers)
+
+    async def _post(
+        self, path, body: bytes, headers, query_params, timeout=None
+    ) -> tuple:
+        url = f"{self._base_url}/{path}{build_query_string(query_params)}"
+        if self._verbose:
+            print(f"POST {url} ({len(body)} bytes)")
+        session = self._ensure_session()
+        req_timeout = (
+            aiohttp.ClientTimeout(total=timeout) if timeout else None
+        )
+        async with session.post(
+            url,
+            data=body,
+            headers=self._prepare_headers(headers),
+            timeout=req_timeout,
+        ) as resp:
+            rbody = await resp.read()
+            if self._verbose:
+                print(f"-> {resp.status} ({len(rbody)} bytes)")
+            return resp.status, rbody, dict(resp.headers)
+
+    async def _get_json(self, path, headers, query_params) -> Dict[str, Any]:
+        status, body, _ = await self._get(path, headers, query_params)
+        return parse_json_response(status, body)
+
+    async def _post_json(
+        self, path, request: Optional[Dict[str, Any]], headers, query_params
+    ) -> Dict[str, Any]:
+        body = json.dumps(request).encode("utf-8") if request is not None else b""
+        status, rbody, _ = await self._post(path, body, headers, query_params)
+        return parse_json_response(status, rbody)
+
+    # -- health ------------------------------------------------------------
+
+    async def is_server_live(self, headers=None, query_params=None) -> bool:
+        status, _, _ = await self._get("v2/health/live", headers, query_params)
+        return status == 200
+
+    async def is_server_ready(self, headers=None, query_params=None) -> bool:
+        status, _, _ = await self._get("v2/health/ready", headers, query_params)
+        return status == 200
+
+    async def is_model_ready(
+        self, model_name, model_version="", headers=None, query_params=None
+    ) -> bool:
+        path = f"v2/models/{model_name}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        status, _, _ = await self._get(f"{path}/ready", headers, query_params)
+        return status == 200
+
+    # -- metadata / config -------------------------------------------------
+
+    async def get_server_metadata(self, headers=None, query_params=None):
+        return await self._get_json("v2", headers, query_params)
+
+    async def get_model_metadata(
+        self, model_name, model_version="", headers=None, query_params=None
+    ):
+        path = f"v2/models/{model_name}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        return await self._get_json(path, headers, query_params)
+
+    async def get_model_config(
+        self, model_name, model_version="", headers=None, query_params=None
+    ):
+        path = f"v2/models/{model_name}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        return await self._get_json(f"{path}/config", headers, query_params)
+
+    # -- repository control ------------------------------------------------
+
+    async def get_model_repository_index(self, headers=None, query_params=None):
+        return await self._post_json(
+            "v2/repository/index", None, headers, query_params
+        )
+
+    async def load_model(
+        self,
+        model_name,
+        headers=None,
+        query_params=None,
+        config: Optional[str] = None,
+        files: Optional[Dict[str, bytes]] = None,
+    ) -> None:
+        """Load (or reload) a model, optionally overriding config/files.
+
+        ``config`` is a JSON model-config string; ``files`` maps
+        ``file:<relative-path>`` names to raw content (base64'd on the wire),
+        matching the reference contract
+        (reference src/python/library/tritonclient/http/_client.py:620-672).
+        """
+        load_request: Dict[str, Any] = {}
+        if config is not None or files:
+            params: Dict[str, Any] = {}
+            if config is not None:
+                params["config"] = config
+            if files:
+                import base64
+
+                for name, content in files.items():
+                    params[name] = base64.b64encode(content).decode("ascii")
+            load_request["parameters"] = params
+        await self._post_json(
+            f"v2/repository/models/{model_name}/load",
+            load_request,
+            headers,
+            query_params,
+        )
+
+    async def unload_model(
+        self,
+        model_name,
+        headers=None,
+        query_params=None,
+        unload_dependents: bool = False,
+    ) -> None:
+        request = {
+            "parameters": {"unload_dependents": unload_dependents}
+        }
+        await self._post_json(
+            f"v2/repository/models/{model_name}/unload",
+            request,
+            headers,
+            query_params,
+        )
+
+    # -- statistics / settings ----------------------------------------------
+
+    async def get_inference_statistics(
+        self, model_name="", model_version="", headers=None, query_params=None
+    ):
+        if model_name:
+            path = f"v2/models/{model_name}"
+            if model_version:
+                path += f"/versions/{model_version}"
+            path += "/stats"
+        else:
+            path = "v2/models/stats"
+        return await self._get_json(path, headers, query_params)
+
+    async def update_trace_settings(
+        self, model_name=None, settings=None, headers=None, query_params=None
+    ):
+        path = (
+            f"v2/models/{model_name}/trace/setting"
+            if model_name
+            else "v2/trace/setting"
+        )
+        return await self._post_json(
+            path, settings or {}, headers, query_params
+        )
+
+    async def get_trace_settings(
+        self, model_name=None, headers=None, query_params=None
+    ):
+        path = (
+            f"v2/models/{model_name}/trace/setting"
+            if model_name
+            else "v2/trace/setting"
+        )
+        return await self._get_json(path, headers, query_params)
+
+    async def update_log_settings(
+        self, settings, headers=None, query_params=None
+    ):
+        return await self._post_json("v2/logging", settings, headers, query_params)
+
+    async def get_log_settings(self, headers=None, query_params=None):
+        return await self._get_json("v2/logging", headers, query_params)
+
+    # -- shared memory ------------------------------------------------------
+
+    async def get_system_shared_memory_status(
+        self, region_name="", headers=None, query_params=None
+    ):
+        path = "v2/systemsharedmemory"
+        if region_name:
+            path += f"/region/{region_name}"
+        return await self._get_json(f"{path}/status", headers, query_params)
+
+    async def register_system_shared_memory(
+        self, name, key, byte_size, offset=0, headers=None, query_params=None
+    ) -> None:
+        request = {"key": key, "offset": offset, "byte_size": byte_size}
+        await self._post_json(
+            f"v2/systemsharedmemory/region/{name}/register",
+            request,
+            headers,
+            query_params,
+        )
+
+    async def unregister_system_shared_memory(
+        self, name="", headers=None, query_params=None
+    ) -> None:
+        path = "v2/systemsharedmemory"
+        if name:
+            path += f"/region/{name}"
+        await self._post_json(f"{path}/unregister", None, headers, query_params)
+
+    async def get_cuda_shared_memory_status(
+        self, region_name="", headers=None, query_params=None
+    ):
+        path = "v2/cudasharedmemory"
+        if region_name:
+            path += f"/region/{region_name}"
+        return await self._get_json(f"{path}/status", headers, query_params)
+
+    async def register_cuda_shared_memory(
+        self, name, raw_handle, device_id, byte_size, headers=None, query_params=None
+    ) -> None:
+        """Register a CUDA-IPC region (only meaningful against GPU servers)."""
+        import base64
+
+        request = {
+            "raw_handle": {
+                "b64": base64.b64encode(raw_handle).decode("ascii")
+            },
+            "device_id": device_id,
+            "byte_size": byte_size,
+        }
+        await self._post_json(
+            f"v2/cudasharedmemory/region/{name}/register",
+            request,
+            headers,
+            query_params,
+        )
+
+    async def unregister_cuda_shared_memory(
+        self, name="", headers=None, query_params=None
+    ) -> None:
+        path = "v2/cudasharedmemory"
+        if name:
+            path += f"/region/{name}"
+        await self._post_json(f"{path}/unregister", None, headers, query_params)
+
+    async def get_tpu_shared_memory_status(
+        self, region_name="", headers=None, query_params=None
+    ):
+        path = "v2/tpusharedmemory"
+        if region_name:
+            path += f"/region/{region_name}"
+        return await self._get_json(f"{path}/status", headers, query_params)
+
+    async def register_tpu_shared_memory(
+        self, name, raw_handle, device_id, byte_size, headers=None, query_params=None
+    ) -> None:
+        """Register a TPU shared-memory region (client_tpu extension).
+
+        ``raw_handle`` comes from
+        :func:`client_tpu.utils.tpu_shared_memory.get_raw_handle`.
+        """
+        import base64
+
+        request = {
+            "raw_handle": {
+                "b64": base64.b64encode(raw_handle).decode("ascii")
+            },
+            "device_id": device_id,
+            "byte_size": byte_size,
+        }
+        await self._post_json(
+            f"v2/tpusharedmemory/region/{name}/register",
+            request,
+            headers,
+            query_params,
+        )
+
+    async def unregister_tpu_shared_memory(
+        self, name="", headers=None, query_params=None
+    ) -> None:
+        path = "v2/tpusharedmemory"
+        if name:
+            path += f"/region/{name}"
+        await self._post_json(f"{path}/unregister", None, headers, query_params)
+
+    # -- inference ----------------------------------------------------------
+
+    @staticmethod
+    def generate_request_body(
+        inputs,
+        request_id="",
+        outputs=None,
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        parameters=None,
+    ):
+        """Build an inference request body offline.
+
+        Returns ``(body, json_size)`` — json_size is None for pure-JSON
+        bodies (reference http/_client.py:1219-1300 static twin).
+        """
+        return get_inference_request_body(
+            inputs,
+            request_id=request_id,
+            outputs=outputs,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout,
+            parameters=parameters,
+        )
+
+    @staticmethod
+    def parse_response_body(response_body, header_length=None):
+        """Parse a raw response body built by :meth:`generate_request_body`'s
+        round trip (reference http/_client.py:1304-1330 static twin)."""
+        return InferResult(response_body, header_length)
+
+    async def infer(
+        self,
+        model_name: str,
+        inputs: Sequence[InferInput],
+        model_version: str = "",
+        outputs: Optional[Sequence[InferRequestedOutput]] = None,
+        request_id: str = "",
+        sequence_id: int = 0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+        priority: int = 0,
+        timeout: Optional[float] = None,
+        headers: Optional[Dict[str, str]] = None,
+        query_params: Optional[Dict[str, Any]] = None,
+        request_compression_algorithm: Optional[str] = None,
+        response_compression_algorithm: Optional[str] = None,
+        parameters: Optional[Dict[str, Any]] = None,
+    ) -> InferResult:
+        """Run a synchronous (from the caller's view: awaited) inference."""
+        body, json_size = get_inference_request_body(
+            inputs,
+            request_id=request_id,
+            outputs=outputs,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+            priority=priority,
+            timeout=int(timeout * 1_000_000) if timeout else None,
+            parameters=parameters,
+        )
+        extra_headers = dict(headers) if headers else {}
+        body, encoding = compress_body(body, request_compression_algorithm)
+        if encoding:
+            extra_headers["Content-Encoding"] = encoding
+        if response_compression_algorithm:
+            extra_headers["Accept-Encoding"] = response_compression_algorithm
+        if json_size is not None:
+            extra_headers[HEADER_CONTENT_LENGTH] = str(json_size)
+
+        status, rbody, rheaders = await self._post(
+            model_infer_uri(model_name, model_version),
+            body,
+            extra_headers,
+            query_params,
+            timeout=timeout,
+        )
+        raise_if_error(status, rbody)
+        return InferResult.from_response(rbody, rheaders)

@@ -1,0 +1,23 @@
+"""An in-repo KServe v2 inference server backed by JAX models.
+
+The reference client stack is tested against a live Triton server and ships
+an in-process ``triton_c_api`` backend for network-free measurement
+(reference src/c++/perf_analyzer/client_backend/triton_c_api/). This package
+plays both roles for client_tpu:
+
+- ``client_tpu.server.http_server`` / ``grpc_server``: real network servers
+  speaking the KServe v2 HTTP/REST and gRPC protocols (health, metadata,
+  infer with binary tensors, decoupled streaming, shared-memory registration,
+  statistics, repository control, trace/log settings);
+- ``client_tpu.server.core.ServerCore``: the protocol-independent engine,
+  usable in-process for overhead-free baselines;
+- ``client_tpu.server.models``: built-in JAX models (add_sub "simple",
+  identity, and the model-zoo adapters from ``client_tpu.models``).
+
+It is a genuine (single-node) serving runtime for JAX/XLA models on TPU, not
+a mock: tensors move through the same dtype/serialization layer the clients
+use, and the TPU shared-memory data plane is fully honored.
+"""
+
+from client_tpu.server.core import ServerCore  # noqa: F401
+from client_tpu.server.model_repository import Model, ModelRepository  # noqa: F401

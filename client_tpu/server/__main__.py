@@ -1,0 +1,72 @@
+"""CLI entry point: ``python -m client_tpu.server``.
+
+Starts the KServe v2 HTTP + gRPC front-ends with the built-in fixture models
+and (optionally) a model repository directory of ``<name>/model.py`` models.
+"""
+
+import argparse
+import asyncio
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="client_tpu.server",
+        description="TPU-native KServe v2 inference server (JAX backend)",
+    )
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--http-port", type=int, default=8000)
+    parser.add_argument("--grpc-port", type=int, default=8001)
+    parser.add_argument(
+        "--model-repository",
+        default=None,
+        help="directory of <name>/model.py models (python_backend analogue)",
+    )
+    parser.add_argument(
+        "--no-builtin-models",
+        action="store_true",
+        help="skip the built-in fixture models (simple, identity_*, repeat)",
+    )
+    parser.add_argument(
+        "--max-workers", type=int, default=8, help="model execution threads"
+    )
+    args = parser.parse_args(argv)
+
+    from client_tpu.server.core import ServerCore
+    from client_tpu.server.model_repository import ModelRepository
+
+    repository = ModelRepository(args.model_repository)
+    core = ServerCore(repository, max_workers=args.max_workers)
+    if not args.no_builtin_models:
+        from client_tpu.server.models import register_builtin_models
+
+        register_builtin_models(repository)
+    repository.scan()
+
+    async def serve() -> None:
+        from client_tpu.server.grpc_server import serve_grpc
+        from client_tpu.server.http_server import serve_http
+
+        http_runner = await serve_http(core, args.host, args.http_port)
+        grpc_server, grpc_port = await serve_grpc(
+            core, args.host, args.grpc_port
+        )
+        print(
+            f"client_tpu server listening: http={args.host}:"
+            f"{http_runner.addresses[0][1]} grpc={args.host}:{grpc_port}",
+            flush=True,
+        )
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await grpc_server.stop(grace=2)
+            await http_runner.cleanup()
+
+    try:
+        asyncio.run(serve())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,420 @@
+"""Protocol-independent server engine.
+
+Both the HTTP and gRPC front-ends reduce a request to :class:`CoreRequest`
+(name->ndarray inputs plus requested-output descriptors), hand it to
+:meth:`ServerCore.infer` / :meth:`ServerCore.infer_decoupled`, and serialize
+the returned :class:`CoreResponse` objects back onto their wire. Statistics
+are accounted the way Triton's statistics extension reports them
+(success/fail/queue/compute_input/compute_infer/compute_output cumulative
+count+ns; reference SURVEY.md §5 observability).
+"""
+
+import asyncio
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+import numpy as np
+
+from client_tpu.server.model_repository import Model, ModelRepository
+from client_tpu.server.shm import SharedMemoryManager
+from client_tpu.utils import (
+    InferenceServerException,
+    deserialize_bytes_tensor,
+    np_to_triton_dtype,
+    num_elements,
+    serialize_byte_tensor,
+    triton_to_np_dtype,
+)
+
+SERVER_NAME = "client_tpu_server"
+SERVER_VERSION = "0.1.0"
+SERVER_EXTENSIONS = [
+    "classification",
+    "sequence",
+    "model_repository",
+    "model_repository(unload_dependents)",
+    "schedule_policy",
+    "model_configuration",
+    "system_shared_memory",
+    "cuda_shared_memory",
+    "tpu_shared_memory",
+    "binary_tensor_data",
+    "parameters",
+    "statistics",
+    "trace",
+    "logging",
+]
+
+
+@dataclass
+class CoreTensor:
+    name: str
+    datatype: str
+    shape: List[int]
+    data: np.ndarray  # host ndarray (object dtype for BYTES)
+
+
+@dataclass
+class CoreRequestedOutput:
+    name: str
+    binary_data: bool = False
+    classification: int = 0
+    shm_region: Optional[str] = None
+    shm_byte_size: int = 0
+    shm_offset: int = 0
+
+
+@dataclass
+class CoreRequest:
+    model_name: str
+    model_version: str = ""
+    id: str = ""
+    inputs: List[CoreTensor] = field(default_factory=list)
+    outputs: List[CoreRequestedOutput] = field(default_factory=list)
+    parameters: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class CoreResponse:
+    model_name: str
+    model_version: str
+    id: str
+    outputs: List[CoreTensor]
+    parameters: Dict[str, Any] = field(default_factory=dict)
+    # outputs redirected to shared memory: name -> (region, byte_size, offset)
+    shm_outputs: Dict[str, Any] = field(default_factory=dict)
+
+
+class _Stats:
+    """Cumulative per-model statistics (counts + ns)."""
+
+    FIELDS = ("success", "fail", "queue", "compute_input", "compute_infer", "compute_output")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.counts = {f: 0 for f in self.FIELDS}
+        self.ns = {f: 0 for f in self.FIELDS}
+        self.inference_count = 0
+        self.execution_count = 0
+        self.last_inference = 0
+
+    def record(self, field_name: str, duration_ns: int) -> None:
+        with self.lock:
+            self.counts[field_name] += 1
+            self.ns[field_name] += duration_ns
+
+    def record_success(self, batch: int, queue_ns, in_ns, infer_ns, out_ns):
+        now_ms = int(time.time() * 1000)
+        total = queue_ns + in_ns + infer_ns + out_ns
+        with self.lock:
+            self.inference_count += batch
+            self.execution_count += 1
+            self.last_inference = now_ms
+            for f, ns in (
+                ("success", total),
+                ("queue", queue_ns),
+                ("compute_input", in_ns),
+                ("compute_infer", infer_ns),
+                ("compute_output", out_ns),
+            ):
+                self.counts[f] += 1
+                self.ns[f] += ns
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self.lock:
+            return {
+                "inference_count": self.inference_count,
+                "execution_count": self.execution_count,
+                "last_inference": self.last_inference,
+                "inference_stats": {
+                    f: {"count": self.counts[f], "ns": self.ns[f]}
+                    for f in self.FIELDS
+                },
+            }
+
+
+class ServerCore:
+    """The protocol-independent inference engine."""
+
+    def __init__(
+        self,
+        repository: Optional[ModelRepository] = None,
+        max_workers: int = 8,
+    ):
+        self.repository = repository or ModelRepository()
+        self.shm = SharedMemoryManager()
+        self.stats: Dict[str, _Stats] = {}
+        self._stats_lock = threading.Lock()
+        self._executor = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="client-tpu-exec"
+        )
+        self.live = True
+        self.trace_settings: Dict[str, Any] = {
+            "trace_level": ["OFF"],
+            "trace_rate": "1000",
+            "trace_count": "-1",
+            "log_frequency": "0",
+            "trace_file": "",
+        }
+        self.log_settings: Dict[str, Any] = {
+            "log_file": "",
+            "log_info": True,
+            "log_warning": True,
+            "log_error": True,
+            "log_verbose_level": 0,
+            "log_format": "default",
+        }
+
+    def close(self) -> None:
+        self._executor.shutdown(wait=False, cancel_futures=True)
+
+    def _stats_for(self, model_name: str) -> _Stats:
+        with self._stats_lock:
+            if model_name not in self.stats:
+                self.stats[model_name] = _Stats()
+            return self.stats[model_name]
+
+    # -- statistics API ------------------------------------------------------
+
+    def statistics(self, model_name: str = "", model_version: str = ""):
+        models = (
+            [model_name]
+            if model_name
+            else [m["name"] for m in self.repository.index()]
+        )
+        result = []
+        for name in models:
+            try:
+                model = self.repository.get(name)
+            except InferenceServerException:
+                if model_name:
+                    raise
+                continue
+            snap = self._stats_for(name).snapshot()
+            snap.update({"name": name, "version": model.version})
+            result.append(snap)
+        return {"model_stats": result}
+
+    # -- inference -----------------------------------------------------------
+
+    def _resolve_batch(self, model: Model, request: CoreRequest) -> int:
+        if not request.inputs:
+            return 1
+        shape = request.inputs[0].shape
+        return int(shape[0]) if (model.max_batch_size > 0 and shape) else 1
+
+    def _run_model(
+        self, model: Model, request: CoreRequest
+    ) -> Dict[str, np.ndarray]:
+        inputs = {t.name: t.data for t in request.inputs}
+        declared = {i["name"] for i in model.inputs}
+        for t in request.inputs:
+            if declared and t.name not in declared:
+                raise InferenceServerException(
+                    f"unexpected inference input '{t.name}' for model "
+                    f"'{model.name}'"
+                )
+        return model.execute(inputs, request.parameters)
+
+    def _package_outputs(
+        self, model: Model, request: CoreRequest, raw: Dict[str, np.ndarray]
+    ) -> CoreResponse:
+        requested = request.outputs or [
+            CoreRequestedOutput(name=o["name"]) for o in model.outputs
+        ]
+        out_tensors: List[CoreTensor] = []
+        shm_outputs: Dict[str, Any] = {}
+        for req_out in requested:
+            if req_out.name not in raw:
+                raise InferenceServerException(
+                    f"unexpected inference output '{req_out.name}' for model "
+                    f"'{model.name}'"
+                )
+            arr = np.asarray(raw[req_out.name])
+            if req_out.classification > 0:
+                arr = self._classify(model, req_out, arr)
+            datatype = np_to_triton_dtype(arr.dtype)
+            tensor = CoreTensor(
+                name=req_out.name,
+                datatype=datatype,
+                shape=list(arr.shape),
+                data=arr,
+            )
+            if req_out.shm_region is not None:
+                if datatype == "BYTES":
+                    payload = serialize_byte_tensor(arr).tobytes()
+                else:
+                    payload = np.ascontiguousarray(arr).tobytes()
+                if len(payload) > req_out.shm_byte_size:
+                    raise InferenceServerException(
+                        f"shared memory region for output '{req_out.name}' is "
+                        f"too small: need {len(payload)} bytes, have "
+                        f"{req_out.shm_byte_size}"
+                    )
+                self.shm.write(req_out.shm_region, req_out.shm_offset, payload)
+                shm_outputs[req_out.name] = (
+                    req_out.shm_region,
+                    len(payload),
+                    req_out.shm_offset,
+                )
+            out_tensors.append(tensor)
+        return CoreResponse(
+            model_name=model.name,
+            model_version=model.version,
+            id=request.id,
+            outputs=out_tensors,
+            shm_outputs=shm_outputs,
+        )
+
+    def _classify(
+        self, model: Model, req_out: CoreRequestedOutput, arr: np.ndarray
+    ) -> np.ndarray:
+        """Convert a score tensor to Triton classification strings
+        ``"value:index[:label]"`` over the last axis."""
+        k = min(req_out.classification, arr.shape[-1])
+        labels = model.labels(req_out.name)
+        flat = arr.reshape(-1, arr.shape[-1])
+        rows = []
+        for row in flat:
+            top = np.argsort(row)[::-1][:k]
+            entries = []
+            for idx in top:
+                s = f"{row[idx]:f}:{idx}"
+                if labels and idx < len(labels):
+                    s += f":{labels[idx]}"
+                entries.append(s.encode("utf-8"))
+            rows.append(entries)
+        out = np.array(rows, dtype=np.object_)
+        return out.reshape(list(arr.shape[:-1]) + [k])
+
+    async def infer(self, request: CoreRequest) -> CoreResponse:
+        """Execute a request->response inference (decoupled models rejected)."""
+        model = self.repository.get(request.model_name, request.model_version)
+        if model.decoupled:
+            raise InferenceServerException(
+                f"model '{model.name}' is decoupled; use streaming inference"
+            )
+        stats = self._stats_for(model.name)
+        t0 = time.monotonic_ns()
+        loop = asyncio.get_running_loop()
+        try:
+            t1 = time.monotonic_ns()
+            raw = await loop.run_in_executor(
+                self._executor, self._run_model, model, request
+            )
+            t2 = time.monotonic_ns()
+            response = self._package_outputs(model, request, raw)
+            t3 = time.monotonic_ns()
+        except Exception:
+            stats.record("fail", time.monotonic_ns() - t0)
+            raise
+        stats.record_success(
+            self._resolve_batch(model, request),
+            queue_ns=t1 - t0,
+            in_ns=0,
+            infer_ns=t2 - t1,
+            out_ns=t3 - t2,
+        )
+        return response
+
+    async def infer_decoupled(
+        self, request: CoreRequest
+    ) -> AsyncIterator[CoreResponse]:
+        """Execute a streaming inference; yields 0..N responses.
+
+        Non-decoupled models yield exactly one response, so the streaming
+        front-end can serve both kinds (Triton semantics).
+        """
+        model = self.repository.get(request.model_name, request.model_version)
+        stats = self._stats_for(model.name)
+        t0 = time.monotonic_ns()
+        try:
+            if not model.decoupled:
+                yield await self.infer(request)
+                return
+            inputs = {t.name: t.data for t in request.inputs}
+            async for raw in model.execute_decoupled(inputs, request.parameters):
+                final = raw.pop("__final__", False) if isinstance(raw, dict) else False
+                if raw:
+                    response = self._package_outputs(model, request, raw)
+                else:
+                    response = CoreResponse(
+                        model_name=model.name,
+                        model_version=model.version,
+                        id=request.id,
+                        outputs=[],
+                    )
+                if final:
+                    response.parameters["triton_final_response"] = True
+                yield response
+        except Exception:
+            stats.record("fail", time.monotonic_ns() - t0)
+            raise
+        else:
+            t1 = time.monotonic_ns()
+            stats.record_success(
+                self._resolve_batch(model, request),
+                queue_ns=0,
+                in_ns=0,
+                infer_ns=t1 - t0,
+                out_ns=0,
+            )
+
+    # -- wire-side input decoding -------------------------------------------
+
+    def decode_input(
+        self,
+        name: str,
+        datatype: str,
+        shape: List[int],
+        raw: Optional[bytes] = None,
+        json_data: Optional[list] = None,
+        shm_region: Optional[str] = None,
+        shm_byte_size: int = 0,
+        shm_offset: int = 0,
+    ) -> CoreTensor:
+        """Materialize an input tensor from any of the three data sources
+        (inline binary, JSON, shared memory)."""
+        count = num_elements(shape)
+        if shm_region is not None:
+            raw = bytes(self.shm.read(shm_region, shm_offset, shm_byte_size))
+        if raw is not None:
+            if datatype == "BYTES":
+                arr = deserialize_bytes_tensor(raw).reshape(shape)
+            else:
+                np_dtype = triton_to_np_dtype(datatype)
+                if np_dtype is None:
+                    raise InferenceServerException(
+                        f"unsupported datatype '{datatype}' for input '{name}'"
+                    )
+                expected = count * np_dtype.itemsize
+                if len(raw) != expected:
+                    raise InferenceServerException(
+                        f"input '{name}' expected {expected} bytes for shape "
+                        f"{shape} and datatype {datatype}, got {len(raw)}"
+                    )
+                arr = np.frombuffer(raw, dtype=np_dtype).reshape(shape)
+        elif json_data is not None:
+            if datatype == "BYTES":
+                arr = np.array(
+                    [
+                        d.encode("utf-8") if isinstance(d, str) else d
+                        for d in json_data
+                    ],
+                    dtype=np.object_,
+                ).reshape(shape)
+            else:
+                np_dtype = triton_to_np_dtype(datatype)
+                if np_dtype is None:
+                    raise InferenceServerException(
+                        f"unsupported datatype '{datatype}' for input '{name}'"
+                    )
+                arr = np.array(json_data, dtype=np_dtype).reshape(shape)
+        else:
+            raise InferenceServerException(
+                f"input '{name}' has no data (inline, JSON, or shared memory)"
+            )
+        return CoreTensor(name=name, datatype=datatype, shape=list(shape), data=arr)

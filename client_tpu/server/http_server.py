@@ -1,0 +1,452 @@
+"""KServe v2 HTTP/REST front-end (aiohttp) over :class:`ServerCore`.
+
+Implements the endpoint surface the client stack exercises: health,
+metadata, config, repository control, statistics, trace/log settings,
+system/CUDA/TPU shared-memory registration, and binary-tensor inference
+(JSON header + concatenated raw buffers, ``Inference-Header-Content-Length``).
+"""
+
+import base64
+import gzip
+import json
+import zlib
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+from aiohttp import web
+
+from client_tpu.server.core import (
+    SERVER_EXTENSIONS,
+    SERVER_NAME,
+    SERVER_VERSION,
+    CoreRequest,
+    CoreRequestedOutput,
+    ServerCore,
+)
+from client_tpu.utils import (
+    InferenceServerException,
+    serialize_byte_tensor,
+)
+
+HEADER_CONTENT_LENGTH = "Inference-Header-Content-Length"
+
+
+def _error_response(msg: str, status: int = 400) -> web.Response:
+    return web.json_response({"error": msg}, status=status)
+
+
+def _guarded(handler):
+    async def wrapper(request: web.Request) -> web.Response:
+        try:
+            return await handler(request)
+        except InferenceServerException as e:
+            return _error_response(e.message())
+        except web.HTTPException:
+            raise
+        except Exception as e:  # noqa: BLE001 - surface as server error
+            return _error_response(f"internal error: {e}", status=500)
+
+    return wrapper
+
+
+class HttpServer:
+    """aiohttp application exposing a ServerCore."""
+
+    def __init__(self, core: ServerCore):
+        self.core = core
+        self.app = web.Application(client_max_size=1 << 30)
+        self._add_routes()
+
+    def _add_routes(self) -> None:
+        r = self.app.router
+        g, p = r.add_get, r.add_post
+        g("/v2/health/live", _guarded(self.handle_live))
+        g("/v2/health/ready", _guarded(self.handle_ready))
+        g("/v2/models/{model}/ready", _guarded(self.handle_model_ready))
+        g(
+            "/v2/models/{model}/versions/{version}/ready",
+            _guarded(self.handle_model_ready),
+        )
+        g("/v2", _guarded(self.handle_server_metadata))
+        g("/v2/", _guarded(self.handle_server_metadata))
+        g("/v2/models/stats", _guarded(self.handle_stats))
+        g("/v2/models/{model}/stats", _guarded(self.handle_stats))
+        g("/v2/models/{model}/versions/{version}/stats", _guarded(self.handle_stats))
+        g("/v2/models/{model}", _guarded(self.handle_model_metadata))
+        g(
+            "/v2/models/{model}/versions/{version}",
+            _guarded(self.handle_model_metadata),
+        )
+        g("/v2/models/{model}/config", _guarded(self.handle_model_config))
+        g(
+            "/v2/models/{model}/versions/{version}/config",
+            _guarded(self.handle_model_config),
+        )
+        p("/v2/repository/index", _guarded(self.handle_repository_index))
+        p(
+            "/v2/repository/models/{model}/load",
+            _guarded(self.handle_repository_load),
+        )
+        p(
+            "/v2/repository/models/{model}/unload",
+            _guarded(self.handle_repository_unload),
+        )
+        p("/v2/models/{model}/infer", _guarded(self.handle_infer))
+        p(
+            "/v2/models/{model}/versions/{version}/infer",
+            _guarded(self.handle_infer),
+        )
+        for kind in ("system", "cuda", "tpu"):
+            base = f"/v2/{kind}sharedmemory"
+            g(f"{base}/status", _guarded(self._shm_status_handler(kind)))
+            g(
+                f"{base}/region/{{name}}/status",
+                _guarded(self._shm_status_handler(kind)),
+            )
+            p(
+                f"{base}/region/{{name}}/register",
+                _guarded(self._shm_register_handler(kind)),
+            )
+            p(f"{base}/unregister", _guarded(self._shm_unregister_handler(kind)))
+            p(
+                f"{base}/region/{{name}}/unregister",
+                _guarded(self._shm_unregister_handler(kind)),
+            )
+        g("/v2/trace/setting", _guarded(self.handle_get_trace))
+        p("/v2/trace/setting", _guarded(self.handle_update_trace))
+        g("/v2/models/{model}/trace/setting", _guarded(self.handle_get_trace))
+        p("/v2/models/{model}/trace/setting", _guarded(self.handle_update_trace))
+        g("/v2/logging", _guarded(self.handle_get_logging))
+        p("/v2/logging", _guarded(self.handle_update_logging))
+
+    # -- health / metadata ---------------------------------------------------
+
+    async def handle_live(self, request):
+        return web.Response(status=200 if self.core.live else 400)
+
+    async def handle_ready(self, request):
+        return web.Response(status=200 if self.core.live else 400)
+
+    async def handle_model_ready(self, request):
+        ready = self.core.repository.is_ready(
+            request.match_info["model"], request.match_info.get("version", "")
+        )
+        return web.Response(status=200 if ready else 400)
+
+    async def handle_server_metadata(self, request):
+        return web.json_response(
+            {
+                "name": SERVER_NAME,
+                "version": SERVER_VERSION,
+                "extensions": SERVER_EXTENSIONS,
+            }
+        )
+
+    async def handle_model_metadata(self, request):
+        model = self.core.repository.get(
+            request.match_info["model"], request.match_info.get("version", "")
+        )
+        return web.json_response(model.metadata())
+
+    async def handle_model_config(self, request):
+        model = self.core.repository.get(
+            request.match_info["model"], request.match_info.get("version", "")
+        )
+        return web.json_response(model.config())
+
+    # -- repository ----------------------------------------------------------
+
+    async def handle_repository_index(self, request):
+        return web.json_response(self.core.repository.index())
+
+    async def handle_repository_load(self, request):
+        body = await request.read()
+        config_override = None
+        if body:
+            payload = json.loads(body)
+            params = payload.get("parameters", {})
+            config_override = params.get("config")
+        self.core.repository.load(
+            request.match_info["model"], config_override=config_override
+        )
+        return web.Response(status=200)
+
+    async def handle_repository_unload(self, request):
+        self.core.repository.unload(request.match_info["model"])
+        return web.Response(status=200)
+
+    # -- statistics ----------------------------------------------------------
+
+    async def handle_stats(self, request):
+        return web.json_response(
+            self.core.statistics(
+                request.match_info.get("model", ""),
+                request.match_info.get("version", ""),
+            )
+        )
+
+    # -- shared memory -------------------------------------------------------
+
+    def _shm_status_handler(self, kind):
+        async def handler(request):
+            name = request.match_info.get("name", "")
+            if kind == "cuda":
+                regions: Dict[str, Any] = {}
+            else:
+                regions = self.core.shm.status(kind, name)
+            # HTTP status returns a list of region dicts (Triton wire shape)
+            return web.json_response(list(regions.values()))
+
+        return handler
+
+    def _shm_register_handler(self, kind):
+        async def handler(request):
+            name = request.match_info["name"]
+            payload = json.loads(await request.read())
+            if kind == "system":
+                self.core.shm.register_system(
+                    name,
+                    payload["key"],
+                    int(payload.get("offset", 0)),
+                    int(payload["byte_size"]),
+                )
+            elif kind == "tpu":
+                raw_handle = base64.b64decode(payload["raw_handle"]["b64"])
+                self.core.shm.register_tpu(
+                    name,
+                    raw_handle,
+                    int(payload.get("device_id", 0)),
+                    int(payload["byte_size"]),
+                )
+            else:
+                raise InferenceServerException(
+                    "this server has no CUDA devices; use TPU or system "
+                    "shared memory"
+                )
+            return web.Response(status=200)
+
+        return handler
+
+    def _shm_unregister_handler(self, kind):
+        async def handler(request):
+            name = request.match_info.get("name", "")
+            shm_kind = kind if kind != "cuda" else "cuda"
+            if name:
+                self.core.shm.unregister(name, kind=shm_kind)
+            else:
+                self.core.shm.unregister_all(kind=shm_kind)
+            return web.Response(status=200)
+
+        return handler
+
+    # -- trace / logging -----------------------------------------------------
+
+    async def handle_get_trace(self, request):
+        return web.json_response(self.core.trace_settings)
+
+    async def handle_update_trace(self, request):
+        body = await request.read()
+        if body:
+            updates = json.loads(body)
+            for key, value in updates.items():
+                if value is None:
+                    continue
+                self.core.trace_settings[key] = value
+        return web.json_response(self.core.trace_settings)
+
+    async def handle_get_logging(self, request):
+        return web.json_response(self.core.log_settings)
+
+    async def handle_update_logging(self, request):
+        body = await request.read()
+        if body:
+            updates = json.loads(body)
+            for key, value in updates.items():
+                if value is not None:
+                    self.core.log_settings[key] = value
+        return web.json_response(self.core.log_settings)
+
+    # -- inference -----------------------------------------------------------
+
+    async def handle_infer(self, request):
+        # aiohttp auto-decompresses request bodies per Content-Encoding
+        # (gzip/deflate), so `body` is already plain here.
+        body = await request.read()
+
+        header_len = request.headers.get(HEADER_CONTENT_LENGTH)
+        if header_len is not None:
+            header_len = int(header_len)
+            try:
+                payload = json.loads(body[:header_len].decode("utf-8"))
+            except (UnicodeDecodeError, json.JSONDecodeError) as e:
+                raise InferenceServerException(
+                    f"malformed inference request header: {e}"
+                ) from None
+            binary = body[header_len:]
+        else:
+            try:
+                payload = json.loads(body.decode("utf-8"))
+            except (UnicodeDecodeError, json.JSONDecodeError) as e:
+                raise InferenceServerException(
+                    f"malformed inference request: {e}"
+                ) from None
+            binary = b""
+
+        core_request = self._build_core_request(
+            request.match_info["model"],
+            request.match_info.get("version", ""),
+            payload,
+            binary,
+        )
+        core_response = await self.core.infer(core_request)
+        accept = request.headers.get("Accept-Encoding", "")
+        return self._build_response(payload, core_response, accept)
+
+    def _build_core_request(
+        self, model_name, model_version, payload, binary
+    ) -> CoreRequest:
+        parameters = dict(payload.get("parameters", {}))
+        binary_output_default = bool(parameters.pop("binary_data_output", False))
+        request = CoreRequest(
+            model_name=model_name,
+            model_version=model_version,
+            id=payload.get("id", ""),
+            parameters=parameters,
+        )
+        offset = 0
+        for tensor in payload.get("inputs", []):
+            params = tensor.get("parameters", {})
+            name = tensor.get("name")
+            datatype = tensor.get("datatype")
+            shape = [int(s) for s in tensor.get("shape", [])]
+            if name is None or datatype is None:
+                raise InferenceServerException(
+                    "inference input must have 'name' and 'datatype'"
+                )
+            raw = None
+            json_data = None
+            shm_region = params.get("shared_memory_region")
+            if "binary_data_size" in params:
+                size = int(params["binary_data_size"])
+                if offset + size > len(binary):
+                    raise InferenceServerException(
+                        f"binary section truncated for input '{name}'"
+                    )
+                raw = binary[offset : offset + size]
+                offset += size
+            elif shm_region is None:
+                json_data = tensor.get("data")
+            request.inputs.append(
+                self.core.decode_input(
+                    name,
+                    datatype,
+                    shape,
+                    raw=raw,
+                    json_data=json_data,
+                    shm_region=shm_region,
+                    shm_byte_size=int(params.get("shared_memory_byte_size", 0)),
+                    shm_offset=int(params.get("shared_memory_offset", 0)),
+                )
+            )
+        for out in payload.get("outputs", []):
+            params = out.get("parameters", {})
+            request.outputs.append(
+                CoreRequestedOutput(
+                    name=out["name"],
+                    binary_data=bool(
+                        params.get("binary_data", binary_output_default)
+                    ),
+                    classification=int(params.get("classification", 0)),
+                    shm_region=params.get("shared_memory_region"),
+                    shm_byte_size=int(params.get("shared_memory_byte_size", 0)),
+                    shm_offset=int(params.get("shared_memory_offset", 0)),
+                )
+            )
+        return request
+
+    def _build_response(self, payload, core_response, accept: str) -> web.Response:
+        requested = {
+            o.get("name"): o.get("parameters", {})
+            for o in payload.get("outputs", [])
+        }
+        # Spec default for JSON requests is JSON output; only the explicit
+        # binary_data_output request parameter flips unlisted outputs to
+        # binary (the client sets it whenever outputs are omitted).
+        want_binary_default = bool(
+            payload.get("parameters", {}).get("binary_data_output", False)
+        )
+        header: Dict[str, Any] = {
+            "model_name": core_response.model_name,
+            "model_version": core_response.model_version,
+            "outputs": [],
+        }
+        if core_response.id:
+            header["id"] = core_response.id
+        if core_response.parameters:
+            header["parameters"] = core_response.parameters
+        chunks: List[bytes] = []
+        for tensor in core_response.outputs:
+            out_json: Dict[str, Any] = {
+                "name": tensor.name,
+                "datatype": tensor.datatype,
+                "shape": tensor.shape,
+            }
+            if tensor.name in core_response.shm_outputs:
+                region, size, shm_offset = core_response.shm_outputs[tensor.name]
+                out_json["parameters"] = {
+                    "shared_memory_region": region,
+                    "shared_memory_byte_size": size,
+                }
+                if shm_offset:
+                    out_json["parameters"]["shared_memory_offset"] = shm_offset
+            else:
+                params = requested.get(tensor.name, {})
+                binary = bool(params.get("binary_data", want_binary_default))
+                if tensor.datatype == "BF16" and not binary:
+                    binary = True  # BF16 has no JSON form
+                if binary:
+                    if tensor.datatype == "BYTES":
+                        raw = serialize_byte_tensor(tensor.data).tobytes()
+                    else:
+                        raw = np.ascontiguousarray(tensor.data).tobytes()
+                    chunks.append(raw)
+                    out_json["parameters"] = {"binary_data_size": len(raw)}
+                else:
+                    if tensor.datatype == "BYTES":
+                        out_json["data"] = [
+                            b.decode("utf-8", errors="replace")
+                            for b in tensor.data.reshape(-1)
+                        ]
+                    else:
+                        out_json["data"] = tensor.data.reshape(-1).tolist()
+            header["outputs"].append(out_json)
+
+        header_bytes = json.dumps(header).encode("utf-8")
+        response_headers = {"Content-Type": "application/octet-stream"}
+        if chunks:
+            body = b"".join([header_bytes] + chunks)
+            response_headers[HEADER_CONTENT_LENGTH] = str(len(header_bytes))
+        else:
+            body = header_bytes
+            response_headers["Content-Type"] = "application/json"
+
+        accept = accept.lower()
+        if "gzip" in accept:
+            body = gzip.compress(body)
+            response_headers["Content-Encoding"] = "gzip"
+        elif "deflate" in accept:
+            body = zlib.compress(body)
+            response_headers["Content-Encoding"] = "deflate"
+        return web.Response(body=body, headers=response_headers)
+
+
+async def serve_http(
+    core: ServerCore, host: str = "0.0.0.0", port: int = 8000
+) -> web.AppRunner:
+    """Start the HTTP server; returns the runner (caller owns shutdown)."""
+    server = HttpServer(core)
+    runner = web.AppRunner(server.app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    return runner

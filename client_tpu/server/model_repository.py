@@ -1,0 +1,232 @@
+"""Model abstraction + repository for the in-repo server.
+
+A model exposes KServe v2 metadata/config and an execute function over
+name->ndarray dicts. Decoupled (streaming) models yield multiple responses
+per request via an async generator, mirroring Triton's decoupled transaction
+policy (reference model_config.proto ModelTransactionPolicy).
+"""
+
+import asyncio
+import importlib.util
+import json
+import os
+import threading
+import time
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional
+
+import numpy as np
+
+from client_tpu.utils import InferenceServerException
+
+
+class Model:
+    """Base class for served models.
+
+    Subclasses define ``inputs``/``outputs`` metadata and implement
+    :meth:`execute` (one response) or :meth:`execute_decoupled` (stream of
+    responses; set ``decoupled = True``).
+    """
+
+    name: str = "model"
+    version: str = "1"
+    platform: str = "jax"
+    backend: str = "jax"
+    max_batch_size: int = 0
+    decoupled: bool = False
+    # [{"name", "datatype", "shape"}] — shape without batch dim if
+    # max_batch_size > 0, matching Triton config conventions.
+    inputs: List[Dict[str, Any]] = []
+    outputs: List[Dict[str, Any]] = []
+
+    def metadata(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "versions": [self.version],
+            "platform": self.platform,
+            "inputs": [
+                {
+                    "name": i["name"],
+                    "datatype": i["datatype"],
+                    "shape": ([-1] if self.max_batch_size > 0 else [])
+                    + list(i["shape"]),
+                }
+                for i in self.inputs
+            ],
+            "outputs": [
+                {
+                    "name": o["name"],
+                    "datatype": o["datatype"],
+                    "shape": ([-1] if self.max_batch_size > 0 else [])
+                    + list(o["shape"]),
+                }
+                for o in self.outputs
+            ],
+        }
+
+    def config(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "platform": self.platform,
+            "backend": self.backend,
+            "max_batch_size": self.max_batch_size,
+            "input": [
+                {
+                    "name": i["name"],
+                    "data_type": "TYPE_" + i["datatype"].replace("BYTES", "STRING"),
+                    "dims": list(i["shape"]),
+                }
+                for i in self.inputs
+            ],
+            "output": [
+                {
+                    "name": o["name"],
+                    "data_type": "TYPE_" + o["datatype"].replace("BYTES", "STRING"),
+                    "dims": list(o["shape"]),
+                }
+                for o in self.outputs
+            ],
+            "model_transaction_policy": {"decoupled": self.decoupled},
+        }
+
+    def labels(self, output_name: str) -> Optional[List[str]]:
+        """Classification labels for an output (None if unlabeled)."""
+        return None
+
+    def execute(
+        self, inputs: Dict[str, np.ndarray], parameters: Dict[str, Any]
+    ) -> Dict[str, np.ndarray]:
+        raise InferenceServerException(
+            f"model '{self.name}' does not implement execute"
+        )
+
+    async def execute_decoupled(
+        self, inputs: Dict[str, np.ndarray], parameters: Dict[str, Any]
+    ) -> AsyncIterator[Dict[str, np.ndarray]]:
+        raise InferenceServerException(
+            f"model '{self.name}' is not decoupled"
+        )
+        yield {}  # pragma: no cover - makes this an async generator
+
+    def warmup(self) -> None:
+        """Called at load; jit-compile here so first request is fast."""
+
+
+class ModelRepository:
+    """Name -> model registry with Triton-style load/unload semantics.
+
+    Models can be registered programmatically (``add_model``) or loaded from
+    a repository directory where each subdirectory holds a ``model.py``
+    defining ``create_model()`` (the python_backend analogue).
+    """
+
+    def __init__(self, repository_path: Optional[str] = None):
+        self._models: Dict[str, Model] = {}
+        self._ready: Dict[str, bool] = {}
+        self._lock = threading.Lock()
+        self._repository_path = repository_path
+
+    def add_model(self, model: Model, ready: bool = True) -> None:
+        model.warmup()
+        with self._lock:
+            self._models[model.name] = model
+            self._ready[model.name] = ready
+
+    def get(self, name: str, version: str = "") -> Model:
+        with self._lock:
+            model = self._models.get(name)
+            ready = self._ready.get(name, False)
+        if model is None:
+            raise InferenceServerException(
+                f"Request for unknown model: '{name}' is not found"
+            )
+        if not ready:
+            raise InferenceServerException(
+                f"Request for unavailable model: '{name}' is not ready"
+            )
+        if version and version != model.version:
+            raise InferenceServerException(
+                f"Request for unknown model version: '{name}' version "
+                f"{version} is not found"
+            )
+        return model
+
+    def is_ready(self, name: str, version: str = "") -> bool:
+        with self._lock:
+            if name not in self._models:
+                return False
+            if version and self._models[name].version != version:
+                return False
+            return self._ready.get(name, False)
+
+    def index(self) -> List[Dict[str, str]]:
+        with self._lock:
+            return [
+                {
+                    "name": m.name,
+                    "version": m.version,
+                    "state": "READY" if self._ready.get(m.name) else "UNAVAILABLE",
+                    "reason": "",
+                }
+                for m in self._models.values()
+            ]
+
+    def load(self, name: str, config_override: Optional[str] = None) -> None:
+        """Load (or reload) a model by name.
+
+        Programmatically added models are marked ready; directory models are
+        (re-)imported from ``<repo>/<name>/model.py``.
+        """
+        with self._lock:
+            known = name in self._models
+        if known and self._repository_path is None:
+            with self._lock:
+                self._ready[name] = True
+            return
+        if self._repository_path is None:
+            raise InferenceServerException(
+                f"failed to load '{name}': no model repository configured"
+            )
+        model_py = os.path.join(self._repository_path, name, "model.py")
+        if not os.path.exists(model_py):
+            raise InferenceServerException(
+                f"failed to load '{name}': {model_py} not found"
+            )
+        spec = importlib.util.spec_from_file_location(
+            f"client_tpu_model_{name}", model_py
+        )
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        if not hasattr(module, "create_model"):
+            raise InferenceServerException(
+                f"failed to load '{name}': model.py must define create_model()"
+            )
+        model = module.create_model()
+        if config_override:
+            try:
+                overrides = json.loads(config_override)
+            except json.JSONDecodeError as e:
+                raise InferenceServerException(
+                    f"failed to load '{name}': bad config override: {e}"
+                ) from None
+            if "max_batch_size" in overrides:
+                model.max_batch_size = int(overrides["max_batch_size"])
+        model.name = name
+        self.add_model(model)
+
+    def unload(self, name: str) -> None:
+        with self._lock:
+            if name not in self._models:
+                raise InferenceServerException(
+                    f"failed to unload '{name}': model is not loaded"
+                )
+            self._ready[name] = False
+
+    def scan(self) -> None:
+        """Load every model directory found in the repository path."""
+        if not self._repository_path:
+            return
+        for entry in sorted(os.listdir(self._repository_path)):
+            if os.path.exists(
+                os.path.join(self._repository_path, entry, "model.py")
+            ):
+                self.load(entry)

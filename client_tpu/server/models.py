@@ -1,0 +1,136 @@
+"""Built-in JAX models for the in-repo server.
+
+These mirror the fixture models the reference test/bench flows rely on:
+``simple`` (the add_sub model every quick-start and integration test uses,
+reference src/c++/tests/cc_client_test.cc), ``identity`` variants (BYTES and
+fixed-size passthrough), and a decoupled ``repeat`` model for token-streaming
+paths (reference custom_repeat example) — implemented as jitted JAX
+functions, not torch/CUDA.
+"""
+
+import asyncio
+from typing import Any, AsyncIterator, Dict, List
+
+import numpy as np
+
+from client_tpu.server.model_repository import Model
+from client_tpu.utils import InferenceServerException
+
+
+class AddSubModel(Model):
+    """The canonical 'simple' model: OUTPUT0=IN0+IN1, OUTPUT1=IN0-IN1.
+
+    INT32 [1,16] like the reference quick-start model (perf baselines in
+    BASELINE.md target this model's request path).
+    """
+
+    platform = "jax"
+    backend = "jax"
+    max_batch_size = 8
+    inputs = [
+        {"name": "INPUT0", "datatype": "INT32", "shape": [16]},
+        {"name": "INPUT1", "datatype": "INT32", "shape": [16]},
+    ]
+    outputs = [
+        {"name": "OUTPUT0", "datatype": "INT32", "shape": [16]},
+        {"name": "OUTPUT1", "datatype": "INT32", "shape": [16]},
+    ]
+
+    def __init__(self, name: str = "simple"):
+        self.name = name
+        self._fn = None
+
+    def warmup(self) -> None:
+        import jax
+
+        @jax.jit
+        def add_sub(a, b):
+            return a + b, a - b
+
+        self._fn = add_sub
+        # Compile for the canonical [1,16] shape so first request is fast.
+        z = np.zeros([1, 16], dtype=np.int32)
+        jax.block_until_ready(self._fn(z, z))
+
+    def execute(self, inputs, parameters):
+        a, b = inputs.get("INPUT0"), inputs.get("INPUT1")
+        if a is None or b is None:
+            raise InferenceServerException(
+                "model 'simple' expects inputs INPUT0 and INPUT1"
+            )
+        if a.shape != b.shape:
+            raise InferenceServerException(
+                f"INPUT0 shape {list(a.shape)} != INPUT1 shape {list(b.shape)}"
+            )
+        out0, out1 = self._fn(a, b)
+        return {
+            "OUTPUT0": np.asarray(out0),
+            "OUTPUT1": np.asarray(out1),
+        }
+
+
+class IdentityModel(Model):
+    """Fixed-dtype passthrough (any shape): OUTPUT0 = INPUT0."""
+
+    max_batch_size = 0
+
+    def __init__(self, name: str = "identity_fp32", datatype: str = "FP32"):
+        self.name = name
+        self._datatype = datatype
+        self.inputs = [{"name": "INPUT0", "datatype": datatype, "shape": [-1]}]
+        self.outputs = [{"name": "OUTPUT0", "datatype": datatype, "shape": [-1]}]
+
+    def execute(self, inputs, parameters):
+        if "INPUT0" not in inputs:
+            raise InferenceServerException(
+                f"model '{self.name}' expects input INPUT0"
+            )
+        return {"OUTPUT0": inputs["INPUT0"]}
+
+
+class BytesIdentityModel(IdentityModel):
+    """BYTES passthrough — exercises string-tensor serialization."""
+
+    def __init__(self, name: str = "identity_bytes"):
+        super().__init__(name=name, datatype="BYTES")
+
+
+class RepeatModel(Model):
+    """Decoupled model: streams IN[i] back as one response per element.
+
+    The minimal stand-in for token-by-token LLM decode streaming (reference
+    decoupled custom_repeat example; token streaming contract SURVEY.md §5
+    long-context notes). Honors a ``delay_us`` parameter between responses.
+    """
+
+    decoupled = True
+    max_batch_size = 0
+    inputs = [{"name": "IN", "datatype": "INT32", "shape": [-1]}]
+    outputs = [{"name": "OUT", "datatype": "INT32", "shape": [1]}]
+
+    def __init__(self, name: str = "repeat_int32"):
+        self.name = name
+
+    async def execute_decoupled(
+        self, inputs: Dict[str, np.ndarray], parameters: Dict[str, Any]
+    ) -> AsyncIterator[Dict[str, np.ndarray]]:
+        if "IN" not in inputs:
+            raise InferenceServerException("model 'repeat' expects input IN")
+        delay_us = int(parameters.get("delay_us", 0))
+        values = inputs["IN"].reshape(-1)
+        for i, v in enumerate(values):
+            if delay_us:
+                await asyncio.sleep(delay_us / 1e6)
+            yield {
+                "OUT": np.array([v], dtype=np.int32),
+                "__final__": i == len(values) - 1,
+            }
+
+
+def register_builtin_models(repository) -> None:
+    """Install the fixture models into a repository."""
+    repository.add_model(AddSubModel())
+    repository.add_model(IdentityModel("identity_fp32", "FP32"))
+    repository.add_model(IdentityModel("identity_bf16", "BF16"))
+    repository.add_model(BytesIdentityModel())
+    repository.add_model(RepeatModel())

@@ -1,0 +1,202 @@
+"""Server-side shared-memory region manager.
+
+Tracks regions registered by clients over the system (POSIX) and TPU
+shared-memory extensions and maps them into the server process. The server
+reads request inputs from, and writes requested outputs into, these mappings
+— the sideband data plane of SURVEY.md §1/L1.
+
+TPU regions are shared pinned host buffers: the raw handle (produced by
+client_tpu.utils.tpu_shared_memory.get_raw_handle) is a JSON document naming
+the POSIX shm key backing the buffer. On the server they are mapped like
+system regions but tracked separately so status/unregister semantics match
+the per-kind endpoints, and so the JAX backend can import them zero-copy via
+DLPack.
+"""
+
+import json
+import mmap
+import os
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from client_tpu.utils import InferenceServerException
+
+SHM_DIR = "/dev/shm"
+
+
+def _shm_path(key: str) -> str:
+    return os.path.join(SHM_DIR, key.lstrip("/"))
+
+
+class _Region:
+    def __init__(
+        self,
+        name: str,
+        kind: str,
+        key: str,
+        offset: int,
+        byte_size: int,
+        device_id: int = 0,
+    ):
+        self.name = name
+        self.kind = kind  # "system" | "tpu"
+        self.key = key
+        self.offset = offset
+        self.byte_size = byte_size
+        self.device_id = device_id
+        path = _shm_path(key)
+        try:
+            self._fd = os.open(path, os.O_RDWR)
+        except OSError as e:
+            raise InferenceServerException(
+                f"failed to open shared memory region '{name}' "
+                f"(key '{key}'): {e}"
+            ) from None
+        try:
+            total = os.fstat(self._fd).st_size
+            if offset + byte_size > total:
+                raise InferenceServerException(
+                    f"shared memory region '{name}' (key '{key}') is "
+                    f"{total} bytes; cannot map offset {offset} + "
+                    f"byte_size {byte_size}"
+                )
+            self._map = mmap.mmap(self._fd, total)
+        except Exception:
+            os.close(self._fd)
+            raise
+
+    def view(self, offset: int, byte_size: int) -> memoryview:
+        start = self.offset + offset
+        end = start + byte_size
+        if offset < 0 or byte_size < 0 or end > self.offset + self.byte_size:
+            raise InferenceServerException(
+                f"invalid offset/byte_size for shared memory region "
+                f"'{self.name}': {offset}+{byte_size} exceeds region size "
+                f"{self.byte_size}"
+            )
+        return memoryview(self._map)[start:end]
+
+    def close(self) -> None:
+        try:
+            self._map.close()
+        finally:
+            os.close(self._fd)
+
+
+class SharedMemoryManager:
+    """name -> mapped region registry (thread-safe)."""
+
+    def __init__(self):
+        self._regions: Dict[str, _Region] = {}
+        self._lock = threading.Lock()
+
+    # -- registration -------------------------------------------------------
+
+    def register_system(
+        self, name: str, key: str, offset: int, byte_size: int
+    ) -> None:
+        self._register(_Region(name, "system", key, offset, byte_size))
+
+    def register_tpu(
+        self, name: str, raw_handle: bytes, device_id: int, byte_size: int
+    ) -> None:
+        try:
+            handle = json.loads(bytes(raw_handle).decode("utf-8"))
+            key = handle["shm_key"]
+        except (UnicodeDecodeError, json.JSONDecodeError, KeyError) as e:
+            raise InferenceServerException(
+                f"malformed TPU shared-memory raw handle for region "
+                f"'{name}': {e}"
+            ) from None
+        handle_size = int(handle.get("byte_size", byte_size))
+        if handle_size < byte_size:
+            raise InferenceServerException(
+                f"TPU shared-memory region '{name}': registered byte_size "
+                f"{byte_size} exceeds handle's buffer size {handle_size}"
+            )
+        self._register(
+            _Region(name, "tpu", key, 0, byte_size, device_id=device_id)
+        )
+
+    def _register(self, region: _Region) -> None:
+        with self._lock:
+            if region.name in self._regions:
+                existing = self._regions[region.name]
+                # Re-registration with identical parameters is idempotent.
+                if (
+                    existing.kind == region.kind
+                    and existing.key == region.key
+                    and existing.offset == region.offset
+                    and existing.byte_size == region.byte_size
+                ):
+                    region.close()
+                    return
+                region.close()
+                raise InferenceServerException(
+                    f"shared memory region '{region.name}' already registered "
+                    "with different parameters"
+                )
+            self._regions[region.name] = region
+
+    # -- unregistration -----------------------------------------------------
+
+    def unregister(self, name: str, kind: Optional[str] = None) -> None:
+        with self._lock:
+            region = self._regions.get(name)
+            if region is None:
+                return  # Triton semantics: unregister of unknown is a no-op
+            if kind is not None and region.kind != kind:
+                raise InferenceServerException(
+                    f"shared memory region '{name}' is of kind "
+                    f"'{region.kind}', not '{kind}'"
+                )
+            del self._regions[name]
+        region.close()
+
+    def unregister_all(self, kind: Optional[str] = None) -> None:
+        with self._lock:
+            victims = [
+                n
+                for n, r in self._regions.items()
+                if kind is None or r.kind == kind
+            ]
+            regions = [self._regions.pop(n) for n in victims]
+        for r in regions:
+            r.close()
+
+    # -- access -------------------------------------------------------------
+
+    def status(self, kind: str, name: str = "") -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            result = {}
+            for n, r in self._regions.items():
+                if r.kind != kind or (name and n != name):
+                    continue
+                if kind == "system":
+                    result[n] = {
+                        "name": n,
+                        "key": r.key,
+                        "offset": r.offset,
+                        "byte_size": r.byte_size,
+                    }
+                else:
+                    result[n] = {
+                        "name": n,
+                        "device_id": r.device_id,
+                        "byte_size": r.byte_size,
+                        "key": r.key,
+                    }
+            return result
+
+    def read(self, name: str, offset: int, byte_size: int) -> memoryview:
+        with self._lock:
+            region = self._regions.get(name)
+        if region is None:
+            raise InferenceServerException(
+                f"Unable to find shared memory region: '{name}'"
+            )
+        return region.view(offset, byte_size)
+
+    def write(self, name: str, offset: int, data: bytes) -> None:
+        view = self.read(name, offset, len(data))
+        view[:] = data

@@ -1,0 +1,3 @@
+"""Test/bench harness utilities (in-process server, fixtures)."""
+
+from client_tpu.testing.inprocess import InProcessServer  # noqa: F401

@@ -1,0 +1,115 @@
+"""Run the KServe v2 server in-process on a background event loop.
+
+The harness used by integration tests and by in-process benchmarking (the
+role the reference's triton_c_api in-process backend plays: exercising the
+full client/server path without a separate server process,
+reference src/c++/perf_analyzer/client_backend/triton_c_api/).
+"""
+
+import asyncio
+import threading
+from typing import Optional
+
+from client_tpu.server.core import ServerCore
+from client_tpu.server.model_repository import ModelRepository
+
+
+class InProcessServer:
+    """Starts HTTP and/or gRPC front-ends over one ServerCore in a thread."""
+
+    def __init__(
+        self,
+        core: Optional[ServerCore] = None,
+        http: bool = True,
+        grpc: bool = True,
+        host: str = "127.0.0.1",
+        builtin_models: bool = True,
+    ):
+        if core is None:
+            repository = ModelRepository()
+            core = ServerCore(repository)
+        self.core = core
+        if builtin_models:
+            from client_tpu.server.models import register_builtin_models
+
+            register_builtin_models(self.core.repository)
+        self._want_http = http
+        self._want_grpc = grpc
+        self._host = host
+        self.http_port: Optional[int] = None
+        self.grpc_port: Optional[int] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._ready = threading.Event()
+        self._stop = None  # asyncio.Event created on the loop
+        self._error: Optional[BaseException] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "InProcessServer":
+        self._thread = threading.Thread(
+            target=self._run, name="client-tpu-server", daemon=True
+        )
+        self._thread.start()
+        self._ready.wait(timeout=60)
+        if self._error is not None:
+            raise self._error
+        if not self._ready.is_set():
+            raise RuntimeError("in-process server failed to start in 60s")
+        return self
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._loop.run_until_complete(self._serve())
+        except BaseException as e:  # noqa: BLE001 - propagate to starter
+            self._error = e
+            self._ready.set()
+        finally:
+            self._loop.close()
+
+    async def _serve(self) -> None:
+        self._stop = asyncio.Event()
+        http_runner = None
+        grpc_server = None
+        if self._want_http:
+            from client_tpu.server.http_server import serve_http
+
+            http_runner = await serve_http(self.core, self._host, 0)
+            self.http_port = http_runner.addresses[0][1]
+        if self._want_grpc:
+            from client_tpu.server.grpc_server import serve_grpc
+
+            grpc_server, self.grpc_port = await serve_grpc(
+                self.core, self._host, 0
+            )
+        self._ready.set()
+        await self._stop.wait()
+        if grpc_server is not None:
+            await grpc_server.stop(grace=1)
+        if http_runner is not None:
+            await http_runner.cleanup()
+
+    def stop(self) -> None:
+        if self._loop is not None and self._stop is not None:
+            self._loop.call_soon_threadsafe(self._stop.set)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self.core.close()
+
+    def __enter__(self) -> "InProcessServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- convenience --------------------------------------------------------
+
+    @property
+    def http_url(self) -> str:
+        return f"{self._host}:{self.http_port}"
+
+    @property
+    def grpc_url(self) -> str:
+        return f"{self._host}:{self.grpc_port}"

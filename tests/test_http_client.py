@@ -1,0 +1,276 @@
+"""Integration tests: sync + aio HTTP clients against the in-repo server.
+
+These play the role of the reference's live-server cc_client_test suite
+(SURVEY.md §4 tier 2) with the in-repo JAX-backed server standing in for
+Triton.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import client_tpu.http as httpclient
+import client_tpu.http.aio as aio_httpclient
+from client_tpu.utils import InferenceServerException, bfloat16
+from client_tpu.testing import InProcessServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    with InProcessServer(grpc=False) as s:
+        yield s
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    c = httpclient.InferenceServerClient(server.http_url)
+    yield c
+    c.close()
+
+
+def _simple_inputs():
+    in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    in1 = np.ones([1, 16], dtype=np.int32)
+    a = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+    a.set_data_from_numpy(in0)
+    b = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+    b.set_data_from_numpy(in1)
+    return in0, in1, [a, b]
+
+
+def test_health(client):
+    assert client.is_server_live()
+    assert client.is_server_ready()
+    assert client.is_model_ready("simple")
+    assert not client.is_model_ready("nonexistent")
+
+
+def test_server_metadata(client):
+    meta = client.get_server_metadata()
+    assert meta["name"] == "client_tpu_server"
+    assert "tpu_shared_memory" in meta["extensions"]
+
+
+def test_model_metadata(client):
+    meta = client.get_model_metadata("simple")
+    assert meta["name"] == "simple"
+    names = {t["name"] for t in meta["inputs"]}
+    assert names == {"INPUT0", "INPUT1"}
+
+
+def test_model_config(client):
+    config = client.get_model_config("simple")
+    assert config["max_batch_size"] == 8
+    assert config["backend"] == "jax"
+
+
+def test_repository_index(client):
+    index = client.get_model_repository_index()
+    names = {m["name"] for m in index}
+    assert {"simple", "identity_fp32", "identity_bf16"} <= names
+
+
+def test_infer_binary(client):
+    in0, in1, inputs = _simple_inputs()
+    outputs = [
+        httpclient.InferRequestedOutput("OUTPUT0"),
+        httpclient.InferRequestedOutput("OUTPUT1"),
+    ]
+    result = client.infer("simple", inputs, outputs=outputs, request_id="42")
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), in0 - in1)
+    assert result.get_response()["id"] == "42"
+    assert result.get_output("OUTPUT0")["datatype"] == "INT32"
+    assert result.get_output("MISSING") is None
+
+
+def test_infer_default_outputs(client):
+    in0, in1, inputs = _simple_inputs()
+    result = client.infer("simple", inputs)
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), in0 - in1)
+
+
+def test_infer_json_tensors(client):
+    in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    in1 = np.full([1, 16], 2, dtype=np.int32)
+    a = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+    a.set_data_from_numpy(in0, binary_data=False)
+    b = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+    b.set_data_from_numpy(in1, binary_data=False)
+    outputs = [httpclient.InferRequestedOutput("OUTPUT0", binary_data=False)]
+    result = client.infer("simple", [a, b], outputs=outputs)
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+
+
+def test_infer_bf16(client):
+    data = np.array([[1.5, -2.0, 0.25, 8.0]], dtype=bfloat16)
+    inp = httpclient.InferInput("INPUT0", [1, 4], "BF16")
+    inp.set_data_from_numpy(data)
+    result = client.infer("identity_bf16", [inp])
+    out = result.as_numpy("OUTPUT0")
+    assert out.dtype == bfloat16
+    np.testing.assert_array_equal(out, data)
+
+
+def test_infer_jax_input(client):
+    jnp = pytest.importorskip("jax.numpy")
+    x = jnp.asarray(np.random.randn(1, 8), dtype=jnp.bfloat16)
+    inp = httpclient.InferInput("INPUT0", [1, 8], "BF16")
+    inp.set_data_from_jax(x)
+    result = client.infer("identity_bf16", [inp])
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), np.asarray(x))
+    jax_out = result.as_jax("OUTPUT0")
+    assert jax_out.dtype == jnp.bfloat16
+
+
+def test_infer_bytes(client):
+    data = np.array([b"hello", "w\xf6rld".encode("utf-8"), b""], dtype=object)
+    inp = httpclient.InferInput("INPUT0", [3], "BYTES")
+    inp.set_data_from_numpy(data)
+    result = client.infer("identity_bytes", [inp])
+    out = result.as_numpy("OUTPUT0")
+    assert list(out) == list(data)
+
+
+def test_infer_compression(client):
+    in0, in1, inputs = _simple_inputs()
+    for algo in ("gzip", "deflate"):
+        result = client.infer(
+            "simple",
+            inputs,
+            request_compression_algorithm=algo,
+            response_compression_algorithm=algo,
+        )
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+
+
+def test_async_infer(client):
+    in0, in1, inputs = _simple_inputs()
+    handles = [client.async_infer("simple", inputs) for _ in range(8)]
+    for handle in handles:
+        result = handle.get_result()
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+
+
+def test_async_infer_callback(client):
+    import threading
+
+    in0, in1, inputs = _simple_inputs()
+    done = threading.Event()
+    captured = {}
+
+    def callback(result, error):
+        captured["result"] = result
+        captured["error"] = error
+        done.set()
+
+    client.async_infer("simple", inputs, callback=callback)
+    assert done.wait(timeout=30)
+    assert captured["error"] is None
+    np.testing.assert_array_equal(
+        captured["result"].as_numpy("OUTPUT0"), in0 + in1
+    )
+
+
+def test_infer_wrong_model(client):
+    _, _, inputs = _simple_inputs()
+    with pytest.raises(InferenceServerException, match="not found"):
+        client.infer("nonexistent", inputs)
+
+
+def test_infer_bad_input_name(client):
+    inp = httpclient.InferInput("WRONG", [1, 16], "INT32")
+    inp.set_data_from_numpy(np.zeros([1, 16], dtype=np.int32))
+    inp2 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+    inp2.set_data_from_numpy(np.zeros([1, 16], dtype=np.int32))
+    with pytest.raises(InferenceServerException):
+        client.infer("simple", [inp, inp2])
+
+
+def test_input_validation():
+    inp = httpclient.InferInput("X", [2, 2], "FP32")
+    with pytest.raises(InferenceServerException, match="expected"):
+        inp.set_data_from_numpy(np.zeros([3], dtype=np.float32))
+    with pytest.raises(InferenceServerException, match="datatype"):
+        inp.set_data_from_numpy(np.zeros([2, 2], dtype=np.int64))
+    with pytest.raises(InferenceServerException, match="binary"):
+        bf = httpclient.InferInput("X", [2], "BF16")
+        bf.set_data_from_numpy(
+            np.zeros([2], dtype=bfloat16), binary_data=False
+        )
+
+
+def test_statistics(client):
+    in0, in1, inputs = _simple_inputs()
+    client.infer("simple", inputs)
+    stats = client.get_inference_statistics("simple")
+    entry = stats["model_stats"][0]
+    assert entry["name"] == "simple"
+    assert entry["inference_count"] >= 1
+    assert entry["inference_stats"]["success"]["count"] >= 1
+
+
+def test_trace_and_log_settings(client):
+    settings = client.update_trace_settings(
+        model_name=None, settings={"trace_level": ["TIMESTAMPS"]}
+    )
+    assert settings["trace_level"] == ["TIMESTAMPS"]
+    assert client.get_trace_settings()["trace_level"] == ["TIMESTAMPS"]
+    log = client.update_log_settings({"log_verbose_level": 1})
+    assert log["log_verbose_level"] == 1
+    assert client.get_log_settings()["log_verbose_level"] == 1
+
+
+def test_load_unload(client):
+    client.unload_model("identity_fp32")
+    assert not client.is_model_ready("identity_fp32")
+    client.load_model("identity_fp32")
+    assert client.is_model_ready("identity_fp32")
+
+
+def test_generate_and_parse_request_body(server):
+    """Offline request construction + response parsing (no client pool)."""
+    in0, in1, inputs = _simple_inputs()
+    body, json_size = httpclient.InferenceServerClient.generate_request_body(
+        inputs, request_id="7"
+    )
+    assert json_size is not None
+    import requests as _requests
+
+    http_response = _requests.post(
+        f"http://{server.http_url}/v2/models/simple/infer",
+        data=body,
+        headers={"Inference-Header-Content-Length": str(json_size)},
+    )
+    header_length = http_response.headers.get("Inference-Header-Content-Length")
+    result = httpclient.InferenceServerClient.parse_response_body(
+        http_response.content,
+        header_length=int(header_length) if header_length else None,
+    )
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+
+
+def test_aio_client(server):
+    async def run():
+        async with aio_httpclient.InferenceServerClient(server.http_url) as c:
+            assert await c.is_server_live()
+            in0, in1, inputs = _simple_inputs()
+            result = await c.infer("simple", inputs)
+            np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+            meta = await c.get_server_metadata()
+            assert meta["name"] == "client_tpu_server"
+            # concurrent fan-out on one pool
+            results = await asyncio.gather(
+                *[c.infer("simple", inputs) for _ in range(8)]
+            )
+            for r in results:
+                np.testing.assert_array_equal(r.as_numpy("OUTPUT1"), in0 - in1)
+
+    asyncio.run(run())
+
+
+def test_client_context_manager(server):
+    with httpclient.InferenceServerClient(server.http_url) as c:
+        assert c.is_server_live()

@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Regenerate protobuf message modules into client_tpu/grpc/_generated/.
+#
+# grpc_tools is not available in this environment, so only *_pb2.py message
+# modules are generated here; the gRPC service stubs are hand-written in
+# client_tpu/grpc/_service_stubs.py. Protos are staged under a path that
+# mirrors the Python package so protoc emits package-correct imports
+# (avoiding the sed-patching the reference build resorts to,
+# reference src/python/library/build_wheel.py:107-180).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+STAGE=$(mktemp -d)
+trap 'rm -rf "$STAGE"' EXIT
+mkdir -p "$STAGE/client_tpu/grpc/_generated"
+cp client_tpu/protos/model_config.proto client_tpu/protos/grpc_service.proto \
+   "$STAGE/client_tpu/grpc/_generated/"
+
+mkdir -p client_tpu/grpc/_generated
+protoc -I "$STAGE" \
+  --python_out=. \
+  "$STAGE/client_tpu/grpc/_generated/model_config.proto" \
+  "$STAGE/client_tpu/grpc/_generated/grpc_service.proto"
+
+cat > client_tpu/grpc/_generated/__init__.py <<'EOF'
+"""Generated protobuf message modules (see tools/gen_protos.sh)."""
+
+from client_tpu.grpc._generated import model_config_pb2  # noqa: F401
+from client_tpu.grpc._generated import grpc_service_pb2  # noqa: F401
+
+# Compatibility aliases matching the reference wheel's module names
+# (service_pb2 / model_config_pb2).
+service_pb2 = grpc_service_pb2
+EOF
+echo "generated: $(ls client_tpu/grpc/_generated/)"
