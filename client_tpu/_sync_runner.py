@@ -40,6 +40,13 @@ class EventLoopRunner:
 
     def run(self, coro: Coroutine, timeout: Optional[float] = None) -> Any:
         """Run ``coro`` to completion and return its result (blocking)."""
+        if threading.current_thread() is self._thread:
+            # Blocking on our own loop would deadlock (e.g. GC finalizers
+            # running on the loop thread); fail fast instead.
+            coro.close()
+            raise RuntimeError(
+                "EventLoopRunner.run called from its own loop thread"
+            )
         return self.submit(coro).result(timeout)
 
     def close(self) -> None:

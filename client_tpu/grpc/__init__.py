@@ -1,0 +1,665 @@
+"""Synchronous gRPC client for KServe v2 inference servers.
+
+Capability parity with the reference gRPC client
+(reference src/python/library/tritonclient/grpc/_client.py:119-1900):
+health/metadata/config, repository control, statistics, trace/log settings,
+system/CUDA/TPU shared-memory registration, unary + async + decoupled
+streaming inference with cancellation, SSL and keepalive tuning, message
+size capped at INT32_MAX both directions.
+"""
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import grpc
+
+from client_tpu._client import InferenceServerClientBase
+from client_tpu._request import Request
+from client_tpu.grpc._generated import grpc_service_pb2 as service_pb2
+from client_tpu.grpc._generated import model_config_pb2
+from client_tpu.grpc._infer_input import InferInput
+from client_tpu.grpc._infer_result import InferResult
+from client_tpu.grpc._infer_stream import InferStream
+from client_tpu.grpc._requested_output import InferRequestedOutput
+from client_tpu.grpc._service_stubs import GRPCInferenceServiceStub
+from client_tpu.grpc._utils import (
+    get_inference_request,
+    rpc_error_to_exception,
+)
+from client_tpu.utils import InferenceServerException
+
+__all__ = [
+    "InferenceServerClient",
+    "InferInput",
+    "InferRequestedOutput",
+    "InferResult",
+    "KeepAliveOptions",
+    "CallContext",
+    "service_pb2",
+    "model_config_pb2",
+]
+
+# INT32_MAX: same cap as the reference (grpc/_client.py:53-54)
+MAX_GRPC_MESSAGE_SIZE = 2**31 - 1
+
+
+@dataclasses.dataclass
+class KeepAliveOptions:
+    """gRPC keepalive tuning (reference grpc/_client.py:57-99)."""
+
+    keepalive_time_ms: int = 2**31 - 1
+    keepalive_timeout_ms: int = 20000
+    keepalive_permit_without_calls: bool = False
+    http2_max_pings_without_data: int = 2
+
+
+class CallContext:
+    """Handle to an in-flight async_infer call (supports cancellation)."""
+
+    def __init__(self, future):
+        self._future = future
+
+    def cancel(self) -> bool:
+        """Cancel the request if still in flight."""
+        return self._future.cancel()
+
+    def get_result(self, timeout: Optional[float] = None) -> InferResult:
+        """Block for and return the InferResult."""
+        try:
+            return InferResult(self._future.result(timeout=timeout))
+        except grpc.RpcError as e:
+            raise rpc_error_to_exception(e) from None
+        except grpc.FutureTimeoutError:
+            raise InferenceServerException(
+                "timeout waiting for async infer result"
+            ) from None
+        except grpc.FutureCancelledError:
+            raise InferenceServerException("request was cancelled") from None
+
+
+def _to_json(message):
+    from google.protobuf import json_format
+
+    return json_format.MessageToDict(message, preserving_proto_field_name=True)
+
+
+class InferenceServerClient(InferenceServerClientBase):
+    """Synchronous client for the KServe v2 gRPC protocol."""
+
+    def __init__(
+        self,
+        url: str,
+        verbose: bool = False,
+        ssl: bool = False,
+        root_certificates: Optional[str] = None,
+        private_key: Optional[str] = None,
+        certificate_chain: Optional[str] = None,
+        creds: Optional[grpc.ChannelCredentials] = None,
+        keepalive_options: Optional[KeepAliveOptions] = None,
+        channel_args: Optional[List] = None,
+    ):
+        super().__init__()
+        self._verbose = verbose
+        if channel_args is not None:
+            options = list(channel_args)
+        else:
+            options = [
+                ("grpc.max_send_message_length", MAX_GRPC_MESSAGE_SIZE),
+                ("grpc.max_receive_message_length", MAX_GRPC_MESSAGE_SIZE),
+                ("grpc.primary_user_agent", "client-tpu-grpc"),
+            ]
+            if keepalive_options is not None:
+                options += [
+                    ("grpc.keepalive_time_ms", keepalive_options.keepalive_time_ms),
+                    (
+                        "grpc.keepalive_timeout_ms",
+                        keepalive_options.keepalive_timeout_ms,
+                    ),
+                    (
+                        "grpc.keepalive_permit_without_calls",
+                        int(keepalive_options.keepalive_permit_without_calls),
+                    ),
+                    (
+                        "grpc.http2.max_pings_without_data",
+                        keepalive_options.http2_max_pings_without_data,
+                    ),
+                ]
+        if creds is not None:
+            self._channel = grpc.secure_channel(url, creds, options=options)
+        elif ssl:
+
+            def _read(path):
+                if path is None:
+                    return None
+                with open(path, "rb") as f:
+                    return f.read()
+
+            credentials = grpc.ssl_channel_credentials(
+                root_certificates=_read(root_certificates),
+                private_key=_read(private_key),
+                certificate_chain=_read(certificate_chain),
+            )
+            self._channel = grpc.secure_channel(url, credentials, options=options)
+        else:
+            self._channel = grpc.insecure_channel(url, options=options)
+        self._client_stub = GRPCInferenceServiceStub(self._channel)
+        self._stream: Optional[InferStream] = None
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _metadata(self, headers: Optional[Dict[str, str]]):
+        request = Request(headers or {})
+        self._call_plugin(request)
+        return tuple((k.lower(), v) for k, v in request.headers.items()) or None
+
+    def _call(
+        self,
+        name,
+        request,
+        headers=None,
+        client_timeout=None,
+        compression_algorithm=None,
+    ):
+        if self._verbose:
+            print(f"gRPC {name}: {{{str(request)[:200]}}}")
+        try:
+            return getattr(self._client_stub, name)(
+                request,
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+                compression=_grpc_compression(compression_algorithm),
+            )
+        except grpc.RpcError as e:
+            raise rpc_error_to_exception(e) from None
+
+    def close(self) -> None:
+        """Close the channel (stops any active stream first)."""
+        self.stop_stream()
+        self._channel.close()
+
+    def __enter__(self) -> "InferenceServerClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- health -------------------------------------------------------------
+
+    def is_server_live(self, headers=None, client_timeout=None) -> bool:
+        response = self._call(
+            "ServerLive", service_pb2.ServerLiveRequest(), headers, client_timeout
+        )
+        return response.live
+
+    def is_server_ready(self, headers=None, client_timeout=None) -> bool:
+        response = self._call(
+            "ServerReady", service_pb2.ServerReadyRequest(), headers, client_timeout
+        )
+        return response.ready
+
+    def is_model_ready(
+        self, model_name, model_version="", headers=None, client_timeout=None
+    ) -> bool:
+        response = self._call(
+            "ModelReady",
+            service_pb2.ModelReadyRequest(name=model_name, version=model_version),
+            headers,
+            client_timeout,
+        )
+        return response.ready
+
+    # -- metadata / config ---------------------------------------------------
+
+    def get_server_metadata(self, headers=None, as_json=False, client_timeout=None):
+        response = self._call(
+            "ServerMetadata",
+            service_pb2.ServerMetadataRequest(),
+            headers,
+            client_timeout,
+        )
+        return _to_json(response) if as_json else response
+
+    def get_model_metadata(
+        self,
+        model_name,
+        model_version="",
+        headers=None,
+        as_json=False,
+        client_timeout=None,
+    ):
+        response = self._call(
+            "ModelMetadata",
+            service_pb2.ModelMetadataRequest(
+                name=model_name, version=model_version
+            ),
+            headers,
+            client_timeout,
+        )
+        return _to_json(response) if as_json else response
+
+    def get_model_config(
+        self,
+        model_name,
+        model_version="",
+        headers=None,
+        as_json=False,
+        client_timeout=None,
+    ):
+        response = self._call(
+            "ModelConfig",
+            service_pb2.ModelConfigRequest(
+                name=model_name, version=model_version
+            ),
+            headers,
+            client_timeout,
+        )
+        return _to_json(response) if as_json else response
+
+    # -- repository ----------------------------------------------------------
+
+    def get_model_repository_index(
+        self, headers=None, as_json=False, client_timeout=None
+    ):
+        response = self._call(
+            "RepositoryIndex",
+            service_pb2.RepositoryIndexRequest(),
+            headers,
+            client_timeout,
+        )
+        return _to_json(response) if as_json else response
+
+    def load_model(
+        self,
+        model_name,
+        headers=None,
+        config: Optional[str] = None,
+        files: Optional[Dict[str, bytes]] = None,
+        client_timeout=None,
+    ) -> None:
+        request = service_pb2.RepositoryModelLoadRequest(model_name=model_name)
+        if config is not None:
+            request.parameters["config"].string_param = config
+        if files:
+            for name, content in files.items():
+                request.parameters[name].bytes_param = content
+        self._call("RepositoryModelLoad", request, headers, client_timeout)
+
+    def unload_model(
+        self,
+        model_name,
+        headers=None,
+        unload_dependents: bool = False,
+        client_timeout=None,
+    ) -> None:
+        request = service_pb2.RepositoryModelUnloadRequest(model_name=model_name)
+        request.parameters["unload_dependents"].bool_param = unload_dependents
+        self._call("RepositoryModelUnload", request, headers, client_timeout)
+
+    # -- statistics / settings -----------------------------------------------
+
+    def get_inference_statistics(
+        self,
+        model_name="",
+        model_version="",
+        headers=None,
+        as_json=False,
+        client_timeout=None,
+    ):
+        response = self._call(
+            "ModelStatistics",
+            service_pb2.ModelStatisticsRequest(
+                name=model_name, version=model_version
+            ),
+            headers,
+            client_timeout,
+        )
+        return _to_json(response) if as_json else response
+
+    def update_trace_settings(
+        self,
+        model_name=None,
+        settings: Optional[Dict[str, Any]] = None,
+        headers=None,
+        as_json=False,
+        client_timeout=None,
+    ):
+        request = service_pb2.TraceSettingRequest(model_name=model_name or "")
+        for key, value in (settings or {}).items():
+            if value is None:
+                # empty entry = clear/reset this setting (Triton semantics)
+                request.settings[key].SetInParent()
+                continue
+            values = value if isinstance(value, (list, tuple)) else [value]
+            request.settings[key].value.extend(str(v) for v in values)
+        response = self._call("TraceSetting", request, headers, client_timeout)
+        return _to_json(response) if as_json else response
+
+    def get_trace_settings(
+        self, model_name=None, headers=None, as_json=False, client_timeout=None
+    ):
+        request = service_pb2.TraceSettingRequest(model_name=model_name or "")
+        response = self._call("TraceSetting", request, headers, client_timeout)
+        return _to_json(response) if as_json else response
+
+    def update_log_settings(
+        self, settings: Dict[str, Any], headers=None, as_json=False, client_timeout=None
+    ):
+        request = service_pb2.LogSettingsRequest()
+        for key, value in settings.items():
+            if isinstance(value, bool):
+                request.settings[key].bool_param = value
+            elif isinstance(value, int):
+                request.settings[key].uint32_param = value
+            else:
+                request.settings[key].string_param = str(value)
+        response = self._call("LogSettings", request, headers, client_timeout)
+        return _to_json(response) if as_json else response
+
+    def get_log_settings(self, headers=None, as_json=False, client_timeout=None):
+        response = self._call(
+            "LogSettings", service_pb2.LogSettingsRequest(), headers, client_timeout
+        )
+        return _to_json(response) if as_json else response
+
+    # -- shared memory -------------------------------------------------------
+
+    def get_system_shared_memory_status(
+        self, region_name="", headers=None, as_json=False, client_timeout=None
+    ):
+        response = self._call(
+            "SystemSharedMemoryStatus",
+            service_pb2.SystemSharedMemoryStatusRequest(name=region_name),
+            headers,
+            client_timeout,
+        )
+        return _to_json(response) if as_json else response
+
+    def register_system_shared_memory(
+        self, name, key, byte_size, offset=0, headers=None, client_timeout=None
+    ) -> None:
+        self._call(
+            "SystemSharedMemoryRegister",
+            service_pb2.SystemSharedMemoryRegisterRequest(
+                name=name, key=key, offset=offset, byte_size=byte_size
+            ),
+            headers,
+            client_timeout,
+        )
+
+    def unregister_system_shared_memory(
+        self, name="", headers=None, client_timeout=None
+    ) -> None:
+        self._call(
+            "SystemSharedMemoryUnregister",
+            service_pb2.SystemSharedMemoryUnregisterRequest(name=name),
+            headers,
+            client_timeout,
+        )
+
+    def get_cuda_shared_memory_status(
+        self, region_name="", headers=None, as_json=False, client_timeout=None
+    ):
+        response = self._call(
+            "CudaSharedMemoryStatus",
+            service_pb2.CudaSharedMemoryStatusRequest(name=region_name),
+            headers,
+            client_timeout,
+        )
+        return _to_json(response) if as_json else response
+
+    def register_cuda_shared_memory(
+        self, name, raw_handle, device_id, byte_size, headers=None, client_timeout=None
+    ) -> None:
+        self._call(
+            "CudaSharedMemoryRegister",
+            service_pb2.CudaSharedMemoryRegisterRequest(
+                name=name,
+                raw_handle=raw_handle,
+                device_id=device_id,
+                byte_size=byte_size,
+            ),
+            headers,
+            client_timeout,
+        )
+
+    def unregister_cuda_shared_memory(
+        self, name="", headers=None, client_timeout=None
+    ) -> None:
+        self._call(
+            "CudaSharedMemoryUnregister",
+            service_pb2.CudaSharedMemoryUnregisterRequest(name=name),
+            headers,
+            client_timeout,
+        )
+
+    def get_tpu_shared_memory_status(
+        self, region_name="", headers=None, as_json=False, client_timeout=None
+    ):
+        response = self._call(
+            "TpuSharedMemoryStatus",
+            service_pb2.TpuSharedMemoryStatusRequest(name=region_name),
+            headers,
+            client_timeout,
+        )
+        return _to_json(response) if as_json else response
+
+    def register_tpu_shared_memory(
+        self, name, raw_handle, device_id, byte_size, headers=None, client_timeout=None
+    ) -> None:
+        """Register a TPU shared-memory region (client_tpu extension)."""
+        self._call(
+            "TpuSharedMemoryRegister",
+            service_pb2.TpuSharedMemoryRegisterRequest(
+                name=name,
+                raw_handle=raw_handle,
+                device_id=device_id,
+                byte_size=byte_size,
+            ),
+            headers,
+            client_timeout,
+        )
+
+    def unregister_tpu_shared_memory(
+        self, name="", headers=None, client_timeout=None
+    ) -> None:
+        self._call(
+            "TpuSharedMemoryUnregister",
+            service_pb2.TpuSharedMemoryUnregisterRequest(name=name),
+            headers,
+            client_timeout,
+        )
+
+    # -- inference -----------------------------------------------------------
+
+    def infer(
+        self,
+        model_name: str,
+        inputs: Sequence[InferInput],
+        model_version: str = "",
+        outputs: Optional[Sequence[InferRequestedOutput]] = None,
+        request_id: str = "",
+        sequence_id: Union[int, str] = 0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+        priority: int = 0,
+        timeout: Optional[int] = None,
+        client_timeout: Optional[float] = None,
+        headers: Optional[Dict[str, str]] = None,
+        compression_algorithm: Optional[str] = None,
+        parameters: Optional[Dict[str, Any]] = None,
+    ) -> InferResult:
+        """Run an inference and block for the result."""
+        request = get_inference_request(
+            model_name,
+            inputs,
+            model_version=model_version,
+            request_id=request_id,
+            outputs=outputs,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout,
+            parameters=parameters,
+        )
+        response = self._call(
+            "ModelInfer",
+            request,
+            headers,
+            client_timeout,
+            compression_algorithm=compression_algorithm,
+        )
+        return InferResult(response)
+
+    def async_infer(
+        self,
+        model_name: str,
+        inputs: Sequence[InferInput],
+        callback,
+        model_version: str = "",
+        outputs: Optional[Sequence[InferRequestedOutput]] = None,
+        request_id: str = "",
+        sequence_id: Union[int, str] = 0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+        priority: int = 0,
+        timeout: Optional[int] = None,
+        client_timeout: Optional[float] = None,
+        headers: Optional[Dict[str, str]] = None,
+        compression_algorithm: Optional[str] = None,
+        parameters: Optional[Dict[str, Any]] = None,
+    ) -> CallContext:
+        """Issue an inference without blocking.
+
+        ``callback(result, error)`` fires from a gRPC thread on completion.
+        Returns a :class:`CallContext` whose ``cancel()`` aborts the call.
+        """
+        request = get_inference_request(
+            model_name,
+            inputs,
+            model_version=model_version,
+            request_id=request_id,
+            outputs=outputs,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout,
+            parameters=parameters,
+        )
+        if self._verbose:
+            print(f"gRPC async ModelInfer: {{{str(request)[:200]}}}")
+        future = self._client_stub.ModelInfer.future(
+            request,
+            metadata=self._metadata(headers),
+            timeout=client_timeout,
+            compression=_grpc_compression(compression_algorithm),
+        )
+
+        def _done(f):
+            # Build (result, error) first, then invoke the callback exactly
+            # once — a raising user callback must not trigger a second,
+            # contradictory invocation.
+            result, error = None, None
+            try:
+                result = InferResult(f.result())
+            except grpc.RpcError as e:
+                error = rpc_error_to_exception(e)
+            except grpc.FutureCancelledError:
+                error = InferenceServerException("request was cancelled")
+            except Exception as e:  # noqa: BLE001
+                error = InferenceServerException(str(e))
+            callback(result, error)
+
+        future.add_done_callback(_done)
+        return CallContext(future)
+
+    # -- decoupled streaming -------------------------------------------------
+
+    def start_stream(
+        self,
+        callback,
+        stream_timeout: Optional[float] = None,
+        headers: Optional[Dict[str, str]] = None,
+        compression_algorithm: Optional[str] = None,
+    ) -> None:
+        """Open the bidirectional inference stream.
+
+        Only one stream per client at a time (the reference contract,
+        reference grpc_client.cc:1327-1332). ``callback(result, error)``
+        fires once per *response* — decoupled models may produce many
+        responses per request.
+        """
+        if self._stream is not None and self._stream.is_active():
+            raise InferenceServerException(
+                "stream is already active; call stop_stream() first"
+            )
+        self._stream = InferStream(callback, verbose=self._verbose)
+        call = self._client_stub.ModelStreamInfer(
+            self._stream.request_iterator,
+            metadata=self._metadata(headers),
+            timeout=stream_timeout,
+            compression=_grpc_compression(compression_algorithm),
+        )
+        self._stream.init_handler(call)
+
+    def async_stream_infer(
+        self,
+        model_name: str,
+        inputs: Sequence[InferInput],
+        model_version: str = "",
+        outputs: Optional[Sequence[InferRequestedOutput]] = None,
+        request_id: str = "",
+        sequence_id: Union[int, str] = 0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+        enable_empty_final_response: bool = False,
+        priority: int = 0,
+        timeout: Optional[int] = None,
+        parameters: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Send one request on the active stream (non-blocking)."""
+        if self._stream is None or not self._stream.is_active():
+            raise InferenceServerException(
+                "stream is not active; call start_stream() first"
+            )
+        request = get_inference_request(
+            model_name,
+            inputs,
+            model_version=model_version,
+            request_id=request_id,
+            outputs=outputs,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout,
+            parameters=parameters,
+        )
+        if enable_empty_final_response:
+            request.parameters[
+                "triton_enable_empty_final_response"
+            ].bool_param = True
+        self._stream.enqueue_request(request)
+
+    def stop_stream(self, cancel_requests: bool = False) -> None:
+        """Close the active stream (if any)."""
+        if self._stream is not None:
+            self._stream.close(cancel_requests=cancel_requests)
+            self._stream = None
+
+
+def _grpc_compression(algorithm: Optional[str]):
+    if algorithm is None:
+        return None
+    mapping = {
+        "deflate": grpc.Compression.Deflate,
+        "gzip": grpc.Compression.Gzip,
+        "none": grpc.Compression.NoCompression,
+    }
+    if algorithm not in mapping:
+        raise InferenceServerException(
+            f"unsupported compression algorithm '{algorithm}' "
+            "(expected 'deflate', 'gzip', or 'none')"
+        )
+    return mapping[algorithm]

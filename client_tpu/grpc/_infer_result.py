@@ -1,0 +1,109 @@
+"""InferResult for the gRPC protocol.
+
+Wraps a ModelInferResponse; decodes raw_output_contents (or proto contents)
+into numpy/jax arrays. Capability parity with reference
+src/python/library/tritonclient/grpc/_infer_result.py.
+"""
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from client_tpu.grpc._generated import grpc_service_pb2 as pb
+from client_tpu.utils import (
+    InferenceServerException,
+    deserialize_bytes_tensor,
+    triton_to_np_dtype,
+)
+
+_CONTENTS_FIELD = {
+    "BOOL": "bool_contents",
+    "INT8": "int_contents",
+    "INT16": "int_contents",
+    "INT32": "int_contents",
+    "INT64": "int64_contents",
+    "UINT8": "uint_contents",
+    "UINT16": "uint_contents",
+    "UINT32": "uint_contents",
+    "UINT64": "uint64_contents",
+    "FP32": "fp32_contents",
+    "FP64": "fp64_contents",
+    "BYTES": "bytes_contents",
+}
+
+
+class InferResult:
+    """The result of a gRPC inference request."""
+
+    def __init__(self, response: pb.ModelInferResponse):
+        self._response = response
+        self._index: Dict[str, int] = {
+            out.name: i for i, out in enumerate(response.outputs)
+        }
+
+    def get_response(self, as_json: bool = False):
+        """The underlying ModelInferResponse (or a JSON-ish dict)."""
+        if as_json:
+            from google.protobuf import json_format
+
+            return json_format.MessageToDict(
+                self._response, preserving_proto_field_name=True
+            )
+        return self._response
+
+    def get_output(self, name: str, as_json: bool = False):
+        """Metadata for output ``name`` (None if absent)."""
+        i = self._index.get(name)
+        if i is None:
+            return None
+        out = self._response.outputs[i]
+        if as_json:
+            from google.protobuf import json_format
+
+            return json_format.MessageToDict(
+                out, preserving_proto_field_name=True
+            )
+        return out
+
+    def as_numpy(self, name: str) -> Optional[np.ndarray]:
+        """Output ``name`` as a numpy array (None if absent or in shm)."""
+        i = self._index.get(name)
+        if i is None:
+            return None
+        out = self._response.outputs[i]
+        shape = list(out.shape)
+        datatype = out.datatype
+        if "shared_memory_region" in out.parameters:
+            return None  # caller reads the registered region directly
+        if i < len(self._response.raw_output_contents):
+            raw = self._response.raw_output_contents[i]
+            if datatype == "BYTES":
+                return deserialize_bytes_tensor(raw).reshape(shape)
+            np_dtype = triton_to_np_dtype(datatype)
+            if np_dtype is None:
+                raise InferenceServerException(
+                    f"unknown datatype '{datatype}' for output '{name}'"
+                )
+            return np.frombuffer(raw, dtype=np_dtype).reshape(shape)
+        field = _CONTENTS_FIELD.get(datatype)
+        if field is not None and out.HasField("contents"):
+            values = getattr(out.contents, field)
+            if datatype == "BYTES":
+                return np.array(list(values), dtype=np.object_).reshape(shape)
+            return np.array(
+                list(values), dtype=triton_to_np_dtype(datatype)
+            ).reshape(shape)
+        return None
+
+    def as_jax(self, name: str, device=None):
+        """Output ``name`` as a jax.Array placed on ``device``."""
+        host = self.as_numpy(name)
+        if host is None:
+            return None
+        if host.dtype == np.dtype(object):
+            raise InferenceServerException(
+                f"BYTES output '{name}' cannot convert to a jax.Array"
+            )
+        import jax
+
+        return jax.device_put(host, device)

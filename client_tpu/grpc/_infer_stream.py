@@ -1,0 +1,121 @@
+"""Bidirectional streaming machinery for the sync gRPC client.
+
+A queue-fed request iterator plus a response-reader thread invoking the
+user callback — the same shape as the reference's ``_InferStream`` /
+``_RequestIterator`` (reference
+src/python/library/tritonclient/grpc/_infer_stream.py:39-190), with the
+response-statistics bug class avoided by never assuming 1:1
+request/response (decoupled models send 0..N responses per request).
+"""
+
+import queue
+import threading
+from typing import Callable, Optional
+
+import grpc
+
+from client_tpu.grpc._infer_result import InferResult
+from client_tpu.grpc._utils import rpc_error_to_exception
+from client_tpu.utils import InferenceServerException
+
+_SENTINEL = object()
+
+
+class _RequestIterator:
+    """Blocking iterator feeding the gRPC stream writer."""
+
+    def __init__(self):
+        self._queue: "queue.Queue" = queue.Queue()
+
+    def put(self, request) -> None:
+        self._queue.put(request)
+
+    def close(self) -> None:
+        self._queue.put(_SENTINEL)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._queue.get()
+        if item is _SENTINEL:
+            raise StopIteration
+        return item
+
+
+class InferStream:
+    """One active bidirectional inference stream."""
+
+    def __init__(self, callback: Callable, verbose: bool = False):
+        self._callback = callback
+        self._verbose = verbose
+        self._requests = _RequestIterator()
+        self._call = None
+        self._worker: Optional[threading.Thread] = None
+        self._active = False
+        self._lock = threading.Lock()
+
+    def init_handler(self, call) -> None:
+        """Attach the gRPC call object and start the reader thread."""
+        self._call = call
+        self._active = True
+        self._worker = threading.Thread(
+            target=self._process_responses,
+            name="client-tpu-grpc-stream",
+            daemon=True,
+        )
+        self._worker.start()
+
+    @property
+    def request_iterator(self) -> _RequestIterator:
+        return self._requests
+
+    def is_active(self) -> bool:
+        with self._lock:
+            return self._active
+
+    def enqueue_request(self, request) -> None:
+        if not self.is_active():
+            raise InferenceServerException(
+                "stream is not active; call start_stream() first"
+            )
+        self._requests.put(request)
+
+    def _deactivate(self) -> None:
+        with self._lock:
+            self._active = False
+
+    def _process_responses(self) -> None:
+        try:
+            for response in self._call:
+                if self._verbose:
+                    print(f"stream response: {response.error_message or 'ok'}")
+                if response.error_message:
+                    self._callback(
+                        None, InferenceServerException(response.error_message)
+                    )
+                else:
+                    self._callback(InferResult(response.infer_response), None)
+        except grpc.RpcError as e:
+            self._deactivate()
+            if e.code() != grpc.StatusCode.CANCELLED:
+                self._callback(None, rpc_error_to_exception(e))
+        except Exception as e:  # noqa: BLE001 - surface to callback
+            self._deactivate()
+            self._callback(None, InferenceServerException(str(e)))
+        finally:
+            self._deactivate()
+
+    def close(self, cancel_requests: bool = False) -> None:
+        """End the stream. ``cancel_requests`` aborts in-flight requests."""
+        if cancel_requests and self._call is not None:
+            self._call.cancel()
+        self._requests.close()
+        if self._worker is not None:
+            self._worker.join(timeout=30)
+            if self._worker.is_alive() and self._call is not None:
+                # Server never sent the final response: force the reader out
+                # so its callback cannot interleave with a later stream.
+                self._call.cancel()
+                self._worker.join(timeout=10)
+        self._deactivate()

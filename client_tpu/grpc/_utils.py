@@ -1,0 +1,112 @@
+"""gRPC client helpers: request building and error mapping.
+
+Reference semantics: src/python/library/tritonclient/grpc/_utils.py:80-158.
+"""
+
+from typing import Any, Dict, Optional, Sequence
+
+import grpc
+
+from client_tpu.grpc._generated import grpc_service_pb2 as pb
+from client_tpu.utils import InferenceServerException
+
+
+def raise_error(msg: str) -> None:
+    raise InferenceServerException(msg)
+
+
+def rpc_error_to_exception(rpc_error: grpc.RpcError) -> InferenceServerException:
+    """Map a grpc.RpcError to the client exception type."""
+    try:
+        code = rpc_error.code()
+        status = str(code) if code is not None else None
+        details = rpc_error.details()
+    except Exception:
+        status = None
+        details = str(rpc_error)
+    return InferenceServerException(
+        details or "gRPC request failed", status=status
+    )
+
+
+def set_parameter(proto_params, key: str, value: Any) -> None:
+    if isinstance(value, bool):
+        proto_params[key].bool_param = value
+    elif isinstance(value, int):
+        proto_params[key].int64_param = value
+    elif isinstance(value, float):
+        proto_params[key].double_param = value
+    elif isinstance(value, str):
+        proto_params[key].string_param = value
+    else:
+        raise InferenceServerException(
+            f"unsupported parameter type {type(value).__name__} for '{key}'"
+        )
+
+
+_RESERVED_PARAMS = frozenset(
+    (
+        "sequence_id",
+        "sequence_start",
+        "sequence_end",
+        "priority",
+        "timeout",
+        "shared_memory_region",
+        "shared_memory_byte_size",
+        "shared_memory_offset",
+        "classification",
+        "binary_data",
+        "binary_data_size",
+        "binary_data_output",
+    )
+)
+
+
+def get_inference_request(
+    model_name: str,
+    inputs,
+    model_version: str = "",
+    request_id: str = "",
+    outputs=None,
+    sequence_id: int = 0,
+    sequence_start: bool = False,
+    sequence_end: bool = False,
+    priority: int = 0,
+    timeout: Optional[int] = None,
+    parameters: Optional[Dict[str, Any]] = None,
+) -> pb.ModelInferRequest:
+    """Build a ModelInferRequest proto from client-side tensor objects."""
+    request = pb.ModelInferRequest(
+        model_name=model_name, model_version=model_version
+    )
+    if request_id:
+        request.id = request_id
+    if sequence_id != 0 and sequence_id != "":
+        if isinstance(sequence_id, str):
+            request.parameters["sequence_id"].string_param = sequence_id
+        else:
+            request.parameters["sequence_id"].int64_param = sequence_id
+        request.parameters["sequence_start"].bool_param = bool(sequence_start)
+        request.parameters["sequence_end"].bool_param = bool(sequence_end)
+    if priority != 0:
+        request.parameters["priority"].uint64_param = priority
+    if timeout is not None:
+        request.parameters["timeout"].int64_param = timeout
+    if parameters:
+        for key, value in parameters.items():
+            if key in _RESERVED_PARAMS:
+                raise InferenceServerException(
+                    f"parameter '{key}' is reserved; use the dedicated "
+                    "keyword argument"
+                )
+            set_parameter(request.parameters, key, value)
+    for infer_input in inputs:
+        tensor = request.inputs.add()
+        tensor.CopyFrom(infer_input._get_tensor())
+        raw = infer_input._get_raw_content()
+        if raw is not None:
+            request.raw_input_contents.append(raw)
+    if outputs:
+        for infer_output in outputs:
+            request.outputs.add().CopyFrom(infer_output._get_tensor())
+    return request

@@ -1,0 +1,428 @@
+"""Asyncio gRPC client for KServe v2 inference servers.
+
+Mirrors the sync surface of :mod:`client_tpu.grpc` with coroutines, plus
+``stream_infer`` — an async-iterator interface over the decoupled
+bidirectional stream with cancellation (reference
+src/python/library/tritonclient/grpc/aio/__init__.py:50-798, ``stream_infer``
+at :688, cancel at :798).
+"""
+
+import asyncio
+from typing import Any, AsyncIterator, Dict, List, Optional, Sequence, Union
+
+import grpc
+
+from client_tpu._client import InferenceServerClientBase
+from client_tpu._request import Request
+from client_tpu.grpc import (
+    MAX_GRPC_MESSAGE_SIZE,
+    KeepAliveOptions,
+    _grpc_compression,
+    _to_json,
+)
+from client_tpu.grpc._generated import grpc_service_pb2 as service_pb2
+from client_tpu.grpc._infer_input import InferInput
+from client_tpu.grpc._infer_result import InferResult
+from client_tpu.grpc._requested_output import InferRequestedOutput
+from client_tpu.grpc._service_stubs import GRPCInferenceServiceStub
+from client_tpu.grpc._utils import get_inference_request, rpc_error_to_exception
+from client_tpu.utils import InferenceServerException
+
+__all__ = [
+    "InferenceServerClient",
+    "InferInput",
+    "InferRequestedOutput",
+    "InferResult",
+    "KeepAliveOptions",
+]
+
+
+class InferenceServerClient(InferenceServerClientBase):
+    """Asyncio client for the KServe v2 gRPC protocol."""
+
+    def __init__(
+        self,
+        url: str,
+        verbose: bool = False,
+        ssl: bool = False,
+        root_certificates: Optional[str] = None,
+        private_key: Optional[str] = None,
+        certificate_chain: Optional[str] = None,
+        creds: Optional[grpc.ChannelCredentials] = None,
+        keepalive_options: Optional[KeepAliveOptions] = None,
+        channel_args: Optional[List] = None,
+    ):
+        super().__init__()
+        self._verbose = verbose
+        if channel_args is not None:
+            options = list(channel_args)
+        else:
+            options = [
+                ("grpc.max_send_message_length", MAX_GRPC_MESSAGE_SIZE),
+                ("grpc.max_receive_message_length", MAX_GRPC_MESSAGE_SIZE),
+                ("grpc.primary_user_agent", "client-tpu-grpc-aio"),
+            ]
+            if keepalive_options is not None:
+                options += [
+                    ("grpc.keepalive_time_ms", keepalive_options.keepalive_time_ms),
+                    (
+                        "grpc.keepalive_timeout_ms",
+                        keepalive_options.keepalive_timeout_ms,
+                    ),
+                    (
+                        "grpc.keepalive_permit_without_calls",
+                        int(keepalive_options.keepalive_permit_without_calls),
+                    ),
+                    (
+                        "grpc.http2.max_pings_without_data",
+                        keepalive_options.http2_max_pings_without_data,
+                    ),
+                ]
+        if creds is not None:
+            self._channel = grpc.aio.secure_channel(url, creds, options=options)
+        elif ssl:
+
+            def _read(path):
+                if path is None:
+                    return None
+                with open(path, "rb") as f:
+                    return f.read()
+
+            credentials = grpc.ssl_channel_credentials(
+                root_certificates=_read(root_certificates),
+                private_key=_read(private_key),
+                certificate_chain=_read(certificate_chain),
+            )
+            self._channel = grpc.aio.secure_channel(
+                url, credentials, options=options
+            )
+        else:
+            self._channel = grpc.aio.insecure_channel(url, options=options)
+        self._client_stub = GRPCInferenceServiceStub(self._channel)
+
+    def _metadata(self, headers: Optional[Dict[str, str]]):
+        request = Request(headers or {})
+        self._call_plugin(request)
+        return tuple((k.lower(), v) for k, v in request.headers.items()) or None
+
+    async def _call(self, name, request, headers=None, client_timeout=None):
+        try:
+            return await getattr(self._client_stub, name)(
+                request,
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+            )
+        except grpc.RpcError as e:
+            raise rpc_error_to_exception(e) from None
+
+    async def close(self) -> None:
+        await self._channel.close()
+
+    async def __aenter__(self) -> "InferenceServerClient":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    # -- health -------------------------------------------------------------
+
+    async def is_server_live(self, headers=None, client_timeout=None) -> bool:
+        r = await self._call(
+            "ServerLive", service_pb2.ServerLiveRequest(), headers, client_timeout
+        )
+        return r.live
+
+    async def is_server_ready(self, headers=None, client_timeout=None) -> bool:
+        r = await self._call(
+            "ServerReady", service_pb2.ServerReadyRequest(), headers, client_timeout
+        )
+        return r.ready
+
+    async def is_model_ready(
+        self, model_name, model_version="", headers=None, client_timeout=None
+    ) -> bool:
+        r = await self._call(
+            "ModelReady",
+            service_pb2.ModelReadyRequest(name=model_name, version=model_version),
+            headers,
+            client_timeout,
+        )
+        return r.ready
+
+    # -- metadata / config / repository / stats ------------------------------
+
+    async def get_server_metadata(
+        self, headers=None, as_json=False, client_timeout=None
+    ):
+        r = await self._call(
+            "ServerMetadata",
+            service_pb2.ServerMetadataRequest(),
+            headers,
+            client_timeout,
+        )
+        return _to_json(r) if as_json else r
+
+    async def get_model_metadata(
+        self,
+        model_name,
+        model_version="",
+        headers=None,
+        as_json=False,
+        client_timeout=None,
+    ):
+        r = await self._call(
+            "ModelMetadata",
+            service_pb2.ModelMetadataRequest(
+                name=model_name, version=model_version
+            ),
+            headers,
+            client_timeout,
+        )
+        return _to_json(r) if as_json else r
+
+    async def get_model_config(
+        self,
+        model_name,
+        model_version="",
+        headers=None,
+        as_json=False,
+        client_timeout=None,
+    ):
+        r = await self._call(
+            "ModelConfig",
+            service_pb2.ModelConfigRequest(name=model_name, version=model_version),
+            headers,
+            client_timeout,
+        )
+        return _to_json(r) if as_json else r
+
+    async def get_model_repository_index(
+        self, headers=None, as_json=False, client_timeout=None
+    ):
+        r = await self._call(
+            "RepositoryIndex",
+            service_pb2.RepositoryIndexRequest(),
+            headers,
+            client_timeout,
+        )
+        return _to_json(r) if as_json else r
+
+    async def load_model(
+        self, model_name, headers=None, config=None, files=None, client_timeout=None
+    ) -> None:
+        request = service_pb2.RepositoryModelLoadRequest(model_name=model_name)
+        if config is not None:
+            request.parameters["config"].string_param = config
+        if files:
+            for name, content in files.items():
+                request.parameters[name].bytes_param = content
+        await self._call("RepositoryModelLoad", request, headers, client_timeout)
+
+    async def unload_model(
+        self,
+        model_name,
+        headers=None,
+        unload_dependents=False,
+        client_timeout=None,
+    ) -> None:
+        request = service_pb2.RepositoryModelUnloadRequest(model_name=model_name)
+        request.parameters["unload_dependents"].bool_param = unload_dependents
+        await self._call("RepositoryModelUnload", request, headers, client_timeout)
+
+    async def get_inference_statistics(
+        self,
+        model_name="",
+        model_version="",
+        headers=None,
+        as_json=False,
+        client_timeout=None,
+    ):
+        r = await self._call(
+            "ModelStatistics",
+            service_pb2.ModelStatisticsRequest(
+                name=model_name, version=model_version
+            ),
+            headers,
+            client_timeout,
+        )
+        return _to_json(r) if as_json else r
+
+    # -- shared memory -------------------------------------------------------
+
+    async def get_system_shared_memory_status(
+        self, region_name="", headers=None, as_json=False, client_timeout=None
+    ):
+        r = await self._call(
+            "SystemSharedMemoryStatus",
+            service_pb2.SystemSharedMemoryStatusRequest(name=region_name),
+            headers,
+            client_timeout,
+        )
+        return _to_json(r) if as_json else r
+
+    async def register_system_shared_memory(
+        self, name, key, byte_size, offset=0, headers=None, client_timeout=None
+    ) -> None:
+        await self._call(
+            "SystemSharedMemoryRegister",
+            service_pb2.SystemSharedMemoryRegisterRequest(
+                name=name, key=key, offset=offset, byte_size=byte_size
+            ),
+            headers,
+            client_timeout,
+        )
+
+    async def unregister_system_shared_memory(
+        self, name="", headers=None, client_timeout=None
+    ) -> None:
+        await self._call(
+            "SystemSharedMemoryUnregister",
+            service_pb2.SystemSharedMemoryUnregisterRequest(name=name),
+            headers,
+            client_timeout,
+        )
+
+    async def get_tpu_shared_memory_status(
+        self, region_name="", headers=None, as_json=False, client_timeout=None
+    ):
+        r = await self._call(
+            "TpuSharedMemoryStatus",
+            service_pb2.TpuSharedMemoryStatusRequest(name=region_name),
+            headers,
+            client_timeout,
+        )
+        return _to_json(r) if as_json else r
+
+    async def register_tpu_shared_memory(
+        self, name, raw_handle, device_id, byte_size, headers=None, client_timeout=None
+    ) -> None:
+        await self._call(
+            "TpuSharedMemoryRegister",
+            service_pb2.TpuSharedMemoryRegisterRequest(
+                name=name,
+                raw_handle=raw_handle,
+                device_id=device_id,
+                byte_size=byte_size,
+            ),
+            headers,
+            client_timeout,
+        )
+
+    async def unregister_tpu_shared_memory(
+        self, name="", headers=None, client_timeout=None
+    ) -> None:
+        await self._call(
+            "TpuSharedMemoryUnregister",
+            service_pb2.TpuSharedMemoryUnregisterRequest(name=name),
+            headers,
+            client_timeout,
+        )
+
+    # -- inference -----------------------------------------------------------
+
+    async def infer(
+        self,
+        model_name: str,
+        inputs: Sequence[InferInput],
+        model_version: str = "",
+        outputs: Optional[Sequence[InferRequestedOutput]] = None,
+        request_id: str = "",
+        sequence_id: Union[int, str] = 0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+        priority: int = 0,
+        timeout: Optional[int] = None,
+        client_timeout: Optional[float] = None,
+        headers: Optional[Dict[str, str]] = None,
+        compression_algorithm: Optional[str] = None,
+        parameters: Optional[Dict[str, Any]] = None,
+    ) -> InferResult:
+        request = get_inference_request(
+            model_name,
+            inputs,
+            model_version=model_version,
+            request_id=request_id,
+            outputs=outputs,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout,
+            parameters=parameters,
+        )
+        try:
+            response = await self._client_stub.ModelInfer(
+                request,
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+                compression=_grpc_compression(compression_algorithm),
+            )
+        except grpc.RpcError as e:
+            raise rpc_error_to_exception(e) from None
+        return InferResult(response)
+
+    def stream_infer(
+        self,
+        inputs_iterator: AsyncIterator[Dict[str, Any]],
+        stream_timeout: Optional[float] = None,
+        headers: Optional[Dict[str, str]] = None,
+        compression_algorithm: Optional[str] = None,
+    ) -> AsyncIterator:
+        """Run inferences over the decoupled bidirectional stream.
+
+        ``inputs_iterator`` yields dicts of :meth:`infer`-style kwargs (at
+        minimum ``model_name`` and ``inputs``). Returns an async iterator of
+        ``(InferResult, error)`` tuples carrying a ``cancel()`` method.
+        """
+
+        async def _request_iterator():
+            async for kwargs in inputs_iterator:
+                enable_empty_final = kwargs.pop(
+                    "enable_empty_final_response", False
+                )
+                request = get_inference_request(
+                    kwargs.pop("model_name"),
+                    kwargs.pop("inputs"),
+                    **kwargs,
+                )
+                if enable_empty_final:
+                    request.parameters[
+                        "triton_enable_empty_final_response"
+                    ].bool_param = True
+                yield request
+
+        call = self._client_stub.ModelStreamInfer(
+            _request_iterator(),
+            metadata=self._metadata(headers),
+            timeout=stream_timeout,
+            compression=_grpc_compression(compression_algorithm),
+        )
+
+        class _ResponseIterator:
+            """Async iterator of (result, error); cancellable."""
+
+            def __init__(self, grpc_call):
+                self._call = grpc_call
+
+            def cancel(self) -> bool:
+                return self._call.cancel()
+
+            def __aiter__(self):
+                return self
+
+            async def __anext__(self):
+                try:
+                    response = await self._call.read()
+                except asyncio.CancelledError:
+                    raise StopAsyncIteration from None
+                except grpc.RpcError as e:
+                    raise rpc_error_to_exception(e) from None
+                if response == grpc.aio.EOF:
+                    raise StopAsyncIteration
+                if response.error_message:
+                    return None, InferenceServerException(
+                        response.error_message
+                    )
+                return InferResult(response.infer_response), None
+
+        return _ResponseIterator(call)

@@ -158,18 +158,25 @@ class InferenceServerClient:
         if callback is not None:
 
             def _done(f: concurrent.futures.Future):
+                result, error = None, None
                 try:
-                    callback(f.result(), None)
+                    result = f.result()
                 except Exception as e:  # noqa: BLE001 - surface to callback
-                    callback(None, e)
+                    error = e
+                callback(result, error)
 
             future.add_done_callback(_done)
         return InferAsyncRequest(future)
 
-    def close(self) -> None:
+    def close(self, timeout: Optional[float] = 60.0) -> None:
         """Close the connection pool and stop the loop thread."""
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
         try:
-            self._runner.run(self._aio_client.close())
+            self._runner.run(self._aio_client.close(), timeout=timeout)
+        except Exception:
+            pass  # pool teardown is best-effort; the loop stops regardless
         finally:
             self._runner.close()
 
@@ -181,15 +188,8 @@ class InferenceServerClient:
 
     def __del__(self):  # best-effort cleanup, mirrors close()
         try:
-            runner = self.__dict__.get("_runner")
-            aio_client = self.__dict__.get("_aio_client")
-            if runner is None:
+            if self.__dict__.get("_closed", False):
                 return
-            if aio_client is not None:
-                try:
-                    runner.run(aio_client.close(), timeout=5)
-                except Exception:
-                    pass
-            runner.close()
+            self.close(timeout=5.0)
         except Exception:
             pass

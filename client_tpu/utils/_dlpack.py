@@ -1,0 +1,261 @@
+"""Pure-ctypes DLPack producer/consumer.
+
+Lets shared-memory regions interoperate zero-copy with any DLPack-speaking
+framework (torch, jax, numpy >= 1.22) without importing them. Structures
+follow the public DLPack v0.8 ABI (dlpack/dlpack.h); same role as the
+reference's ctypes implementation
+(reference src/python/library/tritonclient/utils/_dlpack.py:57-271).
+"""
+
+import ctypes
+from typing import Any, Tuple
+
+import numpy as np
+
+# -- DLPack ABI --------------------------------------------------------------
+
+kDLCPU = 1
+kDLCUDA = 2
+kDLCUDAHost = 3
+kDLOpenCL = 4
+kDLVulkan = 7
+kDLMetal = 8
+kDLVPI = 9
+kDLROCM = 10
+kDLROCMHost = 11
+kDLExtDev = 12
+kDLCUDAManaged = 13
+kDLOneAPI = 14
+
+kDLInt = 0
+kDLUInt = 1
+kDLFloat = 2
+kDLOpaqueHandle = 3
+kDLBfloat = 4
+kDLComplex = 5
+kDLBool = 6
+
+
+class DLDevice(ctypes.Structure):
+    _fields_ = [
+        ("device_type", ctypes.c_int32),
+        ("device_id", ctypes.c_int32),
+    ]
+
+
+class DLDataType(ctypes.Structure):
+    _fields_ = [
+        ("type_code", ctypes.c_uint8),
+        ("bits", ctypes.c_uint8),
+        ("lanes", ctypes.c_uint16),
+    ]
+
+
+class DLTensor(ctypes.Structure):
+    _fields_ = [
+        ("data", ctypes.c_void_p),
+        ("device", DLDevice),
+        ("ndim", ctypes.c_int32),
+        ("dtype", DLDataType),
+        ("shape", ctypes.POINTER(ctypes.c_int64)),
+        ("strides", ctypes.POINTER(ctypes.c_int64)),
+        ("byte_offset", ctypes.c_uint64),
+    ]
+
+
+class DLManagedTensor(ctypes.Structure):
+    pass
+
+
+_DELETER_FN = ctypes.CFUNCTYPE(None, ctypes.POINTER(DLManagedTensor))
+
+DLManagedTensor._fields_ = [
+    ("dl_tensor", DLTensor),
+    ("manager_ctx", ctypes.c_void_p),
+    ("deleter", _DELETER_FN),
+]
+
+_CAPSULE_NAME = b"dltensor"
+_USED_CAPSULE_NAME = b"used_dltensor"
+
+_pycapi = ctypes.pythonapi
+_pycapi.PyCapsule_New.restype = ctypes.py_object
+_pycapi.PyCapsule_New.argtypes = [
+    ctypes.c_void_p,
+    ctypes.c_char_p,
+    ctypes.c_void_p,
+]
+_pycapi.PyCapsule_GetPointer.restype = ctypes.c_void_p
+_pycapi.PyCapsule_GetPointer.argtypes = [ctypes.py_object, ctypes.c_char_p]
+_pycapi.PyCapsule_IsValid.restype = ctypes.c_int
+_pycapi.PyCapsule_IsValid.argtypes = [ctypes.py_object, ctypes.c_char_p]
+_pycapi.PyCapsule_SetName.restype = ctypes.c_int
+_pycapi.PyCapsule_SetName.argtypes = [ctypes.py_object, ctypes.c_char_p]
+
+
+def _np_dtype_to_dl(dtype: np.dtype) -> DLDataType:
+    try:
+        import ml_dtypes
+
+        if dtype == np.dtype(ml_dtypes.bfloat16):
+            return DLDataType(kDLBfloat, 16, 1)
+    except ImportError:  # pragma: no cover
+        pass
+    kind_map = {"i": kDLInt, "u": kDLUInt, "f": kDLFloat, "b": kDLBool}
+    if dtype.kind not in kind_map:
+        raise ValueError(f"dtype {dtype} has no DLPack representation")
+    return DLDataType(kind_map[dtype.kind], dtype.itemsize * 8, 1)
+
+
+def _dl_to_np_dtype(dl: DLDataType) -> np.dtype:
+    if dl.lanes != 1:
+        raise ValueError("vectorized (lanes>1) DLPack dtypes not supported")
+    if dl.type_code == kDLBfloat and dl.bits == 16:
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    code_map = {kDLInt: "i", kDLUInt: "u", kDLFloat: "f", kDLBool: "b"}
+    if dl.type_code not in code_map:
+        raise ValueError(f"DLPack type code {dl.type_code} not supported")
+    if dl.type_code == kDLBool:
+        return np.dtype(np.bool_)
+    return np.dtype(f"{code_map[dl.type_code]}{dl.bits // 8}")
+
+
+class _Holder:
+    """Keeps the backing buffer + ctypes arrays alive until the consumer
+    calls the deleter."""
+
+    live = {}
+
+    def __init__(self, owner: Any, managed: DLManagedTensor, shape_arr, deleter):
+        self.owner = owner
+        self.managed = managed
+        self.shape_arr = shape_arr
+        self.deleter = deleter
+
+
+@_DELETER_FN
+def _deleter(managed_ptr):
+    _Holder.live.pop(ctypes.addressof(managed_ptr.contents), None)
+
+
+def make_dlpack_capsule(buffer, shape, np_dtype, writable: bool = True):
+    """Produce a ``dltensor`` capsule over ``buffer`` (memoryview/ndarray).
+
+    The capsule holds a reference to ``buffer`` until consumed+deleted, so
+    the shared-memory mapping stays alive while the importing framework
+    uses it.
+    """
+    arr = np.frombuffer(buffer, dtype=np_dtype).reshape(shape)
+    data_ptr = arr.ctypes.data if hasattr(arr, "ctypes") else None
+    ndim = arr.ndim
+    shape_arr = (ctypes.c_int64 * ndim)(*arr.shape)
+
+    managed = DLManagedTensor()
+    managed.dl_tensor.data = ctypes.c_void_p(data_ptr)
+    managed.dl_tensor.device = DLDevice(kDLCPU, 0)
+    managed.dl_tensor.ndim = ndim
+    managed.dl_tensor.dtype = _np_dtype_to_dl(np.dtype(np_dtype))
+    managed.dl_tensor.shape = shape_arr
+    managed.dl_tensor.strides = None  # compact row-major
+    managed.dl_tensor.byte_offset = 0
+    managed.manager_ctx = None
+    managed.deleter = _deleter
+
+    holder = _Holder(arr, managed, shape_arr, _deleter)
+    _Holder.live[ctypes.addressof(managed)] = holder
+    return _pycapi.PyCapsule_New(
+        ctypes.byref(managed), _CAPSULE_NAME, None
+    )
+
+
+def consume_dlpack_capsule(capsule) -> np.ndarray:
+    """Import a ``dltensor`` capsule as a (possibly zero-copy) CPU ndarray.
+
+    Only compact row-major CPU tensors import zero-copy; strided tensors
+    are copied; device tensors are rejected (the caller should export to
+    host first, e.g. via ``np.asarray`` / ``jax.device_get``).
+    """
+    if not _pycapi.PyCapsule_IsValid(capsule, _CAPSULE_NAME):
+        raise ValueError("expected a 'dltensor' capsule (already consumed?)")
+    ptr = _pycapi.PyCapsule_GetPointer(capsule, _CAPSULE_NAME)
+    managed = ctypes.cast(ptr, ctypes.POINTER(DLManagedTensor)).contents
+    dl = managed.dl_tensor
+    if dl.device.device_type not in (kDLCPU, kDLCUDAHost, kDLROCMHost):
+        raise ValueError(
+            "only host-memory DLPack tensors can be consumed here; stage "
+            "device tensors to host first"
+        )
+    np_dtype = _dl_to_np_dtype(dl.dtype)
+    shape = [dl.shape[i] for i in range(dl.ndim)]
+    count = int(np.prod(shape)) if shape else 1
+
+    base = dl.data  # ctypes exposes c_void_p struct fields as int/None
+    if not base:
+        arr = np.empty(shape, dtype=np_dtype)
+    else:
+        src = (ctypes.c_uint8 * (count * np_dtype.itemsize)).from_address(
+            base + dl.byte_offset
+        )
+        flat = np.frombuffer(src, dtype=np_dtype)
+        if dl.strides:
+            strides = [dl.strides[i] for i in range(dl.ndim)]
+            itemstrides = [s * np_dtype.itemsize for s in strides]
+            arr = np.lib.stride_tricks.as_strided(
+                flat, shape=shape, strides=itemstrides
+            ).copy()
+        else:
+            arr = flat.reshape(shape).copy()
+    # Hand ownership back to the producer.
+    if managed.deleter:
+        managed.deleter(ctypes.cast(ptr, ctypes.POINTER(DLManagedTensor)))
+    _pycapi.PyCapsule_SetName(capsule, _USED_CAPSULE_NAME)
+    return arr
+
+
+def get_dlpack_device(tensor) -> Tuple[int, int]:
+    """The (device_type, device_id) a tensor's __dlpack__ would report."""
+    if hasattr(tensor, "__dlpack_device__"):
+        return tuple(tensor.__dlpack_device__())
+    return (kDLCPU, 0)
+
+
+def is_contiguous_data(ndim, shape_ptr, strides_ptr) -> bool:
+    """True if a DLTensor's strides describe compact row-major data."""
+    if not strides_ptr:
+        return True
+    expected = 1
+    for i in range(ndim - 1, -1, -1):
+        if shape_ptr[i] != 1 and strides_ptr[i] != expected:
+            return False
+        expected *= shape_ptr[i]
+    return True
+
+
+class SharedMemoryTensor:
+    """DLPack-exporting view over a shared-memory buffer.
+
+    Implements ``__dlpack__``/``__dlpack_device__`` so
+    ``torch.from_dlpack``/``np.from_dlpack`` import the region zero-copy
+    (reference utils/_shared_memory_tensor.py:34-87 semantics).
+    """
+
+    def __init__(self, buffer, shape, np_dtype):
+        self._buffer = buffer
+        self._shape = tuple(shape)
+        self._np_dtype = np.dtype(np_dtype)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._np_dtype
+
+    def __dlpack__(self, stream=None, max_version=None, dl_device=None, copy=None):
+        return make_dlpack_capsule(self._buffer, self._shape, self._np_dtype)
+
+    def __dlpack_device__(self) -> Tuple[int, int]:
+        return (kDLCPU, 0)

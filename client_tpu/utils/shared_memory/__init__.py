@@ -1,0 +1,219 @@
+"""System (POSIX) shared-memory utilities.
+
+Capability parity with the reference module
+(reference src/python/library/tritonclient/utils/shared_memory/__init__.py
+backed by the C extension libcshm.so,
+reference .../shared_memory/shared_memory.cc:76-149). Implemented directly
+on Linux /dev/shm + mmap — no C extension needed for correctness; the hot
+data path (bulk np copies into the mapping) is already zero-Python-loop.
+"""
+
+import mmap
+import os
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from client_tpu.utils import serialize_byte_tensor
+
+SHM_DIR = "/dev/shm"
+
+_mapped_lock = threading.Lock()
+_mapped_regions: Dict[str, "SharedMemoryRegion"] = {}
+
+
+class SharedMemoryException(Exception):
+    """Exception raised for shared-memory errors (errno-style messages)."""
+
+    def __init__(self, err: str):
+        self.err = err
+        super().__init__(err)
+
+    def __str__(self) -> str:
+        return self.err
+
+
+class SharedMemoryRegion:
+    """Handle to a created/attached system shared-memory region."""
+
+    def __init__(
+        self,
+        triton_shm_name: str,
+        shm_key: str,
+        fd: int,
+        mapping: mmap.mmap,
+        byte_size: int,
+        offset: int,
+        owner: bool,
+    ):
+        self._triton_shm_name = triton_shm_name
+        self._shm_key = shm_key
+        self._fd = fd
+        self._map = mapping
+        self._byte_size = byte_size
+        self._offset = offset
+        self._owner = owner
+        self._closed = False
+
+    # accessor surface matching the reference handle tuple
+    def name(self) -> str:
+        return self._triton_shm_name
+
+    def key(self) -> str:
+        return self._shm_key
+
+    def byte_size(self) -> int:
+        return self._byte_size
+
+    def offset(self) -> int:
+        return self._offset
+
+    def buf(self, offset: int = 0, length: Optional[int] = None) -> memoryview:
+        """A writable memoryview over [offset, offset+length) of the region."""
+        if self._closed:
+            raise SharedMemoryException(
+                "unable to access destroyed shared memory region"
+            )
+        start = self._offset + offset
+        if length is None:
+            end = self._offset + self._byte_size
+        else:
+            end = start + length
+        if offset < 0 or end > self._offset + self._byte_size:
+            raise SharedMemoryException(
+                "unable to access shared memory region beyond its size"
+            )
+        return memoryview(self._map)[start:end]
+
+    def _close(self, unlink: bool) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._map.close()
+        except BufferError:
+            # Zero-copy numpy views still reference the mapping; it will be
+            # unmapped when the last view is garbage-collected. The fd and
+            # (below) the name are released now, matching the reference's
+            # unlink-first semantics.
+            pass
+        finally:
+            os.close(self._fd)
+        if unlink and self._owner:
+            try:
+                os.unlink(os.path.join(SHM_DIR, self._shm_key.lstrip("/")))
+            except FileNotFoundError:
+                pass
+
+
+def _shm_path(shm_key: str) -> str:
+    return os.path.join(SHM_DIR, shm_key.lstrip("/"))
+
+
+def create_shared_memory_region(
+    triton_shm_name: str,
+    shm_key: str,
+    byte_size: int,
+    create_only: bool = False,
+) -> SharedMemoryRegion:
+    """Create (or attach to) a system shared-memory region.
+
+    Mirrors the reference contract (reference shared_memory/__init__.py:93):
+    ``create_only=True`` fails if the key already exists; otherwise an
+    existing region is attached and grown to ``byte_size`` if needed.
+    """
+    if byte_size < 0:
+        raise SharedMemoryException(
+            "unable to create shared memory region: negative byte_size"
+        )
+    path = _shm_path(shm_key)
+    flags = os.O_RDWR | os.O_CREAT
+    if create_only:
+        flags |= os.O_EXCL
+    try:
+        fd = os.open(path, flags, 0o600)
+    except FileExistsError:
+        raise SharedMemoryException(
+            f"unable to create the shared memory region, already exists: "
+            f"'{shm_key}'"
+        ) from None
+    except OSError as e:
+        raise SharedMemoryException(
+            f"unable to create the shared memory region: {e}"
+        ) from None
+    try:
+        existing = os.fstat(fd).st_size
+        if existing < byte_size:
+            os.ftruncate(fd, byte_size)
+        mapping = mmap.mmap(fd, max(byte_size, existing) or 1)
+    except OSError as e:
+        os.close(fd)
+        raise SharedMemoryException(
+            f"unable to map the shared memory region: {e}"
+        ) from None
+    region = SharedMemoryRegion(
+        triton_shm_name, shm_key, fd, mapping, byte_size, 0, owner=True
+    )
+    with _mapped_lock:
+        _mapped_regions[triton_shm_name] = region
+    return region
+
+
+def set_shared_memory_region(
+    shm_handle: SharedMemoryRegion, input_values, offset: int = 0
+) -> None:
+    """Copy a list of numpy arrays into the region back-to-back.
+
+    BYTES (object/str) tensors are written in their serialized wire form,
+    matching the reference behavior.
+    """
+    if not isinstance(input_values, (list, tuple)):
+        raise SharedMemoryException(
+            "input_values must be a list/tuple of numpy arrays"
+        )
+    cursor = offset
+    for arr in input_values:
+        arr = np.asarray(arr)
+        if arr.dtype == np.dtype(object) or arr.dtype.kind in ("S", "U"):
+            payload = serialize_byte_tensor(arr).tobytes()
+        else:
+            payload = np.ascontiguousarray(arr).tobytes()
+        view = shm_handle.buf(cursor, len(payload))
+        view[:] = payload
+        cursor += len(payload)
+
+
+def get_contents_as_numpy(
+    shm_handle: SharedMemoryRegion,
+    datatype,
+    shape: List[int],
+    offset: int = 0,
+) -> np.ndarray:
+    """View the region contents as a numpy array of ``datatype``/``shape``.
+
+    Fixed-size dtypes return a zero-copy view; BYTES deserializes.
+    """
+    from client_tpu.utils import deserialize_bytes_tensor, num_elements
+
+    dtype = np.dtype(datatype) if not isinstance(datatype, np.dtype) else datatype
+    if dtype == np.dtype(object):
+        view = shm_handle.buf(offset)
+        return deserialize_bytes_tensor(bytes(view)).reshape(shape)
+    count = num_elements(shape)
+    view = shm_handle.buf(offset, count * dtype.itemsize)
+    return np.frombuffer(view, dtype=dtype).reshape(shape)
+
+
+def mapped_shared_memory_regions() -> List[str]:
+    """Names of regions currently mapped by this process."""
+    with _mapped_lock:
+        return list(_mapped_regions.keys())
+
+
+def destroy_shared_memory_region(shm_handle: SharedMemoryRegion) -> None:
+    """Unmap and unlink the region."""
+    with _mapped_lock:
+        _mapped_regions.pop(shm_handle.name(), None)
+    shm_handle._close(unlink=True)

@@ -1,0 +1,250 @@
+"""Integration tests: sync + aio gRPC clients against the in-repo server.
+
+Covers unary, async, and decoupled streaming inference plus the control
+surface (SURVEY.md §3.1-3.3 call-stack parity).
+"""
+
+import asyncio
+import queue
+import threading
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.grpc.aio as aio_grpcclient
+from client_tpu.utils import InferenceServerException, bfloat16
+from client_tpu.testing import InProcessServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    with InProcessServer(http=False) as s:
+        yield s
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    with grpcclient.InferenceServerClient(server.grpc_url) as c:
+        yield c
+
+
+def _simple_inputs():
+    in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    in1 = np.ones([1, 16], dtype=np.int32)
+    a = grpcclient.InferInput("INPUT0", [1, 16], "INT32").set_data_from_numpy(in0)
+    b = grpcclient.InferInput("INPUT1", [1, 16], "INT32").set_data_from_numpy(in1)
+    return in0, in1, [a, b]
+
+
+def test_health(client):
+    assert client.is_server_live()
+    assert client.is_server_ready()
+    assert client.is_model_ready("simple")
+    assert not client.is_model_ready("nope")
+
+
+def test_metadata(client):
+    meta = client.get_server_metadata()
+    assert meta.name == "client_tpu_server"
+    assert "tpu_shared_memory" in list(meta.extensions)
+    model_meta = client.get_model_metadata("simple", as_json=True)
+    assert model_meta["name"] == "simple"
+    assert {t["name"] for t in model_meta["inputs"]} == {"INPUT0", "INPUT1"}
+
+
+def test_model_config(client):
+    config = client.get_model_config("simple")
+    assert config.config.max_batch_size == 8
+    assert config.config.backend == "jax"
+    assert not config.config.model_transaction_policy.decoupled
+    repeat_config = client.get_model_config("repeat_int32")
+    assert repeat_config.config.model_transaction_policy.decoupled
+
+
+def test_repository_index(client):
+    index = client.get_model_repository_index(as_json=True)
+    names = {m["name"] for m in index["models"]}
+    assert "simple" in names
+
+
+def test_infer(client):
+    in0, in1, inputs = _simple_inputs()
+    outputs = [
+        grpcclient.InferRequestedOutput("OUTPUT0"),
+        grpcclient.InferRequestedOutput("OUTPUT1"),
+    ]
+    result = client.infer("simple", inputs, outputs=outputs, request_id="9")
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), in0 - in1)
+    assert result.get_response().id == "9"
+    assert result.get_output("OUTPUT0").datatype == "INT32"
+    assert result.as_numpy("MISSING") is None
+
+
+def test_infer_bf16_and_jax(client):
+    jnp = pytest.importorskip("jax.numpy")
+    x = jnp.asarray(np.random.randn(2, 4), dtype=jnp.bfloat16)
+    inp = grpcclient.InferInput("INPUT0", [2, 4], "BF16").set_data_from_jax(x)
+    result = client.infer("identity_bf16", [inp])
+    out = result.as_numpy("OUTPUT0")
+    assert out.dtype == bfloat16
+    np.testing.assert_array_equal(out, np.asarray(x))
+    assert result.as_jax("OUTPUT0").dtype == jnp.bfloat16
+
+
+def test_infer_bytes(client):
+    data = np.array([b"a", b"longer-string", b""], dtype=object)
+    inp = grpcclient.InferInput("INPUT0", [3], "BYTES").set_data_from_numpy(data)
+    result = client.infer("identity_bytes", [inp])
+    assert list(result.as_numpy("OUTPUT0")) == list(data)
+
+
+def test_infer_compression(client):
+    in0, in1, inputs = _simple_inputs()
+    result = client.infer("simple", inputs, compression_algorithm="gzip")
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+    with pytest.raises(InferenceServerException, match="compression"):
+        client.infer("simple", inputs, compression_algorithm="zstd")
+
+
+def test_infer_error(client):
+    _, _, inputs = _simple_inputs()
+    with pytest.raises(InferenceServerException, match="not found") as exc_info:
+        client.infer("missing_model", inputs)
+    assert "NOT_FOUND" in exc_info.value.status()
+
+
+def test_async_infer(client):
+    in0, in1, inputs = _simple_inputs()
+    done = threading.Event()
+    captured = {}
+
+    def callback(result, error):
+        captured["result"], captured["error"] = result, error
+        done.set()
+
+    ctx = client.async_infer("simple", inputs, callback)
+    assert done.wait(timeout=30)
+    assert captured["error"] is None
+    np.testing.assert_array_equal(
+        captured["result"].as_numpy("OUTPUT0"), in0 + in1
+    )
+    assert ctx.get_result() is not None
+
+
+def test_streaming_decoupled(client):
+    """One request -> N streamed responses (token-streaming shape)."""
+    values = np.array([3, 1, 4, 1, 5], dtype=np.int32)
+    results: "queue.Queue" = queue.Queue()
+
+    client.start_stream(callback=lambda r, e: results.put((r, e)))
+    try:
+        inp = grpcclient.InferInput("IN", [5], "INT32").set_data_from_numpy(values)
+        client.async_stream_infer("repeat_int32", [inp], request_id="s1")
+        received = []
+        for _ in range(len(values)):
+            result, error = results.get(timeout=30)
+            assert error is None
+            received.append(int(result.as_numpy("OUT")[0]))
+        assert received == list(values)
+        final_params = result.get_response().parameters
+        assert final_params["triton_final_response"].bool_param
+    finally:
+        client.stop_stream()
+
+
+def test_streaming_error_surface(client):
+    results: "queue.Queue" = queue.Queue()
+    client.start_stream(callback=lambda r, e: results.put((r, e)))
+    try:
+        inp = grpcclient.InferInput("IN", [1], "INT32").set_data_from_numpy(
+            np.zeros([1], dtype=np.int32)
+        )
+        client.async_stream_infer("missing_model", [inp])
+        result, error = results.get(timeout=30)
+        assert result is None
+        assert "not found" in error.message()
+    finally:
+        client.stop_stream()
+
+
+def test_stream_inactive_rejects(client):
+    _, _, inputs = _simple_inputs()
+    with pytest.raises(InferenceServerException, match="not active"):
+        client.async_stream_infer("simple", inputs)
+
+
+def test_statistics_and_settings(client):
+    in0, in1, inputs = _simple_inputs()
+    client.infer("simple", inputs)
+    stats = client.get_inference_statistics("simple", as_json=True)
+    entry = stats["model_stats"][0]
+    assert entry["name"] == "simple"
+    assert int(entry["inference_count"]) >= 1
+    trace = client.update_trace_settings(settings={"trace_rate": "500"}, as_json=True)
+    assert trace["settings"]["trace_rate"]["value"] == ["500"]
+    log = client.update_log_settings({"log_verbose_level": 2}, as_json=True)
+    assert int(log["settings"]["log_verbose_level"]["uint32_param"]) == 2
+
+
+def test_load_unload(client):
+    client.unload_model("identity_fp32")
+    assert not client.is_model_ready("identity_fp32")
+    client.load_model("identity_fp32")
+    assert client.is_model_ready("identity_fp32")
+
+
+def test_cuda_shm_rejected(client):
+    with pytest.raises(InferenceServerException, match="CUDA"):
+        client.register_cuda_shared_memory("r", b"handle", 0, 64)
+    status = client.get_cuda_shared_memory_status(as_json=True)
+    assert status.get("regions", {}) == {}
+
+
+def test_sequence_parameters(client):
+    """Sequence ids flow through request parameters to the model."""
+    in0, in1, inputs = _simple_inputs()
+    result = client.infer(
+        "simple",
+        inputs,
+        sequence_id=77,
+        sequence_start=True,
+        sequence_end=False,
+    )
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+
+
+def test_aio_client(server):
+    async def run():
+        async with aio_grpcclient.InferenceServerClient(server.grpc_url) as c:
+            assert await c.is_server_live()
+            in0, in1, inputs = _simple_inputs()
+            result = await c.infer("simple", inputs)
+            np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+
+            # concurrent unary fan-out
+            results = await asyncio.gather(
+                *[c.infer("simple", inputs) for _ in range(8)]
+            )
+            for r in results:
+                np.testing.assert_array_equal(r.as_numpy("OUTPUT1"), in0 - in1)
+
+            # decoupled streaming via async iterator
+            values = np.array([9, 8, 7], dtype=np.int32)
+
+            async def requests():
+                inp = aio_grpcclient.InferInput(
+                    "IN", [3], "INT32"
+                ).set_data_from_numpy(values)
+                yield {"model_name": "repeat_int32", "inputs": [inp]}
+
+            received = []
+            async for result, error in c.stream_infer(requests()):
+                assert error is None
+                received.append(int(result.as_numpy("OUT")[0]))
+                if len(received) == 3:
+                    break
+            assert received == [9, 8, 7]
+
+    asyncio.run(run())

@@ -1,0 +1,303 @@
+"""Shared-memory data plane tests: unit + end-to-end over both protocols.
+
+Models the reference's shm coverage (test_cuda_shared_memory.py + the
+simple_*_shm_client examples) with the TPU path in place of CUDA-IPC.
+"""
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.http as httpclient
+import client_tpu.utils.shared_memory as shm
+import client_tpu.utils.tpu_shared_memory as tpushm
+from client_tpu.testing import InProcessServer
+from client_tpu.utils import bfloat16
+
+
+# ---------------------------------------------------------------------------
+# unit level
+# ---------------------------------------------------------------------------
+
+
+def test_system_shm_create_set_get_destroy():
+    handle = shm.create_shared_memory_region("reg0", "psm_test_key0", 256)
+    try:
+        assert "reg0" in shm.mapped_shared_memory_regions()
+        data = np.arange(16, dtype=np.float32)
+        shm.set_shared_memory_region(handle, [data])
+        out = shm.get_contents_as_numpy(handle, np.float32, [16])
+        np.testing.assert_array_equal(out, data)
+        more = np.arange(8, dtype=np.int64)
+        shm.set_shared_memory_region(handle, [more], offset=64)
+        np.testing.assert_array_equal(
+            shm.get_contents_as_numpy(handle, np.int64, [8], offset=64), more
+        )
+    finally:
+        shm.destroy_shared_memory_region(handle)
+    assert "reg0" not in shm.mapped_shared_memory_regions()
+
+
+def test_system_shm_create_only_conflict():
+    handle = shm.create_shared_memory_region("c1", "psm_test_conflict", 64)
+    try:
+        with pytest.raises(shm.SharedMemoryException, match="already exists"):
+            shm.create_shared_memory_region(
+                "c2", "psm_test_conflict", 64, create_only=True
+            )
+    finally:
+        shm.destroy_shared_memory_region(handle)
+
+
+def test_system_shm_bounds():
+    handle = shm.create_shared_memory_region("b1", "psm_test_bounds", 32)
+    try:
+        with pytest.raises(shm.SharedMemoryException, match="beyond"):
+            shm.set_shared_memory_region(
+                handle, [np.zeros(9, dtype=np.float32)]
+            )
+        with pytest.raises(shm.SharedMemoryException, match="beyond"):
+            handle.buf(-4, 8)
+    finally:
+        shm.destroy_shared_memory_region(handle)
+
+
+def test_tpu_shm_round_trip():
+    handle = tpushm.create_shared_memory_region("t0", 512, device_id=0)
+    try:
+        assert "t0" in tpushm.allocated_shared_memory_regions()
+        data = np.random.randn(4, 16).astype(np.float32)
+        tpushm.set_shared_memory_region(handle, [data])
+        out = tpushm.get_contents_as_numpy(handle, "FP32", [4, 16])
+        np.testing.assert_array_equal(out, data)
+    finally:
+        tpushm.destroy_shared_memory_region(handle)
+    assert "t0" not in tpushm.allocated_shared_memory_regions()
+
+
+def test_tpu_shm_raw_handle():
+    import json
+
+    handle = tpushm.create_shared_memory_region("t1", 64)
+    try:
+        raw = tpushm.get_raw_handle(handle)
+        parsed = json.loads(raw.decode("utf-8"))
+        assert parsed["kind"] == "tpu-host-pinned"
+        assert parsed["byte_size"] == 64
+        assert parsed["shm_key"].startswith("client_tpu_shm_")
+    finally:
+        tpushm.destroy_shared_memory_region(handle)
+
+
+def test_tpu_shm_jax_staging():
+    jax = pytest.importorskip("jax")
+    jnp = jax.numpy
+    handle = tpushm.create_shared_memory_region("t2", 1024)
+    try:
+        x = jnp.asarray(np.random.randn(8, 16), dtype=jnp.bfloat16)
+        tpushm.set_shared_memory_region_from_jax(handle, x)
+        out = tpushm.get_contents_as_numpy(handle, "BF16", [8, 16])
+        np.testing.assert_array_equal(out, np.asarray(x))
+        back = tpushm.as_jax_array(handle, "BF16", [8, 16])
+        assert back.dtype == jnp.bfloat16
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+    finally:
+        tpushm.destroy_shared_memory_region(handle)
+
+
+def test_tpu_shm_dlpack_export_import():
+    handle = tpushm.create_shared_memory_region("t3", 256)
+    try:
+        data = np.arange(32, dtype=np.float32).reshape(4, 8)
+        tpushm.set_shared_memory_region(handle, [data])
+        tensor = tpushm.as_shared_memory_tensor(handle, "FP32", [4, 8])
+        assert tensor.__dlpack_device__() == (1, 0)
+        imported = np.from_dlpack(tensor)
+        np.testing.assert_array_equal(imported, data)
+        # numpy zero-copy semantics: mutating the region reflects in import
+        tpushm.set_shared_memory_region(
+            handle, [np.full([4, 8], 7, dtype=np.float32)]
+        )
+        assert imported[0, 0] == 7.0
+
+        # torch import path
+        torch = pytest.importorskip("torch")
+        t = torch.from_dlpack(
+            tpushm.as_shared_memory_tensor(handle, "FP32", [4, 8])
+        )
+        assert t.shape == (4, 8)
+        assert float(t[0, 0]) == 7.0
+    finally:
+        tpushm.destroy_shared_memory_region(handle)
+
+
+def test_tpu_shm_dlpack_ingest():
+    torch = pytest.importorskip("torch")
+    handle = tpushm.create_shared_memory_region("t4", 256)
+    try:
+        t = torch.arange(16, dtype=torch.float32).reshape(2, 8)
+        tpushm.set_shared_memory_region_from_dlpack(handle, t)
+        out = tpushm.get_contents_as_numpy(handle, "FP32", [2, 8])
+        np.testing.assert_array_equal(out, t.numpy())
+    finally:
+        tpushm.destroy_shared_memory_region(handle)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end over both protocols
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def server():
+    with InProcessServer() as s:
+        yield s
+
+
+def test_system_shm_infer_grpc(server):
+    in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    in1 = np.full([1, 16], 3, dtype=np.int32)
+    byte_size = in0.nbytes
+
+    input_handle = shm.create_shared_memory_region(
+        "input_region", "e2e_in", 2 * byte_size
+    )
+    output_handle = shm.create_shared_memory_region(
+        "output_region", "e2e_out", 2 * byte_size
+    )
+    with grpcclient.InferenceServerClient(server.grpc_url) as client:
+        try:
+            shm.set_shared_memory_region(input_handle, [in0, in1])
+            client.register_system_shared_memory(
+                "input_region", "e2e_in", 2 * byte_size
+            )
+            client.register_system_shared_memory(
+                "output_region", "e2e_out", 2 * byte_size
+            )
+            status = client.get_system_shared_memory_status(as_json=True)
+            assert set(status.get("regions", {})) == {
+                "input_region",
+                "output_region",
+            }
+
+            inputs = [
+                grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+                grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+            ]
+            inputs[0].set_shared_memory("input_region", byte_size)
+            inputs[1].set_shared_memory("input_region", byte_size, offset=byte_size)
+            outputs = [
+                grpcclient.InferRequestedOutput("OUTPUT0"),
+                grpcclient.InferRequestedOutput("OUTPUT1"),
+            ]
+            outputs[0].set_shared_memory("output_region", byte_size)
+            outputs[1].set_shared_memory(
+                "output_region", byte_size, offset=byte_size
+            )
+
+            result = client.infer("simple", inputs, outputs=outputs)
+            # outputs live in shm, not inline
+            assert result.as_numpy("OUTPUT0") is None
+            out_params = result.get_output("OUTPUT0").parameters
+            assert (
+                out_params["shared_memory_region"].string_param
+                == "output_region"
+            )
+            out0 = shm.get_contents_as_numpy(output_handle, np.int32, [1, 16])
+            out1 = shm.get_contents_as_numpy(
+                output_handle, np.int32, [1, 16], offset=byte_size
+            )
+            np.testing.assert_array_equal(out0, in0 + in1)
+            np.testing.assert_array_equal(out1, in0 - in1)
+
+            client.unregister_system_shared_memory()
+            status = client.get_system_shared_memory_status(as_json=True)
+            assert status.get("regions", {}) == {}
+        finally:
+            shm.destroy_shared_memory_region(input_handle)
+            shm.destroy_shared_memory_region(output_handle)
+
+
+def test_tpu_shm_infer_grpc_jax(server):
+    """The headline path: jax.Array -> TPU shm -> server -> TPU shm -> jax."""
+    jax = pytest.importorskip("jax")
+    jnp = jax.numpy
+
+    x = jnp.asarray(np.random.randn(1, 32), dtype=jnp.bfloat16)
+    byte_size = 32 * 2
+    input_handle = tpushm.create_shared_memory_region("tpu_in", byte_size)
+    output_handle = tpushm.create_shared_memory_region("tpu_out", byte_size)
+    with grpcclient.InferenceServerClient(server.grpc_url) as client:
+        try:
+            tpushm.set_shared_memory_region_from_jax(input_handle, x)
+            client.register_tpu_shared_memory(
+                "tpu_in", tpushm.get_raw_handle(input_handle), 0, byte_size
+            )
+            client.register_tpu_shared_memory(
+                "tpu_out", tpushm.get_raw_handle(output_handle), 0, byte_size
+            )
+            status = client.get_tpu_shared_memory_status(as_json=True)
+            assert set(status.get("regions", {})) == {"tpu_in", "tpu_out"}
+
+            inp = grpcclient.InferInput("INPUT0", [1, 32], "BF16")
+            inp.set_shared_memory("tpu_in", byte_size)
+            out = grpcclient.InferRequestedOutput("OUTPUT0")
+            out.set_shared_memory("tpu_out", byte_size)
+            client.infer("identity_bf16", [inp], outputs=[out])
+
+            result = tpushm.as_jax_array(output_handle, "BF16", [1, 32])
+            np.testing.assert_array_equal(np.asarray(result), np.asarray(x))
+
+            client.unregister_tpu_shared_memory("tpu_in")
+            status = client.get_tpu_shared_memory_status(as_json=True)
+            assert set(status.get("regions", {})) == {"tpu_out"}
+            client.unregister_tpu_shared_memory()
+        finally:
+            tpushm.destroy_shared_memory_region(input_handle)
+            tpushm.destroy_shared_memory_region(output_handle)
+
+
+def test_system_shm_infer_http(server):
+    in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    in1 = np.full([1, 16], 5, dtype=np.int32)
+    byte_size = in0.nbytes
+    handle = shm.create_shared_memory_region("http_in", "e2e_http_in", 2 * byte_size)
+    with httpclient.InferenceServerClient(server.http_url) as client:
+        try:
+            shm.set_shared_memory_region(handle, [in0, in1])
+            client.register_system_shared_memory(
+                "http_in", "e2e_http_in", 2 * byte_size
+            )
+            regions = client.get_system_shared_memory_status()
+            assert {r["name"] for r in regions} == {"http_in"}
+
+            inputs = [
+                httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+                httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+            ]
+            inputs[0].set_shared_memory("http_in", byte_size)
+            inputs[1].set_shared_memory("http_in", byte_size, offset=byte_size)
+            result = client.infer("simple", inputs)
+            np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+            client.unregister_system_shared_memory("http_in")
+        finally:
+            shm.destroy_shared_memory_region(handle)
+
+
+def test_tpu_shm_infer_http(server):
+    data = np.random.randn(2, 8).astype(np.float32)
+    byte_size = data.nbytes
+    handle = tpushm.create_shared_memory_region("http_tpu", byte_size)
+    with httpclient.InferenceServerClient(server.http_url) as client:
+        try:
+            tpushm.set_shared_memory_region(handle, [data])
+            client.register_tpu_shared_memory(
+                "http_tpu", tpushm.get_raw_handle(handle), 0, byte_size
+            )
+            inp = httpclient.InferInput("INPUT0", [2, 8], "FP32")
+            inp.set_shared_memory("http_tpu", byte_size)
+            result = client.infer("identity_fp32", [inp])
+            np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), data)
+            client.unregister_tpu_shared_memory()
+        finally:
+            tpushm.destroy_shared_memory_region(handle)
