@@ -1,0 +1,174 @@
+"""Benchmark entry point: prints ONE JSON line with the headline metric.
+
+Replicates the reference's headline benchmark (BASELINE.md row 1):
+perf_analyzer against the ``simple`` add_sub model, measuring inference
+throughput over loopback. The reference quick-start reports
+1,407.84 infer/sec (HTTP, concurrency 1, GPU host); vs_baseline is measured
+throughput divided by that number.
+
+Uses the C++ perf_analyzer if built (build/perf_analyzer); otherwise the
+Python async gRPC client drives the load (concurrency 4).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_INFER_PER_SEC = 1407.84
+CONCURRENCY = int(os.environ.get("BENCH_CONCURRENCY", "4"))
+WARMUP_S = float(os.environ.get("BENCH_WARMUP_S", "2"))
+MEASURE_S = float(os.environ.get("BENCH_MEASURE_S", "8"))
+
+
+def _bench_python_grpc(grpc_url: str) -> dict:
+    """Closed-loop concurrency-N load via the asyncio gRPC client."""
+    import asyncio
+
+    import numpy as np
+
+    import client_tpu.grpc.aio as grpcclient
+
+    in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    in1 = np.ones([1, 16], dtype=np.int32)
+
+    async def run():
+        async with grpcclient.InferenceServerClient(grpc_url) as client:
+            def make_inputs():
+                a = grpcclient.InferInput("INPUT0", [1, 16], "INT32")
+                a.set_data_from_numpy(in0)
+                b = grpcclient.InferInput("INPUT1", [1, 16], "INT32")
+                b.set_data_from_numpy(in1)
+                return [a, b]
+
+            latencies = []
+            count = 0
+            stop_at = 0.0
+
+            async def worker():
+                nonlocal count
+                inputs = make_inputs()
+                while time.monotonic() < stop_at:
+                    t0 = time.monotonic_ns()
+                    await client.infer("simple", inputs)
+                    t1 = time.monotonic_ns()
+                    if time.monotonic() < stop_at:
+                        latencies.append(t1 - t0)
+                        count += 1
+
+            # warmup
+            stop_at = time.monotonic() + WARMUP_S
+            await asyncio.gather(*[worker() for _ in range(CONCURRENCY)])
+            latencies.clear()
+            count = 0
+            # measure
+            start = time.monotonic()
+            stop_at = start + MEASURE_S
+            await asyncio.gather(*[worker() for _ in range(CONCURRENCY)])
+            elapsed = time.monotonic() - start
+            latencies.sort()
+            p = lambda q: latencies[
+                min(len(latencies) - 1, int(q * len(latencies)))
+            ] / 1e3 if latencies else 0.0
+            return {
+                "throughput": count / elapsed,
+                "p50_us": p(0.50),
+                "p99_us": p(0.99),
+                "count": count,
+            }
+
+    return asyncio.run(run())
+
+
+def _device_platform_usable(timeout_s: float = 120.0) -> bool:
+    """Probe (in a subprocess) that the default jax platform can compile
+    and run a trivial program. The TPU relay in some environments wedges
+    after an unclean client exit; bench must still emit its JSON line."""
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "jax.block_until_ready(jax.jit(lambda a: a + 1)(jnp.zeros((4, 4))))"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            timeout=timeout_s,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main() -> int:
+    if not _device_platform_usable():
+        print(
+            "bench: default jax platform unusable (TPU relay stuck?); "
+            "falling back to CPU",
+            file=sys.stderr,
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from client_tpu.testing import InProcessServer
+
+    result = None
+    with InProcessServer(host="127.0.0.1") as server:
+        pa = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "build", "perf_analyzer")
+        if os.path.exists(pa):
+            try:
+                out = subprocess.run(
+                    [
+                        pa,
+                        "-m", "simple",
+                        "-u", server.http_url,
+                        "--concurrency-range", str(CONCURRENCY),
+                        "--measurement-interval",
+                        str(int(MEASURE_S * 1000)),
+                        "--json-summary",
+                    ],
+                    capture_output=True, text=True, timeout=300,
+                )
+                for line in out.stdout.splitlines():
+                    line = line.strip()
+                    if line.startswith("{"):
+                        summary = json.loads(line)
+                        result = {
+                            "throughput": summary["throughput"],
+                            "p50_us": summary.get("p50_us", 0.0),
+                            "p99_us": summary.get("p99_us", 0.0),
+                            "count": summary.get("count", 0),
+                            "harness": "perf_analyzer(c++)",
+                        }
+                        break
+            except Exception:
+                result = None
+        if result is None:
+            result = _bench_python_grpc(server.grpc_url)
+            result["harness"] = "python-grpc-aio"
+
+    value = round(result["throughput"], 2)
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"simple add_sub infer/sec (loopback, concurrency "
+                    f"{CONCURRENCY}, {result['harness']})"
+                ),
+                "value": value,
+                "unit": "infer/sec",
+                "vs_baseline": round(value / BASELINE_INFER_PER_SEC, 3),
+                "p50_us": round(result.get("p50_us", 0.0), 1),
+                "p99_us": round(result.get("p99_us", 0.0), 1),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

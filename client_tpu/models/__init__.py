@@ -1,0 +1,11 @@
+"""JAX model zoo for the serving runtime and benchmarks.
+
+- :mod:`client_tpu.models.llama` — the flagship decoder-only transformer
+  (tensor/data/sequence-parallel shardings, ring attention long-context
+  prefill, KV-cache decode, training step);
+- :mod:`client_tpu.models.resnet` — ResNet-50-class image classifier for
+  the image-client benchmark configs;
+- :mod:`client_tpu.models.serving` — adapters exposing these as
+  KServe v2 models on the in-repo server (including the decoupled
+  token-streaming LLM decode model).
+"""

@@ -1,0 +1,429 @@
+"""Flagship decoder-only transformer (Llama-family architecture) in pure JAX.
+
+Design (TPU-first, not a torch port):
+
+- parameters are a plain pytree with an explicit ``PartitionSpec`` twin
+  (``param_specs``) — Megatron-style tensor parallelism: attention heads and
+  MLP hidden sharded over ``tp``, embeddings sharded over the vocab;
+- ``forward`` is a single jitted function; under a mesh, `jax.jit` with
+  sharding-annotated inputs lets XLA insert the tp collectives (psum over
+  the contracted axes materializes as all-reduce on ICI);
+- long-context prefill can route attention through
+  :func:`client_tpu.parallel.ring_attention` when the mesh has an ``sp``
+  axis (sequence sharded);
+- decode keeps a KV cache pytree and generates with ``lax.scan`` — no
+  Python loop inside jit (XLA semantics: static shapes, traced once);
+- bfloat16 activations/params with float32 attention softmax and optimizer
+  state, the standard TPU recipe.
+
+Role in the framework: the "Llama-7B streaming" benchmark config of
+BASELINE.json (served via client_tpu.models.serving.LlmDecodeModel) and the
+flagship entry for the driver's __graft_entry__.
+"""
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from client_tpu.parallel import DP_AXIS, SP_AXIS, TP_AXIS
+from client_tpu.parallel.ring_attention import reference_attention, ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    d_ff: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def tiny(**overrides) -> "LlamaConfig":
+        """A tiny config for tests/dryruns (compiles in seconds)."""
+        base = dict(
+            vocab_size=256,
+            d_model=64,
+            n_layers=2,
+            n_heads=4,
+            n_kv_heads=4,
+            d_ff=128,
+            max_seq_len=128,
+        )
+        base.update(overrides)
+        return LlamaConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# parameters
+# ---------------------------------------------------------------------------
+
+
+def init_params(key, config: LlamaConfig) -> Dict[str, Any]:
+    """Initialize a parameter pytree (He/scaled-normal init)."""
+    d, h, hd, f = (
+        config.d_model,
+        config.n_heads,
+        config.head_dim,
+        config.d_ff,
+    )
+    kv = config.n_kv_heads
+    keys = jax.random.split(key, config.n_layers + 2)
+
+    def normal(k, shape, scale):
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * scale).astype(
+            config.dtype
+        )
+
+    layers = []
+    for i in range(config.n_layers):
+        lk = jax.random.split(keys[i], 7)
+        scale = 1.0 / np.sqrt(d)
+        layers.append(
+            {
+                "wq": normal(lk[0], (d, h, hd), scale),
+                "wk": normal(lk[1], (d, kv, hd), scale),
+                "wv": normal(lk[2], (d, kv, hd), scale),
+                "wo": normal(lk[3], (h, hd, d), scale / np.sqrt(2 * config.n_layers)),
+                "w_gate": normal(lk[4], (d, f), scale),
+                "w_up": normal(lk[5], (d, f), scale),
+                "w_down": normal(lk[6], (f, d), 1.0 / np.sqrt(f)),
+                "attn_norm": jnp.ones((d,), dtype=config.dtype),
+                "mlp_norm": jnp.ones((d,), dtype=config.dtype),
+            }
+        )
+    return {
+        "embed": normal(keys[-2], (config.vocab_size, d), 1.0),
+        "final_norm": jnp.ones((d,), dtype=config.dtype),
+        "lm_head": normal(keys[-1], (d, config.vocab_size), 1.0 / np.sqrt(d)),
+        "layers": layers,
+    }
+
+
+def param_specs(config: LlamaConfig) -> Dict[str, Any]:
+    """PartitionSpec pytree twin of init_params (tp = tensor parallel)."""
+    layer = {
+        "wq": P(None, TP_AXIS, None),
+        "wk": P(None, TP_AXIS, None),
+        "wv": P(None, TP_AXIS, None),
+        "wo": P(TP_AXIS, None, None),
+        "w_gate": P(None, TP_AXIS),
+        "w_up": P(None, TP_AXIS),
+        "w_down": P(TP_AXIS, None),
+        "attn_norm": P(),
+        "mlp_norm": P(),
+    }
+    return {
+        "embed": P(TP_AXIS, None),
+        "final_norm": P(),
+        "lm_head": P(None, TP_AXIS),
+        "layers": [layer] * config.n_layers,
+    }
+
+
+# ---------------------------------------------------------------------------
+# building blocks
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x, weight, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight
+
+
+def _rope(x, positions, theta):
+    """Rotary position embedding; x: [..., L, H, D]."""
+    head_dim = x.shape[-1]
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., L, D/2]
+    angles = angles[..., None, :]  # broadcast over heads
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    out = jnp.stack([out1, out2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def _repeat_kv(x, n_rep: int):
+    """[B, L, KV, D] -> [B, L, KV*n_rep, D] (grouped-query attention)."""
+    if n_rep == 1:
+        return x
+    b, l, kv, d = x.shape
+    return jnp.broadcast_to(
+        x[:, :, :, None, :], (b, l, kv, n_rep, d)
+    ).reshape(b, l, kv * n_rep, d)
+
+
+def _attention_block(
+    layer, x, positions, config: LlamaConfig, mesh: Optional[Mesh], kv_cache=None
+):
+    """Self-attention; returns (output, new_kv) — new_kv None when caching
+    is off."""
+    b, l, d = x.shape
+    n_rep = config.n_heads // config.n_kv_heads
+    q = jnp.einsum("bld,dhk->blhk", x, layer["wq"])
+    k = jnp.einsum("bld,dhk->blhk", x, layer["wk"])
+    v = jnp.einsum("bld,dhk->blhk", x, layer["wv"])
+    q = _rope(q, positions, config.rope_theta)
+    k = _rope(k, positions, config.rope_theta)
+
+    if kv_cache is not None:
+        # decode: append this step's K/V at index `positions` in the cache
+        cache_k, cache_v = kv_cache  # [B, S, KV, D]
+        idx = positions[0, 0]  # same step index across batch (scalar)
+        cache_k = jax.lax.dynamic_update_slice_in_dim(cache_k, k, idx, axis=1)
+        cache_v = jax.lax.dynamic_update_slice_in_dim(cache_v, v, idx, axis=1)
+        k_full = _repeat_kv(cache_k, n_rep)
+        v_full = _repeat_kv(cache_v, n_rep)
+        qh = q.transpose(0, 2, 1, 3)  # [B, H, 1, D]
+        kh = k_full.transpose(0, 2, 1, 3)  # [B, H, S, D]
+        vh = v_full.transpose(0, 2, 1, 3)
+        scores = jnp.einsum(
+            "bhqd,bhkd->bhqk", qh, kh, preferred_element_type=jnp.float32
+        ) / np.sqrt(config.head_dim)
+        # mask out cache slots beyond the current position
+        valid = jnp.arange(kh.shape[2]) <= idx
+        scores = jnp.where(valid[None, None, None, :], scores, -1e30)
+        weights = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", weights, vh.astype(weights.dtype))
+        out = out.astype(x.dtype).transpose(0, 2, 1, 3)  # [B, 1, H, D]
+        new_kv = (cache_k, cache_v)
+    else:
+        k_full = _repeat_kv(k, n_rep)
+        v_full = _repeat_kv(v, n_rep)
+        qh = q.transpose(0, 2, 1, 3)
+        kh = k_full.transpose(0, 2, 1, 3)
+        vh = v_full.transpose(0, 2, 1, 3)
+        if mesh is not None and SP_AXIS in mesh.axis_names and mesh.shape[SP_AXIS] > 1:
+            out = ring_attention(qh, kh, vh, mesh, causal=True)
+        else:
+            out = reference_attention(qh, kh, vh, causal=True)
+        out = out.transpose(0, 2, 1, 3)
+        new_kv = None
+
+    out = jnp.einsum("blhk,hkd->bld", out, layer["wo"])
+    return out, new_kv
+
+
+def _mlp_block(layer, x):
+    gate = jax.nn.silu(jnp.einsum("bld,df->blf", x, layer["w_gate"]))
+    up = jnp.einsum("bld,df->blf", x, layer["w_up"])
+    return jnp.einsum("blf,fd->bld", gate * up, layer["w_down"])
+
+
+# ---------------------------------------------------------------------------
+# forward / loss / train
+# ---------------------------------------------------------------------------
+
+
+def forward(
+    params,
+    tokens: jnp.ndarray,
+    config: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+    positions: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Full-sequence forward (prefill): tokens [B, L] -> logits [B, L, V]."""
+    if positions is None:
+        positions = jnp.arange(tokens.shape[1])[None, :]
+    x = params["embed"][tokens].astype(config.dtype)
+    for layer in params["layers"]:
+        h, _ = _attention_block(
+            layer, rms_norm(x, layer["attn_norm"], config.norm_eps), positions,
+            config, mesh,
+        )
+        x = x + h
+        x = x + _mlp_block(
+            layer, rms_norm(x, layer["mlp_norm"], config.norm_eps)
+        )
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    return jnp.einsum("bld,dv->blv", x, params["lm_head"]).astype(jnp.float32)
+
+
+def loss_fn(params, tokens, config: LlamaConfig, mesh=None):
+    """Next-token cross-entropy over tokens [B, L].
+
+    Runs forward on the full sequence and shifts the logits (keeps the
+    sequence length divisible by the sp mesh axis; the last position's
+    logits are simply unused).
+    """
+    logits = forward(params, tokens, config, mesh)[:, :-1]
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def make_train_step(config: LlamaConfig, mesh: Optional[Mesh], learning_rate=1e-3):
+    """Build a jitted (params, opt_state, tokens) -> (params, opt_state,
+    loss) training step, sharded over the mesh when given."""
+    import optax
+
+    optimizer = optax.adamw(learning_rate)
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, config, mesh)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    if mesh is None:
+        return jax.jit(train_step), optimizer
+    specs = param_specs(config)
+    param_shardings = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    token_sharding = NamedSharding(mesh, P(DP_AXIS, None))
+    jitted = jax.jit(
+        train_step,
+        in_shardings=(param_shardings, None, token_sharding),
+        out_shardings=(param_shardings, None, None),
+    )
+    return jitted, optimizer
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decode
+# ---------------------------------------------------------------------------
+
+
+def init_kv_cache(config: LlamaConfig, batch: int, max_len: Optional[int] = None):
+    """Zeroed KV cache pytree: one (k, v) pair per layer."""
+    max_len = max_len or config.max_seq_len
+    shape = (batch, max_len, config.n_kv_heads, config.head_dim)
+    return [
+        (
+            jnp.zeros(shape, dtype=config.dtype),
+            jnp.zeros(shape, dtype=config.dtype),
+        )
+        for _ in range(config.n_layers)
+    ]
+
+
+def prefill_with_cache(
+    params, tokens, cache, config: LlamaConfig, mesh=None, last_index=None
+):
+    """Run the prompt through the model, filling the cache.
+
+    Returns (logits_of_last_token [B, V], cache). ``last_index`` (traced
+    scalar) selects which position's logits to return — callers that pad
+    prompts to bucket lengths pass the real last-token index so padding
+    does not change the result (causal attention guarantees positions
+    <= last_index never attend to the padded tail, and decode overwrites
+    padded cache slots before its validity mask ever exposes them).
+    """
+    b, l = tokens.shape
+    positions = jnp.arange(l)[None, :].repeat(b, axis=0)
+    x = params["embed"][tokens].astype(config.dtype)
+    new_cache = []
+    for layer, kv in zip(params["layers"], cache):
+        normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q = jnp.einsum("bld,dhk->blhk", normed, layer["wq"])
+        k = jnp.einsum("bld,dhk->blhk", normed, layer["wk"])
+        v = jnp.einsum("bld,dhk->blhk", normed, layer["wv"])
+        q = _rope(q, positions, config.rope_theta)
+        k = _rope(k, positions, config.rope_theta)
+        cache_k = jax.lax.dynamic_update_slice_in_dim(kv[0], k, 0, axis=1)
+        cache_v = jax.lax.dynamic_update_slice_in_dim(kv[1], v, 0, axis=1)
+        new_cache.append((cache_k, cache_v))
+        n_rep = config.n_heads // config.n_kv_heads
+        qh = q.transpose(0, 2, 1, 3)
+        kh = _repeat_kv(k, n_rep).transpose(0, 2, 1, 3)
+        vh = _repeat_kv(v, n_rep).transpose(0, 2, 1, 3)
+        out = reference_attention(qh, kh, vh, causal=True).transpose(0, 2, 1, 3)
+        x = x + jnp.einsum("blhk,hkd->bld", out, layer["wo"])
+        x = x + _mlp_block(layer, rms_norm(x, layer["mlp_norm"], config.norm_eps))
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    if last_index is None:
+        last = x[:, -1]
+    else:
+        last = jnp.take_along_axis(
+            x, jnp.full((b, 1, 1), last_index, dtype=jnp.int32).repeat(
+                x.shape[-1], axis=-1
+            ), axis=1,
+        )[:, 0]
+    logits = jnp.einsum("bd,dv->bv", last, params["lm_head"])
+    return logits.astype(jnp.float32), new_cache
+
+
+def decode_step(params, token, position, cache, config: LlamaConfig):
+    """One decode step: token [B], position scalar -> (logits [B, V], cache)."""
+    b = token.shape[0]
+    positions = jnp.full((b, 1), position, dtype=jnp.int32)
+    x = params["embed"][token][:, None, :].astype(config.dtype)
+    new_cache = []
+    for layer, kv in zip(params["layers"], cache):
+        h, new_kv = _attention_block(
+            layer,
+            rms_norm(x, layer["attn_norm"], config.norm_eps),
+            positions,
+            config,
+            mesh=None,
+            kv_cache=kv,
+        )
+        new_cache.append(new_kv)
+        x = x + h
+        x = x + _mlp_block(layer, rms_norm(x, layer["mlp_norm"], config.norm_eps))
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = jnp.einsum("bd,dv->bv", x[:, 0], params["lm_head"])
+    return logits.astype(jnp.float32), new_cache
+
+
+def generate(
+    params,
+    prompt_tokens: jnp.ndarray,
+    config: LlamaConfig,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+):
+    """Greedy/temperature generation with lax.scan (no Python decode loop).
+
+    Returns [B, max_new_tokens] generated token ids.
+    """
+    b, prompt_len = prompt_tokens.shape
+    cache = init_kv_cache(config, b, prompt_len + max_new_tokens)
+    logits, cache = prefill_with_cache(params, prompt_tokens, cache, config)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def sample(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / temperature, axis=-1).astype(
+            jnp.int32
+        )
+
+    first_token = sample(logits, rng)
+
+    def step(carry, key):
+        token, position, cache = carry
+        logits, cache = decode_step(params, token, position, cache, config)
+        next_token = sample(logits, key)
+        return (next_token, position + 1, cache), token
+
+    keys = jax.random.split(rng, max_new_tokens)
+    (_, _, _), tokens = jax.lax.scan(
+        step,
+        (first_token, jnp.int32(prompt_len), cache),
+        keys,
+    )
+    return tokens.T  # [B, max_new_tokens]

@@ -1,0 +1,127 @@
+"""ResNet-50-class image classifier in flax.
+
+The image-classification model behind the ``image_client`` benchmark config
+(reference src/c++/examples/image_client.cc drives inception/resnet ONNX
+models; here the model is a native JAX/flax network served by the in-repo
+server). NHWC layout and bfloat16 compute — the TPU-friendly choices — with
+float32 batch-norm statistics.
+"""
+
+import functools
+from typing import Any, Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import flax.linen as nn
+
+
+class ResNetBlock(nn.Module):
+    """Bottleneck residual block (1x1 -> 3x3 -> 1x1)."""
+
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = functools.partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+        )
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), self.strides)(y)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+
+        if residual.shape != y.shape:
+            residual = conv(
+                self.filters * 4, (1, 1), self.strides, name="conv_proj"
+            )(residual)
+            residual = norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """ResNet-v1.5 with bottleneck blocks; stage_sizes (3,4,6,3) = ResNet-50."""
+
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.num_filters,
+            (7, 7),
+            (2, 2),
+            padding=[(3, 3), (3, 3)],
+            use_bias=False,
+            dtype=self.dtype,
+            name="conv_init",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            name="bn_init",
+        )(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = ResNetBlock(
+                    self.num_filters * 2**i, strides=strides, dtype=self.dtype
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+def ResNet50(num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes, dtype=dtype)
+
+
+def ResNet18Thin(num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
+    """A small variant for tests/CI (same code path, fewer blocks)."""
+    return ResNet(
+        stage_sizes=(1, 1, 1, 1),
+        num_classes=num_classes,
+        num_filters=16,
+        dtype=dtype,
+    )
+
+
+def init_resnet(model: ResNet, image_size: int = 224, seed: int = 0):
+    """Initialize variables for NHWC input [1, H, W, 3]."""
+    variables = model.init(
+        jax.random.PRNGKey(seed),
+        jnp.zeros((1, image_size, image_size, 3), dtype=jnp.float32),
+        train=False,
+    )
+    return variables
+
+
+def make_apply_fn(model: ResNet) -> Callable:
+    """A jitted (variables, images) -> logits function."""
+
+    @jax.jit
+    def apply(variables, images):
+        return model.apply(variables, images, train=False)
+
+    return apply

@@ -1,0 +1,211 @@
+"""Adapters exposing the model zoo as KServe v2 models on the in-repo server.
+
+These are the serving-side halves of the BASELINE.json benchmark configs:
+``image_classifier`` (ResNet on NHWC images, classification extension) and
+``llm_decode`` (decoupled token streaming over the flagship llama model —
+the genai-perf target).
+"""
+
+import asyncio
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+import numpy as np
+
+from client_tpu.server.model_repository import Model
+from client_tpu.utils import InferenceServerException
+
+
+class ImageClassifierModel(Model):
+    """ResNet image classifier: INPUT [H, W, 3] FP32 -> logits [classes]."""
+
+    max_batch_size = 8
+    platform = "jax"
+    backend = "jax"
+
+    def __init__(
+        self,
+        name: str = "image_classifier",
+        image_size: int = 224,
+        num_classes: int = 1000,
+        small: bool = False,
+        class_labels: Optional[List[str]] = None,
+    ):
+        self.name = name
+        self._image_size = image_size
+        self._num_classes = num_classes
+        self._small = small
+        self._labels = class_labels
+        self.inputs = [
+            {
+                "name": "INPUT",
+                "datatype": "FP32",
+                "shape": [image_size, image_size, 3],
+            }
+        ]
+        self.outputs = [
+            {"name": "OUTPUT", "datatype": "FP32", "shape": [num_classes]}
+        ]
+        self._apply = None
+        self._variables = None
+
+    def labels(self, output_name: str):
+        return self._labels
+
+    def warmup(self) -> None:
+        import jax
+
+        from client_tpu.models.resnet import (
+            ResNet18Thin,
+            ResNet50,
+            init_resnet,
+            make_apply_fn,
+        )
+
+        model = (
+            ResNet18Thin(self._num_classes)
+            if self._small
+            else ResNet50(self._num_classes)
+        )
+        self._variables = init_resnet(model, self._image_size)
+        self._apply = make_apply_fn(model)
+        # compile for batch 1 so the first request is fast
+        dummy = np.zeros(
+            [1, self._image_size, self._image_size, 3], dtype=np.float32
+        )
+        jax.block_until_ready(self._apply(self._variables, dummy))
+
+    def execute(self, inputs, parameters):
+        if "INPUT" not in inputs:
+            raise InferenceServerException(
+                f"model '{self.name}' expects input INPUT"
+            )
+        images = inputs["INPUT"]
+        if images.ndim == 3:
+            images = images[None]
+        logits = np.asarray(self._apply(self._variables, images))
+        return {"OUTPUT": logits}
+
+
+class LlmDecodeModel(Model):
+    """Decoupled LLM decode: INPUT_IDS -> one OUTPUT_IDS token per response.
+
+    The serving half of the genai-perf streaming benchmark (BASELINE.json
+    "gRPC streaming ensemble: tokenizer -> JAX decode"): true incremental
+    KV-cache decode, one streamed response per generated token, final
+    response flagged with ``triton_final_response``.
+    """
+
+    decoupled = True
+    max_batch_size = 0
+    platform = "jax"
+    backend = "jax"
+    inputs = [
+        {"name": "INPUT_IDS", "datatype": "INT32", "shape": [-1]},
+    ]
+    outputs = [
+        {"name": "OUTPUT_IDS", "datatype": "INT32", "shape": [1]},
+    ]
+
+    def __init__(self, name: str = "llm_decode", config=None, params=None):
+        from client_tpu.models import llama
+
+        self.name = name
+        self._config = config or llama.LlamaConfig.tiny(max_seq_len=512)
+        self._params = params
+        self._prefill = None
+        self._decode = None
+
+    def warmup(self) -> None:
+        import jax
+
+        from client_tpu.models import llama
+
+        if self._params is None:
+            self._params = llama.init_params(
+                jax.random.PRNGKey(0), self._config
+            )
+        config = self._config
+
+        self._prefill = jax.jit(
+            lambda params, tokens, cache, last_index: llama.prefill_with_cache(
+                params, tokens, cache, config, last_index=last_index
+            )
+        )
+        self._decode = jax.jit(
+            lambda params, token, position, cache: llama.decode_step(
+                params, token, position, cache, config
+            )
+        )
+        # compile decode + the smallest prefill bucket up front
+        cache = llama.init_kv_cache(config, 1, config.max_seq_len)
+        _, cache = self._prefill(
+            self._params, np.zeros([1, 8], dtype=np.int32), cache, 7
+        )
+        jax.block_until_ready(
+            self._decode(
+                self._params, np.zeros([1], dtype=np.int32), 8, cache
+            )[0]
+        )
+
+    @staticmethod
+    def _bucket_length(n: int, minimum: int = 8) -> int:
+        """Next power-of-two bucket — bounds XLA retraces to
+        O(log max_seq_len) prefill shapes instead of one per prompt
+        length."""
+        bucket = minimum
+        while bucket < n:
+            bucket *= 2
+        return bucket
+
+    async def execute_decoupled(
+        self, inputs: Dict[str, np.ndarray], parameters: Dict[str, Any]
+    ) -> AsyncIterator[Dict[str, np.ndarray]]:
+        from client_tpu.models import llama
+
+        if "INPUT_IDS" not in inputs:
+            raise InferenceServerException(
+                f"model '{self.name}' expects input INPUT_IDS"
+            )
+        prompt = np.asarray(inputs["INPUT_IDS"], dtype=np.int32).reshape(1, -1)
+        max_tokens = int(parameters.get("max_tokens", 16))
+        prompt_len = prompt.shape[1]
+        if prompt_len + max_tokens > self._config.max_seq_len:
+            raise InferenceServerException(
+                f"prompt ({prompt_len}) + max_tokens ({max_tokens}) exceeds "
+                f"max sequence length {self._config.max_seq_len}"
+            )
+
+        cache = llama.init_kv_cache(self._config, 1, self._config.max_seq_len)
+        bucket = min(
+            self._bucket_length(prompt_len), self._config.max_seq_len
+        )
+        padded = np.zeros([1, bucket], dtype=np.int32)
+        padded[:, :prompt_len] = prompt
+        logits, cache = self._prefill(
+            self._params, padded, cache, prompt_len - 1
+        )
+        token = np.asarray(logits).argmax(-1).astype(np.int32)
+
+        for i in range(max_tokens):
+            yield {
+                "OUTPUT_IDS": np.array([token[0]], dtype=np.int32),
+                "__final__": i == max_tokens - 1,
+            }
+            if i == max_tokens - 1:
+                break
+            logits, cache = self._decode(
+                self._params, token, prompt_len + i, cache
+            )
+            token = np.asarray(logits).argmax(-1).astype(np.int32)
+            # yield control so other stream requests interleave
+            await asyncio.sleep(0)
+
+
+def register_zoo_models(repository, small: bool = True) -> None:
+    """Install the model-zoo adapters (small variants by default)."""
+    repository.add_model(
+        ImageClassifierModel(
+            "image_classifier", image_size=64 if small else 224, small=small
+        )
+    )
+    repository.add_model(LlmDecodeModel())

@@ -1,0 +1,57 @@
+"""Parallelism utilities for the JAX serving runtime.
+
+The reference is a serving client with no intra-model parallelism
+(SURVEY.md §2.7); the models it benchmarks get their parallelism from the
+server. In client_tpu the server-side compute path is in-repo, so the
+SPMD machinery lives here:
+
+- :func:`create_mesh` — build a ``jax.sharding.Mesh`` over dp/tp/sp axes;
+- :mod:`client_tpu.parallel.ring_attention` — ring attention over the
+  sequence-parallel axis (long-context prefill);
+- spec helpers for parameter/activation sharding.
+"""
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from client_tpu.parallel.ring_attention import ring_attention  # noqa: F401
+
+DP_AXIS = "dp"  # data parallel (batch)
+TP_AXIS = "tp"  # tensor parallel (heads / hidden)
+SP_AXIS = "sp"  # sequence parallel (context length)
+
+
+def create_mesh(
+    dp: int = 1,
+    tp: int = 1,
+    sp: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a ``Mesh`` with (dp, tp, sp) axes over ``devices``.
+
+    ``dp*tp*sp`` must equal the device count. Axis order puts tp innermost
+    so tensor-parallel collectives ride the fastest ICI links on TPU
+    topologies.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if dp * tp * sp != n:
+        raise ValueError(
+            f"mesh {dp}x{sp}x{tp} (dp*sp*tp={dp * sp * tp}) does not match "
+            f"device count {n}"
+        )
+    grid = np.asarray(devices).reshape(dp, sp, tp)
+    return Mesh(grid, (DP_AXIS, SP_AXIS, TP_AXIS))
+
+
+def shard(mesh: Mesh, *spec) -> NamedSharding:
+    """NamedSharding helper: ``shard(mesh, 'dp', None)``."""
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

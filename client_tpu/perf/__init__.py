@@ -1,0 +1,15 @@
+"""perf-analyzer-tpu: the load-generation & measurement harness.
+
+The Python counterpart of the reference's perf_analyzer (L4 in SURVEY.md §1):
+load managers (concurrency / request-rate / custom-interval / periodic),
+a measurement engine with stability windows, per-request records, CSV and
+profile-export-JSON reporting, and a CLI with reference-compatible flags.
+
+asyncio replaces the reference's thread-per-worker design: a single loop
+drives thousands of in-flight requests per host (the client-side
+"data parallelism" of SURVEY.md §2.7), with the C++ harness (src/cpp)
+available where nanosecond scheduling fidelity matters.
+"""
+
+from client_tpu.perf.records import PerfStatus, RequestRecord  # noqa: F401
+from client_tpu.perf.profiler import InferenceProfiler  # noqa: F401

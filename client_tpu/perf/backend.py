@@ -1,0 +1,404 @@
+"""Client-backend abstraction for the perf harness.
+
+The Python twin of the reference's ClientBackend layer
+(reference src/c++/perf_analyzer/client_backend/client_backend.h:266-650):
+one async interface, concrete backends for our HTTP and gRPC clients, an
+in-process backend calling ServerCore directly (the triton_c_api analogue —
+measures client-overhead-free server performance), and a mock backend with
+injectable latency/errors (the linchpin of the reference's no-server test
+strategy, SURVEY.md §4 tier 1).
+"""
+
+import asyncio
+import time
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from client_tpu.utils import InferenceServerException
+
+
+class PerfInferInput:
+    """Backend-independent input tensor description."""
+
+    def __init__(self, name: str, shape: Sequence[int], datatype: str, data: np.ndarray):
+        self.name = name
+        self.shape = list(shape)
+        self.datatype = datatype
+        self.data = data
+
+
+class PerfBackend:
+    """Async backend interface."""
+
+    kind = "abstract"
+    supports_streaming = False
+
+    async def connect(self) -> None:
+        pass
+
+    async def close(self) -> None:
+        pass
+
+    async def get_model_metadata(self, model_name: str, model_version: str = "") -> Dict:
+        raise NotImplementedError
+
+    async def get_model_config(self, model_name: str, model_version: str = "") -> Dict:
+        raise NotImplementedError
+
+    async def infer(
+        self,
+        model_name: str,
+        inputs: Sequence[PerfInferInput],
+        model_version: str = "",
+        request_id: str = "",
+        parameters: Optional[Dict[str, Any]] = None,
+        sequence_id: int = 0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+    ) -> None:
+        """One request -> one response (payload discarded; timing is the
+        caller's job)."""
+        raise NotImplementedError
+
+    async def stream_infer(
+        self,
+        model_name: str,
+        inputs: Sequence[PerfInferInput],
+        on_response: Callable[[], None],
+        model_version: str = "",
+        request_id: str = "",
+        parameters: Optional[Dict[str, Any]] = None,
+        sequence_id: int = 0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+    ) -> None:
+        """One request -> many responses; ``on_response()`` fires per
+        response; returns when the final response arrives."""
+        raise NotImplementedError
+
+    async def get_inference_statistics(self, model_name: str = "") -> Dict:
+        return {}
+
+
+# ---------------------------------------------------------------------------
+
+
+class HttpPerfBackend(PerfBackend):
+    kind = "http"
+
+    def __init__(self, url: str, concurrency: int = 128):
+        from client_tpu.http import aio as httpclient
+
+        self._mod = httpclient
+        self._client = httpclient.InferenceServerClient(
+            url, concurrency=concurrency
+        )
+
+    async def close(self) -> None:
+        await self._client.close()
+
+    async def get_model_metadata(self, model_name, model_version=""):
+        return await self._client.get_model_metadata(model_name, model_version)
+
+    async def get_model_config(self, model_name, model_version=""):
+        return await self._client.get_model_config(model_name, model_version)
+
+    async def get_inference_statistics(self, model_name=""):
+        return await self._client.get_inference_statistics(model_name)
+
+    def _build_inputs(self, inputs):
+        built = []
+        for t in inputs:
+            x = self._mod.InferInput(t.name, t.shape, t.datatype)
+            x.set_data_from_numpy(t.data)
+            built.append(x)
+        return built
+
+    async def infer(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        request_id="",
+        parameters=None,
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+    ):
+        await self._client.infer(
+            model_name,
+            self._build_inputs(inputs),
+            model_version=model_version,
+            request_id=request_id,
+            parameters=parameters,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+        )
+
+
+class GrpcPerfBackend(PerfBackend):
+    kind = "grpc"
+    supports_streaming = True
+
+    def __init__(self, url: str):
+        from client_tpu.grpc import aio as grpcclient
+
+        self._mod = grpcclient
+        self._client = grpcclient.InferenceServerClient(url)
+
+    async def close(self) -> None:
+        await self._client.close()
+
+    async def get_model_metadata(self, model_name, model_version=""):
+        return await self._client.get_model_metadata(
+            model_name, model_version, as_json=True
+        )
+
+    async def get_model_config(self, model_name, model_version=""):
+        config = await self._client.get_model_config(
+            model_name, model_version, as_json=True
+        )
+        return config.get("config", config)
+
+    async def get_inference_statistics(self, model_name=""):
+        return await self._client.get_inference_statistics(
+            model_name, as_json=True
+        )
+
+    def _build_inputs(self, inputs):
+        built = []
+        for t in inputs:
+            x = self._mod.InferInput(t.name, t.shape, t.datatype)
+            x.set_data_from_numpy(t.data)
+            built.append(x)
+        return built
+
+    async def infer(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        request_id="",
+        parameters=None,
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+    ):
+        await self._client.infer(
+            model_name,
+            self._build_inputs(inputs),
+            model_version=model_version,
+            request_id=request_id,
+            parameters=parameters,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+        )
+
+    async def stream_infer(
+        self,
+        model_name,
+        inputs,
+        on_response,
+        model_version="",
+        request_id="",
+        parameters=None,
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+    ):
+        built = self._build_inputs(inputs)
+
+        async def requests():
+            yield {
+                "model_name": model_name,
+                "inputs": built,
+                "model_version": model_version,
+                "request_id": request_id,
+                "parameters": parameters,
+                "sequence_id": sequence_id,
+                "sequence_start": sequence_start,
+                "sequence_end": sequence_end,
+            }
+
+        iterator = self._client.stream_infer(requests())
+        async for result, error in iterator:
+            if error is not None:
+                raise error
+            on_response()
+            params = result.get_response().parameters
+            if (
+                "triton_final_response" in params
+                and params["triton_final_response"].bool_param
+            ):
+                break
+
+
+class LocalPerfBackend(PerfBackend):
+    """In-process backend over a ServerCore (triton_c_api analogue)."""
+
+    kind = "local"
+    supports_streaming = True
+
+    def __init__(self, core):
+        from client_tpu.server.core import CoreRequest, CoreTensor
+
+        self._core = core
+        self._CoreRequest = CoreRequest
+        self._CoreTensor = CoreTensor
+
+    def _build_request(
+        self, model_name, inputs, model_version, request_id, parameters
+    ):
+        from client_tpu.utils import np_to_triton_dtype
+
+        request = self._CoreRequest(
+            model_name=model_name,
+            model_version=model_version,
+            id=request_id,
+            parameters=dict(parameters or {}),
+        )
+        for t in inputs:
+            request.inputs.append(
+                self._CoreTensor(
+                    name=t.name,
+                    datatype=t.datatype,
+                    shape=t.shape,
+                    data=t.data,
+                )
+            )
+        return request
+
+    async def get_model_metadata(self, model_name, model_version=""):
+        return self._core.repository.get(model_name, model_version).metadata()
+
+    async def get_model_config(self, model_name, model_version=""):
+        return self._core.repository.get(model_name, model_version).config()
+
+    async def get_inference_statistics(self, model_name=""):
+        return self._core.statistics(model_name)
+
+    async def infer(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        request_id="",
+        parameters=None,
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+    ):
+        await self._core.infer(
+            self._build_request(
+                model_name, inputs, model_version, request_id, parameters
+            )
+        )
+
+    async def stream_infer(
+        self,
+        model_name,
+        inputs,
+        on_response,
+        model_version="",
+        request_id="",
+        parameters=None,
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+    ):
+        async for _ in self._core.infer_decoupled(
+            self._build_request(
+                model_name, inputs, model_version, request_id, parameters
+            )
+        ):
+            on_response()
+
+
+class MockPerfBackend(PerfBackend):
+    """Injectable-latency/error backend for hermetic harness tests
+    (reference mock_client_backend.h:289-318 role)."""
+
+    kind = "mock"
+    supports_streaming = True
+
+    def __init__(
+        self,
+        latency_s: float = 0.001,
+        responses_per_request: int = 1,
+        error_every: int = 0,
+        metadata: Optional[Dict] = None,
+    ):
+        self.latency_s = latency_s
+        self.responses_per_request = responses_per_request
+        self.error_every = error_every
+        self.request_count = 0
+        self.inflight = 0
+        self.max_inflight = 0
+        self._metadata = metadata or {
+            "name": "mock",
+            "versions": ["1"],
+            "platform": "mock",
+            "inputs": [{"name": "IN", "datatype": "FP32", "shape": [8]}],
+            "outputs": [{"name": "OUT", "datatype": "FP32", "shape": [8]}],
+        }
+
+    async def get_model_metadata(self, model_name, model_version=""):
+        return dict(self._metadata, name=model_name)
+
+    async def get_model_config(self, model_name, model_version=""):
+        return {
+            "name": model_name,
+            "platform": "mock",
+            "backend": "mock",
+            "max_batch_size": 8,
+            "input": [],
+            "output": [],
+            "model_transaction_policy": {
+                "decoupled": self.responses_per_request != 1
+            },
+        }
+
+    async def infer(self, model_name, inputs, **kwargs):
+        self.request_count += 1
+        n = self.request_count
+        self.inflight += 1
+        self.max_inflight = max(self.max_inflight, self.inflight)
+        try:
+            await asyncio.sleep(self.latency_s)
+            if self.error_every and n % self.error_every == 0:
+                raise InferenceServerException("mock injected failure")
+        finally:
+            self.inflight -= 1
+
+    async def stream_infer(
+        self, model_name, inputs, on_response, **kwargs
+    ):
+        self.request_count += 1
+        for _ in range(self.responses_per_request):
+            await asyncio.sleep(self.latency_s / self.responses_per_request)
+            on_response()
+
+
+def create_backend(
+    kind: str,
+    url: str = "",
+    core=None,
+    **kwargs,
+) -> PerfBackend:
+    """Factory (reference ClientBackendFactory::Create)."""
+    if kind == "http":
+        return HttpPerfBackend(url, **kwargs)
+    if kind == "grpc":
+        return GrpcPerfBackend(url)
+    if kind == "local":
+        if core is None:
+            raise InferenceServerException(
+                "local backend requires an in-process ServerCore"
+            )
+        return LocalPerfBackend(core)
+    if kind == "mock":
+        return MockPerfBackend(**kwargs)
+    raise InferenceServerException(f"unknown backend kind '{kind}'")

@@ -1,0 +1,335 @@
+"""perf-analyzer-tpu CLI.
+
+Flag names follow the reference's perf_analyzer CLI
+(reference src/c++/perf_analyzer/command_line_parser.cc option table) for
+drop-in familiarity: -m, -u, -i, -b, --concurrency-range,
+--request-rate-range, --request-intervals, --periodic-concurrency-range,
+--request-period, --request-distribution, --measurement-interval,
+--stability-percentage, --max-trials, --latency-threshold, --percentile,
+--input-data, --shape, --streaming, --sequence-length, --num-of-sequences,
+-f (csv), --profile-export-file, --verbose.
+"""
+
+import argparse
+import asyncio
+import json
+import sys
+from typing import List, Optional, Tuple
+
+
+def _parse_range(value: str, kind=int) -> Tuple:
+    """start[:end[:step]]"""
+    parts = value.split(":")
+    start = kind(parts[0])
+    end = kind(parts[1]) if len(parts) > 1 else start
+    step = kind(parts[2]) if len(parts) > 2 else kind(1)
+    return start, end, step
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="perf-analyzer-tpu",
+        description="Measure inference serving performance (KServe v2).",
+    )
+    parser.add_argument("-m", "--model-name", required=True)
+    parser.add_argument("-x", "--model-version", default="")
+    parser.add_argument(
+        "-u", "--url", default="localhost:8000", help="server host:port"
+    )
+    parser.add_argument(
+        "-i",
+        "--protocol",
+        default="http",
+        choices=["http", "grpc"],
+        help="service protocol",
+    )
+    parser.add_argument("-b", "--batch-size", type=int, default=1)
+    parser.add_argument(
+        "--concurrency-range",
+        default=None,
+        help="start:end:step concurrency sweep",
+    )
+    parser.add_argument(
+        "--request-rate-range",
+        default=None,
+        help="start:end:step request-rate sweep (infer/sec)",
+    )
+    parser.add_argument(
+        "--request-distribution",
+        default="constant",
+        choices=["constant", "poisson"],
+    )
+    parser.add_argument(
+        "--request-intervals",
+        default=None,
+        help="file of inter-request intervals in microseconds (one per line)",
+    )
+    parser.add_argument(
+        "--periodic-concurrency-range",
+        default=None,
+        help="start:end:step periodic concurrency ramp (LLM profiling)",
+    )
+    parser.add_argument(
+        "--request-period",
+        type=int,
+        default=10,
+        help="requests per periodic-concurrency period",
+    )
+    parser.add_argument(
+        "--measurement-interval",
+        "-p",
+        type=int,
+        default=5000,
+        help="measurement window in msec",
+    )
+    parser.add_argument(
+        "--stability-percentage", "-s", type=float, default=10.0
+    )
+    parser.add_argument("--max-trials", "-r", type=int, default=10)
+    parser.add_argument(
+        "--latency-threshold",
+        "-l",
+        type=int,
+        default=0,
+        help="latency budget in msec (0 = none)",
+    )
+    parser.add_argument(
+        "--percentile",
+        type=int,
+        default=None,
+        help="use this latency percentile for stability (default: avg)",
+    )
+    parser.add_argument("--input-data", default=None, help="JSON data file")
+    parser.add_argument(
+        "--shape",
+        action="append",
+        default=[],
+        help="name:d1,d2,... override for dynamic input shapes",
+    )
+    parser.add_argument("--streaming", action="store_true")
+    parser.add_argument("--sequence-length", type=int, default=0)
+    parser.add_argument("--num-of-sequences", type=int, default=4)
+    parser.add_argument("-f", "--filename", default=None, help="CSV output")
+    parser.add_argument("--profile-export-file", default=None)
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument(
+        "--warmup-request-count", type=int, default=0,
+        help="requests to discard before measuring",
+    )
+    parser.add_argument(
+        "--json-summary",
+        action="store_true",
+        help="print a one-line JSON summary (bench integration)",
+    )
+    return parser
+
+
+async def run(args) -> int:
+    from client_tpu.perf.backend import create_backend
+    from client_tpu.perf.data import DataLoader
+    from client_tpu.perf.load_manager import (
+        ConcurrencyManager,
+        PeriodicConcurrencyManager,
+        RequestRateManager,
+    )
+    from client_tpu.perf.profiler import InferenceProfiler
+    from client_tpu.perf.report import (
+        console_report,
+        detailed_report,
+        export_profile,
+        write_csv,
+    )
+    from client_tpu.perf.sequence import SequenceManager
+
+    backend = create_backend(args.protocol, args.url)
+    if args.streaming and not backend.supports_streaming:
+        print(
+            f"error: --streaming is not supported by the '{args.protocol}' "
+            "protocol; use -i grpc",
+            file=sys.stderr,
+        )
+        await backend.close()
+        return 2
+    try:
+        metadata = await backend.get_model_metadata(
+            args.model_name, args.model_version
+        )
+        try:
+            config = await backend.get_model_config(
+                args.model_name, args.model_version
+            )
+            batched = int(config.get("max_batch_size", 0) or 0) > 0
+        except Exception:  # noqa: BLE001 - config extension is optional
+            batched = False
+        shape_overrides = {}
+        for override in args.shape:
+            name, _, dims = override.partition(":")
+            shape_overrides[name] = [int(d) for d in dims.split(",")]
+        loader = DataLoader(
+            metadata,
+            batch_size=args.batch_size,
+            shape_overrides=shape_overrides,
+            batched=batched,
+        )
+        if args.input_data:
+            loader.read_from_json(args.input_data)
+        else:
+            loader.generate_synthetic()
+
+        sequence_manager = None
+        if args.sequence_length > 0:
+            sequence_manager = SequenceManager(
+                length_mean=args.sequence_length
+            )
+            common_seq = {"num_sequence_slots": args.num_of_sequences}
+        else:
+            common_seq = {}
+
+        percentiles = (50, 90, 95, 99)
+        if args.percentile and args.percentile not in percentiles:
+            percentiles = tuple(sorted(set(percentiles) | {args.percentile}))
+
+        common = dict(
+            model_name=args.model_name,
+            model_version=args.model_version,
+            data_loader=loader,
+            streaming=args.streaming,
+            sequence_manager=sequence_manager,
+        )
+
+        latency_threshold_us = (
+            args.latency_threshold * 1000 if args.latency_threshold else None
+        )
+
+        def make_profiler(manager):
+            return InferenceProfiler(
+                manager,
+                measurement_interval_s=args.measurement_interval / 1000.0,
+                stability_pct=args.stability_percentage,
+                max_trials=args.max_trials,
+                latency_threshold_us=latency_threshold_us,
+                percentiles=percentiles,
+                stability_percentile=args.percentile,
+                warmup_requests=args.warmup_request_count,
+                verbose=args.verbose,
+            )
+
+        if args.periodic_concurrency_range:
+            start, end, step = _parse_range(args.periodic_concurrency_range)
+            manager = PeriodicConcurrencyManager(
+                backend,
+                start=start,
+                end=end,
+                step=step,
+                request_period=args.request_period,
+                **common,
+            )
+            import time as _time
+
+            t0 = _time.monotonic_ns()
+            await manager.run()
+            t1 = _time.monotonic_ns()
+            from client_tpu.perf.profiler import ProfileExperiment
+            from client_tpu.perf.records import compute_window_status
+
+            status = compute_window_status(manager.records, t0, t1, percentiles)
+            experiments = [
+                ProfileExperiment(
+                    mode="periodic_concurrency",
+                    value=end,
+                    status=status,
+                    records=manager.records,
+                )
+            ]
+        elif args.request_intervals:
+            with open(args.request_intervals) as f:
+                intervals_us = [float(line) for line in f if line.strip()]
+            manager = RequestRateManager(
+                backend,
+                distribution=args.request_distribution,
+                **common_seq,
+                **common,
+            )
+            profiler = make_profiler(manager)
+            experiments = await profiler.profile_custom_intervals(
+                [us / 1e6 for us in intervals_us]
+            )
+        elif args.request_rate_range:
+            start, end, step = _parse_range(args.request_rate_range, float)
+            manager = RequestRateManager(
+                backend,
+                distribution=args.request_distribution,
+                **common_seq,
+                **common,
+            )
+            profiler = make_profiler(manager)
+            experiments = await profiler.profile_request_rate_range(
+                start, end, step
+            )
+        else:
+            start, end, step = _parse_range(args.concurrency_range or "1")
+            manager = ConcurrencyManager(backend, **common)
+            profiler = make_profiler(manager)
+            experiments = await profiler.profile_concurrency_range(
+                start, end, step
+            )
+
+        for experiment in experiments:
+            label = f"{experiment.mode} = {experiment.value:g}"
+            print(f"* {label}")
+            print(detailed_report(experiment))
+        print()
+        print(console_report(experiments))
+
+        if args.filename:
+            write_csv(experiments, args.filename)
+        if args.profile_export_file:
+            export_profile(
+                experiments,
+                args.profile_export_file,
+                endpoint=args.url,
+            )
+        if args.json_summary and experiments:
+            best = max(experiments, key=lambda e: e.status.throughput)
+            print(
+                json.dumps(
+                    {
+                        "throughput": best.status.throughput,
+                        "p50_us": best.status.latency_percentiles_us.get(50, 0),
+                        "p99_us": best.status.latency_percentiles_us.get(99, 0),
+                        "count": best.status.request_count,
+                        "mode": best.mode,
+                        "value": best.value,
+                    }
+                )
+            )
+        return 0
+    finally:
+        await backend.close()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if (
+        sum(
+            bool(x)
+            for x in (
+                args.concurrency_range,
+                args.request_rate_range,
+                args.request_intervals,
+                args.periodic_concurrency_range,
+            )
+        )
+        > 1
+    ):
+        print(
+            "error: pick one of --concurrency-range, --request-rate-range, "
+            "--request-intervals, --periodic-concurrency-range",
+            file=sys.stderr,
+        )
+        return 2
+    return asyncio.run(run(args))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,202 @@
+"""Input-data management for the perf harness.
+
+The reference's DataLoader (reference src/c++/perf_analyzer/data_loader.h:
+41-229) supports synthetic generation, a directory of files, and multi-
+stream JSON corpora; this module covers the same three sources over model
+metadata, producing PerfInferInput sets per (stream, step).
+"""
+
+import base64
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from client_tpu.perf.backend import PerfInferInput
+from client_tpu.utils import (
+    InferenceServerException,
+    triton_to_np_dtype,
+)
+
+
+def _resolve_shape(shape, batch_size: int, tensor_name: str, shape_overrides):
+    resolved = []
+    for dim in shape:
+        dim = int(dim)
+        if dim < 0:
+            override = (shape_overrides or {}).get(tensor_name)
+            if override is None:
+                raise InferenceServerException(
+                    f"input '{tensor_name}' has dynamic shape {list(shape)}; "
+                    "provide --shape overrides"
+                )
+            return list(override)
+        resolved.append(dim)
+    return resolved
+
+
+class DataLoader:
+    """Materializes request inputs from synthetic/random or JSON data."""
+
+    def __init__(
+        self,
+        metadata: Dict[str, Any],
+        batch_size: int = 1,
+        shape_overrides: Optional[Dict[str, List[int]]] = None,
+        seed: int = 0,
+        batched: bool = False,
+    ):
+        """``batched=True`` means the model supports batching
+        (config.max_batch_size > 0), so a leading -1 in metadata shapes is
+        the batch dimension rather than a free dynamic dim."""
+        self._metadata = metadata
+        self._batch_size = batch_size
+        self._batched = batched
+        self._shape_overrides = shape_overrides or {}
+        self._rng = np.random.default_rng(seed)
+        # streams[i] is a list of steps; each step maps name -> ndarray
+        self._streams: List[List[Dict[str, np.ndarray]]] = []
+
+    @property
+    def stream_count(self) -> int:
+        return len(self._streams)
+
+    def step_count(self, stream: int) -> int:
+        return len(self._streams[stream])
+
+    def _input_descs(self):
+        return self._metadata.get("inputs", [])
+
+    def _batched_shape(self, shape: List[int]) -> List[int]:
+        # metadata shapes on batched models lead with -1; replace with batch
+        if self._batched and shape and int(shape[0]) == -1:
+            return [self._batch_size] + [int(s) for s in shape[1:]]
+        return [int(s) for s in shape]
+
+    def generate_synthetic(self, zero_data: bool = False) -> None:
+        """One stream, one step of random (or zero) tensors per input."""
+        step: Dict[str, np.ndarray] = {}
+        for desc in self._input_descs():
+            name = desc["name"]
+            datatype = desc["datatype"]
+            # replace the leading batch dim first, then resolve any
+            # remaining dynamic dims via --shape overrides
+            shape = _resolve_shape(
+                self._batched_shape(desc.get("shape", [])),
+                self._batch_size,
+                name,
+                self._shape_overrides,
+            )
+            np_dtype = triton_to_np_dtype(datatype)
+            if datatype == "BYTES":
+                flat = [
+                    b"synthetic_%d" % i for i in range(int(np.prod(shape) or 1))
+                ]
+                arr = np.array(flat, dtype=np.object_).reshape(shape)
+            elif zero_data:
+                arr = np.zeros(shape, dtype=np_dtype)
+            elif np.dtype(np_dtype).kind in ("i", "u"):
+                arr = self._rng.integers(0, 127, size=shape).astype(np_dtype)
+            elif np_dtype == np.bool_:
+                arr = self._rng.integers(0, 2, size=shape).astype(np.bool_)
+            else:
+                arr = self._rng.random(size=shape).astype(np_dtype)
+            step[name] = arr
+        self._streams = [[step]]
+
+    def read_from_json(self, path: str) -> None:
+        """Load the reference's --input-data JSON format.
+
+        {"data": [ {input-name: {"content": [...], "shape": [...]}, ...} |
+                   [ {...step...}, ... ]   # nested list = one stream
+                 ]}
+        Values may be flat lists, nested lists, or {"b64": "..."} raw blobs.
+        """
+        with open(path) as f:
+            doc = json.load(f)
+        if "data" not in doc:
+            raise InferenceServerException(
+                f"input data file '{path}' missing top-level 'data'"
+            )
+        descs = {d["name"]: d for d in self._input_descs()}
+        streams: List[List[Dict[str, np.ndarray]]] = []
+        entries = doc["data"]
+        for entry in entries:
+            steps = entry if isinstance(entry, list) else [entry]
+            stream = [self._parse_step(step, descs) for step in steps]
+            streams.append(stream)
+        if not isinstance(entries[0] if entries else None, list):
+            # flat list of steps = a single stream (reference semantics)
+            streams = [[s[0] for s in streams]]
+        self._streams = streams
+
+    def _parse_step(self, step: Dict, descs: Dict) -> Dict[str, np.ndarray]:
+        out: Dict[str, np.ndarray] = {}
+        for name, value in step.items():
+            desc = descs.get(name)
+            if desc is None:
+                raise InferenceServerException(
+                    f"input data references unknown input '{name}'"
+                )
+            datatype = desc["datatype"]
+            np_dtype = triton_to_np_dtype(datatype)
+            if isinstance(value, dict) and "b64" in value:
+                raw = base64.b64decode(value["b64"])
+                shape = value.get(
+                    "shape",
+                    _resolve_shape(
+                        desc.get("shape", []), self._batch_size, name,
+                        self._shape_overrides,
+                    ),
+                )
+                arr = np.frombuffer(raw, dtype=np_dtype).reshape(shape)
+            else:
+                content = value["content"] if isinstance(value, dict) else value
+                shape = (
+                    value.get("shape")
+                    if isinstance(value, dict) and "shape" in value
+                    else None
+                )
+                if datatype == "BYTES":
+                    flat = [
+                        c.encode("utf-8") if isinstance(c, str) else c
+                        for c in np.asarray(content, dtype=object).reshape(-1)
+                    ]
+                    arr = np.array(flat, dtype=np.object_)
+                    if shape:
+                        arr = arr.reshape(shape)
+                else:
+                    arr = np.asarray(content, dtype=np_dtype)
+                    if shape:
+                        arr = arr.reshape(shape)
+            out[name] = arr
+        return out
+
+    def get_inputs(self, stream: int = 0, step: int = 0) -> List[PerfInferInput]:
+        """The PerfInferInput list for (stream, step)."""
+        if not self._streams:
+            raise InferenceServerException(
+                "no input data loaded; call generate_synthetic or "
+                "read_from_json"
+            )
+        data = self._streams[stream % len(self._streams)]
+        tensors = data[step % len(data)]
+        inputs = []
+        for desc in self._input_descs():
+            name = desc["name"]
+            if name not in tensors:
+                raise InferenceServerException(
+                    f"input data stream {stream} step {step} missing "
+                    f"input '{name}'"
+                )
+            arr = tensors[name]
+            inputs.append(
+                PerfInferInput(
+                    name=name,
+                    shape=list(arr.shape),
+                    datatype=desc["datatype"],
+                    data=arr,
+                )
+            )
+        return inputs

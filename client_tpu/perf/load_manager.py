@@ -1,0 +1,302 @@
+"""Async load managers: concurrency, request-rate, custom-interval, periodic.
+
+The asyncio re-expression of the reference's manager/worker hierarchy
+(reference load_manager.h:48-180, concurrency_manager.h, request_rate_
+manager.h, custom_load_manager.h, periodic_concurrency_manager.h). One loop
+drives all in-flight requests; workers are tasks, not threads.
+"""
+
+import asyncio
+import itertools
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from client_tpu.perf.backend import PerfBackend
+from client_tpu.perf.data import DataLoader
+from client_tpu.perf.records import RequestRecord
+from client_tpu.perf.sequence import SequenceManager
+
+
+class LoadManager:
+    """Base: owns the backend, data loader, and the shared record list."""
+
+    def __init__(
+        self,
+        backend: PerfBackend,
+        model_name: str,
+        data_loader: DataLoader,
+        model_version: str = "",
+        streaming: bool = False,
+        sequence_manager: Optional[SequenceManager] = None,
+        parameters: Optional[Dict] = None,
+    ):
+        self.backend = backend
+        self.model_name = model_name
+        self.model_version = model_version
+        self.data_loader = data_loader
+        self.streaming = streaming
+        self.sequences = sequence_manager
+        self.parameters = parameters
+        self.records: List[RequestRecord] = []
+        self._records_lock = asyncio.Lock()
+        self._request_counter = itertools.count()
+        self._idle_ns = 0  # accumulated worker idle time (rate mode)
+        self._tasks: List[asyncio.Task] = []
+        self._stopping = False
+
+    # -- issuing -------------------------------------------------------------
+
+    async def issue_one(
+        self, stream: int = 0, step: int = 0, slot: Optional[int] = None
+    ) -> RequestRecord:
+        """Send one request (or one sequence step) and record its timing.
+
+        ``slot`` identifies the issuing worker for sequence bookkeeping —
+        each slot owns at most one active sequence at a time (two workers
+        must never interleave steps of one sequence id).
+        """
+        request_id = str(next(self._request_counter))
+        seq_kwargs = {}
+        if self.sequences is not None:
+            seq_kwargs = self.sequences.next_step(
+                slot if slot is not None else stream
+            )
+        inputs = self.data_loader.get_inputs(stream, step)
+        record = RequestRecord(start_ns=time.monotonic_ns(), request_id=request_id)
+        try:
+            if self.streaming and self.backend.supports_streaming:
+                def on_response():
+                    record.response_ns.append(time.monotonic_ns())
+
+                await self.backend.stream_infer(
+                    self.model_name,
+                    inputs,
+                    on_response,
+                    model_version=self.model_version,
+                    request_id=request_id,
+                    parameters=self.parameters,
+                    **seq_kwargs,
+                )
+            else:
+                await self.backend.infer(
+                    self.model_name,
+                    inputs,
+                    model_version=self.model_version,
+                    request_id=request_id,
+                    parameters=self.parameters,
+                    **seq_kwargs,
+                )
+                record.response_ns.append(time.monotonic_ns())
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 - failures are data
+            record.success = False
+            record.error = str(e)
+        record.end_ns = time.monotonic_ns()
+        record.sequence_id = seq_kwargs.get("sequence_id", 0)
+        self.records.append(record)
+        return record
+
+    def swap_records(self) -> List[RequestRecord]:
+        """Hand the accumulated records to the profiler (reference
+        SwapRequestRecords)."""
+        records, self.records = self.records, []
+        return records
+
+    def check_health(self) -> None:
+        """Raise if any worker task died unexpectedly (reference
+        CheckHealth)."""
+        for task in self._tasks:
+            if task.done() and not task.cancelled():
+                exc = task.exception()
+                if exc is not None:
+                    raise exc
+
+    async def stop(self) -> None:
+        self._stopping = True
+        for task in self._tasks:
+            task.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+
+
+class ConcurrencyManager(LoadManager):
+    """Maintains N outstanding requests (closed loop).
+
+    Reference semantics: ConcurrencyManager/ConcurrencyWorker — send until
+    the concurrency budget is full, re-issue as responses return
+    (reference concurrency_worker.h:99-127).
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._concurrency = 0
+        self._worker_seq = itertools.count()
+
+    @property
+    def concurrency(self) -> int:
+        return self._concurrency
+
+    async def change_concurrency(self, concurrency: int) -> None:
+        """Grow/shrink the worker pool (reference ChangeConcurrencyLevel)."""
+        self._concurrency = concurrency
+        while len(self._tasks) < concurrency:
+            worker_id = next(self._worker_seq)
+            self._tasks.append(
+                asyncio.ensure_future(self._worker(worker_id))
+            )
+        while len(self._tasks) > concurrency:
+            task = self._tasks.pop()
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+
+    async def _worker(self, worker_id: int) -> None:
+        step = 0
+        stream = worker_id % max(1, self.data_loader.stream_count or 1)
+        while not self._stopping:
+            await self.issue_one(stream, step, slot=worker_id)
+            step += 1
+
+
+class RequestRateManager(LoadManager):
+    """Open-loop fixed-rate load (constant or Poisson schedule).
+
+    Requests fire at schedule instants regardless of completions
+    (reference request_rate_manager.h:105-136). Late dispatches accumulate
+    in ``schedule_slip_ns``.
+    """
+
+    def __init__(
+        self,
+        *args,
+        distribution: str = "constant",
+        seed: int = 0,
+        num_sequence_slots: int = 4,
+        **kwargs,
+    ):
+        super().__init__(*args, **kwargs)
+        self.distribution = distribution
+        self._rng = np.random.default_rng(seed)
+        self._dispatcher: Optional[asyncio.Task] = None
+        self._inflight: set = set()
+        self.schedule_slip_ns = 0
+        # open-loop mode has no workers; sequence ownership cycles over
+        # this many slots (reference --num-of-sequences)
+        self.num_sequence_slots = max(1, num_sequence_slots)
+
+    def _intervals(self, rate: float):
+        if self.distribution == "constant":
+            while True:
+                yield 1.0 / rate
+        elif self.distribution == "poisson":
+            while True:
+                yield float(self._rng.exponential(1.0 / rate))
+        else:
+            raise ValueError(
+                f"unknown schedule distribution '{self.distribution}'"
+            )
+
+    async def change_rate(self, rate: float) -> None:
+        """Replace the dispatch schedule (reference ChangeRequestRate)."""
+        await self.stop_dispatch()
+        self._stopping = False
+        self._dispatcher = asyncio.ensure_future(
+            self._dispatch(self._intervals(rate))
+        )
+        self._tasks = [self._dispatcher]
+
+    async def start_custom_intervals(self, intervals_s: Sequence[float]) -> None:
+        """Replay a fixed interval list, cycling (reference
+        CustomLoadManager)."""
+        await self.stop_dispatch()
+        self._stopping = False
+        self._dispatcher = asyncio.ensure_future(
+            self._dispatch(itertools.cycle(intervals_s))
+        )
+        self._tasks = [self._dispatcher]
+
+    async def _dispatch(self, intervals) -> None:
+        next_fire = time.monotonic()
+        stream = 0
+        step = 0
+        slot = 0
+        n_streams = max(1, self.data_loader.stream_count or 1)
+        for interval in intervals:
+            if self._stopping:
+                break
+            now = time.monotonic()
+            delay = next_fire - now
+            if delay > 0:
+                await asyncio.sleep(delay)
+            else:
+                self.schedule_slip_ns += int(-delay * 1e9)
+                self._idle_ns = 0
+            task = asyncio.ensure_future(self.issue_one(stream, step, slot=slot))
+            self._inflight.add(task)
+            task.add_done_callback(self._inflight.discard)
+            step += 1
+            if self.sequences is not None:
+                # round-robin sequence ownership over the configured slots;
+                # rotate input stream when a slot finishes its sequence
+                if self.sequences.rotate_stream(slot):
+                    stream = (stream + 1) % n_streams
+                slot = (slot + 1) % self.num_sequence_slots
+            next_fire += interval
+
+    async def stop_dispatch(self) -> None:
+        self._stopping = True
+        if self._dispatcher is not None:
+            self._dispatcher.cancel()
+            try:
+                await self._dispatcher
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._dispatcher = None
+        # let in-flight requests drain briefly
+        if self._inflight:
+            await asyncio.gather(*list(self._inflight), return_exceptions=True)
+        self._tasks = []
+
+    async def stop(self) -> None:
+        await self.stop_dispatch()
+
+
+class PeriodicConcurrencyManager(ConcurrencyManager):
+    """Ramp concurrency start->end by step every ``request_period`` requests
+    (reference periodic_concurrency_manager.h; the LLM profiling mode)."""
+
+    def __init__(
+        self,
+        *args,
+        start: int = 1,
+        end: int = 1,
+        step: int = 1,
+        request_period: int = 10,
+        **kwargs,
+    ):
+        super().__init__(*args, **kwargs)
+        self._range = (start, end, step)
+        self._request_period = request_period
+        self._ramp_task: Optional[asyncio.Task] = None
+
+    async def run(self) -> None:
+        """Run the full ramp; returns when the end concurrency's period
+        completes."""
+        start, end, step = self._range
+        await self.change_concurrency(start)
+        current = start
+        while True:
+            target = len(self.records) + self._request_period
+            while len(self.records) < target:
+                await asyncio.sleep(0.005)
+                self.check_health()
+            if current >= end:
+                break
+            current = min(end, current + step)
+            await self.change_concurrency(current)
+        await self.stop()

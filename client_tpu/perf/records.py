@@ -1,0 +1,110 @@
+"""Per-request records and window statistics.
+
+RequestRecord mirrors the reference's request_record.h (6-point timestamps
+reduced to the ones a network client can observe: send start, response(s),
+completion); PerfStatus mirrors the client-side slice of
+inference_profiler.h's PerfStatus.
+"""
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence
+
+
+@dataclasses.dataclass
+class RequestRecord:
+    """One issued request's lifecycle (monotonic ns timestamps)."""
+
+    start_ns: int
+    end_ns: int = 0
+    # per-response arrival times (>=1 entry; decoupled models several)
+    response_ns: List[int] = dataclasses.field(default_factory=list)
+    success: bool = True
+    error: Optional[str] = None
+    sequence_id: int = 0
+    request_id: str = ""
+
+    @property
+    def latency_ns(self) -> int:
+        return self.end_ns - self.start_ns
+
+    @property
+    def first_response_ns(self) -> Optional[int]:
+        return self.response_ns[0] if self.response_ns else None
+
+
+def percentile(sorted_values: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile on a pre-sorted sequence."""
+    if not sorted_values:
+        return 0.0
+    rank = max(0, min(len(sorted_values) - 1, math.ceil(q / 100.0 * len(sorted_values)) - 1))
+    return sorted_values[rank]
+
+
+@dataclasses.dataclass
+class PerfStatus:
+    """Client-side statistics for one measurement window."""
+
+    concurrency: int = 0
+    request_rate: float = 0.0
+    window_start_ns: int = 0
+    window_end_ns: int = 0
+    request_count: int = 0
+    error_count: int = 0
+    throughput: float = 0.0  # infer/sec
+    response_throughput: float = 0.0  # responses/sec (decoupled)
+    avg_latency_us: float = 0.0
+    std_latency_us: float = 0.0
+    latency_percentiles_us: Dict[int, float] = dataclasses.field(
+        default_factory=dict
+    )
+    # server-side deltas (from the statistics extension), all microseconds
+    server_queue_us: float = 0.0
+    server_compute_infer_us: float = 0.0
+    server_compute_input_us: float = 0.0
+    server_compute_output_us: float = 0.0
+
+    @property
+    def stabilizing_latency_us(self) -> float:
+        """The latency metric used for stability checks (p99 if computed,
+        else avg) — reference DetermineStability semantics."""
+        return self.latency_percentiles_us.get(99, self.avg_latency_us)
+
+
+def compute_window_status(
+    records: List[RequestRecord],
+    window_start_ns: int,
+    window_end_ns: int,
+    percentiles: Sequence[int] = (50, 90, 95, 99),
+) -> PerfStatus:
+    """Reduce the records completing inside a window to a PerfStatus."""
+    window = [
+        r
+        for r in records
+        if r.end_ns and window_start_ns <= r.end_ns <= window_end_ns
+    ]
+    status = PerfStatus(
+        window_start_ns=window_start_ns, window_end_ns=window_end_ns
+    )
+    duration_s = max(1e-9, (window_end_ns - window_start_ns) / 1e9)
+    successes = [r for r in window if r.success]
+    status.request_count = len(successes)
+    status.error_count = sum(1 for r in window if not r.success)
+    status.throughput = len(successes) / duration_s
+    status.response_throughput = (
+        sum(len(r.response_ns) for r in successes) / duration_s
+    )
+    if successes:
+        lat_us = sorted(r.latency_ns / 1e3 for r in successes)
+        n = len(lat_us)
+        mean = sum(lat_us) / n
+        status.avg_latency_us = mean
+        status.std_latency_us = (
+            (sum((x - mean) ** 2 for x in lat_us) / (n - 1)) ** 0.5
+            if n > 1
+            else 0.0
+        )
+        status.latency_percentiles_us = {
+            q: percentile(lat_us, q) for q in percentiles
+        }
+    return status

@@ -1,0 +1,158 @@
+"""Reporting: console summary, CSV, and profile-export JSON.
+
+Console/CSV mirror the reference's ReportWriter output columns
+(reference report_writer.cc); the profile export follows the shape of the
+reference's ProfileDataExporter document (experiments with per-request
+timestamps) that genai-perf consumes
+(reference profile_data_exporter.h:52-86).
+"""
+
+import json
+from typing import List, Optional, Sequence
+
+from client_tpu.perf.profiler import ProfileExperiment
+
+
+def console_report(
+    experiments: Sequence[ProfileExperiment],
+    percentile: Optional[int] = None,
+) -> str:
+    lines = []
+    for experiment in experiments:
+        s = experiment.status
+        label = (
+            f"Concurrency: {int(experiment.value)}"
+            if experiment.mode == "concurrency"
+            else f"Request rate: {experiment.value:g}"
+        )
+        lines.append(
+            f"{label}, throughput: {s.throughput:.2f} infer/sec, latency "
+            f"{int(s.avg_latency_us)} usec"
+        )
+    lines.append("")
+    lines.append("Inferences/Second vs. Client Average Batch Latency")
+    for experiment in experiments:
+        s = experiment.status
+        lines.append(
+            f"{experiment.mode}: {experiment.value:g}, throughput: "
+            f"{s.throughput:.2f} infer/sec, latency avg {int(s.avg_latency_us)}"
+            f" usec, p50 {int(s.latency_percentiles_us.get(50, 0))} usec, "
+            f"p90 {int(s.latency_percentiles_us.get(90, 0))} usec, "
+            f"p95 {int(s.latency_percentiles_us.get(95, 0))} usec, "
+            f"p99 {int(s.latency_percentiles_us.get(99, 0))} usec"
+        )
+    return "\n".join(lines)
+
+
+def detailed_report(experiment: ProfileExperiment) -> str:
+    """The per-point block the reference prints under each measurement."""
+    s = experiment.status
+    lines = [
+        f"  Request count: {s.request_count}",
+        f"  Throughput: {s.throughput:.2f} infer/sec",
+    ]
+    if s.response_throughput and s.response_throughput != s.throughput:
+        lines.append(
+            f"  Response throughput: {s.response_throughput:.2f} resp/sec"
+        )
+    lines += [
+        f"  Avg latency: {int(s.avg_latency_us)} usec "
+        f"(standard deviation {int(s.std_latency_us)} usec)",
+    ]
+    for q in sorted(s.latency_percentiles_us):
+        lines.append(
+            f"  p{q} latency: {int(s.latency_percentiles_us[q])} usec"
+        )
+    if s.server_compute_infer_us:
+        lines.append(
+            "  Server: queue "
+            f"{s.server_queue_us:.0f} usec, compute input "
+            f"{s.server_compute_input_us:.0f} usec, compute infer "
+            f"{s.server_compute_infer_us:.0f} usec, compute output "
+            f"{s.server_compute_output_us:.0f} usec"
+        )
+    if s.error_count:
+        lines.append(f"  Errors: {s.error_count}")
+    return "\n".join(lines)
+
+
+def write_csv(experiments: Sequence[ProfileExperiment], path: str) -> None:
+    """Reference-compatible CSV columns."""
+    percentile_cols = sorted(
+        {
+            q
+            for e in experiments
+            for q in e.status.latency_percentiles_us
+        }
+    )
+    header = (
+        ["Concurrency" if experiments and experiments[0].mode == "concurrency"
+         else "Request Rate"]
+        + ["Inferences/Second", "Client Send/Recv", "Server Queue",
+           "Server Compute Input", "Server Compute Infer",
+           "Server Compute Output"]
+        + [f"p{q} latency" for q in percentile_cols]
+        + ["Avg latency"]
+    )
+    rows = [",".join(header)]
+    for e in experiments:
+        s = e.status
+        row = [
+            f"{e.value:g}",
+            f"{s.throughput:.2f}",
+            "0",
+            f"{s.server_queue_us:.0f}",
+            f"{s.server_compute_input_us:.0f}",
+            f"{s.server_compute_infer_us:.0f}",
+            f"{s.server_compute_output_us:.0f}",
+        ]
+        row += [
+            f"{s.latency_percentiles_us.get(q, 0):.0f}"
+            for q in percentile_cols
+        ]
+        row.append(f"{s.avg_latency_us:.0f}")
+        rows.append(",".join(row))
+    with open(path, "w") as f:
+        f.write("\n".join(rows) + "\n")
+
+
+def export_profile(
+    experiments: Sequence[ProfileExperiment],
+    path: str,
+    service_kind: str = "triton",
+    endpoint: str = "",
+) -> None:
+    """Profile-export JSON: per-request timestamps per experiment.
+
+    genai-perf's parser consumes this document (reference
+    llm_metrics.py LLMProfileDataParser; exporter shape
+    profile_data_exporter.h:52-86).
+    """
+    doc = {
+        "service_kind": service_kind,
+        "endpoint": endpoint,
+        "experiments": [
+            {
+                "experiment": {
+                    "mode": e.mode,
+                    "value": e.value,
+                },
+                "requests": [
+                    {
+                        "timestamp": r.start_ns,
+                        "sequence_id": r.sequence_id,
+                        "response_timestamps": list(r.response_ns),
+                        "success": r.success,
+                    }
+                    for r in e.records
+                ],
+                "window_boundaries": [
+                    e.status.window_start_ns,
+                    e.status.window_end_ns,
+                ],
+            }
+            for e in experiments
+        ],
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f)

@@ -8,11 +8,20 @@ anywhere (multi-chip TPU hardware is exercised separately by the driver's
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the environment pre-sets JAX_PLATFORMS (e.g. to
+# the TPU platform), and tests must run on the virtual CPU mesh regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# A pytest plugin imports jax before this conftest runs, so jax's config
+# has already captured the original JAX_PLATFORMS value; override it before
+# any backend initializes (backends are still uninitialized here).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,136 @@
+"""Tests for the JAX model zoo + parallelism layer (8-device CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from client_tpu.parallel import create_mesh
+from client_tpu.parallel.ring_attention import (
+    reference_attention,
+    ring_attention,
+)
+from client_tpu.models import llama
+
+
+def test_mesh_creation():
+    mesh = create_mesh(dp=2, tp=2, sp=2)
+    assert mesh.shape == {"dp": 2, "sp": 2, "tp": 2}
+    with pytest.raises(ValueError, match="does not match"):
+        create_mesh(dp=3, tp=1, sp=1)
+
+
+def test_ring_attention_matches_reference():
+    mesh = create_mesh(dp=2, tp=2, sp=2)
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (2, 4, 16, 8)  # [B, H, L, D]; L sharded 2-way
+    q = jax.random.normal(kq, shape, dtype=jnp.float32)
+    k = jax.random.normal(kk, shape, dtype=jnp.float32)
+    v = jax.random.normal(kv, shape, dtype=jnp.float32)
+
+    expected = reference_attention(q, k, v, causal=True)
+    got = ring_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expected), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_ring_attention_non_causal():
+    mesh = create_mesh(dp=1, tp=1, sp=8)
+    key = jax.random.PRNGKey(1)
+    shape = (1, 2, 32, 4)
+    q, k, v = (
+        jax.random.normal(k_, shape, dtype=jnp.float32)
+        for k_ in jax.random.split(key, 3)
+    )
+    expected = reference_attention(q, k, v, causal=False)
+    got = ring_attention(q, k, v, mesh, causal=False)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expected), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), config)
+    return config, params
+
+
+def test_llama_forward_shapes(tiny):
+    config, params = tiny
+    tokens = jnp.zeros((2, 10), dtype=jnp.int32)
+    logits = llama.forward(params, tokens, config)
+    assert logits.shape == (2, 10, config.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_llama_prefill_matches_forward(tiny):
+    """KV-cache prefill last-token logits == full forward last position."""
+    config, params = tiny
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 12), 0, 255)
+    full = llama.forward(params, tokens, config)
+    cache = llama.init_kv_cache(config, 2, 32)
+    last, _ = llama.prefill_with_cache(params, tokens, cache, config)
+    np.testing.assert_allclose(
+        np.asarray(last), np.asarray(full[:, -1]), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_llama_decode_consistency(tiny):
+    """decode_step at position L must match forward on the L+1 sequence."""
+    config, params = tiny
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (1, 8), 0, 255)
+    next_token = jax.random.randint(jax.random.PRNGKey(5), (1, 1), 0, 255)
+    extended = jnp.concatenate([tokens, next_token], axis=1)
+    full = llama.forward(params, extended, config)
+
+    cache = llama.init_kv_cache(config, 1, 32)
+    _, cache = llama.prefill_with_cache(params, tokens, cache, config)
+    logits, _ = llama.decode_step(
+        params, next_token[:, 0], jnp.int32(8), cache, config
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(full[:, -1]), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_llama_generate(tiny):
+    config, params = tiny
+    prompt = jnp.array([[1, 2, 3, 4]], dtype=jnp.int32)
+    out = llama.generate(params, prompt, config, max_new_tokens=6)
+    assert out.shape == (1, 6)
+    assert out.dtype == jnp.int32
+    # greedy decode is deterministic
+    out2 = llama.generate(params, prompt, config, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+def test_llama_sharded_train_step():
+    """Full train step jitted over a dp×sp×tp mesh executes and learns."""
+    mesh = create_mesh(dp=2, tp=2, sp=2)
+    config = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), config)
+    train_step, optimizer = llama.make_train_step(config, mesh)
+    opt_state = optimizer.init(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 255)
+
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss = train_step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]  # memorizing one batch must reduce loss
+
+
+def test_llama_forward_with_sp_mesh():
+    """Prefill through ring attention on a sequence-parallel mesh."""
+    mesh = create_mesh(dp=1, tp=2, sp=4)
+    config = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), config)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, 255)
+    plain = llama.forward(params, tokens, config, mesh=None)
+    ringed = llama.forward(params, tokens, config, mesh=mesh)
+    np.testing.assert_allclose(
+        np.asarray(ringed), np.asarray(plain), rtol=5e-2, atol=5e-2
+    )

@@ -1,0 +1,415 @@
+"""perf harness tests — hermetic (mock backend, the reference's tier-1
+strategy) plus a live end-to-end CLI run against the in-repo server."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from client_tpu.perf.backend import MockPerfBackend, PerfInferInput
+from client_tpu.perf.data import DataLoader
+from client_tpu.perf.load_manager import (
+    ConcurrencyManager,
+    PeriodicConcurrencyManager,
+    RequestRateManager,
+)
+from client_tpu.perf.profiler import InferenceProfiler
+from client_tpu.perf.records import RequestRecord, compute_window_status, percentile
+from client_tpu.perf.sequence import SequenceManager
+from client_tpu.utils import InferenceServerException
+
+META = {
+    "name": "mock",
+    "inputs": [{"name": "IN", "datatype": "FP32", "shape": [8]}],
+    "outputs": [{"name": "OUT", "datatype": "FP32", "shape": [8]}],
+}
+
+
+def make_loader():
+    loader = DataLoader(META)
+    loader.generate_synthetic()
+    return loader
+
+
+# ---------------------------------------------------------------------------
+# records / stats
+# ---------------------------------------------------------------------------
+
+
+def test_percentile_nearest_rank():
+    values = sorted([10.0, 20.0, 30.0, 40.0, 50.0, 60.0, 70.0, 80.0, 90.0, 100.0])
+    assert percentile(values, 50) == 50.0
+    assert percentile(values, 90) == 90.0
+    assert percentile(values, 99) == 100.0
+
+
+def test_compute_window_status():
+    records = [
+        RequestRecord(start_ns=0, end_ns=1_000_000, response_ns=[1_000_000]),
+        RequestRecord(start_ns=0, end_ns=2_000_000, response_ns=[2_000_000]),
+        RequestRecord(start_ns=0, end_ns=3_000_000, success=False),
+    ]
+    status = compute_window_status(records, 0, 1_000_000_000)
+    assert status.request_count == 2
+    assert status.error_count == 1
+    assert status.throughput == pytest.approx(2.0)
+    assert status.avg_latency_us == pytest.approx(1500.0)
+
+
+# ---------------------------------------------------------------------------
+# data loader
+# ---------------------------------------------------------------------------
+
+
+def test_dataloader_synthetic():
+    loader = make_loader()
+    inputs = loader.get_inputs()
+    assert len(inputs) == 1
+    assert inputs[0].name == "IN"
+    assert inputs[0].data.shape == (8,)
+    assert inputs[0].data.dtype == np.float32
+
+
+def test_dataloader_batched_shape():
+    meta = {
+        "name": "m",
+        "inputs": [{"name": "IN", "datatype": "INT32", "shape": [-1, 16]}],
+        "outputs": [],
+    }
+    loader = DataLoader(meta, batch_size=4, batched=True)
+    loader.generate_synthetic()
+    assert loader.get_inputs()[0].data.shape == (4, 16)
+
+
+def test_dataloader_shape_override():
+    meta = {
+        "name": "m",
+        "inputs": [{"name": "IN", "datatype": "FP32", "shape": [-1]}],
+        "outputs": [],
+    }
+    loader = DataLoader(meta)
+    with pytest.raises(InferenceServerException, match="dynamic shape"):
+        loader.generate_synthetic()
+    loader = DataLoader(meta, shape_overrides={"IN": [32]})
+    loader.generate_synthetic()
+    assert loader.get_inputs()[0].data.shape == (32,)
+
+
+def test_dataloader_json(tmp_path):
+    path = tmp_path / "data.json"
+    path.write_text(
+        json.dumps(
+            {
+                "data": [
+                    {"IN": [1.0] * 8},
+                    {"IN": {"content": [2.0] * 8, "shape": [8]}},
+                ]
+            }
+        )
+    )
+    loader = make_loader()
+    loader.read_from_json(str(path))
+    assert loader.stream_count == 1
+    assert loader.step_count(0) == 2
+    np.testing.assert_array_equal(
+        loader.get_inputs(0, 0)[0].data, np.ones(8, dtype=np.float32)
+    )
+    np.testing.assert_array_equal(
+        loader.get_inputs(0, 1)[0].data, np.full(8, 2.0, dtype=np.float32)
+    )
+
+
+def test_dataloader_json_multistream(tmp_path):
+    path = tmp_path / "data.json"
+    path.write_text(
+        json.dumps(
+            {
+                "data": [
+                    [{"IN": [1.0] * 8}, {"IN": [2.0] * 8}],
+                    [{"IN": [3.0] * 8}],
+                ]
+            }
+        )
+    )
+    loader = make_loader()
+    loader.read_from_json(str(path))
+    assert loader.stream_count == 2
+    assert loader.step_count(0) == 2
+    assert loader.step_count(1) == 1
+
+
+def test_dataloader_json_b64(tmp_path):
+    import base64
+
+    payload = np.arange(8, dtype=np.float32)
+    path = tmp_path / "data.json"
+    path.write_text(
+        json.dumps(
+            {
+                "data": [
+                    {
+                        "IN": {
+                            "b64": base64.b64encode(payload.tobytes()).decode(),
+                            "shape": [8],
+                        }
+                    }
+                ]
+            }
+        )
+    )
+    loader = make_loader()
+    loader.read_from_json(str(path))
+    np.testing.assert_array_equal(loader.get_inputs()[0].data, payload)
+
+
+# ---------------------------------------------------------------------------
+# sequence manager
+# ---------------------------------------------------------------------------
+
+
+def test_sequence_manager_flags():
+    manager = SequenceManager(length_mean=3, length_variation_pct=0)
+    first = manager.next_step(0)
+    assert first["sequence_start"] and not first["sequence_end"]
+    mid = manager.next_step(0)
+    assert not mid["sequence_start"] and not mid["sequence_end"]
+    last = manager.next_step(0)
+    assert last["sequence_end"]
+    fresh = manager.next_step(0)
+    assert fresh["sequence_start"]
+    assert fresh["sequence_id"] != first["sequence_id"]
+
+
+def test_sequence_manager_unique_ids_across_slots():
+    manager = SequenceManager(length_mean=2, length_variation_pct=0)
+    ids = {manager.next_step(slot)["sequence_id"] for slot in range(8)}
+    assert len(ids) == 8
+
+
+# ---------------------------------------------------------------------------
+# load managers (mock backend)
+# ---------------------------------------------------------------------------
+
+
+def test_concurrency_manager_maintains_inflight():
+    async def run():
+        backend = MockPerfBackend(latency_s=0.02)
+        manager = ConcurrencyManager(backend, "mock", make_loader())
+        await manager.change_concurrency(8)
+        await asyncio.sleep(0.3)
+        await manager.stop()
+        return backend
+
+    backend = asyncio.run(run())
+    assert backend.max_inflight == 8
+    assert backend.request_count >= 8
+
+
+def test_concurrency_manager_reconfigure():
+    async def run():
+        backend = MockPerfBackend(latency_s=0.01)
+        manager = ConcurrencyManager(backend, "mock", make_loader())
+        await manager.change_concurrency(4)
+        await asyncio.sleep(0.1)
+        await manager.change_concurrency(1)
+        backend.max_inflight = 0
+        await asyncio.sleep(0.15)
+        await manager.stop()
+        return backend
+
+    backend = asyncio.run(run())
+    assert backend.max_inflight <= 2  # shrunk pool
+
+
+def test_request_rate_manager_hits_rate():
+    async def run():
+        backend = MockPerfBackend(latency_s=0.001)
+        manager = RequestRateManager(backend, "mock", make_loader())
+        await manager.change_rate(200.0)
+        await asyncio.sleep(1.0)
+        await manager.stop()
+        return manager
+
+    manager = asyncio.run(run())
+    achieved = len(manager.records)
+    assert 150 <= achieved <= 260, f"rate off: {achieved} in 1s"
+
+
+def test_request_rate_poisson():
+    async def run():
+        backend = MockPerfBackend(latency_s=0.0005)
+        manager = RequestRateManager(
+            backend, "mock", make_loader(), distribution="poisson"
+        )
+        await manager.change_rate(300.0)
+        await asyncio.sleep(1.0)
+        await manager.stop()
+        return manager
+
+    manager = asyncio.run(run())
+    count = len(manager.records)
+    assert 200 <= count <= 420
+    # poisson intervals: variance of inter-arrival should be non-trivial
+    starts = sorted(r.start_ns for r in manager.records)
+    gaps = np.diff(starts) / 1e9
+    assert gaps.std() > 0.2 * gaps.mean()
+
+
+def test_errors_recorded():
+    async def run():
+        backend = MockPerfBackend(latency_s=0.001, error_every=3)
+        manager = ConcurrencyManager(backend, "mock", make_loader())
+        await manager.change_concurrency(2)
+        await asyncio.sleep(0.3)
+        await manager.stop()
+        return manager
+
+    manager = asyncio.run(run())
+    errors = [r for r in manager.records if not r.success]
+    assert errors
+    assert "mock injected failure" in errors[0].error
+
+
+def test_streaming_records_multiple_responses():
+    async def run():
+        backend = MockPerfBackend(latency_s=0.01, responses_per_request=5)
+        manager = ConcurrencyManager(
+            backend, "mock", make_loader(), streaming=True
+        )
+        await manager.change_concurrency(1)
+        await asyncio.sleep(0.25)
+        await manager.stop()
+        return manager
+
+    manager = asyncio.run(run())
+    done = [r for r in manager.records if r.success and r.response_ns]
+    assert done
+    assert len(done[0].response_ns) == 5
+
+
+def test_periodic_concurrency_ramp():
+    async def run():
+        backend = MockPerfBackend(latency_s=0.005)
+        manager = PeriodicConcurrencyManager(
+            backend,
+            "mock",
+            make_loader(),
+            start=1,
+            end=4,
+            step=1,
+            request_period=5,
+        )
+        await manager.run()
+        return backend, manager
+
+    backend, manager = asyncio.run(run())
+    assert backend.max_inflight >= 3
+    assert len(manager.records) >= 20  # 4 periods of >=5 requests
+
+
+# ---------------------------------------------------------------------------
+# profiler
+# ---------------------------------------------------------------------------
+
+
+def test_profiler_stability_and_sweep():
+    async def run():
+        backend = MockPerfBackend(latency_s=0.002)
+        manager = ConcurrencyManager(backend, "mock", make_loader())
+        profiler = InferenceProfiler(
+            manager,
+            measurement_interval_s=0.2,
+            stability_pct=50.0,
+            max_trials=6,
+        )
+        return await profiler.profile_concurrency_range(1, 2, 1)
+
+    experiments = asyncio.run(run())
+    assert len(experiments) == 2
+    assert experiments[0].status.concurrency == 1
+    assert experiments[0].status.throughput > 100
+    assert experiments[1].status.throughput > experiments[0].status.throughput
+    # latency percentiles populated
+    assert 50 in experiments[0].status.latency_percentiles_us
+
+
+def test_profiler_latency_threshold_stops_sweep():
+    async def run():
+        backend = MockPerfBackend(latency_s=0.02)
+        manager = ConcurrencyManager(backend, "mock", make_loader())
+        profiler = InferenceProfiler(
+            manager,
+            measurement_interval_s=0.15,
+            stability_pct=80.0,
+            max_trials=4,
+            latency_threshold_us=1000.0,  # 1ms < 20ms mock latency
+        )
+        return await profiler.profile_concurrency_range(1, 8, 1)
+
+    experiments = asyncio.run(run())
+    assert len(experiments) == 1  # stopped after the first point
+
+
+def test_report_writers(tmp_path):
+    from client_tpu.perf.report import console_report, export_profile, write_csv
+
+    async def run():
+        backend = MockPerfBackend(latency_s=0.002)
+        manager = ConcurrencyManager(backend, "mock", make_loader())
+        profiler = InferenceProfiler(
+            manager, measurement_interval_s=0.15, stability_pct=60.0,
+            max_trials=5,
+        )
+        return await profiler.profile_concurrency_range(1, 1)
+
+    experiments = asyncio.run(run())
+    text = console_report(experiments)
+    assert "infer/sec" in text
+
+    csv_path = tmp_path / "report.csv"
+    write_csv(experiments, str(csv_path))
+    lines = csv_path.read_text().strip().splitlines()
+    assert lines[0].startswith("Concurrency,Inferences/Second")
+    assert len(lines) == 2
+
+    export_path = tmp_path / "profile.json"
+    export_profile(experiments, str(export_path))
+    doc = json.loads(export_path.read_text())
+    assert doc["experiments"][0]["requests"]
+    first = doc["experiments"][0]["requests"][0]
+    assert "timestamp" in first and "response_timestamps" in first
+
+
+# ---------------------------------------------------------------------------
+# CLI end-to-end against the in-repo server
+# ---------------------------------------------------------------------------
+
+
+def test_cli_end_to_end(tmp_path, capsys):
+    from client_tpu.perf.cli import main
+    from client_tpu.testing import InProcessServer
+
+    with InProcessServer(grpc=False) as server:
+        csv_path = tmp_path / "out.csv"
+        export_path = tmp_path / "profile.json"
+        code = main(
+            [
+                "-m", "simple",
+                "-u", server.http_url,
+                "-i", "http",
+                "--concurrency-range", "2",
+                "--measurement-interval", "300",
+                "--stability-percentage", "60",
+                "--max-trials", "5",
+                "-f", str(csv_path),
+                "--profile-export-file", str(export_path),
+                "--json-summary",
+            ]
+        )
+    assert code == 0
+    out = capsys.readouterr().out
+    assert "Throughput" in out
+    summary = json.loads(out.strip().splitlines()[-1])
+    assert summary["throughput"] > 10
+    assert csv_path.exists() and export_path.exists()

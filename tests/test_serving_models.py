@@ -1,0 +1,117 @@
+"""Integration tests: model-zoo serving adapters over the wire.
+
+The LLM decode model streams real KV-cache decode tokens (the genai-perf
+target); the image classifier exercises the classification extension.
+"""
+
+import queue
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+from client_tpu.server.core import ServerCore
+from client_tpu.server.model_repository import ModelRepository
+from client_tpu.testing import InProcessServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    from client_tpu.models.serving import register_zoo_models
+
+    repository = ModelRepository()
+    core = ServerCore(repository)
+    register_zoo_models(repository, small=True)
+    with InProcessServer(core=core, http=False, builtin_models=False) as s:
+        yield s
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    with grpcclient.InferenceServerClient(server.grpc_url) as c:
+        yield c
+
+
+def test_llm_decode_streams_tokens(client):
+    config = client.get_model_config("llm_decode")
+    assert config.config.model_transaction_policy.decoupled
+
+    results: "queue.Queue" = queue.Queue()
+    client.start_stream(callback=lambda r, e: results.put((r, e)))
+    try:
+        prompt = np.array([1, 2, 3, 4, 5, 6, 7, 8], dtype=np.int32)
+        inp = grpcclient.InferInput(
+            "INPUT_IDS", [8], "INT32"
+        ).set_data_from_numpy(prompt)
+        client.async_stream_infer(
+            "llm_decode", [inp], parameters={"max_tokens": 5}
+        )
+        tokens = []
+        for _ in range(5):
+            result, error = results.get(timeout=60)
+            assert error is None
+            tokens.append(int(result.as_numpy("OUTPUT_IDS")[0]))
+        assert len(tokens) == 5
+        assert all(0 <= t < 256 for t in tokens)
+        final = result.get_response().parameters
+        assert final["triton_final_response"].bool_param
+
+        # greedy decode is deterministic: same prompt -> same tokens
+        client.async_stream_infer(
+            "llm_decode", [inp], parameters={"max_tokens": 5}
+        )
+        tokens2 = []
+        for _ in range(5):
+            result, error = results.get(timeout=60)
+            assert error is None
+            tokens2.append(int(result.as_numpy("OUTPUT_IDS")[0]))
+        assert tokens == tokens2
+    finally:
+        client.stop_stream()
+
+
+def test_llm_decode_rejects_overlong(client):
+    results: "queue.Queue" = queue.Queue()
+    client.start_stream(callback=lambda r, e: results.put((r, e)))
+    try:
+        prompt = np.zeros([600], dtype=np.int32)
+        inp = grpcclient.InferInput(
+            "INPUT_IDS", [600], "INT32"
+        ).set_data_from_numpy(prompt)
+        client.async_stream_infer("llm_decode", [inp])
+        result, error = results.get(timeout=60)
+        assert result is None
+        assert "exceeds" in error.message()
+    finally:
+        client.stop_stream()
+
+
+def test_image_classifier(client):
+    meta = client.get_model_metadata("image_classifier", as_json=True)
+    shape = [int(s) for s in meta["inputs"][0]["shape"]]
+    assert shape == [-1, 64, 64, 3]
+
+    image = np.random.rand(1, 64, 64, 3).astype(np.float32)
+    inp = grpcclient.InferInput(
+        "INPUT", [1, 64, 64, 3], "FP32"
+    ).set_data_from_numpy(image)
+    result = client.infer("image_classifier", [inp])
+    logits = result.as_numpy("OUTPUT")
+    assert logits.shape == (1, 1000)
+    assert np.isfinite(logits).all()
+
+
+def test_image_classifier_classification_extension(client):
+    image = np.random.rand(1, 64, 64, 3).astype(np.float32)
+    inp = grpcclient.InferInput(
+        "INPUT", [1, 64, 64, 3], "FP32"
+    ).set_data_from_numpy(image)
+    out = grpcclient.InferRequestedOutput("OUTPUT", class_count=3)
+    result = client.infer("image_classifier", [inp], outputs=[out])
+    classes = result.as_numpy("OUTPUT")
+    assert classes.shape == (1, 3)
+    # entries are "value:index" strings, ordered by descending score
+    first = classes[0, 0].decode("utf-8").split(":")
+    assert len(first) >= 2
+    values = [float(c.decode().split(":")[0]) for c in classes[0]]
+    assert values == sorted(values, reverse=True)
