@@ -1,0 +1,15 @@
+"""genai-perf-tpu: LLM benchmark front-end over the perf harness.
+
+The L5 layer of SURVEY.md §1 (reference
+src/c++/perf_analyzer/genai-perf/): synthesizes LLM input corpora, drives
+the perf harness in streaming mode against a decoupled decode model, and
+reduces the profile export to LLM metrics — time-to-first-token,
+inter-token latency, output-token throughput, request throughput — with
+avg/percentile statistics and console/CSV/JSON reporting.
+"""
+
+from client_tpu.genai_perf.metrics import (  # noqa: F401
+    LLMMetrics,
+    LLMProfileDataParser,
+    Statistics,
+)

@@ -1,0 +1,75 @@
+"""LLM input-corpus generation.
+
+The reference's llm_inputs (reference genai-perf llm_inputs/llm_inputs.py:
+29-360 + synthetic_prompt_generator.py): synthetic prompts with a target
+token-count distribution, emitted as a perf-harness --input-data JSON file.
+Output formats:
+
+- ``kserve-ids``: token-id tensors for the in-repo ``llm_decode`` model
+  (INPUT_IDS int32) — the TPU-native path, no tokenizer round trip on the
+  server;
+- ``kserve-text``: BYTES prompt tensors for text-input models.
+"""
+
+import json
+import random
+from typing import Dict, List, Optional
+
+from client_tpu.genai_perf.tokenizer import SyntheticTokenizer
+
+# A small word bank for synthetic prose (stand-in for the reference's
+# Shakespeare-derived corpus).
+_WORDS = (
+    "the quick brown fox jumps over lazy dog while measuring inference "
+    "latency throughput tokens streaming benchmark context parallel mesh "
+    "tensor shard pipeline decode prefill attention cache memory bandwidth "
+    "systolic array compiler fusion kernel schedule window stability"
+).split()
+
+
+def synthesize_prompt(
+    rng: random.Random, mean_tokens: int, stddev_tokens: float
+) -> str:
+    count = max(1, int(rng.gauss(mean_tokens, stddev_tokens)))
+    return " ".join(rng.choice(_WORDS) for _ in range(count))
+
+
+def create_llm_inputs(
+    path: str,
+    num_prompts: int = 100,
+    input_tokens_mean: int = 128,
+    input_tokens_stddev: float = 0.0,
+    output_tokens_mean: int = 32,
+    output_tokens_stddev: float = 0.0,
+    output_format: str = "kserve-ids",
+    input_name: str = "INPUT_IDS",
+    tokenizer=None,
+    seed: int = 0,
+) -> Dict:
+    """Write a perf-harness input-data JSON of synthetic LLM requests.
+
+    Returns the generated document (also written to ``path``).
+    """
+    rng = random.Random(seed)
+    tokenizer = tokenizer or SyntheticTokenizer()
+    entries: List[Dict] = []
+    for _ in range(num_prompts):
+        prompt = synthesize_prompt(rng, input_tokens_mean, input_tokens_stddev)
+        if output_format == "kserve-ids":
+            # length follows the sampled distribution — no clipping to the
+            # mean, or above-mean prefill lengths would never occur
+            ids = tokenizer.encode(prompt)
+            if not ids:
+                ids = [1]
+            entries.append({input_name: {"content": ids, "shape": [len(ids)]}})
+        elif output_format == "kserve-text":
+            entries.append(
+                {input_name: {"content": [prompt], "shape": [1]}}
+            )
+        else:
+            raise ValueError(f"unknown output format '{output_format}'")
+    doc = {"data": entries}
+    if path:
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    return doc

@@ -1,0 +1,146 @@
+"""genai-perf-tpu CLI.
+
+Reference parity: the ``profile`` flow of genai-perf
+(reference genai-perf main.py + parser.py + wrapper.py) — synthesize LLM
+inputs, drive the perf harness in streaming mode, parse the profile export
+into LLM metrics, and report. Runs the harness in-process rather than
+subprocess-forking a binary (the wrapper builds the same CLI argument list
+the reference would, reference wrapper.py:53-121).
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="genai-perf-tpu", description="Benchmark LLM serving (KServe v2)."
+    )
+    parser.add_argument("-m", "--model", required=True)
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument(
+        "--service-kind",
+        default="triton",
+        choices=["triton"],
+        help="backend service flavor",
+    )
+    parser.add_argument(
+        "--endpoint-type",
+        default="kserve-ids",
+        choices=["kserve-ids", "kserve-text"],
+        help="input tensor flavor (token ids vs text prompts)",
+    )
+    parser.add_argument("--input-name", default="INPUT_IDS")
+    parser.add_argument("--num-prompts", type=int, default=50)
+    parser.add_argument("--synthetic-input-tokens-mean", type=int, default=64)
+    parser.add_argument(
+        "--synthetic-input-tokens-stddev", type=float, default=0.0
+    )
+    parser.add_argument("--output-tokens-mean", type=int, default=16)
+    parser.add_argument("--output-tokens-stddev", type=float, default=0.0)
+    parser.add_argument("--tokenizer", default="synthetic")
+    parser.add_argument("--concurrency", type=int, default=1)
+    parser.add_argument("--request-rate", type=float, default=None)
+    parser.add_argument("--measurement-interval", "-p", type=int, default=4000)
+    parser.add_argument("--stability-percentage", type=float, default=50.0)
+    parser.add_argument("--max-trials", type=int, default=6)
+    parser.add_argument(
+        "--streaming",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="use decoupled streaming (--no-streaming for unary models)",
+    )
+    parser.add_argument(
+        "--artifact-dir", default=None, help="output directory"
+    )
+    parser.add_argument(
+        "--profile-export-file", default="profile_export.json"
+    )
+    parser.add_argument(
+        "--generate-plots", action="store_true",
+        help="write latency/throughput plots (matplotlib if available)",
+    )
+    parser.add_argument("-v", "--verbose", action="store_true")
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from client_tpu.genai_perf.inputs import create_llm_inputs
+    from client_tpu.genai_perf.metrics import (
+        LLMProfileDataParser,
+        console_table,
+        export_csv,
+        export_json,
+    )
+    from client_tpu.genai_perf.tokenizer import get_tokenizer
+    from client_tpu.perf import cli as perf_cli
+
+    args = build_parser().parse_args(argv)
+    artifact_dir = args.artifact_dir or tempfile.mkdtemp(prefix="genai_perf_")
+    os.makedirs(artifact_dir, exist_ok=True)
+    inputs_path = os.path.join(artifact_dir, "llm_inputs.json")
+    export_path = os.path.join(artifact_dir, args.profile_export_file)
+
+    tokenizer = get_tokenizer(args.tokenizer)
+    create_llm_inputs(
+        inputs_path,
+        num_prompts=args.num_prompts,
+        input_tokens_mean=args.synthetic_input_tokens_mean,
+        input_tokens_stddev=args.synthetic_input_tokens_stddev,
+        output_tokens_mean=args.output_tokens_mean,
+        output_tokens_stddev=args.output_tokens_stddev,
+        output_format=args.endpoint_type,
+        input_name=args.input_name,
+        tokenizer=tokenizer,
+    )
+
+    # Build the perf-harness invocation (reference wrapper.Profiler role).
+    perf_args = [
+        "-m", args.model,
+        "-u", args.url,
+        "-i", "grpc",
+        "--input-data", inputs_path,
+        "--measurement-interval", str(args.measurement_interval),
+        "--stability-percentage", str(args.stability_percentage),
+        "--max-trials", str(args.max_trials),
+        "--profile-export-file", export_path,
+    ]
+    if args.streaming:
+        perf_args.append("--streaming")
+    if args.output_tokens_mean:
+        perf_args += [
+            "--request-parameter",
+            f"max_tokens:{args.output_tokens_mean}:int",
+        ]
+    if args.request_rate is not None:
+        perf_args += ["--request-rate-range", str(args.request_rate)]
+    else:
+        perf_args += ["--concurrency-range", str(args.concurrency)]
+    if args.verbose:
+        perf_args.append("--verbose")
+
+    code = perf_cli.main(perf_args)
+    if code != 0:
+        return code
+
+    metrics = LLMProfileDataParser(export_path).parse()
+    print()
+    print(console_table(metrics))
+    export_csv(metrics, os.path.join(artifact_dir, "llm_metrics.csv"))
+    export_json(metrics, os.path.join(artifact_dir, "llm_metrics.json"))
+    print(f"\nartifacts: {artifact_dir}")
+    if args.generate_plots:
+        try:
+            from client_tpu.genai_perf.plots import generate_plots
+
+            generate_plots(export_path, artifact_dir)
+        except Exception as e:  # noqa: BLE001 - plots are optional
+            print(f"plot generation skipped: {e}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

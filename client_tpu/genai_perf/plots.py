@@ -1,0 +1,48 @@
+"""Optional plots for genai-perf runs (reference genai-perf plots/).
+
+Uses matplotlib when available; writes TTFT distribution and per-request
+token-timeline scatter to the artifact directory.
+"""
+
+import json
+import os
+
+
+def generate_plots(profile_export_path: str, artifact_dir: str) -> None:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    with open(profile_export_path) as f:
+        doc = json.load(f)
+    experiments = doc.get("experiments", [])
+    if not experiments:
+        return
+    requests = experiments[0].get("requests", [])
+    ttfts = [
+        (r["response_timestamps"][0] - r["timestamp"]) / 1e6
+        for r in requests
+        if r.get("response_timestamps")
+    ]
+    if ttfts:
+        fig, ax = plt.subplots(figsize=(6, 4))
+        ax.hist(ttfts, bins=30)
+        ax.set_xlabel("time to first token (ms)")
+        ax.set_ylabel("requests")
+        ax.set_title("TTFT distribution")
+        fig.tight_layout()
+        fig.savefig(os.path.join(artifact_dir, "ttft_distribution.png"))
+        plt.close(fig)
+
+    fig, ax = plt.subplots(figsize=(8, 4))
+    base = min(r["timestamp"] for r in requests) if requests else 0
+    for i, r in enumerate(requests[:100]):
+        xs = [(t - base) / 1e9 for t in r.get("response_timestamps", [])]
+        ax.scatter(xs, [i] * len(xs), s=2)
+    ax.set_xlabel("time (s)")
+    ax.set_ylabel("request #")
+    ax.set_title("token arrival timeline")
+    fig.tight_layout()
+    fig.savefig(os.path.join(artifact_dir, "token_timeline.png"))
+    plt.close(fig)

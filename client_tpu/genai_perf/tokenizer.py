@@ -1,0 +1,50 @@
+"""Tokenizer abstraction for genai-perf.
+
+The reference wraps HF AutoTokenizer (reference genai-perf tokenizer.py:
+1-49). Here a HF tokenizer is used when one is available locally, with a
+hashing fallback tokenizer for hermetic/zero-egress environments (the
+in-repo decode model consumes raw token ids, so the tokenizer's job is
+synthetic-prompt token accounting, not fidelity).
+"""
+
+from typing import List, Optional
+
+DEFAULT_TOKENIZER = "hf-internal-testing/llama-tokenizer"
+
+
+class SyntheticTokenizer:
+    """Deterministic word-hash tokenizer: 1 word -> 1 token id.
+
+    Uses crc32 rather than ``hash()`` so ids are stable across interpreter
+    processes (PYTHONHASHSEED randomizes str hashing) — input corpora must
+    be reproducible run-to-run.
+    """
+
+    def __init__(self, vocab_size: int = 32000):
+        self.vocab_size = vocab_size
+
+    def encode(self, text: str) -> List[int]:
+        import zlib
+
+        return [
+            (zlib.crc32(word.encode("utf-8")) % (self.vocab_size - 2)) + 2
+            for word in text.split()
+        ]
+
+    def decode(self, ids) -> str:
+        return " ".join(f"tok{i}" for i in ids)
+
+    def __call__(self, text: str):
+        return {"input_ids": self.encode(text)}
+
+
+def get_tokenizer(name: Optional[str] = None, vocab_size: int = 32000):
+    """Load a HF tokenizer if possible, else the synthetic fallback."""
+    if name in (None, "", "synthetic"):
+        return SyntheticTokenizer(vocab_size)
+    try:
+        from transformers import AutoTokenizer
+
+        return AutoTokenizer.from_pretrained(name, local_files_only=True)
+    except Exception:  # noqa: BLE001 - offline environments
+        return SyntheticTokenizer(vocab_size)

@@ -117,11 +117,56 @@ def build_parser() -> argparse.ArgumentParser:
         help="requests to discard before measuring",
     )
     parser.add_argument(
+        "--request-parameter",
+        action="append",
+        default=[],
+        help="name:value:type custom request parameter "
+        "(type: int|float|bool|string)",
+    )
+    parser.add_argument(
         "--json-summary",
         action="store_true",
         help="print a one-line JSON summary (bench integration)",
     )
     return parser
+
+
+def _cast_bool(value: str) -> bool:
+    lowered = value.lower()
+    if lowered in ("1", "true", "yes"):
+        return True
+    if lowered in ("0", "false", "no"):
+        return False
+    raise ValueError(f"not a boolean: '{value}'")
+
+
+_PARAM_CASTS = {
+    "int": int,
+    "float": float,
+    "bool": _cast_bool,
+    "string": str,
+}
+
+
+def parse_request_parameters(specs):
+    parameters = {}
+    for spec in specs:
+        # name:value:type — the value may itself contain colons (URLs,
+        # timestamps), so peel name from the front and type from the back
+        name, _, rest = spec.partition(":")
+        value, _, kind = rest.rpartition(":")
+        if not name or not kind or kind not in _PARAM_CASTS:
+            raise ValueError(
+                f"bad --request-parameter '{spec}' (want name:value:type, "
+                "type in int|float|bool|string)"
+            )
+        try:
+            parameters[name] = _PARAM_CASTS[kind](value)
+        except ValueError as e:
+            raise ValueError(
+                f"bad --request-parameter '{spec}': {e}"
+            ) from None
+    return parameters
 
 
 async def run(args) -> int:
@@ -189,12 +234,21 @@ async def run(args) -> int:
         if args.percentile and args.percentile not in percentiles:
             percentiles = tuple(sorted(set(percentiles) | {args.percentile}))
 
+        try:
+            request_parameters = parse_request_parameters(
+                args.request_parameter
+            )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
         common = dict(
             model_name=args.model_name,
             model_version=args.model_version,
             data_loader=loader,
             streaming=args.streaming,
             sequence_manager=sequence_manager,
+            parameters=request_parameters or None,
         )
 
         latency_threshold_us = (

@@ -40,9 +40,7 @@ class LoadManager:
         self.sequences = sequence_manager
         self.parameters = parameters
         self.records: List[RequestRecord] = []
-        self._records_lock = asyncio.Lock()
         self._request_counter = itertools.count()
-        self._idle_ns = 0  # accumulated worker idle time (rate mode)
         self._tasks: List[asyncio.Task] = []
         self._stopping = False
 
@@ -235,7 +233,6 @@ class RequestRateManager(LoadManager):
                 await asyncio.sleep(delay)
             else:
                 self.schedule_slip_ns += int(-delay * 1e9)
-                self._idle_ns = 0
             task = asyncio.ensure_future(self.issue_one(stream, step, slot=slot))
             self._inflight.add(task)
             task.add_done_callback(self._inflight.discard)

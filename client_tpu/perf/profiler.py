@@ -165,6 +165,9 @@ class InferenceProfiler:
             await asyncio.sleep(self.warmup_s)
             self.manager.swap_records()
         if self.warmup_requests > 0:
+            # drop records drained from the previous sweep point so the
+            # warm-up counts only requests at the new load level
+            self.manager.swap_records()
             while len(self.manager.records) < self.warmup_requests:
                 await asyncio.sleep(0.01)
                 self.manager.check_health()

@@ -1,0 +1,149 @@
+"""genai-perf tests: metrics math (hermetic) + full CLI e2e against the
+in-repo llm_decode model (reference genai-perf test suite role)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from client_tpu.genai_perf.inputs import create_llm_inputs
+from client_tpu.genai_perf.metrics import (
+    LLMMetrics,
+    LLMProfileDataParser,
+    Statistics,
+    console_table,
+    export_csv,
+    export_json,
+)
+from client_tpu.genai_perf.tokenizer import SyntheticTokenizer, get_tokenizer
+
+
+def test_statistics():
+    s = Statistics.from_samples([1.0, 2.0, 3.0, 4.0, 5.0])
+    assert s.avg == 3.0
+    assert s.min == 1.0 and s.max == 5.0
+    assert s.p50 == 3.0
+    assert s.count == 5
+    empty = Statistics.from_samples([])
+    assert empty.count == 0
+
+
+def test_tokenizer_fallback():
+    tok = get_tokenizer("synthetic")
+    ids = tok.encode("hello world hello")
+    assert len(ids) == 3
+    assert ids[0] == ids[2]  # deterministic per word
+    # unknown HF model in an offline env falls back cleanly
+    tok2 = get_tokenizer("definitely/not-a-local-model")
+    assert isinstance(tok2, SyntheticTokenizer)
+
+
+def test_create_llm_inputs(tmp_path):
+    path = tmp_path / "inputs.json"
+    doc = create_llm_inputs(
+        str(path),
+        num_prompts=10,
+        input_tokens_mean=16,
+        output_format="kserve-ids",
+    )
+    assert len(doc["data"]) == 10
+    entry = doc["data"][0]["INPUT_IDS"]
+    assert entry["shape"] == [len(entry["content"])]
+    assert all(isinstance(i, int) for i in entry["content"])
+    on_disk = json.loads(path.read_text())
+    assert on_disk == doc
+
+
+def test_create_llm_inputs_text(tmp_path):
+    doc = create_llm_inputs(
+        "", num_prompts=3, input_tokens_mean=8, output_format="kserve-text",
+        input_name="PROMPT",
+    )
+    entry = doc["data"][0]["PROMPT"]
+    assert isinstance(entry["content"][0], str)
+    assert len(entry["content"][0].split()) == 8
+
+
+def test_profile_parser(tmp_path):
+    ms = 1_000_000
+    doc = {
+        "experiments": [
+            {
+                "experiment": {"mode": "concurrency", "value": 1},
+                "requests": [
+                    {
+                        "timestamp": 0,
+                        "response_timestamps": [10 * ms, 12 * ms, 14 * ms],
+                        "success": True,
+                    },
+                    {
+                        "timestamp": 5 * ms,
+                        "response_timestamps": [20 * ms, 21 * ms],
+                        "success": True,
+                    },
+                    {"timestamp": 0, "response_timestamps": [], "success": False},
+                ],
+            }
+        ]
+    }
+    path = tmp_path / "profile.json"
+    path.write_text(json.dumps(doc))
+    metrics = LLMProfileDataParser(str(path)).parse()
+    assert metrics.request_count == 2
+    assert metrics.time_to_first_tokens == [10 * ms, 15 * ms]
+    assert metrics.output_token_counts == [3, 2]
+    assert metrics.inter_token_latencies == [2 * ms, 2 * ms, 1 * ms]
+    # duration: first start 0 -> last response 21ms
+    assert metrics.benchmark_duration_ns == 21 * ms
+    assert metrics.output_token_throughput == pytest.approx(5 / 0.021)
+    assert metrics.request_throughput == pytest.approx(2 / 0.021)
+
+    table = console_table(metrics)
+    assert "time_to_first_token" in table
+    assert "Output token throughput" in table
+
+    export_csv(metrics, str(tmp_path / "m.csv"))
+    export_json(metrics, str(tmp_path / "m.json"))
+    parsed = json.loads((tmp_path / "m.json").read_text())
+    assert parsed["request_count"] == 2
+    assert "time_to_first_token" in parsed
+
+
+def test_genai_perf_end_to_end(tmp_path, capsys):
+    """Full flow: synthetic prompts -> streaming perf run against the
+    llm_decode model -> TTFT/ITL metrics."""
+    from client_tpu.genai_perf.main import main
+    from client_tpu.models.serving import LlmDecodeModel
+    from client_tpu.server.core import ServerCore
+    from client_tpu.server.model_repository import ModelRepository
+    from client_tpu.testing import InProcessServer
+
+    repository = ModelRepository()
+    core = ServerCore(repository)
+    repository.add_model(LlmDecodeModel())
+    with InProcessServer(core=core, http=False, builtin_models=False) as server:
+        code = main(
+            [
+                "-m", "llm_decode",
+                "-u", server.grpc_url,
+                "--num-prompts", "10",
+                "--synthetic-input-tokens-mean", "12",
+                "--output-tokens-mean", "8",
+                "--concurrency", "2",
+                "--measurement-interval", "1500",
+                "--stability-percentage", "80",
+                "--max-trials", "3",
+                "--artifact-dir", str(tmp_path),
+            ]
+        )
+    assert code == 0
+    out = capsys.readouterr().out
+    assert "time_to_first_token" in out
+    assert "Output token throughput" in out
+    report = json.loads((tmp_path / "llm_metrics.json").read_text())
+    assert report["request_count"] > 0
+    # each request streams >1 token, so ITL samples must exist
+    assert report["inter_token_latency"]["count"] > 0
+    assert report["output_token_throughput_per_s"] > 0
+    assert (tmp_path / "llm_inputs.json").exists()
+    assert (tmp_path / "profile_export.json").exists()
