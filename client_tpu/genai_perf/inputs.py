@@ -61,13 +61,21 @@ def create_llm_inputs(
             ids = tokenizer.encode(prompt)
             if not ids:
                 ids = [1]
-            entries.append({input_name: {"content": ids, "shape": [len(ids)]}})
+            entry = {input_name: {"content": ids, "shape": [len(ids)]}}
         elif output_format == "kserve-text":
-            entries.append(
-                {input_name: {"content": [prompt], "shape": [1]}}
-            )
+            entry = {input_name: {"content": [prompt], "shape": [1]}}
         else:
             raise ValueError(f"unknown output format '{output_format}'")
+        if output_tokens_mean is not None:
+            # per-request sampled output length, carried as a request
+            # parameter via the input-data "parameters" key (role of the
+            # reference's per-request max_tokens embedding,
+            # reference genai-perf llm_inputs/llm_inputs.py)
+            max_tokens = max(
+                1, int(rng.gauss(output_tokens_mean, output_tokens_stddev))
+            )
+            entry["parameters"] = {"max_tokens": max_tokens}
+        entries.append(entry)
     doc = {"data": entries}
     if path:
         with open(path, "w") as f:

@@ -110,11 +110,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     ]
     if args.streaming:
         perf_args.append("--streaming")
-    if args.output_tokens_mean:
-        perf_args += [
-            "--request-parameter",
-            f"max_tokens:{args.output_tokens_mean}:int",
-        ]
+    # output lengths are embedded per request in the generated input data
+    # ("parameters" key), so no global max_tokens request parameter here
     if args.request_rate is not None:
         perf_args += ["--request-rate-range", str(args.request_rate)]
     else:

@@ -35,14 +35,20 @@ def generate_plots(profile_export_path: str, artifact_dir: str) -> None:
         fig.savefig(os.path.join(artifact_dir, "ttft_distribution.png"))
         plt.close(fig)
 
-    fig, ax = plt.subplots(figsize=(8, 4))
-    base = min(r["timestamp"] for r in requests) if requests else 0
-    for i, r in enumerate(requests[:100]):
-        xs = [(t - base) / 1e9 for t in r.get("response_timestamps", [])]
-        ax.scatter(xs, [i] * len(xs), s=2)
-    ax.set_xlabel("time (s)")
-    ax.set_ylabel("request #")
-    ax.set_title("token arrival timeline")
-    fig.tight_layout()
-    fig.savefig(os.path.join(artifact_dir, "token_timeline.png"))
-    plt.close(fig)
+    timeline = [r for r in requests if r.get("response_timestamps")]
+    if timeline:
+        shown = timeline[:100]
+        fig, ax = plt.subplots(figsize=(8, 4))
+        base = min(r["timestamp"] for r in timeline)
+        for i, r in enumerate(shown):
+            xs = [(t - base) / 1e9 for t in r["response_timestamps"]]
+            ax.scatter(xs, [i] * len(xs), s=2)
+        ax.set_xlabel("time (s)")
+        ax.set_ylabel("request #")
+        title = "token arrival timeline"
+        if len(timeline) > len(shown):
+            title += f" (first {len(shown)} of {len(timeline)} requests)"
+        ax.set_title(title)
+        fig.tight_layout()
+        fig.savefig(os.path.join(artifact_dir, "token_timeline.png"))
+        plt.close(fig)

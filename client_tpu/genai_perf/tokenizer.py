@@ -46,5 +46,13 @@ def get_tokenizer(name: Optional[str] = None, vocab_size: int = 32000):
         from transformers import AutoTokenizer
 
         return AutoTokenizer.from_pretrained(name, local_files_only=True)
-    except Exception:  # noqa: BLE001 - offline environments
+    except Exception as e:  # noqa: BLE001 - offline environments
+        import sys
+
+        print(
+            f"genai-perf: warning: could not load tokenizer '{name}' "
+            f"({e}); falling back to the synthetic tokenizer — token "
+            "counts will not match the requested tokenizer",
+            file=sys.stderr,
+        )
         return SyntheticTokenizer(vocab_size)

@@ -337,6 +337,8 @@ class MockPerfBackend(PerfBackend):
         self.request_count = 0
         self.inflight = 0
         self.max_inflight = 0
+        # per-request kwargs as issued, for assertions
+        self.requests: List[Dict[str, Any]] = []
         self._metadata = metadata or {
             "name": "mock",
             "versions": ["1"],
@@ -363,6 +365,7 @@ class MockPerfBackend(PerfBackend):
 
     async def infer(self, model_name, inputs, **kwargs):
         self.request_count += 1
+        self.requests.append(dict(kwargs, model_name=model_name))
         n = self.request_count
         self.inflight += 1
         self.max_inflight = max(self.max_inflight, self.inflight)
@@ -377,6 +380,7 @@ class MockPerfBackend(PerfBackend):
         self, model_name, inputs, on_response, **kwargs
     ):
         self.request_count += 1
+        self.requests.append(dict(kwargs, model_name=model_name))
         for _ in range(self.responses_per_request):
             await asyncio.sleep(self.latency_s / self.responses_per_request)
             on_response()

@@ -57,6 +57,8 @@ class DataLoader:
         self._rng = np.random.default_rng(seed)
         # streams[i] is a list of steps; each step maps name -> ndarray
         self._streams: List[List[Dict[str, np.ndarray]]] = []
+        # per-step request parameters, parallel to _streams (None = none)
+        self._params: List[List[Optional[Dict[str, Any]]]] = []
 
     @property
     def stream_count(self) -> int:
@@ -104,6 +106,7 @@ class DataLoader:
                 arr = self._rng.random(size=shape).astype(np_dtype)
             step[name] = arr
         self._streams = [[step]]
+        self._params = [[None]]
 
     def read_from_json(self, path: str) -> None:
         """Load the reference's --input-data JSON format.
@@ -121,19 +124,34 @@ class DataLoader:
             )
         descs = {d["name"]: d for d in self._input_descs()}
         streams: List[List[Dict[str, np.ndarray]]] = []
+        params: List[List[Optional[Dict[str, Any]]]] = []
         entries = doc["data"]
         for entry in entries:
             steps = entry if isinstance(entry, list) else [entry]
-            stream = [self._parse_step(step, descs) for step in steps]
+            stream = []
+            stream_params = []
+            for step in steps:
+                # reserved key: per-step request parameters (how genai-perf
+                # embeds per-request sampled max_tokens)
+                step_params = step.get("parameters")
+                stream.append(self._parse_step(step, descs))
+                stream_params.append(
+                    dict(step_params) if step_params else None
+                )
             streams.append(stream)
+            params.append(stream_params)
         if not isinstance(entries[0] if entries else None, list):
             # flat list of steps = a single stream (reference semantics)
             streams = [[s[0] for s in streams]]
+            params = [[p[0] for p in params]]
         self._streams = streams
+        self._params = params
 
     def _parse_step(self, step: Dict, descs: Dict) -> Dict[str, np.ndarray]:
         out: Dict[str, np.ndarray] = {}
         for name, value in step.items():
+            if name == "parameters":
+                continue
             desc = descs.get(name)
             if desc is None:
                 raise InferenceServerException(
@@ -200,3 +218,12 @@ class DataLoader:
                 )
             )
         return inputs
+
+    def get_parameters(
+        self, stream: int = 0, step: int = 0
+    ) -> Optional[Dict[str, Any]]:
+        """Per-step request parameters for (stream, step), or None."""
+        if not self._params:
+            return None
+        data = self._params[stream % len(self._params)]
+        return data[step % len(data)] if data else None

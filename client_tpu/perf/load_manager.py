@@ -62,6 +62,10 @@ class LoadManager:
                 slot if slot is not None else stream
             )
         inputs = self.data_loader.get_inputs(stream, step)
+        parameters = self.parameters
+        step_params = self.data_loader.get_parameters(stream, step)
+        if step_params:
+            parameters = {**(parameters or {}), **step_params}
         record = RequestRecord(start_ns=time.monotonic_ns(), request_id=request_id)
         try:
             if self.streaming and self.backend.supports_streaming:
@@ -74,7 +78,7 @@ class LoadManager:
                     on_response,
                     model_version=self.model_version,
                     request_id=request_id,
-                    parameters=self.parameters,
+                    parameters=parameters,
                     **seq_kwargs,
                 )
             else:
@@ -83,7 +87,7 @@ class LoadManager:
                     inputs,
                     model_version=self.model_version,
                     request_id=request_id,
-                    parameters=self.parameters,
+                    parameters=parameters,
                     **seq_kwargs,
                 )
                 record.response_ns.append(time.monotonic_ns())

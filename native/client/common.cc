@@ -1,0 +1,63 @@
+#include "common.h"
+
+namespace ctpu {
+
+int64_t DtypeByteSize(const std::string& dtype) {
+  if (dtype == "BOOL" || dtype == "INT8" || dtype == "UINT8") return 1;
+  if (dtype == "INT16" || dtype == "UINT16" || dtype == "FP16" ||
+      dtype == "BF16") {
+    return 2;
+  }
+  if (dtype == "INT32" || dtype == "UINT32" || dtype == "FP32") return 4;
+  if (dtype == "INT64" || dtype == "UINT64" || dtype == "FP64") return 8;
+  if (dtype == "BYTES") return 0;
+  return -1;
+}
+
+int64_t ShapeNumElements(const std::vector<int64_t>& shape) {
+  int64_t n = 1;
+  for (int64_t d : shape) {
+    if (d < 0) return -1;
+    n *= d;
+  }
+  return n;
+}
+
+Error InferInput::AppendFromString(const std::vector<std::string>& strings) {
+  // 4-byte little-endian length prefix per element
+  // (reference src/python/library/tritonclient/utils/__init__.py:193-246,
+  // C++ twin in reference common.cc).
+  owned_.emplace_back();
+  std::string& blob = owned_.back();
+  size_t total = 0;
+  for (const auto& s : strings) total += 4 + s.size();
+  blob.reserve(total);
+  for (const auto& s : strings) {
+    uint32_t len = static_cast<uint32_t>(s.size());
+    blob.append(reinterpret_cast<const char*>(&len), 4);
+    blob.append(s);
+  }
+  return AppendRaw(reinterpret_cast<const uint8_t*>(blob.data()), blob.size());
+}
+
+Error InferResult::StringData(const std::string& output_name,
+                              std::vector<std::string>* out) const {
+  const uint8_t* buf = nullptr;
+  size_t size = 0;
+  CTPU_RETURN_IF_ERROR(RawData(output_name, &buf, &size));
+  out->clear();
+  size_t pos = 0;
+  while (pos + 4 <= size) {
+    uint32_t len;
+    std::memcpy(&len, buf + pos, 4);
+    pos += 4;
+    if (pos + len > size) {
+      return Error("malformed BYTES tensor in output '" + output_name + "'");
+    }
+    out->emplace_back(reinterpret_cast<const char*>(buf + pos), len);
+    pos += len;
+  }
+  return Error::Success();
+}
+
+}  // namespace ctpu

@@ -1,0 +1,317 @@
+// Value types shared by the native client stack.
+//
+// Capability parity with the reference C++ client library's common layer
+// (reference src/c++/library/common.h:61-673): Error, InferStat,
+// InferOptions (sequence id/start/end, priority, timeouts), InferInput with
+// no-copy append of raw buffers and shared-memory references,
+// InferRequestedOutput (class_count, binary_data, shm), the abstract
+// InferResult, and the six-point RequestTimers used for client-side timing.
+//
+// Design departures for the TPU stack: BF16 is a first-class dtype (the
+// Python side maps it to jnp.bfloat16); there is no CUDA anywhere — the
+// device data plane is the tpu_shared_memory region protocol.
+#pragma once
+
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace ctpu {
+
+// ---------------------------------------------------------------------------
+// Error
+// ---------------------------------------------------------------------------
+
+class Error {
+ public:
+  Error() : ok_(true) {}
+  explicit Error(std::string msg) : ok_(false), msg_(std::move(msg)) {}
+
+  static Error Success() { return Error(); }
+
+  bool IsOk() const { return ok_; }
+  const std::string& Message() const { return msg_; }
+
+  explicit operator bool() const { return !ok_; }  // true when error
+
+ private:
+  bool ok_;
+  std::string msg_;
+};
+
+#define CTPU_RETURN_IF_ERROR(expr)          \
+  do {                                      \
+    ::ctpu::Error err__ = (expr);           \
+    if (!err__.IsOk()) return err__;        \
+  } while (0)
+
+// ---------------------------------------------------------------------------
+// Dtypes (KServe v2 names)
+// ---------------------------------------------------------------------------
+
+// Byte size of one element for a KServe v2 dtype name; 0 for BYTES
+// (variable length), -1 for unknown.
+int64_t DtypeByteSize(const std::string& dtype);
+
+int64_t ShapeNumElements(const std::vector<int64_t>& shape);
+
+// ---------------------------------------------------------------------------
+// Timing
+// ---------------------------------------------------------------------------
+
+// Six-point per-request timestamps (reference common.h:568-648).
+struct RequestTimers {
+  enum class Kind {
+    REQUEST_START = 0,
+    SEND_START = 1,
+    SEND_END = 2,
+    RECV_START = 3,
+    RECV_END = 4,
+    REQUEST_END = 5,
+    COUNT = 6,
+  };
+
+  uint64_t timestamps_ns[static_cast<int>(Kind::COUNT)] = {0};
+
+  void CaptureTimestamp(Kind kind) {
+    timestamps_ns[static_cast<int>(kind)] = Now();
+  }
+  uint64_t Timestamp(Kind kind) const {
+    return timestamps_ns[static_cast<int>(kind)];
+  }
+  uint64_t Duration(Kind start, Kind end) const {
+    uint64_t s = Timestamp(start), e = Timestamp(end);
+    return (s == 0 || e == 0 || e < s) ? 0 : e - s;
+  }
+  void Reset() { std::memset(timestamps_ns, 0, sizeof(timestamps_ns)); }
+
+  static uint64_t Now() {
+    return std::chrono::duration_cast<std::chrono::nanoseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  }
+};
+
+// Aggregated client-side stats (reference common.h:93-117).
+struct InferStat {
+  size_t completed_request_count = 0;
+  uint64_t cumulative_total_request_time_ns = 0;
+  uint64_t cumulative_send_time_ns = 0;
+  uint64_t cumulative_receive_time_ns = 0;
+};
+
+// ---------------------------------------------------------------------------
+// InferOptions (reference common.h:164-231)
+// ---------------------------------------------------------------------------
+
+struct InferOptions {
+  explicit InferOptions(std::string model_name)
+      : model_name(std::move(model_name)) {}
+
+  std::string model_name;
+  std::string model_version;
+  std::string request_id;
+  // 0 = not part of a sequence. String correlation ids are carried in
+  // sequence_id_str when non-empty (takes precedence).
+  uint64_t sequence_id = 0;
+  std::string sequence_id_str;
+  bool sequence_start = false;
+  bool sequence_end = false;
+  uint64_t priority = 0;
+  // Server-side timeout (microseconds), 0 = none.
+  uint64_t server_timeout_us = 0;
+  // Client-side timeout (microseconds), 0 = none.
+  uint64_t client_timeout_us = 0;
+  // Ask decoupled models to send an empty final response marker.
+  bool enable_empty_final_response = false;
+};
+
+// ---------------------------------------------------------------------------
+// InferInput (reference common.h:237-394)
+// ---------------------------------------------------------------------------
+
+class InferInput {
+ public:
+  InferInput(std::string name, std::vector<int64_t> shape, std::string dtype)
+      : name_(std::move(name)),
+        shape_(std::move(shape)),
+        datatype_(std::move(dtype)) {}
+
+  const std::string& Name() const { return name_; }
+  const std::string& Datatype() const { return datatype_; }
+  const std::vector<int64_t>& Shape() const { return shape_; }
+  Error SetShape(std::vector<int64_t> shape) {
+    shape_ = std::move(shape);
+    return Error::Success();
+  }
+
+  // No-copy append: caller keeps the buffer alive until the request
+  // completes (reference common.h:270-282 AppendRaw).
+  Error AppendRaw(const uint8_t* data, size_t size) {
+    bufs_.emplace_back(data, size);
+    total_byte_size_ += size;
+    return Error::Success();
+  }
+  Error AppendRaw(const std::vector<uint8_t>& data) {
+    return AppendRaw(data.data(), data.size());
+  }
+  // Serialize a batch of strings as 4-byte-length-prefixed BYTES elements
+  // (reference common.cc AppendFromString).
+  Error AppendFromString(const std::vector<std::string>& strings);
+
+  Error Reset() {
+    bufs_.clear();
+    total_byte_size_ = 0;
+    shm_name_.clear();
+    shm_offset_ = 0;
+    shm_byte_size_ = 0;
+    return Error::Success();
+  }
+
+  // Shared-memory reference: tensor bytes live in a pre-registered region;
+  // the request carries only (name, offset, size)
+  // (reference common.h:300-320 SetSharedMemory).
+  Error SetSharedMemory(const std::string& region_name, size_t byte_size,
+                        size_t offset = 0) {
+    bufs_.clear();
+    total_byte_size_ = 0;
+    shm_name_ = region_name;
+    shm_offset_ = offset;
+    shm_byte_size_ = byte_size;
+    return Error::Success();
+  }
+  bool IsSharedMemory() const { return !shm_name_.empty(); }
+  const std::string& SharedMemoryName() const { return shm_name_; }
+  size_t SharedMemoryOffset() const { return shm_offset_; }
+  size_t SharedMemoryByteSize() const { return shm_byte_size_; }
+
+  size_t TotalByteSize() const {
+    return IsSharedMemory() ? shm_byte_size_ : total_byte_size_;
+  }
+  const std::vector<std::pair<const uint8_t*, size_t>>& Buffers() const {
+    return bufs_;
+  }
+  // Concatenate all appended buffers (copies; used when a contiguous body
+  // is needed).
+  void ConcatenatedData(std::string* out) const {
+    out->clear();
+    out->reserve(total_byte_size_);
+    for (const auto& b : bufs_) {
+      out->append(reinterpret_cast<const char*>(b.first), b.second);
+    }
+  }
+
+ private:
+  std::string name_;
+  std::vector<int64_t> shape_;
+  std::string datatype_;
+  std::vector<std::pair<const uint8_t*, size_t>> bufs_;
+  size_t total_byte_size_ = 0;
+  // Owned storage backing AppendFromString.
+  std::vector<std::string> owned_;
+  std::string shm_name_;
+  size_t shm_offset_ = 0;
+  size_t shm_byte_size_ = 0;
+};
+
+// ---------------------------------------------------------------------------
+// InferRequestedOutput (reference common.h:400-482)
+// ---------------------------------------------------------------------------
+
+class InferRequestedOutput {
+ public:
+  explicit InferRequestedOutput(std::string name, size_t class_count = 0)
+      : name_(std::move(name)), class_count_(class_count) {}
+
+  const std::string& Name() const { return name_; }
+  size_t ClassCount() const { return class_count_; }
+
+  // Request the output over the binary extension (HTTP) — default on.
+  void SetBinaryData(bool b) { binary_data_ = b; }
+  bool BinaryData() const { return binary_data_; }
+
+  Error SetSharedMemory(const std::string& region_name, size_t byte_size,
+                        size_t offset = 0) {
+    shm_name_ = region_name;
+    shm_offset_ = offset;
+    shm_byte_size_ = byte_size;
+    return Error::Success();
+  }
+  Error UnsetSharedMemory() {
+    shm_name_.clear();
+    shm_offset_ = 0;
+    shm_byte_size_ = 0;
+    return Error::Success();
+  }
+  bool IsSharedMemory() const { return !shm_name_.empty(); }
+  const std::string& SharedMemoryName() const { return shm_name_; }
+  size_t SharedMemoryOffset() const { return shm_offset_; }
+  size_t SharedMemoryByteSize() const { return shm_byte_size_; }
+
+ private:
+  std::string name_;
+  size_t class_count_;
+  bool binary_data_ = true;
+  std::string shm_name_;
+  size_t shm_offset_ = 0;
+  size_t shm_byte_size_ = 0;
+};
+
+// ---------------------------------------------------------------------------
+// InferResult (reference common.h:488-563)
+// ---------------------------------------------------------------------------
+
+class InferResult {
+ public:
+  virtual ~InferResult() = default;
+
+  virtual Error ModelName(std::string* name) const = 0;
+  virtual Error ModelVersion(std::string* version) const = 0;
+  virtual Error Id(std::string* id) const = 0;
+  virtual Error Shape(const std::string& output_name,
+                      std::vector<int64_t>* shape) const = 0;
+  virtual Error Datatype(const std::string& output_name,
+                         std::string* datatype) const = 0;
+  // Zero-copy view into the result's buffer for a named output.
+  virtual Error RawData(const std::string& output_name, const uint8_t** buf,
+                        size_t* byte_size) const = 0;
+  virtual Error StringData(const std::string& output_name,
+                           std::vector<std::string>* out) const;
+  virtual Error RequestStatus() const = 0;
+  virtual std::string DebugString() const = 0;
+};
+
+// ---------------------------------------------------------------------------
+// Base client: shared stats plumbing (reference common.h:119-153)
+// ---------------------------------------------------------------------------
+
+class InferenceServerClient {
+ public:
+  explicit InferenceServerClient(bool verbose) : verbose_(verbose) {}
+  virtual ~InferenceServerClient() = default;
+
+  Error ClientInferStat(InferStat* stat) const {
+    *stat = infer_stat_;
+    return Error::Success();
+  }
+
+ protected:
+  void UpdateInferStat(const RequestTimers& timers) {
+    infer_stat_.completed_request_count++;
+    infer_stat_.cumulative_total_request_time_ns += timers.Duration(
+        RequestTimers::Kind::REQUEST_START, RequestTimers::Kind::REQUEST_END);
+    infer_stat_.cumulative_send_time_ns += timers.Duration(
+        RequestTimers::Kind::SEND_START, RequestTimers::Kind::SEND_END);
+    infer_stat_.cumulative_receive_time_ns += timers.Duration(
+        RequestTimers::Kind::RECV_START, RequestTimers::Kind::RECV_END);
+  }
+
+  bool verbose_;
+  InferStat infer_stat_;
+};
+
+}  // namespace ctpu

@@ -1,0 +1,109 @@
+// C-ABI shared-memory shim consumed by the Python package via ctypes.
+//
+// Role parity with the reference's libcshm.so
+// (reference src/python/library/tritonclient/utils/shared_memory/
+// shared_memory.cc:76-149): SharedMemoryRegionCreate / Set / GetData /
+// Destroy operating on an opaque handle. The Python side
+// (client_tpu/utils/shared_memory) prefers this library when present and
+// falls back to its pure-Python mmap implementation otherwise.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+#include "shm_utils.h"
+
+namespace {
+
+struct SharedMemoryHandle {
+  std::string triton_shm_name;
+  std::string shm_key;
+  void* base_addr = nullptr;
+  int shm_fd = -1;
+  size_t offset = 0;
+  size_t byte_size = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Error codes mirror the reference's errno-style mapping
+// (reference shared_memory/__init__.py:312-338).
+enum CshmError {
+  CSHM_SUCCESS = 0,
+  CSHM_CREATE_FAIL = -2,
+  CSHM_MAP_FAIL = -3,
+  CSHM_CLOSE_FAIL = -4,
+  CSHM_SET_FAIL = -5,
+  CSHM_UNLINK_FAIL = -6,
+  CSHM_INVALID_HANDLE = -7,
+};
+
+int SharedMemoryRegionCreate(const char* triton_shm_name, const char* shm_key,
+                             uint64_t byte_size, void** shm_handle) {
+  auto* handle = new SharedMemoryHandle();
+  handle->triton_shm_name = triton_shm_name;
+  handle->shm_key = shm_key;
+  handle->byte_size = byte_size;
+  if (!ctpu::CreateSharedMemoryRegion(shm_key, byte_size, &handle->shm_fd)
+           .IsOk()) {
+    delete handle;
+    return CSHM_CREATE_FAIL;
+  }
+  if (!ctpu::MapSharedMemory(handle->shm_fd, 0, byte_size,
+                             &handle->base_addr)
+           .IsOk()) {
+    ctpu::CloseSharedMemory(handle->shm_fd);
+    delete handle;
+    return CSHM_MAP_FAIL;
+  }
+  *shm_handle = handle;
+  return CSHM_SUCCESS;
+}
+
+int SharedMemoryRegionSet(void* shm_handle, uint64_t offset,
+                          uint64_t byte_size, const void* data) {
+  auto* handle = static_cast<SharedMemoryHandle*>(shm_handle);
+  if (handle == nullptr || handle->base_addr == nullptr) {
+    return CSHM_INVALID_HANDLE;
+  }
+  if (offset + byte_size > handle->byte_size) return CSHM_SET_FAIL;
+  std::memcpy(static_cast<uint8_t*>(handle->base_addr) + offset, data,
+              byte_size);
+  return CSHM_SUCCESS;
+}
+
+int GetSharedMemoryHandleInfo(void* shm_handle, char** shm_addr,
+                              const char** shm_key, int* shm_fd,
+                              uint64_t* offset, uint64_t* byte_size) {
+  auto* handle = static_cast<SharedMemoryHandle*>(shm_handle);
+  if (handle == nullptr) return CSHM_INVALID_HANDLE;
+  *shm_addr = static_cast<char*>(handle->base_addr);
+  *shm_key = handle->shm_key.c_str();
+  *shm_fd = handle->shm_fd;
+  *offset = handle->offset;
+  *byte_size = handle->byte_size;
+  return CSHM_SUCCESS;
+}
+
+int SharedMemoryRegionDestroy(void* shm_handle) {
+  auto* handle = static_cast<SharedMemoryHandle*>(shm_handle);
+  if (handle == nullptr) return CSHM_INVALID_HANDLE;
+  int rc = CSHM_SUCCESS;
+  if (handle->base_addr != nullptr &&
+      !ctpu::UnmapSharedMemory(handle->base_addr, handle->byte_size).IsOk()) {
+    rc = CSHM_MAP_FAIL;
+  }
+  if (handle->shm_fd >= 0 &&
+      !ctpu::CloseSharedMemory(handle->shm_fd).IsOk()) {
+    rc = CSHM_CLOSE_FAIL;
+  }
+  if (!ctpu::UnlinkSharedMemoryRegion(handle->shm_key).IsOk()) {
+    rc = CSHM_UNLINK_FAIL;
+  }
+  delete handle;
+  return rc;
+}
+
+}  // extern "C"

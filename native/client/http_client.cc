@@ -1,0 +1,831 @@
+#include "http_client.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+
+namespace ctpu {
+
+namespace {
+
+Error MakeSocketError(const char* what) {
+  return Error(std::string(what) + ": " + std::strerror(errno));
+}
+
+// Encode little-endian element(s) of a JSON "data" array into raw bytes.
+template <typename T>
+void AppendScalar(std::string* out, T v) {
+  out->append(reinterpret_cast<const char*>(&v), sizeof(v));
+}
+
+void FlattenJsonData(const json::Value& v, const std::string& dtype,
+                     std::string* out) {
+  if (v.IsArray()) {
+    for (const auto& e : v.AsArray()) FlattenJsonData(e, dtype, out);
+    return;
+  }
+  if (dtype == "BOOL") AppendScalar<uint8_t>(out, v.AsBool() ? 1 : 0);
+  else if (dtype == "INT8") AppendScalar<int8_t>(out, (int8_t)v.AsInt());
+  else if (dtype == "UINT8") AppendScalar<uint8_t>(out, (uint8_t)v.AsInt());
+  else if (dtype == "INT16") AppendScalar<int16_t>(out, (int16_t)v.AsInt());
+  else if (dtype == "UINT16") AppendScalar<uint16_t>(out, (uint16_t)v.AsInt());
+  else if (dtype == "INT32") AppendScalar<int32_t>(out, (int32_t)v.AsInt());
+  else if (dtype == "UINT32") AppendScalar<uint32_t>(out, (uint32_t)v.AsInt());
+  else if (dtype == "INT64") AppendScalar<int64_t>(out, v.AsInt());
+  else if (dtype == "UINT64") AppendScalar<uint64_t>(out, (uint64_t)v.AsInt());
+  else if (dtype == "FP32") AppendScalar<float>(out, (float)v.AsDouble());
+  else if (dtype == "FP64") AppendScalar<double>(out, v.AsDouble());
+  else if (dtype == "BYTES") {
+    const std::string& s = v.AsString();
+    uint32_t len = (uint32_t)s.size();
+    out->append(reinterpret_cast<const char*>(&len), 4);
+    out->append(s);
+  }
+  // FP16/BF16 have no JSON representation — binary-only by design
+  // (the reference errors the same way, http_client.cc:1234-1235).
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// HttpConnection
+// ---------------------------------------------------------------------------
+
+Error HttpConnection::Connect(int64_t timeout_us) {
+  Close();
+  struct addrinfo hints;
+  std::memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  std::string port_str = std::to_string(port_);
+  int rc = getaddrinfo(host_.c_str(), port_str.c_str(), &hints, &res);
+  if (rc != 0) {
+    return Error("failed to resolve " + host_ + ": " + gai_strerror(rc));
+  }
+  Error err("failed to connect");
+  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    int fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      if (timeout_us > 0) {
+        struct timeval tv;
+        tv.tv_sec = timeout_us / 1000000;
+        tv.tv_usec = timeout_us % 1000000;
+        setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+        setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+      }
+      fd_ = fd;
+      err = Error::Success();
+      break;
+    }
+    err = MakeSocketError("connect");
+    close(fd);
+  }
+  freeaddrinfo(res);
+  buf_.clear();
+  return err;
+}
+
+void HttpConnection::Close() {
+  if (fd_ >= 0) {
+    close(fd_);
+    fd_ = -1;
+  }
+  buf_.clear();
+}
+
+Error HttpConnection::SendAll(const char* data, size_t size) {
+  size_t sent = 0;
+  while (sent < size) {
+    ssize_t n = send(fd_, data + sent, size - sent, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return MakeSocketError("send");
+    }
+    sent += n;
+  }
+  return Error::Success();
+}
+
+Error HttpConnection::FillBuffer() {
+  char tmp[65536];
+  ssize_t n = recv(fd_, tmp, sizeof(tmp), 0);
+  if (n < 0) {
+    if (errno == EINTR) return FillBuffer();
+    return MakeSocketError("recv");
+  }
+  if (n == 0) return Error("connection closed by server");
+  buf_.append(tmp, n);
+  return Error::Success();
+}
+
+Error HttpConnection::ReadResponse(int* status_out, std::string* headers_out,
+                                   std::string* body_out) {
+  // Read until end of headers.
+  size_t hdr_end;
+  while ((hdr_end = buf_.find("\r\n\r\n")) == std::string::npos) {
+    CTPU_RETURN_IF_ERROR(FillBuffer());
+  }
+  std::string head = buf_.substr(0, hdr_end + 2);
+  buf_.erase(0, hdr_end + 4);
+
+  // Status line: HTTP/1.1 200 OK
+  if (head.compare(0, 5, "HTTP/") != 0) {
+    return Error("malformed HTTP status line");
+  }
+  size_t sp = head.find(' ');
+  *status_out = std::atoi(head.c_str() + sp + 1);
+  *headers_out = head;
+
+  // Locate framing headers (case-insensitive).
+  auto find_header = [&head](const char* name) -> std::string {
+    std::string lower_head;
+    lower_head.reserve(head.size());
+    for (char c : head) lower_head += std::tolower((unsigned char)c);
+    std::string needle = std::string("\r\n") + name + ":";
+    size_t pos = lower_head.find(needle);
+    if (pos == std::string::npos) return "";
+    pos += needle.size();
+    size_t eol = head.find("\r\n", pos);
+    std::string val = head.substr(pos, eol - pos);
+    size_t b = val.find_first_not_of(" \t");
+    size_t e = val.find_last_not_of(" \t");
+    return b == std::string::npos ? "" : val.substr(b, e - b + 1);
+  };
+
+  std::string te = find_header("transfer-encoding");
+  if (te.find("chunked") != std::string::npos) {
+    body_out->clear();
+    while (true) {
+      size_t eol;
+      while ((eol = buf_.find("\r\n")) == std::string::npos) {
+        CTPU_RETURN_IF_ERROR(FillBuffer());
+      }
+      size_t chunk_size = std::strtoul(buf_.c_str(), nullptr, 16);
+      buf_.erase(0, eol + 2);
+      if (chunk_size == 0) {
+        // Trailer: consume to final CRLF.
+        while (buf_.find("\r\n") == std::string::npos) {
+          CTPU_RETURN_IF_ERROR(FillBuffer());
+        }
+        buf_.erase(0, buf_.find("\r\n") + 2);
+        return Error::Success();
+      }
+      while (buf_.size() < chunk_size + 2) {
+        CTPU_RETURN_IF_ERROR(FillBuffer());
+      }
+      body_out->append(buf_, 0, chunk_size);
+      buf_.erase(0, chunk_size + 2);
+    }
+  }
+
+  std::string cl = find_header("content-length");
+  size_t content_length = cl.empty() ? 0 : std::strtoul(cl.c_str(), nullptr, 10);
+  while (buf_.size() < content_length) {
+    CTPU_RETURN_IF_ERROR(FillBuffer());
+  }
+  body_out->assign(buf_, 0, content_length);
+  buf_.erase(0, content_length);
+  return Error::Success();
+}
+
+Error HttpConnection::Roundtrip(const std::string& method,
+                                const std::string& uri,
+                                const std::vector<std::string>& extra_headers,
+                                const char* body, size_t body_size,
+                                int* status_out, std::string* resp_headers,
+                                std::string* resp_body, int64_t timeout_us) {
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    if (!Connected()) {
+      CTPU_RETURN_IF_ERROR(Connect(timeout_us));
+    }
+    std::string head;
+    head.reserve(256 + uri.size());
+    head += method + " /" + uri + " HTTP/1.1\r\n";
+    head += "Host: " + host_ + ":" + std::to_string(port_) + "\r\n";
+    head += "Connection: keep-alive\r\n";
+    for (const auto& h : extra_headers) head += h + "\r\n";
+    if (body_size > 0 || method == "POST") {
+      head += "Content-Length: " + std::to_string(body_size) + "\r\n";
+    }
+    head += "\r\n";
+
+    Error err = SendAll(head.data(), head.size());
+    if (err.IsOk() && body_size > 0) err = SendAll(body, body_size);
+    if (err.IsOk()) err = ReadResponse(status_out, resp_headers, resp_body);
+    if (err.IsOk()) return err;
+    // Stale keep-alive connection: reconnect once and retry.
+    Close();
+    if (attempt == 1) return err;
+  }
+  return Error("unreachable");
+}
+
+// ---------------------------------------------------------------------------
+// InferResultHttp
+// ---------------------------------------------------------------------------
+
+Error InferResultHttp::Create(std::unique_ptr<InferResult>* result,
+                              int http_status, std::string&& body,
+                              size_t json_size) {
+  auto r = std::unique_ptr<InferResultHttp>(new InferResultHttp());
+  r->body_ = std::move(body);
+  size_t jlen = json_size == 0 ? r->body_.size() : json_size;
+  try {
+    r->header_ = json::Parse(r->body_.substr(0, jlen));
+  } catch (const std::exception& e) {
+    return Error(std::string("failed to parse inference response: ") +
+                 e.what());
+  }
+  if (http_status != 200) {
+    std::string msg = r->header_["error"].IsString()
+                          ? r->header_["error"].AsString()
+                          : "inference failed with HTTP status " +
+                                std::to_string(http_status);
+    r->status_ = Error(msg);
+    *result = std::move(r);
+    return Error::Success();
+  }
+  // Walk outputs: binary ones live at sequential offsets after the JSON
+  // header, ordered as listed (KServe v2 binary extension).
+  size_t offset = jlen;
+  if (r->header_["outputs"].IsArray()) {
+    for (const auto& out : r->header_["outputs"].AsArray()) {
+      const std::string& name = out["name"].AsString();
+      r->outputs_[name] = &out;
+      const json::Value& params = out["parameters"];
+      if (params.Has("binary_data_size")) {
+        size_t size = (size_t)params["binary_data_size"].AsInt();
+        r->binary_[name] = {offset, size};
+        offset += size;
+      } else if (out.Has("data")) {
+        std::string decoded;
+        FlattenJsonData(out["data"], out["datatype"].AsString(), &decoded);
+        r->decoded_[name] = std::move(decoded);
+      }
+    }
+  }
+  *result = std::move(r);
+  return Error::Success();
+}
+
+Error InferResultHttp::ModelName(std::string* name) const {
+  *name = header_["model_name"].AsString();
+  return Error::Success();
+}
+Error InferResultHttp::ModelVersion(std::string* version) const {
+  *version = header_["model_version"].AsString();
+  return Error::Success();
+}
+Error InferResultHttp::Id(std::string* id) const {
+  *id = header_["id"].AsString();
+  return Error::Success();
+}
+
+Error InferResultHttp::Shape(const std::string& output_name,
+                             std::vector<int64_t>* shape) const {
+  auto it = outputs_.find(output_name);
+  if (it == outputs_.end()) {
+    return Error("output '" + output_name + "' not found in result");
+  }
+  shape->clear();
+  for (const auto& d : (*it->second)["shape"].AsArray()) {
+    shape->push_back(d.AsInt());
+  }
+  return Error::Success();
+}
+
+Error InferResultHttp::Datatype(const std::string& output_name,
+                                std::string* datatype) const {
+  auto it = outputs_.find(output_name);
+  if (it == outputs_.end()) {
+    return Error("output '" + output_name + "' not found in result");
+  }
+  *datatype = (*it->second)["datatype"].AsString();
+  return Error::Success();
+}
+
+Error InferResultHttp::RawData(const std::string& output_name,
+                               const uint8_t** buf, size_t* byte_size) const {
+  auto bit = binary_.find(output_name);
+  if (bit != binary_.end()) {
+    *buf = reinterpret_cast<const uint8_t*>(body_.data()) + bit->second.first;
+    *byte_size = bit->second.second;
+    return Error::Success();
+  }
+  auto dit = decoded_.find(output_name);
+  if (dit != decoded_.end()) {
+    *buf = reinterpret_cast<const uint8_t*>(dit->second.data());
+    *byte_size = dit->second.size();
+    return Error::Success();
+  }
+  return Error("output '" + output_name + "' has no data in result");
+}
+
+// ---------------------------------------------------------------------------
+// InferenceServerHttpClient
+// ---------------------------------------------------------------------------
+
+Error InferenceServerHttpClient::Create(
+    std::unique_ptr<InferenceServerHttpClient>* client, const std::string& url,
+    bool verbose, size_t async_workers) {
+  size_t colon = url.rfind(':');
+  if (colon == std::string::npos) {
+    return Error("url must be host:port, got '" + url + "'");
+  }
+  std::string host = url.substr(0, colon);
+  int port = std::atoi(url.c_str() + colon + 1);
+  client->reset(
+      new InferenceServerHttpClient(host, port, verbose, async_workers));
+  return Error::Success();
+}
+
+InferenceServerHttpClient::InferenceServerHttpClient(std::string host,
+                                                     int port, bool verbose,
+                                                     size_t async_workers)
+    : InferenceServerClient(verbose),
+      host_(std::move(host)),
+      port_(port),
+      control_conn_(host_, port),
+      infer_conn_(host_, port) {
+  for (size_t i = 0; i < async_workers; ++i) {
+    workers_.emplace_back(&InferenceServerHttpClient::AsyncWorker, this);
+  }
+}
+
+InferenceServerHttpClient::~InferenceServerHttpClient() {
+  {
+    std::lock_guard<std::mutex> lk(jobs_mu_);
+    shutdown_ = true;
+  }
+  jobs_cv_.notify_all();
+  for (auto& w : workers_) w.join();
+}
+
+Error InferenceServerHttpClient::Get(const std::string& uri, int* status,
+                                     std::string* body) {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::string headers;
+  return control_conn_.Roundtrip("GET", uri, {}, nullptr, 0, status, &headers,
+                                 body);
+}
+
+Error InferenceServerHttpClient::Post(const std::string& uri,
+                                      const std::string& body, int* status,
+                                      std::string* resp_body) {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::string headers;
+  return control_conn_.Roundtrip(
+      "POST", uri, {"Content-Type: application/json"}, body.data(),
+      body.size(), status, &headers, resp_body);
+}
+
+Error InferenceServerHttpClient::JsonGet(const std::string& uri,
+                                         json::Value* out) {
+  int status = 0;
+  std::string body;
+  CTPU_RETURN_IF_ERROR(Get(uri, &status, &body));
+  try {
+    *out = body.empty() ? json::Value(json::Object{}) : json::Parse(body);
+  } catch (const std::exception& e) {
+    return Error(std::string("malformed JSON from server: ") + e.what());
+  }
+  if (status != 200) {
+    return Error((*out)["error"].IsString()
+                     ? (*out)["error"].AsString()
+                     : "server returned HTTP " + std::to_string(status));
+  }
+  return Error::Success();
+}
+
+Error InferenceServerHttpClient::JsonPost(const std::string& uri,
+                                          const json::Value& payload,
+                                          json::Value* out) {
+  int status = 0;
+  std::string body;
+  CTPU_RETURN_IF_ERROR(Post(uri, payload.Dump(), &status, &body));
+  try {
+    *out = body.empty() ? json::Value(json::Object{}) : json::Parse(body);
+  } catch (const std::exception& e) {
+    return Error(std::string("malformed JSON from server: ") + e.what());
+  }
+  if (status != 200) {
+    return Error((*out)["error"].IsString()
+                     ? (*out)["error"].AsString()
+                     : "server returned HTTP " + std::to_string(status));
+  }
+  return Error::Success();
+}
+
+Error InferenceServerHttpClient::IsServerLive(bool* live) {
+  int status = 0;
+  std::string body;
+  Error err = Get("v2/health/live", &status, &body);
+  *live = err.IsOk() && status == 200;
+  return err;
+}
+
+Error InferenceServerHttpClient::IsServerReady(bool* ready) {
+  int status = 0;
+  std::string body;
+  Error err = Get("v2/health/ready", &status, &body);
+  *ready = err.IsOk() && status == 200;
+  return err;
+}
+
+Error InferenceServerHttpClient::IsModelReady(bool* ready,
+                                              const std::string& model_name,
+                                              const std::string& version) {
+  std::string uri = "v2/models/" + model_name;
+  if (!version.empty()) uri += "/versions/" + version;
+  uri += "/ready";
+  int status = 0;
+  std::string body;
+  Error err = Get(uri, &status, &body);
+  *ready = err.IsOk() && status == 200;
+  return err;
+}
+
+Error InferenceServerHttpClient::ServerMetadata(json::Value* metadata) {
+  return JsonGet("v2", metadata);
+}
+
+Error InferenceServerHttpClient::ModelMetadata(json::Value* metadata,
+                                               const std::string& model_name,
+                                               const std::string& version) {
+  std::string uri = "v2/models/" + model_name;
+  if (!version.empty()) uri += "/versions/" + version;
+  return JsonGet(uri, metadata);
+}
+
+Error InferenceServerHttpClient::ModelConfig(json::Value* config,
+                                             const std::string& model_name,
+                                             const std::string& version) {
+  std::string uri = "v2/models/" + model_name;
+  if (!version.empty()) uri += "/versions/" + version;
+  uri += "/config";
+  return JsonGet(uri, config);
+}
+
+Error InferenceServerHttpClient::ModelRepositoryIndex(json::Value* index) {
+  return JsonPost("v2/repository/index", json::Value(json::Object{}), index);
+}
+
+Error InferenceServerHttpClient::LoadModel(const std::string& model_name,
+                                           const std::string& config_json) {
+  json::Object payload;
+  if (!config_json.empty()) {
+    json::Object params;
+    params["config"] = json::Value(config_json);
+    payload["parameters"] = json::Value(std::move(params));
+  }
+  json::Value out;
+  return JsonPost("v2/repository/models/" + model_name + "/load",
+                  json::Value(std::move(payload)), &out);
+}
+
+Error InferenceServerHttpClient::UnloadModel(const std::string& model_name) {
+  json::Value out;
+  return JsonPost("v2/repository/models/" + model_name + "/unload",
+                  json::Value(json::Object{}), &out);
+}
+
+Error InferenceServerHttpClient::ModelInferenceStatistics(
+    json::Value* stats, const std::string& model_name,
+    const std::string& version) {
+  std::string uri = "v2/models";
+  if (!model_name.empty()) {
+    uri += "/" + model_name;
+    if (!version.empty()) uri += "/versions/" + version;
+  }
+  uri += "/stats";
+  return JsonGet(uri, stats);
+}
+
+Error InferenceServerHttpClient::RegisterSystemSharedMemory(
+    const std::string& name, const std::string& key, size_t byte_size,
+    size_t offset) {
+  json::Object payload;
+  payload["key"] = json::Value(key);
+  payload["offset"] = json::Value((int64_t)offset);
+  payload["byte_size"] = json::Value((int64_t)byte_size);
+  json::Value out;
+  return JsonPost("v2/systemsharedmemory/region/" + name + "/register",
+                  json::Value(std::move(payload)), &out);
+}
+
+Error InferenceServerHttpClient::UnregisterSystemSharedMemory(
+    const std::string& name) {
+  json::Value out;
+  std::string uri = name.empty()
+                        ? "v2/systemsharedmemory/unregister"
+                        : "v2/systemsharedmemory/region/" + name + "/unregister";
+  return JsonPost(uri, json::Value(json::Object{}), &out);
+}
+
+Error InferenceServerHttpClient::SystemSharedMemoryStatus(json::Value* status) {
+  return JsonGet("v2/systemsharedmemory/status", status);
+}
+
+Error InferenceServerHttpClient::RegisterTpuSharedMemory(
+    const std::string& name, const std::string& key, size_t byte_size,
+    size_t offset) {
+  json::Object payload;
+  payload["key"] = json::Value(key);
+  payload["offset"] = json::Value((int64_t)offset);
+  payload["byte_size"] = json::Value((int64_t)byte_size);
+  json::Value out;
+  return JsonPost("v2/tpusharedmemory/region/" + name + "/register",
+                  json::Value(std::move(payload)), &out);
+}
+
+Error InferenceServerHttpClient::UnregisterTpuSharedMemory(
+    const std::string& name) {
+  json::Value out;
+  std::string uri = name.empty()
+                        ? "v2/tpusharedmemory/unregister"
+                        : "v2/tpusharedmemory/region/" + name + "/unregister";
+  return JsonPost(uri, json::Value(json::Object{}), &out);
+}
+
+Error InferenceServerHttpClient::TpuSharedMemoryStatus(json::Value* status) {
+  return JsonGet("v2/tpusharedmemory/status", status);
+}
+
+Error InferenceServerHttpClient::GenerateRequestBody(
+    std::string* body, size_t* header_length, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs) {
+  json::Object req;
+  if (!options.request_id.empty()) {
+    req["id"] = json::Value(options.request_id);
+  }
+  json::Object params;
+  if (!options.sequence_id_str.empty()) {
+    params["sequence_id"] = json::Value(options.sequence_id_str);
+    params["sequence_start"] = json::Value(options.sequence_start);
+    params["sequence_end"] = json::Value(options.sequence_end);
+  } else if (options.sequence_id != 0) {
+    params["sequence_id"] = json::Value((int64_t)options.sequence_id);
+    params["sequence_start"] = json::Value(options.sequence_start);
+    params["sequence_end"] = json::Value(options.sequence_end);
+  }
+  if (options.priority != 0) {
+    params["priority"] = json::Value((int64_t)options.priority);
+  }
+  if (options.server_timeout_us != 0) {
+    params["timeout"] = json::Value((int64_t)options.server_timeout_us);
+  }
+
+  json::Array jinputs;
+  size_t binary_total = 0;
+  for (const InferInput* input : inputs) {
+    json::Object jin;
+    jin["name"] = json::Value(input->Name());
+    jin["datatype"] = json::Value(input->Datatype());
+    json::Array shape;
+    for (int64_t d : input->Shape()) shape.push_back(json::Value(d));
+    jin["shape"] = json::Value(std::move(shape));
+    json::Object jparams;
+    if (input->IsSharedMemory()) {
+      jparams["shared_memory_region"] =
+          json::Value(input->SharedMemoryName());
+      jparams["shared_memory_byte_size"] =
+          json::Value((int64_t)input->SharedMemoryByteSize());
+      if (input->SharedMemoryOffset() != 0) {
+        jparams["shared_memory_offset"] =
+            json::Value((int64_t)input->SharedMemoryOffset());
+      }
+    } else {
+      jparams["binary_data_size"] =
+          json::Value((int64_t)input->TotalByteSize());
+      binary_total += input->TotalByteSize();
+    }
+    jin["parameters"] = json::Value(std::move(jparams));
+    jinputs.push_back(json::Value(std::move(jin)));
+  }
+  req["inputs"] = json::Value(std::move(jinputs));
+
+  if (!outputs.empty()) {
+    json::Array jouts;
+    for (const InferRequestedOutput* out : outputs) {
+      json::Object jout;
+      jout["name"] = json::Value(out->Name());
+      json::Object jparams;
+      if (out->IsSharedMemory()) {
+        jparams["shared_memory_region"] = json::Value(out->SharedMemoryName());
+        jparams["shared_memory_byte_size"] =
+            json::Value((int64_t)out->SharedMemoryByteSize());
+        if (out->SharedMemoryOffset() != 0) {
+          jparams["shared_memory_offset"] =
+              json::Value((int64_t)out->SharedMemoryOffset());
+        }
+      } else {
+        if (out->ClassCount() > 0) {
+          jparams["classification"] = json::Value((int64_t)out->ClassCount());
+        }
+        jparams["binary_data"] = json::Value(out->BinaryData());
+      }
+      if (!jparams.empty()) jout["parameters"] = json::Value(std::move(jparams));
+      jouts.push_back(json::Value(std::move(jout)));
+    }
+    req["outputs"] = json::Value(std::move(jouts));
+  } else {
+    // No explicit outputs: ask for everything as binary
+    // (reference http/_utils.py:131-139 semantics).
+    params["binary_data_output"] = json::Value(true);
+  }
+  if (!params.empty()) req["parameters"] = json::Value(std::move(params));
+
+  std::string header = json::Value(std::move(req)).Dump();
+  *header_length = header.size();
+  body->clear();
+  body->reserve(header.size() + binary_total);
+  body->append(header);
+  for (const InferInput* input : inputs) {
+    for (const auto& buf : input->Buffers()) {
+      body->append(reinterpret_cast<const char*>(buf.first), buf.second);
+    }
+  }
+  return Error::Success();
+}
+
+Error InferenceServerHttpClient::ParseResponseBody(
+    std::unique_ptr<InferResult>* result, std::string&& body,
+    size_t header_length) {
+  return InferResultHttp::Create(result, 200, std::move(body), header_length);
+}
+
+Error InferenceServerHttpClient::InferOnConnection(
+    HttpConnection* conn, std::unique_ptr<InferResult>* result,
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    RequestTimers* timers) {
+  timers->CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
+  std::string body;
+  size_t header_length = 0;
+  CTPU_RETURN_IF_ERROR(
+      GenerateRequestBody(&body, &header_length, options, inputs, outputs));
+
+  std::string uri = "v2/models/" + options.model_name;
+  if (!options.model_version.empty()) {
+    uri += "/versions/" + options.model_version;
+  }
+  uri += "/infer";
+
+  std::vector<std::string> headers = {
+      "Content-Type: application/octet-stream",
+      "Inference-Header-Content-Length: " + std::to_string(header_length)};
+
+  timers->CaptureTimestamp(RequestTimers::Kind::SEND_START);
+  int status = 0;
+  std::string resp_headers, resp_body;
+  Error err =
+      conn->Roundtrip("POST", uri, headers, body.data(), body.size(), &status,
+                      &resp_headers, &resp_body, options.client_timeout_us);
+  timers->CaptureTimestamp(RequestTimers::Kind::SEND_END);
+  timers->CaptureTimestamp(RequestTimers::Kind::RECV_START);
+  if (!err.IsOk()) return err;
+
+  // Binary section offset from the response header.
+  size_t json_size = 0;
+  {
+    std::string lower;
+    lower.reserve(resp_headers.size());
+    for (char c : resp_headers) lower += std::tolower((unsigned char)c);
+    const std::string needle = "\r\ninference-header-content-length:";
+    size_t pos = lower.find(needle);
+    if (pos != std::string::npos) {
+      json_size = std::strtoul(resp_headers.c_str() + pos + needle.size(),
+                               nullptr, 10);
+    }
+  }
+  err = InferResultHttp::Create(result, status, std::move(resp_body),
+                                json_size);
+  timers->CaptureTimestamp(RequestTimers::Kind::RECV_END);
+  timers->CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
+  return err;
+}
+
+Error InferenceServerHttpClient::Infer(
+    std::unique_ptr<InferResult>* result, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs) {
+  RequestTimers timers;
+  std::lock_guard<std::mutex> lk(mu_);
+  Error err =
+      InferOnConnection(&infer_conn_, result, options, inputs, outputs,
+                        &timers);
+  if (err.IsOk()) UpdateInferStat(timers);
+  return err;
+}
+
+Error InferenceServerHttpClient::AsyncInfer(
+    OnCompleteFn callback, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs) {
+  AsyncJob job;
+  job.callback = std::move(callback);
+  job.options = options;
+  CTPU_RETURN_IF_ERROR(GenerateRequestBody(&job.body, &job.header_length,
+                                           options, inputs, outputs));
+  job.uri = "v2/models/" + options.model_name;
+  if (!options.model_version.empty()) {
+    job.uri += "/versions/" + options.model_version;
+  }
+  job.uri += "/infer";
+  {
+    std::lock_guard<std::mutex> lk(jobs_mu_);
+    if (shutdown_) return Error("client is shutting down");
+    jobs_.push_back(std::move(job));
+  }
+  jobs_cv_.notify_one();
+  return Error::Success();
+}
+
+void InferenceServerHttpClient::AsyncWorker() {
+  HttpConnection conn(host_, port_);
+  while (true) {
+    AsyncJob job;
+    {
+      std::unique_lock<std::mutex> lk(jobs_mu_);
+      jobs_cv_.wait(lk, [this] { return shutdown_ || !jobs_.empty(); });
+      if (shutdown_ && jobs_.empty()) return;
+      job = std::move(jobs_.front());
+      jobs_.pop_front();
+    }
+    RequestTimers timers;
+    timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
+    std::vector<std::string> headers = {
+        "Content-Type: application/octet-stream",
+        "Inference-Header-Content-Length: " +
+            std::to_string(job.header_length)};
+    timers.CaptureTimestamp(RequestTimers::Kind::SEND_START);
+    int status = 0;
+    std::string resp_headers, resp_body;
+    Error err = conn.Roundtrip("POST", job.uri, headers, job.body.data(),
+                               job.body.size(), &status, &resp_headers,
+                               &resp_body, job.options.client_timeout_us);
+    timers.CaptureTimestamp(RequestTimers::Kind::SEND_END);
+    timers.CaptureTimestamp(RequestTimers::Kind::RECV_START);
+    std::unique_ptr<InferResult> result;
+    if (err.IsOk()) {
+      size_t json_size = 0;
+      std::string lower;
+      lower.reserve(resp_headers.size());
+      for (char c : resp_headers) lower += std::tolower((unsigned char)c);
+      const std::string needle = "\r\ninference-header-content-length:";
+      size_t pos = lower.find(needle);
+      if (pos != std::string::npos) {
+        json_size = std::strtoul(resp_headers.c_str() + pos + needle.size(),
+                                 nullptr, 10);
+      }
+      err = InferResultHttp::Create(&result, status, std::move(resp_body),
+                                    json_size);
+    }
+    timers.CaptureTimestamp(RequestTimers::Kind::RECV_END);
+    timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
+    if (!err.IsOk()) {
+      // Surface the transport error through a failed result.
+      class ErrorResult : public InferResult {
+       public:
+        explicit ErrorResult(Error e) : err_(std::move(e)) {}
+        Error ModelName(std::string*) const override { return err_; }
+        Error ModelVersion(std::string*) const override { return err_; }
+        Error Id(std::string*) const override { return err_; }
+        Error Shape(const std::string&, std::vector<int64_t>*) const override {
+          return err_;
+        }
+        Error Datatype(const std::string&, std::string*) const override {
+          return err_;
+        }
+        Error RawData(const std::string&, const uint8_t**,
+                      size_t*) const override {
+          return err_;
+        }
+        Error RequestStatus() const override { return err_; }
+        std::string DebugString() const override { return err_.Message(); }
+
+       private:
+        Error err_;
+      };
+      result.reset(new ErrorResult(err));
+    } else {
+      std::lock_guard<std::mutex> lk(mu_);
+      UpdateInferStat(timers);
+    }
+    job.callback(result.get());
+  }
+}
+
+}  // namespace ctpu

@@ -1,0 +1,209 @@
+// HTTP/REST KServe v2 client over raw POSIX sockets.
+//
+// Capability parity with the reference's libcurl-based client
+// (reference src/c++/library/http_client.h:105, http_client.cc): server /
+// model health & metadata, model control, inference statistics,
+// shared-memory registration, blocking Infer, callback AsyncInfer, and the
+// static GenerateRequestBody/ParseResponseBody pair for offline request
+// construction (reference http_client.cc:1286-1351).
+//
+// Departures: no libcurl in this image, so the transport is a small
+// persistent-connection HTTP/1.1 implementation (same approach as the
+// reference's openai backend, which carries its own minimal HttpClient —
+// reference src/c++/perf_analyzer/client_backend/openai/http_client.h).
+// Async inference uses a thread pool where each worker owns one
+// connection, instead of a curl-multi loop; at perf_analyzer concurrency
+// levels this is both simpler and faster than one multiplexed event loop.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+#include "json.h"
+
+namespace ctpu {
+
+// One persistent HTTP/1.1 connection. Not thread-safe.
+class HttpConnection {
+ public:
+  HttpConnection(std::string host, int port) : host_(std::move(host)), port_(port) {}
+  ~HttpConnection() { Close(); }
+
+  // (Re)establish the TCP connection (TCP_NODELAY set).
+  Error Connect(int64_t timeout_us = 0);
+  void Close();
+  bool Connected() const { return fd_ >= 0; }
+
+  // Issue one request and read the full response. Reconnects once on a
+  // stale keep-alive connection. extra_headers are "Name: value" lines.
+  Error Roundtrip(const std::string& method, const std::string& uri,
+                  const std::vector<std::string>& extra_headers,
+                  const char* body, size_t body_size, int* status_out,
+                  std::string* resp_headers, std::string* resp_body,
+                  int64_t timeout_us = 0);
+
+ private:
+  Error SendAll(const char* data, size_t size);
+  Error ReadResponse(int* status_out, std::string* headers_out,
+                     std::string* body_out);
+  Error FillBuffer();  // read() into buf_
+
+  std::string host_;
+  int port_;
+  int fd_ = -1;
+  int64_t deadline_ns_ = 0;  // absolute steady-clock deadline, 0 = none
+  std::string buf_;          // unconsumed read-ahead
+};
+
+// Parsed HTTP headers of interest.
+struct HttpResponseInfo {
+  int status = 0;
+  size_t header_content_length = 0;  // Inference-Header-Content-Length
+  std::string content_encoding;
+};
+
+class InferenceServerHttpClient;
+
+// Result of an HTTP inference (reference http_client.cc InferResultHttp).
+class InferResultHttp : public InferResult {
+ public:
+  // body is the raw response body (JSON header + binary section);
+  // json_size 0 means the whole body is JSON.
+  static Error Create(std::unique_ptr<InferResult>* result, int http_status,
+                      std::string&& body, size_t json_size);
+
+  Error ModelName(std::string* name) const override;
+  Error ModelVersion(std::string* version) const override;
+  Error Id(std::string* id) const override;
+  Error Shape(const std::string& output_name,
+              std::vector<int64_t>* shape) const override;
+  Error Datatype(const std::string& output_name,
+                 std::string* datatype) const override;
+  Error RawData(const std::string& output_name, const uint8_t** buf,
+                size_t* byte_size) const override;
+  Error RequestStatus() const override { return status_; }
+  std::string DebugString() const override { return header_.Dump(); }
+
+ private:
+  Error status_;
+  std::string body_;
+  json::Value header_;
+  // output name -> (offset into body_, size) for binary outputs;
+  // JSON-data outputs are decoded into owned buffers.
+  std::map<std::string, std::pair<size_t, size_t>> binary_;
+  std::map<std::string, std::string> decoded_;
+  std::map<std::string, const json::Value*> outputs_;
+};
+
+using OnCompleteFn = std::function<void(InferResult*)>;
+
+class InferenceServerHttpClient : public InferenceServerClient {
+ public:
+  // url is "host:port" (no scheme; TLS is not supported by this build —
+  // the reference gates HTTPS behind libcurl options, http_client.h:45).
+  static Error Create(std::unique_ptr<InferenceServerHttpClient>* client,
+                      const std::string& url, bool verbose = false,
+                      size_t async_workers = 4);
+  ~InferenceServerHttpClient() override;
+
+  Error IsServerLive(bool* live);
+  Error IsServerReady(bool* ready);
+  Error IsModelReady(bool* ready, const std::string& model_name,
+                     const std::string& model_version = "");
+  Error ServerMetadata(json::Value* metadata);
+  Error ModelMetadata(json::Value* metadata, const std::string& model_name,
+                      const std::string& model_version = "");
+  Error ModelConfig(json::Value* config, const std::string& model_name,
+                    const std::string& model_version = "");
+  Error ModelRepositoryIndex(json::Value* index);
+  Error LoadModel(const std::string& model_name,
+                  const std::string& config_json = "");
+  Error UnloadModel(const std::string& model_name);
+  Error ModelInferenceStatistics(json::Value* stats,
+                                 const std::string& model_name = "",
+                                 const std::string& model_version = "");
+
+  // Shared-memory registration (system + tpu regions;
+  // reference http_client.h RegisterSystemSharedMemory /
+  // RegisterCudaSharedMemory pair).
+  Error RegisterSystemSharedMemory(const std::string& name,
+                                   const std::string& key, size_t byte_size,
+                                   size_t offset = 0);
+  Error UnregisterSystemSharedMemory(const std::string& name = "");
+  Error SystemSharedMemoryStatus(json::Value* status);
+  Error RegisterTpuSharedMemory(const std::string& name,
+                                const std::string& key, size_t byte_size,
+                                size_t offset = 0);
+  Error UnregisterTpuSharedMemory(const std::string& name = "");
+  Error TpuSharedMemoryStatus(json::Value* status);
+
+  // Blocking inference.
+  Error Infer(std::unique_ptr<InferResult>* result,
+              const InferOptions& options,
+              const std::vector<InferInput*>& inputs,
+              const std::vector<const InferRequestedOutput*>& outputs = {});
+
+  // Asynchronous inference: callback fires on a worker thread.
+  Error AsyncInfer(OnCompleteFn callback, const InferOptions& options,
+                   const std::vector<InferInput*>& inputs,
+                   const std::vector<const InferRequestedOutput*>& outputs = {});
+
+  // Offline request construction / response parse
+  // (reference http_client.cc:1286-1351).
+  static Error GenerateRequestBody(
+      std::string* body, size_t* header_length, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs);
+  static Error ParseResponseBody(std::unique_ptr<InferResult>* result,
+                                 std::string&& body, size_t header_length);
+
+ private:
+  InferenceServerHttpClient(std::string host, int port, bool verbose,
+                            size_t async_workers);
+
+  Error Get(const std::string& uri, int* status, std::string* body);
+  Error Post(const std::string& uri, const std::string& body, int* status,
+             std::string* resp_body);
+  Error JsonGet(const std::string& uri, json::Value* out);
+  Error JsonPost(const std::string& uri, const json::Value& payload,
+                 json::Value* out);
+
+  Error InferOnConnection(HttpConnection* conn,
+                          std::unique_ptr<InferResult>* result,
+                          const InferOptions& options,
+                          const std::vector<InferInput*>& inputs,
+                          const std::vector<const InferRequestedOutput*>& outputs,
+                          RequestTimers* timers);
+
+  std::string host_;
+  int port_;
+
+  std::mutex mu_;                 // guards control connection + stats
+  HttpConnection control_conn_;   // health/metadata/control requests
+  HttpConnection infer_conn_;     // blocking Infer
+  std::string infer_uri_cache_;
+
+  // Async pool: fixed workers, each with its own connection.
+  struct AsyncJob {
+    OnCompleteFn callback;
+    InferOptions options{""};
+    std::string body;
+    size_t header_length = 0;
+    std::string uri;
+  };
+  void AsyncWorker();
+  std::vector<std::thread> workers_;
+  std::deque<AsyncJob> jobs_;
+  std::mutex jobs_mu_;
+  std::condition_variable jobs_cv_;
+  bool shutdown_ = false;
+};
+
+}  // namespace ctpu

@@ -1,0 +1,319 @@
+#include "json.h"
+
+#include <cctype>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <sstream>
+
+namespace ctpu {
+namespace json {
+
+namespace {
+
+class Parser {
+ public:
+  explicit Parser(const std::string& text) : s_(text), pos_(0) {}
+
+  Value ParseDocument() {
+    Value v = ParseValue();
+    SkipWs();
+    if (pos_ != s_.size()) Fail("trailing characters after JSON value");
+    return v;
+  }
+
+ private:
+  [[noreturn]] void Fail(const std::string& why) {
+    throw std::runtime_error(
+        "JSON parse error at offset " + std::to_string(pos_) + ": " + why);
+  }
+
+  void SkipWs() {
+    while (pos_ < s_.size() &&
+           (s_[pos_] == ' ' || s_[pos_] == '\t' || s_[pos_] == '\n' ||
+            s_[pos_] == '\r')) {
+      ++pos_;
+    }
+  }
+
+  char Peek() {
+    if (pos_ >= s_.size()) Fail("unexpected end of input");
+    return s_[pos_];
+  }
+
+  void Expect(char c) {
+    if (Peek() != c) Fail(std::string("expected '") + c + "'");
+    ++pos_;
+  }
+
+  bool Consume(const char* lit) {
+    size_t n = std::strlen(lit);
+    if (s_.compare(pos_, n, lit) == 0) {
+      pos_ += n;
+      return true;
+    }
+    return false;
+  }
+
+  Value ParseValue() {
+    SkipWs();
+    char c = Peek();
+    switch (c) {
+      case '{':
+        return ParseObject();
+      case '[':
+        return ParseArray();
+      case '"':
+        return Value(ParseString());
+      case 't':
+        if (Consume("true")) return Value(true);
+        Fail("invalid literal");
+      case 'f':
+        if (Consume("false")) return Value(false);
+        Fail("invalid literal");
+      case 'n':
+        if (Consume("null")) return Value(nullptr);
+        Fail("invalid literal");
+      default:
+        return ParseNumber();
+    }
+  }
+
+  Value ParseObject() {
+    Expect('{');
+    Object obj;
+    SkipWs();
+    if (Peek() == '}') {
+      ++pos_;
+      return Value(std::move(obj));
+    }
+    while (true) {
+      SkipWs();
+      std::string key = ParseString();
+      SkipWs();
+      Expect(':');
+      obj[std::move(key)] = ParseValue();
+      SkipWs();
+      if (Peek() == ',') {
+        ++pos_;
+        continue;
+      }
+      Expect('}');
+      return Value(std::move(obj));
+    }
+  }
+
+  Value ParseArray() {
+    Expect('[');
+    Array arr;
+    SkipWs();
+    if (Peek() == ']') {
+      ++pos_;
+      return Value(std::move(arr));
+    }
+    while (true) {
+      arr.push_back(ParseValue());
+      SkipWs();
+      if (Peek() == ',') {
+        ++pos_;
+        continue;
+      }
+      Expect(']');
+      return Value(std::move(arr));
+    }
+  }
+
+  std::string ParseString() {
+    Expect('"');
+    std::string out;
+    while (true) {
+      if (pos_ >= s_.size()) Fail("unterminated string");
+      char c = s_[pos_++];
+      if (c == '"') return out;
+      if (c == '\\') {
+        if (pos_ >= s_.size()) Fail("unterminated escape");
+        char e = s_[pos_++];
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            if (pos_ + 4 > s_.size()) Fail("truncated \\u escape");
+            unsigned cp = 0;
+            for (int i = 0; i < 4; ++i) {
+              char h = s_[pos_++];
+              cp <<= 4;
+              if (h >= '0' && h <= '9') cp |= h - '0';
+              else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
+              else Fail("bad hex digit in \\u escape");
+            }
+            // Surrogate pair?
+            if (cp >= 0xD800 && cp <= 0xDBFF && pos_ + 6 <= s_.size() &&
+                s_[pos_] == '\\' && s_[pos_ + 1] == 'u') {
+              pos_ += 2;
+              unsigned lo = 0;
+              for (int i = 0; i < 4; ++i) {
+                char h = s_[pos_++];
+                lo <<= 4;
+                if (h >= '0' && h <= '9') lo |= h - '0';
+                else if (h >= 'a' && h <= 'f') lo |= h - 'a' + 10;
+                else if (h >= 'A' && h <= 'F') lo |= h - 'A' + 10;
+                else Fail("bad hex digit in \\u escape");
+              }
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            }
+            // UTF-8 encode.
+            if (cp < 0x80) {
+              out += static_cast<char>(cp);
+            } else if (cp < 0x800) {
+              out += static_cast<char>(0xC0 | (cp >> 6));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            } else if (cp < 0x10000) {
+              out += static_cast<char>(0xE0 | (cp >> 12));
+              out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            } else {
+              out += static_cast<char>(0xF0 | (cp >> 18));
+              out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
+              out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            }
+            break;
+          }
+          default:
+            Fail("bad escape character");
+        }
+      } else {
+        out += c;
+      }
+    }
+  }
+
+  Value ParseNumber() {
+    size_t start = pos_;
+    if (Peek() == '-') ++pos_;
+    while (pos_ < s_.size() && std::isdigit((unsigned char)s_[pos_])) ++pos_;
+    bool is_double = false;
+    if (pos_ < s_.size() && s_[pos_] == '.') {
+      is_double = true;
+      ++pos_;
+      while (pos_ < s_.size() && std::isdigit((unsigned char)s_[pos_])) ++pos_;
+    }
+    if (pos_ < s_.size() && (s_[pos_] == 'e' || s_[pos_] == 'E')) {
+      is_double = true;
+      ++pos_;
+      if (pos_ < s_.size() && (s_[pos_] == '+' || s_[pos_] == '-')) ++pos_;
+      while (pos_ < s_.size() && std::isdigit((unsigned char)s_[pos_])) ++pos_;
+    }
+    if (pos_ == start || (pos_ == start + 1 && s_[start] == '-')) {
+      Fail("invalid number");
+    }
+    std::string num = s_.substr(start, pos_ - start);
+    if (is_double) return Value(std::stod(num));
+    try {
+      return Value(static_cast<int64_t>(std::stoll(num)));
+    } catch (const std::out_of_range&) {
+      return Value(std::stod(num));
+    }
+  }
+
+  const std::string& s_;
+  size_t pos_;
+};
+
+void EscapeTo(const std::string& s, std::string* out) {
+  out->push_back('"');
+  for (char c : s) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\b': *out += "\\b"; break;
+      case '\f': *out += "\\f"; break;
+      case '\n': *out += "\\n"; break;
+      case '\r': *out += "\\r"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          *out += buf;
+        } else {
+          out->push_back(c);
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+void DumpTo(const Value& v, int indent, int depth, std::string* out) {
+  const std::string nl = indent >= 0 ? "\n" : "";
+  const std::string pad =
+      indent >= 0 ? std::string((depth + 1) * indent, ' ') : "";
+  const std::string padc = indent >= 0 ? std::string(depth * indent, ' ') : "";
+  const char* colon = indent >= 0 ? ": " : ":";
+  switch (v.type()) {
+    case Type::Null: *out += "null"; break;
+    case Type::Bool: *out += v.AsBool() ? "true" : "false"; break;
+    case Type::Int: *out += std::to_string(v.AsInt()); break;
+    case Type::Double: {
+      double d = v.AsDouble();
+      if (std::isnan(d) || std::isinf(d)) {
+        *out += "null";  // JSON has no NaN/Inf
+      } else {
+        char buf[32];
+        std::snprintf(buf, sizeof(buf), "%.17g", d);
+        *out += buf;
+      }
+      break;
+    }
+    case Type::String: EscapeTo(v.AsString(), out); break;
+    case Type::Array: {
+      const Array& a = v.AsArray();
+      if (a.empty()) { *out += "[]"; break; }
+      *out += "[" + nl;
+      for (size_t i = 0; i < a.size(); ++i) {
+        *out += pad;
+        DumpTo(a[i], indent, depth + 1, out);
+        if (i + 1 < a.size()) *out += ",";
+        *out += nl;
+      }
+      *out += padc + "]";
+      break;
+    }
+    case Type::Object: {
+      const Object& o = v.AsObject();
+      if (o.empty()) { *out += "{}"; break; }
+      *out += "{" + nl;
+      size_t i = 0;
+      for (const auto& kv : o) {
+        *out += pad;
+        EscapeTo(kv.first, out);
+        *out += colon;
+        DumpTo(kv.second, indent, depth + 1, out);
+        if (++i < o.size()) *out += ",";
+        *out += nl;
+      }
+      *out += padc + "}";
+      break;
+    }
+  }
+}
+
+}  // namespace
+
+Value Parse(const std::string& text) { return Parser(text).ParseDocument(); }
+
+std::string Value::Dump(int indent) const {
+  std::string out;
+  DumpTo(*this, indent, 0, &out);
+  return out;
+}
+
+}  // namespace json
+}  // namespace ctpu

@@ -1,0 +1,91 @@
+// Minimal JSON value / parser / writer for the native client stack.
+//
+// Role parity: the reference links rapidjson for the same jobs (parsing
+// model metadata, perf_analyzer --input-data files, writing the profile
+// export; reference src/c++/library/json_utils.cc:1-47 and
+// src/c++/perf_analyzer/profile_data_exporter.cc). rapidjson is not in this
+// image, and the needs are small, so this is a self-contained DOM.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ctpu {
+namespace json {
+
+class Value;
+using Array = std::vector<Value>;
+// std::map keeps key order deterministic for golden-file tests.
+using Object = std::map<std::string, Value>;
+
+enum class Type { Null, Bool, Int, Double, String, Array, Object };
+
+class Value {
+ public:
+  Value() : type_(Type::Null) {}
+  Value(std::nullptr_t) : type_(Type::Null) {}
+  Value(bool b) : type_(Type::Bool), bool_(b) {}
+  Value(int i) : type_(Type::Int), int_(i) {}
+  Value(int64_t i) : type_(Type::Int), int_(i) {}
+  Value(uint64_t i) : type_(Type::Int), int_(static_cast<int64_t>(i)) {}
+  Value(double d) : type_(Type::Double), double_(d) {}
+  Value(const char* s) : type_(Type::String), string_(s) {}
+  Value(std::string s) : type_(Type::String), string_(std::move(s)) {}
+  Value(Array a) : type_(Type::Array), array_(std::move(a)) {}
+  Value(Object o) : type_(Type::Object), object_(std::move(o)) {}
+
+  Type type() const { return type_; }
+  bool IsNull() const { return type_ == Type::Null; }
+  bool IsBool() const { return type_ == Type::Bool; }
+  bool IsNumber() const {
+    return type_ == Type::Int || type_ == Type::Double;
+  }
+  bool IsInt() const { return type_ == Type::Int; }
+  bool IsString() const { return type_ == Type::String; }
+  bool IsArray() const { return type_ == Type::Array; }
+  bool IsObject() const { return type_ == Type::Object; }
+
+  bool AsBool() const { return bool_; }
+  int64_t AsInt() const {
+    return type_ == Type::Double ? static_cast<int64_t>(double_) : int_;
+  }
+  double AsDouble() const {
+    return type_ == Type::Int ? static_cast<double>(int_) : double_;
+  }
+  const std::string& AsString() const { return string_; }
+  const Array& AsArray() const { return array_; }
+  Array& AsArray() { return array_; }
+  const Object& AsObject() const { return object_; }
+  Object& AsObject() { return object_; }
+
+  // Object member access; returns a shared null sentinel when absent.
+  const Value& operator[](const std::string& key) const {
+    static const Value kNull;
+    auto it = object_.find(key);
+    return it == object_.end() ? kNull : it->second;
+  }
+  bool Has(const std::string& key) const {
+    return type_ == Type::Object && object_.count(key) > 0;
+  }
+
+  std::string Dump(int indent = -1) const;
+
+ private:
+  Type type_;
+  bool bool_ = false;
+  int64_t int_ = 0;
+  double double_ = 0.0;
+  std::string string_;
+  Array array_;
+  Object object_;
+};
+
+// Throws std::runtime_error with position info on malformed input.
+Value Parse(const std::string& text);
+
+}  // namespace json
+}  // namespace ctpu

@@ -1,0 +1,34 @@
+// POSIX system shared-memory helpers.
+//
+// Capability parity with the reference
+// (reference src/c++/library/shm_utils.h:1-66, shm_utils.cc:39-106):
+// create/map/close/unlink/unmap a /dev/shm region used as the zero-copy
+// host data plane between client and server.
+#pragma once
+
+#include <cstddef>
+
+#include "common.h"
+
+namespace ctpu {
+
+// Creates a shared-memory region named `shm_key` (e.g. "/my_region") of
+// `byte_size` and returns its fd (reference shm_utils.cc:39).
+Error CreateSharedMemoryRegion(const std::string& shm_key, size_t byte_size,
+                               int* shm_fd);
+
+// Maps `byte_size` bytes at `offset` of an open region into this process
+// (reference shm_utils.cc:60).
+Error MapSharedMemory(int shm_fd, size_t offset, size_t byte_size,
+                      void** shm_addr);
+
+// Closes the region fd (reference shm_utils.cc:75).
+Error CloseSharedMemory(int shm_fd);
+
+// Removes the named region from the system (reference shm_utils.cc:87).
+Error UnlinkSharedMemoryRegion(const std::string& shm_key);
+
+// Unmaps a previously mapped region (reference shm_utils.cc:98).
+Error UnmapSharedMemory(void* shm_addr, size_t byte_size);
+
+}  // namespace ctpu

@@ -1,0 +1,101 @@
+// Minimal HTTP inference example against the `simple` add_sub model.
+//
+// Parity with reference src/c++/examples/simple_http_infer_client.cc:
+// builds two INT32[1,16] inputs, runs a blocking Infer, validates
+// OUTPUT0 = INPUT0 + INPUT1 and OUTPUT1 = INPUT0 - INPUT1.
+
+#include <cstdint>
+#include <cstring>
+#include <iostream>
+#include <vector>
+
+#include "http_client.h"
+
+namespace {
+
+void FailOnError(const ctpu::Error& err, const char* what) {
+  if (!err.IsOk()) {
+    std::cerr << "error: " << what << ": " << err.Message() << std::endl;
+    exit(1);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  bool verbose = false;
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    if (arg == "-u" && i + 1 < argc) url = argv[++i];
+    if (arg == "-v") verbose = true;
+  }
+
+  std::unique_ptr<ctpu::InferenceServerHttpClient> client;
+  FailOnError(ctpu::InferenceServerHttpClient::Create(&client, url, verbose),
+              "create client");
+
+  bool live = false;
+  FailOnError(client->IsServerLive(&live), "server live");
+  if (!live) {
+    std::cerr << "error: server not live" << std::endl;
+    return 1;
+  }
+
+  std::vector<int32_t> input0_data(16), input1_data(16);
+  for (int i = 0; i < 16; ++i) {
+    input0_data[i] = i;
+    input1_data[i] = 1;
+  }
+
+  ctpu::InferInput input0("INPUT0", {1, 16}, "INT32");
+  ctpu::InferInput input1("INPUT1", {1, 16}, "INT32");
+  FailOnError(
+      input0.AppendRaw(reinterpret_cast<const uint8_t*>(input0_data.data()),
+                       input0_data.size() * sizeof(int32_t)),
+      "set INPUT0");
+  FailOnError(
+      input1.AppendRaw(reinterpret_cast<const uint8_t*>(input1_data.data()),
+                       input1_data.size() * sizeof(int32_t)),
+      "set INPUT1");
+
+  ctpu::InferRequestedOutput output0("OUTPUT0");
+  ctpu::InferRequestedOutput output1("OUTPUT1");
+
+  ctpu::InferOptions options("simple");
+  options.request_id = "1";
+
+  std::unique_ptr<ctpu::InferResult> result;
+  FailOnError(client->Infer(&result, options, {&input0, &input1},
+                            {&output0, &output1}),
+              "infer");
+  FailOnError(result->RequestStatus(), "request status");
+
+  const uint8_t* out0;
+  const uint8_t* out1;
+  size_t out0_size, out1_size;
+  FailOnError(result->RawData("OUTPUT0", &out0, &out0_size), "OUTPUT0 data");
+  FailOnError(result->RawData("OUTPUT1", &out1, &out1_size), "OUTPUT1 data");
+  if (out0_size != 64 || out1_size != 64) {
+    std::cerr << "error: unexpected output sizes " << out0_size << ", "
+              << out1_size << std::endl;
+    return 1;
+  }
+
+  const int32_t* sum = reinterpret_cast<const int32_t*>(out0);
+  const int32_t* diff = reinterpret_cast<const int32_t*>(out1);
+  for (int i = 0; i < 16; ++i) {
+    if (sum[i] != input0_data[i] + input1_data[i] ||
+        diff[i] != input0_data[i] - input1_data[i]) {
+      std::cerr << "error: incorrect result at " << i << std::endl;
+      return 1;
+    }
+    if (verbose) {
+      std::cout << input0_data[i] << " + " << input1_data[i] << " = "
+                << sum[i] << ", - = " << diff[i] << std::endl;
+    }
+  }
+
+  std::cout << "PASS : simple_http_infer_client" << std::endl;
+  return 0;
+}

@@ -120,6 +120,40 @@ def test_dataloader_json(tmp_path):
     )
 
 
+def test_dataloader_per_step_parameters(tmp_path):
+    path = tmp_path / "data.json"
+    path.write_text(
+        json.dumps(
+            {
+                "data": [
+                    {"IN": [1.0] * 8, "parameters": {"max_tokens": 7}},
+                    {"IN": [2.0] * 8},
+                ]
+            }
+        )
+    )
+    loader = make_loader()
+    loader.read_from_json(str(path))
+    assert loader.get_parameters(0, 0) == {"max_tokens": 7}
+    assert loader.get_parameters(0, 1) is None
+    # the "parameters" key must not be treated as an input tensor
+    assert [i.name for i in loader.get_inputs(0, 0)] == ["IN"]
+
+    # merged into the issued request (step overrides global)
+    backend = MockPerfBackend()
+    manager = ConcurrencyManager(
+        backend, "mock", loader, parameters={"max_tokens": 1, "top_k": 3}
+    )
+
+    async def run():
+        await manager.issue_one(0, 0)
+        await manager.issue_one(0, 1)
+
+    asyncio.run(run())
+    assert backend.requests[0]["parameters"] == {"max_tokens": 7, "top_k": 3}
+    assert backend.requests[1]["parameters"] == {"max_tokens": 1, "top_k": 3}
+
+
 def test_dataloader_json_multistream(tmp_path):
     path = tmp_path / "data.json"
     path.write_text(
