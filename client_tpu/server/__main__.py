@@ -29,7 +29,19 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--max-workers", type=int, default=8, help="model execution threads"
     )
+    parser.add_argument(
+        "--platform",
+        default=None,
+        help="force the JAX platform (e.g. 'cpu', 'tpu'); overrides any "
+        "site default — useful for dev loops on hosts where the default "
+        "platform is a remote TPU relay",
+    )
     args = parser.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     from client_tpu.server.core import ServerCore
     from client_tpu.server.model_repository import ModelRepository

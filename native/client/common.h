@@ -128,6 +128,10 @@ struct InferOptions {
   uint64_t client_timeout_us = 0;
   // Ask decoupled models to send an empty final response marker.
   bool enable_empty_final_response = false;
+  // Custom request parameters: name -> raw JSON fragment for the value
+  // (e.g. {"max_tokens", "32"} or {"note", "\"text\""}). Kept as raw JSON
+  // so this header stays free of the JSON library.
+  std::map<std::string, std::string> parameters;
 };
 
 // ---------------------------------------------------------------------------

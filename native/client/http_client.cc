@@ -586,6 +586,14 @@ Error InferenceServerHttpClient::GenerateRequestBody(
   if (options.server_timeout_us != 0) {
     params["timeout"] = json::Value((int64_t)options.server_timeout_us);
   }
+  for (const auto& kv : options.parameters) {
+    try {
+      params[kv.first] = json::Parse(kv.second);
+    } catch (const std::exception&) {
+      return Error("request parameter '" + kv.first +
+                   "' is not valid JSON: " + kv.second);
+    }
+  }
 
   json::Array jinputs;
   size_t binary_total = 0;

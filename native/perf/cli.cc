@@ -1,0 +1,280 @@
+#include "cli.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <sstream>
+
+namespace ctpu {
+namespace perf {
+
+namespace {
+
+Error ParseRange(const std::string& value, double* start, double* end,
+                 double* step) {
+  std::stringstream ss(value);
+  std::string part;
+  double vals[3] = {0, 0, 1};
+  int i = 0;
+  while (std::getline(ss, part, ':') && i < 3) {
+    try {
+      vals[i++] = std::stod(part);
+    } catch (...) {
+      return Error("bad range component '" + part + "'");
+    }
+  }
+  if (i == 0) return Error("empty range");
+  *start = vals[0];
+  *end = i >= 2 ? vals[1] : vals[0];
+  *step = i >= 3 ? vals[2] : 1;
+  return Error::Success();
+}
+
+// name:d1,d2,... or name:d1/d2/... (reference --shape INPUT:1,3,224,224)
+Error ParseShape(const std::string& value,
+                 std::map<std::string, std::vector<int64_t>>* out) {
+  size_t colon = value.find(':');
+  if (colon == std::string::npos) {
+    return Error("bad --shape '" + value + "' (want name:d1,d2,...)");
+  }
+  std::string name = value.substr(0, colon);
+  std::vector<int64_t> dims;
+  std::stringstream ss(value.substr(colon + 1));
+  std::string part;
+  while (std::getline(ss, part, ',')) {
+    try {
+      dims.push_back(std::stoll(part));
+    } catch (...) {
+      return Error("bad --shape dim '" + part + "'");
+    }
+  }
+  if (dims.empty()) return Error("empty --shape for '" + name + "'");
+  (*out)[name] = dims;
+  return Error::Success();
+}
+
+// name:value:type -> raw JSON fragment (reference --request-parameter)
+Error ParseRequestParameter(const std::string& value,
+                            std::map<std::string, std::string>* out) {
+  size_t c1 = value.find(':');
+  size_t c2 = value.rfind(':');
+  if (c1 == std::string::npos || c2 == c1) {
+    return Error("bad --request-parameter '" + value +
+                 "' (want name:value:type)");
+  }
+  std::string name = value.substr(0, c1);
+  std::string val = value.substr(c1 + 1, c2 - c1 - 1);
+  std::string type = value.substr(c2 + 1);
+  if (type == "int" || type == "uint") {
+    (*out)[name] = val;
+  } else if (type == "float" || type == "double") {
+    (*out)[name] = val;
+  } else if (type == "bool") {
+    (*out)[name] = (val == "true" || val == "1") ? "true" : "false";
+  } else if (type == "string") {
+    std::string escaped = "\"";
+    for (char c : val) {
+      if (c == '"' || c == '\\') escaped += '\\';
+      escaped += c;
+    }
+    escaped += '"';
+    (*out)[name] = escaped;
+  } else {
+    return Error("bad --request-parameter type '" + type + "'");
+  }
+  return Error::Success();
+}
+
+}  // namespace
+
+std::string Usage() {
+  return
+      "usage: perf_analyzer -m <model> [options]\n"
+      "  -m/--model-name NAME        model to benchmark (required)\n"
+      "  -x/--model-version VER      model version\n"
+      "  -u/--url HOST:PORT          server url (default localhost:8000)\n"
+      "  -i/--protocol http          service protocol (http)\n"
+      "  -b/--batch-size N           batch size (default 1)\n"
+      "  --concurrency-range S:E:T   closed-loop concurrency sweep\n"
+      "  --request-rate-range S:E:T  open-loop request-rate sweep\n"
+      "  --request-intervals FILE    replay inter-request intervals (ns per "
+      "line)\n"
+      "  --periodic-concurrency-range S:E:T  concurrency ramp (LLM mode)\n"
+      "  --request-period N          requests per periodic step\n"
+      "  --request-distribution D    constant | poisson\n"
+      "  --measurement-interval MS   window length (default 5000)\n"
+      "  --stability-percentage P    stability band (default 10)\n"
+      "  --max-trials N              max windows per point (default 10)\n"
+      "  --latency-threshold MS      stop sweep past this latency\n"
+      "  --percentile P              latency percentile for stability\n"
+      "  --warmup-request-period S   warmup seconds before measuring\n"
+      "  --input-data FILE           input-data JSON\n"
+      "  --shape NAME:D1,D2,...      shape override for dynamic dims\n"
+      "  --shared-memory MODE        none | system\n"
+      "  --streaming                 streaming mode flag\n"
+      "  --sequence-length N         sequence length (default 20)\n"
+      "  --sequence-length-variation P  +-pct length variation\n"
+      "  --num-of-sequences N        concurrent sequences (default 4)\n"
+      "  --sequence-model            treat model as sequence model\n"
+      "  --request-parameter N:V:T   custom request parameter\n"
+      "  --max-threads N             open-loop pool size (default 32)\n"
+      "  --random-seed N             seed for schedules/data\n"
+      "  -f FILE                     CSV report path\n"
+      "  --profile-export-file FILE  per-request JSON export\n"
+      "  --json-summary              print one-line JSON summary\n"
+      "  -v/--verbose                verbose output\n";
+}
+
+Error ParseArgs(int argc, char** argv, PAParams* params) {
+  auto need = [&](int i) -> Error {
+    if (i + 1 >= argc) {
+      return Error(std::string("flag ") + argv[i] + " needs a value");
+    }
+    return Error::Success();
+  };
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    auto next = [&]() { return std::string(argv[++i]); };
+    Error err;
+    try {
+    if (arg == "-m" || arg == "--model-name") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->model_name = next();
+    } else if (arg == "-x" || arg == "--model-version") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->model_version = next();
+    } else if (arg == "-u" || arg == "--url") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->url = next();
+    } else if (arg == "-i" || arg == "--protocol") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->protocol = next();
+    } else if (arg == "-b" || arg == "--batch-size") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->batch_size = std::stoll(next());
+    } else if (arg == "--concurrency-range") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      double s, e, t;
+      CTPU_RETURN_IF_ERROR(ParseRange(next(), &s, &e, &t));
+      params->has_concurrency_range = true;
+      params->concurrency_start = (size_t)s;
+      params->concurrency_end = (size_t)e;
+      params->concurrency_step = (size_t)t;
+    } else if (arg == "--request-rate-range") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      CTPU_RETURN_IF_ERROR(ParseRange(next(), &params->rate_start,
+                                      &params->rate_end,
+                                      &params->rate_step));
+      params->has_request_rate_range = true;
+    } else if (arg == "--request-intervals") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->request_intervals_file = next();
+    } else if (arg == "--periodic-concurrency-range") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      double s, e, t;
+      CTPU_RETURN_IF_ERROR(ParseRange(next(), &s, &e, &t));
+      params->has_periodic_range = true;
+      params->periodic_start = (size_t)s;
+      params->periodic_end = (size_t)e;
+      params->periodic_step = (size_t)t;
+    } else if (arg == "--request-period") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->request_period = (size_t)std::stoull(next());
+    } else if (arg == "--request-distribution") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->request_distribution = next();
+    } else if (arg == "--measurement-interval" || arg == "-p") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->measurement_interval_ms = std::stod(next());
+    } else if (arg == "--stability-percentage" || arg == "-s") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->stability_percentage = std::stod(next());
+    } else if (arg == "--max-trials" || arg == "-r") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->max_trials = (size_t)std::stoull(next());
+    } else if (arg == "--latency-threshold" || arg == "-l") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->latency_threshold_ms = std::stod(next());
+    } else if (arg == "--percentile") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->percentile = std::atoi(next().c_str());
+    } else if (arg == "--warmup-request-period") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->warmup_s = std::stod(next());
+    } else if (arg == "--input-data") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->input_data_file = next();
+    } else if (arg == "--shape") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      CTPU_RETURN_IF_ERROR(ParseShape(next(), &params->shape_overrides));
+    } else if (arg == "--shared-memory") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->shared_memory = next();
+    } else if (arg == "--streaming") {
+      params->streaming = true;
+    } else if (arg == "--sequence-length") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->sequence_length = std::atoi(next().c_str());
+    } else if (arg == "--sequence-length-variation") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->sequence_length_variation = std::stod(next());
+    } else if (arg == "--num-of-sequences") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->num_of_sequences = (size_t)std::stoull(next());
+    } else if (arg == "--sequence-model") {
+      params->force_sequences = true;
+    } else if (arg == "--request-parameter") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      CTPU_RETURN_IF_ERROR(
+          ParseRequestParameter(next(), &params->request_parameters));
+    } else if (arg == "--max-threads") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->max_threads = (size_t)std::stoull(next());
+    } else if (arg == "--random-seed") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->random_seed = std::stoull(next());
+    } else if (arg == "-f") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->csv_file = next();
+    } else if (arg == "--profile-export-file") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->profile_export_file = next();
+    } else if (arg == "--json-summary") {
+      params->json_summary = true;
+    } else if (arg == "-v" || arg == "--verbose") {
+      params->verbose = true;
+    } else if (arg == "-h" || arg == "--help") {
+      return Error("help");
+    } else {
+      return Error("unknown flag '" + arg + "'");
+    }
+    } catch (const std::exception&) {
+      return Error("bad value for flag '" + arg + "'");
+    }
+  }
+  if (params->model_name.empty()) {
+    return Error("-m <model> is required");
+  }
+  if (params->protocol != "http") {
+    return Error("this build supports -i http (native gRPC client uses the "
+                 "Python harness: perf-analyzer-tpu -i grpc)");
+  }
+  if (params->streaming) {
+    return Error("--streaming needs the gRPC decoupled path; use the Python "
+                 "harness: perf-analyzer-tpu -i grpc --streaming");
+  }
+  int modes = (params->has_concurrency_range ? 1 : 0) +
+              (params->has_request_rate_range ? 1 : 0) +
+              (!params->request_intervals_file.empty() ? 1 : 0) +
+              (params->has_periodic_range ? 1 : 0);
+  if (modes > 1) {
+    return Error("choose one of --concurrency-range, --request-rate-range, "
+                 "--request-intervals, --periodic-concurrency-range");
+  }
+  if (modes == 0) {
+    params->has_concurrency_range = true;  // default: concurrency 1
+  }
+  return Error::Success();
+}
+
+}  // namespace perf
+}  // namespace ctpu

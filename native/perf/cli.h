@@ -1,0 +1,63 @@
+// CLI option table → PAParams (reference command_line_parser.{h,cc}; flag
+// names follow the reference's perf_analyzer for drop-in familiarity).
+#pragma once
+
+#include <map>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace ctpu {
+namespace perf {
+
+struct PAParams {
+  std::string model_name;
+  std::string model_version;
+  std::string url = "localhost:8000";
+  std::string protocol = "http";
+  int64_t batch_size = 1;
+
+  bool has_concurrency_range = false;
+  size_t concurrency_start = 1, concurrency_end = 1, concurrency_step = 1;
+  bool has_request_rate_range = false;
+  double rate_start = 0, rate_end = 0, rate_step = 1;
+  std::string request_intervals_file;
+  bool has_periodic_range = false;
+  size_t periodic_start = 1, periodic_end = 1, periodic_step = 1;
+  size_t request_period = 10;
+  std::string request_distribution = "constant";
+
+  double measurement_interval_ms = 5000;
+  double stability_percentage = 10;
+  size_t max_trials = 10;
+  double latency_threshold_ms = 0;
+  int percentile = 0;  // 0 = use average latency for stability
+  double warmup_s = 0;
+
+  std::string input_data_file;
+  std::map<std::string, std::vector<int64_t>> shape_overrides;
+  std::string shared_memory = "none";  // none | system
+  bool streaming = false;
+
+  int sequence_length = 20;
+  double sequence_length_variation = 20.0;
+  size_t num_of_sequences = 4;
+  bool force_sequences = false;
+
+  std::map<std::string, std::string> request_parameters;  // raw JSON values
+  size_t max_threads = 32;
+  uint64_t random_seed = 0;
+
+  std::string csv_file;
+  std::string profile_export_file;
+  bool json_summary = false;
+  bool verbose = false;
+};
+
+// Returns an error message on bad flags (and fills params otherwise).
+Error ParseArgs(int argc, char** argv, PAParams* params);
+std::string Usage();
+
+}  // namespace perf
+}  // namespace ctpu

@@ -1,0 +1,22 @@
+#include "client_backend.h"
+
+#include "http_backend.h"
+#include "mock_backend.h"
+
+namespace ctpu {
+namespace perf {
+
+Error CreateClientBackend(const BackendFactoryConfig& config,
+                          std::shared_ptr<ClientBackend>* backend) {
+  switch (config.kind) {
+    case BackendKind::KSERVE_HTTP:
+      return HttpClientBackend::Create(config.url, config.verbose, backend);
+    case BackendKind::MOCK:
+      backend->reset(new MockClientBackend());
+      return Error::Success();
+  }
+  return Error("unknown backend kind");
+}
+
+}  // namespace perf
+}  // namespace ctpu

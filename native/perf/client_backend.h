@@ -1,0 +1,92 @@
+// Service-agnostic client-backend abstraction for the perf harness.
+//
+// Role parity with the reference's client_backend layer
+// (reference src/c++/perf_analyzer/client_backend/client_backend.h:134-660):
+// a factory + abstract backend the load managers drive, so the harness is
+// testable against a mock and retargetable at different services. This
+// build ships the KServe v2 HTTP backend (the TPU server's wire protocol)
+// and a mock; each worker thread owns a BackendContext (its own
+// connection), the blocking-thread re-expression of the reference's
+// per-context async clients (reference infer_context.h:93).
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "common.h"
+#include "json.h"
+#include "records.h"
+
+namespace ctpu {
+namespace perf {
+
+enum class BackendKind { KSERVE_HTTP, MOCK };
+
+// One worker's issuing handle; not thread-safe (one context per thread).
+class BackendContext {
+ public:
+  virtual ~BackendContext() = default;
+
+  // Blocking inference. Fills record timestamps (start/end/send/recv and
+  // one response_ns entry; streaming backends append several).
+  virtual Error Infer(const InferOptions& options,
+                      const std::vector<InferInput*>& inputs,
+                      const std::vector<const InferRequestedOutput*>& outputs,
+                      RequestRecord* record) = 0;
+};
+
+class ClientBackend {
+ public:
+  virtual ~ClientBackend() = default;
+
+  virtual BackendKind Kind() const = 0;
+  virtual Error ModelMetadata(json::Value* metadata,
+                              const std::string& model_name,
+                              const std::string& model_version) = 0;
+  virtual Error ModelConfig(json::Value* config,
+                            const std::string& model_name,
+                            const std::string& model_version) = 0;
+  // Inference statistics snapshot: field -> (count, total_ns)
+  // (reference ClientBackend::ModelInferenceStatistics,
+  // client_backend.h:423-426).
+  virtual Error InferenceStatistics(
+      std::map<std::string, std::pair<uint64_t, uint64_t>>* stats,
+      const std::string& model_name) {
+    (void)stats;
+    (void)model_name;
+    return Error("inference statistics not supported by this backend");
+  }
+  virtual std::unique_ptr<BackendContext> CreateContext() = 0;
+
+  // Shared-memory registration passthrough (system shm data plane;
+  // reference client_backend.h:433-485).
+  virtual Error RegisterSystemSharedMemory(const std::string& name,
+                                           const std::string& key,
+                                           size_t byte_size) {
+    (void)name;
+    (void)key;
+    (void)byte_size;
+    return Error("shared memory not supported by this backend");
+  }
+  virtual Error UnregisterSystemSharedMemory(const std::string& name) {
+    (void)name;
+    return Error("shared memory not supported by this backend");
+  }
+};
+
+struct BackendFactoryConfig {
+  BackendKind kind = BackendKind::KSERVE_HTTP;
+  std::string url = "localhost:8000";
+  bool verbose = false;
+};
+
+// reference ClientBackendFactory::Create (client_backend.h:292)
+Error CreateClientBackend(const BackendFactoryConfig& config,
+                          std::shared_ptr<ClientBackend>* backend);
+
+}  // namespace perf
+}  // namespace ctpu

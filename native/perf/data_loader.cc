@@ -1,0 +1,260 @@
+#include "data_loader.h"
+
+#include <cstring>
+#include <fstream>
+#include <sstream>
+
+namespace ctpu {
+namespace perf {
+
+namespace {
+
+// Base64 decode (for {"b64": ...} raw blobs in input-data files).
+std::string B64Decode(const std::string& in) {
+  auto val = [](char c) -> int {
+    if (c >= 'A' && c <= 'Z') return c - 'A';
+    if (c >= 'a' && c <= 'z') return c - 'a' + 26;
+    if (c >= '0' && c <= '9') return c - '0' + 52;
+    if (c == '+') return 62;
+    if (c == '/') return 63;
+    return -1;
+  };
+  std::string out;
+  int buf = 0, bits = 0;
+  for (char c : in) {
+    int v = val(c);
+    if (v < 0) continue;  // skip padding/whitespace
+    buf = (buf << 6) | v;
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out += (char)((buf >> bits) & 0xFF);
+    }
+  }
+  return out;
+}
+
+template <typename T>
+void AppendAs(std::string* bytes, double v) {
+  T t = (T)v;
+  bytes->append(reinterpret_cast<const char*>(&t), sizeof(t));
+}
+
+// Flatten a JSON content value (possibly nested arrays) into wire bytes.
+void FlattenContent(const json::Value& v, const std::string& dtype,
+                    std::string* bytes, int64_t* count) {
+  if (v.IsArray()) {
+    for (const auto& e : v.AsArray()) FlattenContent(e, dtype, bytes, count);
+    return;
+  }
+  (*count)++;
+  if (dtype == "BYTES") {
+    const std::string& s = v.AsString();
+    uint32_t len = (uint32_t)s.size();
+    bytes->append(reinterpret_cast<const char*>(&len), 4);
+    bytes->append(s);
+  } else if (dtype == "BOOL") {
+    AppendAs<uint8_t>(bytes, v.AsBool() ? 1 : 0);
+  } else if (dtype == "INT8") AppendAs<int8_t>(bytes, (double)v.AsInt());
+  else if (dtype == "UINT8") AppendAs<uint8_t>(bytes, (double)v.AsInt());
+  else if (dtype == "INT16") AppendAs<int16_t>(bytes, (double)v.AsInt());
+  else if (dtype == "UINT16") AppendAs<uint16_t>(bytes, (double)v.AsInt());
+  else if (dtype == "INT32") AppendAs<int32_t>(bytes, (double)v.AsInt());
+  else if (dtype == "UINT32") AppendAs<uint32_t>(bytes, (double)v.AsInt());
+  else if (dtype == "INT64") AppendAs<int64_t>(bytes, (double)v.AsInt());
+  else if (dtype == "UINT64") AppendAs<uint64_t>(bytes, (double)v.AsInt());
+  else if (dtype == "FP32") AppendAs<float>(bytes, v.AsDouble());
+  else if (dtype == "FP64") AppendAs<double>(bytes, v.AsDouble());
+  else if (dtype == "FP16" || dtype == "BF16") {
+    // BF16: truncate an FP32 to its top half (round-to-nearest-even is the
+    // server's job on exact data; input corpora use representable values).
+    float f = (float)v.AsDouble();
+    uint32_t u;
+    std::memcpy(&u, &f, 4);
+    uint16_t h = (uint16_t)(u >> 16);
+    bytes->append(reinterpret_cast<const char*>(&h), 2);
+  }
+}
+
+}  // namespace
+
+Error DataLoader::ResolveShape(const TensorDesc& desc,
+                               std::vector<int64_t>* shape) {
+  shape->clear();
+  bool first = true;
+  for (int64_t d : desc.shape) {
+    if (d < 0) {
+      if (first && parser_->SupportsBatching()) {
+        shape->push_back(batch_size_);
+      } else {
+        auto it = shape_overrides_.find(desc.name);
+        if (it == shape_overrides_.end()) {
+          return Error("input '" + desc.name +
+                       "' has dynamic shape; provide --shape override");
+        }
+        // override replaces the remaining dynamic dims wholesale
+        *shape = it->second;
+        return Error::Success();
+      }
+    } else {
+      shape->push_back(d);
+    }
+    first = false;
+  }
+  return Error::Success();
+}
+
+Error DataLoader::GenerateSynthetic(bool zero_data) {
+  StepData step;
+  for (const TensorDesc& desc : parser_->Inputs()) {
+    TensorData tensor;
+    tensor.name = desc.name;
+    tensor.datatype = desc.datatype;
+    CTPU_RETURN_IF_ERROR(ResolveShape(desc, &tensor.shape));
+    int64_t count = ShapeNumElements(tensor.shape);
+    if (desc.datatype == "BYTES") {
+      for (int64_t i = 0; i < count; ++i) {
+        std::string s = "synthetic_" + std::to_string(i);
+        uint32_t len = (uint32_t)s.size();
+        tensor.bytes.append(reinterpret_cast<const char*>(&len), 4);
+        tensor.bytes.append(s);
+      }
+    } else {
+      int64_t elem = DtypeByteSize(desc.datatype);
+      if (elem <= 0) {
+        return Error("cannot generate data for dtype '" + desc.datatype +
+                     "'");
+      }
+      tensor.bytes.resize((size_t)(count * elem));
+      if (!zero_data) {
+        // fill with uniform bytes; numeric garbage is fine for load
+        // generation (reference perf_utils GenerateRandom semantics), but
+        // keep float exponents sane by masking to small positives
+        if (desc.datatype == "FP32") {
+          float* f = reinterpret_cast<float*>(&tensor.bytes[0]);
+          for (int64_t i = 0; i < count; ++i) {
+            f[i] = (float)((rng_() % 1000) / 1000.0);
+          }
+        } else if (desc.datatype == "FP64") {
+          double* f = reinterpret_cast<double*>(&tensor.bytes[0]);
+          for (int64_t i = 0; i < count; ++i) {
+            f[i] = (double)((rng_() % 1000) / 1000.0);
+          }
+        } else {
+          for (auto& c : tensor.bytes) c = (char)(rng_() % 100);
+        }
+      }
+    }
+    step.tensors.push_back(std::move(tensor));
+  }
+  streams_.clear();
+  streams_.push_back({std::move(step)});
+  return Error::Success();
+}
+
+Error DataLoader::MaterializeTensor(const TensorDesc& desc,
+                                    const json::Value& value,
+                                    TensorData* out) {
+  out->name = desc.name;
+  out->datatype = desc.datatype;
+  if (value.IsObject() && value.Has("b64")) {
+    out->bytes = B64Decode(value["b64"].AsString());
+    if (value.Has("shape")) {
+      for (const auto& d : value["shape"].AsArray()) {
+        out->shape.push_back(d.AsInt());
+      }
+    } else {
+      CTPU_RETURN_IF_ERROR(ResolveShape(desc, &out->shape));
+    }
+    return Error::Success();
+  }
+  const json::Value& content =
+      value.IsObject() && value.Has("content") ? value["content"] : value;
+  int64_t count = 0;
+  FlattenContent(content, desc.datatype, &out->bytes, &count);
+  if (value.IsObject() && value.Has("shape")) {
+    for (const auto& d : value["shape"].AsArray()) {
+      out->shape.push_back(d.AsInt());
+    }
+  } else {
+    out->shape = {count};
+  }
+  return Error::Success();
+}
+
+Error DataLoader::ParseStep(const json::Value& step, StepData* out) {
+  std::map<std::string, const TensorDesc*> descs;
+  for (const TensorDesc& d : parser_->Inputs()) descs[d.name] = &d;
+  for (const auto& kv : step.AsObject()) {
+    if (kv.first == "parameters") {
+      out->parameters = kv.second;
+      continue;
+    }
+    auto it = descs.find(kv.first);
+    if (it == descs.end()) {
+      return Error("input data references unknown input '" + kv.first + "'");
+    }
+    TensorData tensor;
+    CTPU_RETURN_IF_ERROR(MaterializeTensor(*it->second, kv.second, &tensor));
+    out->tensors.push_back(std::move(tensor));
+  }
+  return Error::Success();
+}
+
+Error DataLoader::ReadFromJson(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) return Error("cannot open input data file '" + path + "'");
+  std::stringstream ss;
+  ss << f.rdbuf();
+  json::Value doc;
+  try {
+    doc = json::Parse(ss.str());
+  } catch (const std::exception& e) {
+    return Error("malformed input data file '" + path + "': " + e.what());
+  }
+  if (!doc.Has("data") || !doc["data"].IsArray()) {
+    return Error("input data file '" + path + "' missing top-level 'data'");
+  }
+  const json::Array& entries = doc["data"].AsArray();
+  if (entries.empty()) {
+    return Error("input data file '" + path + "' has an empty 'data' list");
+  }
+  for (const auto& entry : entries) {
+    if (entry.IsArray() && entry.AsArray().empty()) {
+      return Error("input data file '" + path +
+                   "' contains an empty stream");
+    }
+  }
+  bool nested = !entries.empty() && entries[0].IsArray();
+  streams_.clear();
+  if (nested) {
+    // list of streams, each a list of steps
+    for (const auto& entry : entries) {
+      std::vector<StepData> stream;
+      for (const auto& step : entry.AsArray()) {
+        StepData sd;
+        CTPU_RETURN_IF_ERROR(ParseStep(step, &sd));
+        stream.push_back(std::move(sd));
+      }
+      streams_.push_back(std::move(stream));
+    }
+  } else {
+    // flat list of steps = one stream (reference semantics)
+    std::vector<StepData> stream;
+    for (const auto& step : entries) {
+      StepData sd;
+      CTPU_RETURN_IF_ERROR(ParseStep(step, &sd));
+      stream.push_back(std::move(sd));
+    }
+    streams_.push_back(std::move(stream));
+  }
+  return Error::Success();
+}
+
+const StepData& DataLoader::GetStep(size_t stream, size_t step) const {
+  const auto& s = streams_[stream % streams_.size()];
+  return s[step % s.size()];
+}
+
+}  // namespace perf
+}  // namespace ctpu

@@ -1,0 +1,69 @@
+// Input corpus: synthetic generation or multi-stream --input-data JSON
+// (reference data_loader.h:41-229 — ReadDataFromJSON/GenerateData with
+// stream/step indexing; per-step request parameters match the Python
+// harness's extension in client_tpu/perf/data.py).
+#pragma once
+
+#include <map>
+#include <memory>
+#include <random>
+#include <string>
+#include <vector>
+
+#include "model_parser.h"
+
+namespace ctpu {
+namespace perf {
+
+// One materialized tensor: owned raw bytes in wire layout.
+struct TensorData {
+  std::string name;
+  std::string datatype;
+  std::vector<int64_t> shape;
+  std::string bytes;
+};
+
+// One step: tensors + optional per-step request parameters (name -> JSON).
+struct StepData {
+  std::vector<TensorData> tensors;
+  json::Value parameters;  // Null when absent
+};
+
+class DataLoader {
+ public:
+  DataLoader(const ModelParser* parser, int64_t batch_size,
+             std::map<std::string, std::vector<int64_t>> shape_overrides = {},
+             uint64_t seed = 0)
+      : parser_(parser),
+        batch_size_(batch_size),
+        shape_overrides_(std::move(shape_overrides)),
+        rng_(seed) {}
+
+  // One stream, one step of random data per input (reference GenerateData).
+  Error GenerateSynthetic(bool zero_data = false);
+
+  // Load the --input-data JSON document (reference ReadDataFromJSON).
+  Error ReadFromJson(const std::string& path);
+
+  size_t StreamCount() const { return streams_.size(); }
+  size_t StepCount(size_t stream) const {
+    return stream < streams_.size() ? streams_[stream].size() : 0;
+  }
+  // Wraps indices modulo available data.
+  const StepData& GetStep(size_t stream, size_t step) const;
+
+ private:
+  Error ResolveShape(const TensorDesc& desc, std::vector<int64_t>* shape);
+  Error ParseStep(const json::Value& step, StepData* out);
+  Error MaterializeTensor(const TensorDesc& desc, const json::Value& value,
+                          TensorData* out);
+
+  const ModelParser* parser_;
+  int64_t batch_size_;
+  std::map<std::string, std::vector<int64_t>> shape_overrides_;
+  std::mt19937_64 rng_;
+  std::vector<std::vector<StepData>> streams_;
+};
+
+}  // namespace perf
+}  // namespace ctpu

@@ -1,0 +1,111 @@
+#include "http_backend.h"
+
+#include <cctype>
+#include <cstdlib>
+
+namespace ctpu {
+namespace perf {
+
+Error HttpClientBackend::Create(const std::string& url, bool verbose,
+                                std::shared_ptr<ClientBackend>* backend) {
+  size_t colon = url.rfind(':');
+  if (colon == std::string::npos) {
+    return Error("url must be host:port, got '" + url + "'");
+  }
+  auto* b = new HttpClientBackend(url.substr(0, colon),
+                                  std::atoi(url.c_str() + colon + 1));
+  Error err = InferenceServerHttpClient::Create(&b->client_, url, verbose,
+                                                /*async_workers=*/0);
+  if (!err.IsOk()) {
+    delete b;
+    return err;
+  }
+  backend->reset(b);
+  return Error::Success();
+}
+
+Error HttpClientBackend::InferenceStatistics(
+    std::map<std::string, std::pair<uint64_t, uint64_t>>* stats,
+    const std::string& model_name) {
+  json::Value doc;
+  CTPU_RETURN_IF_ERROR(client_->ModelInferenceStatistics(&doc, model_name));
+  stats->clear();
+  if (!doc["model_stats"].IsArray()) return Error::Success();
+  for (const auto& entry : doc["model_stats"].AsArray()) {
+    if (entry["name"].AsString() != model_name) continue;
+    if (!entry["inference_stats"].IsObject()) continue;
+    for (const auto& kv : entry["inference_stats"].AsObject()) {
+      const json::Value& duration = kv.second;
+      if (duration.IsObject()) {
+        (*stats)[kv.first] = {(uint64_t)duration["count"].AsInt(),
+                              (uint64_t)duration["ns"].AsInt()};
+      }
+    }
+  }
+  return Error::Success();
+}
+
+Error HttpBackendContext::Infer(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    RequestRecord* record) {
+  record->start_ns = RequestTimers::Now();
+
+  std::string body;
+  size_t header_length = 0;
+  CTPU_RETURN_IF_ERROR(InferenceServerHttpClient::GenerateRequestBody(
+      &body, &header_length, options, inputs, outputs));
+
+  std::string uri = "v2/models/" + options.model_name;
+  if (!options.model_version.empty()) {
+    uri += "/versions/" + options.model_version;
+  }
+  uri += "/infer";
+  std::vector<std::string> headers = {
+      "Content-Type: application/octet-stream",
+      "Inference-Header-Content-Length: " + std::to_string(header_length)};
+
+  uint64_t send_start = RequestTimers::Now();
+  int status = 0;
+  std::string resp_headers, resp_body;
+  Error err =
+      conn_.Roundtrip("POST", uri, headers, body.data(), body.size(), &status,
+                      &resp_headers, &resp_body, options.client_timeout_us);
+  uint64_t recv_end = RequestTimers::Now();
+  if (!err.IsOk()) {
+    record->success = false;
+    record->error = err.Message();
+    record->end_ns = recv_end;
+    return err;
+  }
+
+  size_t json_size = 0;
+  {
+    std::string lower;
+    lower.reserve(resp_headers.size());
+    for (char c : resp_headers) lower += std::tolower((unsigned char)c);
+    const std::string needle = "\r\ninference-header-content-length:";
+    size_t pos = lower.find(needle);
+    if (pos != std::string::npos) {
+      json_size = std::strtoul(resp_headers.c_str() + pos + needle.size(),
+                               nullptr, 10);
+    }
+  }
+  std::unique_ptr<InferResult> result;
+  err = InferResultHttp::Create(&result, status, std::move(resp_body),
+                                json_size);
+  if (err.IsOk()) err = result->RequestStatus();
+
+  record->send_ns = send_start - record->start_ns;
+  record->recv_ns = recv_end - send_start;
+  record->response_ns.push_back(recv_end);
+  record->end_ns = RequestTimers::Now();
+  if (!err.IsOk()) {
+    record->success = false;
+    record->error = err.Message();
+  }
+  return err;
+}
+
+}  // namespace perf
+}  // namespace ctpu

@@ -1,0 +1,66 @@
+// KServe v2 HTTP backend: wraps the native client library
+// (role of the reference's triton backend wrapping the L2 C++ library,
+// reference client_backend/triton/triton_client_backend.h:72-205).
+#pragma once
+
+#include "client_backend.h"
+#include "http_client.h"
+
+namespace ctpu {
+namespace perf {
+
+class HttpBackendContext : public BackendContext {
+ public:
+  HttpBackendContext(const std::string& host, int port)
+      : conn_(host, port) {}
+
+  Error Infer(const InferOptions& options,
+              const std::vector<InferInput*>& inputs,
+              const std::vector<const InferRequestedOutput*>& outputs,
+              RequestRecord* record) override;
+
+ private:
+  HttpConnection conn_;
+};
+
+class HttpClientBackend : public ClientBackend {
+ public:
+  static Error Create(const std::string& url, bool verbose,
+                      std::shared_ptr<ClientBackend>* backend);
+
+  BackendKind Kind() const override { return BackendKind::KSERVE_HTTP; }
+  Error ModelMetadata(json::Value* metadata, const std::string& model_name,
+                      const std::string& model_version) override {
+    return client_->ModelMetadata(metadata, model_name, model_version);
+  }
+  Error ModelConfig(json::Value* config, const std::string& model_name,
+                    const std::string& model_version) override {
+    return client_->ModelConfig(config, model_name, model_version);
+  }
+  Error InferenceStatistics(
+      std::map<std::string, std::pair<uint64_t, uint64_t>>* stats,
+      const std::string& model_name) override;
+  std::unique_ptr<BackendContext> CreateContext() override {
+    return std::unique_ptr<BackendContext>(
+        new HttpBackendContext(host_, port_));
+  }
+  Error RegisterSystemSharedMemory(const std::string& name,
+                                   const std::string& key,
+                                   size_t byte_size) override {
+    return client_->RegisterSystemSharedMemory(name, key, byte_size);
+  }
+  Error UnregisterSystemSharedMemory(const std::string& name) override {
+    return client_->UnregisterSystemSharedMemory(name);
+  }
+
+ private:
+  HttpClientBackend(std::string host, int port)
+      : host_(std::move(host)), port_(port) {}
+
+  std::string host_;
+  int port_;
+  std::unique_ptr<InferenceServerHttpClient> client_;
+};
+
+}  // namespace perf
+}  // namespace ctpu

@@ -1,0 +1,97 @@
+#include "infer_data.h"
+
+#include <unistd.h>
+
+#include <cstring>
+
+#include "shm_utils.h"
+
+namespace ctpu {
+namespace perf {
+
+InferDataManagerShm::~InferDataManagerShm() { Cleanup(); }
+
+Error InferDataManagerShm::Init() {
+  if (initialized_) return Error::Success();
+  // Unique key prefix per process so parallel runs don't collide.
+  std::string pid = std::to_string(getpid());
+  for (size_t stream = 0; stream < loader_->StreamCount(); ++stream) {
+    regions_.emplace_back();
+    for (size_t step = 0; step < loader_->StepCount(stream); ++step) {
+      regions_.back().emplace_back();
+      const StepData& data = loader_->GetStep(stream, step);
+      size_t input_index = 0;
+      for (const TensorData& tensor : data.tensors) {
+        Region region;
+        region.name = prefix_ + "_" + pid + "_s" + std::to_string(stream) +
+                      "_t" + std::to_string(step) + "_i" +
+                      std::to_string(input_index);
+        region.key = "/" + region.name;
+        region.byte_size = tensor.bytes.size();
+        CTPU_RETURN_IF_ERROR(CreateSharedMemoryRegion(
+            region.key, region.byte_size, &region.fd));
+        CTPU_RETURN_IF_ERROR(MapSharedMemory(region.fd, 0, region.byte_size,
+                                             &region.addr));
+        std::memcpy(region.addr, tensor.bytes.data(), region.byte_size);
+        CTPU_RETURN_IF_ERROR(backend_->RegisterSystemSharedMemory(
+            region.name, region.key, region.byte_size));
+        regions_.back().back().push_back(region);
+        input_index++;
+      }
+    }
+  }
+  initialized_ = true;
+  return Error::Success();
+}
+
+Error InferDataManagerShm::Prepare(size_t stream, size_t step,
+                                   PreparedRequest* request) {
+  const StepData& data =
+      loader_->GetStep(stream, step);
+  const auto& step_regions =
+      regions_[stream % regions_.size()]
+              [step % regions_[stream % regions_.size()].size()];
+  request->inputs.clear();
+  request->input_ptrs.clear();
+  for (size_t i = 0; i < data.tensors.size(); ++i) {
+    const TensorData& tensor = data.tensors[i];
+    auto input = std::make_unique<InferInput>(tensor.name, tensor.shape,
+                                              tensor.datatype);
+    CTPU_RETURN_IF_ERROR(input->SetSharedMemory(
+        step_regions[i].name, step_regions[i].byte_size, 0));
+    request->input_ptrs.push_back(input.get());
+    request->inputs.push_back(std::move(input));
+  }
+  request->step_parameters =
+      data.parameters.IsNull() ? nullptr : &data.parameters;
+  return Error::Success();
+}
+
+Error InferDataManagerShm::Cleanup() {
+  Error first;
+  auto keep_first = [&first](const Error& err) {
+    if (!err.IsOk() && first.IsOk()) first = err;
+  };
+  for (auto& stream : regions_) {
+    for (auto& step : stream) {
+      for (auto& region : step) {
+        keep_first(backend_->UnregisterSystemSharedMemory(region.name));
+        if (region.addr != nullptr) {
+          keep_first(UnmapSharedMemory(region.addr, region.byte_size));
+          region.addr = nullptr;
+        }
+        if (region.fd >= 0) {
+          keep_first(CloseSharedMemory(region.fd));
+          keep_first(UnlinkSharedMemoryRegion(region.key));
+          region.fd = -1;
+        }
+      }
+    }
+  }
+  regions_.clear();
+  initialized_ = false;
+  return first;
+}
+
+}  // namespace perf
+}  // namespace ctpu

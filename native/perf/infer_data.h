@@ -1,0 +1,94 @@
+// Materializes request inputs per (stream, step) — plain copy or the
+// shared-memory data plane (reference iinfer_data_manager.h /
+// infer_data_manager.{h,cc} / infer_data_manager_shm.{h,cc}).
+#pragma once
+
+#include <memory>
+#include <vector>
+
+#include "client_backend.h"
+#include "data_loader.h"
+
+namespace ctpu {
+namespace perf {
+
+// A prepared request: owns the InferInput objects (their raw buffers point
+// into loader- or shm-owned storage, which outlives the request).
+struct PreparedRequest {
+  std::vector<std::unique_ptr<InferInput>> inputs;
+  std::vector<InferInput*> input_ptrs;
+  const json::Value* step_parameters = nullptr;  // may be null
+};
+
+class IInferDataManager {
+ public:
+  virtual ~IInferDataManager() = default;
+  virtual Error Init() = 0;
+  virtual Error Prepare(size_t stream, size_t step,
+                        PreparedRequest* request) = 0;
+  virtual Error Cleanup() { return Error::Success(); }
+};
+
+// Plain mode: inputs reference the loader's tensor bytes directly
+// (reference infer_data_manager.{h,cc}).
+class InferDataManager : public IInferDataManager {
+ public:
+  explicit InferDataManager(const DataLoader* loader) : loader_(loader) {}
+
+  Error Init() override { return Error::Success(); }
+
+  Error Prepare(size_t stream, size_t step, PreparedRequest* request) override {
+    const StepData& data = loader_->GetStep(stream, step);
+    request->inputs.clear();
+    request->input_ptrs.clear();
+    for (const TensorData& tensor : data.tensors) {
+      auto input = std::make_unique<InferInput>(tensor.name, tensor.shape,
+                                                tensor.datatype);
+      CTPU_RETURN_IF_ERROR(input->AppendRaw(
+          reinterpret_cast<const uint8_t*>(tensor.bytes.data()),
+          tensor.bytes.size()));
+      request->input_ptrs.push_back(input.get());
+      request->inputs.push_back(std::move(input));
+    }
+    request->step_parameters =
+        data.parameters.IsNull() ? nullptr : &data.parameters;
+    return Error::Success();
+  }
+
+ private:
+  const DataLoader* loader_;
+};
+
+// Shared-memory mode: every (stream, step, input) tensor is staged once
+// into a registered /dev/shm region at Init; requests then carry only
+// region references (reference infer_data_manager_shm.cc:1-384).
+class InferDataManagerShm : public IInferDataManager {
+ public:
+  InferDataManagerShm(const DataLoader* loader, ClientBackend* backend,
+                      const std::string& region_prefix = "ctpu_perf")
+      : loader_(loader), backend_(backend), prefix_(region_prefix) {}
+  ~InferDataManagerShm() override;
+
+  Error Init() override;
+  Error Prepare(size_t stream, size_t step, PreparedRequest* request) override;
+  Error Cleanup() override;
+
+ private:
+  struct Region {
+    std::string name;  // server-registered name
+    std::string key;   // /dev/shm key
+    void* addr = nullptr;
+    int fd = -1;
+    size_t byte_size = 0;
+  };
+
+  const DataLoader* loader_;
+  ClientBackend* backend_;
+  std::string prefix_;
+  // regions[stream][step][input index]
+  std::vector<std::vector<std::vector<Region>>> regions_;
+  bool initialized_ = false;
+};
+
+}  // namespace perf
+}  // namespace ctpu

@@ -1,0 +1,221 @@
+#include "load_manager.h"
+
+#include <chrono>
+
+namespace ctpu {
+namespace perf {
+
+void LoadManager::IssueOne(BackendContext* ctx, size_t slot, size_t stream,
+                           size_t step) {
+  PreparedRequest request;
+  Error err = data_->Prepare(stream, step, &request);
+  if (!err.IsOk()) {
+    ReportWorkerError(err);
+    return;
+  }
+
+  InferOptions options(config_.model_name);
+  options.model_version = config_.model_version;
+  uint64_t request_id = request_seq_.fetch_add(1);
+  options.request_id = std::to_string(request_id);
+  options.client_timeout_us = config_.client_timeout_us;
+  options.parameters = config_.request_parameters;
+  if (request.step_parameters != nullptr &&
+      request.step_parameters->IsObject()) {
+    // per-step parameters override the globals (same merge as the Python
+    // harness, client_tpu/perf/load_manager.py issue_one)
+    for (const auto& kv : request.step_parameters->AsObject()) {
+      options.parameters[kv.first] = kv.second.Dump();
+    }
+  }
+  if (sequences_ != nullptr) {
+    SequenceManager::StepFlags flags = sequences_->NextStep(slot);
+    options.sequence_id = flags.sequence_id;
+    options.sequence_start = flags.start;
+    options.sequence_end = flags.end;
+  }
+
+  RequestRecord record;
+  record.request_id = request_id;
+  ctx->Infer(options, request.input_ptrs, {}, &record);  // errors are data
+  record.sequence_id = options.sequence_id;
+  {
+    std::lock_guard<std::mutex> lk(records_mu_);
+    records_.push_back(std::move(record));
+  }
+}
+
+// ---------------------------------------------------------------------------
+// ConcurrencyManager
+// ---------------------------------------------------------------------------
+
+void ConcurrencyManager::ChangeConcurrency(size_t concurrency) {
+  target_.store(concurrency);
+  // shrink: deactivate surplus workers and join them
+  while (workers_.size() > concurrency) {
+    workers_.back().active->store(false);
+    workers_.back().thread.join();
+    workers_.pop_back();
+  }
+  // grow
+  while (workers_.size() < concurrency) {
+    Worker w;
+    w.active = std::make_shared<std::atomic<bool>>(true);
+    size_t id = workers_.size();
+    w.thread = std::thread(&ConcurrencyManager::WorkerLoop, this, id,
+                           w.active);
+    workers_.push_back(std::move(w));
+  }
+}
+
+void ConcurrencyManager::WorkerLoop(
+    size_t worker_id, std::shared_ptr<std::atomic<bool>> active) {
+  std::unique_ptr<BackendContext> ctx = backend_->CreateContext();
+  size_t step = 0;
+  while (active->load() && !stopping_.load()) {
+    IssueOne(ctx.get(), worker_id, worker_id, step);
+    step++;
+  }
+}
+
+void ConcurrencyManager::Stop() {
+  stopping_.store(true);
+  for (auto& w : workers_) {
+    w.active->store(false);
+    if (w.thread.joinable()) w.thread.join();
+  }
+  workers_.clear();
+  stopping_.store(false);
+  target_.store(0);
+}
+
+// ---------------------------------------------------------------------------
+// RequestRateManager
+// ---------------------------------------------------------------------------
+
+void RequestRateManager::StartPool() {
+  std::lock_guard<std::mutex> lk(pool_mu_);
+  if (pool_running_) return;
+  pool_running_ = true;
+  for (size_t i = 0; i < config_.max_threads; ++i) {
+    pool_.emplace_back(&RequestRateManager::PoolWorker, this);
+  }
+}
+
+void RequestRateManager::ChangeRate(double rate) {
+  Stop();
+  stopping_.store(false);
+  StartPool();
+  if (distribution_ == Distribution::POISSON) {
+    auto dist = std::make_shared<std::exponential_distribution<double>>(rate);
+    scheduler_ = std::thread(&RequestRateManager::SchedulerLoop, this,
+                             [this, dist] { return (*dist)(rng_); });
+  } else {
+    double interval = 1.0 / rate;
+    scheduler_ = std::thread(&RequestRateManager::SchedulerLoop, this,
+                             [interval] { return interval; });
+  }
+}
+
+void RequestRateManager::StartCustomIntervals(std::vector<double> intervals_s) {
+  Stop();
+  stopping_.store(false);
+  StartPool();
+  auto state = std::make_shared<std::pair<std::vector<double>, size_t>>(
+      std::move(intervals_s), 0);
+  scheduler_ = std::thread(&RequestRateManager::SchedulerLoop, this,
+                           [state] {
+                             double v = state->first[state->second];
+                             state->second =
+                                 (state->second + 1) % state->first.size();
+                             return v;
+                           });
+}
+
+void RequestRateManager::SchedulerLoop(std::function<double()> next_interval) {
+  uint64_t next_fire = RequestTimers::Now();
+  while (!stopping_.load()) {
+    uint64_t now = RequestTimers::Now();
+    if (now < next_fire) {
+      std::this_thread::sleep_for(
+          std::chrono::nanoseconds(next_fire - now));
+    } else {
+      slip_ns_.fetch_add(now - next_fire);
+    }
+    if (stopping_.load()) break;
+    {
+      std::lock_guard<std::mutex> lk(pool_mu_);
+      fire_times_ns_.push_back(next_fire);
+    }
+    pool_cv_.notify_one();
+    next_fire += (uint64_t)(next_interval() * 1e9);
+  }
+}
+
+void RequestRateManager::PoolWorker() {
+  std::unique_ptr<BackendContext> ctx = backend_->CreateContext();
+  while (true) {
+    size_t dispatch;
+    {
+      std::unique_lock<std::mutex> lk(pool_mu_);
+      pool_cv_.wait(lk, [this] {
+        return !pool_running_ || !fire_times_ns_.empty();
+      });
+      if (!pool_running_) return;  // Stop() clears the backlog first
+      fire_times_ns_.pop_front();
+      dispatch = dispatch_seq_.fetch_add(1);
+    }
+    if (sequences_ != nullptr) {
+      // slot cycles over pool size for sequence ownership; sequence data
+      // streams rotate with the slot
+      size_t slot = dispatch % config_.max_threads;
+      IssueOne(ctx.get(), slot, slot, dispatch);
+    } else {
+      // cover every stream of a multi-stream corpus round-robin
+      size_t streams = std::max<size_t>(1, config_.stream_count);
+      IssueOne(ctx.get(), dispatch % config_.max_threads,
+               dispatch % streams, dispatch / streams);
+    }
+  }
+}
+
+void RequestRateManager::Stop() {
+  stopping_.store(true);
+  if (scheduler_.joinable()) scheduler_.join();
+  {
+    // drop the un-issued backlog BEFORE joining, or a rate above server
+    // capacity would make Stop() drain thousands of queued requests
+    std::lock_guard<std::mutex> lk(pool_mu_);
+    pool_running_ = false;
+    fire_times_ns_.clear();
+  }
+  pool_cv_.notify_all();
+  for (auto& t : pool_) {
+    if (t.joinable()) t.join();
+  }
+  pool_.clear();
+}
+
+// ---------------------------------------------------------------------------
+// PeriodicConcurrencyManager
+// ---------------------------------------------------------------------------
+
+Error PeriodicConcurrencyManager::Run() {
+  ChangeConcurrency(start_);
+  size_t current = start_;
+  while (true) {
+    size_t target = RecordCount() + request_period_;
+    while (RecordCount() < target) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(5));
+      CTPU_RETURN_IF_ERROR(CheckHealth());
+    }
+    if (current >= end_) break;
+    current = std::min(end_, current + step_);
+    ChangeConcurrency(current);
+  }
+  Stop();
+  return Error::Success();
+}
+
+}  // namespace perf
+}  // namespace ctpu

@@ -1,0 +1,192 @@
+// Load managers: closed-loop concurrency, open-loop request rate
+// (constant/Poisson), custom interval replay, periodic concurrency ramp.
+//
+// Role parity with the reference's manager/worker hierarchy
+// (reference load_manager.h:48-180, concurrency_manager.h:93-133,
+// request_rate_manager.h:105-136, custom_load_manager.h,
+// periodic_concurrency_manager.h). The thread model differs deliberately:
+// the reference multiplexes async clients over a few workers; this build
+// gives every concurrency slot its own blocking thread + connection —
+// simpler, no callback inversion, and faster at the concurrencies a
+// loopback TPU host sees.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "client_backend.h"
+#include "infer_data.h"
+#include "model_parser.h"
+#include "sequence_manager.h"
+
+namespace ctpu {
+namespace perf {
+
+struct LoadConfig {
+  std::string model_name;
+  std::string model_version;
+  // raw-JSON request parameters applied to every request (CLI
+  // --request-parameter); per-step parameters from the input data override
+  std::map<std::string, std::string> request_parameters;
+  // open-loop thread pool size (reference --max-threads)
+  size_t max_threads = 32;
+  uint64_t client_timeout_us = 0;
+  // stream count of the input corpus (for round-robin coverage in
+  // open-loop mode)
+  size_t stream_count = 1;
+};
+
+class LoadManager {
+ public:
+  LoadManager(std::shared_ptr<ClientBackend> backend,
+              IInferDataManager* data_manager, LoadConfig config,
+              SequenceManager* sequences = nullptr)
+      : backend_(std::move(backend)),
+        data_(data_manager),
+        config_(std::move(config)),
+        sequences_(sequences) {}
+  virtual ~LoadManager() = default;
+
+  // Hand accumulated records to the profiler (reference SwapRequestRecords,
+  // load_manager.h:83).
+  std::vector<RequestRecord> SwapRecords() {
+    std::lock_guard<std::mutex> lk(records_mu_);
+    std::vector<RequestRecord> out;
+    out.swap(records_);
+    return out;
+  }
+  size_t RecordCount() {
+    std::lock_guard<std::mutex> lk(records_mu_);
+    return records_.size();
+  }
+
+  // Raise worker failures to the profiler (reference CheckHealth,
+  // load_manager.h:77).
+  Error CheckHealth() {
+    std::lock_guard<std::mutex> lk(health_mu_);
+    return worker_error_;
+  }
+
+  ClientBackend* Backend() { return backend_.get(); }
+  const LoadConfig& Config() const { return config_; }
+
+  virtual void Stop() = 0;
+
+ protected:
+  // Issue one blocking request on the given context and record it.
+  void IssueOne(BackendContext* ctx, size_t slot, size_t stream, size_t step);
+
+  void ReportWorkerError(const Error& err) {
+    std::lock_guard<std::mutex> lk(health_mu_);
+    if (worker_error_.IsOk()) worker_error_ = err;
+  }
+
+  std::shared_ptr<ClientBackend> backend_;
+  IInferDataManager* data_;
+  LoadConfig config_;
+  SequenceManager* sequences_;
+
+  std::mutex records_mu_;
+  std::vector<RequestRecord> records_;
+  std::mutex health_mu_;
+  Error worker_error_;
+  std::atomic<uint64_t> request_seq_{0};
+  std::atomic<bool> stopping_{false};
+};
+
+// Closed loop: N workers, each re-issuing as soon as its response returns
+// (reference concurrency_worker.h:99-127 send-until-full semantics).
+class ConcurrencyManager : public LoadManager {
+ public:
+  using LoadManager::LoadManager;
+  ~ConcurrencyManager() override { Stop(); }
+
+  // Grow/shrink the worker pool (reference ChangeConcurrencyLevel).
+  void ChangeConcurrency(size_t concurrency);
+  size_t Concurrency() const { return target_.load(); }
+  void Stop() override;
+
+ private:
+  struct Worker {
+    std::thread thread;
+    std::shared_ptr<std::atomic<bool>> active;
+  };
+  void WorkerLoop(size_t worker_id, std::shared_ptr<std::atomic<bool>> active);
+  std::vector<Worker> workers_;
+  std::atomic<size_t> target_{0};
+};
+
+// Open loop: a scheduler thread fires requests at schedule instants into a
+// worker pool; late dispatches accumulate in ScheduleSlipNs
+// (reference request_rate_manager.h, rate_schedule.h).
+class RequestRateManager : public LoadManager {
+ public:
+  enum class Distribution { CONSTANT, POISSON };
+
+  RequestRateManager(std::shared_ptr<ClientBackend> backend,
+                     IInferDataManager* data_manager, LoadConfig config,
+                     SequenceManager* sequences = nullptr,
+                     Distribution distribution = Distribution::CONSTANT,
+                     uint64_t seed = 0)
+      : LoadManager(std::move(backend), data_manager, std::move(config),
+                    sequences),
+        distribution_(distribution),
+        rng_(seed) {}
+  ~RequestRateManager() override { Stop(); }
+
+  // Replace the dispatch schedule (reference ChangeRequestRate).
+  void ChangeRate(double rate);
+  // Replay a fixed interval list, cycling (reference CustomLoadManager).
+  void StartCustomIntervals(std::vector<double> intervals_s);
+  void Stop() override;
+
+  uint64_t ScheduleSlipNs() const { return slip_ns_.load(); }
+
+ private:
+  void StartPool();
+  void SchedulerLoop(std::function<double()> next_interval);
+  void PoolWorker();
+
+  Distribution distribution_;
+  std::mt19937_64 rng_;
+  std::thread scheduler_;
+  std::vector<std::thread> pool_;
+  std::deque<uint64_t> fire_times_ns_;  // absolute steady-clock ns
+  std::mutex pool_mu_;
+  std::condition_variable pool_cv_;
+  std::atomic<uint64_t> slip_ns_{0};
+  std::atomic<size_t> dispatch_seq_{0};
+  bool pool_running_ = false;
+};
+
+// Ramp concurrency start->end by step every request_period completed
+// requests (reference periodic_concurrency_manager.h — LLM profiling mode).
+class PeriodicConcurrencyManager : public ConcurrencyManager {
+ public:
+  PeriodicConcurrencyManager(std::shared_ptr<ClientBackend> backend,
+                             IInferDataManager* data_manager,
+                             LoadConfig config, size_t start, size_t end,
+                             size_t step, size_t request_period,
+                             SequenceManager* sequences = nullptr)
+      : ConcurrencyManager(std::move(backend), data_manager,
+                           std::move(config), sequences),
+        start_(start),
+        end_(end),
+        step_(step),
+        request_period_(request_period) {}
+
+  // Run the full ramp; returns when the final period completes.
+  Error Run();
+
+ private:
+  size_t start_, end_, step_, request_period_;
+};
+
+}  // namespace perf
+}  // namespace ctpu

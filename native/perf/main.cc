@@ -1,0 +1,229 @@
+// perf_analyzer entry point (reference main.cc:33-39 + the object wiring
+// of PerfAnalyzer::CreateAnalyzerObjects, perf_analyzer.cc:72-289).
+
+#include <csignal>
+#include <cstdio>
+#include <fstream>
+#include <iostream>
+
+#include "cli.h"
+#include "client_backend.h"
+#include "data_loader.h"
+#include "infer_data.h"
+#include "load_manager.h"
+#include "model_parser.h"
+#include "profiler.h"
+#include "report.h"
+#include "sequence_manager.h"
+
+namespace {
+
+std::atomic<bool> early_exit{false};
+
+void SignalHandler(int) {
+  // two-stage: first SIGINT finishes the current window and reports;
+  // second aborts (reference perf_analyzer.cc:40-54)
+  if (early_exit.load()) {
+    std::_Exit(1);
+  }
+  early_exit.store(true);
+  std::fprintf(stderr,
+               "\nfinishing current measurement; interrupt again to abort\n");
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  using namespace ctpu;
+  using namespace ctpu::perf;
+
+  PAParams params;
+  Error err = ParseArgs(argc, argv, &params);
+  if (!err.IsOk()) {
+    if (err.Message() == "help") {
+      std::cout << Usage();
+      return 0;
+    }
+    std::cerr << "error: " << err.Message() << "\n\n" << Usage();
+    return 1;
+  }
+  std::signal(SIGINT, SignalHandler);
+
+  auto fail = [](const Error& e, const char* what) {
+    std::cerr << "error: " << what << ": " << e.Message() << std::endl;
+    return 1;
+  };
+
+  BackendFactoryConfig backend_config;
+  backend_config.url = params.url;
+  backend_config.verbose = params.verbose;
+  std::shared_ptr<ClientBackend> backend;
+  err = CreateClientBackend(backend_config, &backend);
+  if (!err.IsOk()) return fail(err, "create backend");
+
+  ModelParser parser;
+  err = parser.Init(backend.get(), params.model_name, params.model_version);
+  if (!err.IsOk()) return fail(err, "query model");
+
+  DataLoader loader(&parser, params.batch_size, params.shape_overrides,
+                    params.random_seed);
+  if (!params.input_data_file.empty()) {
+    err = loader.ReadFromJson(params.input_data_file);
+  } else {
+    err = loader.GenerateSynthetic();
+  }
+  if (!err.IsOk()) return fail(err, "load input data");
+
+  std::unique_ptr<IInferDataManager> data_manager;
+  if (params.shared_memory == "system") {
+    data_manager.reset(new InferDataManagerShm(&loader, backend.get()));
+  } else if (params.shared_memory == "none") {
+    data_manager.reset(new InferDataManager(&loader));
+  } else {
+    std::cerr << "error: unsupported --shared-memory mode '"
+              << params.shared_memory << "'" << std::endl;
+    return 1;
+  }
+  err = data_manager->Init();
+  if (!err.IsOk()) return fail(err, "prepare input data");
+
+  std::unique_ptr<SequenceManager> sequences;
+  bool sequence_model =
+      parser.Scheduler() == ModelParser::SchedulerType::SEQUENCE ||
+      params.force_sequences;
+  if (sequence_model) {
+    sequences.reset(new SequenceManager(
+        1, params.num_of_sequences, params.sequence_length,
+        params.sequence_length_variation, params.random_seed));
+  }
+
+  LoadConfig load_config;
+  load_config.model_name = params.model_name;
+  load_config.model_version = params.model_version;
+  load_config.request_parameters = params.request_parameters;
+  load_config.max_threads = params.max_threads;
+  load_config.stream_count = loader.StreamCount();
+
+  ProfilerConfig profiler_config;
+  profiler_config.measurement_interval_s =
+      params.measurement_interval_ms / 1000.0;
+  profiler_config.stability_pct = params.stability_percentage;
+  profiler_config.max_trials = params.max_trials;
+  profiler_config.latency_threshold_us =
+      params.latency_threshold_ms * 1000.0;
+  profiler_config.stability_percentile = params.percentile;
+  profiler_config.warmup_s = params.warmup_s;
+  profiler_config.verbose = params.verbose;
+  profiler_config.early_exit = &early_exit;
+
+  if (params.verbose) {
+    std::printf("model: %s (max_batch_size %ld, %zu inputs)\n",
+                parser.ModelName().c_str(), (long)parser.MaxBatchSize(),
+                parser.Inputs().size());
+  }
+
+  std::vector<ProfileExperiment> experiments;
+  if (params.has_periodic_range) {
+    PeriodicConcurrencyManager manager(
+        backend, data_manager.get(), load_config, params.periodic_start,
+        params.periodic_end, params.periodic_step, params.request_period,
+        sequences.get());
+    err = manager.Run();
+    if (!err.IsOk()) return fail(err, "periodic run");
+    std::vector<RequestRecord> records = manager.SwapRecords();
+    uint64_t start_ns = records.empty() ? 0 : records.front().start_ns;
+    uint64_t end_ns = 0;
+    for (const auto& r : records) end_ns = std::max(end_ns, r.end_ns);
+    ProfileExperiment e;
+    e.mode = "periodic_concurrency";
+    e.value = (double)params.periodic_end;
+    e.status = ComputeWindowStatus(records, start_ns, end_ns);
+    e.records = std::move(records);
+    experiments.push_back(std::move(e));
+  } else if (params.has_request_rate_range) {
+    RequestRateManager manager(
+        backend, data_manager.get(), load_config, sequences.get(),
+        params.request_distribution == "poisson"
+            ? RequestRateManager::Distribution::POISSON
+            : RequestRateManager::Distribution::CONSTANT,
+        params.random_seed);
+    InferenceProfiler profiler(&manager, profiler_config);
+    err = profiler.ProfileRequestRateRange(
+        &manager, params.rate_start,
+        params.rate_end > 0 ? params.rate_end : params.rate_start,
+        params.rate_step);
+    if (!err.IsOk()) return fail(err, "profile");
+    experiments = profiler.Experiments();
+  } else if (!params.request_intervals_file.empty()) {
+    std::ifstream f(params.request_intervals_file);
+    if (!f) {
+      std::cerr << "error: cannot open --request-intervals file" << std::endl;
+      return 1;
+    }
+    // one interval per line, nanoseconds (reference format)
+    std::vector<double> intervals;
+    std::string line;
+    while (std::getline(f, line)) {
+      if (line.empty()) continue;
+      try {
+        intervals.push_back(std::stod(line) / 1e9);
+      } catch (const std::exception&) {
+        std::cerr << "error: bad interval line '" << line
+                  << "' in --request-intervals file (want nanoseconds)"
+                  << std::endl;
+        return 1;
+      }
+    }
+    if (intervals.empty()) {
+      std::cerr << "error: empty --request-intervals file" << std::endl;
+      return 1;
+    }
+    RequestRateManager manager(backend, data_manager.get(), load_config,
+                               sequences.get());
+    InferenceProfiler profiler(&manager, profiler_config);
+    err = profiler.ProfileCustomIntervals(&manager, intervals);
+    if (!err.IsOk()) return fail(err, "profile");
+    experiments = profiler.Experiments();
+  } else {
+    ConcurrencyManager manager(backend, data_manager.get(), load_config,
+                               sequences.get());
+    InferenceProfiler profiler(&manager, profiler_config);
+    err = profiler.ProfileConcurrencyRange(
+        &manager, params.concurrency_start, params.concurrency_end,
+        params.concurrency_step);
+    if (!err.IsOk()) return fail(err, "profile");
+    experiments = profiler.Experiments();
+  }
+
+  if (experiments.empty()) {
+    std::cerr << "error: no measurements taken" << std::endl;
+    return 1;
+  }
+
+  for (const auto& e : experiments) {
+    if (e.mode == "concurrency") {
+      std::printf("Request concurrency: %zu\n", (size_t)e.value);
+    } else if (e.mode == "request_rate" || e.mode == "custom_intervals") {
+      std::printf("Request rate: %g infer/sec\n", e.value);
+    } else {
+      std::printf("Periodic concurrency ramp to %zu\n", (size_t)e.value);
+    }
+    std::fputs(DetailedReport(e).c_str(), stdout);
+  }
+  std::printf("\n%s", ConsoleReport(experiments).c_str());
+
+  if (!params.csv_file.empty()) {
+    err = WriteCsv(experiments, params.csv_file);
+    if (!err.IsOk()) return fail(err, "write csv");
+  }
+  if (!params.profile_export_file.empty()) {
+    err = ExportProfile(experiments, params.profile_export_file, "kserve",
+                        params.url);
+    if (!err.IsOk()) return fail(err, "write profile export");
+  }
+  if (params.json_summary) {
+    std::printf("%s\n", JsonSummary(experiments).c_str());
+  }
+  data_manager->Cleanup();
+  return 0;
+}

@@ -1,0 +1,135 @@
+// Injectable-latency/error mock backend — the linchpin of the hermetic
+// test strategy (reference client_backend/mock_client_backend.h:289-318):
+// concurrency, rate scheduling, sequences, and profiler logic are all
+// testable against it without any server or network.
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <mutex>
+#include <thread>
+
+#include "client_backend.h"
+
+namespace ctpu {
+namespace perf {
+
+class MockClientBackend;
+
+class MockBackendContext : public BackendContext {
+ public:
+  explicit MockBackendContext(MockClientBackend* backend)
+      : backend_(backend) {}
+
+  Error Infer(const InferOptions& options,
+              const std::vector<InferInput*>& inputs,
+              const std::vector<const InferRequestedOutput*>& outputs,
+              RequestRecord* record) override;
+
+ private:
+  MockClientBackend* backend_;
+};
+
+class MockClientBackend : public ClientBackend {
+ public:
+  struct Options {
+    // simulated per-request latency
+    uint64_t latency_us = 1000;
+    // every Nth request fails (0 = never; reference SetReturnStatuses role)
+    int error_every = 0;
+    // responses per request (decoupled simulation)
+    int responses_per_request = 1;
+    std::string metadata_json =
+        R"({"name":"mock","versions":["1"],"platform":"mock",)"
+        R"("inputs":[{"name":"IN","datatype":"FP32","shape":[8]}],)"
+        R"("outputs":[{"name":"OUT","datatype":"FP32","shape":[8]}]})";
+    std::string config_json =
+        R"({"name":"mock","max_batch_size":8,"input":[],"output":[]})";
+  };
+
+  MockClientBackend() : options_() {}
+  explicit MockClientBackend(Options options) : options_(std::move(options)) {}
+
+  BackendKind Kind() const override { return BackendKind::MOCK; }
+
+  Error ModelMetadata(json::Value* metadata, const std::string& model_name,
+                      const std::string&) override {
+    *metadata = json::Parse(options_.metadata_json);
+    metadata->AsObject()["name"] = json::Value(model_name);
+    return Error::Success();
+  }
+  Error ModelConfig(json::Value* config, const std::string& model_name,
+                    const std::string&) override {
+    *config = json::Parse(options_.config_json);
+    config->AsObject()["name"] = json::Value(model_name);
+    return Error::Success();
+  }
+  std::unique_ptr<BackendContext> CreateContext() override {
+    context_count++;
+    return std::unique_ptr<BackendContext>(new MockBackendContext(this));
+  }
+  Error RegisterSystemSharedMemory(const std::string&, const std::string&,
+                                   size_t) override {
+    shm_register_count++;
+    return Error::Success();
+  }
+  Error UnregisterSystemSharedMemory(const std::string&) override {
+    shm_unregister_count++;
+    return Error::Success();
+  }
+
+  // -- accounting (read by tests) -----------------------------------------
+  std::atomic<uint64_t> request_count{0};
+  std::atomic<int> inflight{0};
+  std::atomic<int> max_inflight{0};
+  std::atomic<int> context_count{0};
+  std::atomic<int> shm_register_count{0};
+  std::atomic<int> shm_unregister_count{0};
+  // sequence accounting: per-sequence observed (starts, steps, ended)
+  struct SeqStat {
+    int starts = 0;
+    int steps = 0;
+    bool ended = false;
+  };
+  std::map<uint64_t, SeqStat> sequences;
+  std::mutex seq_mu;
+
+  Options options_;
+};
+
+inline Error MockBackendContext::Infer(
+    const InferOptions& options, const std::vector<InferInput*>&,
+    const std::vector<const InferRequestedOutput*>&, RequestRecord* record) {
+  auto* b = backend_;
+  uint64_t n = ++b->request_count;
+  int cur = ++b->inflight;
+  int prev = b->max_inflight.load();
+  while (cur > prev && !b->max_inflight.compare_exchange_weak(prev, cur)) {
+  }
+  if (options.sequence_id != 0) {
+    std::lock_guard<std::mutex> lk(b->seq_mu);
+    auto& stat = b->sequences[options.sequence_id];
+    if (options.sequence_start) stat.starts++;
+    stat.steps++;
+    if (options.sequence_end) stat.ended = true;
+  }
+  record->start_ns = RequestTimers::Now();
+  int responses = std::max(1, b->options_.responses_per_request);
+  for (int i = 0; i < responses; ++i) {
+    std::this_thread::sleep_for(
+        std::chrono::microseconds(b->options_.latency_us / responses));
+    record->response_ns.push_back(RequestTimers::Now());
+  }
+  record->end_ns = RequestTimers::Now();
+  --b->inflight;
+  if (b->options_.error_every > 0 &&
+      n % (uint64_t)b->options_.error_every == 0) {
+    record->success = false;
+    record->error = "mock injected failure";
+    return Error("mock injected failure");
+  }
+  return Error::Success();
+}
+
+}  // namespace perf
+}  // namespace ctpu

@@ -1,0 +1,54 @@
+#include "model_parser.h"
+
+namespace ctpu {
+namespace perf {
+
+namespace {
+
+std::vector<TensorDesc> ParseTensors(const json::Value& arr) {
+  std::vector<TensorDesc> out;
+  if (!arr.IsArray()) return out;
+  for (const auto& t : arr.AsArray()) {
+    TensorDesc desc;
+    desc.name = t["name"].AsString();
+    desc.datatype = t["datatype"].AsString();
+    for (const auto& d : t["shape"].AsArray()) {
+      desc.shape.push_back(d.AsInt());
+    }
+    out.push_back(std::move(desc));
+  }
+  return out;
+}
+
+}  // namespace
+
+Error ModelParser::Init(ClientBackend* backend, const std::string& model_name,
+                        const std::string& model_version) {
+  model_name_ = model_name;
+  json::Value metadata, config;
+  CTPU_RETURN_IF_ERROR(
+      backend->ModelMetadata(&metadata, model_name, model_version));
+  CTPU_RETURN_IF_ERROR(
+      backend->ModelConfig(&config, model_name, model_version));
+
+  inputs_ = ParseTensors(metadata["inputs"]);
+  outputs_ = ParseTensors(metadata["outputs"]);
+  if (config.Has("max_batch_size")) {
+    max_batch_size_ = config["max_batch_size"].AsInt();
+  }
+  if (config.Has("sequence_batching")) {
+    scheduler_ = SchedulerType::SEQUENCE;
+  } else if (config.Has("ensemble_scheduling")) {
+    scheduler_ = SchedulerType::ENSEMBLE;
+  } else if (config.Has("dynamic_batching")) {
+    scheduler_ = SchedulerType::DYNAMIC;
+  }
+  const json::Value& policy = config["model_transaction_policy"];
+  if (policy.IsObject() && policy["decoupled"].IsBool()) {
+    decoupled_ = policy["decoupled"].AsBool();
+  }
+  return Error::Success();
+}
+
+}  // namespace perf
+}  // namespace ctpu

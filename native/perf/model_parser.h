@@ -1,0 +1,45 @@
+// Normalizes model metadata/config (reference model_parser.{h,cc}:
+// InitTriton + scheduler-type detection, perf_analyzer.cc:107-148).
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "client_backend.h"
+#include "json.h"
+
+namespace ctpu {
+namespace perf {
+
+struct TensorDesc {
+  std::string name;
+  std::string datatype;
+  std::vector<int64_t> shape;
+};
+
+class ModelParser {
+ public:
+  enum class SchedulerType { NONE, DYNAMIC, SEQUENCE, ENSEMBLE };
+
+  Error Init(ClientBackend* backend, const std::string& model_name,
+             const std::string& model_version);
+
+  const std::string& ModelName() const { return model_name_; }
+  int64_t MaxBatchSize() const { return max_batch_size_; }
+  bool SupportsBatching() const { return max_batch_size_ > 0; }
+  SchedulerType Scheduler() const { return scheduler_; }
+  bool IsDecoupled() const { return decoupled_; }
+  const std::vector<TensorDesc>& Inputs() const { return inputs_; }
+  const std::vector<TensorDesc>& Outputs() const { return outputs_; }
+
+ private:
+  std::string model_name_;
+  int64_t max_batch_size_ = 0;
+  SchedulerType scheduler_ = SchedulerType::NONE;
+  bool decoupled_ = false;
+  std::vector<TensorDesc> inputs_;
+  std::vector<TensorDesc> outputs_;
+};
+
+}  // namespace perf
+}  // namespace ctpu

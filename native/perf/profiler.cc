@@ -1,0 +1,263 @@
+#include "profiler.h"
+
+#include <chrono>
+#include <cmath>
+#include <cstdio>
+#include <thread>
+
+namespace ctpu {
+namespace perf {
+
+namespace {
+
+using StatsMap = std::map<std::string, std::pair<uint64_t, uint64_t>>;
+
+double StatsDelta(const StatsMap& before, const StatsMap& after,
+                  const std::string& field) {
+  auto b = before.count(field) ? before.at(field)
+                               : std::pair<uint64_t, uint64_t>{0, 0};
+  auto a = after.count(field) ? after.at(field)
+                              : std::pair<uint64_t, uint64_t>{0, 0};
+  int64_t d_count = (int64_t)a.first - (int64_t)b.first;
+  if (d_count <= 0) return 0.0;
+  return (double)((int64_t)a.second - (int64_t)b.second) / (double)d_count /
+         1e3;
+}
+
+}  // namespace
+
+double InferenceProfiler::StabilizingLatency(const PerfStatus& status) const {
+  if (config_.stability_percentile == 0) return status.avg_latency_us;
+  auto it = status.latency_percentiles_us.find(config_.stability_percentile);
+  return it != status.latency_percentiles_us.end() ? it->second
+                                                   : status.avg_latency_us;
+}
+
+Error InferenceProfiler::MeasureWindow(PerfStatus* status) {
+  StatsMap before, after;
+  manager_->Backend()->InferenceStatistics(&before,
+                                           manager_->Config().model_name);
+  manager_->SwapRecords();  // discard partial records
+  uint64_t start_ns = RequestTimers::Now();
+  std::this_thread::sleep_for(std::chrono::duration<double>(
+      config_.measurement_interval_s));
+  CTPU_RETURN_IF_ERROR(manager_->CheckHealth());
+  uint64_t end_ns = RequestTimers::Now();
+  std::vector<RequestRecord> records = manager_->SwapRecords();
+  manager_->Backend()->InferenceStatistics(&after,
+                                           manager_->Config().model_name);
+  *status =
+      ComputeWindowStatus(records, start_ns, end_ns, config_.percentiles);
+  status->server_queue_us = StatsDelta(before, after, "queue");
+  status->server_compute_infer_us =
+      StatsDelta(before, after, "compute_infer");
+  status->server_compute_input_us =
+      StatsDelta(before, after, "compute_input");
+  status->server_compute_output_us =
+      StatsDelta(before, after, "compute_output");
+  last_records_ = std::move(records);
+  return Error::Success();
+}
+
+bool InferenceProfiler::IsStable(
+    const std::vector<PerfStatus>& windows) const {
+  if (windows.size() < 3) return false;
+  auto recent = std::vector<PerfStatus>(windows.end() - 3, windows.end());
+  for (const auto& w : recent) {
+    if (w.request_count == 0) return false;
+  }
+  for (int metric = 0; metric < 2; ++metric) {
+    double values[3];
+    for (int i = 0; i < 3; ++i) {
+      values[i] = metric == 0 ? recent[i].throughput
+                              : StabilizingLatency(recent[i]);
+    }
+    double mean = (values[0] + values[1] + values[2]) / 3.0;
+    if (mean == 0) return false;
+    for (double v : values) {
+      if (std::abs(v - mean) / mean > config_.stability_pct / 100.0) {
+        return false;
+      }
+    }
+  }
+  if (config_.latency_threshold_us > 0) {
+    for (const auto& w : recent) {
+      if (StabilizingLatency(w) > config_.latency_threshold_us) return false;
+    }
+  }
+  return true;
+}
+
+PerfStatus InferenceProfiler::Merge(
+    const std::vector<PerfStatus>& windows) const {
+  if (windows.size() == 1) return windows[0];
+  PerfStatus merged;
+  merged.window_start_ns = windows.front().window_start_ns;
+  merged.window_end_ns = windows.back().window_end_ns;
+  size_t total = 0;
+  for (const auto& w : windows) {
+    merged.request_count += w.request_count;
+    merged.error_count += w.error_count;
+    merged.throughput += w.throughput;
+    merged.response_throughput += w.response_throughput;
+  }
+  total = merged.request_count ? merged.request_count : 1;
+  merged.throughput /= (double)windows.size();
+  merged.response_throughput /= (double)windows.size();
+  for (const auto& w : windows) {
+    merged.avg_latency_us +=
+        w.avg_latency_us * (double)w.request_count / (double)total;
+    merged.avg_send_us +=
+        w.avg_send_us * (double)w.request_count / (double)total;
+    merged.avg_recv_us +=
+        w.avg_recv_us * (double)w.request_count / (double)total;
+    merged.std_latency_us = std::max(merged.std_latency_us, w.std_latency_us);
+    for (int q : config_.percentiles) {
+      auto it = w.latency_percentiles_us.find(q);
+      merged.latency_percentiles_us[q] +=
+          (it != w.latency_percentiles_us.end() ? it->second : 0.0) *
+          (double)w.request_count / (double)total;
+    }
+    merged.server_queue_us += w.server_queue_us / (double)windows.size();
+    merged.server_compute_infer_us +=
+        w.server_compute_infer_us / (double)windows.size();
+    merged.server_compute_input_us +=
+        w.server_compute_input_us / (double)windows.size();
+    merged.server_compute_output_us +=
+        w.server_compute_output_us / (double)windows.size();
+  }
+  return merged;
+}
+
+Error InferenceProfiler::ProfilePoint(PerfStatus* status, bool* stable) {
+  if (config_.warmup_s > 0) {
+    std::this_thread::sleep_for(
+        std::chrono::duration<double>(config_.warmup_s));
+    manager_->SwapRecords();
+  }
+  std::vector<PerfStatus> windows;
+  window_records_.clear();
+  for (size_t trial = 0; trial < config_.max_trials; ++trial) {
+    if (config_.early_exit != nullptr && config_.early_exit->load()) break;
+    PerfStatus w;
+    CTPU_RETURN_IF_ERROR(MeasureWindow(&w));
+    windows.push_back(w);
+    window_records_.push_back(std::move(last_records_));
+    if (config_.verbose) {
+      double p99 = w.latency_percentiles_us.count(99)
+                       ? w.latency_percentiles_us.at(99)
+                       : 0.0;
+      std::printf("  window %zu: %zu requests, %.1f infer/s, p99 %.0f us\n",
+                  trial + 1, w.request_count, w.throughput, p99);
+    }
+    if (IsStable(windows)) {
+      *status = Merge(std::vector<PerfStatus>(windows.end() - 3,
+                                              windows.end()));
+      *stable = true;
+      last_records_.clear();
+      for (size_t i = window_records_.size() - 3; i < window_records_.size();
+           ++i) {
+        for (auto& r : window_records_[i]) last_records_.push_back(r);
+      }
+      return Error::Success();
+    }
+  }
+  if (windows.empty()) {
+    *status = PerfStatus();
+    *stable = false;
+    return Error::Success();
+  }
+  size_t keep = std::min<size_t>(3, windows.size());
+  *status = Merge(
+      std::vector<PerfStatus>(windows.end() - keep, windows.end()));
+  *stable = false;
+  last_records_.clear();
+  for (size_t i = window_records_.size() - keep; i < window_records_.size();
+       ++i) {
+    for (auto& r : window_records_[i]) last_records_.push_back(r);
+  }
+  return Error::Success();
+}
+
+Error InferenceProfiler::ProfileConcurrencyRange(ConcurrencyManager* manager,
+                                                 size_t start, size_t end,
+                                                 size_t step) {
+  for (size_t concurrency = start; concurrency <= end;
+       concurrency += std::max<size_t>(1, step)) {
+    if (config_.early_exit != nullptr && config_.early_exit->load()) break;
+    manager->ChangeConcurrency(concurrency);
+    PerfStatus status;
+    bool stable = false;
+    CTPU_RETURN_IF_ERROR(ProfilePoint(&status, &stable));
+    status.concurrency = concurrency;
+    if (config_.verbose && !stable) {
+      std::printf(
+          "  warning: concurrency %zu did not stabilize in %zu windows\n",
+          concurrency, config_.max_trials);
+    }
+    ProfileExperiment experiment;
+    experiment.mode = "concurrency";
+    experiment.value = (double)concurrency;
+    experiment.status = status;
+    experiment.records = std::move(last_records_);
+    experiment.stable = stable;
+    experiments_.push_back(std::move(experiment));
+    if (config_.latency_threshold_us > 0 &&
+        StabilizingLatency(status) > config_.latency_threshold_us) {
+      break;  // reference: stop the sweep past the latency budget
+    }
+  }
+  manager->Stop();
+  return Error::Success();
+}
+
+Error InferenceProfiler::ProfileRequestRateRange(RequestRateManager* manager,
+                                                 double start, double end,
+                                                 double step) {
+  for (double rate = start; rate <= end + 1e-9;
+       rate += std::max(1e-9, step)) {
+    if (config_.early_exit != nullptr && config_.early_exit->load()) break;
+    manager->ChangeRate(rate);
+    PerfStatus status;
+    bool stable = false;
+    CTPU_RETURN_IF_ERROR(ProfilePoint(&status, &stable));
+    status.request_rate = rate;
+    ProfileExperiment experiment;
+    experiment.mode = "request_rate";
+    experiment.value = rate;
+    experiment.status = status;
+    experiment.records = std::move(last_records_);
+    experiment.stable = stable;
+    experiments_.push_back(std::move(experiment));
+    if (config_.latency_threshold_us > 0 &&
+        StabilizingLatency(status) > config_.latency_threshold_us) {
+      break;
+    }
+  }
+  manager->Stop();
+  return Error::Success();
+}
+
+Error InferenceProfiler::ProfileCustomIntervals(
+    RequestRateManager* manager, const std::vector<double>& intervals_s) {
+  manager->StartCustomIntervals(intervals_s);
+  PerfStatus status;
+  bool stable = false;
+  CTPU_RETURN_IF_ERROR(ProfilePoint(&status, &stable));
+  double mean = 0;
+  for (double v : intervals_s) mean += v;
+  mean /= intervals_s.empty() ? 1.0 : (double)intervals_s.size();
+  status.request_rate = mean > 0 ? 1.0 / mean : 0.0;
+  ProfileExperiment experiment;
+  experiment.mode = "custom_intervals";
+  experiment.value = status.request_rate;
+  experiment.status = status;
+  experiment.records = std::move(last_records_);
+  experiment.stable = stable;
+  experiments_.push_back(std::move(experiment));
+  manager->Stop();
+  return Error::Success();
+}
+
+}  // namespace perf
+}  // namespace ctpu

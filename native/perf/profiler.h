@@ -1,0 +1,72 @@
+// Measurement engine: windows, 3-window stability, sweeps
+// (reference inference_profiler.h:192-747 — Profile<T> linear sweep,
+// ProfileHelper stability loop inference_profiler.cc:686-795,
+// DetermineStability :797). Semantics match the Python harness
+// (client_tpu/perf/profiler.py) so both produce comparable numbers.
+#pragma once
+
+#include <vector>
+
+#include "load_manager.h"
+#include "records.h"
+
+namespace ctpu {
+namespace perf {
+
+struct ProfileExperiment {
+  std::string mode;  // "concurrency" | "request_rate" | "custom_intervals"
+  double value = 0;
+  PerfStatus status;
+  std::vector<RequestRecord> records;
+  bool stable = true;
+};
+
+struct ProfilerConfig {
+  double measurement_interval_s = 5.0;
+  double stability_pct = 10.0;
+  size_t max_trials = 10;
+  double latency_threshold_us = 0;  // 0 = no threshold
+  std::vector<int> percentiles = {50, 90, 95, 99};
+  // latency metric for stability/threshold: this percentile, or avg when 0
+  int stability_percentile = 0;
+  double warmup_s = 0.0;
+  bool verbose = false;
+  // When set, a true value stops measurement after the current window
+  // (reference two-stage SIGINT early_exit, perf_analyzer.cc:40-54).
+  std::atomic<bool>* early_exit = nullptr;
+};
+
+class InferenceProfiler {
+ public:
+  InferenceProfiler(LoadManager* manager, ProfilerConfig config)
+      : manager_(manager), config_(std::move(config)) {}
+
+  // Measure until stable or out of trials (reference ProfileHelper).
+  Error ProfilePoint(PerfStatus* status, bool* stable);
+
+  Error ProfileConcurrencyRange(ConcurrencyManager* manager, size_t start,
+                                size_t end, size_t step);
+  Error ProfileRequestRateRange(RequestRateManager* manager, double start,
+                                double end, double step);
+  Error ProfileCustomIntervals(RequestRateManager* manager,
+                               const std::vector<double>& intervals_s);
+
+  const std::vector<ProfileExperiment>& Experiments() const {
+    return experiments_;
+  }
+
+ private:
+  Error MeasureWindow(PerfStatus* status);
+  bool IsStable(const std::vector<PerfStatus>& windows) const;
+  double StabilizingLatency(const PerfStatus& status) const;
+  PerfStatus Merge(const std::vector<PerfStatus>& windows) const;
+
+  LoadManager* manager_;
+  ProfilerConfig config_;
+  std::vector<ProfileExperiment> experiments_;
+  std::vector<RequestRecord> last_records_;
+  std::vector<std::vector<RequestRecord>> window_records_;
+};
+
+}  // namespace perf
+}  // namespace ctpu

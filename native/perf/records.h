@@ -1,0 +1,120 @@
+// Per-request records and window statistics.
+//
+// RequestRecord mirrors the reference's request_record.h; PerfStatus the
+// client-side slice of inference_profiler.h:101-169. Semantics are kept
+// identical to the Python harness (client_tpu/perf/records.py) so both
+// harnesses produce comparable numbers and export documents.
+#pragma once
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace ctpu {
+namespace perf {
+
+struct RequestRecord {
+  uint64_t start_ns = 0;
+  uint64_t end_ns = 0;
+  // per-response arrival times (decoupled models: several)
+  std::vector<uint64_t> response_ns;
+  bool success = true;
+  std::string error;
+  uint64_t sequence_id = 0;
+  uint64_t request_id = 0;
+  // client-side send/recv durations from RequestTimers
+  uint64_t send_ns = 0;
+  uint64_t recv_ns = 0;
+
+  uint64_t LatencyNs() const { return end_ns - start_ns; }
+};
+
+// Nearest-rank percentile over a pre-sorted vector
+// (client_tpu/perf/records.py percentile()).
+inline double Percentile(const std::vector<double>& sorted_values, double q) {
+  if (sorted_values.empty()) return 0.0;
+  long rank =
+      (long)std::ceil(q / 100.0 * (double)sorted_values.size()) - 1;
+  rank = std::max(0L, std::min((long)sorted_values.size() - 1, rank));
+  return sorted_values[rank];
+}
+
+struct PerfStatus {
+  size_t concurrency = 0;
+  double request_rate = 0.0;
+  uint64_t window_start_ns = 0;
+  uint64_t window_end_ns = 0;
+  size_t request_count = 0;
+  size_t error_count = 0;
+  double throughput = 0.0;           // infer/sec
+  double response_throughput = 0.0;  // responses/sec (decoupled)
+  double avg_latency_us = 0.0;
+  double std_latency_us = 0.0;
+  double avg_send_us = 0.0;
+  double avg_recv_us = 0.0;
+  std::map<int, double> latency_percentiles_us;
+  // server-side per-request averages over the window (microseconds)
+  double server_queue_us = 0.0;
+  double server_compute_infer_us = 0.0;
+  double server_compute_input_us = 0.0;
+  double server_compute_output_us = 0.0;
+};
+
+// Reduce the records completing inside [start, end] to a PerfStatus
+// (client_tpu/perf/records.py compute_window_status()).
+inline PerfStatus ComputeWindowStatus(
+    const std::vector<RequestRecord>& records, uint64_t window_start_ns,
+    uint64_t window_end_ns, const std::vector<int>& percentiles = {50, 90, 95,
+                                                                   99}) {
+  PerfStatus status;
+  status.window_start_ns = window_start_ns;
+  status.window_end_ns = window_end_ns;
+  double duration_s =
+      std::max(1e-9, (double)(window_end_ns - window_start_ns) / 1e9);
+  std::vector<double> lat_us;
+  size_t responses = 0;
+  uint64_t send_total = 0, recv_total = 0;
+  for (const auto& r : records) {
+    if (r.end_ns == 0 || r.end_ns < window_start_ns ||
+        r.end_ns > window_end_ns) {
+      continue;
+    }
+    if (!r.success) {
+      status.error_count++;
+      continue;
+    }
+    status.request_count++;
+    responses += r.response_ns.size();
+    lat_us.push_back((double)r.LatencyNs() / 1e3);
+    send_total += r.send_ns;
+    recv_total += r.recv_ns;
+  }
+  status.throughput = (double)status.request_count / duration_s;
+  status.response_throughput = (double)responses / duration_s;
+  if (!lat_us.empty()) {
+    std::sort(lat_us.begin(), lat_us.end());
+    double sum = 0;
+    for (double v : lat_us) sum += v;
+    double mean = sum / (double)lat_us.size();
+    status.avg_latency_us = mean;
+    if (lat_us.size() > 1) {
+      double ss = 0;
+      for (double v : lat_us) ss += (v - mean) * (v - mean);
+      status.std_latency_us = std::sqrt(ss / (double)(lat_us.size() - 1));
+    }
+    for (int q : percentiles) {
+      status.latency_percentiles_us[q] = Percentile(lat_us, q);
+    }
+    status.avg_send_us =
+        (double)send_total / (double)status.request_count / 1e3;
+    status.avg_recv_us =
+        (double)recv_total / (double)status.request_count / 1e3;
+  }
+  return status;
+}
+
+}  // namespace perf
+}  // namespace ctpu

@@ -1,0 +1,195 @@
+#include "report.h"
+
+#include <algorithm>
+#include <cstdio>
+#include <fstream>
+#include <sstream>
+
+namespace ctpu {
+namespace perf {
+
+namespace {
+double Pct(const PerfStatus& s, int q) {
+  auto it = s.latency_percentiles_us.find(q);
+  return it != s.latency_percentiles_us.end() ? it->second : 0.0;
+}
+}  // namespace
+
+std::string ConsoleReport(const std::vector<ProfileExperiment>& experiments) {
+  std::ostringstream out;
+  for (const auto& e : experiments) {
+    const PerfStatus& s = e.status;
+    if (e.mode == "concurrency") {
+      out << "Concurrency: " << (size_t)e.value;
+    } else {
+      out << "Request rate: " << e.value;
+    }
+    char buf[160];
+    std::snprintf(buf, sizeof(buf),
+                  ", throughput: %.2f infer/sec, latency %.0f usec\n",
+                  s.throughput, s.avg_latency_us);
+    out << buf;
+  }
+  out << "\nInferences/Second vs. Client Average Batch Latency\n";
+  for (const auto& e : experiments) {
+    const PerfStatus& s = e.status;
+    char buf[256];
+    std::snprintf(
+        buf, sizeof(buf),
+        "%s: %g, throughput: %.2f infer/sec, latency avg %.0f usec, "
+        "p50 %.0f usec, p90 %.0f usec, p95 %.0f usec, p99 %.0f usec\n",
+        e.mode.c_str(), e.value, s.throughput, s.avg_latency_us, Pct(s, 50),
+        Pct(s, 90), Pct(s, 95), Pct(s, 99));
+    out << buf;
+  }
+  return out.str();
+}
+
+std::string DetailedReport(const ProfileExperiment& experiment) {
+  const PerfStatus& s = experiment.status;
+  std::ostringstream out;
+  char buf[256];
+  std::snprintf(buf, sizeof(buf), "  Request count: %zu\n",
+                s.request_count);
+  out << buf;
+  std::snprintf(buf, sizeof(buf), "  Throughput: %.2f infer/sec\n",
+                s.throughput);
+  out << buf;
+  if (s.response_throughput > 0 &&
+      s.response_throughput != s.throughput) {
+    std::snprintf(buf, sizeof(buf),
+                  "  Response throughput: %.2f resp/sec\n",
+                  s.response_throughput);
+    out << buf;
+  }
+  std::snprintf(buf, sizeof(buf),
+                "  Avg latency: %.0f usec (standard deviation %.0f usec)\n",
+                s.avg_latency_us, s.std_latency_us);
+  out << buf;
+  for (const auto& kv : s.latency_percentiles_us) {
+    std::snprintf(buf, sizeof(buf), "  p%d latency: %.0f usec\n", kv.first,
+                  kv.second);
+    out << buf;
+  }
+  if (s.avg_send_us > 0 || s.avg_recv_us > 0) {
+    std::snprintf(buf, sizeof(buf),
+                  "  Client send: %.0f usec, recv: %.0f usec\n",
+                  s.avg_send_us, s.avg_recv_us);
+    out << buf;
+  }
+  if (s.server_compute_infer_us > 0) {
+    std::snprintf(buf, sizeof(buf),
+                  "  Server: queue %.0f usec, compute input %.0f usec, "
+                  "compute infer %.0f usec, compute output %.0f usec\n",
+                  s.server_queue_us, s.server_compute_input_us,
+                  s.server_compute_infer_us, s.server_compute_output_us);
+    out << buf;
+  }
+  if (s.error_count > 0) {
+    std::snprintf(buf, sizeof(buf), "  Errors: %zu\n", s.error_count);
+    out << buf;
+  }
+  return out.str();
+}
+
+Error WriteCsv(const std::vector<ProfileExperiment>& experiments,
+               const std::string& path) {
+  std::ofstream f(path);
+  if (!f) return Error("cannot open CSV report file '" + path + "'");
+  std::vector<int> percentile_cols;
+  for (const auto& e : experiments) {
+    for (const auto& kv : e.status.latency_percentiles_us) {
+      bool found = false;
+      for (int q : percentile_cols) found = found || q == kv.first;
+      if (!found) percentile_cols.push_back(kv.first);
+    }
+  }
+  std::sort(percentile_cols.begin(), percentile_cols.end());
+  f << (experiments.empty() || experiments[0].mode == "concurrency"
+            ? "Concurrency"
+            : "Request Rate")
+    << ",Inferences/Second,Client Send/Recv,Server Queue,"
+       "Server Compute Input,Server Compute Infer,Server Compute Output";
+  for (int q : percentile_cols) f << ",p" << q << " latency";
+  f << ",Avg latency\n";
+  for (const auto& e : experiments) {
+    const PerfStatus& s = e.status;
+    char buf[256];
+    std::snprintf(buf, sizeof(buf), "%g,%.2f,%.0f,%.0f,%.0f,%.0f,%.0f",
+                  e.value, s.throughput, s.avg_send_us + s.avg_recv_us,
+                  s.server_queue_us, s.server_compute_input_us,
+                  s.server_compute_infer_us, s.server_compute_output_us);
+    f << buf;
+    for (int q : percentile_cols) {
+      std::snprintf(buf, sizeof(buf), ",%.0f", Pct(s, q));
+      f << buf;
+    }
+    std::snprintf(buf, sizeof(buf), ",%.0f\n", s.avg_latency_us);
+    f << buf;
+  }
+  return Error::Success();
+}
+
+Error ExportProfile(const std::vector<ProfileExperiment>& experiments,
+                    const std::string& path, const std::string& service_kind,
+                    const std::string& endpoint) {
+  json::Object doc;
+  doc["service_kind"] = json::Value(service_kind);
+  doc["endpoint"] = json::Value(endpoint);
+  json::Array jexperiments;
+  for (const auto& e : experiments) {
+    json::Object jexp;
+    json::Object meta;
+    meta["mode"] = json::Value(e.mode);
+    meta["value"] = json::Value(e.value);
+    jexp["experiment"] = json::Value(std::move(meta));
+    json::Array jrequests;
+    for (const auto& r : e.records) {
+      json::Object jr;
+      jr["timestamp"] = json::Value((int64_t)r.start_ns);
+      jr["sequence_id"] = json::Value((int64_t)r.sequence_id);
+      json::Array resp;
+      for (uint64_t t : r.response_ns) resp.push_back(json::Value((int64_t)t));
+      jr["response_timestamps"] = json::Value(std::move(resp));
+      jr["success"] = json::Value(r.success);
+      jrequests.push_back(json::Value(std::move(jr)));
+    }
+    jexp["requests"] = json::Value(std::move(jrequests));
+    json::Array bounds;
+    bounds.push_back(json::Value((int64_t)e.status.window_start_ns));
+    bounds.push_back(json::Value((int64_t)e.status.window_end_ns));
+    jexp["window_boundaries"] = json::Value(std::move(bounds));
+    jexperiments.push_back(json::Value(std::move(jexp)));
+  }
+  doc["experiments"] = json::Value(std::move(jexperiments));
+  std::ofstream f(path);
+  if (!f) return Error("cannot open profile export file '" + path + "'");
+  f << json::Value(std::move(doc)).Dump();
+  return Error::Success();
+}
+
+std::string JsonSummary(const std::vector<ProfileExperiment>& experiments) {
+  // summarize the best (max-throughput) experiment
+  const ProfileExperiment* best = nullptr;
+  for (const auto& e : experiments) {
+    if (best == nullptr || e.status.throughput > best->status.throughput) {
+      best = &e;
+    }
+  }
+  json::Object out;
+  if (best != nullptr) {
+    const PerfStatus& s = best->status;
+    out["mode"] = json::Value(best->mode);
+    out["value"] = json::Value(best->value);
+    out["throughput"] = json::Value(s.throughput);
+    out["avg_us"] = json::Value(s.avg_latency_us);
+    out["p50_us"] = json::Value(Pct(s, 50));
+    out["p99_us"] = json::Value(Pct(s, 99));
+    out["count"] = json::Value((int64_t)s.request_count);
+    out["errors"] = json::Value((int64_t)s.error_count);
+  }
+  return json::Value(std::move(out)).Dump();
+}
+
+}  // namespace perf
+}  // namespace ctpu

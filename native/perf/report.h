@@ -1,0 +1,27 @@
+// Reporting: console summary, CSV, profile-export JSON, bench summary.
+// Console/CSV mirror the reference's ReportWriter (report_writer.cc); the
+// profile-export document matches the Python harness's exporter
+// (client_tpu/perf/report.py export_profile) so genai-perf parses either.
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "profiler.h"
+
+namespace ctpu {
+namespace perf {
+
+std::string ConsoleReport(const std::vector<ProfileExperiment>& experiments);
+std::string DetailedReport(const ProfileExperiment& experiment);
+Error WriteCsv(const std::vector<ProfileExperiment>& experiments,
+               const std::string& path);
+Error ExportProfile(const std::vector<ProfileExperiment>& experiments,
+                    const std::string& path,
+                    const std::string& service_kind = "kserve",
+                    const std::string& endpoint = "");
+// One-line JSON for bench drivers: {"throughput": ..., "p50_us": ...}.
+std::string JsonSummary(const std::vector<ProfileExperiment>& experiments);
+
+}  // namespace perf
+}  // namespace ctpu

@@ -1,0 +1,76 @@
+// Sequence id assignment, length variation, start/end flags
+// (reference sequence_manager.h:46-218). Each worker slot owns at most one
+// active sequence; ids are unique across slots.
+#pragma once
+
+#include <cstdint>
+#include <mutex>
+#include <random>
+#include <vector>
+
+namespace ctpu {
+namespace perf {
+
+class SequenceManager {
+ public:
+  SequenceManager(uint64_t start_id, size_t num_slots, int sequence_length,
+                  double length_variation_pct = 0.0, uint64_t seed = 0)
+      : next_id_(start_id),
+        length_(sequence_length),
+        variation_pct_(length_variation_pct),
+        rng_(seed),
+        slots_(num_slots) {}
+
+  struct StepFlags {
+    uint64_t sequence_id = 0;
+    bool start = false;
+    bool end = false;
+  };
+
+  // Next step for the given slot; rolls to a fresh sequence after the
+  // (possibly varied) length is reached.
+  StepFlags NextStep(size_t slot_index) {
+    std::lock_guard<std::mutex> lk(mu_);
+    Slot& slot = slots_[slot_index % slots_.size()];
+    StepFlags flags;
+    if (slot.remaining == 0) {
+      slot.id = next_id_++;
+      slot.remaining = SampleLength();
+      flags.start = true;
+    }
+    flags.sequence_id = slot.id;
+    slot.remaining--;
+    if (slot.remaining == 0) flags.end = true;
+    return flags;
+  }
+
+  // True when the slot has no active sequence (last step ended it).
+  bool SequenceComplete(size_t slot_index) {
+    std::lock_guard<std::mutex> lk(mu_);
+    return slots_[slot_index % slots_.size()].remaining == 0;
+  }
+
+ private:
+  int SampleLength() {
+    if (variation_pct_ <= 0.0) return std::max(1, length_);
+    double lo = length_ * (1.0 - variation_pct_ / 100.0);
+    double hi = length_ * (1.0 + variation_pct_ / 100.0);
+    std::uniform_real_distribution<double> dist(lo, hi);
+    return std::max(1, (int)dist(rng_));
+  }
+
+  struct Slot {
+    uint64_t id = 0;
+    int remaining = 0;
+  };
+
+  std::mutex mu_;
+  uint64_t next_id_;
+  int length_;
+  double variation_pct_;
+  std::mt19937_64 rng_;
+  std::vector<Slot> slots_;
+};
+
+}  // namespace perf
+}  // namespace ctpu

@@ -1,0 +1,147 @@
+// Data-loader + infer-data tests (reference test_dataloader.cc role).
+#include <cstring>
+#include <fstream>
+
+#include "data_loader.h"
+#include "infer_data.h"
+#include "mock_backend.h"
+#include "model_parser.h"
+#include "test_framework.h"
+
+using namespace ctpu;
+using namespace ctpu::perf;
+
+namespace {
+
+MockClientBackend::Options MetaOptions(const char* metadata,
+                                       const char* config) {
+  MockClientBackend::Options options;
+  options.metadata_json = metadata;
+  options.config_json = config;
+  return options;
+}
+
+}  // namespace
+
+TEST_CASE("data loader: synthetic respects shapes and dtypes") {
+  MockClientBackend backend(MetaOptions(
+      R"({"name":"m","inputs":[
+          {"name":"A","datatype":"INT32","shape":[-1, 4]},
+          {"name":"B","datatype":"BYTES","shape":[2]}],
+          "outputs":[]})",
+      R"({"name":"m","max_batch_size":8})"));
+  ModelParser parser;
+  CHECK_OK(parser.Init(&backend, "m", ""));
+  DataLoader loader(&parser, 3);
+  CHECK_OK(loader.GenerateSynthetic());
+  const StepData& step = loader.GetStep(0, 0);
+  CHECK_EQ(step.tensors.size(), 2u);
+  CHECK_EQ(step.tensors[0].shape.size(), 2u);
+  CHECK_EQ(step.tensors[0].shape[0], 3);  // batch dim replaced
+  CHECK_EQ(step.tensors[0].bytes.size(), 3u * 4u * 4u);
+  // BYTES: two length-prefixed elements
+  uint32_t len;
+  std::memcpy(&len, step.tensors[1].bytes.data(), 4);
+  CHECK(len > 0);
+}
+
+TEST_CASE("data loader: dynamic non-batch dim needs --shape") {
+  MockClientBackend backend(MetaOptions(
+      R"({"name":"m","inputs":[{"name":"A","datatype":"FP32","shape":[-1]}],
+          "outputs":[]})",
+      R"({"name":"m","max_batch_size":0})"));
+  ModelParser parser;
+  CHECK_OK(parser.Init(&backend, "m", ""));
+  DataLoader no_override(&parser, 1);
+  CHECK(!no_override.GenerateSynthetic().IsOk());
+  DataLoader with_override(&parser, 1, {{"A", {16}}});
+  CHECK_OK(with_override.GenerateSynthetic());
+  CHECK_EQ(with_override.GetStep(0, 0).tensors[0].bytes.size(), 64u);
+}
+
+TEST_CASE("data loader: json streams, steps, b64, parameters") {
+  MockClientBackend backend(MetaOptions(
+      R"({"name":"m","inputs":[{"name":"IN","datatype":"INT32","shape":[2]}],
+          "outputs":[]})",
+      R"({"name":"m","max_batch_size":0})"));
+  ModelParser parser;
+  CHECK_OK(parser.Init(&backend, "m", ""));
+  // AQAAAAIAAAA= is int32 [1, 2] little-endian
+  const char* doc = R"({"data": [
+      [{"IN": [1, 2], "parameters": {"max_tokens": 7}},
+       {"IN": {"content": [3, 4], "shape": [2]}}],
+      [{"IN": {"b64": "AQAAAAIAAAA=", "shape": [2]}}]
+  ]})";
+  std::ofstream("/tmp/ctpu_test_data.json") << doc;
+  DataLoader loader(&parser, 1);
+  CHECK_OK(loader.ReadFromJson("/tmp/ctpu_test_data.json"));
+  CHECK_EQ(loader.StreamCount(), 2u);
+  CHECK_EQ(loader.StepCount(0), 2u);
+  const StepData& s00 = loader.GetStep(0, 0);
+  CHECK(!s00.parameters.IsNull());
+  CHECK_EQ(s00.parameters["max_tokens"].AsInt(), 7);
+  int32_t vals[2];
+  std::memcpy(vals, s00.tensors[0].bytes.data(), 8);
+  CHECK_EQ(vals[0], 1);
+  CHECK_EQ(vals[1], 2);
+  const StepData& s10 = loader.GetStep(1, 0);
+  std::memcpy(vals, s10.tensors[0].bytes.data(), 8);
+  CHECK_EQ(vals[0], 1);
+  CHECK_EQ(vals[1], 2);
+  // flat (non-nested) form: one stream
+  std::ofstream("/tmp/ctpu_test_flat.json")
+      << R"({"data": [{"IN": [1,2]}, {"IN": [3,4]}]})";
+  DataLoader flat(&parser, 1);
+  CHECK_OK(flat.ReadFromJson("/tmp/ctpu_test_flat.json"));
+  CHECK_EQ(flat.StreamCount(), 1u);
+  CHECK_EQ(flat.StepCount(0), 2u);
+}
+
+TEST_CASE("infer data: plain manager points at loader bytes") {
+  MockClientBackend backend;
+  ModelParser parser;
+  CHECK_OK(parser.Init(&backend, "mock", ""));
+  DataLoader loader(&parser, 1);
+  CHECK_OK(loader.GenerateSynthetic());
+  InferDataManager data(&loader);
+  PreparedRequest request;
+  CHECK_OK(data.Prepare(0, 0, &request));
+  CHECK_EQ(request.input_ptrs.size(), 1u);
+  CHECK_EQ(request.input_ptrs[0]->Name(), "IN");
+  CHECK_EQ(request.input_ptrs[0]->TotalByteSize(), 32u);  // FP32[8]
+  // zero copy: buffer points into the loader's storage
+  CHECK_EQ((const void*)request.input_ptrs[0]->Buffers()[0].first,
+           (const void*)loader.GetStep(0, 0).tensors[0].bytes.data());
+}
+
+TEST_CASE("infer data: shm manager registers regions and uses refs") {
+  MockClientBackend backend;
+  ModelParser parser;
+  CHECK_OK(parser.Init(&backend, "mock", ""));
+  DataLoader loader(&parser, 1);
+  CHECK_OK(loader.GenerateSynthetic());
+  {
+    InferDataManagerShm data(&loader, &backend, "ctpu_test");
+    CHECK_OK(data.Init());
+    CHECK_EQ(backend.shm_register_count.load(), 1);
+    PreparedRequest request;
+    CHECK_OK(data.Prepare(0, 0, &request));
+    CHECK(request.input_ptrs[0]->IsSharedMemory());
+    CHECK_EQ(request.input_ptrs[0]->SharedMemoryByteSize(), 32u);
+    CHECK_OK(data.Cleanup());
+    CHECK_EQ(backend.shm_unregister_count.load(), 1);
+  }
+}
+
+TEST_CASE("model parser: scheduler + decoupled detection") {
+  MockClientBackend backend(MetaOptions(
+      R"({"name":"m","inputs":[],"outputs":[]})",
+      R"({"name":"m","max_batch_size":4,"sequence_batching":{},
+          "model_transaction_policy":{"decoupled":true}})"));
+  ModelParser parser;
+  CHECK_OK(parser.Init(&backend, "m", ""));
+  CHECK(parser.Scheduler() == ModelParser::SchedulerType::SEQUENCE);
+  CHECK(parser.IsDecoupled());
+  CHECK_EQ(parser.MaxBatchSize(), 4);
+  CHECK(parser.SupportsBatching());
+}

@@ -1,0 +1,80 @@
+// Minimal test framework (the role doctest plays in the reference's
+// perf_analyzer_unit_tests; not vendored here — ~60 lines cover the need).
+#pragma once
+
+#include <cmath>
+#include <cstdio>
+#include <functional>
+#include <string>
+#include <vector>
+
+namespace ctest {
+
+struct TestCase {
+  std::string name;
+  std::function<void()> fn;
+};
+
+inline std::vector<TestCase>& Registry() {
+  static std::vector<TestCase> cases;
+  return cases;
+}
+
+inline int& Failures() {
+  static int failures = 0;
+  return failures;
+}
+
+struct Registrar {
+  Registrar(const char* name, std::function<void()> fn) {
+    Registry().push_back({name, std::move(fn)});
+  }
+};
+
+#define CTEST_CONCAT_(a, b) a##b
+#define CTEST_CONCAT(a, b) CTEST_CONCAT_(a, b)
+
+#define TEST_CASE(name)                                              \
+  static void CTEST_CONCAT(ctest_fn_, __LINE__)();                   \
+  static ::ctest::Registrar CTEST_CONCAT(ctest_reg_, __LINE__)(      \
+      name, CTEST_CONCAT(ctest_fn_, __LINE__));                      \
+  static void CTEST_CONCAT(ctest_fn_, __LINE__)()
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      std::printf("    FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      ::ctest::Failures()++;                                          \
+    }                                                                 \
+  } while (0)
+
+#define CHECK_EQ(a, b) CHECK((a) == (b))
+#define CHECK_NEAR(a, b, eps) CHECK(std::fabs((double)(a) - (double)(b)) <= (eps))
+#define CHECK_OK(expr)                                                      \
+  do {                                                                      \
+    ::ctpu::Error err__ = (expr);                                           \
+    if (!err__.IsOk()) {                                                    \
+      std::printf("    FAIL %s:%d: %s -> %s\n", __FILE__, __LINE__, #expr,  \
+                  err__.Message().c_str());                                 \
+      ::ctest::Failures()++;                                                \
+    }                                                                       \
+  } while (0)
+
+inline int RunAll() {
+  int run = 0;
+  for (auto& t : Registry()) {
+    std::printf("[ RUN  ] %s\n", t.name.c_str());
+    int before = Failures();
+    t.fn();
+    run++;
+    if (Failures() == before) {
+      std::printf("[  OK  ] %s\n", t.name.c_str());
+    } else {
+      std::printf("[ FAIL ] %s\n", t.name.c_str());
+    }
+  }
+  std::printf("%d test cases, %d failures\n", run, Failures());
+  return Failures() == 0 ? 0 : 1;
+}
+
+}  // namespace ctest

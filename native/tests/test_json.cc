@@ -1,0 +1,61 @@
+#include "json.h"
+#include "test_framework.h"
+
+using ctpu::json::Parse;
+using ctpu::json::Value;
+
+TEST_CASE("json: parse scalars and structure") {
+  Value v = Parse(R"({"a": 1, "b": -2.5, "c": "x\ny", "d": [true, null]})");
+  CHECK(v.IsObject());
+  CHECK_EQ(v["a"].AsInt(), 1);
+  CHECK_NEAR(v["b"].AsDouble(), -2.5, 1e-12);
+  CHECK_EQ(v["c"].AsString(), "x\ny");
+  CHECK(v["d"].IsArray());
+  CHECK_EQ(v["d"].AsArray().size(), 2u);
+  CHECK(v["d"].AsArray()[0].AsBool());
+  CHECK(v["d"].AsArray()[1].IsNull());
+  CHECK(v["missing"].IsNull());
+}
+
+TEST_CASE("json: unicode escapes") {
+  Value v = Parse(R"({"s": "Aé中"})");
+  CHECK_EQ(v["s"].AsString(), "A\xc3\xa9\xe4\xb8\xad");
+}
+
+TEST_CASE("json: roundtrip dump/parse") {
+  Value v = Parse(R"({"x": [1, 2.5, "s"], "y": {"z": false}})");
+  Value v2 = Parse(v.Dump());
+  CHECK_EQ(v2["x"].AsArray()[0].AsInt(), 1);
+  CHECK_NEAR(v2["x"].AsArray()[1].AsDouble(), 2.5, 1e-12);
+  CHECK_EQ(v2["x"].AsArray()[2].AsString(), "s");
+  CHECK_EQ(v2["y"]["z"].AsBool(), false);
+}
+
+TEST_CASE("json: malformed input throws") {
+  bool threw = false;
+  try {
+    Parse("{\"a\": }");
+  } catch (const std::exception&) {
+    threw = true;
+  }
+  CHECK(threw);
+  threw = false;
+  try {
+    Parse("[1, 2");
+  } catch (const std::exception&) {
+    threw = true;
+  }
+  CHECK(threw);
+  threw = false;
+  try {
+    Parse("{} trailing");
+  } catch (const std::exception&) {
+    threw = true;
+  }
+  CHECK(threw);
+}
+
+TEST_CASE("json: big ints preserved") {
+  Value v = Parse("{\"t\": 1769888881234567890}");
+  CHECK_EQ(v["t"].AsInt(), 1769888881234567890LL);
+}

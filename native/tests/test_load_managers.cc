@@ -1,0 +1,253 @@
+// Hermetic load-manager / profiler tests over the mock backend — the
+// reference's tier-1 strategy (reference test_request_rate_manager.cc,
+// test_concurrency_manager.cc, test_inference_profiler.cc roles).
+#include <chrono>
+#include <fstream>
+#include <sstream>
+#include <thread>
+
+#include "data_loader.h"
+#include "infer_data.h"
+#include "load_manager.h"
+#include "mock_backend.h"
+#include "model_parser.h"
+#include "profiler.h"
+#include "report.h"
+#include "sequence_manager.h"
+#include "test_framework.h"
+
+using namespace ctpu;
+using namespace ctpu::perf;
+
+namespace {
+
+struct Harness {
+  std::shared_ptr<MockClientBackend> mock;
+  std::shared_ptr<ClientBackend> backend;
+  ModelParser parser;
+  std::unique_ptr<DataLoader> loader;
+  std::unique_ptr<InferDataManager> data;
+  LoadConfig config;
+
+  explicit Harness(MockClientBackend::Options options =
+                       MockClientBackend::Options()) {
+    mock = std::make_shared<MockClientBackend>(options);
+    backend = mock;
+    CHECK_OK(parser.Init(mock.get(), "mock", ""));
+    loader.reset(new DataLoader(&parser, 1));
+    CHECK_OK(loader->GenerateSynthetic());
+    data.reset(new InferDataManager(loader.get()));
+    config.model_name = "mock";
+    config.max_threads = 8;
+  }
+};
+
+void SleepMs(int ms) {
+  std::this_thread::sleep_for(std::chrono::milliseconds(ms));
+}
+
+}  // namespace
+
+TEST_CASE("concurrency: maintains the requested in-flight level") {
+  MockClientBackend::Options options;
+  options.latency_us = 5000;
+  Harness h(options);
+  ConcurrencyManager manager(h.backend, h.data.get(), h.config);
+  manager.ChangeConcurrency(4);
+  SleepMs(150);
+  manager.Stop();
+  CHECK_EQ(h.mock->max_inflight.load(), 4);
+  CHECK(h.mock->request_count.load() > 20);
+  // each worker created exactly one context
+  CHECK_EQ(h.mock->context_count.load(), 4);
+}
+
+TEST_CASE("concurrency: reconfigure up and down") {
+  MockClientBackend::Options options;
+  options.latency_us = 2000;
+  Harness h(options);
+  ConcurrencyManager manager(h.backend, h.data.get(), h.config);
+  manager.ChangeConcurrency(2);
+  SleepMs(60);
+  manager.ChangeConcurrency(6);
+  SleepMs(100);
+  CHECK_EQ(h.mock->max_inflight.load(), 6);
+  manager.ChangeConcurrency(1);
+  h.mock->max_inflight.store(0);
+  SleepMs(80);
+  CHECK_EQ(h.mock->max_inflight.load(), 1);
+  manager.Stop();
+}
+
+TEST_CASE("concurrency: records carry timestamps and errors") {
+  MockClientBackend::Options options;
+  options.latency_us = 1000;
+  options.error_every = 3;
+  Harness h(options);
+  ConcurrencyManager manager(h.backend, h.data.get(), h.config);
+  manager.ChangeConcurrency(2);
+  SleepMs(100);
+  manager.Stop();
+  auto records = manager.SwapRecords();
+  CHECK(records.size() > 10);
+  size_t errors = 0;
+  for (const auto& r : records) {
+    CHECK(r.end_ns > r.start_ns);
+    if (!r.success) errors++;
+  }
+  CHECK(errors > 0);
+  CHECK_NEAR((double)errors, (double)records.size() / 3.0,
+             (double)records.size() / 6.0 + 2.0);
+}
+
+TEST_CASE("request rate: hits the configured rate") {
+  MockClientBackend::Options options;
+  options.latency_us = 1000;
+  Harness h(options);
+  RequestRateManager manager(h.backend, h.data.get(), h.config);
+  manager.ChangeRate(200.0);
+  SleepMs(500);
+  manager.Stop();
+  auto records = manager.SwapRecords();
+  // 200/s over ~0.5s => ~100; allow wide margin for CI noise
+  CHECK(records.size() > 60);
+  CHECK(records.size() < 140);
+}
+
+TEST_CASE("request rate: poisson schedule also sustains the mean") {
+  MockClientBackend::Options options;
+  options.latency_us = 500;
+  Harness h(options);
+  RequestRateManager manager(h.backend, h.data.get(), h.config, nullptr,
+                             RequestRateManager::Distribution::POISSON, 7);
+  manager.ChangeRate(300.0);
+  SleepMs(400);
+  manager.Stop();
+  auto records = manager.SwapRecords();
+  CHECK(records.size() > 60);
+  CHECK(records.size() < 190);
+}
+
+TEST_CASE("custom intervals: replays the interval list") {
+  MockClientBackend::Options options;
+  options.latency_us = 200;
+  Harness h(options);
+  RequestRateManager manager(h.backend, h.data.get(), h.config);
+  // 2ms + 8ms alternating = 200/s mean
+  manager.StartCustomIntervals({0.002, 0.008});
+  SleepMs(400);
+  manager.Stop();
+  auto records = manager.SwapRecords();
+  CHECK(records.size() > 50);
+  CHECK(records.size() < 110);
+}
+
+TEST_CASE("sequences: ids unique per slot, start/end flags consistent") {
+  MockClientBackend::Options options;
+  options.latency_us = 200;
+  Harness h(options);
+  SequenceManager sequences(100, 3, 5, 0.0, 0);
+  ConcurrencyManager manager(h.backend, h.data.get(), h.config, &sequences);
+  manager.ChangeConcurrency(3);
+  SleepMs(200);
+  manager.Stop();
+  std::lock_guard<std::mutex> lk(h.mock->seq_mu);
+  CHECK(h.mock->sequences.size() >= 3u);
+  size_t complete = 0;
+  for (const auto& kv : h.mock->sequences) {
+    CHECK_EQ(kv.second.starts, 1);
+    CHECK(kv.second.steps <= 5);
+    if (kv.second.ended) {
+      CHECK_EQ(kv.second.steps, 5);
+      complete++;
+    }
+  }
+  CHECK(complete > 0);
+}
+
+TEST_CASE("sequence manager: length variation within bounds") {
+  SequenceManager sequences(1, 1, 100, 20.0, 42);
+  for (int s = 0; s < 20; ++s) {
+    int len = 0;
+    while (true) {
+      auto flags = sequences.NextStep(0);
+      len++;
+      if (flags.end) break;
+    }
+    CHECK(len >= 80);
+    CHECK(len <= 120);
+  }
+}
+
+TEST_CASE("profiler: stabilizes on steady mock load") {
+  MockClientBackend::Options options;
+  options.latency_us = 1000;
+  Harness h(options);
+  ConcurrencyManager manager(h.backend, h.data.get(), h.config);
+  ProfilerConfig config;
+  config.measurement_interval_s = 0.1;
+  config.stability_pct = 50.0;
+  config.max_trials = 8;
+  InferenceProfiler profiler(&manager, config);
+  CHECK_OK(profiler.ProfileConcurrencyRange(&manager, 2, 2, 1));
+  const auto& experiments = profiler.Experiments();
+  CHECK_EQ(experiments.size(), 1u);
+  CHECK(experiments[0].stable);
+  CHECK(experiments[0].status.request_count > 20);
+  CHECK(experiments[0].status.throughput > 100.0);
+  CHECK(experiments[0].status.avg_latency_us > 500.0);
+  CHECK(!experiments[0].records.empty());
+}
+
+TEST_CASE("profiler: latency threshold stops the sweep") {
+  MockClientBackend::Options options;
+  options.latency_us = 4000;
+  Harness h(options);
+  ConcurrencyManager manager(h.backend, h.data.get(), h.config);
+  ProfilerConfig config;
+  config.measurement_interval_s = 0.08;
+  config.stability_pct = 60.0;
+  config.max_trials = 5;
+  config.latency_threshold_us = 1000.0;  // mock latency 4ms > 1ms budget
+  InferenceProfiler profiler(&manager, config);
+  CHECK_OK(profiler.ProfileConcurrencyRange(&manager, 1, 8, 1));
+  CHECK_EQ(profiler.Experiments().size(), 1u);  // stopped after first point
+}
+
+TEST_CASE("periodic concurrency: ramps and completes") {
+  MockClientBackend::Options options;
+  options.latency_us = 500;
+  Harness h(options);
+  PeriodicConcurrencyManager manager(h.backend, h.data.get(), h.config, 1, 3,
+                                     1, 10);
+  CHECK_OK(manager.Run());
+  auto records = manager.SwapRecords();
+  CHECK(records.size() >= 30u);
+  CHECK(h.mock->max_inflight.load() <= 3);
+}
+
+TEST_CASE("report: csv + export + summary are well formed") {
+  MockClientBackend::Options options;
+  options.latency_us = 500;
+  Harness h(options);
+  ConcurrencyManager manager(h.backend, h.data.get(), h.config);
+  ProfilerConfig config;
+  config.measurement_interval_s = 0.05;
+  config.stability_pct = 80.0;
+  config.max_trials = 5;
+  InferenceProfiler profiler(&manager, config);
+  CHECK_OK(profiler.ProfileConcurrencyRange(&manager, 1, 2, 1));
+  const auto& experiments = profiler.Experiments();
+  CHECK_OK(WriteCsv(experiments, "/tmp/ctpu_test_report.csv"));
+  CHECK_OK(ExportProfile(experiments, "/tmp/ctpu_test_export.json"));
+  // export parses back and has the expected shape
+  std::ifstream f("/tmp/ctpu_test_export.json");
+  std::stringstream ss;
+  ss << f.rdbuf();
+  json::Value doc = json::Parse(ss.str());
+  CHECK_EQ(doc["experiments"].AsArray().size(), experiments.size());
+  CHECK(doc["experiments"].AsArray()[0]["requests"].AsArray().size() > 0);
+  std::string summary = JsonSummary(experiments);
+  json::Value sv = json::Parse(summary);
+  CHECK(sv["throughput"].AsDouble() > 0);
+}

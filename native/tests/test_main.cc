@@ -1,0 +1,3 @@
+#include "test_framework.h"
+
+int main() { return ctest::RunAll(); }

@@ -1,0 +1,107 @@
+"""Native C++ layer tests: build, hermetic unit tests, and live end-to-end
+runs of the example client and perf_analyzer against the in-repo server
+(the C++ twin of the reference's tier-1 + tier-2 strategy, SURVEY.md §4)."""
+
+import json
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUILD = os.path.join(REPO, "build")
+
+
+def _build():
+    if shutil.which("cmake") is None or shutil.which("ninja") is None:
+        pytest.skip("cmake/ninja not available")
+    subprocess.run(
+        ["cmake", "-S", os.path.join(REPO, "native"), "-B", BUILD,
+         "-G", "Ninja"],
+        check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["ninja", "-C", BUILD], check=True, capture_output=True, timeout=600
+    )
+
+
+@pytest.fixture(scope="module")
+def native_build():
+    _build()
+    return BUILD
+
+
+def test_cpp_unit_tests(native_build):
+    out = subprocess.run(
+        [os.path.join(native_build, "unit_tests")],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "0 failures" in out.stdout
+
+
+@pytest.fixture(scope="module")
+def live_server():
+    from client_tpu.testing import InProcessServer
+
+    with InProcessServer(host="127.0.0.1", grpc=False) as server:
+        yield server
+
+
+def test_cpp_example_client(native_build, live_server):
+    out = subprocess.run(
+        [os.path.join(native_build, "simple_http_infer_client"),
+         "-u", live_server.http_url],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "PASS" in out.stdout
+
+
+def test_cpp_perf_analyzer_live(native_build, live_server, tmp_path):
+    export = tmp_path / "export.json"
+    csv = tmp_path / "report.csv"
+    out = subprocess.run(
+        [os.path.join(native_build, "perf_analyzer"),
+         "-m", "simple", "-u", live_server.http_url,
+         "--concurrency-range", "2",
+         "--measurement-interval", "500",
+         "--stability-percentage", "60",
+         "--max-trials", "4",
+         "--json-summary",
+         "-f", str(csv),
+         "--profile-export-file", str(export)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    summary = None
+    for line in out.stdout.splitlines():
+        if line.startswith("{"):
+            summary = json.loads(line)
+    assert summary is not None
+    assert summary["throughput"] > 0
+    assert summary["errors"] == 0
+    doc = json.loads(export.read_text())
+    assert doc["experiments"][0]["requests"]
+    assert csv.read_text().startswith("Concurrency,")
+
+
+def test_cpp_perf_analyzer_shm_live(native_build, live_server):
+    out = subprocess.run(
+        [os.path.join(native_build, "perf_analyzer"),
+         "-m", "simple", "-u", live_server.http_url,
+         "--shared-memory", "system",
+         "--concurrency-range", "2",
+         "--measurement-interval", "400",
+         "--stability-percentage", "60",
+         "--max-trials", "3",
+         "--json-summary"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    summary = json.loads(
+        [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+    )
+    assert summary["errors"] == 0
+    assert summary["throughput"] > 0
