@@ -57,6 +57,12 @@ class Error {
 // (variable length), -1 for unknown.
 int64_t DtypeByteSize(const std::string& dtype);
 
+// Resolves `host` and opens a TCP connection with TCP_NODELAY set. When
+// timeout_us > 0, SO_RCVTIMEO/SO_SNDTIMEO are also applied. Returns the fd,
+// or -1 with a message in *err. Shared by the HTTP/1.1 and HTTP/2 clients.
+int DialTcp(const std::string& host, int port, int64_t timeout_us,
+            std::string* err);
+
 int64_t ShapeNumElements(const std::vector<int64_t>& shape);
 
 // ---------------------------------------------------------------------------

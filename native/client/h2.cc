@@ -1,0 +1,531 @@
+#include "h2.h"
+
+#include "common.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+
+namespace ctpu {
+namespace h2 {
+
+namespace {
+
+constexpr uint8_t kFrameData = 0x0;
+constexpr uint8_t kFrameHeaders = 0x1;
+constexpr uint8_t kFramePriority = 0x2;
+constexpr uint8_t kFrameRstStream = 0x3;
+constexpr uint8_t kFrameSettings = 0x4;
+constexpr uint8_t kFramePushPromise = 0x5;
+constexpr uint8_t kFramePing = 0x6;
+constexpr uint8_t kFrameGoaway = 0x7;
+constexpr uint8_t kFrameWindowUpdate = 0x8;
+constexpr uint8_t kFrameContinuation = 0x9;
+
+constexpr uint8_t kFlagEndStream = 0x1;   // DATA, HEADERS
+constexpr uint8_t kFlagAck = 0x1;         // SETTINGS, PING
+constexpr uint8_t kFlagEndHeaders = 0x4;  // HEADERS, CONTINUATION
+constexpr uint8_t kFlagPadded = 0x8;
+constexpr uint8_t kFlagPriority = 0x20;
+
+constexpr uint16_t kSettingsHeaderTableSize = 0x1;
+constexpr uint16_t kSettingsMaxConcurrentStreams = 0x3;
+constexpr uint16_t kSettingsInitialWindowSize = 0x4;
+constexpr uint16_t kSettingsMaxFrameSize = 0x5;
+
+// Our advertised receive windows. Large so the server rarely stalls; we
+// still replenish with WINDOW_UPDATE as data is consumed.
+constexpr int64_t kRecvWindow = 1 << 30;
+constexpr int64_t kRecvUpdateThreshold = 1 << 20;
+
+const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+
+void PutU16(uint8_t* p, uint16_t v) {
+  p[0] = v >> 8;
+  p[1] = v & 0xff;
+}
+
+void PutU32(uint8_t* p, uint32_t v) {
+  p[0] = v >> 24;
+  p[1] = (v >> 16) & 0xff;
+  p[2] = (v >> 8) & 0xff;
+  p[3] = v & 0xff;
+}
+
+uint32_t GetU32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+}  // namespace
+
+std::unique_ptr<Connection> Connection::Connect(const std::string& host,
+                                                int port, std::string* err) {
+  int fd = DialTcp(host, port, 0, err);
+  if (fd < 0) return nullptr;
+  std::unique_ptr<Connection> conn(new Connection());
+  conn->fd_ = fd;
+  // Client preface + initial SETTINGS + connection window top-up, one write.
+  uint8_t settings[18];
+  PutU16(settings + 0, kSettingsInitialWindowSize);
+  PutU32(settings + 2, static_cast<uint32_t>(kRecvWindow));
+  PutU16(settings + 6, kSettingsMaxFrameSize);
+  PutU32(settings + 8, 1 << 20);
+  PutU16(settings + 12, kSettingsHeaderTableSize);
+  PutU32(settings + 14, 4096);
+  std::string buf(kPreface, sizeof(kPreface) - 1);
+  uint8_t fh[9];
+  PutU32(fh, static_cast<uint32_t>(sizeof(settings)) << 8);
+  fh[3] = kFrameSettings;
+  fh[4] = 0;
+  PutU32(fh + 5, 0);
+  buf.append(reinterpret_cast<char*>(fh), 9);
+  buf.append(reinterpret_cast<char*>(settings), sizeof(settings));
+  uint8_t wu[4];
+  PutU32(wu, static_cast<uint32_t>(kRecvWindow - 65535));
+  PutU32(fh, 4u << 8);
+  fh[3] = kFrameWindowUpdate;
+  PutU32(fh + 5, 0);
+  buf.append(reinterpret_cast<char*>(fh), 9);
+  buf.append(reinterpret_cast<char*>(wu), 4);
+  if (!conn->WriteAll(buf.data(), buf.size())) {
+    *err = "failed to write HTTP/2 preface";
+    close(fd);
+    conn->fd_ = -1;
+    return nullptr;
+  }
+  conn->reader_ = std::thread([c = conn.get()] { c->ReaderLoop(); });
+  return conn;
+}
+
+Connection::~Connection() {
+  Shutdown("connection destroyed");
+  if (reader_.joinable()) reader_.join();
+  if (fd_ >= 0) {
+    ::close(fd_);  // Shutdown() only half-closes; release the fd here
+    fd_ = -1;
+  }
+}
+
+bool Connection::WriteAll(const void* data, size_t len) {
+  const char* p = static_cast<const char*>(data);
+  while (len > 0) {
+    ssize_t n = ::send(fd_, p, len, MSG_NOSIGNAL);
+    if (n <= 0) {
+      if (n < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool Connection::SendFrameLocked(uint8_t type, uint8_t flags,
+                                 uint32_t stream_id, const void* payload,
+                                 size_t len) {
+  uint8_t fh[9];
+  PutU32(fh, static_cast<uint32_t>(len) << 8);
+  fh[3] = type;
+  fh[4] = flags;
+  PutU32(fh + 5, stream_id & 0x7fffffffu);
+  if (dead_.load()) return false;
+  if (!WriteAll(fh, 9)) return false;
+  if (len > 0 && !WriteAll(payload, len)) return false;
+  return true;
+}
+
+bool Connection::SendFrame(uint8_t type, uint8_t flags, uint32_t stream_id,
+                           const void* payload, size_t len) {
+  std::lock_guard<std::mutex> lk(write_mu_);
+  return SendFrameLocked(type, flags, stream_id, payload, len);
+}
+
+int32_t Connection::StartStream(const std::vector<hpack::Header>& headers,
+                                bool end_stream, StreamEvents events) {
+  std::string block;
+  hpack::Encode(headers, &block);
+  uint32_t id;
+  bool ok = true;
+  {
+    // write_mu_ is held across stream-id allocation AND the whole header
+    // block so that (a) HEADERS frames hit the wire in stream-id order
+    // (RFC 7540 §5.1.1) and (b) no other frame interleaves between HEADERS
+    // and its CONTINUATIONs (§4.3).
+    std::lock_guard<std::mutex> wlk(write_mu_);
+    size_t max_frame;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      if (dead_.load()) return -1;
+      id = next_stream_id_;
+      next_stream_id_ += 2;
+      auto s = std::make_shared<Stream>();
+      s->events = std::move(events);
+      s->send_window = peer_initial_window_;
+      streams_[id] = std::move(s);
+      max_frame = peer_max_frame_;
+    }
+    size_t off = 0;
+    bool first = true;
+    do {
+      const size_t n = std::min(block.size() - off, max_frame);
+      uint8_t flags = 0;
+      if (off + n == block.size()) flags |= kFlagEndHeaders;
+      if (first && end_stream) flags |= kFlagEndStream;
+      ok = SendFrameLocked(first ? kFrameHeaders : kFrameContinuation, flags,
+                           id, block.data() + off, n);
+      first = false;
+      off += n;
+    } while (ok && off < block.size());
+  }
+  if (!ok) {
+    std::unique_lock<std::mutex> lk(mu_);
+    CloseStreamLocked(id, false, 0, "failed to send HEADERS", &lk);
+    return -1;
+  }
+  return static_cast<int32_t>(id);
+}
+
+bool Connection::SendData(int32_t stream_id, const void* data, size_t len,
+                          bool end_stream) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  size_t remaining = len;
+  do {
+    size_t chunk;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      auto it = streams_.find(static_cast<uint32_t>(stream_id));
+      // Wait for send window (both levels) or stream death.
+      window_cv_.wait(lk, [&] {
+        if (dead_.load()) return true;
+        it = streams_.find(static_cast<uint32_t>(stream_id));
+        if (it == streams_.end() || it->second->closed) return true;
+        return remaining == 0 ||
+               (conn_send_window_ > 0 && it->second->send_window > 0);
+      });
+      if (dead_.load()) return false;
+      it = streams_.find(static_cast<uint32_t>(stream_id));
+      if (it == streams_.end() || it->second->closed) return false;
+      chunk = remaining;
+      if (chunk > 0) {
+        chunk = std::min<size_t>(chunk, peer_max_frame_);
+        chunk = std::min<size_t>(
+            chunk, static_cast<size_t>(
+                       std::min(conn_send_window_, it->second->send_window)));
+        conn_send_window_ -= chunk;
+        it->second->send_window -= chunk;
+      }
+    }
+    const bool last = (remaining - chunk) == 0;
+    if (!SendFrame(kFrameData, (last && end_stream) ? kFlagEndStream : 0,
+                   static_cast<uint32_t>(stream_id), p, chunk)) {
+      return false;
+    }
+    p += chunk;
+    remaining -= chunk;
+  } while (remaining > 0);
+  return true;
+}
+
+void Connection::ResetStream(int32_t stream_id, uint32_t error_code) {
+  uint8_t payload[4];
+  PutU32(payload, error_code);
+  SendFrame(kFrameRstStream, 0, static_cast<uint32_t>(stream_id), payload, 4);
+  std::unique_lock<std::mutex> lk(mu_);
+  CloseStreamLocked(static_cast<uint32_t>(stream_id), false, error_code,
+                    "stream reset by client", &lk);
+}
+
+void Connection::Shutdown(const std::string& reason) {
+  bool was_dead = dead_.exchange(true);
+  if (!was_dead && fd_ >= 0) {
+    ::shutdown(fd_, SHUT_RDWR);
+  }
+  FailAllStreams(reason);
+}
+
+void Connection::FailAllStreams(const std::string& reason) {
+  std::unique_lock<std::mutex> lk(mu_);
+  // Move handlers out so callbacks run without the lock.
+  std::vector<std::shared_ptr<Stream>> doomed;
+  for (auto& kv : streams_) {
+    if (!kv.second->closed) {
+      kv.second->closed = true;
+      doomed.push_back(kv.second);
+    }
+  }
+  streams_.clear();
+  window_cv_.notify_all();
+  lk.unlock();
+  for (auto& s : doomed) {
+    if (s->events.on_close) s->events.on_close(false, 0, reason);
+  }
+}
+
+void Connection::CloseStreamLocked(uint32_t stream_id, bool ok,
+                                   uint32_t h2_error, const std::string& err,
+                                   std::unique_lock<std::mutex>* lk) {
+  auto it = streams_.find(stream_id);
+  if (it == streams_.end() || it->second->closed) return;
+  auto s = it->second;
+  s->closed = true;
+  streams_.erase(it);
+  window_cv_.notify_all();
+  lk->unlock();
+  if (s->events.on_close) s->events.on_close(ok, h2_error, err);
+  lk->lock();
+}
+
+void Connection::ReaderLoop() {
+  std::vector<uint8_t> buf;
+  uint8_t fh[9];
+  while (!dead_.load()) {
+    // Read one frame header.
+    size_t got = 0;
+    while (got < 9) {
+      ssize_t n = ::recv(fd_, fh + got, 9 - got, 0);
+      if (n <= 0) {
+        if (n < 0 && errno == EINTR) continue;
+        Shutdown(got == 0 ? "connection closed by peer"
+                          : "truncated frame header");
+        return;
+      }
+      got += static_cast<size_t>(n);
+    }
+    const uint32_t len = (uint32_t(fh[0]) << 16) | (uint32_t(fh[1]) << 8) |
+                         uint32_t(fh[2]);
+    const uint8_t type = fh[3];
+    const uint8_t flags = fh[4];
+    const uint32_t stream_id = GetU32(fh + 5) & 0x7fffffffu;
+    if (len > (1u << 24)) {
+      Shutdown("oversized frame");
+      return;
+    }
+    buf.resize(len);
+    got = 0;
+    while (got < len) {
+      ssize_t n = ::recv(fd_, buf.data() + got, len - got, 0);
+      if (n <= 0) {
+        if (n < 0 && errno == EINTR) continue;
+        Shutdown("truncated frame payload");
+        return;
+      }
+      got += static_cast<size_t>(n);
+    }
+    HandleFrame(type, flags, stream_id, buf.data(), len);
+  }
+}
+
+void Connection::DispatchHeaderBlock(uint32_t stream_id, bool end_stream) {
+  std::vector<hpack::Header> headers;
+  std::string err;
+  std::shared_ptr<Stream> s;
+  {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (!decoder_.Decode(
+            reinterpret_cast<const uint8_t*>(header_block_.data()),
+            header_block_.size(), &headers, &err)) {
+      lk.unlock();
+      Shutdown("HPACK error: " + err);
+      return;
+    }
+    auto it = streams_.find(stream_id);
+    if (it != streams_.end()) s = it->second;
+    if (s && end_stream) s->remote_done = true;
+  }
+  if (!s) return;  // stream already gone (reset) — tolerated
+  if (s->events.on_headers) s->events.on_headers(std::move(headers), end_stream);
+  if (end_stream) {
+    std::unique_lock<std::mutex> lk(mu_);
+    CloseStreamLocked(stream_id, true, 0, "", &lk);
+  }
+}
+
+void Connection::HandleFrame(uint8_t type, uint8_t flags, uint32_t stream_id,
+                             const uint8_t* payload, size_t len) {
+  if (in_header_block_ && type != kFrameContinuation) {
+    Shutdown("expected CONTINUATION");
+    return;
+  }
+  switch (type) {
+    case kFrameData: {
+      size_t off = 0, pad = 0;
+      if (flags & kFlagPadded) {
+        if (len < 1) return;
+        pad = payload[0];
+        off = 1;
+      }
+      if (off + pad > len) {
+        Shutdown("bad DATA padding");
+        return;
+      }
+      const size_t data_len = len - off - pad;
+      const bool end_stream = (flags & kFlagEndStream) != 0;
+      std::shared_ptr<Stream> s;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        auto it = streams_.find(stream_id);
+        if (it != streams_.end()) s = it->second;
+        // Flow-control accounting uses the whole frame length.
+        conn_recv_consumed_ += static_cast<int64_t>(len);
+        if (s) {
+          s->recv_consumed += static_cast<int64_t>(len);
+          if (end_stream) s->remote_done = true;
+        }
+      }
+      if (s && s->events.on_data && data_len > 0) {
+        s->events.on_data(payload + off, data_len, end_stream);
+      } else if (s && s->events.on_data && end_stream) {
+        s->events.on_data(payload + off, 0, true);
+      }
+      // Replenish windows.
+      bool send_conn_update = false;
+      int64_t conn_delta = 0;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        if (conn_recv_consumed_ >= kRecvUpdateThreshold) {
+          conn_delta = conn_recv_consumed_;
+          conn_recv_consumed_ = 0;
+          send_conn_update = true;
+        }
+      }
+      if (send_conn_update) {
+        uint8_t wu[4];
+        PutU32(wu, static_cast<uint32_t>(conn_delta));
+        SendFrame(kFrameWindowUpdate, 0, 0, wu, 4);
+      }
+      if (s && !end_stream) {
+        int64_t stream_delta = 0;
+        {
+          std::unique_lock<std::mutex> lk(mu_);
+          if (s->recv_consumed >= kRecvUpdateThreshold) {
+            stream_delta = s->recv_consumed;
+            s->recv_consumed = 0;
+          }
+        }
+        if (stream_delta > 0) {
+          uint8_t wu[4];
+          PutU32(wu, static_cast<uint32_t>(stream_delta));
+          SendFrame(kFrameWindowUpdate, 0, stream_id, wu, 4);
+        }
+      }
+      if (end_stream) {
+        std::unique_lock<std::mutex> lk(mu_);
+        CloseStreamLocked(stream_id, true, 0, "", &lk);
+      }
+      break;
+    }
+    case kFrameHeaders: {
+      size_t off = 0, pad = 0;
+      if (flags & kFlagPadded) {
+        if (len < 1) return;
+        pad = payload[0];
+        off = 1;
+      }
+      if (flags & kFlagPriority) off += 5;
+      if (off + pad > len) {
+        Shutdown("bad HEADERS padding");
+        return;
+      }
+      header_block_.assign(reinterpret_cast<const char*>(payload + off),
+                           len - off - pad);
+      header_block_stream_ = stream_id;
+      header_block_end_stream_ = (flags & kFlagEndStream) != 0;
+      if (flags & kFlagEndHeaders) {
+        in_header_block_ = false;
+        DispatchHeaderBlock(stream_id, header_block_end_stream_);
+      } else {
+        in_header_block_ = true;
+      }
+      break;
+    }
+    case kFrameContinuation: {
+      if (!in_header_block_ || stream_id != header_block_stream_) {
+        Shutdown("unexpected CONTINUATION");
+        return;
+      }
+      header_block_.append(reinterpret_cast<const char*>(payload), len);
+      if (flags & kFlagEndHeaders) {
+        in_header_block_ = false;
+        DispatchHeaderBlock(stream_id, header_block_end_stream_);
+      }
+      break;
+    }
+    case kFrameRstStream: {
+      if (len < 4) return;
+      const uint32_t code = GetU32(payload);
+      std::unique_lock<std::mutex> lk(mu_);
+      CloseStreamLocked(stream_id, false, code,
+                        "stream reset by server (code " +
+                            std::to_string(code) + ")",
+                        &lk);
+      break;
+    }
+    case kFrameSettings: {
+      if (flags & kFlagAck) break;
+      std::unique_lock<std::mutex> lk(mu_);
+      for (size_t off = 0; off + 6 <= len; off += 6) {
+        const uint16_t id = (uint16_t(payload[off]) << 8) | payload[off + 1];
+        const uint32_t value = GetU32(payload + off + 2);
+        if (id == kSettingsInitialWindowSize) {
+          const int64_t delta =
+              static_cast<int64_t>(value) - peer_initial_window_;
+          peer_initial_window_ = value;
+          for (auto& kv : streams_) kv.second->send_window += delta;
+          window_cv_.notify_all();
+        } else if (id == kSettingsMaxFrameSize) {
+          if (value >= 16384 && value <= (1u << 24) - 1) {
+            peer_max_frame_ = value;
+          }
+        } else if (id == kSettingsHeaderTableSize ||
+                   id == kSettingsMaxConcurrentStreams) {
+          // Encoder never uses the dynamic table; concurrency is managed by
+          // the gRPC layer. Acknowledged below either way.
+        }
+      }
+      lk.unlock();
+      SendFrame(kFrameSettings, kFlagAck, 0, nullptr, 0);
+      break;
+    }
+    case kFramePing: {
+      if (!(flags & kFlagAck) && len == 8) {
+        SendFrame(kFramePing, kFlagAck, 0, payload, 8);
+      }
+      break;
+    }
+    case kFrameGoaway: {
+      std::string reason = "GOAWAY from server";
+      if (len >= 8) {
+        reason += " (error " + std::to_string(GetU32(payload + 4)) + ")";
+      }
+      Shutdown(reason);
+      break;
+    }
+    case kFrameWindowUpdate: {
+      if (len < 4) return;
+      const uint32_t inc = GetU32(payload) & 0x7fffffffu;
+      std::unique_lock<std::mutex> lk(mu_);
+      if (stream_id == 0) {
+        conn_send_window_ += inc;
+      } else {
+        auto it = streams_.find(stream_id);
+        if (it != streams_.end()) it->second->send_window += inc;
+      }
+      window_cv_.notify_all();
+      break;
+    }
+    case kFramePriority:
+    case kFramePushPromise:
+    default:
+      break;  // ignored (PUSH is disabled for clients by default semantics)
+  }
+}
+
+}  // namespace h2
+}  // namespace ctpu

@@ -1,0 +1,120 @@
+// Minimal HTTP/2 (RFC 7540) client connection for gRPC-over-h2c.
+//
+// The reference's C++ gRPC client rides grpc++'s transport
+// (reference: src/c++/library/grpc_client.cc); this framework implements the
+// small client-side slice of HTTP/2 that gRPC needs — multiplexed streams,
+// HPACK header blocks, flow control, PING/GOAWAY — directly over a TCP
+// socket, with no external dependencies. Cleartext (h2c prior-knowledge)
+// only; TLS deployments should front with a local proxy or use the Python
+// client (grpcio) which carries TLS.
+//
+// Threading model: one reader thread per connection parses frames and fires
+// per-stream callbacks (without holding the connection lock); writers are
+// serialized by a write mutex. All public methods are thread-safe.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "hpack.h"
+
+namespace ctpu {
+namespace h2 {
+
+struct StreamEvents {
+  // Fired for each HEADERS block (initial response headers, then trailers).
+  std::function<void(std::vector<hpack::Header> headers, bool end_stream)>
+      on_headers;
+  // Fired per DATA frame payload.
+  std::function<void(const uint8_t* data, size_t len, bool end_stream)>
+      on_data;
+  // Fired exactly once when the stream is done. ok=false means transport or
+  // RST failure (message in err).
+  std::function<void(bool ok, uint32_t h2_error, const std::string& err)>
+      on_close;
+};
+
+class Connection {
+ public:
+  // Establishes TCP + HTTP/2 preface. Returns nullptr and sets *err on
+  // failure.
+  static std::unique_ptr<Connection> Connect(const std::string& host, int port,
+                                             std::string* err);
+  ~Connection();
+
+  // Opens a stream by sending a HEADERS frame. Returns the stream id, or -1
+  // if the connection is dead. Events fire on the reader thread.
+  int32_t StartStream(const std::vector<hpack::Header>& headers,
+                      bool end_stream, StreamEvents events);
+
+  // Sends DATA on an open stream, chunked to the peer's max frame size and
+  // blocking on send flow control. Returns false if the stream/connection
+  // died first.
+  bool SendData(int32_t stream_id, const void* data, size_t len,
+                bool end_stream);
+
+  void ResetStream(int32_t stream_id, uint32_t error_code);
+
+  bool alive() const { return !dead_.load(); }
+  // Closes the socket and fails all open streams.
+  void Shutdown(const std::string& reason);
+
+ private:
+  Connection() = default;
+  struct Stream {
+    StreamEvents events;
+    int64_t send_window = 65535;
+    int64_t recv_consumed = 0;
+    bool closed = false;        // on_close already fired
+    bool remote_done = false;   // END_STREAM seen
+  };
+
+  void ReaderLoop();
+  bool WriteAll(const void* data, size_t len);
+  bool SendFrame(uint8_t type, uint8_t flags, uint32_t stream_id,
+                 const void* payload, size_t len);
+  // Same, but assumes write_mu_ is already held (used to keep a
+  // HEADERS+CONTINUATION block contiguous and stream-id order monotonic).
+  bool SendFrameLocked(uint8_t type, uint8_t flags, uint32_t stream_id,
+                       const void* payload, size_t len);
+  void HandleFrame(uint8_t type, uint8_t flags, uint32_t stream_id,
+                   const uint8_t* payload, size_t len);
+  void DispatchHeaderBlock(uint32_t stream_id, bool end_stream);
+  void CloseStreamLocked(uint32_t stream_id, bool ok, uint32_t h2_error,
+                         const std::string& err,
+                         std::unique_lock<std::mutex>* lk);
+  void FailAllStreams(const std::string& reason);
+
+  int fd_ = -1;
+  std::thread reader_;
+  std::atomic<bool> dead_{false};
+
+  std::mutex mu_;  // guards streams_, windows, hpack decoder, settings
+  std::condition_variable window_cv_;
+  std::map<uint32_t, std::shared_ptr<Stream>> streams_;
+  uint32_t next_stream_id_ = 1;
+  int64_t conn_send_window_ = 65535;
+  int64_t conn_recv_consumed_ = 0;
+  uint32_t peer_max_frame_ = 16384;
+  uint32_t peer_initial_window_ = 65535;
+  hpack::Decoder decoder_;
+
+  // CONTINUATION reassembly state.
+  std::string header_block_;
+  uint32_t header_block_stream_ = 0;
+  bool header_block_end_stream_ = false;
+  bool in_header_block_ = false;
+
+  std::mutex write_mu_;  // serializes socket writes
+};
+
+}  // namespace h2
+}  // namespace ctpu

@@ -60,40 +60,11 @@ void FlattenJsonData(const json::Value& v, const std::string& dtype,
 
 Error HttpConnection::Connect(int64_t timeout_us) {
   Close();
-  struct addrinfo hints;
-  std::memset(&hints, 0, sizeof(hints));
-  hints.ai_family = AF_UNSPEC;
-  hints.ai_socktype = SOCK_STREAM;
-  struct addrinfo* res = nullptr;
-  std::string port_str = std::to_string(port_);
-  int rc = getaddrinfo(host_.c_str(), port_str.c_str(), &hints, &res);
-  if (rc != 0) {
-    return Error("failed to resolve " + host_ + ": " + gai_strerror(rc));
-  }
-  Error err("failed to connect");
-  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
-    int fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
-    if (fd < 0) continue;
-    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
-      int one = 1;
-      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      if (timeout_us > 0) {
-        struct timeval tv;
-        tv.tv_sec = timeout_us / 1000000;
-        tv.tv_usec = timeout_us % 1000000;
-        setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
-        setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
-      }
-      fd_ = fd;
-      err = Error::Success();
-      break;
-    }
-    err = MakeSocketError("connect");
-    close(fd);
-  }
-  freeaddrinfo(res);
+  std::string err;
+  fd_ = DialTcp(host_, port_, timeout_us, &err);
   buf_.clear();
-  return err;
+  if (fd_ < 0) return Error(err);
+  return Error::Success();
 }
 
 void HttpConnection::Close() {

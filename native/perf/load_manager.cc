@@ -201,6 +201,10 @@ void RequestRateManager::Stop() {
 // ---------------------------------------------------------------------------
 
 Error PeriodicConcurrencyManager::Run() {
+  // Guard degenerate ranges: concurrency 0 issues nothing (the record-count
+  // wait below would spin forever) and step 0 never advances the ramp.
+  start_ = std::max<size_t>(1, start_);
+  step_ = std::max<size_t>(1, step_);
   ChangeConcurrency(start_);
   size_t current = start_;
   while (true) {

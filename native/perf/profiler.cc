@@ -214,8 +214,9 @@ Error InferenceProfiler::ProfileConcurrencyRange(ConcurrencyManager* manager,
 Error InferenceProfiler::ProfileRequestRateRange(RequestRateManager* manager,
                                                  double start, double end,
                                                  double step) {
+  // An explicit 0 step would make the sweep effectively infinite.
   for (double rate = start; rate <= end + 1e-9;
-       rate += std::max(1e-9, step)) {
+       rate += std::max(1.0, step)) {
     if (config_.early_exit != nullptr && config_.early_exit->load()) break;
     manager->ChangeRate(rate);
     PerfStatus status;

@@ -48,6 +48,17 @@ struct Registrar {
     }                                                                 \
   } while (0)
 
+// Like CHECK but aborts the current test case on failure (for preconditions
+// later assertions depend on, e.g. container sizes before indexing).
+#define REQUIRE(cond)                                                 \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      std::printf("    FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      ::ctest::Failures()++;                                          \
+      return;                                                         \
+    }                                                                 \
+  } while (0)
+
 #define CHECK_EQ(a, b) CHECK((a) == (b))
 #define CHECK_NEAR(a, b, eps) CHECK(std::fabs((double)(a) - (double)(b)) <= (eps))
 #define CHECK_OK(expr)                                                      \

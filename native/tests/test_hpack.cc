@@ -1,0 +1,179 @@
+// HPACK unit tests: RFC 7541 Appendix C vectors + Huffman round-trips.
+#include <random>
+#include <string>
+#include <vector>
+
+#include "hpack.h"
+#include "hpack_tables.h"
+#include "test_framework.h"
+
+namespace {
+
+using ctpu::hpack::Decoder;
+using ctpu::hpack::Encode;
+using ctpu::hpack::Header;
+using ctpu::hpack::HuffmanDecode;
+
+std::vector<uint8_t> FromHex(const std::string& hex) {
+  std::vector<uint8_t> out;
+  std::string digits;
+  for (char c : hex) {
+    if (!isspace(static_cast<unsigned char>(c))) digits.push_back(c);
+  }
+  for (size_t i = 0; i + 1 < digits.size(); i += 2) {
+    out.push_back(
+        static_cast<uint8_t>(std::stoi(digits.substr(i, 2), nullptr, 16)));
+  }
+  return out;
+}
+
+// Reference Huffman *encoder* (test-only) straight from the RFC table, used
+// to exercise the production decoder with arbitrary strings.
+std::string HuffmanEncodeForTest(const std::string& s) {
+  std::string out;
+  uint64_t acc = 0;
+  int nbits = 0;
+  for (unsigned char c : s) {
+    acc = (acc << ctpu::hpack::kHuffmanLengths[c]) | ctpu::hpack::kHuffmanCodes[c];
+    nbits += ctpu::hpack::kHuffmanLengths[c];
+    while (nbits >= 8) {
+      nbits -= 8;
+      out.push_back(static_cast<char>((acc >> nbits) & 0xff));
+    }
+  }
+  if (nbits > 0) {  // pad with EOS prefix (all 1s)
+    acc = (acc << (8 - nbits)) | ((1u << (8 - nbits)) - 1);
+    out.push_back(static_cast<char>(acc & 0xff));
+  }
+  return out;
+}
+
+TEST_CASE("hpack: RFC C.2.1 literal with incremental indexing") {
+  auto bytes = FromHex(
+      "400a 6375 7374 6f6d 2d6b 6579 0d63 7573 746f 6d2d 6865 6164 6572");
+  Decoder dec;
+  std::vector<Header> out;
+  std::string err;
+  CHECK(dec.Decode(bytes.data(), bytes.size(), &out, &err));
+  REQUIRE(out.size() == 1u);
+  CHECK(out[0].name == "custom-key");
+  CHECK(out[0].value == "custom-header");
+}
+
+TEST_CASE("hpack: RFC C.2.2 literal without indexing, name index") {
+  auto bytes = FromHex("040c 2f73 616d 706c 652f 7061 7468");
+  Decoder dec;
+  std::vector<Header> out;
+  std::string err;
+  CHECK(dec.Decode(bytes.data(), bytes.size(), &out, &err));
+  REQUIRE(out.size() == 1u);
+  CHECK(out[0].name == ":path");
+  CHECK(out[0].value == "/sample/path");
+}
+
+TEST_CASE("hpack: RFC C.4 Huffman request sequence w/ dynamic table") {
+  Decoder dec;
+  std::string err;
+  // C.4.1
+  auto r1 = FromHex("8286 8441 8cf1 e3c2 e5f2 3a6b a0ab 90f4 ff");
+  std::vector<Header> out;
+  CHECK(dec.Decode(r1.data(), r1.size(), &out, &err));
+  REQUIRE(out.size() == 4u);
+  CHECK(out[0].name == ":method");
+  CHECK(out[0].value == "GET");
+  CHECK(out[1].name == ":scheme");
+  CHECK(out[1].value == "http");
+  CHECK(out[2].name == ":path");
+  CHECK(out[2].value == "/");
+  CHECK(out[3].name == ":authority");
+  CHECK(out[3].value == "www.example.com");
+  // C.4.2 — reuses dynamic entry (index 62) inserted by C.4.1.
+  auto r2 = FromHex("8286 84be 5886 a8eb 1064 9cbf");
+  out.clear();
+  CHECK(dec.Decode(r2.data(), r2.size(), &out, &err));
+  REQUIRE(out.size() == 5u);
+  CHECK(out[3].name == ":authority");
+  CHECK(out[3].value == "www.example.com");
+  CHECK(out[4].name == "cache-control");
+  CHECK(out[4].value == "no-cache");
+  // C.4.3
+  auto r3 = FromHex(
+      "8287 85bf 4088 25a8 49e9 5ba9 7d7f 8925 a849 e95b b8e8 b4bf");
+  out.clear();
+  CHECK(dec.Decode(r3.data(), r3.size(), &out, &err));
+  REQUIRE(out.size() == 5u);
+  CHECK(out[1].value == "https");
+  CHECK(out[2].value == "/index.html");
+  CHECK(out[4].name == "custom-key");
+  CHECK(out[4].value == "custom-value");
+}
+
+TEST_CASE("hpack: Huffman round-trip, printable + binary strings") {
+  std::mt19937 rng(1234);
+  for (int trial = 0; trial < 200; ++trial) {
+    std::string s;
+    const int len = static_cast<int>(rng() % 64);
+    for (int i = 0; i < len; ++i) {
+      s.push_back(static_cast<char>(
+          trial % 2 ? rng() % 256 : 32 + rng() % 95));
+    }
+    std::string enc = HuffmanEncodeForTest(s);
+    std::string dec;
+    CHECK(HuffmanDecode(reinterpret_cast<const uint8_t*>(enc.data()),
+                        enc.size(), &dec));
+    CHECK(dec == s);
+  }
+}
+
+TEST_CASE("hpack: Huffman rejects EOS and bad padding") {
+  // A full EOS code (30 bits of 1s) inside the stream must fail.
+  std::string eos = "\xff\xff\xff\xff";
+  std::string out;
+  CHECK(!HuffmanDecode(reinterpret_cast<const uint8_t*>(eos.data()), 4, &out));
+  // '0' encodes (5 bits); padding with 0-bits is invalid.
+  out.clear();
+  std::string bad_pad;
+  bad_pad.push_back(0x00);  // '0' is code 0x0 len 5 → byte 0000 0|000 pad=000
+  CHECK(!HuffmanDecode(reinterpret_cast<const uint8_t*>(bad_pad.data()), 1,
+                       &out));
+}
+
+TEST_CASE("hpack: encoder output decodes to the same headers") {
+  std::vector<Header> in = {
+      {":method", "POST"},
+      {":scheme", "http"},
+      {":path", "/inference.GRPCInferenceService/ModelInfer"},
+      {":authority", "localhost:8001"},
+      {"content-type", "application/grpc"},
+      {"te", "trailers"},
+      {"grpc-timeout", "5S"},
+      {"x-custom", "value with spaces"},
+  };
+  std::string block;
+  Encode(in, &block);
+  Decoder dec;
+  std::vector<Header> out;
+  std::string err;
+  CHECK(dec.Decode(reinterpret_cast<const uint8_t*>(block.data()),
+                   block.size(), &out, &err));
+  REQUIRE(out.size() == in.size());
+  for (size_t i = 0; i < in.size(); ++i) {
+    CHECK(out[i].name == in[i].name);
+    CHECK(out[i].value == in[i].value);
+  }
+}
+
+TEST_CASE("hpack: large integer + dynamic table size update") {
+  // 0x3f 0x9a 0x0a = size update to 1337 (RFC C.1.2 integer coding) — but
+  // decoder caps at SETTINGS value 4096, so 1337 is accepted.
+  auto bytes = FromHex("3f9a 0a40 0a63 7573 746f 6d2d 6b65 790d 6375 7374"
+                       "6f6d 2d68 6561 6465 72");
+  Decoder dec;
+  std::vector<Header> out;
+  std::string err;
+  CHECK(dec.Decode(bytes.data(), bytes.size(), &out, &err));
+  REQUIRE(out.size() == 1u);
+  CHECK(out[0].name == "custom-key");
+}
+
+}  // namespace
