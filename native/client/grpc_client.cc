@@ -1,0 +1,996 @@
+#include "grpc_client.h"
+
+#include <cstring>
+#include <sstream>
+
+namespace ctpu {
+
+namespace {
+
+constexpr char kServicePrefix[] = "/inference.GRPCInferenceService/";
+
+// gRPC percent-decodes grpc-message (RFC 3986-style, applied by servers to
+// non-ASCII/whitespace). Decode best-effort.
+std::string PercentDecode(const std::string& in) {
+  std::string out;
+  out.reserve(in.size());
+  for (size_t i = 0; i < in.size(); ++i) {
+    if (in[i] == '%' && i + 2 < in.size() && isxdigit(in[i + 1]) &&
+        isxdigit(in[i + 2])) {
+      out.push_back(static_cast<char>(
+          std::stoi(in.substr(i + 1, 2), nullptr, 16)));
+      i += 2;
+    } else {
+      out.push_back(in[i]);
+    }
+  }
+  return out;
+}
+
+// Incrementally splits a byte stream into gRPC length-prefixed messages
+// (5-byte header: 1 compressed flag + 4 big-endian length).
+class GrpcFramer {
+ public:
+  void Append(const uint8_t* data, size_t len) {
+    buf_.append(reinterpret_cast<const char*>(data), len);
+  }
+  // Returns true if a complete message was extracted into *msg.
+  bool Next(std::string* msg, bool* compressed) {
+    if (buf_.size() < 5) return false;
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(buf_.data());
+    const uint32_t len = (uint32_t(p[1]) << 24) | (uint32_t(p[2]) << 16) |
+                         (uint32_t(p[3]) << 8) | uint32_t(p[4]);
+    if (buf_.size() < 5u + len) return false;
+    *compressed = p[0] != 0;
+    msg->assign(buf_, 5, len);
+    buf_.erase(0, 5u + len);
+    return true;
+  }
+  size_t Pending() const { return buf_.size(); }
+
+ private:
+  std::string buf_;
+};
+
+std::string FrameMessage(const google::protobuf::Message& msg) {
+  std::string body;
+  body.resize(5);
+  msg.AppendToString(&body);
+  const uint32_t len = static_cast<uint32_t>(body.size() - 5);
+  body[0] = 0;  // uncompressed
+  body[1] = static_cast<char>((len >> 24) & 0xff);
+  body[2] = static_cast<char>((len >> 16) & 0xff);
+  body[3] = static_cast<char>((len >> 8) & 0xff);
+  body[4] = static_cast<char>(len & 0xff);
+  return body;
+}
+
+// Formats a grpc-timeout header value. The gRPC spec caps the value at
+// 8 ASCII digits, so coarsen the unit until it fits.
+std::string GrpcTimeoutValue(uint64_t timeout_us) {
+  uint64_t v = timeout_us;
+  const char* unit = "u";
+  if (v > 99999999) {
+    v = timeout_us / 1000;
+    unit = "m";
+  }
+  if (v > 99999999) {
+    v = timeout_us / 1000000;
+    unit = "S";
+  }
+  if (v > 99999999) {
+    v = timeout_us / 60000000;
+    unit = "M";
+  }
+  return std::to_string(v) + unit;
+}
+
+struct UnaryCallState {
+  std::mutex mu;
+  std::condition_variable cv;
+  bool done = false;
+  bool transport_ok = false;
+  std::string transport_err;
+  int http_status = 0;
+  int grpc_status = -1;
+  std::string grpc_message;
+  GrpcFramer framer;
+};
+
+void ScanGrpcTrailers(const std::vector<hpack::Header>& headers,
+                      UnaryCallState* st) {
+  for (const auto& h : headers) {
+    if (h.name == ":status") {
+      st->http_status = atoi(h.value.c_str());
+    } else if (h.name == "grpc-status") {
+      st->grpc_status = atoi(h.value.c_str());
+    } else if (h.name == "grpc-message") {
+      st->grpc_message = PercentDecode(h.value);
+    }
+  }
+}
+
+Error SetParameterFromJson(const std::string& key, const std::string& raw,
+                           inference::InferParameter* param) {
+  // options.parameters carries raw JSON fragments (see common.h); map them
+  // onto the InferParameter oneof.
+  if (raw == "true" || raw == "false") {
+    param->set_bool_param(raw == "true");
+    return Error::Success();
+  }
+  if (!raw.empty() && raw.front() == '"' && raw.back() == '"') {
+    param->set_string_param(raw.substr(1, raw.size() - 2));
+    return Error::Success();
+  }
+  if (raw.find('.') != std::string::npos ||
+      raw.find('e') != std::string::npos) {
+    try {
+      param->set_double_param(std::stod(raw));
+      return Error::Success();
+    } catch (...) {
+    }
+  }
+  try {
+    param->set_int64_param(std::stoll(raw));
+    return Error::Success();
+  } catch (...) {
+  }
+  return Error("cannot convert parameter '" + key + "' value " + raw);
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// InferResultGrpc
+// ---------------------------------------------------------------------------
+
+InferResultGrpc::InferResultGrpc(
+    std::shared_ptr<inference::ModelInferResponse> response,
+    Error request_status)
+    : response_(std::move(response)),
+      request_status_(std::move(request_status)) {}
+
+void InferResultGrpc::Create(
+    InferResult** result,
+    std::shared_ptr<inference::ModelInferResponse> response,
+    Error request_status) {
+  *result = new InferResultGrpc(std::move(response), std::move(request_status));
+}
+
+Error InferResultGrpc::ModelName(std::string* name) const {
+  *name = response_->model_name();
+  return Error::Success();
+}
+
+Error InferResultGrpc::ModelVersion(std::string* version) const {
+  *version = response_->model_version();
+  return Error::Success();
+}
+
+Error InferResultGrpc::Id(std::string* id) const {
+  *id = response_->id();
+  return Error::Success();
+}
+
+Error InferResultGrpc::Output(
+    const std::string& name,
+    const inference::ModelInferResponse::InferOutputTensor** t,
+    int* index) const {
+  for (int i = 0; i < response_->outputs_size(); ++i) {
+    if (response_->outputs(i).name() == name) {
+      *t = &response_->outputs(i);
+      *index = i;
+      return Error::Success();
+    }
+  }
+  return Error("output '" + name + "' not found in result");
+}
+
+Error InferResultGrpc::Shape(const std::string& output_name,
+                             std::vector<int64_t>* shape) const {
+  const inference::ModelInferResponse::InferOutputTensor* t;
+  int index;
+  CTPU_RETURN_IF_ERROR(Output(output_name, &t, &index));
+  shape->assign(t->shape().begin(), t->shape().end());
+  return Error::Success();
+}
+
+Error InferResultGrpc::Datatype(const std::string& output_name,
+                                std::string* datatype) const {
+  const inference::ModelInferResponse::InferOutputTensor* t;
+  int index;
+  CTPU_RETURN_IF_ERROR(Output(output_name, &t, &index));
+  *datatype = t->datatype();
+  return Error::Success();
+}
+
+Error InferResultGrpc::RawData(const std::string& output_name,
+                               const uint8_t** buf, size_t* byte_size) const {
+  const inference::ModelInferResponse::InferOutputTensor* t;
+  int index;
+  CTPU_RETURN_IF_ERROR(Output(output_name, &t, &index));
+  if (index >= response_->raw_output_contents_size()) {
+    // Shared-memory output: bytes live in the registered region.
+    *buf = nullptr;
+    *byte_size = 0;
+    return Error::Success();
+  }
+  const std::string& raw = response_->raw_output_contents(index);
+  *buf = reinterpret_cast<const uint8_t*>(raw.data());
+  *byte_size = raw.size();
+  return Error::Success();
+}
+
+std::string InferResultGrpc::DebugString() const {
+  return response_->ShortDebugString();
+}
+
+// ---------------------------------------------------------------------------
+// InferenceServerGrpcClient
+// ---------------------------------------------------------------------------
+
+// Per-stream state shared with the h2 reader thread.
+struct StreamState {
+  std::mutex mu;
+  std::condition_variable cv;
+  bool closed = false;
+  std::string close_err;
+  GrpcFramer framer;
+  int grpc_status = -1;
+  std::string grpc_message;
+};
+
+Error InferenceServerGrpcClient::Create(
+    std::unique_ptr<InferenceServerGrpcClient>* client, const std::string& url,
+    bool verbose) {
+  std::string rest = url;
+  const size_t scheme = rest.find("://");
+  if (scheme != std::string::npos) rest = rest.substr(scheme + 3);
+  const size_t colon = rest.rfind(':');
+  if (colon == std::string::npos) {
+    return Error("expected <host>:<port> gRPC url, got " + url);
+  }
+  const std::string host = rest.substr(0, colon);
+  const int port = atoi(rest.c_str() + colon + 1);
+  client->reset(new InferenceServerGrpcClient(host, port, verbose));
+  return Error::Success();
+}
+
+InferenceServerGrpcClient::InferenceServerGrpcClient(std::string host,
+                                                     int port, bool verbose)
+    : InferenceServerClient(verbose), host_(std::move(host)), port_(port) {}
+
+InferenceServerGrpcClient::~InferenceServerGrpcClient() {
+  StopStream();
+}
+
+std::shared_ptr<h2::Connection> InferenceServerGrpcClient::Conn() {
+  std::lock_guard<std::mutex> lk(conn_mu_);
+  return conn_;
+}
+
+Error InferenceServerGrpcClient::EnsureConnection() {
+  std::lock_guard<std::mutex> lk(conn_mu_);
+  if (conn_ && conn_->alive()) return Error::Success();
+  std::string err;
+  conn_ = std::shared_ptr<h2::Connection>(
+      h2::Connection::Connect(host_, port_, &err).release());
+  if (!conn_) return Error("gRPC connect failed: " + err);
+  return Error::Success();
+}
+
+std::vector<hpack::Header> InferenceServerGrpcClient::BuildHeaders(
+    const std::string& method, const Headers& user_headers,
+    uint64_t timeout_us) {
+  std::vector<hpack::Header> headers = {
+      {":method", "POST"},
+      {":scheme", "http"},
+      {":path", kServicePrefix + method},
+      {":authority", host_ + ":" + std::to_string(port_)},
+      {"content-type", "application/grpc"},
+      {"te", "trailers"},
+      {"user-agent", "ctpu-grpc/1.0"},
+  };
+  if (timeout_us > 0) {
+    headers.push_back({"grpc-timeout", GrpcTimeoutValue(timeout_us)});
+  }
+  for (const auto& kv : user_headers) {
+    headers.push_back({kv.first, kv.second});
+  }
+  return headers;
+}
+
+Error InferenceServerGrpcClient::Call(const std::string& method,
+                                      const google::protobuf::Message& req,
+                                      google::protobuf::Message* resp,
+                                      const Headers& headers,
+                                      uint64_t timeout_us) {
+  CTPU_RETURN_IF_ERROR(EnsureConnection());
+  auto st = std::make_shared<UnaryCallState>();
+  h2::StreamEvents ev;
+  ev.on_headers = [st](std::vector<hpack::Header> hs, bool) {
+    std::lock_guard<std::mutex> lk(st->mu);
+    ScanGrpcTrailers(hs, st.get());
+  };
+  ev.on_data = [st](const uint8_t* d, size_t n, bool) {
+    std::lock_guard<std::mutex> lk(st->mu);
+    st->framer.Append(d, n);
+  };
+  ev.on_close = [st](bool ok, uint32_t, const std::string& err) {
+    std::lock_guard<std::mutex> lk(st->mu);
+    st->done = true;
+    st->transport_ok = ok;
+    st->transport_err = err;
+    st->cv.notify_all();
+  };
+
+  std::shared_ptr<h2::Connection> conn = Conn();
+  const int32_t sid =
+      conn->StartStream(BuildHeaders(method, headers, timeout_us), false, ev);
+  if (sid < 0) return Error("gRPC stream open failed (connection lost)");
+  const std::string body = FrameMessage(req);
+  if (!conn->SendData(sid, body.data(), body.size(), true)) {
+    return Error("gRPC request send failed (connection lost)");
+  }
+
+  std::unique_lock<std::mutex> lk(st->mu);
+  if (timeout_us > 0) {
+    if (!st->cv.wait_for(lk, std::chrono::microseconds(timeout_us),
+                         [&] { return st->done; })) {
+      lk.unlock();
+      conn->ResetStream(sid, 0x8 /* CANCEL */);
+      return Error("gRPC call '" + method + "' timed out");
+    }
+  } else {
+    st->cv.wait(lk, [&] { return st->done; });
+  }
+  if (!st->transport_ok) {
+    return Error("gRPC transport error: " + st->transport_err);
+  }
+  if (st->grpc_status != 0) {
+    if (st->grpc_status < 0) {
+      return Error("gRPC response missing grpc-status (HTTP " +
+                   std::to_string(st->http_status) + ")");
+    }
+    return Error("[gRPC status " + std::to_string(st->grpc_status) + "] " +
+                 st->grpc_message);
+  }
+  std::string msg;
+  bool compressed = false;
+  if (!st->framer.Next(&msg, &compressed)) {
+    return Error("gRPC response missing message body");
+  }
+  if (compressed) {
+    return Error("gRPC response unexpectedly compressed");
+  }
+  if (!resp->ParseFromString(msg)) {
+    return Error("failed to parse " + method + " response proto");
+  }
+  return Error::Success();
+}
+
+// --- health / metadata ---
+
+Error InferenceServerGrpcClient::IsServerLive(bool* live,
+                                              const Headers& headers) {
+  inference::ServerLiveRequest req;
+  inference::ServerLiveResponse resp;
+  CTPU_RETURN_IF_ERROR(Call("ServerLive", req, &resp, headers));
+  *live = resp.live();
+  return Error::Success();
+}
+
+Error InferenceServerGrpcClient::IsServerReady(bool* ready,
+                                               const Headers& headers) {
+  inference::ServerReadyRequest req;
+  inference::ServerReadyResponse resp;
+  CTPU_RETURN_IF_ERROR(Call("ServerReady", req, &resp, headers));
+  *ready = resp.ready();
+  return Error::Success();
+}
+
+Error InferenceServerGrpcClient::IsModelReady(bool* ready,
+                                              const std::string& model_name,
+                                              const std::string& model_version,
+                                              const Headers& headers) {
+  inference::ModelReadyRequest req;
+  req.set_name(model_name);
+  req.set_version(model_version);
+  inference::ModelReadyResponse resp;
+  CTPU_RETURN_IF_ERROR(Call("ModelReady", req, &resp, headers));
+  *ready = resp.ready();
+  return Error::Success();
+}
+
+Error InferenceServerGrpcClient::ServerMetadata(
+    inference::ServerMetadataResponse* metadata, const Headers& headers) {
+  inference::ServerMetadataRequest req;
+  return Call("ServerMetadata", req, metadata, headers);
+}
+
+Error InferenceServerGrpcClient::ModelMetadata(
+    inference::ModelMetadataResponse* metadata, const std::string& model_name,
+    const std::string& model_version, const Headers& headers) {
+  inference::ModelMetadataRequest req;
+  req.set_name(model_name);
+  req.set_version(model_version);
+  return Call("ModelMetadata", req, metadata, headers);
+}
+
+Error InferenceServerGrpcClient::ModelConfig(
+    inference::ModelConfigResponse* config, const std::string& model_name,
+    const std::string& model_version, const Headers& headers) {
+  inference::ModelConfigRequest req;
+  req.set_name(model_name);
+  req.set_version(model_version);
+  return Call("ModelConfig", req, config, headers);
+}
+
+// --- model control + repository ---
+
+Error InferenceServerGrpcClient::ModelRepositoryIndex(
+    inference::RepositoryIndexResponse* index, const Headers& headers) {
+  inference::RepositoryIndexRequest req;
+  return Call("RepositoryIndex", req, index, headers);
+}
+
+Error InferenceServerGrpcClient::LoadModel(
+    const std::string& model_name, const Headers& headers,
+    const std::string& config,
+    const std::map<std::string, std::vector<char>>& files) {
+  inference::RepositoryModelLoadRequest req;
+  req.set_model_name(model_name);
+  if (!config.empty()) {
+    (*req.mutable_parameters())["config"].set_string_param(config);
+  }
+  for (const auto& kv : files) {
+    (*req.mutable_parameters())[kv.first].set_bytes_param(
+        std::string(kv.second.data(), kv.second.size()));
+  }
+  inference::RepositoryModelLoadResponse resp;
+  return Call("RepositoryModelLoad", req, &resp, headers);
+}
+
+Error InferenceServerGrpcClient::UnloadModel(const std::string& model_name,
+                                             const Headers& headers) {
+  inference::RepositoryModelUnloadRequest req;
+  req.set_model_name(model_name);
+  inference::RepositoryModelUnloadResponse resp;
+  return Call("RepositoryModelUnload", req, &resp, headers);
+}
+
+// --- statistics / trace / log ---
+
+Error InferenceServerGrpcClient::ModelInferenceStatistics(
+    inference::ModelStatisticsResponse* infer_stat,
+    const std::string& model_name, const std::string& model_version,
+    const Headers& headers) {
+  inference::ModelStatisticsRequest req;
+  req.set_name(model_name);
+  req.set_version(model_version);
+  return Call("ModelStatistics", req, infer_stat, headers);
+}
+
+Error InferenceServerGrpcClient::UpdateTraceSettings(
+    inference::TraceSettingResponse* response, const std::string& model_name,
+    const std::map<std::string, std::vector<std::string>>& settings,
+    const Headers& headers) {
+  inference::TraceSettingRequest req;
+  req.set_model_name(model_name);
+  for (const auto& kv : settings) {
+    auto& value = (*req.mutable_settings())[kv.first];
+    for (const auto& v : kv.second) value.add_value(v);
+  }
+  return Call("TraceSetting", req, response, headers);
+}
+
+Error InferenceServerGrpcClient::GetTraceSettings(
+    inference::TraceSettingResponse* settings, const std::string& model_name,
+    const Headers& headers) {
+  inference::TraceSettingRequest req;
+  req.set_model_name(model_name);
+  return Call("TraceSetting", req, settings, headers);
+}
+
+Error InferenceServerGrpcClient::UpdateLogSettings(
+    inference::LogSettingsResponse* response,
+    const std::map<std::string, std::string>& settings,
+    const Headers& headers) {
+  inference::LogSettingsRequest req;
+  for (const auto& kv : settings) {
+    auto& value = (*req.mutable_settings())[kv.first];
+    if (kv.second == "true" || kv.second == "false") {
+      value.set_bool_param(kv.second == "true");
+    } else {
+      char* end = nullptr;
+      const unsigned long v = strtoul(kv.second.c_str(), &end, 10);
+      if (end && *end == '\0' && !kv.second.empty()) {
+        value.set_uint32_param(static_cast<uint32_t>(v));
+      } else {
+        value.set_string_param(kv.second);
+      }
+    }
+  }
+  return Call("LogSettings", req, response, headers);
+}
+
+Error InferenceServerGrpcClient::GetLogSettings(
+    inference::LogSettingsResponse* settings, const Headers& headers) {
+  inference::LogSettingsRequest req;
+  return Call("LogSettings", req, settings, headers);
+}
+
+// --- shared memory ---
+
+Error InferenceServerGrpcClient::SystemSharedMemoryStatus(
+    inference::SystemSharedMemoryStatusResponse* status,
+    const std::string& region_name, const Headers& headers) {
+  inference::SystemSharedMemoryStatusRequest req;
+  req.set_name(region_name);
+  return Call("SystemSharedMemoryStatus", req, status, headers);
+}
+
+Error InferenceServerGrpcClient::RegisterSystemSharedMemory(
+    const std::string& name, const std::string& key, size_t byte_size,
+    size_t offset, const Headers& headers) {
+  inference::SystemSharedMemoryRegisterRequest req;
+  req.set_name(name);
+  req.set_key(key);
+  req.set_offset(offset);
+  req.set_byte_size(byte_size);
+  inference::SystemSharedMemoryRegisterResponse resp;
+  return Call("SystemSharedMemoryRegister", req, &resp, headers);
+}
+
+Error InferenceServerGrpcClient::UnregisterSystemSharedMemory(
+    const std::string& name, const Headers& headers) {
+  inference::SystemSharedMemoryUnregisterRequest req;
+  req.set_name(name);
+  inference::SystemSharedMemoryUnregisterResponse resp;
+  return Call("SystemSharedMemoryUnregister", req, &resp, headers);
+}
+
+Error InferenceServerGrpcClient::TpuSharedMemoryStatus(
+    inference::TpuSharedMemoryStatusResponse* status,
+    const std::string& region_name, const Headers& headers) {
+  inference::TpuSharedMemoryStatusRequest req;
+  req.set_name(region_name);
+  return Call("TpuSharedMemoryStatus", req, status, headers);
+}
+
+Error InferenceServerGrpcClient::RegisterTpuSharedMemory(
+    const std::string& name, const std::string& raw_handle, int64_t device_id,
+    size_t byte_size, const Headers& headers) {
+  inference::TpuSharedMemoryRegisterRequest req;
+  req.set_name(name);
+  req.set_raw_handle(raw_handle);
+  req.set_device_id(device_id);
+  req.set_byte_size(byte_size);
+  inference::TpuSharedMemoryRegisterResponse resp;
+  return Call("TpuSharedMemoryRegister", req, &resp, headers);
+}
+
+Error InferenceServerGrpcClient::UnregisterTpuSharedMemory(
+    const std::string& name, const Headers& headers) {
+  inference::TpuSharedMemoryUnregisterRequest req;
+  req.set_name(name);
+  inference::TpuSharedMemoryUnregisterResponse resp;
+  return Call("TpuSharedMemoryUnregister", req, &resp, headers);
+}
+
+// --- inference ---
+
+Error InferenceServerGrpcClient::FillInferRequest(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    inference::ModelInferRequest* request) {
+  request->Clear();
+  request->set_model_name(options.model_name);
+  request->set_model_version(options.model_version);
+  request->set_id(options.request_id);
+  auto* params = request->mutable_parameters();
+  if (!options.sequence_id_str.empty()) {
+    (*params)["sequence_id"].set_string_param(options.sequence_id_str);
+    (*params)["sequence_start"].set_bool_param(options.sequence_start);
+    (*params)["sequence_end"].set_bool_param(options.sequence_end);
+  } else if (options.sequence_id != 0) {
+    (*params)["sequence_id"].set_int64_param(
+        static_cast<int64_t>(options.sequence_id));
+    (*params)["sequence_start"].set_bool_param(options.sequence_start);
+    (*params)["sequence_end"].set_bool_param(options.sequence_end);
+  }
+  if (options.priority != 0) {
+    (*params)["priority"].set_uint64_param(options.priority);
+  }
+  if (options.server_timeout_us != 0) {
+    (*params)["timeout"].set_int64_param(
+        static_cast<int64_t>(options.server_timeout_us));
+  }
+  if (options.enable_empty_final_response) {
+    (*params)["triton_enable_empty_final_response"].set_bool_param(true);
+  }
+  for (const auto& kv : options.parameters) {
+    CTPU_RETURN_IF_ERROR(
+        SetParameterFromJson(kv.first, kv.second, &(*params)[kv.first]));
+  }
+  for (InferInput* input : inputs) {
+    auto* tensor = request->add_inputs();
+    tensor->set_name(input->Name());
+    tensor->set_datatype(input->Datatype());
+    for (int64_t d : input->Shape()) tensor->add_shape(d);
+    if (input->IsSharedMemory()) {
+      auto* tp = tensor->mutable_parameters();
+      (*tp)["shared_memory_region"].set_string_param(
+          input->SharedMemoryName());
+      (*tp)["shared_memory_byte_size"].set_int64_param(
+          static_cast<int64_t>(input->SharedMemoryByteSize()));
+      if (input->SharedMemoryOffset() != 0) {
+        (*tp)["shared_memory_offset"].set_int64_param(
+            static_cast<int64_t>(input->SharedMemoryOffset()));
+      }
+    } else {
+      std::string* raw = request->add_raw_input_contents();
+      input->ConcatenatedData(raw);
+    }
+  }
+  for (const InferRequestedOutput* output : outputs) {
+    auto* tensor = request->add_outputs();
+    tensor->set_name(output->Name());
+    auto* tp = tensor->mutable_parameters();
+    if (output->ClassCount() != 0) {
+      (*tp)["classification"].set_int64_param(
+          static_cast<int64_t>(output->ClassCount()));
+    }
+    if (output->IsSharedMemory()) {
+      (*tp)["shared_memory_region"].set_string_param(
+          output->SharedMemoryName());
+      (*tp)["shared_memory_byte_size"].set_int64_param(
+          static_cast<int64_t>(output->SharedMemoryByteSize()));
+      if (output->SharedMemoryOffset() != 0) {
+        (*tp)["shared_memory_offset"].set_int64_param(
+            static_cast<int64_t>(output->SharedMemoryOffset()));
+      }
+    }
+  }
+  return Error::Success();
+}
+
+Error InferenceServerGrpcClient::Infer(
+    InferResult** result, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    const Headers& headers) {
+  RequestTimers timers;
+  timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
+  inference::ModelInferRequest request;
+  CTPU_RETURN_IF_ERROR(FillInferRequest(options, inputs, outputs, &request));
+  auto response = std::make_shared<inference::ModelInferResponse>();
+  // Call() blocks for the whole RTT; send/recv cannot be split out here, so
+  // leave those timestamps unset (they contribute 0) rather than report the
+  // full RTT as send time.
+  Error err = Call("ModelInfer", request, response.get(), headers,
+                   options.client_timeout_us);
+  timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
+  if (!err.IsOk()) return err;
+  UpdateInferStat(timers);
+  InferResultGrpc::Create(result, std::move(response));
+  return Error::Success();
+}
+
+Error InferenceServerGrpcClient::AsyncInfer(
+    OnCompleteFn callback, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    const Headers& headers) {
+  if (!callback) return Error("callback is required for AsyncInfer");
+  CTPU_RETURN_IF_ERROR(EnsureConnection());
+  inference::ModelInferRequest request;
+  CTPU_RETURN_IF_ERROR(FillInferRequest(options, inputs, outputs, &request));
+
+  auto st = std::make_shared<UnaryCallState>();
+  auto cb = std::make_shared<OnCompleteFn>(std::move(callback));
+  h2::StreamEvents ev;
+  ev.on_headers = [st](std::vector<hpack::Header> hs, bool) {
+    std::lock_guard<std::mutex> lk(st->mu);
+    ScanGrpcTrailers(hs, st.get());
+  };
+  ev.on_data = [st](const uint8_t* d, size_t n, bool) {
+    std::lock_guard<std::mutex> lk(st->mu);
+    st->framer.Append(d, n);
+  };
+  ev.on_close = [st, cb](bool ok, uint32_t, const std::string& err) {
+    // Runs on the reader thread (reference delivers from the CQ thread,
+    // grpc_client.cc:1583-1626 — same contract).
+    Error status = Error::Success();
+    auto response = std::make_shared<inference::ModelInferResponse>();
+    std::string msg;
+    bool compressed = false;
+    {
+      std::lock_guard<std::mutex> lk(st->mu);
+      if (!ok) {
+        status = Error("gRPC transport error: " + err);
+      } else if (st->grpc_status != 0) {
+        status = Error("[gRPC status " + std::to_string(st->grpc_status) +
+                       "] " + st->grpc_message);
+      } else if (!st->framer.Next(&msg, &compressed) || compressed) {
+        status = Error("gRPC response missing/compressed message body");
+      } else if (!response->ParseFromString(msg)) {
+        status = Error("failed to parse ModelInfer response proto");
+      }
+    }
+    InferResult* result;
+    InferResultGrpc::Create(&result, std::move(response), status);
+    (*cb)(result);
+  };
+
+  std::shared_ptr<h2::Connection> conn = Conn();
+  const int32_t sid = conn->StartStream(
+      BuildHeaders("ModelInfer", headers, options.client_timeout_us), false,
+      ev);
+  if (sid < 0) return Error("gRPC stream open failed (connection lost)");
+  const std::string body = FrameMessage(request);
+  // If the send fails the stream is already registered and on_close WILL
+  // fire with the transport error — report success here so the callback is
+  // the single delivery path (no double signaling).
+  conn->SendData(sid, body.data(), body.size(), true);
+  return Error::Success();
+}
+
+Error InferenceServerGrpcClient::InferMulti(
+    std::vector<InferResult*>* results, const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs,
+    const Headers& headers) {
+  // Mirrors reference InferMulti (grpc_client.cc): one options entry may be
+  // shared across all requests.
+  if (options.size() != 1 && options.size() != inputs.size()) {
+    return Error("options size must be 1 or match inputs size");
+  }
+  if (!outputs.empty() && outputs.size() != inputs.size()) {
+    return Error("outputs size must be 0 or match inputs size");
+  }
+  results->clear();
+  for (size_t i = 0; i < inputs.size(); ++i) {
+    const InferOptions& opt = options.size() == 1 ? options[0] : options[i];
+    static const std::vector<const InferRequestedOutput*> kNoOutputs;
+    const auto& outs = outputs.empty() ? kNoOutputs : outputs[i];
+    InferResult* result = nullptr;
+    CTPU_RETURN_IF_ERROR(Infer(&result, opt, inputs[i], outs, headers));
+    results->push_back(result);
+  }
+  return Error::Success();
+}
+
+Error InferenceServerGrpcClient::AsyncInferMulti(
+    OnMultiCompleteFn callback, const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs,
+    const Headers& headers) {
+  if (!callback) return Error("callback is required for AsyncInferMulti");
+  if (options.size() != 1 && options.size() != inputs.size()) {
+    return Error("options size must be 1 or match inputs size");
+  }
+  if (!outputs.empty() && outputs.size() != inputs.size()) {
+    return Error("outputs size must be 0 or match inputs size");
+  }
+  struct MultiState {
+    std::mutex mu;
+    std::vector<InferResult*> results;
+    size_t pending;
+    OnMultiCompleteFn callback;
+  };
+  if (inputs.empty()) {
+    std::vector<InferResult*> empty;
+    callback(&empty);
+    return Error::Success();
+  }
+  auto ms = std::make_shared<MultiState>();
+  ms->results.resize(inputs.size(), nullptr);
+  ms->pending = inputs.size();
+  ms->callback = std::move(callback);
+  for (size_t i = 0; i < inputs.size(); ++i) {
+    const InferOptions& opt = options.size() == 1 ? options[0] : options[i];
+    static const std::vector<const InferRequestedOutput*> kNoOutputs;
+    const auto& outs = outputs.empty() ? kNoOutputs : outputs[i];
+    Error err = AsyncInfer(
+        [ms, i](InferResult* result) {
+          bool last = false;
+          {
+            std::lock_guard<std::mutex> lk(ms->mu);
+            ms->results[i] = result;
+            last = (--ms->pending == 0);
+          }
+          if (last) ms->callback(&ms->results);
+        },
+        opt, inputs[i], outs, headers);
+    if (!err.IsOk()) {
+      // Deliver the failure for this slot so the callback still fires once
+      // all slots resolve.
+      InferResult* result;
+      InferResultGrpc::Create(
+          &result, std::make_shared<inference::ModelInferResponse>(), err);
+      bool last = false;
+      {
+        std::lock_guard<std::mutex> lk(ms->mu);
+        ms->results[i] = result;
+        last = (--ms->pending == 0);
+      }
+      if (last) ms->callback(&ms->results);
+    }
+  }
+  return Error::Success();
+}
+
+// --- streaming ---
+
+Error InferenceServerGrpcClient::StartStream(OnCompleteFn callback,
+                                             bool enable_stats,
+                                             uint32_t stream_timeout_us,
+                                             const Headers& headers) {
+  if (!callback) return Error("callback is required for StartStream");
+  std::lock_guard<std::mutex> slk(stream_mu_);
+  if (stream_id_ >= 0) {
+    return Error("stream is already active; only one stream per client");
+  }
+  CTPU_RETURN_IF_ERROR(EnsureConnection());
+  auto st = std::make_shared<StreamState>();
+  auto cb = std::make_shared<OnCompleteFn>(std::move(callback));
+
+  h2::StreamEvents ev;
+  ev.on_headers = [st](std::vector<hpack::Header> hs, bool) {
+    std::lock_guard<std::mutex> lk(st->mu);
+    for (const auto& h : hs) {
+      if (h.name == "grpc-status") st->grpc_status = atoi(h.value.c_str());
+      if (h.name == "grpc-message") st->grpc_message = PercentDecode(h.value);
+    }
+  };
+  ev.on_data = [this, st, cb, enable_stats](const uint8_t* d, size_t n,
+                                            bool) {
+    // Parse complete ModelStreamInferResponse messages as they arrive and
+    // deliver each (token streaming for decoupled models,
+    // reference grpc_client.cc:1629-1673 AsyncStreamTransfer).
+    std::vector<std::string> msgs;
+    {
+      std::lock_guard<std::mutex> lk(st->mu);
+      st->framer.Append(d, n);
+      std::string msg;
+      bool compressed = false;
+      while (st->framer.Next(&msg, &compressed)) {
+        if (!compressed) msgs.push_back(std::move(msg));
+      }
+    }
+    for (const std::string& m : msgs) {
+      inference::ModelStreamInferResponse stream_resp;
+      Error status = Error::Success();
+      auto response = std::make_shared<inference::ModelInferResponse>();
+      if (!stream_resp.ParseFromString(m)) {
+        status = Error("failed to parse stream response proto");
+      } else {
+        if (!stream_resp.error_message().empty()) {
+          status = Error(stream_resp.error_message());
+        }
+        response->Swap(stream_resp.mutable_infer_response());
+      }
+      if (enable_stats) RecordStreamResponse();
+      InferResult* result;
+      InferResultGrpc::Create(&result, std::move(response), status);
+      (*cb)(result);
+    }
+  };
+  ev.on_close = [this, st, cb](bool ok, uint32_t, const std::string& err) {
+    int grpc_status;
+    std::string grpc_message;
+    {
+      std::lock_guard<std::mutex> lk(st->mu);
+      st->closed = true;
+      if (!ok) st->close_err = err;
+      st->cv.notify_all();
+      grpc_status = st->grpc_status;
+      grpc_message = st->grpc_message;
+    }
+    {
+      // The stream is gone; deactivate so AsyncStreamInfer fails cleanly
+      // (mirrors the auto-deactivation of the reference Python client,
+      // grpc/_infer_stream.py:156-166).
+      std::lock_guard<std::mutex> slk(stream_mu_);
+      if (stream_state_ == st) {
+        stream_id_ = -1;
+        stream_state_.reset();
+        stream_conn_.reset();
+      }
+    }
+    Error status = Error::Success();
+    if (!ok) {
+      status = Error("stream closed: " + err);
+    } else if (grpc_status > 0) {
+      // Clean HTTP/2 close but the server ended the RPC with an error
+      // (e.g. unknown model): surface it instead of dropping it.
+      status = Error("[gRPC status " + std::to_string(grpc_status) + "] " +
+                     grpc_message);
+    }
+    if (!status.IsOk()) {
+      InferResult* result;
+      InferResultGrpc::Create(
+          &result, std::make_shared<inference::ModelInferResponse>(), status);
+      (*cb)(result);
+    }
+  };
+
+  std::shared_ptr<h2::Connection> conn = Conn();
+  const int32_t sid = conn->StartStream(
+      BuildHeaders("ModelStreamInfer", headers, stream_timeout_us), false, ev);
+  if (sid < 0) return Error("gRPC stream open failed (connection lost)");
+  stream_id_ = sid;
+  stream_enable_stats_ = enable_stats;
+  stream_state_ = st;
+  stream_conn_ = conn;
+  // If the server closed the stream before the assignments above, on_close
+  // found stream_state_ != st and skipped deactivation — recheck here.
+  {
+    std::lock_guard<std::mutex> lk(st->mu);
+    if (st->closed) {
+      stream_id_ = -1;
+      stream_state_.reset();
+      stream_conn_.reset();
+    }
+  }
+  return Error::Success();
+}
+
+void InferenceServerGrpcClient::RecordStreamResponse() {
+  // Minimal stream accounting: response count only. Per-response latency
+  // attribution needs request/response correlation that decoupled streams
+  // do not provide (the reference has the same caveat and mis-maps stats
+  // 1:1, grpc_client.cc:1650-1653 — counting only is the honest subset).
+  RequestTimers timers;
+  UpdateInferStat(timers);
+}
+
+Error InferenceServerGrpcClient::AsyncStreamInfer(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs) {
+  int32_t sid;
+  std::shared_ptr<h2::Connection> conn;
+  {
+    std::lock_guard<std::mutex> slk(stream_mu_);
+    if (stream_id_ < 0) return Error("stream not active; call StartStream");
+    sid = stream_id_;
+    conn = stream_conn_;
+  }
+  inference::ModelInferRequest request;
+  CTPU_RETURN_IF_ERROR(FillInferRequest(options, inputs, outputs, &request));
+  const std::string body = FrameMessage(request);
+  if (!conn->SendData(sid, body.data(), body.size(), false)) {
+    return Error("stream write failed (connection lost)");
+  }
+  return Error::Success();
+}
+
+Error InferenceServerGrpcClient::StopStream() {
+  std::shared_ptr<StreamState> st;
+  std::shared_ptr<h2::Connection> conn;
+  int32_t sid;
+  {
+    std::lock_guard<std::mutex> slk(stream_mu_);
+    if (stream_id_ < 0) return Error::Success();
+    sid = stream_id_;
+    st = stream_state_;
+    conn = stream_conn_;
+    stream_id_ = -1;
+    stream_state_.reset();
+    stream_conn_.reset();
+  }
+  if (conn && conn->alive()) {
+    // Half-close (WritesDone equivalent) then wait for the server to finish.
+    conn->SendData(sid, nullptr, 0, true);
+    std::unique_lock<std::mutex> lk(st->mu);
+    st->cv.wait_for(lk, std::chrono::seconds(5), [&] { return st->closed; });
+    if (!st->closed) {
+      lk.unlock();
+      conn->ResetStream(sid, 0x8 /* CANCEL */);
+    }
+  }
+  return Error::Success();
+}
+
+}  // namespace ctpu

@@ -185,8 +185,16 @@ int32_t Connection::StartStream(const std::vector<hpack::Header>& headers,
     } while (ok && off < block.size());
   }
   if (!ok) {
+    // Contract: a -1 return means the stream was never created and NO events
+    // will fire for it (callers hold their own locks around StartStream, so
+    // firing on_close synchronously here could deadlock them).
     std::unique_lock<std::mutex> lk(mu_);
-    CloseStreamLocked(id, false, 0, "failed to send HEADERS", &lk);
+    auto it = streams_.find(id);
+    if (it != streams_.end() && !it->second->closed) {
+      it->second->closed = true;
+      streams_.erase(it);
+      window_cv_.notify_all();
+    }
     return -1;
   }
   return static_cast<int32_t>(id);

@@ -1,0 +1,182 @@
+// Minimal gRPC inference example against the `simple` add_sub model.
+//
+// Parity with reference src/c++/examples/simple_grpc_infer_client.cc,
+// plus an async round and a streaming round (the reference splits these
+// into simple_grpc_async_infer_client.cc / sequence_stream examples).
+// Rides the in-repo gRPC-over-HTTP/2 client — no grpc++.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <iostream>
+#include <mutex>
+#include <vector>
+
+#include "grpc_client.h"
+
+namespace {
+
+void FailOnError(const ctpu::Error& err, const char* what) {
+  if (!err.IsOk()) {
+    std::cerr << "error: " << what << ": " << err.Message() << std::endl;
+    exit(1);
+  }
+}
+
+void CheckAddSub(ctpu::InferResult* result,
+                 const std::vector<int32_t>& input0,
+                 const std::vector<int32_t>& input1, const char* what) {
+  FailOnError(result->RequestStatus(), what);
+  const uint8_t* out0;
+  const uint8_t* out1;
+  size_t n0, n1;
+  FailOnError(result->RawData("OUTPUT0", &out0, &n0), "OUTPUT0 data");
+  FailOnError(result->RawData("OUTPUT1", &out1, &n1), "OUTPUT1 data");
+  if (n0 != 64 || n1 != 64) {
+    std::cerr << "error: unexpected output sizes " << n0 << ", " << n1
+              << std::endl;
+    exit(1);
+  }
+  const int32_t* sum = reinterpret_cast<const int32_t*>(out0);
+  const int32_t* diff = reinterpret_cast<const int32_t*>(out1);
+  for (int i = 0; i < 16; ++i) {
+    if (sum[i] != input0[i] + input1[i] || diff[i] != input0[i] - input1[i]) {
+      std::cerr << "error: incorrect " << what << " result at " << i
+                << std::endl;
+      exit(1);
+    }
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8001";
+  std::string model_name = "simple";
+  bool verbose = false;
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    if (arg == "-u" && i + 1 < argc) url = argv[++i];
+    if (arg == "-m" && i + 1 < argc) model_name = argv[++i];
+    if (arg == "-v") verbose = true;
+  }
+
+  std::unique_ptr<ctpu::InferenceServerGrpcClient> client;
+  FailOnError(ctpu::InferenceServerGrpcClient::Create(&client, url, verbose),
+              "create client");
+
+  bool live = false;
+  FailOnError(client->IsServerLive(&live), "server live");
+  if (!live) {
+    std::cerr << "error: server not live" << std::endl;
+    return 1;
+  }
+  bool ready = false;
+  FailOnError(client->IsModelReady(&ready, model_name), "model ready");
+  if (!ready) {
+    // Proceed anyway: the next calls surface the server's grpc-status for
+    // unknown models, which is more useful than a bare not-ready exit.
+    std::cerr << "warning: model '" << model_name
+              << "' not ready; proceeding" << std::endl;
+  }
+
+  inference::ModelMetadataResponse metadata;
+  FailOnError(client->ModelMetadata(&metadata, model_name), "model metadata");
+  if (metadata.inputs_size() != 2) {
+    std::cerr << "error: expected 2 inputs in metadata" << std::endl;
+    return 1;
+  }
+
+  std::vector<int32_t> input0_data(16), input1_data(16);
+  for (int i = 0; i < 16; ++i) {
+    input0_data[i] = i;
+    input1_data[i] = 1;
+  }
+  ctpu::InferInput input0("INPUT0", {1, 16}, "INT32");
+  ctpu::InferInput input1("INPUT1", {1, 16}, "INT32");
+  FailOnError(
+      input0.AppendRaw(reinterpret_cast<const uint8_t*>(input0_data.data()),
+                       input0_data.size() * sizeof(int32_t)),
+      "set INPUT0");
+  FailOnError(
+      input1.AppendRaw(reinterpret_cast<const uint8_t*>(input1_data.data()),
+                       input1_data.size() * sizeof(int32_t)),
+      "set INPUT1");
+  ctpu::InferRequestedOutput output0("OUTPUT0");
+  ctpu::InferRequestedOutput output1("OUTPUT1");
+  ctpu::InferOptions options(model_name);
+  options.request_id = "1";
+
+  // 1) blocking Infer
+  ctpu::InferResult* raw_result = nullptr;
+  FailOnError(client->Infer(&raw_result, options, {&input0, &input1},
+                            {&output0, &output1}),
+              "infer");
+  std::unique_ptr<ctpu::InferResult> result(raw_result);
+  CheckAddSub(result.get(), input0_data, input1_data, "sync");
+
+  // 2) AsyncInfer (completion delivered from the connection reader thread)
+  std::mutex mu;
+  std::condition_variable cv;
+  std::unique_ptr<ctpu::InferResult> async_result;
+  FailOnError(client->AsyncInfer(
+                  [&](ctpu::InferResult* r) {
+                    std::lock_guard<std::mutex> lk(mu);
+                    async_result.reset(r);
+                    cv.notify_all();
+                  },
+                  options, {&input0, &input1}, {&output0, &output1}),
+              "async infer");
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    if (!cv.wait_for(lk, std::chrono::seconds(30),
+                     [&] { return async_result != nullptr; })) {
+      std::cerr << "error: async infer timed out" << std::endl;
+      return 1;
+    }
+  }
+  CheckAddSub(async_result.get(), input0_data, input1_data, "async");
+
+  // 3) streaming (ModelStreamInfer bidi)
+  std::vector<std::unique_ptr<ctpu::InferResult>> stream_results;
+  FailOnError(client->StartStream(
+                  [&](ctpu::InferResult* r) {
+                    std::lock_guard<std::mutex> lk(mu);
+                    stream_results.emplace_back(r);
+                    cv.notify_all();
+                  }),
+              "start stream");
+  const int kStreamRequests = 4;
+  for (int i = 0; i < kStreamRequests; ++i) {
+    FailOnError(client->AsyncStreamInfer(options, {&input0, &input1},
+                                         {&output0, &output1}),
+                "stream infer");
+  }
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    if (!cv.wait_for(lk, std::chrono::seconds(30), [&] {
+          return stream_results.size() >= kStreamRequests;
+        })) {
+      std::cerr << "error: stream responses timed out" << std::endl;
+      return 1;
+    }
+  }
+  FailOnError(client->StopStream(), "stop stream");
+  for (auto& r : stream_results) {
+    CheckAddSub(r.get(), input0_data, input1_data, "stream");
+  }
+
+  // 4) statistics round-trip
+  inference::ModelStatisticsResponse stats;
+  FailOnError(client->ModelInferenceStatistics(&stats, model_name), "stats");
+  if (stats.model_stats_size() < 1) {
+    std::cerr << "error: no model statistics" << std::endl;
+    return 1;
+  }
+  if (verbose) {
+    std::cout << stats.model_stats(0).ShortDebugString() << std::endl;
+  }
+
+  std::cout << "PASS : simple_grpc_infer_client" << std::endl;
+  return 0;
+}

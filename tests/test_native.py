@@ -105,3 +105,35 @@ def test_cpp_perf_analyzer_shm_live(native_build, live_server):
     )
     assert summary["errors"] == 0
     assert summary["throughput"] > 0
+
+
+@pytest.fixture(scope="module")
+def live_grpc_server():
+    from client_tpu.testing import InProcessServer
+
+    with InProcessServer(host="127.0.0.1", http=False, grpc=True) as server:
+        yield server
+
+
+def test_cpp_grpc_example_client(native_build, live_grpc_server):
+    """End-to-end: native gRPC client (hand-rolled HTTP/2) against the
+    grpcio server — sync Infer, AsyncInfer, bidi streaming, statistics."""
+    out = subprocess.run(
+        [os.path.join(native_build, "simple_grpc_infer_client"),
+         "-u", live_grpc_server.grpc_url],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "PASS" in out.stdout
+
+
+def test_cpp_grpc_client_error_mapping(native_build, live_grpc_server):
+    """Unknown model must surface the server's grpc-status as a client
+    error (exercises Call()'s trailer handling, not just transport)."""
+    out = subprocess.run(
+        [os.path.join(native_build, "simple_grpc_infer_client"),
+         "-u", live_grpc_server.grpc_url, "-m", "no_such_model"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode != 0
+    assert "gRPC status" in (out.stdout + out.stderr)

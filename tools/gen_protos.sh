@@ -22,6 +22,14 @@ protoc -I "$STAGE" \
   "$STAGE/client_tpu/grpc/_generated/model_config.proto" \
   "$STAGE/client_tpu/grpc/_generated/grpc_service.proto"
 
+# C++ message classes for the native gRPC client (service methods are
+# hand-written over the in-repo HTTP/2 stack in native/client/grpc_client.cc).
+mkdir -p native/generated
+protoc -I "$STAGE" \
+  --cpp_out=native/generated \
+  "$STAGE/client_tpu/grpc/_generated/model_config.proto" \
+  "$STAGE/client_tpu/grpc/_generated/grpc_service.proto"
+
 cat > client_tpu/grpc/_generated/__init__.py <<'EOF'
 """Generated protobuf message modules (see tools/gen_protos.sh)."""
 
