@@ -110,6 +110,49 @@ void ScanGrpcTrailers(const std::vector<hpack::Header>& headers,
   }
 }
 
+// Shared header/data handlers for one unary RPC (Call and AsyncInfer differ
+// only in how completion is delivered).
+void FillUnaryEvents(std::shared_ptr<UnaryCallState> st,
+                     h2::StreamEvents* ev) {
+  ev->on_headers = [st](std::vector<hpack::Header> hs, bool) {
+    std::lock_guard<std::mutex> lk(st->mu);
+    ScanGrpcTrailers(hs, st.get());
+  };
+  ev->on_data = [st](const uint8_t* d, size_t n, bool) {
+    std::lock_guard<std::mutex> lk(st->mu);
+    st->framer.Append(d, n);
+  };
+}
+
+// Decodes the completed unary call into *resp. Caller must hold st->mu (or
+// have exclusive access after completion).
+Error DecodeUnaryResult(UnaryCallState* st, const std::string& method,
+                        google::protobuf::Message* resp) {
+  if (!st->transport_ok) {
+    return Error("gRPC transport error: " + st->transport_err);
+  }
+  if (st->grpc_status != 0) {
+    if (st->grpc_status < 0) {
+      return Error("gRPC response missing grpc-status (HTTP " +
+                   std::to_string(st->http_status) + ")");
+    }
+    return Error("[gRPC status " + std::to_string(st->grpc_status) + "] " +
+                 st->grpc_message);
+  }
+  std::string msg;
+  bool compressed = false;
+  if (!st->framer.Next(&msg, &compressed)) {
+    return Error("gRPC response missing message body");
+  }
+  if (compressed) {
+    return Error("gRPC response unexpectedly compressed");
+  }
+  if (!resp->ParseFromString(msg)) {
+    return Error("failed to parse " + method + " response proto");
+  }
+  return Error::Success();
+}
+
 Error SetParameterFromJson(const std::string& key, const std::string& raw,
                            inference::InferParameter* param) {
   // options.parameters carries raw JSON fragments (see common.h); map them
@@ -308,14 +351,7 @@ Error InferenceServerGrpcClient::Call(const std::string& method,
   CTPU_RETURN_IF_ERROR(EnsureConnection());
   auto st = std::make_shared<UnaryCallState>();
   h2::StreamEvents ev;
-  ev.on_headers = [st](std::vector<hpack::Header> hs, bool) {
-    std::lock_guard<std::mutex> lk(st->mu);
-    ScanGrpcTrailers(hs, st.get());
-  };
-  ev.on_data = [st](const uint8_t* d, size_t n, bool) {
-    std::lock_guard<std::mutex> lk(st->mu);
-    st->framer.Append(d, n);
-  };
+  FillUnaryEvents(st, &ev);
   ev.on_close = [st](bool ok, uint32_t, const std::string& err) {
     std::lock_guard<std::mutex> lk(st->mu);
     st->done = true;
@@ -329,8 +365,14 @@ Error InferenceServerGrpcClient::Call(const std::string& method,
       conn->StartStream(BuildHeaders(method, headers, timeout_us), false, ev);
   if (sid < 0) return Error("gRPC stream open failed (connection lost)");
   const std::string body = FrameMessage(req);
-  if (!conn->SendData(sid, body.data(), body.size(), true)) {
-    return Error("gRPC request send failed (connection lost)");
+  if (!conn->SendData(sid, body.data(), body.size(), true,
+                      static_cast<int64_t>(timeout_us))) {
+    // The stream was registered; h2 fires on_close for it (now or at
+    // connection teardown) — wait below rather than double-report. A
+    // flow-control stall past the deadline resets the stream first.
+    if (timeout_us > 0) {
+      conn->ResetStream(sid, 0x8 /* CANCEL */);
+    }
   }
 
   std::unique_lock<std::mutex> lk(st->mu);
@@ -344,29 +386,7 @@ Error InferenceServerGrpcClient::Call(const std::string& method,
   } else {
     st->cv.wait(lk, [&] { return st->done; });
   }
-  if (!st->transport_ok) {
-    return Error("gRPC transport error: " + st->transport_err);
-  }
-  if (st->grpc_status != 0) {
-    if (st->grpc_status < 0) {
-      return Error("gRPC response missing grpc-status (HTTP " +
-                   std::to_string(st->http_status) + ")");
-    }
-    return Error("[gRPC status " + std::to_string(st->grpc_status) + "] " +
-                 st->grpc_message);
-  }
-  std::string msg;
-  bool compressed = false;
-  if (!st->framer.Next(&msg, &compressed)) {
-    return Error("gRPC response missing message body");
-  }
-  if (compressed) {
-    return Error("gRPC response unexpectedly compressed");
-  }
-  if (!resp->ParseFromString(msg)) {
-    return Error("failed to parse " + method + " response proto");
-  }
-  return Error::Success();
+  return DecodeUnaryResult(st.get(), method, resp);
 }
 
 // --- health / metadata ---
@@ -690,33 +710,17 @@ Error InferenceServerGrpcClient::AsyncInfer(
   auto st = std::make_shared<UnaryCallState>();
   auto cb = std::make_shared<OnCompleteFn>(std::move(callback));
   h2::StreamEvents ev;
-  ev.on_headers = [st](std::vector<hpack::Header> hs, bool) {
-    std::lock_guard<std::mutex> lk(st->mu);
-    ScanGrpcTrailers(hs, st.get());
-  };
-  ev.on_data = [st](const uint8_t* d, size_t n, bool) {
-    std::lock_guard<std::mutex> lk(st->mu);
-    st->framer.Append(d, n);
-  };
+  FillUnaryEvents(st, &ev);
   ev.on_close = [st, cb](bool ok, uint32_t, const std::string& err) {
     // Runs on the reader thread (reference delivers from the CQ thread,
     // grpc_client.cc:1583-1626 — same contract).
-    Error status = Error::Success();
     auto response = std::make_shared<inference::ModelInferResponse>();
-    std::string msg;
-    bool compressed = false;
+    Error status;
     {
       std::lock_guard<std::mutex> lk(st->mu);
-      if (!ok) {
-        status = Error("gRPC transport error: " + err);
-      } else if (st->grpc_status != 0) {
-        status = Error("[gRPC status " + std::to_string(st->grpc_status) +
-                       "] " + st->grpc_message);
-      } else if (!st->framer.Next(&msg, &compressed) || compressed) {
-        status = Error("gRPC response missing/compressed message body");
-      } else if (!response->ParseFromString(msg)) {
-        status = Error("failed to parse ModelInfer response proto");
-      }
+      st->transport_ok = ok;
+      st->transport_err = err;
+      status = DecodeUnaryResult(st.get(), "ModelInfer", response.get());
     }
     InferResult* result;
     InferResultGrpc::Create(&result, std::move(response), status);
@@ -921,7 +925,6 @@ Error InferenceServerGrpcClient::StartStream(OnCompleteFn callback,
       BuildHeaders("ModelStreamInfer", headers, stream_timeout_us), false, ev);
   if (sid < 0) return Error("gRPC stream open failed (connection lost)");
   stream_id_ = sid;
-  stream_enable_stats_ = enable_stats;
   stream_state_ = st;
   stream_conn_ = conn;
   // If the server closed the stream before the assignments above, on_close

@@ -205,7 +205,6 @@ class InferenceServerGrpcClient : public InferenceServerClient {
   // documents the same contract, reference grpc_client.cc:1327-1332).
   std::mutex stream_mu_;
   int32_t stream_id_ = -1;
-  bool stream_enable_stats_ = true;
   std::shared_ptr<struct StreamState> stream_state_;
   std::shared_ptr<h2::Connection> stream_conn_;
   void RecordStreamResponse();

@@ -194,29 +194,41 @@ int32_t Connection::StartStream(const std::vector<hpack::Header>& headers,
       it->second->closed = true;
       streams_.erase(it);
       window_cv_.notify_all();
+      return -1;
     }
-    return -1;
+    // The connection died concurrently and FailAllStreams already fired
+    // on_close for this stream. Report it as started so the caller treats
+    // the (already-delivered) events as the single completion path.
   }
   return static_cast<int32_t>(id);
 }
 
 bool Connection::SendData(int32_t stream_id, const void* data, size_t len,
-                          bool end_stream) {
+                          bool end_stream, int64_t timeout_us) {
   const uint8_t* p = static_cast<const uint8_t*>(data);
   size_t remaining = len;
+  const auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::microseconds(timeout_us);
   do {
     size_t chunk;
     {
       std::unique_lock<std::mutex> lk(mu_);
       auto it = streams_.find(static_cast<uint32_t>(stream_id));
       // Wait for send window (both levels) or stream death.
-      window_cv_.wait(lk, [&] {
+      auto window_open = [&] {
         if (dead_.load()) return true;
         it = streams_.find(static_cast<uint32_t>(stream_id));
         if (it == streams_.end() || it->second->closed) return true;
         return remaining == 0 ||
                (conn_send_window_ > 0 && it->second->send_window > 0);
-      });
+      };
+      if (timeout_us > 0) {
+        if (!window_cv_.wait_until(lk, deadline, window_open)) {
+          return false;  // flow-control stall past the caller's deadline
+        }
+      } else {
+        window_cv_.wait(lk, window_open);
+      }
       if (dead_.load()) return false;
       it = streams_.find(static_cast<uint32_t>(stream_id));
       if (it == streams_.end() || it->second->closed) return false;

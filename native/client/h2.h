@@ -57,9 +57,9 @@ class Connection {
 
   // Sends DATA on an open stream, chunked to the peer's max frame size and
   // blocking on send flow control. Returns false if the stream/connection
-  // died first.
+  // died first, or if timeout_us > 0 elapsed while blocked on flow control.
   bool SendData(int32_t stream_id, const void* data, size_t len,
-                bool end_stream);
+                bool end_stream, int64_t timeout_us = 0);
 
   void ResetStream(int32_t stream_id, uint32_t error_code);
 

@@ -145,6 +145,7 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
     } else if (arg == "-u" || arg == "--url") {
       CTPU_RETURN_IF_ERROR(need(i));
       params->url = next();
+      params->url_set = true;
     } else if (arg == "-i" || arg == "--protocol") {
       CTPU_RETURN_IF_ERROR(need(i));
       params->protocol = next();
@@ -254,13 +255,11 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
   if (params->model_name.empty()) {
     return Error("-m <model> is required");
   }
-  if (params->protocol != "http") {
-    return Error("this build supports -i http (native gRPC client uses the "
-                 "Python harness: perf-analyzer-tpu -i grpc)");
+  if (params->protocol != "http" && params->protocol != "grpc") {
+    return Error("-i must be http or grpc, got '" + params->protocol + "'");
   }
-  if (params->streaming) {
-    return Error("--streaming needs the gRPC decoupled path; use the Python "
-                 "harness: perf-analyzer-tpu -i grpc --streaming");
+  if (params->streaming && params->protocol != "grpc") {
+    return Error("--streaming requires -i grpc (decoupled bidi stream)");
   }
   int modes = (params->has_concurrency_range ? 1 : 0) +
               (params->has_request_rate_range ? 1 : 0) +

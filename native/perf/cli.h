@@ -15,6 +15,7 @@ struct PAParams {
   std::string model_name;
   std::string model_version;
   std::string url = "localhost:8000";
+  bool url_set = false;  // true when -u was passed (default swaps per proto)
   std::string protocol = "http";
   int64_t batch_size = 1;
 

@@ -1,5 +1,6 @@
 #include "client_backend.h"
 
+#include "grpc_backend.h"
 #include "http_backend.h"
 #include "mock_backend.h"
 
@@ -11,6 +12,9 @@ Error CreateClientBackend(const BackendFactoryConfig& config,
   switch (config.kind) {
     case BackendKind::KSERVE_HTTP:
       return HttpClientBackend::Create(config.url, config.verbose, backend);
+    case BackendKind::KSERVE_GRPC:
+      return GrpcClientBackend::Create(config.url, config.verbose,
+                                       config.streaming, backend);
     case BackendKind::MOCK:
       backend->reset(new MockClientBackend());
       return Error::Success();

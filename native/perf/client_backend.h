@@ -24,7 +24,7 @@
 namespace ctpu {
 namespace perf {
 
-enum class BackendKind { KSERVE_HTTP, MOCK };
+enum class BackendKind { KSERVE_HTTP, KSERVE_GRPC, MOCK };
 
 // One worker's issuing handle; not thread-safe (one context per thread).
 class BackendContext {
@@ -82,6 +82,8 @@ struct BackendFactoryConfig {
   BackendKind kind = BackendKind::KSERVE_HTTP;
   std::string url = "localhost:8000";
   bool verbose = false;
+  // gRPC only: drive requests over one decoupled bidi stream per context.
+  bool streaming = false;
 };
 
 // reference ClientBackendFactory::Create (client_backend.h:292)

@@ -1,0 +1,234 @@
+#include "grpc_backend.h"
+
+namespace ctpu {
+namespace perf {
+
+namespace {
+
+json::Value TensorsToJson(
+    const google::protobuf::RepeatedPtrField<
+        inference::ModelMetadataResponse::TensorMetadata>& tensors) {
+  json::Array arr;
+  for (const auto& t : tensors) {
+    json::Object obj;
+    obj["name"] = t.name();
+    obj["datatype"] = t.datatype();
+    json::Array shape;
+    for (int64_t d : t.shape()) shape.push_back(json::Value(d));
+    obj["shape"] = json::Value(std::move(shape));
+    arr.push_back(json::Value(std::move(obj)));
+  }
+  return json::Value(std::move(arr));
+}
+
+}  // namespace
+
+Error GrpcClientBackend::Create(const std::string& url, bool verbose,
+                                bool streaming,
+                                std::shared_ptr<ClientBackend>* backend) {
+  auto* b = new GrpcClientBackend(url, streaming);
+  Error err = InferenceServerGrpcClient::Create(&b->client_, url, verbose);
+  if (!err.IsOk()) {
+    delete b;
+    return err;
+  }
+  backend->reset(b);
+  return Error::Success();
+}
+
+Error GrpcClientBackend::ModelMetadata(json::Value* metadata,
+                                       const std::string& model_name,
+                                       const std::string& model_version) {
+  inference::ModelMetadataResponse resp;
+  CTPU_RETURN_IF_ERROR(
+      client_->ModelMetadata(&resp, model_name, model_version));
+  json::Object obj;
+  obj["name"] = resp.name();
+  obj["platform"] = resp.platform();
+  obj["inputs"] = TensorsToJson(resp.inputs());
+  obj["outputs"] = TensorsToJson(resp.outputs());
+  *metadata = json::Value(std::move(obj));
+  return Error::Success();
+}
+
+Error GrpcClientBackend::ModelConfig(json::Value* config,
+                                     const std::string& model_name,
+                                     const std::string& model_version) {
+  inference::ModelConfigResponse resp;
+  CTPU_RETURN_IF_ERROR(client_->ModelConfig(&resp, model_name, model_version));
+  const inference::ModelConfig& mc = resp.config();
+  json::Object obj;
+  obj["name"] = mc.name();
+  obj["max_batch_size"] = json::Value(int64_t{mc.max_batch_size()});
+  if (mc.has_sequence_batching()) obj["sequence_batching"] = json::Object{};
+  if (mc.has_dynamic_batching()) obj["dynamic_batching"] = json::Object{};
+  if (mc.has_ensemble_scheduling()) {
+    obj["ensemble_scheduling"] = json::Object{};
+  }
+  if (mc.has_model_transaction_policy()) {
+    json::Object policy;
+    policy["decoupled"] = mc.model_transaction_policy().decoupled();
+    decoupled_ = mc.model_transaction_policy().decoupled();
+    obj["model_transaction_policy"] = json::Value(std::move(policy));
+  }
+  *config = json::Value(std::move(obj));
+  return Error::Success();
+}
+
+Error GrpcClientBackend::InferenceStatistics(
+    std::map<std::string, std::pair<uint64_t, uint64_t>>* stats,
+    const std::string& model_name) {
+  inference::ModelStatisticsResponse resp;
+  CTPU_RETURN_IF_ERROR(client_->ModelInferenceStatistics(&resp, model_name));
+  stats->clear();
+  for (const auto& ms : resp.model_stats()) {
+    if (ms.name() != model_name) continue;
+    const auto& is = ms.inference_stats();
+    (*stats)["success"] = {is.success().count(), is.success().ns()};
+    (*stats)["fail"] = {is.fail().count(), is.fail().ns()};
+    (*stats)["queue"] = {is.queue().count(), is.queue().ns()};
+    (*stats)["compute_input"] = {is.compute_input().count(),
+                                 is.compute_input().ns()};
+    (*stats)["compute_infer"] = {is.compute_infer().count(),
+                                 is.compute_infer().ns()};
+    (*stats)["compute_output"] = {is.compute_output().count(),
+                                  is.compute_output().ns()};
+  }
+  return Error::Success();
+}
+
+// ---------------------------------------------------------------------------
+// GrpcBackendContext
+// ---------------------------------------------------------------------------
+
+GrpcBackendContext::~GrpcBackendContext() {
+  if (client_ && stream_started_) client_->StopStream();
+}
+
+Error GrpcBackendContext::EnsureClient() {
+  if (client_) return Error::Success();
+  CTPU_RETURN_IF_ERROR(
+      InferenceServerGrpcClient::Create(&client_, url_, false));
+  if (streaming_) {
+    // One response-timestamping callback serves every request this context
+    // issues (requests are sequential per context).
+    CTPU_RETURN_IF_ERROR(client_->StartStream(
+        [this](InferResult* raw) {
+          std::unique_ptr<InferResult> result(raw);
+          const uint64_t now = RequestTimers::Now();
+          std::lock_guard<std::mutex> lk(mu_);
+          Error status = result->RequestStatus();
+          if (!status.IsOk()) {
+            stream_error_ = status;
+            request_done_ = true;
+            cv_.notify_all();
+            return;
+          }
+          auto* grpc_result = static_cast<InferResultGrpc*>(result.get());
+          if (grpc_result->Response().id() != expected_id_) {
+            return;  // late response from a timed-out request — drop
+          }
+          response_ns_.push_back(now);
+          bool final = !decoupled_;  // 1:1 without decoupling
+          const auto& params = grpc_result->Response().parameters();
+          auto it = params.find("triton_final_response");
+          if (it != params.end() && it->second.bool_param()) final = true;
+          if (final) {
+            request_done_ = true;
+            cv_.notify_all();
+          }
+        },
+        /*enable_stats=*/false));
+    stream_started_ = true;
+  }
+  return Error::Success();
+}
+
+Error GrpcBackendContext::InferStreaming(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    RequestRecord* record) {
+  // Tag this request with a context-unique id so the shared stream callback
+  // can drop stragglers from timed-out predecessors.
+  InferOptions tagged = options;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    response_ns_.clear();
+    request_done_ = false;
+    stream_error_ = Error::Success();
+    expected_id_ = "ctpu-" + std::to_string(++request_seq_);
+    tagged.request_id = expected_id_;
+  }
+  record->start_ns = RequestTimers::Now();
+  Error err = client_->AsyncStreamInfer(tagged, inputs, outputs);
+  if (!err.IsOk()) {
+    record->success = false;
+    record->error = err.Message();
+    record->end_ns = RequestTimers::Now();
+    // The stream (or its connection) is gone; drop the client so the next
+    // request re-establishes instead of failing the rest of the run.
+    client_.reset();
+    stream_started_ = false;
+    return err;
+  }
+  std::unique_lock<std::mutex> lk(mu_);
+  const auto deadline =
+      options.client_timeout_us > 0
+          ? std::chrono::steady_clock::now() +
+                std::chrono::microseconds(options.client_timeout_us)
+          : std::chrono::steady_clock::now() + std::chrono::minutes(10);
+  if (!cv_.wait_until(lk, deadline, [&] { return request_done_; })) {
+    record->success = false;
+    record->error = "stream request timed out";
+    record->end_ns = RequestTimers::Now();
+    return Error(record->error);
+  }
+  record->response_ns = response_ns_;
+  record->end_ns =
+      response_ns_.empty() ? RequestTimers::Now() : response_ns_.back();
+  if (!stream_error_.IsOk()) {
+    record->success = false;
+    record->error = stream_error_.Message();
+    return stream_error_;
+  }
+  record->success = true;
+  return Error::Success();
+}
+
+Error GrpcBackendContext::Infer(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    RequestRecord* record) {
+  Error err = EnsureClient();
+  if (!err.IsOk()) {
+    record->success = false;
+    record->error = err.Message();
+    record->start_ns = record->end_ns = RequestTimers::Now();
+    return err;
+  }
+  if (streaming_) {
+    return InferStreaming(options, inputs, outputs, record);
+  }
+  record->start_ns = RequestTimers::Now();
+  InferResult* raw = nullptr;
+  err = client_->Infer(&raw, options, inputs, outputs);
+  record->end_ns = RequestTimers::Now();
+  record->response_ns.push_back(record->end_ns);
+  if (!err.IsOk()) {
+    record->success = false;
+    record->error = err.Message();
+    return err;
+  }
+  std::unique_ptr<InferResult> result(raw);
+  Error status = result->RequestStatus();
+  if (!status.IsOk()) {
+    record->success = false;
+    record->error = status.Message();
+    return status;
+  }
+  record->success = true;
+  return Error::Success();
+}
+
+}  // namespace perf
+}  // namespace ctpu

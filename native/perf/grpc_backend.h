@@ -1,0 +1,94 @@
+// KServe v2 gRPC backend for the perf harness: wraps the native gRPC
+// client (role of the reference triton backend's gRPC protocol path,
+// reference client_backend/triton/triton_client_backend.h:72-205), including
+// decoupled streaming where one request yields N timestamped responses
+// (reference infer_context.h:121,140).
+#pragma once
+
+#include <condition_variable>
+#include <mutex>
+
+#include "client_backend.h"
+#include "grpc_client.h"
+
+namespace ctpu {
+namespace perf {
+
+class GrpcBackendContext : public BackendContext {
+ public:
+  // streaming: drive requests over one ModelStreamInfer bidi stream.
+  // decoupled: a request is complete at the triton_final_response marker
+  // (otherwise responses map 1:1 to requests).
+  GrpcBackendContext(std::string url, bool streaming, bool decoupled)
+      : url_(std::move(url)), streaming_(streaming), decoupled_(decoupled) {}
+  ~GrpcBackendContext() override;
+
+  Error Infer(const InferOptions& options,
+              const std::vector<InferInput*>& inputs,
+              const std::vector<const InferRequestedOutput*>& outputs,
+              RequestRecord* record) override;
+
+ private:
+  Error EnsureClient();
+  Error InferStreaming(const InferOptions& options,
+                       const std::vector<InferInput*>& inputs,
+                       const std::vector<const InferRequestedOutput*>& outputs,
+                       RequestRecord* record);
+
+  std::string url_;
+  bool streaming_;
+  bool decoupled_;
+  std::unique_ptr<InferenceServerGrpcClient> client_;
+  bool stream_started_ = false;
+
+  // In-flight stream request state (one outstanding request per context;
+  // contexts are single-threaded by contract). Responses are correlated by
+  // echoed request id so a late response from a timed-out request cannot be
+  // attributed to the next one.
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::vector<uint64_t> response_ns_;
+  bool request_done_ = false;
+  Error stream_error_ = Error::Success();
+  uint64_t request_seq_ = 0;
+  std::string expected_id_;
+};
+
+class GrpcClientBackend : public ClientBackend {
+ public:
+  static Error Create(const std::string& url, bool verbose, bool streaming,
+                      std::shared_ptr<ClientBackend>* backend);
+
+  BackendKind Kind() const override { return BackendKind::KSERVE_GRPC; }
+  Error ModelMetadata(json::Value* metadata, const std::string& model_name,
+                      const std::string& model_version) override;
+  Error ModelConfig(json::Value* config, const std::string& model_name,
+                    const std::string& model_version) override;
+  Error InferenceStatistics(
+      std::map<std::string, std::pair<uint64_t, uint64_t>>* stats,
+      const std::string& model_name) override;
+  std::unique_ptr<BackendContext> CreateContext() override {
+    return std::unique_ptr<BackendContext>(
+        new GrpcBackendContext(url_, streaming_, decoupled_));
+  }
+  Error RegisterSystemSharedMemory(const std::string& name,
+                                   const std::string& key,
+                                   size_t byte_size) override {
+    return client_->RegisterSystemSharedMemory(name, key, byte_size);
+  }
+  Error UnregisterSystemSharedMemory(const std::string& name) override {
+    return client_->UnregisterSystemSharedMemory(name);
+  }
+
+ private:
+  GrpcClientBackend(std::string url, bool streaming)
+      : url_(std::move(url)), streaming_(streaming) {}
+
+  std::string url_;
+  bool streaming_;
+  bool decoupled_ = false;  // learned from ModelConfig
+  std::unique_ptr<InferenceServerGrpcClient> client_;
+};
+
+}  // namespace perf
+}  // namespace ctpu

@@ -57,6 +57,11 @@ int main(int argc, char** argv) {
   BackendFactoryConfig backend_config;
   backend_config.url = params.url;
   backend_config.verbose = params.verbose;
+  backend_config.streaming = params.streaming;
+  if (params.protocol == "grpc") {
+    backend_config.kind = BackendKind::KSERVE_GRPC;
+    if (!params.url_set) backend_config.url = "localhost:8001";
+  }
   std::shared_ptr<ClientBackend> backend;
   err = CreateClientBackend(backend_config, &backend);
   if (!err.IsOk()) return fail(err, "create backend");

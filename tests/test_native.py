@@ -137,3 +137,44 @@ def test_cpp_grpc_client_error_mapping(native_build, live_grpc_server):
     )
     assert out.returncode != 0
     assert "gRPC status" in (out.stdout + out.stderr)
+
+
+def test_cpp_perf_analyzer_grpc(native_build, live_grpc_server):
+    out = subprocess.run(
+        [os.path.join(native_build, "perf_analyzer"),
+         "-m", "simple", "-u", live_grpc_server.grpc_url, "-i", "grpc",
+         "--concurrency-range", "2",
+         "--measurement-interval", "500",
+         "--stability-percentage", "60",
+         "--max-trials", "3",
+         "--json-summary"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    summary = json.loads(
+        [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+    )
+    assert summary["errors"] == 0
+    assert summary["throughput"] > 0
+
+
+def test_cpp_perf_analyzer_grpc_streaming_decoupled(native_build,
+                                                    live_grpc_server):
+    """Decoupled bidi streaming: one request -> N timestamped responses."""
+    out = subprocess.run(
+        [os.path.join(native_build, "perf_analyzer"),
+         "-m", "repeat_int32", "-u", live_grpc_server.grpc_url, "-i", "grpc",
+         "--streaming", "--shape", "IN:4",
+         "--concurrency-range", "2",
+         "--measurement-interval", "500",
+         "--stability-percentage", "60",
+         "--max-trials", "3",
+         "--json-summary"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    summary = json.loads(
+        [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+    )
+    assert summary["errors"] == 0
+    assert summary["throughput"] > 0
