@@ -118,6 +118,7 @@ class HttpServer:
         p("/v2/models/{model}/trace/setting", _guarded(self.handle_update_trace))
         g("/v2/logging", _guarded(self.handle_get_logging))
         p("/v2/logging", _guarded(self.handle_update_logging))
+        g("/metrics", _guarded(self.handle_metrics))
 
     # -- health / metadata ---------------------------------------------------
 
@@ -183,6 +184,70 @@ class HttpServer:
                 request.match_info.get("model", ""),
                 request.match_info.get("version", ""),
             )
+        )
+
+    async def handle_metrics(self, request):
+        """Prometheus text metrics: per-model inference counters plus TPU
+        device memory gauges (the TPU replacement for the reference's
+        nv_gpu_* metrics scraped by perf_analyzer's MetricsManager,
+        reference metrics_manager.h:45-92, metrics.h:37-42)."""
+        def esc(label: str) -> str:
+            # Prometheus exposition format label-value escaping.
+            return (
+                label.replace("\\", "\\\\")
+                .replace('"', '\\"')
+                .replace("\n", "\\n")
+            )
+
+        lines = [
+            "# HELP tpu_inference_count Successful inference requests.",
+            "# TYPE tpu_inference_count counter",
+        ]
+        for ms in self.core.statistics()["model_stats"]:
+            model = esc(ms["name"])
+            stats = ms["inference_stats"]
+            lines.append(
+                f'tpu_inference_count{{model="{model}"}} '
+                f'{stats["success"]["count"]}'
+            )
+            lines.append(
+                f'tpu_inference_duration_ns{{model="{model}"}} '
+                f'{stats["success"]["ns"]}'
+            )
+            lines.append(
+                f'tpu_inference_fail_count{{model="{model}"}} '
+                f'{stats["fail"]["count"]}'
+            )
+        lines.append("# TYPE tpu_memory_used_bytes gauge")
+        try:
+            import jax
+
+            for i, device in enumerate(jax.local_devices()):
+                try:
+                    mstats = device.memory_stats() or {}
+                except Exception:
+                    mstats = {}
+                used = mstats.get("bytes_in_use")
+                limit = mstats.get("bytes_limit") or mstats.get(
+                    "bytes_reservable_limit"
+                )
+                if used is not None:
+                    lines.append(
+                        f'tpu_memory_used_bytes{{device="{i}"}} {used}'
+                    )
+                if limit:
+                    lines.append(
+                        f'tpu_memory_limit_bytes{{device="{i}"}} {limit}'
+                    )
+                    if used is not None:
+                        lines.append(
+                            f'tpu_memory_utilization{{device="{i}"}} '
+                            f"{used / limit:.6f}"
+                        )
+        except Exception:
+            pass
+        return web.Response(
+            text="\n".join(lines) + "\n", content_type="text/plain"
         )
 
     # -- shared memory -------------------------------------------------------

@@ -364,6 +364,10 @@ Error InferenceServerGrpcClient::Call(const std::string& method,
   const int32_t sid =
       conn->StartStream(BuildHeaders(method, headers, timeout_us), false, ev);
   if (sid < 0) return Error("gRPC stream open failed (connection lost)");
+  // One deadline covers send (flow-control stalls) AND the response wait.
+  const auto deadline = std::chrono::steady_clock::now() +
+                        std::chrono::microseconds(timeout_us);
+  bool send_stalled = false;
   const std::string body = FrameMessage(req);
   if (!conn->SendData(sid, body.data(), body.size(), true,
                       static_cast<int64_t>(timeout_us))) {
@@ -371,17 +375,22 @@ Error InferenceServerGrpcClient::Call(const std::string& method,
     // connection teardown) — wait below rather than double-report. A
     // flow-control stall past the deadline resets the stream first.
     if (timeout_us > 0) {
+      send_stalled = true;
       conn->ResetStream(sid, 0x8 /* CANCEL */);
     }
   }
 
   std::unique_lock<std::mutex> lk(st->mu);
   if (timeout_us > 0) {
-    if (!st->cv.wait_for(lk, std::chrono::microseconds(timeout_us),
-                         [&] { return st->done; })) {
+    if (!st->cv.wait_until(lk, deadline, [&] { return st->done; }) &&
+        !st->done) {
       lk.unlock();
       conn->ResetStream(sid, 0x8 /* CANCEL */);
       return Error("gRPC call '" + method + "' timed out");
+    }
+    if (send_stalled) {
+      // on_close carries "stream reset by client" — report the real cause.
+      return Error("gRPC call '" + method + "' timed out (flow control)");
     }
   } else {
     st->cv.wait(lk, [&] { return st->done; });

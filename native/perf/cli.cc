@@ -121,6 +121,9 @@ std::string Usage() {
       "  -f FILE                     CSV report path\n"
       "  --profile-export-file FILE  per-request JSON export\n"
       "  --json-summary              print one-line JSON summary\n"
+      "  --collect-metrics           poll server Prometheus metrics\n"
+      "  --metrics-url HOST:PORT/P   metrics endpoint (default <url>/metrics)\n"
+      "  --metrics-interval MS       poll interval (default 1000)\n"
       "  -v/--verbose                verbose output\n";
 }
 
@@ -241,6 +244,14 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
       params->profile_export_file = next();
     } else if (arg == "--json-summary") {
       params->json_summary = true;
+    } else if (arg == "--collect-metrics") {
+      params->collect_metrics = true;
+    } else if (arg == "--metrics-url") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->metrics_url = next();
+    } else if (arg == "--metrics-interval") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->metrics_interval_ms = std::stod(next());
     } else if (arg == "-v" || arg == "--verbose") {
       params->verbose = true;
     } else if (arg == "-h" || arg == "--help") {

@@ -53,6 +53,9 @@ struct PAParams {
   std::string csv_file;
   std::string profile_export_file;
   bool json_summary = false;
+  bool collect_metrics = false;
+  std::string metrics_url;  // "host:port/path"; empty = derive from url
+  double metrics_interval_ms = 1000.0;
   bool verbose = false;
 };
 

@@ -117,16 +117,20 @@ Error GrpcBackendContext::EnsureClient() {
           std::unique_ptr<InferResult> result(raw);
           const uint64_t now = RequestTimers::Now();
           std::lock_guard<std::mutex> lk(mu_);
+          auto* grpc_result = static_cast<InferResultGrpc*>(result.get());
+          // Correlate by echoed id BEFORE error handling so a late (error)
+          // response from a timed-out request can't fail the current one.
+          // Responses without an id (transport failures) match any request.
+          const std::string& rid = grpc_result->Response().id();
+          if (!rid.empty() && rid != expected_id_) {
+            return;  // straggler from a timed-out request — drop
+          }
           Error status = result->RequestStatus();
           if (!status.IsOk()) {
             stream_error_ = status;
             request_done_ = true;
             cv_.notify_all();
             return;
-          }
-          auto* grpc_result = static_cast<InferResultGrpc*>(result.get());
-          if (grpc_result->Response().id() != expected_id_) {
-            return;  // late response from a timed-out request — drop
           }
           response_ns_.push_back(now);
           bool final = !decoupled_;  // 1:1 without decoupling

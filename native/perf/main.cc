@@ -11,6 +11,7 @@
 #include "data_loader.h"
 #include "infer_data.h"
 #include "load_manager.h"
+#include "metrics_manager.h"
 #include "model_parser.h"
 #include "profiler.h"
 #include "report.h"
@@ -127,6 +128,31 @@ int main(int argc, char** argv) {
                 parser.Inputs().size());
   }
 
+  std::unique_ptr<MetricsManager> metrics;
+  if (params.collect_metrics) {
+    // Default endpoint: same host:port as -u, path /metrics. The gRPC port
+    // doesn't serve HTTP — default to the conventional HTTP port there.
+    std::string default_url = backend_config.url;
+    if (params.protocol == "grpc") {
+      const size_t colon = default_url.rfind(':');
+      if (colon != std::string::npos) default_url.resize(colon);
+      default_url += ":8000";
+    }
+    std::string murl = params.metrics_url.empty()
+                           ? default_url + "/metrics"
+                           : params.metrics_url;
+    const size_t slash = murl.find('/');
+    std::string mpath = "/metrics";
+    if (slash != std::string::npos) {
+      mpath = murl.substr(slash);
+      murl = murl.substr(0, slash);
+    }
+    metrics.reset(new MetricsManager(murl, mpath,
+                                     params.metrics_interval_ms / 1000.0));
+    err = metrics->Start();
+    if (!err.IsOk()) return fail(err, "start metrics collection");
+  }
+
   std::vector<ProfileExperiment> experiments;
   if (params.has_periodic_range) {
     PeriodicConcurrencyManager manager(
@@ -200,6 +226,8 @@ int main(int argc, char** argv) {
     experiments = profiler.Experiments();
   }
 
+  if (metrics) metrics->StopThread();
+
   if (experiments.empty()) {
     std::cerr << "error: no measurements taken" << std::endl;
     return 1;
@@ -216,6 +244,17 @@ int main(int argc, char** argv) {
     std::fputs(DetailedReport(e).c_str(), stdout);
   }
   std::printf("\n%s", ConsoleReport(experiments).c_str());
+
+  if (metrics) {
+    auto summary = metrics->Summary();
+    if (!summary.empty()) {
+      std::printf("\nServer metrics (min / avg / max over run):\n");
+      for (const auto& kv : summary) {
+        std::printf("  %-48s %.6g / %.6g / %.6g\n", kv.first.c_str(),
+                    kv.second.min, kv.second.avg, kv.second.max);
+      }
+    }
+  }
 
   if (!params.csv_file.empty()) {
     err = WriteCsv(experiments, params.csv_file);

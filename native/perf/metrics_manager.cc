@@ -1,0 +1,117 @@
+#include "metrics_manager.h"
+
+#include <algorithm>
+#include <cstdlib>
+
+#include "http_client.h"
+
+namespace ctpu {
+namespace perf {
+
+std::map<std::string, double> MetricsManager::ParsePrometheus(
+    const std::string& body) {
+  std::map<std::string, double> out;
+  size_t pos = 0;
+  while (pos < body.size()) {
+    size_t eol = body.find('\n', pos);
+    if (eol == std::string::npos) eol = body.size();
+    const std::string line = body.substr(pos, eol - pos);
+    pos = eol + 1;
+    if (line.empty() || line[0] == '#') continue;
+    // "name{labels} value [timestamp]" or "name value [timestamp]".
+    // The key ends at the first space after the (optional) label block.
+    size_t key_end;
+    const size_t brace = line.find('{');
+    if (brace != std::string::npos) {
+      const size_t close = line.find('}', brace);
+      if (close == std::string::npos) continue;
+      key_end = close + 1;
+    } else {
+      key_end = line.find(' ');
+      if (key_end == std::string::npos) continue;
+    }
+    size_t val_start = line.find_first_not_of(' ', key_end);
+    if (val_start == std::string::npos) continue;
+    size_t val_end = line.find(' ', val_start);
+    if (val_end == std::string::npos) val_end = line.size();
+    char* end = nullptr;
+    const std::string val_str = line.substr(val_start, val_end - val_start);
+    const double value = strtod(val_str.c_str(), &end);
+    if (end == val_str.c_str()) continue;
+    out[line.substr(0, key_end)] = value;
+  }
+  return out;
+}
+
+Error MetricsManager::Scrape(std::map<std::string, double>* out) {
+  if (!conn_) {
+    const size_t colon = url_.rfind(':');
+    if (colon == std::string::npos) {
+      return Error("metrics url must be host:port, got '" + url_ + "'");
+    }
+    conn_.reset(new HttpConnection(url_.substr(0, colon),
+                                   std::atoi(url_.c_str() + colon + 1)));
+  }
+  int status = 0;
+  std::string headers, body;
+  // Roundtrip prepends the leading '/'. The 2s timeout sets socket
+  // send/recv timeouts at connect (DialTcp), bounding a stalled endpoint.
+  const std::string uri =
+      path_.size() > 1 && path_[0] == '/' ? path_.substr(1) : path_;
+  CTPU_RETURN_IF_ERROR(conn_->Roundtrip("GET", uri, {}, nullptr, 0, &status,
+                                        &headers, &body, 2000000));
+  if (status != 200) {
+    return Error("metrics endpoint returned HTTP " + std::to_string(status));
+  }
+  *out = ParsePrometheus(body);
+  return Error::Success();
+}
+
+Error MetricsManager::Start() {
+  std::map<std::string, double> probe;
+  CTPU_RETURN_IF_ERROR(Scrape(&probe));
+  stop_.store(false);
+  thread_ = std::thread([this] { Loop(); });
+  return Error::Success();
+}
+
+void MetricsManager::Loop() {
+  while (!stop_.load()) {
+    std::map<std::string, double> sample;
+    if (Scrape(&sample).IsOk()) {
+      std::lock_guard<std::mutex> lk(mu_);
+      for (const auto& kv : sample) {
+        MetricSummary& s = summary_[kv.first];
+        if (s.samples == 0) {
+          s.min = s.max = kv.second;
+        } else {
+          s.min = std::min(s.min, kv.second);
+          s.max = std::max(s.max, kv.second);
+        }
+        s.avg = (s.avg * s.samples + kv.second) / (s.samples + 1);
+        s.last = kv.second;
+        s.samples++;
+      }
+    }
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait_for(lk, std::chrono::duration<double>(interval_s_),
+                 [&] { return stop_.load(); });
+  }
+}
+
+void MetricsManager::StopThread() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    stop_.store(true);
+    cv_.notify_all();
+  }
+  if (thread_.joinable()) thread_.join();
+}
+
+std::map<std::string, MetricSummary> MetricsManager::Summary() {
+  std::lock_guard<std::mutex> lk(mu_);
+  return summary_;
+}
+
+}  // namespace perf
+}  // namespace ctpu

@@ -1,0 +1,71 @@
+// Background server-metrics poller.
+//
+// Role parity with the reference's MetricsManager
+// (reference src/c++/perf_analyzer/metrics_manager.h:45-92): a thread
+// scrapes the server's Prometheus text endpoint on an interval during
+// profiling; per-metric min/avg/max are reported with the results. The
+// reference collects nv_gpu_* gauges — this build scrapes the TPU server's
+// tpu_* metrics (duty-cycle proxy, HBM used/limit) but parses any
+// Prometheus text exposition, so third-party endpoints work too.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+
+#include "common.h"
+#include "http_client.h"
+
+namespace ctpu {
+namespace perf {
+
+struct MetricSummary {
+  double min = 0.0;
+  double max = 0.0;
+  double avg = 0.0;
+  double last = 0.0;
+  size_t samples = 0;
+};
+
+class MetricsManager {
+ public:
+  // url: "host:port", path: e.g. "/metrics".
+  MetricsManager(std::string url, std::string path, double interval_s)
+      : url_(std::move(url)), path_(std::move(path)),
+        interval_s_(interval_s) {}
+  ~MetricsManager() { StopThread(); }
+
+  // Verifies the endpoint responds, then starts the polling thread.
+  Error Start();
+  void StopThread();
+
+  // Aggregates over all samples since Start(). Key is the full metric line
+  // key incl. labels (e.g. tpu_memory_used_bytes{device="0"}).
+  std::map<std::string, MetricSummary> Summary();
+
+  // Parses one Prometheus text document into key->value (exposed for tests).
+  static std::map<std::string, double> ParsePrometheus(
+      const std::string& body);
+
+ private:
+  Error Scrape(std::map<std::string, double>* out);
+  void Loop();
+
+  std::string url_;
+  std::string path_;
+  double interval_s_;
+  // One keep-alive connection for all scrapes (Start() probes, then only
+  // the poller thread uses it).
+  std::unique_ptr<HttpConnection> conn_;
+  std::thread thread_;
+  std::atomic<bool> stop_{false};
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, MetricSummary> summary_;
+};
+
+}  // namespace perf
+}  // namespace ctpu

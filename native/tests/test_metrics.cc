@@ -1,0 +1,31 @@
+// MetricsManager Prometheus-text parsing tests.
+#include "metrics_manager.h"
+#include "test_framework.h"
+
+namespace {
+
+using ctpu::perf::MetricsManager;
+
+TEST_CASE("metrics: prometheus text parsing") {
+  const std::string body =
+      "# HELP tpu_inference_count Successful inference requests.\n"
+      "# TYPE tpu_inference_count counter\n"
+      "tpu_inference_count{model=\"simple\"} 42\n"
+      "tpu_memory_used_bytes{device=\"0\"} 1048576\n"
+      "tpu_memory_utilization{device=\"0\"} 0.125\n"
+      "plain_metric 7\n"
+      "with_timestamp 3.5 1700000000\n"
+      "malformed_line_no_value\n"
+      "bad_value{x=\"y\"} notanumber\n";
+  auto parsed = MetricsManager::ParsePrometheus(body);
+  CHECK_EQ(parsed.size(), 5u);
+  CHECK_NEAR(parsed["tpu_inference_count{model=\"simple\"}"], 42.0, 1e-9);
+  CHECK_NEAR(parsed["tpu_memory_used_bytes{device=\"0\"}"], 1048576.0, 1e-9);
+  CHECK_NEAR(parsed["tpu_memory_utilization{device=\"0\"}"], 0.125, 1e-9);
+  CHECK_NEAR(parsed["plain_metric"], 7.0, 1e-9);
+  CHECK_NEAR(parsed["with_timestamp"], 3.5, 1e-9);
+  CHECK(parsed.find("malformed_line_no_value") == parsed.end());
+  CHECK(parsed.find("bad_value{x=\"y\"}") == parsed.end());
+}
+
+}  // namespace

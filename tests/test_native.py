@@ -178,3 +178,20 @@ def test_cpp_perf_analyzer_grpc_streaming_decoupled(native_build,
     )
     assert summary["errors"] == 0
     assert summary["throughput"] > 0
+
+
+def test_cpp_perf_analyzer_collect_metrics(native_build, live_server):
+    """--collect-metrics scrapes the server's Prometheus endpoint."""
+    out = subprocess.run(
+        [os.path.join(native_build, "perf_analyzer"),
+         "-m", "simple", "-u", live_server.http_url,
+         "--collect-metrics", "--metrics-interval", "200",
+         "--concurrency-range", "2",
+         "--measurement-interval", "400",
+         "--stability-percentage", "60",
+         "--max-trials", "3"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "Server metrics" in out.stdout
+    assert 'tpu_inference_count{model="simple"}' in out.stdout
