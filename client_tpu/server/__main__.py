@@ -27,6 +27,11 @@ def main(argv=None) -> int:
         help="skip the built-in fixture models (simple, identity_*, repeat)",
     )
     parser.add_argument(
+        "--zoo-models",
+        action="store_true",
+        help="also register the model-zoo adapters (resnet, llm_decode)",
+    )
+    parser.add_argument(
         "--max-workers", type=int, default=8, help="model execution threads"
     )
     parser.add_argument(
@@ -52,6 +57,10 @@ def main(argv=None) -> int:
         from client_tpu.server.models import register_builtin_models
 
         register_builtin_models(repository)
+    if args.zoo_models:
+        from client_tpu.models.serving import register_zoo_models
+
+        register_zoo_models(repository)
     repository.scan()
 
     async def serve() -> None:

@@ -119,6 +119,10 @@ class HttpServer:
         g("/v2/logging", _guarded(self.handle_get_logging))
         p("/v2/logging", _guarded(self.handle_update_logging))
         g("/metrics", _guarded(self.handle_metrics))
+        # OpenAI-compatible front-end (chat/completions + SSE streaming).
+        from client_tpu.server.openai_frontend import OpenAiFrontend
+
+        OpenAiFrontend(self.core).add_routes(self.app, _guarded)
 
     # -- health / metadata ---------------------------------------------------
 

@@ -1,0 +1,192 @@
+"""OpenAI-compatible front-end routes (chat/completions with SSE streaming).
+
+The reference perf_analyzer ships an OpenAI client backend that benchmarks
+chat-completions endpoints with SSE token streaming (reference
+client_backend/openai/openai_client.h:132-167, http_client SSE handling).
+This module provides the server half in this stack so the same benchmark
+path is self-contained: requests are tokenized with the deterministic
+synthetic tokenizer, driven through a decoupled LLM decode model
+(INPUT_IDS -> OUTPUT_IDS, e.g. the JAX llama ``llm_decode`` model), and
+streamed back one SSE chunk per generated token.
+"""
+
+import json
+import time
+from typing import Any, Dict
+
+import numpy as np
+from aiohttp import web
+
+from client_tpu.genai_perf.tokenizer import SyntheticTokenizer
+from client_tpu.utils import InferenceServerException
+
+
+def _messages_to_prompt(body: Dict[str, Any]) -> str:
+    if "messages" in body:
+        return "\n".join(
+            str(m.get("content", "")) for m in body.get("messages", [])
+        )
+    return str(body.get("prompt", ""))
+
+
+class OpenAiFrontend:
+    def __init__(self, core, default_model: str = "llm_decode"):
+        self.core = core
+        self.default_model = default_model
+        self.tokenizer = SyntheticTokenizer()
+        self._counter = 0
+
+    def add_routes(self, app: web.Application, guard=None) -> None:
+        wrap = guard if guard is not None else (lambda h: h)
+        app.router.add_post("/v1/chat/completions", wrap(self.handle_chat))
+        app.router.add_post("/v1/completions", wrap(self.handle_chat))
+        app.router.add_get("/v1/models", wrap(self.handle_models))
+
+    async def handle_models(self, request: web.Request) -> web.Response:
+        models = [
+            {"id": entry["name"], "object": "model", "owned_by": "client_tpu"}
+            for entry in self.core.repository.index()
+        ]
+        return web.json_response({"object": "list", "data": models})
+
+    def _decode_stream(self, model_name: str, prompt_ids, max_tokens: int):
+        """Async iterator of generated token ids from the decoupled model."""
+        from client_tpu.server.core import CoreRequest, CoreTensor
+
+        request = CoreRequest(
+            model_name=model_name,
+            model_version="",
+            id="",
+            inputs=[
+                CoreTensor(
+                    name="INPUT_IDS",
+                    datatype="INT32",
+                    shape=[len(prompt_ids)],
+                    data=np.asarray(prompt_ids, dtype=np.int32),
+                )
+            ],
+            parameters={"max_tokens": max_tokens},
+        )
+        return self.core.infer_decoupled(request)
+
+    async def handle_chat(self, request: web.Request) -> web.Response:
+        is_chat = request.path.endswith("/chat/completions")
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response(
+                {"error": {"message": "invalid JSON body"}}, status=400
+            )
+        model_name = body.get("model") or self.default_model
+        prompt = _messages_to_prompt(body)
+        prompt_ids = self.tokenizer.encode(prompt) or [2]
+        max_tokens = int(body.get("max_tokens") or 16)
+        stream = bool(body.get("stream", False))
+        self._counter += 1
+        completion_id = f"chatcmpl-{self._counter}"
+        created = int(time.time())
+        object_name = (
+            "chat.completion.chunk" if (is_chat and stream)
+            else "chat.completion" if is_chat
+            else "text_completion"
+        )
+
+        def chunk(delta_text, finish):
+            choice: Dict[str, Any] = {"index": 0, "finish_reason": finish}
+            if is_chat:
+                choice["delta"] = (
+                    {"content": delta_text} if delta_text is not None else {}
+                )
+            else:
+                choice["text"] = delta_text or ""
+            return {
+                "id": completion_id,
+                "object": object_name,
+                "created": created,
+                "model": model_name,
+                "choices": [choice],
+            }
+
+        # Validate the model BEFORE any SSE headers go out: after
+        # resp.prepare() the 200 is committed and errors can only be
+        # delivered in-band.
+        try:
+            self.core.repository.get(model_name, "")
+        except InferenceServerException as e:
+            return web.json_response(
+                {"error": {"message": e.message()}}, status=404
+            )
+        try:
+            iterator = self._decode_stream(model_name, prompt_ids, max_tokens)
+            if stream:
+                resp = web.StreamResponse(
+                    headers={
+                        "Content-Type": "text/event-stream",
+                        "Cache-Control": "no-cache",
+                    }
+                )
+                await resp.prepare(request)
+                count = 0
+                try:
+                    async for core_response in iterator:
+                        ids = _output_ids(core_response)
+                        if ids is None:
+                            continue
+                        text = (
+                            " " if count else ""
+                        ) + self.tokenizer.decode(ids)
+                        count += len(ids)
+                        await resp.write(
+                            b"data: "
+                            + json.dumps(chunk(text, None)).encode()
+                            + b"\n\n"
+                        )
+                    await resp.write(
+                        b"data: " + json.dumps(chunk(None, "stop")).encode()
+                        + b"\n\n"
+                    )
+                except InferenceServerException as e:
+                    # Mid-stream failure: deliver the error in-band, then
+                    # terminate the stream cleanly.
+                    await resp.write(
+                        b"data: "
+                        + json.dumps(
+                            {"error": {"message": e.message()}}
+                        ).encode()
+                        + b"\n\n"
+                    )
+                await resp.write(b"data: [DONE]\n\n")
+                await resp.write_eof()
+                return resp
+            pieces = []
+            async for core_response in iterator:
+                ids = _output_ids(core_response)
+                if ids is not None:
+                    pieces.append(self.tokenizer.decode(ids))
+            text = " ".join(pieces)
+            doc = chunk(None, "stop")
+            if is_chat:
+                doc["choices"][0].pop("delta", None)
+                doc["choices"][0]["message"] = {
+                    "role": "assistant",
+                    "content": text,
+                }
+            else:
+                doc["choices"][0]["text"] = text
+            doc["usage"] = {
+                "prompt_tokens": len(prompt_ids),
+                "completion_tokens": len(pieces),
+                "total_tokens": len(prompt_ids) + len(pieces),
+            }
+            return web.json_response(doc)
+        except InferenceServerException as e:
+            return web.json_response(
+                {"error": {"message": e.message()}}, status=400
+            )
+
+
+def _output_ids(core_response):
+    for tensor in core_response.outputs:
+        if tensor.name in ("OUTPUT_IDS", "OUT"):
+            return np.asarray(tensor.data).reshape(-1).tolist()
+    return None

@@ -100,73 +100,128 @@ Error HttpConnection::FillBuffer() {
   return Error::Success();
 }
 
-Error HttpConnection::ReadResponse(int* status_out, std::string* headers_out,
-                                   std::string* body_out) {
-  // Read until end of headers.
-  size_t hdr_end;
-  while ((hdr_end = buf_.find("\r\n\r\n")) == std::string::npos) {
-    CTPU_RETURN_IF_ERROR(FillBuffer());
-  }
-  std::string head = buf_.substr(0, hdr_end + 2);
-  buf_.erase(0, hdr_end + 4);
+namespace {
 
-  // Status line: HTTP/1.1 200 OK
-  if (head.compare(0, 5, "HTTP/") != 0) {
+// Case-insensitive header lookup, anchored at line starts ("\r\nname:") so
+// e.g. Inference-Header-Content-Length can never false-match Content-Length.
+std::string FindHeader(const std::string& head, const char* name) {
+  std::string lower_head;
+  lower_head.reserve(head.size());
+  for (char c : head) lower_head += std::tolower((unsigned char)c);
+  std::string needle = std::string("\r\n") + name + ":";
+  size_t pos = lower_head.find(needle);
+  if (pos == std::string::npos) return "";
+  pos += needle.size();
+  size_t eol = head.find("\r\n", pos);
+  std::string val = head.substr(pos, eol - pos);
+  size_t b = val.find_first_not_of(" \t");
+  size_t e = val.find_last_not_of(" \t");
+  return b == std::string::npos ? "" : val.substr(b, e - b + 1);
+}
+
+}  // namespace
+
+Error HttpConnection::RoundtripStream(
+    const std::string& method, const std::string& uri,
+    const std::vector<std::string>& extra_headers, const char* body,
+    size_t body_size, int* status_out, std::string* resp_headers,
+    const std::function<void(const char*, size_t)>& on_data,
+    int64_t timeout_us) {
+  std::string head;
+  head.reserve(256 + uri.size());
+  head += method + " /" + uri + " HTTP/1.1\r\n";
+  head += "Host: " + host_ + ":" + std::to_string(port_) + "\r\n";
+  head += "Connection: keep-alive\r\n";
+  for (const auto& h : extra_headers) head += h + "\r\n";
+  if (body_size > 0 || method == "POST") {
+    head += "Content-Length: " + std::to_string(body_size) + "\r\n";
+  }
+  head += "\r\n";
+
+  // Send + read response headers, retrying once on a stale keep-alive
+  // connection (the failure then surfaces at first read, not just send).
+  std::string hdr;
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    if (!Connected()) {
+      CTPU_RETURN_IF_ERROR(Connect(timeout_us));
+    }
+    Error err = SendAll(head.data(), head.size());
+    if (err.IsOk() && body_size > 0) err = SendAll(body, body_size);
+    if (err.IsOk()) {
+      size_t hdr_end;
+      while ((hdr_end = buf_.find("\r\n\r\n")) == std::string::npos) {
+        err = FillBuffer();
+        if (!err.IsOk()) break;
+      }
+      if (err.IsOk()) {
+        hdr = buf_.substr(0, hdr_end + 2);
+        buf_.erase(0, hdr_end + 4);
+        break;
+      }
+    }
+    Close();
+    if (attempt == 1) return err;
+  }
+  if (hdr.compare(0, 5, "HTTP/") != 0) {
     return Error("malformed HTTP status line");
   }
-  size_t sp = head.find(' ');
-  *status_out = std::atoi(head.c_str() + sp + 1);
-  *headers_out = head;
+  *status_out = std::atoi(hdr.c_str() + hdr.find(' ') + 1);
+  *resp_headers = hdr;
 
-  // Locate framing headers (case-insensitive).
-  auto find_header = [&head](const char* name) -> std::string {
-    std::string lower_head;
-    lower_head.reserve(head.size());
-    for (char c : head) lower_head += std::tolower((unsigned char)c);
-    std::string needle = std::string("\r\n") + name + ":";
-    size_t pos = lower_head.find(needle);
-    if (pos == std::string::npos) return "";
-    pos += needle.size();
-    size_t eol = head.find("\r\n", pos);
-    std::string val = head.substr(pos, eol - pos);
-    size_t b = val.find_first_not_of(" \t");
-    size_t e = val.find_last_not_of(" \t");
-    return b == std::string::npos ? "" : val.substr(b, e - b + 1);
-  };
-
-  std::string te = find_header("transfer-encoding");
-  if (te.find("chunked") != std::string::npos) {
-    body_out->clear();
+  if (FindHeader(hdr, "transfer-encoding").find("chunked") !=
+      std::string::npos) {
     while (true) {
       size_t eol;
       while ((eol = buf_.find("\r\n")) == std::string::npos) {
         CTPU_RETURN_IF_ERROR(FillBuffer());
       }
-      size_t chunk_size = std::strtoul(buf_.c_str(), nullptr, 16);
+      const size_t chunk_size = std::strtoul(buf_.c_str(), nullptr, 16);
       buf_.erase(0, eol + 2);
       if (chunk_size == 0) {
-        // Trailer: consume to final CRLF.
         while (buf_.find("\r\n") == std::string::npos) {
           CTPU_RETURN_IF_ERROR(FillBuffer());
         }
         buf_.erase(0, buf_.find("\r\n") + 2);
         return Error::Success();
       }
+      // Whole chunks are delivered at once; servers emit one SSE event (or
+      // a small batch) per chunk, so this is the event arrival granularity.
       while (buf_.size() < chunk_size + 2) {
         CTPU_RETURN_IF_ERROR(FillBuffer());
       }
-      body_out->append(buf_, 0, chunk_size);
+      on_data(buf_.data(), chunk_size);
       buf_.erase(0, chunk_size + 2);
     }
   }
 
-  std::string cl = find_header("content-length");
-  size_t content_length = cl.empty() ? 0 : std::strtoul(cl.c_str(), nullptr, 10);
-  while (buf_.size() < content_length) {
-    CTPU_RETURN_IF_ERROR(FillBuffer());
+  const std::string cl = FindHeader(hdr, "content-length");
+  const size_t content_length =
+      cl.empty() ? std::string::npos : std::strtoul(cl.c_str(), nullptr, 10);
+  size_t delivered = 0;
+  while (content_length == std::string::npos || delivered < content_length) {
+    if (!buf_.empty()) {
+      size_t take = buf_.size();
+      if (content_length != std::string::npos) {
+        take = std::min(take, content_length - delivered);
+      }
+      on_data(buf_.data(), take);
+      delivered += take;
+      buf_.erase(0, take);
+      if (content_length != std::string::npos &&
+          delivered >= content_length) {
+        break;
+      }
+    }
+    Error fill = FillBuffer();
+    if (!fill.IsOk()) {
+      // EOF-delimited body (no framing headers): close ends the stream.
+      if (content_length == std::string::npos) {
+        Close();
+        return Error::Success();
+      }
+      return fill;
+    }
   }
-  body_out->assign(buf_, 0, content_length);
-  buf_.erase(0, content_length);
   return Error::Success();
 }
 
@@ -176,30 +231,13 @@ Error HttpConnection::Roundtrip(const std::string& method,
                                 const char* body, size_t body_size,
                                 int* status_out, std::string* resp_headers,
                                 std::string* resp_body, int64_t timeout_us) {
-  for (int attempt = 0; attempt < 2; ++attempt) {
-    if (!Connected()) {
-      CTPU_RETURN_IF_ERROR(Connect(timeout_us));
-    }
-    std::string head;
-    head.reserve(256 + uri.size());
-    head += method + " /" + uri + " HTTP/1.1\r\n";
-    head += "Host: " + host_ + ":" + std::to_string(port_) + "\r\n";
-    head += "Connection: keep-alive\r\n";
-    for (const auto& h : extra_headers) head += h + "\r\n";
-    if (body_size > 0 || method == "POST") {
-      head += "Content-Length: " + std::to_string(body_size) + "\r\n";
-    }
-    head += "\r\n";
-
-    Error err = SendAll(head.data(), head.size());
-    if (err.IsOk() && body_size > 0) err = SendAll(body, body_size);
-    if (err.IsOk()) err = ReadResponse(status_out, resp_headers, resp_body);
-    if (err.IsOk()) return err;
-    // Stale keep-alive connection: reconnect once and retry.
-    Close();
-    if (attempt == 1) return err;
-  }
-  return Error("unreachable");
+  resp_body->clear();
+  return RoundtripStream(
+      method, uri, extra_headers, body, body_size, status_out, resp_headers,
+      [resp_body](const char* data, size_t len) {
+        resp_body->append(data, len);
+      },
+      timeout_us);
 }
 
 // ---------------------------------------------------------------------------

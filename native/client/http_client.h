@@ -49,10 +49,20 @@ class HttpConnection {
                   std::string* resp_headers, std::string* resp_body,
                   int64_t timeout_us = 0);
 
+  // Like Roundtrip, but delivers body fragments to on_data as they arrive
+  // (per chunk for chunked transfer, per recv otherwise) — the transport
+  // for SSE token streams (role of the reference openai backend's
+  // curl-multi stream handling, reference openai/http_client.cc).
+  Error RoundtripStream(const std::string& method, const std::string& uri,
+                        const std::vector<std::string>& extra_headers,
+                        const char* body, size_t body_size, int* status_out,
+                        std::string* resp_headers,
+                        const std::function<void(const char*, size_t)>&
+                            on_data,
+                        int64_t timeout_us = 0);
+
  private:
   Error SendAll(const char* data, size_t size);
-  Error ReadResponse(int* status_out, std::string* headers_out,
-                     std::string* body_out);
   Error FillBuffer();  // read() into buf_
 
   std::string host_;

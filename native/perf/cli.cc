@@ -121,6 +121,9 @@ std::string Usage() {
       "  -f FILE                     CSV report path\n"
       "  --profile-export-file FILE  per-request JSON export\n"
       "  --json-summary              print one-line JSON summary\n"
+      "  --service-kind KIND         kserve (default) | openai\n"
+      "  --endpoint PATH             openai endpoint path "
+      "(default v1/chat/completions)\n"
       "  --collect-metrics           poll server Prometheus metrics\n"
       "  --metrics-url HOST:PORT/P   metrics endpoint (default <url>/metrics)\n"
       "  --metrics-interval MS       poll interval (default 1000)\n"
@@ -244,6 +247,12 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
       params->profile_export_file = next();
     } else if (arg == "--json-summary") {
       params->json_summary = true;
+    } else if (arg == "--service-kind") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->service_kind = next();
+    } else if (arg == "--endpoint") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->endpoint = next();
     } else if (arg == "--collect-metrics") {
       params->collect_metrics = true;
     } else if (arg == "--metrics-url") {
@@ -269,8 +278,18 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
   if (params->protocol != "http" && params->protocol != "grpc") {
     return Error("-i must be http or grpc, got '" + params->protocol + "'");
   }
-  if (params->streaming && params->protocol != "grpc") {
-    return Error("--streaming requires -i grpc (decoupled bidi stream)");
+  if (params->service_kind != "kserve" && params->service_kind != "openai") {
+    return Error("--service-kind must be kserve or openai, got '" +
+                 params->service_kind + "'");
+  }
+  if (params->streaming && params->protocol != "grpc" &&
+      params->service_kind != "openai") {
+    return Error("--streaming requires -i grpc (decoupled bidi stream) or "
+                 "--service-kind openai (SSE)");
+  }
+  if (params->service_kind == "openai" && params->input_data_file.empty()) {
+    return Error("--service-kind openai requires --input-data with "
+                 "'payload' entries (request JSON bodies)");
   }
   int modes = (params->has_concurrency_range ? 1 : 0) +
               (params->has_request_rate_range ? 1 : 0) +

@@ -3,6 +3,7 @@
 #include "grpc_backend.h"
 #include "http_backend.h"
 #include "mock_backend.h"
+#include "openai_backend.h"
 
 namespace ctpu {
 namespace perf {
@@ -15,6 +16,9 @@ Error CreateClientBackend(const BackendFactoryConfig& config,
     case BackendKind::KSERVE_GRPC:
       return GrpcClientBackend::Create(config.url, config.verbose,
                                        config.streaming, backend);
+    case BackendKind::OPENAI:
+      return OpenAiClientBackend::Create(config.url, config.endpoint,
+                                         config.streaming, backend);
     case BackendKind::MOCK:
       backend->reset(new MockClientBackend());
       return Error::Success();

@@ -24,7 +24,7 @@
 namespace ctpu {
 namespace perf {
 
-enum class BackendKind { KSERVE_HTTP, KSERVE_GRPC, MOCK };
+enum class BackendKind { KSERVE_HTTP, KSERVE_GRPC, OPENAI, MOCK };
 
 // One worker's issuing handle; not thread-safe (one context per thread).
 class BackendContext {
@@ -84,6 +84,8 @@ struct BackendFactoryConfig {
   bool verbose = false;
   // gRPC only: drive requests over one decoupled bidi stream per context.
   bool streaming = false;
+  // OPENAI only: endpoint path (default v1/chat/completions).
+  std::string endpoint;
 };
 
 // reference ClientBackendFactory::Create (client_backend.h:292)

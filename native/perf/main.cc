@@ -63,6 +63,11 @@ int main(int argc, char** argv) {
     backend_config.kind = BackendKind::KSERVE_GRPC;
     if (!params.url_set) backend_config.url = "localhost:8001";
   }
+  if (params.service_kind == "openai") {
+    backend_config.kind = BackendKind::OPENAI;
+    backend_config.endpoint = params.endpoint;
+    if (!params.url_set) backend_config.url = "localhost:8000";
+  }
   std::shared_ptr<ClientBackend> backend;
   err = CreateClientBackend(backend_config, &backend);
   if (!err.IsOk()) return fail(err, "create backend");

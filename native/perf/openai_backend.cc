@@ -1,0 +1,204 @@
+#include "openai_backend.h"
+
+#include <cstring>
+
+namespace ctpu {
+namespace perf {
+
+Error ExtractOpenAiPayload(const std::vector<InferInput*>& inputs,
+                           std::string* payload) {
+  const InferInput* payload_input = nullptr;
+  for (const InferInput* input : inputs) {
+    if (input->Name() == "payload") {
+      payload_input = input;
+      break;
+    }
+  }
+  if (payload_input == nullptr && inputs.size() == 1) {
+    payload_input = inputs[0];
+  }
+  if (payload_input == nullptr) {
+    return Error("openai backend needs a BYTES input named 'payload'");
+  }
+  std::string raw;
+  payload_input->ConcatenatedData(&raw);
+  // BYTES elements are 4-byte-length-prefixed; a payload tensor holds one
+  // element. Accept both prefixed and raw JSON.
+  if (raw.size() >= 4) {
+    uint32_t len;
+    std::memcpy(&len, raw.data(), 4);
+    if (len == raw.size() - 4) {
+      *payload = raw.substr(4);
+      return Error::Success();
+    }
+  }
+  *payload = raw;
+  return Error::Success();
+}
+
+size_t ConsumeSseEvents(std::string* buf, bool* done,
+                        std::vector<std::string>* events) {
+  size_t count = 0;
+  while (true) {
+    // Events end at a blank line: LF LF or CRLF CRLF.
+    const size_t lf = buf->find("\n\n");
+    const size_t crlf = buf->find("\r\n\r\n");
+    size_t pos, sep;
+    if (crlf != std::string::npos && (lf == std::string::npos || crlf < lf)) {
+      pos = crlf;
+      sep = 4;
+    } else if (lf != std::string::npos) {
+      pos = lf;
+      sep = 2;
+    } else {
+      break;
+    }
+    std::string event = buf->substr(0, pos);
+    buf->erase(0, pos + sep);
+    // Normalize possible \r\n line ends.
+    while (!event.empty() && event.back() == '\r') event.pop_back();
+    if (event.compare(0, 5, "data:") != 0) continue;
+    std::string data = event.substr(5);
+    const size_t start = data.find_first_not_of(' ');
+    data = start == std::string::npos ? "" : data.substr(start);
+    if (data == "[DONE]") {
+      *done = true;
+      continue;
+    }
+    if (events != nullptr) events->push_back(std::move(data));
+    ++count;
+  }
+  return count;
+}
+
+Error OpenAiClientBackend::Create(const std::string& url,
+                                  const std::string& endpoint, bool streaming,
+                                  std::shared_ptr<ClientBackend>* backend) {
+  const size_t colon = url.rfind(':');
+  if (colon == std::string::npos) {
+    return Error("url must be host:port, got '" + url + "'");
+  }
+  std::string path = endpoint.empty() ? "v1/chat/completions" : endpoint;
+  if (!path.empty() && path[0] == '/') path = path.substr(1);
+  backend->reset(new OpenAiClientBackend(url.substr(0, colon),
+                                         std::atoi(url.c_str() + colon + 1),
+                                         std::move(path), streaming));
+  return Error::Success();
+}
+
+Error OpenAiClientBackend::ModelMetadata(json::Value* metadata,
+                                         const std::string& model_name,
+                                         const std::string& model_version) {
+  (void)model_version;
+  json::Object obj;
+  obj["name"] = model_name;
+  obj["platform"] = "openai";
+  json::Array inputs;
+  json::Object payload;
+  payload["name"] = "payload";
+  payload["datatype"] = "BYTES";
+  json::Array shape;
+  shape.push_back(json::Value(int64_t{1}));
+  payload["shape"] = json::Value(std::move(shape));
+  inputs.push_back(json::Value(std::move(payload)));
+  obj["inputs"] = json::Value(std::move(inputs));
+  obj["outputs"] = json::Array{};
+  *metadata = json::Value(std::move(obj));
+  return Error::Success();
+}
+
+Error OpenAiClientBackend::ModelConfig(json::Value* config,
+                                       const std::string& model_name,
+                                       const std::string& model_version) {
+  (void)model_version;
+  json::Object obj;
+  obj["name"] = model_name;
+  obj["max_batch_size"] = json::Value(int64_t{0});
+  if (streaming_) {
+    json::Object policy;
+    policy["decoupled"] = true;
+    obj["model_transaction_policy"] = json::Value(std::move(policy));
+  }
+  *config = json::Value(std::move(obj));
+  return Error::Success();
+}
+
+Error OpenAiBackendContext::Infer(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    RequestRecord* record) {
+  (void)outputs;
+  std::string payload;
+  Error err = ExtractOpenAiPayload(inputs, &payload);
+  if (!err.IsOk()) {
+    record->success = false;
+    record->error = err.Message();
+    record->start_ns = record->end_ns = RequestTimers::Now();
+    return err;
+  }
+  // Inject "stream": true for SSE mode (reference ChatCompletionRequest
+  // carries is_stream_; genai-perf payloads may already set it).
+  if (streaming_ && payload.find("\"stream\"") == std::string::npos) {
+    const size_t brace = payload.rfind('}');
+    if (brace != std::string::npos) {
+      payload.insert(brace, ", \"stream\": true");
+    }
+  }
+
+  const std::vector<std::string> headers = {
+      "Content-Type: application/json"};
+  record->start_ns = RequestTimers::Now();
+  int status = 0;
+  std::string resp_headers;
+
+  if (streaming_) {
+    sse_buf_.clear();
+    bool done = false;
+    size_t events = 0;
+    err = conn_.RoundtripStream(
+        "POST", path_, headers, payload.data(), payload.size(), &status,
+        &resp_headers,
+        [&](const char* data, size_t len) {
+          sse_buf_.append(data, len);
+          bool chunk_done = false;
+          const size_t n = ConsumeSseEvents(&sse_buf_, &chunk_done, nullptr);
+          const uint64_t now = RequestTimers::Now();
+          for (size_t i = 0; i < n; ++i) record->response_ns.push_back(now);
+          events += n;
+          done = done || chunk_done;
+        },
+        options.client_timeout_us);
+    record->end_ns = record->response_ns.empty()
+                         ? RequestTimers::Now()
+                         : record->response_ns.back();
+    if (!err.IsOk() || status != 200) {
+      record->success = false;
+      record->error = err.IsOk()
+                          ? "openai endpoint returned HTTP " +
+                                std::to_string(status)
+                          : err.Message();
+      return err.IsOk() ? Error(record->error) : err;
+    }
+    record->success = true;
+    return Error::Success();
+  }
+
+  std::string body;
+  err = conn_.Roundtrip("POST", path_, headers, payload.data(),
+                        payload.size(), &status, &resp_headers, &body,
+                        options.client_timeout_us);
+  record->end_ns = RequestTimers::Now();
+  record->response_ns.push_back(record->end_ns);
+  if (!err.IsOk() || status != 200) {
+    record->success = false;
+    record->error = err.IsOk() ? "openai endpoint returned HTTP " +
+                                     std::to_string(status) + ": " + body
+                               : err.Message();
+    return err.IsOk() ? Error(record->error) : err;
+  }
+  record->success = true;
+  return Error::Success();
+}
+
+}  // namespace perf
+}  // namespace ctpu

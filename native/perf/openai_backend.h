@@ -1,0 +1,77 @@
+// OpenAI-compatible backend: benchmark chat/completions endpoints with SSE
+// token streaming (role parity with the reference openai client backend,
+// reference client_backend/openai/openai_client.h:132-167 and its
+// ChatCompletionRequest.is_stream_ handling).
+//
+// Inputs follow the reference convention: a BYTES tensor named "payload"
+// whose element is the JSON request body (genai-perf generates these). When
+// --streaming is set, "stream": true is injected and each SSE event is
+// timestamped into the record's response_ns (TTFT/ITL feedstock).
+#pragma once
+
+#include "client_backend.h"
+#include "http_client.h"
+
+namespace ctpu {
+namespace perf {
+
+class OpenAiBackendContext : public BackendContext {
+ public:
+  OpenAiBackendContext(const std::string& host, int port, std::string path,
+                       bool streaming)
+      : conn_(host, port), path_(std::move(path)), streaming_(streaming) {}
+
+  Error Infer(const InferOptions& options,
+              const std::vector<InferInput*>& inputs,
+              const std::vector<const InferRequestedOutput*>& outputs,
+              RequestRecord* record) override;
+
+ private:
+  HttpConnection conn_;
+  std::string path_;
+  bool streaming_;
+  std::string sse_buf_;
+};
+
+class OpenAiClientBackend : public ClientBackend {
+ public:
+  static Error Create(const std::string& url, const std::string& endpoint,
+                      bool streaming,
+                      std::shared_ptr<ClientBackend>* backend);
+
+  BackendKind Kind() const override { return BackendKind::OPENAI; }
+  // The endpoint has no KServe metadata; fabricate the reference's payload
+  // contract (reference model_parser InitOpenAI).
+  Error ModelMetadata(json::Value* metadata, const std::string& model_name,
+                      const std::string& model_version) override;
+  Error ModelConfig(json::Value* config, const std::string& model_name,
+                    const std::string& model_version) override;
+  std::unique_ptr<BackendContext> CreateContext() override {
+    return std::unique_ptr<BackendContext>(
+        new OpenAiBackendContext(host_, port_, path_, streaming_));
+  }
+
+ private:
+  OpenAiClientBackend(std::string host, int port, std::string path,
+                      bool streaming)
+      : host_(std::move(host)), port_(port), path_(std::move(path)),
+        streaming_(streaming) {}
+
+  std::string host_;
+  int port_;
+  std::string path_;
+  bool streaming_;
+};
+
+// Extracts the JSON payload string from the "payload" BYTES input
+// (strips the 4-byte length prefix when present). Exposed for tests.
+Error ExtractOpenAiPayload(const std::vector<InferInput*>& inputs,
+                           std::string* payload);
+
+// Splits accumulated SSE bytes into complete "data: ..." events; returns
+// the number of events and whether [DONE] was seen. Exposed for tests.
+size_t ConsumeSseEvents(std::string* buf, bool* done,
+                        std::vector<std::string>* events);
+
+}  // namespace perf
+}  // namespace ctpu

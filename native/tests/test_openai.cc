@@ -1,0 +1,60 @@
+// OpenAI backend unit tests: payload extraction + SSE event parsing.
+#include <cstring>
+
+#include "openai_backend.h"
+#include "test_framework.h"
+
+namespace {
+
+using ctpu::InferInput;
+using ctpu::perf::ConsumeSseEvents;
+using ctpu::perf::ExtractOpenAiPayload;
+
+TEST_CASE("openai: payload extraction strips BYTES length prefix") {
+  const std::string json = "{\"model\": \"m\"}";
+  std::string prefixed;
+  uint32_t len = static_cast<uint32_t>(json.size());
+  prefixed.append(reinterpret_cast<const char*>(&len), 4);
+  prefixed += json;
+  InferInput input("payload", {1}, "BYTES");
+  CHECK_OK(input.AppendRaw(
+      reinterpret_cast<const uint8_t*>(prefixed.data()), prefixed.size()));
+  std::vector<InferInput*> inputs = {&input};
+  std::string payload;
+  CHECK_OK(ExtractOpenAiPayload(inputs, &payload));
+  CHECK(payload == json);
+}
+
+TEST_CASE("openai: raw (unprefixed) payload accepted") {
+  const std::string json = "{\"prompt\": \"hi\"}";
+  InferInput input("payload", {1}, "BYTES");
+  CHECK_OK(input.AppendRaw(
+      reinterpret_cast<const uint8_t*>(json.data()), json.size()));
+  std::vector<InferInput*> inputs = {&input};
+  std::string payload;
+  CHECK_OK(ExtractOpenAiPayload(inputs, &payload));
+  CHECK(payload == json);
+}
+
+TEST_CASE("openai: SSE events split across arbitrary fragment boundaries") {
+  const std::string stream =
+      "data: {\"one\": 1}\n\n"
+      "data: {\"two\": 2}\r\n\r\n"
+      ": keepalive comment\n\n"
+      "data: [DONE]\n\n";
+  // Feed byte-by-byte to exercise partial-event buffering.
+  std::string buf;
+  bool done = false;
+  std::vector<std::string> events;
+  for (char c : stream) {
+    buf.push_back(c);
+    ConsumeSseEvents(&buf, &done, &events);
+  }
+  CHECK_EQ(events.size(), 2u);
+  CHECK(events[0] == "{\"one\": 1}");
+  CHECK(events[1] == "{\"two\": 2}");
+  CHECK(done);
+  CHECK(buf.empty());
+}
+
+}  // namespace

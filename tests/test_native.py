@@ -195,3 +195,49 @@ def test_cpp_perf_analyzer_collect_metrics(native_build, live_server):
     assert out.returncode == 0, out.stdout + out.stderr
     assert "Server metrics" in out.stdout
     assert 'tpu_inference_count{model="simple"}' in out.stdout
+
+
+@pytest.fixture(scope="module")
+def live_llm_server():
+    from client_tpu.models.serving import register_zoo_models
+    from client_tpu.server.core import ServerCore
+    from client_tpu.server.model_repository import ModelRepository
+    from client_tpu.testing import InProcessServer
+
+    repository = ModelRepository()
+    core = ServerCore(repository)
+    register_zoo_models(repository)
+    with InProcessServer(core=core, host="127.0.0.1", grpc=False,
+                         builtin_models=False) as server:
+        yield server
+
+
+def test_cpp_perf_analyzer_openai_sse(native_build, live_llm_server,
+                                      tmp_path):
+    """OpenAI chat-completions benchmark with SSE streaming against the
+    in-repo OpenAI front-end (JAX llama decode behind it)."""
+    payload = json.dumps({
+        "model": "llm_decode",
+        "messages": [{"role": "user", "content": "hello world how are you"}],
+        "max_tokens": 4,
+    })
+    input_file = tmp_path / "openai_input.json"
+    input_file.write_text(json.dumps({"data": [{"payload": [payload]}]}))
+    out = subprocess.run(
+        [os.path.join(native_build, "perf_analyzer"),
+         "-m", "llm_decode", "-u", live_llm_server.http_url,
+         "--service-kind", "openai", "--streaming",
+         "--input-data", str(input_file),
+         "--concurrency-range", "2",
+         "--measurement-interval", "600",
+         "--stability-percentage", "60",
+         "--max-trials", "3",
+         "--json-summary"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    summary = json.loads(
+        [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+    )
+    assert summary["errors"] == 0
+    assert summary["throughput"] > 0
