@@ -49,19 +49,14 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", args.platform)
 
     from client_tpu.server.core import ServerCore
-    from client_tpu.server.model_repository import ModelRepository
+    from client_tpu.server.model_repository import build_repository
 
-    repository = ModelRepository(args.model_repository)
+    repository = build_repository(
+        args.model_repository,
+        builtin=not args.no_builtin_models,
+        zoo=args.zoo_models,
+    )
     core = ServerCore(repository, max_workers=args.max_workers)
-    if not args.no_builtin_models:
-        from client_tpu.server.models import register_builtin_models
-
-        register_builtin_models(repository)
-    if args.zoo_models:
-        from client_tpu.models.serving import register_zoo_models
-
-        register_zoo_models(repository)
-    repository.scan()
 
     async def serve() -> None:
         from client_tpu.server.grpc_server import serve_grpc

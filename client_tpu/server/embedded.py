@@ -1,0 +1,103 @@
+"""In-process runner for the embedded ("local") perf backend.
+
+The reference's triton_c_api backend dlopens libtritonserver.so and runs the
+whole server in the perf_analyzer process to measure client-overhead-free
+baselines (reference client_backend/triton_c_api/triton_loader.h:85-200).
+This stack's server is Python, so the native analogue dlopens libpython,
+imports this module, and drives a ServerCore directly — no sockets, no HTTP
+parsing in the hot path beyond the KServe binary body decode.
+
+Wire format (matches the HTTP binary protocol so the C++ side reuses
+GenerateRequestBody/ParseResponseBody):
+  infer(model, body, header_len) -> bytes:
+      4-byte LE status (0 ok / 1 error) + 8-byte LE response-header length
+      + response body (JSON header + binary section, or error JSON).
+"""
+
+import asyncio
+import struct
+import threading
+from typing import Optional
+
+
+class EmbeddedRunner:
+    def __init__(self, zoo: bool = False, model_repository: str = ""):
+        from client_tpu.server.core import ServerCore
+        from client_tpu.server.http_server import HttpServer
+        from client_tpu.server.model_repository import build_repository
+
+        repository = build_repository(model_repository or None, zoo=zoo)
+        self.core = ServerCore(repository)
+        # Reuse the HTTP front-end's request/response codecs without any
+        # network or aiohttp handler in the path.
+        self._http = HttpServer(self.core)
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, name="ctpu-embedded", daemon=True
+        )
+        self._thread.start()
+
+    def infer(self, model_name: str, body: bytes, header_len: int) -> bytes:
+        import json
+
+        from client_tpu.utils import InferenceServerException
+
+        try:
+            if header_len <= 0:
+                header_len = len(body)
+            payload = json.loads(body[:header_len].decode("utf-8"))
+            binary = body[header_len:]
+            core_request = self._http._build_core_request(
+                model_name, "", payload, binary
+            )
+            future = asyncio.run_coroutine_threadsafe(
+                self.core.infer(core_request), self._loop
+            )
+            core_response = future.result(timeout=600)
+            resp = self._http._build_response(payload, core_response, "")
+            resp_body = resp.body or b""
+            resp_header_len = int(
+                resp.headers.get(
+                    "Inference-Header-Content-Length", len(resp_body)
+                )
+            )
+            return (
+                struct.pack("<IQ", 0, resp_header_len) + bytes(resp_body)
+            )
+        except InferenceServerException as e:
+            msg = json.dumps({"error": e.message()}).encode()
+            return struct.pack("<IQ", 1, len(msg)) + msg
+        except Exception as e:  # noqa: BLE001 — cross the C boundary safely
+            msg = json.dumps({"error": f"embedded runner: {e}"}).encode()
+            return struct.pack("<IQ", 1, len(msg)) + msg
+
+    def model_metadata_json(self, model_name: str) -> str:
+        import json
+
+        model = self.core.repository.get(model_name, "")
+        return json.dumps(model.metadata())
+
+    def model_config_json(self, model_name: str) -> str:
+        import json
+
+        model = self.core.repository.get(model_name, "")
+        return json.dumps(model.config())
+
+    def statistics_json(self, model_name: str = "") -> str:
+        import json
+
+        return json.dumps(self.core.statistics(model_name))
+
+    def shutdown(self) -> None:
+        self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+_runner: Optional[EmbeddedRunner] = None
+
+
+def start(zoo: bool = False, model_repository: str = "") -> EmbeddedRunner:
+    """Create (or return) the process-wide runner."""
+    global _runner
+    if _runner is None:
+        _runner = EmbeddedRunner(zoo=zoo, model_repository=model_repository)
+    return _runner

@@ -230,3 +230,22 @@ class ModelRepository:
                 os.path.join(self._repository_path, entry, "model.py")
             ):
                 self.load(entry)
+
+
+def build_repository(
+    repository_path=None, builtin: bool = True, zoo: bool = False
+) -> "ModelRepository":
+    """Standard repository bootstrap shared by the CLI server, the
+    in-process test server, and the embedded (perf local-backend) runner:
+    fixture models, optional model-zoo adapters, then a directory scan."""
+    repository = ModelRepository(repository_path)
+    if builtin:
+        from client_tpu.server.models import register_builtin_models
+
+        register_builtin_models(repository)
+    if zoo:
+        from client_tpu.models.serving import register_zoo_models
+
+        register_zoo_models(repository)
+    repository.scan()
+    return repository

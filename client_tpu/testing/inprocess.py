@@ -26,8 +26,7 @@ class InProcessServer:
         builtin_models: bool = True,
     ):
         if core is None:
-            repository = ModelRepository()
-            core = ServerCore(repository)
+            core = ServerCore(ModelRepository())
         self.core = core
         if builtin_models:
             from client_tpu.server.models import register_builtin_models

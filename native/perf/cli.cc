@@ -121,7 +121,10 @@ std::string Usage() {
       "  -f FILE                     CSV report path\n"
       "  --profile-export-file FILE  per-request JSON export\n"
       "  --json-summary              print one-line JSON summary\n"
-      "  --service-kind KIND         kserve (default) | openai\n"
+      "  --service-kind KIND         kserve (default) | openai | local\n"
+      "                              (local = in-process server, no network;\n"
+      "                               needs repo root + venv on PYTHONPATH)\n"
+      "  --local-zoo-models          local: also load resnet/llm_decode\n"
       "  --endpoint PATH             openai endpoint path "
       "(default v1/chat/completions)\n"
       "  --collect-metrics           poll server Prometheus metrics\n"
@@ -253,6 +256,8 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
     } else if (arg == "--endpoint") {
       CTPU_RETURN_IF_ERROR(need(i));
       params->endpoint = next();
+    } else if (arg == "--local-zoo-models") {
+      params->local_zoo = true;
     } else if (arg == "--collect-metrics") {
       params->collect_metrics = true;
     } else if (arg == "--metrics-url") {
@@ -278,12 +283,14 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
   if (params->protocol != "http" && params->protocol != "grpc") {
     return Error("-i must be http or grpc, got '" + params->protocol + "'");
   }
-  if (params->service_kind != "kserve" && params->service_kind != "openai") {
-    return Error("--service-kind must be kserve or openai, got '" +
+  if (params->service_kind != "kserve" && params->service_kind != "openai" &&
+      params->service_kind != "local") {
+    return Error("--service-kind must be kserve, openai or local, got '" +
                  params->service_kind + "'");
   }
-  if (params->streaming && params->protocol != "grpc" &&
-      params->service_kind != "openai") {
+  if (params->streaming &&
+      !((params->service_kind == "kserve" && params->protocol == "grpc") ||
+        params->service_kind == "openai")) {
     return Error("--streaming requires -i grpc (decoupled bidi stream) or "
                  "--service-kind openai (SSE)");
   }

@@ -16,8 +16,9 @@ struct PAParams {
   std::string model_version;
   std::string url = "localhost:8000";
   bool url_set = false;  // true when -u was passed (default swaps per proto)
-  std::string service_kind = "kserve";  // kserve | openai
+  std::string service_kind = "kserve";  // kserve | openai | local
   std::string endpoint;  // openai: path (default v1/chat/completions)
+  bool local_zoo = false;  // local: register model-zoo adapters too
   std::string protocol = "http";
   int64_t batch_size = 1;
 

@@ -2,6 +2,7 @@
 
 #include "grpc_backend.h"
 #include "http_backend.h"
+#include "local_backend.h"
 #include "mock_backend.h"
 #include "openai_backend.h"
 
@@ -19,6 +20,9 @@ Error CreateClientBackend(const BackendFactoryConfig& config,
     case BackendKind::OPENAI:
       return OpenAiClientBackend::Create(config.url, config.endpoint,
                                          config.streaming, backend);
+    case BackendKind::LOCAL:
+      return LocalClientBackend::Create(config.verbose, config.local_zoo,
+                                        backend);
     case BackendKind::MOCK:
       backend->reset(new MockClientBackend());
       return Error::Success();

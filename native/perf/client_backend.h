@@ -24,7 +24,7 @@
 namespace ctpu {
 namespace perf {
 
-enum class BackendKind { KSERVE_HTTP, KSERVE_GRPC, OPENAI, MOCK };
+enum class BackendKind { KSERVE_HTTP, KSERVE_GRPC, OPENAI, LOCAL, MOCK };
 
 // One worker's issuing handle; not thread-safe (one context per thread).
 class BackendContext {
@@ -86,6 +86,8 @@ struct BackendFactoryConfig {
   bool streaming = false;
   // OPENAI only: endpoint path (default v1/chat/completions).
   std::string endpoint;
+  // LOCAL only: also register the model-zoo adapters (resnet, llm_decode).
+  bool local_zoo = false;
 };
 
 // reference ClientBackendFactory::Create (client_backend.h:292)

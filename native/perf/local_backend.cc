@@ -1,0 +1,321 @@
+#include "local_backend.h"
+
+#include <dlfcn.h>
+#include <string.h>
+
+#include <cstdint>
+
+#include "http_client.h"  // GenerateRequestBody / InferResultHttp
+
+namespace ctpu {
+namespace perf {
+
+namespace {
+
+// Minimal CPython C-API slice, resolved at runtime (no Python headers in
+// the build — the same discipline as the reference's TritonLoader fn-ptr
+// table, triton_c_api/triton_loader.h:94-135).
+struct PyApi {
+  void* handle = nullptr;
+  void (*InitializeEx)(int) = nullptr;
+  int (*IsInitialized)(void) = nullptr;
+  void* (*EvalSaveThread)(void) = nullptr;
+  int (*GilEnsure)(void) = nullptr;
+  void (*GilRelease)(int) = nullptr;
+  int (*RunSimpleString)(const char*) = nullptr;
+  void* (*ImportModule)(const char*) = nullptr;
+  void* (*GetAttrString)(void*, const char*) = nullptr;
+  void* (*CallMethodObjArgs)(void*, void*, ...) = nullptr;
+  void* (*CallObject)(void*, void*) = nullptr;
+  void* (*BytesFromStringAndSize)(const char*, ssize_t) = nullptr;
+  char* (*BytesAsString)(void*) = nullptr;
+  ssize_t (*BytesSize)(void*) = nullptr;
+  void* (*LongFromLong)(long) = nullptr;
+  void* (*BoolFromLong)(long) = nullptr;
+  void* (*UnicodeFromString)(const char*) = nullptr;
+  const char* (*UnicodeAsUTF8)(void*) = nullptr;
+  void* (*ErrOccurred)(void) = nullptr;
+  void (*ErrPrint)(void) = nullptr;
+  void (*DecRef)(void*) = nullptr;
+
+  void* runner = nullptr;  // EmbeddedRunner instance (owned reference)
+};
+
+PyApi g_py;
+std::mutex g_boot_mu;
+
+template <typename T>
+bool Resolve(void* handle, const char* name, T* fn) {
+  *fn = reinterpret_cast<T>(dlsym(handle, name));
+  return *fn != nullptr;
+}
+
+Error LoadLibpython(std::string* err_detail) {
+  static const char* kCandidates[] = {
+      "libpython3.12.so.1.0", "libpython3.13.so.1.0", "libpython3.11.so.1.0",
+      "libpython3.10.so.1.0", "libpython3.so",
+  };
+  for (const char* name : kCandidates) {
+    g_py.handle = dlopen(name, RTLD_NOW | RTLD_GLOBAL);
+    if (g_py.handle != nullptr) break;
+  }
+  if (g_py.handle == nullptr) {
+    *err_detail = std::string("dlopen libpython failed: ") + dlerror();
+    return Error(*err_detail);
+  }
+  bool ok = true;
+  ok &= Resolve(g_py.handle, "Py_InitializeEx", &g_py.InitializeEx);
+  ok &= Resolve(g_py.handle, "Py_IsInitialized", &g_py.IsInitialized);
+  ok &= Resolve(g_py.handle, "PyEval_SaveThread", &g_py.EvalSaveThread);
+  ok &= Resolve(g_py.handle, "PyGILState_Ensure", &g_py.GilEnsure);
+  ok &= Resolve(g_py.handle, "PyGILState_Release", &g_py.GilRelease);
+  ok &= Resolve(g_py.handle, "PyRun_SimpleString", &g_py.RunSimpleString);
+  ok &= Resolve(g_py.handle, "PyImport_ImportModule", &g_py.ImportModule);
+  ok &= Resolve(g_py.handle, "PyObject_GetAttrString", &g_py.GetAttrString);
+  ok &= Resolve(g_py.handle, "PyObject_CallMethodObjArgs",
+                &g_py.CallMethodObjArgs);
+  ok &= Resolve(g_py.handle, "PyObject_CallObject", &g_py.CallObject);
+  ok &= Resolve(g_py.handle, "PyBytes_FromStringAndSize",
+                &g_py.BytesFromStringAndSize);
+  ok &= Resolve(g_py.handle, "PyBytes_AsString", &g_py.BytesAsString);
+  ok &= Resolve(g_py.handle, "PyBytes_Size", &g_py.BytesSize);
+  ok &= Resolve(g_py.handle, "PyLong_FromLong", &g_py.LongFromLong);
+  ok &= Resolve(g_py.handle, "PyBool_FromLong", &g_py.BoolFromLong);
+  ok &= Resolve(g_py.handle, "PyUnicode_FromString",
+                &g_py.UnicodeFromString);
+  ok &= Resolve(g_py.handle, "PyUnicode_AsUTF8", &g_py.UnicodeAsUTF8);
+  ok &= Resolve(g_py.handle, "PyErr_Occurred", &g_py.ErrOccurred);
+  ok &= Resolve(g_py.handle, "PyErr_Print", &g_py.ErrPrint);
+  ok &= Resolve(g_py.handle, "Py_DecRef", &g_py.DecRef);
+  if (!ok) {
+    *err_detail = "libpython loaded but required symbols missing";
+    return Error(*err_detail);
+  }
+  return Error::Success();
+}
+
+// RAII GIL hold for a scope.
+class GilScope {
+ public:
+  GilScope() : state_(g_py.GilEnsure()) {}
+  ~GilScope() { g_py.GilRelease(state_); }
+
+ private:
+  int state_;
+};
+
+Error PyErrorToError(const char* what) {
+  if (g_py.ErrOccurred()) g_py.ErrPrint();  // traceback to stderr
+  return Error(std::string("embedded python: ") + what +
+               " failed (traceback above)");
+}
+
+}  // namespace
+
+Error PythonRuntime::Boot(bool zoo, std::string* err_detail) {
+  std::lock_guard<std::mutex> lk(g_boot_mu);
+  if (g_py.runner != nullptr) return Error::Success();
+  if (g_py.handle == nullptr) {
+    CTPU_RETURN_IF_ERROR(LoadLibpython(err_detail));
+  }
+  const bool was_initialized = g_py.IsInitialized() != 0;
+  if (!was_initialized) {
+    g_py.InitializeEx(0);
+  }
+  int gil = g_py.GilEnsure();
+  // Make the working directory importable (repo checkouts run in-tree).
+  g_py.RunSimpleString(
+      "import sys, os\n"
+      "if os.getcwd() not in sys.path: sys.path.insert(0, os.getcwd())\n");
+  void* module = g_py.ImportModule("client_tpu.server.embedded");
+  Error err = Error::Success();
+  if (module == nullptr) {
+    err = PyErrorToError("import client_tpu.server.embedded");
+    *err_detail =
+        err.Message() +
+        " — is the repo root on PYTHONPATH (and the venv's site-packages)?";
+    err = Error(*err_detail);
+  } else {
+    void* zoo_obj = g_py.BoolFromLong(zoo ? 1 : 0);
+    void* name = g_py.UnicodeFromString("start");
+    g_py.runner = g_py.CallMethodObjArgs(module, name, zoo_obj, nullptr);
+    g_py.DecRef(name);
+    g_py.DecRef(zoo_obj);
+    if (g_py.runner == nullptr) {
+      err = PyErrorToError("embedded.start()");
+      *err_detail = err.Message();
+    }
+    g_py.DecRef(module);
+  }
+  if (!was_initialized) {
+    // Release the GIL so worker threads can take it; the main thread never
+    // re-enters Python outside GilScope.
+    g_py.GilRelease(gil);
+    g_py.EvalSaveThread();
+  } else {
+    g_py.GilRelease(gil);
+  }
+  return err;
+}
+
+Error PythonRuntime::Infer(const std::string& model, const std::string& body,
+                           size_t header_len, bool* ok,
+                           size_t* resp_header_len, std::string* resp_body) {
+  GilScope gil;
+  void* name = g_py.UnicodeFromString("infer");
+  void* model_obj = g_py.UnicodeFromString(model.c_str());
+  void* body_obj = g_py.BytesFromStringAndSize(
+      body.data(), static_cast<ssize_t>(body.size()));
+  void* hlen_obj = g_py.LongFromLong(static_cast<long>(header_len));
+  void* result = g_py.CallMethodObjArgs(g_py.runner, name, model_obj,
+                                        body_obj, hlen_obj, nullptr);
+  g_py.DecRef(name);
+  g_py.DecRef(model_obj);
+  g_py.DecRef(body_obj);
+  g_py.DecRef(hlen_obj);
+  if (result == nullptr) return PyErrorToError("runner.infer");
+  const ssize_t n = g_py.BytesSize(result);
+  const char* data = g_py.BytesAsString(result);
+  if (n < 12 || data == nullptr) {
+    // A non-bytes result sets a pending TypeError — drain it so the next
+    // call on this thread starts clean.
+    if (g_py.ErrOccurred()) g_py.ErrPrint();
+    g_py.DecRef(result);
+    return Error("embedded runner returned a malformed buffer");
+  }
+  uint32_t status;
+  uint64_t hlen;
+  memcpy(&status, data, 4);
+  memcpy(&hlen, data + 4, 8);
+  *ok = status == 0;
+  *resp_header_len = static_cast<size_t>(hlen);
+  resp_body->assign(data + 12, static_cast<size_t>(n - 12));
+  g_py.DecRef(result);
+  return Error::Success();
+}
+
+Error PythonRuntime::CallJson(const char* method, const std::string& model,
+                              std::string* json_out) {
+  GilScope gil;
+  void* name = g_py.UnicodeFromString(method);
+  void* model_obj = g_py.UnicodeFromString(model.c_str());
+  void* result =
+      g_py.CallMethodObjArgs(g_py.runner, name, model_obj, nullptr);
+  g_py.DecRef(name);
+  g_py.DecRef(model_obj);
+  if (result == nullptr) {
+    return PyErrorToError(method);
+  }
+  const char* utf8 = g_py.UnicodeAsUTF8(result);
+  if (utf8 == nullptr) {
+    g_py.DecRef(result);
+    return Error(std::string(method) + " returned a non-string");
+  }
+  json_out->assign(utf8);
+  g_py.DecRef(result);
+  return Error::Success();
+}
+
+// ---------------------------------------------------------------------------
+// Backend
+// ---------------------------------------------------------------------------
+
+Error LocalClientBackend::Create(bool verbose, bool zoo,
+                                 std::shared_ptr<ClientBackend>* backend) {
+  (void)verbose;
+  std::string detail;
+  CTPU_RETURN_IF_ERROR(PythonRuntime::Boot(zoo, &detail));
+  backend->reset(new LocalClientBackend());
+  return Error::Success();
+}
+
+Error LocalClientBackend::ModelMetadata(json::Value* metadata,
+                                        const std::string& model_name,
+                                        const std::string& model_version) {
+  (void)model_version;
+  std::string doc;
+  CTPU_RETURN_IF_ERROR(
+      PythonRuntime::CallJson("model_metadata_json", model_name, &doc));
+  try {
+    *metadata = json::Parse(doc);
+  } catch (const std::exception& e) {
+    return Error(std::string("bad metadata json: ") + e.what());
+  }
+  return Error::Success();
+}
+
+Error LocalClientBackend::ModelConfig(json::Value* config,
+                                      const std::string& model_name,
+                                      const std::string& model_version) {
+  (void)model_version;
+  std::string doc;
+  CTPU_RETURN_IF_ERROR(
+      PythonRuntime::CallJson("model_config_json", model_name, &doc));
+  try {
+    *config = json::Parse(doc);
+  } catch (const std::exception& e) {
+    return Error(std::string("bad config json: ") + e.what());
+  }
+  return Error::Success();
+}
+
+Error LocalClientBackend::InferenceStatistics(
+    std::map<std::string, std::pair<uint64_t, uint64_t>>* stats,
+    const std::string& model_name) {
+  std::string doc;
+  CTPU_RETURN_IF_ERROR(
+      PythonRuntime::CallJson("statistics_json", model_name, &doc));
+  json::Value parsed;
+  try {
+    parsed = json::Parse(doc);
+  } catch (const std::exception& e) {
+    return Error(std::string("bad statistics json: ") + e.what());
+  }
+  stats->clear();
+  if (!parsed["model_stats"].IsArray()) return Error::Success();
+  for (const auto& entry : parsed["model_stats"].AsArray()) {
+    if (entry["name"].AsString() != model_name) continue;
+    if (!entry["inference_stats"].IsObject()) continue;
+    for (const auto& kv : entry["inference_stats"].AsObject()) {
+      if (kv.second.IsObject()) {
+        (*stats)[kv.first] = {(uint64_t)kv.second["count"].AsInt(),
+                              (uint64_t)kv.second["ns"].AsInt()};
+      }
+    }
+  }
+  return Error::Success();
+}
+
+Error LocalBackendContext::Infer(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    RequestRecord* record) {
+  std::string body;
+  size_t header_len = 0;
+  Error err = InferenceServerHttpClient::GenerateRequestBody(
+      &body, &header_len, options, inputs, outputs);
+  if (!err.IsOk()) {
+    record->success = false;
+    record->error = err.Message();
+    record->start_ns = record->end_ns = RequestTimers::Now();
+    return err;
+  }
+  record->start_ns = RequestTimers::Now();
+  bool ok = false;
+  size_t resp_header_len = 0;
+  std::string resp_body;
+  err = PythonRuntime::Infer(options.model_name, body, header_len, &ok,
+                             &resp_header_len, &resp_body);
+  record->end_ns = RequestTimers::Now();
+  record->response_ns.push_back(record->end_ns);
+  if (!err.IsOk() || !ok) {
+    record->success = false;
+    record->error = err.IsOk() ? resp_body : err.Message();
+    return err.IsOk() ? Error(record->error) : err;
+  }
+  record->success = true;
+  return Error::Success();
+}
+
+}  // namespace perf
+}  // namespace ctpu

@@ -1,0 +1,69 @@
+// In-process "local" backend: runs the Python ServerCore inside
+// perf_analyzer via an embedded CPython, measuring client-overhead-free
+// baselines with no sockets or HTTP in the path.
+//
+// Role parity with the reference's triton_c_api backend, which dlopens
+// libtritonserver.so and typedefs the server C API into function pointers
+// (reference client_backend/triton_c_api/triton_loader.h:85-200). This
+// stack's server is Python/JAX, so the loader dlopens libpython instead and
+// drives client_tpu.server.embedded through a dozen C-API symbols.
+//
+// Python path resolution: Py_InitializeEx honors PYTHONPATH; callers must
+// ensure the repo root and site-packages are importable (the pytest harness
+// sets PYTHONPATH; standalone runs typically inherit an activated venv).
+#pragma once
+
+#include <mutex>
+
+#include "client_backend.h"
+
+namespace ctpu {
+namespace perf {
+
+// Process-wide embedded interpreter + runner handle. All calls marshal
+// through the GIL; model compute releases it (JAX) so contexts overlap.
+class PythonRuntime {
+ public:
+  // Loads libpython, initializes the interpreter, imports
+  // client_tpu.server.embedded and calls start(zoo=...). Idempotent.
+  static Error Boot(bool zoo, std::string* err_detail);
+
+  // infer(model, request_body, header_len) -> (ok, resp_header_len, body).
+  static Error Infer(const std::string& model, const std::string& body,
+                     size_t header_len, bool* ok, size_t* resp_header_len,
+                     std::string* resp_body);
+  // JSON round-trips for metadata/config/statistics.
+  static Error CallJson(const char* method, const std::string& model,
+                        std::string* json_out);
+};
+
+class LocalBackendContext : public BackendContext {
+ public:
+  explicit LocalBackendContext(bool verbose) { (void)verbose; }
+
+  Error Infer(const InferOptions& options,
+              const std::vector<InferInput*>& inputs,
+              const std::vector<const InferRequestedOutput*>& outputs,
+              RequestRecord* record) override;
+};
+
+class LocalClientBackend : public ClientBackend {
+ public:
+  static Error Create(bool verbose, bool zoo,
+                      std::shared_ptr<ClientBackend>* backend);
+
+  BackendKind Kind() const override { return BackendKind::LOCAL; }
+  Error ModelMetadata(json::Value* metadata, const std::string& model_name,
+                      const std::string& model_version) override;
+  Error ModelConfig(json::Value* config, const std::string& model_name,
+                    const std::string& model_version) override;
+  Error InferenceStatistics(
+      std::map<std::string, std::pair<uint64_t, uint64_t>>* stats,
+      const std::string& model_name) override;
+  std::unique_ptr<BackendContext> CreateContext() override {
+    return std::unique_ptr<BackendContext>(new LocalBackendContext(false));
+  }
+};
+
+}  // namespace perf
+}  // namespace ctpu

@@ -68,6 +68,10 @@ int main(int argc, char** argv) {
     backend_config.endpoint = params.endpoint;
     if (!params.url_set) backend_config.url = "localhost:8000";
   }
+  if (params.service_kind == "local") {
+    backend_config.kind = BackendKind::LOCAL;
+    backend_config.local_zoo = params.local_zoo;
+  }
   std::shared_ptr<ClientBackend> backend;
   err = CreateClientBackend(backend_config, &backend);
   if (!err.IsOk()) return fail(err, "create backend");

@@ -241,3 +241,27 @@ def test_cpp_perf_analyzer_openai_sse(native_build, live_llm_server,
     )
     assert summary["errors"] == 0
     assert summary["throughput"] > 0
+
+
+def test_cpp_perf_analyzer_local_inprocess(native_build):
+    """--service-kind local embeds CPython and runs the ServerCore
+    in-process (triton_c_api analogue): no sockets in the path."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [os.path.join(native_build, "perf_analyzer"),
+         "-m", "simple", "--service-kind", "local",
+         "--concurrency-range", "2",
+         "--measurement-interval", "500",
+         "--stability-percentage", "60",
+         "--max-trials", "3",
+         "--json-summary"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    summary = json.loads(
+        [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+    )
+    assert summary["errors"] == 0
+    assert summary["throughput"] > 0
