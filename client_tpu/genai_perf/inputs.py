@@ -45,6 +45,8 @@ def create_llm_inputs(
     input_name: str = "INPUT_IDS",
     tokenizer=None,
     seed: int = 0,
+    model: str = "",
+    streaming: bool = False,
 ) -> Dict:
     """Write a perf-harness input-data JSON of synthetic LLM requests.
 
@@ -64,6 +66,33 @@ def create_llm_inputs(
             entry = {input_name: {"content": ids, "shape": [len(ids)]}}
         elif output_format == "kserve-text":
             entry = {input_name: {"content": [prompt], "shape": [1]}}
+        elif output_format in ("openai-chat", "openai-completions"):
+            # OpenAI request bodies ride in a BYTES "payload" input
+            # (reference OPENAI_CHAT_COMPLETIONS / OPENAI_COMPLETIONS
+            # formats, genai-perf llm_inputs.py); max_tokens is part of the
+            # body per OpenAI semantics, and "stream" is baked in here so
+            # the benchmark hot path never re-parses the payload.
+            if output_format == "openai-chat":
+                body = {
+                    "model": model,
+                    "messages": [{"role": "user", "content": prompt}],
+                    "stream": streaming,
+                }
+            else:
+                body = {
+                    "model": model,
+                    "prompt": prompt,
+                    "stream": streaming,
+                }
+            if output_tokens_mean is not None:
+                body["max_tokens"] = max(
+                    1,
+                    int(rng.gauss(output_tokens_mean, output_tokens_stddev)),
+                )
+            entries.append(
+                {"payload": {"content": [json.dumps(body)], "shape": [1]}}
+            )
+            continue
         else:
             raise ValueError(f"unknown output format '{output_format}'")
         if output_tokens_mean is not None:

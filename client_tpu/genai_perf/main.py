@@ -15,6 +15,116 @@ import tempfile
 from typing import List, Optional
 
 
+def build_compare_parser() -> argparse.ArgumentParser:
+    """`compare` subcommand: side-by-side metrics + plots across runs
+    (reference genai-perf compare subcommand + plots/)."""
+    parser = argparse.ArgumentParser(
+        prog="genai-perf-tpu compare",
+        description="Compare profile-export files from multiple runs.",
+    )
+    parser.add_argument(
+        "--files", nargs="+", required=True,
+        help="profile_export.json files to compare",
+    )
+    parser.add_argument(
+        "--names", nargs="*", default=None,
+        help="labels for the runs (default: file stems)",
+    )
+    parser.add_argument("--artifact-dir", default=None)
+    parser.add_argument(
+        "--generate-plots", action="store_true",
+        help="write comparison plots (matplotlib if available)",
+    )
+    return parser
+
+
+def compare_main(argv: List[str]) -> int:
+    import csv
+    import json
+
+    from client_tpu.genai_perf.metrics import LLMProfileDataParser
+
+    args = build_compare_parser().parse_args(argv)
+    artifact_dir = args.artifact_dir or tempfile.mkdtemp(
+        prefix="genai_perf_compare_"
+    )
+    os.makedirs(artifact_dir, exist_ok=True)
+    names = args.names or [
+        os.path.splitext(os.path.basename(f))[0] for f in args.files
+    ]
+    if len(names) != len(args.files):
+        print("error: --names must match --files", file=sys.stderr)
+        return 1
+
+    runs = []
+    for name, path in zip(names, args.files):
+        try:
+            metrics = LLMProfileDataParser(path).parse()
+        except Exception as e:  # noqa: BLE001 - surface per-file errors
+            print(f"error: cannot parse '{path}': {e}", file=sys.stderr)
+            return 1
+        runs.append((name, metrics))
+
+    rows = [
+        ("time to first token avg (ms)",
+         lambda m: m.statistics()["time_to_first_token"].avg / 1e6),
+        ("time to first token p99 (ms)",
+         lambda m: m.statistics()["time_to_first_token"].p99 / 1e6),
+        ("inter-token latency avg (ms)",
+         lambda m: m.statistics()["inter_token_latency"].avg / 1e6),
+        ("request latency avg (ms)",
+         lambda m: m.statistics()["request_latency"].avg / 1e6),
+        ("output token throughput (tok/s)",
+         lambda m: m.output_token_throughput),
+        ("request throughput (req/s)", lambda m: m.request_throughput),
+    ]
+    width = max(len(r[0]) for r in rows) + 2
+    header = " " * width + "".join(f"{n:>18}" for n, _ in runs)
+    print(header)
+    table = []
+    for label, fn in rows:
+        values = []
+        for _, metrics in runs:
+            try:
+                values.append(fn(metrics))
+            except Exception:  # noqa: BLE001 - metric absent for this run
+                values.append(float("nan"))
+        print(f"{label:<{width}}" + "".join(f"{v:>18.2f}" for v in values))
+        table.append((label, values))
+
+    csv_path = os.path.join(artifact_dir, "compare.csv")
+    with open(csv_path, "w", newline="") as f:
+        writer = csv.writer(f)
+        writer.writerow(["metric"] + [n for n, _ in runs])
+        for label, values in table:
+            writer.writerow([label] + values)
+    json_path = os.path.join(artifact_dir, "compare.json")
+    with open(json_path, "w") as f:
+        json.dump(
+            {
+                "runs": [n for n, _ in runs],
+                # null (not NaN) for absent metrics — bare NaN is not JSON.
+                "metrics": {
+                    label: [None if v != v else v for v in values]
+                    for label, values in table
+                },
+            },
+            f,
+            indent=2,
+        )
+    print(f"\nartifacts: {artifact_dir}")
+    if args.generate_plots:
+        try:
+            from client_tpu.genai_perf.plots import generate_comparison_plots
+
+            generate_comparison_plots(
+                list(zip(names, args.files)), artifact_dir
+            )
+        except Exception as e:  # noqa: BLE001 - plots are optional
+            print(f"plot generation skipped: {e}", file=sys.stderr)
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="genai-perf-tpu", description="Benchmark LLM serving (KServe v2)."
@@ -24,14 +134,26 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--service-kind",
         default="triton",
-        choices=["triton"],
+        choices=["triton", "openai"],
         help="backend service flavor",
     )
     parser.add_argument(
         "--endpoint-type",
         default="kserve-ids",
-        choices=["kserve-ids", "kserve-text"],
-        help="input tensor flavor (token ids vs text prompts)",
+        choices=[
+            "kserve-ids",
+            "kserve-text",
+            "openai-chat",
+            "openai-completions",
+        ],
+        help="input flavor: KServe token-id/text tensors, or OpenAI "
+        "chat/completions payloads",
+    )
+    parser.add_argument(
+        "--endpoint",
+        default=None,
+        help="openai: endpoint path (default derives from endpoint type:"
+        " v1/chat/completions or v1/completions)",
     )
     parser.add_argument("--input-name", default="INPUT_IDS")
     parser.add_argument("--num-prompts", type=int, default=50)
@@ -68,6 +190,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    # Subcommand dispatch (reference genai-perf profile/compare); a bare
+    # flag list keeps working as `profile` for compatibility.
+    if argv and argv[0] == "compare":
+        return compare_main(argv[1:])
+    if argv and argv[0] == "profile":
+        argv = argv[1:]
     from client_tpu.genai_perf.inputs import create_llm_inputs
     from client_tpu.genai_perf.metrics import (
         LLMProfileDataParser,
@@ -84,6 +214,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     inputs_path = os.path.join(artifact_dir, "llm_inputs.json")
     export_path = os.path.join(artifact_dir, args.profile_export_file)
 
+    openai = (
+        args.service_kind == "openai"
+        or args.endpoint_type.startswith("openai")
+    )
+    if openai and args.endpoint_type.startswith("kserve"):
+        if args.endpoint_type != "kserve-ids":
+            # The default endpoint-type silently upgrades; an explicit
+            # kserve choice conflicts with the openai service kind.
+            print(
+                "error: --service-kind openai is incompatible with "
+                f"--endpoint-type {args.endpoint_type}",
+                file=sys.stderr,
+            )
+            return 1
+        args.endpoint_type = "openai-chat"
+    if args.endpoint is None:
+        args.endpoint = (
+            "v1/completions"
+            if args.endpoint_type == "openai-completions"
+            else "v1/chat/completions"
+        )
+
     tokenizer = get_tokenizer(args.tokenizer)
     create_llm_inputs(
         inputs_path,
@@ -95,19 +247,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         output_format=args.endpoint_type,
         input_name=args.input_name,
         tokenizer=tokenizer,
+        model=args.model,
+        streaming=openai and args.streaming,
     )
 
     # Build the perf-harness invocation (reference wrapper.Profiler role).
     perf_args = [
         "-m", args.model,
         "-u", args.url,
-        "-i", "grpc",
         "--input-data", inputs_path,
         "--measurement-interval", str(args.measurement_interval),
         "--stability-percentage", str(args.stability_percentage),
         "--max-trials", str(args.max_trials),
         "--profile-export-file", export_path,
     ]
+    if openai:
+        perf_args += ["--service-kind", "openai", "--endpoint", args.endpoint]
+    else:
+        perf_args += ["-i", "grpc"]
     if args.streaming:
         perf_args.append("--streaming")
     # output lengths are embedded per request in the generated input data

@@ -52,3 +52,55 @@ def generate_plots(profile_export_path: str, artifact_dir: str) -> None:
         fig.tight_layout()
         fig.savefig(os.path.join(artifact_dir, "token_timeline.png"))
         plt.close(fig)
+
+
+def _extract_times_ms(profile_export_path: str):
+    """(ttfts_ms, latencies_ms) from a profile export's first experiment."""
+    with open(profile_export_path) as f:
+        doc = json.load(f)
+    experiments = doc.get("experiments", [])
+    requests = experiments[0].get("requests", []) if experiments else []
+    timed = [r for r in requests if r.get("response_timestamps")]
+    ttfts = [(r["response_timestamps"][0] - r["timestamp"]) / 1e6 for r in timed]
+    latencies = [
+        (r["response_timestamps"][-1] - r["timestamp"]) / 1e6 for r in timed
+    ]
+    return ttfts, latencies
+
+
+def _comparison_boxplot(plt, data, labels, ylabel, title, path):
+    fig, ax = plt.subplots(figsize=(max(6, 2 * len(labels)), 4))
+    ax.boxplot(data, tick_labels=labels, showfliers=False)
+    ax.set_ylabel(ylabel)
+    ax.set_title(title)
+    fig.tight_layout()
+    fig.savefig(path)
+    plt.close(fig)
+
+
+def generate_comparison_plots(named_paths, artifact_dir: str) -> None:
+    """Cross-run comparison plots for the `compare` subcommand
+    (reference genai-perf plots/: scatter/box across runs).
+
+    named_paths: list of (label, profile_export_path).
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    runs = []
+    for label, path in named_paths:
+        ttfts, latencies = _extract_times_ms(path)
+        if ttfts:
+            runs.append((label, ttfts, latencies))
+    if not runs:
+        return
+    labels = [label for label, _, _ in runs]
+    _comparison_boxplot(
+        plt, [t for _, t, _ in runs], labels, "time to first token (ms)",
+        "TTFT by run", os.path.join(artifact_dir, "compare_ttft_box.png"))
+    _comparison_boxplot(
+        plt, [l for _, _, l in runs], labels, "request latency (ms)",
+        "Request latency by run",
+        os.path.join(artifact_dir, "compare_latency_box.png"))

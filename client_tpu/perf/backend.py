@@ -386,6 +386,139 @@ class MockPerfBackend(PerfBackend):
             on_response()
 
 
+class OpenAiPerfBackend(PerfBackend):
+    """OpenAI-compatible endpoint backend with SSE streaming (role of the
+    reference openai client backend, client_backend/openai/openai_client.h).
+
+    Requests come from a BYTES input named ``payload`` whose element is the
+    JSON request body (the genai-perf openai-* input formats)."""
+
+    kind = "openai"
+    supports_streaming = True
+
+    def __init__(self, url: str, endpoint: str = "v1/chat/completions"):
+        self._base = f"http://{url}/{endpoint.lstrip('/')}"
+        self._session = None
+
+    def _ensure_session(self):
+        import aiohttp
+
+        if self._session is None:
+            self._session = aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(limit=0)
+            )
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def get_model_metadata(self, model_name, model_version=""):
+        # No KServe metadata on OpenAI endpoints; fabricate the payload
+        # contract (reference model_parser InitOpenAI).
+        return {
+            "name": model_name,
+            "platform": "openai",
+            "inputs": [
+                {"name": "payload", "datatype": "BYTES", "shape": [1]}
+            ],
+            "outputs": [],
+        }
+
+    async def get_model_config(self, model_name, model_version=""):
+        return {
+            "name": model_name,
+            "max_batch_size": 0,
+            "model_transaction_policy": {"decoupled": True},
+        }
+
+    @staticmethod
+    def _payload(inputs) -> str:
+        for t in inputs:
+            if t.name == "payload":
+                element = np.asarray(t.data, dtype=object).reshape(-1)[0]
+                if isinstance(element, bytes):
+                    return element.decode("utf-8")
+                return str(element)
+        raise InferenceServerException(
+            "openai backend needs a BYTES input named 'payload'"
+        )
+
+    async def infer(self, model_name, inputs, **kwargs):
+        session = self._ensure_session()
+        async with session.post(
+            self._base,
+            data=self._payload(inputs).encode(),
+            headers={"Content-Type": "application/json"},
+        ) as resp:
+            body = await resp.read()
+            if resp.status != 200:
+                raise InferenceServerException(
+                    f"openai endpoint HTTP {resp.status}: {body[:200]!r}"
+                )
+
+    @staticmethod
+    def sse_event_is_token(data: bytes) -> bool:
+        """True if an SSE data event carries generated content. Empty-delta
+        finish chunks must not count as tokens, and in-band errors raise —
+        otherwise token counts/ITL would be silently wrong."""
+        import json as jsonlib
+
+        try:
+            doc = jsonlib.loads(data)
+        except ValueError:
+            return True  # unknown shape: count rather than drop
+        if "error" in doc:
+            message = doc["error"]
+            if isinstance(message, dict):
+                message = message.get("message", str(message))
+            raise InferenceServerException(f"openai stream error: {message}")
+        for choice in doc.get("choices", []):
+            delta = choice.get("delta", {})
+            if delta.get("content"):
+                return True
+            if choice.get("text"):
+                return True
+        return False
+
+    async def stream_infer(self, model_name, inputs, on_response, **kwargs):
+        import json as jsonlib
+
+        payload = self._payload(inputs)
+        if '"stream"' not in payload:
+            doc = jsonlib.loads(payload)
+            doc["stream"] = True
+            payload = jsonlib.dumps(doc)
+        session = self._ensure_session()
+        async with session.post(
+            self._base,
+            data=payload.encode(),
+            headers={"Content-Type": "application/json"},
+        ) as resp:
+            if resp.status != 200:
+                body = await resp.read()
+                raise InferenceServerException(
+                    f"openai endpoint HTTP {resp.status}: {body[:200]!r}"
+                )
+            buf = b""
+            async for chunk in resp.content.iter_any():
+                buf += chunk
+                while True:
+                    for sep in (b"\n\n", b"\r\n\r\n"):
+                        pos = buf.find(sep)
+                        if pos >= 0:
+                            event, buf = buf[:pos], buf[pos + len(sep):]
+                            break
+                    else:
+                        break
+                    if not event.startswith(b"data:"):
+                        continue
+                    data = event[5:].strip()
+                    if data != b"[DONE]" and self.sse_event_is_token(data):
+                        on_response()
+
+
 def create_backend(
     kind: str,
     url: str = "",
@@ -397,6 +530,8 @@ def create_backend(
         return HttpPerfBackend(url, **kwargs)
     if kind == "grpc":
         return GrpcPerfBackend(url)
+    if kind == "openai":
+        return OpenAiPerfBackend(url, **kwargs)
     if kind == "local":
         if core is None:
             raise InferenceServerException(

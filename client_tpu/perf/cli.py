@@ -43,6 +43,17 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["http", "grpc"],
         help="service protocol",
     )
+    parser.add_argument(
+        "--service-kind",
+        default="kserve",
+        choices=["kserve", "openai"],
+        help="kserve (default) or an OpenAI-compatible endpoint",
+    )
+    parser.add_argument(
+        "--endpoint",
+        default="v1/chat/completions",
+        help="openai: endpoint path",
+    )
     parser.add_argument("-b", "--batch-size", type=int, default=1)
     parser.add_argument(
         "--concurrency-range",
@@ -186,7 +197,10 @@ async def run(args) -> int:
     )
     from client_tpu.perf.sequence import SequenceManager
 
-    backend = create_backend(args.protocol, args.url)
+    if args.service_kind == "openai":
+        backend = create_backend("openai", args.url, endpoint=args.endpoint)
+    else:
+        backend = create_backend(args.protocol, args.url)
     if args.streaming and not backend.supports_streaming:
         print(
             f"error: --streaming is not supported by the '{args.protocol}' "

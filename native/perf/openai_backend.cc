@@ -71,6 +71,36 @@ size_t ConsumeSseEvents(std::string* buf, bool* done,
   return count;
 }
 
+bool SseEventIsToken(const std::string& data, std::string* error) {
+  // Empty-delta finish chunks don't count as tokens; in-band errors fail
+  // the request instead of inflating its token count.
+  json::Value doc;
+  try {
+    doc = json::Parse(data);
+  } catch (const std::exception&) {
+    return true;  // unknown shape: count rather than drop
+  }
+  if (doc.Has("error")) {
+    const json::Value& err = doc["error"];
+    *error = err.IsObject() && err["message"].IsString()
+                 ? err["message"].AsString()
+                 : (err.IsString() ? err.AsString() : data);
+    return false;
+  }
+  if (!doc["choices"].IsArray()) return true;
+  for (const auto& choice : doc["choices"].AsArray()) {
+    const json::Value& delta = choice["delta"];
+    if (delta.IsObject() && delta["content"].IsString() &&
+        !delta["content"].AsString().empty()) {
+      return true;
+    }
+    if (choice["text"].IsString() && !choice["text"].AsString().empty()) {
+      return true;
+    }
+  }
+  return false;
+}
+
 Error OpenAiClientBackend::Create(const std::string& url,
                                   const std::string& endpoint, bool streaming,
                                   std::shared_ptr<ClientBackend>* backend) {
@@ -154,30 +184,38 @@ Error OpenAiBackendContext::Infer(
   if (streaming_) {
     sse_buf_.clear();
     bool done = false;
-    size_t events = 0;
+    std::string stream_error;
     err = conn_.RoundtripStream(
         "POST", path_, headers, payload.data(), payload.size(), &status,
         &resp_headers,
         [&](const char* data, size_t len) {
           sse_buf_.append(data, len);
           bool chunk_done = false;
-          const size_t n = ConsumeSseEvents(&sse_buf_, &chunk_done, nullptr);
+          std::vector<std::string> events;
+          ConsumeSseEvents(&sse_buf_, &chunk_done, &events);
           const uint64_t now = RequestTimers::Now();
-          for (size_t i = 0; i < n; ++i) record->response_ns.push_back(now);
-          events += n;
+          for (const std::string& event : events) {
+            std::string event_error;
+            if (SseEventIsToken(event, &event_error)) {
+              record->response_ns.push_back(now);
+            } else if (!event_error.empty() && stream_error.empty()) {
+              stream_error = event_error;
+            }
+          }
           done = done || chunk_done;
         },
         options.client_timeout_us);
     record->end_ns = record->response_ns.empty()
                          ? RequestTimers::Now()
                          : record->response_ns.back();
-    if (!err.IsOk() || status != 200) {
+    if (!err.IsOk() || status != 200 || !stream_error.empty()) {
       record->success = false;
-      record->error = err.IsOk()
-                          ? "openai endpoint returned HTTP " +
-                                std::to_string(status)
-                          : err.Message();
-      return err.IsOk() ? Error(record->error) : err;
+      record->error = !err.IsOk() ? err.Message()
+                      : !stream_error.empty()
+                          ? "openai stream error: " + stream_error
+                          : "openai endpoint returned HTTP " +
+                                std::to_string(status);
+      return Error(record->error);
     }
     record->success = true;
     return Error::Success();

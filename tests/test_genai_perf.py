@@ -147,3 +147,91 @@ def test_genai_perf_end_to_end(tmp_path, capsys):
     assert report["output_token_throughput_per_s"] > 0
     assert (tmp_path / "llm_inputs.json").exists()
     assert (tmp_path / "profile_export.json").exists()
+
+
+def test_compare_subcommand(tmp_path, capsys):
+    """`compare` prints a side-by-side table, writes CSV/JSON, and (with
+    matplotlib present) box plots."""
+    from client_tpu.genai_perf.main import main
+
+    ms = 1_000_000
+
+    def export(path, scale):
+        doc = {
+            "experiments": [
+                {
+                    "experiment": {"mode": "concurrency", "value": 1},
+                    "requests": [
+                        {
+                            "timestamp": i * ms,
+                            "response_timestamps": [
+                                (i + 5 * scale) * ms,
+                                (i + 7 * scale) * ms,
+                            ],
+                            "success": True,
+                        }
+                        for i in range(10)
+                    ],
+                }
+            ]
+        }
+        path.write_text(json.dumps(doc))
+
+    export(tmp_path / "run_a.json", 1)
+    export(tmp_path / "run_b.json", 2)
+    out_dir = tmp_path / "artifacts"
+    code = main(
+        [
+            "compare",
+            "--files", str(tmp_path / "run_a.json"),
+            str(tmp_path / "run_b.json"),
+            "--names", "baseline", "candidate",
+            "--artifact-dir", str(out_dir),
+            "--generate-plots",
+        ]
+    )
+    assert code == 0
+    out = capsys.readouterr().out
+    assert "baseline" in out and "candidate" in out
+    assert "time to first token avg (ms)" in out
+    doc = json.loads((out_dir / "compare.json").read_text())
+    assert doc["runs"] == ["baseline", "candidate"]
+    ttft = doc["metrics"]["time to first token avg (ms)"]
+    assert ttft[1] == pytest.approx(2 * ttft[0])
+    assert (out_dir / "compare.csv").exists()
+    assert (out_dir / "compare_ttft_box.png").exists()
+
+
+def test_genai_perf_openai_end_to_end(tmp_path, capsys):
+    """OpenAI service-kind: payload generation -> SSE streaming benchmark
+    against the in-repo /v1/chat/completions front-end."""
+    from client_tpu.genai_perf.main import main
+    from client_tpu.models.serving import LlmDecodeModel
+    from client_tpu.server.core import ServerCore
+    from client_tpu.server.model_repository import ModelRepository
+    from client_tpu.testing import InProcessServer
+
+    repository = ModelRepository()
+    core = ServerCore(repository)
+    repository.add_model(LlmDecodeModel())
+    with InProcessServer(core=core, grpc=False, builtin_models=False) as server:
+        code = main(
+            [
+                "-m", "llm_decode",
+                "-u", f"127.0.0.1:{server.http_port}",
+                "--service-kind", "openai",
+                "--endpoint-type", "openai-chat",
+                "--num-prompts", "8",
+                "--synthetic-input-tokens-mean", "12",
+                "--output-tokens-mean", "6",
+                "--concurrency", "2",
+                "--measurement-interval", "1500",
+                "--stability-percentage", "80",
+                "--max-trials", "3",
+                "--artifact-dir", str(tmp_path),
+            ]
+        )
+    assert code == 0
+    report = json.loads((tmp_path / "llm_metrics.json").read_text())
+    assert report["request_count"] > 0
+    assert report["inter_token_latency"]["count"] > 0
