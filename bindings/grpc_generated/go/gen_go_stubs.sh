@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Generate Go gRPC stubs from the in-repo protos
+# (role of reference src/grpc_generated/go/gen_go_stubs.sh).
+#
+# Requires: protoc, protoc-gen-go, protoc-gen-go-grpc on PATH:
+#   go install google.golang.org/protobuf/cmd/protoc-gen-go@latest
+#   go install google.golang.org/grpc/cmd/protoc-gen-go-grpc@latest
+set -euo pipefail
+cd "$(dirname "$0")"
+REPO=../../..
+
+STAGE=$(mktemp -d)
+trap 'rm -rf "$STAGE"' EXIT
+mkdir -p "$STAGE/client_tpu/grpc/_generated"
+cp "$REPO"/client_tpu/protos/model_config.proto \
+   "$REPO"/client_tpu/protos/grpc_service.proto \
+   "$STAGE/client_tpu/grpc/_generated/"
+
+mkdir -p grpc-client
+protoc -I "$STAGE" \
+  --go_out=grpc-client --go_opt=paths=source_relative \
+  --go_opt=Mclient_tpu/grpc/_generated/grpc_service.proto=clienttpu/grpc \
+  --go_opt=Mclient_tpu/grpc/_generated/model_config.proto=clienttpu/grpc \
+  --go-grpc_out=grpc-client --go-grpc_opt=paths=source_relative \
+  --go-grpc_opt=Mclient_tpu/grpc/_generated/grpc_service.proto=clienttpu/grpc \
+  --go-grpc_opt=Mclient_tpu/grpc/_generated/model_config.proto=clienttpu/grpc \
+  "$STAGE/client_tpu/grpc/_generated/model_config.proto" \
+  "$STAGE/client_tpu/grpc/_generated/grpc_service.proto"
+echo "stubs generated under grpc-client/"

@@ -1,0 +1,85 @@
+// Simple Go gRPC client for the `simple` add_sub model
+// (role of reference src/grpc_generated/go/grpc_simple_client.go).
+//
+// Build after running gen_go_stubs.sh:
+//
+//	go mod init clienttpu-example && go mod tidy && go run .
+package main
+
+import (
+	"bytes"
+	"context"
+	"encoding/binary"
+	"flag"
+	"log"
+	"time"
+
+	pb "clienttpu/grpc"
+
+	"google.golang.org/grpc"
+	"google.golang.org/grpc/credentials/insecure"
+)
+
+func packInt32(values []int32) []byte {
+	buf := new(bytes.Buffer)
+	for _, v := range values {
+		binary.Write(buf, binary.LittleEndian, v)
+	}
+	return buf.Bytes()
+}
+
+func unpackInt32(raw []byte) []int32 {
+	out := make([]int32, len(raw)/4)
+	binary.Read(bytes.NewReader(raw), binary.LittleEndian, &out)
+	return out
+}
+
+func main() {
+	url := flag.String("u", "localhost:8001", "server host:port")
+	flag.Parse()
+
+	conn, err := grpc.NewClient(*url,
+		grpc.WithTransportCredentials(insecure.NewCredentials()))
+	if err != nil {
+		log.Fatalf("connect: %v", err)
+	}
+	defer conn.Close()
+	client := pb.NewGRPCInferenceServiceClient(conn)
+	ctx, cancel := context.WithTimeout(context.Background(), 30*time.Second)
+	defer cancel()
+
+	live, err := client.ServerLive(ctx, &pb.ServerLiveRequest{})
+	if err != nil || !live.GetLive() {
+		log.Fatalf("server not live: %v", err)
+	}
+
+	input0 := make([]int32, 16)
+	input1 := make([]int32, 16)
+	for i := range input0 {
+		input0[i] = int32(i)
+		input1[i] = 1
+	}
+	request := &pb.ModelInferRequest{
+		ModelName: "simple",
+		Inputs: []*pb.ModelInferRequest_InferInputTensor{
+			{Name: "INPUT0", Datatype: "INT32", Shape: []int64{1, 16}},
+			{Name: "INPUT1", Datatype: "INT32", Shape: []int64{1, 16}},
+		},
+		Outputs: []*pb.ModelInferRequest_InferRequestedOutputTensor{
+			{Name: "OUTPUT0"}, {Name: "OUTPUT1"},
+		},
+		RawInputContents: [][]byte{packInt32(input0), packInt32(input1)},
+	}
+	response, err := client.ModelInfer(ctx, request)
+	if err != nil {
+		log.Fatalf("infer: %v", err)
+	}
+	sum := unpackInt32(response.RawOutputContents[0])
+	diff := unpackInt32(response.RawOutputContents[1])
+	for i := range input0 {
+		if sum[i] != input0[i]+input1[i] || diff[i] != input0[i]-input1[i] {
+			log.Fatalf("incorrect result at %d", i)
+		}
+	}
+	log.Println("PASS : go grpc_simple_client")
+}

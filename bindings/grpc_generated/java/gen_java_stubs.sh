@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Generate Java gRPC stubs from the in-repo protos
+# (role of reference src/grpc_generated/java — gradle library + examples).
+#
+# Requires: protoc and the protoc-gen-grpc-java plugin
+# (https://github.com/grpc/grpc-java/tree/master/compiler).
+set -euo pipefail
+cd "$(dirname "$0")"
+REPO=../../..
+PLUGIN=${GRPC_JAVA_PLUGIN:-protoc-gen-grpc-java}
+
+STAGE=$(mktemp -d)
+trap 'rm -rf "$STAGE"' EXIT
+mkdir -p "$STAGE/client_tpu/grpc/_generated"
+cp "$REPO"/client_tpu/protos/model_config.proto \
+   "$REPO"/client_tpu/protos/grpc_service.proto \
+   "$STAGE/client_tpu/grpc/_generated/"
+
+mkdir -p src/main/java
+protoc -I "$STAGE" \
+  --java_out=src/main/java \
+  --plugin=protoc-gen-grpc-java="$(command -v "$PLUGIN")" \
+  --grpc-java_out=src/main/java \
+  "$STAGE/client_tpu/grpc/_generated/model_config.proto" \
+  "$STAGE/client_tpu/grpc/_generated/grpc_service.proto"
+echo "stubs generated under src/main/java/"

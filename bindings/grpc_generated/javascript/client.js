@@ -1,0 +1,83 @@
+// Simple Node.js gRPC client for the `simple` add_sub model using dynamic
+// proto loading (role of reference src/grpc_generated/javascript/client.js).
+//
+//   npm install @grpc/grpc-js @grpc/proto-loader
+//   node client.js [host:port]
+"use strict";
+
+const path = require("path");
+const grpc = require("@grpc/grpc-js");
+const protoLoader = require("@grpc/proto-loader");
+
+const PROTO_DIR = path.join(__dirname, "..", "..", "..", "client_tpu", "protos");
+
+const packageDefinition = protoLoader.loadSync(
+  path.join(PROTO_DIR, "grpc_service.proto"),
+  {
+    keepCase: true,
+    longs: Number,
+    enums: String,
+    includeDirs: [
+      PROTO_DIR,
+      // the proto imports via the python package path
+      path.join(__dirname, "..", "..", ".."),
+    ],
+  }
+);
+const inference = grpc.loadPackageDefinition(packageDefinition).inference;
+
+function packInt32(values) {
+  const buf = Buffer.alloc(values.length * 4);
+  values.forEach((v, i) => buf.writeInt32LE(v, i * 4));
+  return buf;
+}
+
+function unpackInt32(buf) {
+  const out = [];
+  for (let i = 0; i < buf.length; i += 4) out.push(buf.readInt32LE(i));
+  return out;
+}
+
+function main() {
+  const url = process.argv[2] || "localhost:8001";
+  const client = new inference.GRPCInferenceService(
+    url,
+    grpc.credentials.createInsecure()
+  );
+
+  const input0 = Array.from({ length: 16 }, (_, i) => i);
+  const input1 = Array.from({ length: 16 }, () => 1);
+
+  client.ServerLive({}, (err, resp) => {
+    if (err || !resp.live) {
+      console.error("server not live:", err);
+      process.exit(1);
+    }
+    const request = {
+      model_name: "simple",
+      inputs: [
+        { name: "INPUT0", datatype: "INT32", shape: [1, 16] },
+        { name: "INPUT1", datatype: "INT32", shape: [1, 16] },
+      ],
+      outputs: [{ name: "OUTPUT0" }, { name: "OUTPUT1" }],
+      raw_input_contents: [packInt32(input0), packInt32(input1)],
+    };
+    client.ModelInfer(request, (err2, response) => {
+      if (err2) {
+        console.error("infer failed:", err2);
+        process.exit(1);
+      }
+      const sum = unpackInt32(response.raw_output_contents[0]);
+      const diff = unpackInt32(response.raw_output_contents[1]);
+      for (let i = 0; i < 16; i++) {
+        if (sum[i] !== input0[i] + input1[i] || diff[i] !== input0[i] - input1[i]) {
+          console.error("incorrect result at", i);
+          process.exit(1);
+        }
+      }
+      console.log("PASS : javascript client");
+    });
+  });
+}
+
+main();

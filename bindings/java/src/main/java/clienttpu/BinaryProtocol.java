@@ -1,0 +1,101 @@
+// KServe v2 HTTP binary-extension framing.
+//
+// Role parity with the reference Java client's BinaryProtocol
+// (reference src/java/src/main/java/triton/client/BinaryProtocol.java):
+// little-endian scalar packing and the 4-byte-length-prefixed BYTES
+// element encoding shared with the Python/C++ clients.
+package clienttpu;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.charset.StandardCharsets;
+import java.util.ArrayList;
+import java.util.List;
+
+public final class BinaryProtocol {
+    private BinaryProtocol() {}
+
+    public static byte[] packInts(int[] values) {
+        ByteBuffer buf =
+            ByteBuffer.allocate(values.length * 4).order(ByteOrder.LITTLE_ENDIAN);
+        for (int v : values) buf.putInt(v);
+        return buf.array();
+    }
+
+    public static byte[] packLongs(long[] values) {
+        ByteBuffer buf =
+            ByteBuffer.allocate(values.length * 8).order(ByteOrder.LITTLE_ENDIAN);
+        for (long v : values) buf.putLong(v);
+        return buf.array();
+    }
+
+    public static byte[] packFloats(float[] values) {
+        ByteBuffer buf =
+            ByteBuffer.allocate(values.length * 4).order(ByteOrder.LITTLE_ENDIAN);
+        for (float v : values) buf.putFloat(v);
+        return buf.array();
+    }
+
+    public static byte[] packDoubles(double[] values) {
+        ByteBuffer buf =
+            ByteBuffer.allocate(values.length * 8).order(ByteOrder.LITTLE_ENDIAN);
+        for (double v : values) buf.putDouble(v);
+        return buf.array();
+    }
+
+    /** 4-byte-length-prefixed BYTES elements (UTF-8 strings). */
+    public static byte[] packStrings(String[] values) {
+        int total = 0;
+        byte[][] encoded = new byte[values.length][];
+        for (int i = 0; i < values.length; i++) {
+            encoded[i] = values[i].getBytes(StandardCharsets.UTF_8);
+            total += 4 + encoded[i].length;
+        }
+        ByteBuffer buf = ByteBuffer.allocate(total).order(ByteOrder.LITTLE_ENDIAN);
+        for (byte[] e : encoded) {
+            buf.putInt(e.length);
+            buf.put(e);
+        }
+        return buf.array();
+    }
+
+    public static int[] unpackInts(byte[] data) {
+        ByteBuffer buf = ByteBuffer.wrap(data).order(ByteOrder.LITTLE_ENDIAN);
+        int[] out = new int[data.length / 4];
+        for (int i = 0; i < out.length; i++) out[i] = buf.getInt();
+        return out;
+    }
+
+    public static long[] unpackLongs(byte[] data) {
+        ByteBuffer buf = ByteBuffer.wrap(data).order(ByteOrder.LITTLE_ENDIAN);
+        long[] out = new long[data.length / 8];
+        for (int i = 0; i < out.length; i++) out[i] = buf.getLong();
+        return out;
+    }
+
+    public static float[] unpackFloats(byte[] data) {
+        ByteBuffer buf = ByteBuffer.wrap(data).order(ByteOrder.LITTLE_ENDIAN);
+        float[] out = new float[data.length / 4];
+        for (int i = 0; i < out.length; i++) out[i] = buf.getFloat();
+        return out;
+    }
+
+    public static double[] unpackDoubles(byte[] data) {
+        ByteBuffer buf = ByteBuffer.wrap(data).order(ByteOrder.LITTLE_ENDIAN);
+        double[] out = new double[data.length / 8];
+        for (int i = 0; i < out.length; i++) out[i] = buf.getDouble();
+        return out;
+    }
+
+    public static List<String> unpackStrings(byte[] data) {
+        ByteBuffer buf = ByteBuffer.wrap(data).order(ByteOrder.LITTLE_ENDIAN);
+        List<String> out = new ArrayList<>();
+        while (buf.remaining() >= 4) {
+            int len = buf.getInt();
+            byte[] element = new byte[len];
+            buf.get(element);
+            out.add(new String(element, StandardCharsets.UTF_8));
+        }
+        return out;
+    }
+}

@@ -1,0 +1,50 @@
+// One named input tensor for an inference request.
+//
+// Role parity with the reference Java client's InferInput
+// (reference src/java/src/main/java/triton/client/InferInput.java):
+// typed setters serialize to the binary extension's raw layout.
+package clienttpu;
+
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+public class InferInput {
+    private final String name;
+    private final long[] shape;
+    private final String datatype;
+    private byte[] data = new byte[0];
+
+    public InferInput(String name, long[] shape, String datatype) {
+        this.name = name;
+        this.shape = shape;
+        this.datatype = datatype;
+    }
+
+    public String getName() { return name; }
+    public String getDatatype() { return datatype; }
+    public long[] getShape() { return shape; }
+    public byte[] getData() { return data; }
+
+    public void setData(int[] values) { data = BinaryProtocol.packInts(values); }
+    public void setData(long[] values) { data = BinaryProtocol.packLongs(values); }
+    public void setData(float[] values) { data = BinaryProtocol.packFloats(values); }
+    public void setData(double[] values) { data = BinaryProtocol.packDoubles(values); }
+    public void setData(String[] values) { data = BinaryProtocol.packStrings(values); }
+    public void setRaw(byte[] raw) { data = raw; }
+
+    /** JSON header fragment (binary_data_size parameter included). */
+    Map<String, Object> toHeader() {
+        Map<String, Object> tensor = new LinkedHashMap<>();
+        tensor.put("name", name);
+        List<Object> dims = new ArrayList<>();
+        for (long d : shape) dims.add(d);
+        tensor.put("shape", dims);
+        tensor.put("datatype", datatype);
+        Map<String, Object> params = new LinkedHashMap<>();
+        params.put("binary_data_size", (long) data.length);
+        tensor.put("parameters", params);
+        return tensor;
+    }
+}

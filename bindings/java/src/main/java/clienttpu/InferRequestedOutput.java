@@ -1,0 +1,31 @@
+// One requested output (reference InferRequestedOutput.java).
+package clienttpu;
+
+import java.util.LinkedHashMap;
+import java.util.Map;
+
+public class InferRequestedOutput {
+    private final String name;
+    private final boolean binaryData;
+    private final int classCount;
+
+    public InferRequestedOutput(String name) { this(name, true, 0); }
+
+    public InferRequestedOutput(String name, boolean binaryData, int classCount) {
+        this.name = name;
+        this.binaryData = binaryData;
+        this.classCount = classCount;
+    }
+
+    public String getName() { return name; }
+
+    Map<String, Object> toHeader() {
+        Map<String, Object> out = new LinkedHashMap<>();
+        out.put("name", name);
+        Map<String, Object> params = new LinkedHashMap<>();
+        params.put("binary_data", binaryData);
+        if (classCount > 0) params.put("classification", (long) classCount);
+        out.put("parameters", params);
+        return out;
+    }
+}

@@ -1,0 +1,91 @@
+// Parsed inference response: JSON header + binary output section
+// (reference InferResult.java).
+package clienttpu;
+
+import java.util.Arrays;
+import java.util.HashMap;
+import java.util.List;
+import java.util.Map;
+
+public class InferResult {
+    private final Map<String, Object> header;
+    private final Map<String, byte[]> binaryOutputs = new HashMap<>();
+    private final Map<String, Map<String, Object>> outputs = new HashMap<>();
+
+    @SuppressWarnings("unchecked")
+    InferResult(byte[] body, int jsonLength) {
+        String json = new String(body, 0, jsonLength,
+            java.nio.charset.StandardCharsets.UTF_8);
+        header = (Map<String, Object>) Json.parse(json);
+        int offset = jsonLength;
+        Object outs = header.get("outputs");
+        if (outs instanceof List) {
+            for (Object o : (List<Object>) outs) {
+                Map<String, Object> tensor = (Map<String, Object>) o;
+                String name = (String) tensor.get("name");
+                outputs.put(name, tensor);
+                Map<String, Object> params =
+                    (Map<String, Object>) tensor.getOrDefault("parameters", Map.of());
+                Object size = params.get("binary_data_size");
+                if (size instanceof Long) {
+                    int n = ((Long) size).intValue();
+                    binaryOutputs.put(name,
+                        Arrays.copyOfRange(body, offset, offset + n));
+                    offset += n;
+                }
+            }
+        }
+    }
+
+    public String getModelName() { return (String) header.get("model_name"); }
+    public String getId() { return (String) header.get("id"); }
+
+    @SuppressWarnings("unchecked")
+    public long[] getShape(String outputName) {
+        List<Object> dims = (List<Object>) output(outputName).get("shape");
+        long[] out = new long[dims.size()];
+        for (int i = 0; i < out.length; i++) out[i] = (Long) dims.get(i);
+        return out;
+    }
+
+    public String getDatatype(String outputName) {
+        return (String) output(outputName).get("datatype");
+    }
+
+    public byte[] getRaw(String outputName) {
+        byte[] raw = binaryOutputs.get(outputName);
+        if (raw == null) {
+            throw new IllegalArgumentException(
+                "output '" + outputName + "' has no binary data");
+        }
+        return raw;
+    }
+
+    public int[] getOutputAsInts(String name) {
+        return BinaryProtocol.unpackInts(getRaw(name));
+    }
+
+    public long[] getOutputAsLongs(String name) {
+        return BinaryProtocol.unpackLongs(getRaw(name));
+    }
+
+    public float[] getOutputAsFloats(String name) {
+        return BinaryProtocol.unpackFloats(getRaw(name));
+    }
+
+    public double[] getOutputAsDoubles(String name) {
+        return BinaryProtocol.unpackDoubles(getRaw(name));
+    }
+
+    public List<String> getOutputAsStrings(String name) {
+        return BinaryProtocol.unpackStrings(getRaw(name));
+    }
+
+    private Map<String, Object> output(String name) {
+        Map<String, Object> tensor = outputs.get(name);
+        if (tensor == null) {
+            throw new IllegalArgumentException("no output named '" + name + "'");
+        }
+        return tensor;
+    }
+}

@@ -1,0 +1,210 @@
+// KServe v2 HTTP client over java.net.http (Java 11+), zero dependencies.
+//
+// Capability parity with the reference Java client
+// (reference src/java/src/main/java/triton/client/InferenceServerClient.java,
+// 468 LoC on Apache HttpAsyncClient): health, metadata, model control,
+// statistics, and binary-protocol inference, sync + async. This build uses
+// the JDK's HttpClient instead of Apache HC — no jars to vendor, and async
+// falls out of sendAsync.
+package clienttpu;
+
+import java.io.IOException;
+import java.net.URI;
+import java.net.http.HttpClient;
+import java.net.http.HttpRequest;
+import java.net.http.HttpResponse;
+import java.nio.charset.StandardCharsets;
+import java.time.Duration;
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+import java.util.concurrent.CompletableFuture;
+
+public class InferenceServerClient {
+    private final String base;
+    private final HttpClient http;
+    private final Duration requestTimeout;
+
+    public InferenceServerClient(String url, double connectTimeoutS,
+                                 double requestTimeoutS) {
+        this.base = url.startsWith("http") ? url : "http://" + url;
+        this.http = HttpClient.newBuilder()
+            .connectTimeout(Duration.ofMillis((long) (connectTimeoutS * 1000)))
+            .build();
+        this.requestTimeout = Duration.ofMillis((long) (requestTimeoutS * 1000));
+    }
+
+    // ---- health / metadata ----
+
+    public boolean isServerLive() throws IOException, InterruptedException {
+        return get("/v2/health/live").statusCode() == 200;
+    }
+
+    public boolean isServerReady() throws IOException, InterruptedException {
+        return get("/v2/health/ready").statusCode() == 200;
+    }
+
+    public boolean isModelReady(String model)
+            throws IOException, InterruptedException {
+        return get("/v2/models/" + model + "/ready").statusCode() == 200;
+    }
+
+    @SuppressWarnings("unchecked")
+    public Map<String, Object> getServerMetadata()
+            throws IOException, InterruptedException {
+        return (Map<String, Object>) Json.parse(checked(get("/v2")).body());
+    }
+
+    @SuppressWarnings("unchecked")
+    public Map<String, Object> getModelMetadata(String model)
+            throws IOException, InterruptedException {
+        return (Map<String, Object>)
+            Json.parse(checked(get("/v2/models/" + model)).body());
+    }
+
+    @SuppressWarnings("unchecked")
+    public Map<String, Object> getModelConfig(String model)
+            throws IOException, InterruptedException {
+        return (Map<String, Object>)
+            Json.parse(checked(get("/v2/models/" + model + "/config")).body());
+    }
+
+    @SuppressWarnings("unchecked")
+    public Map<String, Object> getInferenceStatistics(String model)
+            throws IOException, InterruptedException {
+        return (Map<String, Object>)
+            Json.parse(checked(get("/v2/models/" + model + "/stats")).body());
+    }
+
+    // ---- model control ----
+
+    public void loadModel(String model) throws IOException, InterruptedException {
+        checkedBytes(postJson("/v2/repository/models/" + model + "/load", "{}"));
+    }
+
+    public void unloadModel(String model)
+            throws IOException, InterruptedException {
+        checkedBytes(postJson("/v2/repository/models/" + model + "/unload", "{}"));
+    }
+
+    // ---- inference ----
+
+    public InferResult infer(String model, List<InferInput> inputs,
+                             List<InferRequestedOutput> outputs)
+            throws IOException, InterruptedException {
+        Request req = buildInferRequest(model, inputs, outputs);
+        HttpResponse<byte[]> resp = http.send(
+            req.httpRequest, HttpResponse.BodyHandlers.ofByteArray());
+        return parseInferResponse(resp);
+    }
+
+    public CompletableFuture<InferResult> inferAsync(
+            String model, List<InferInput> inputs,
+            List<InferRequestedOutput> outputs) {
+        Request req = buildInferRequest(model, inputs, outputs);
+        return http.sendAsync(req.httpRequest,
+                              HttpResponse.BodyHandlers.ofByteArray())
+            .thenApply(this::parseInferResponse);
+    }
+
+    // ---- internals ----
+
+    private static final class Request {
+        final HttpRequest httpRequest;
+        Request(HttpRequest r) { httpRequest = r; }
+    }
+
+    private Request buildInferRequest(String model, List<InferInput> inputs,
+                                      List<InferRequestedOutput> outputs) {
+        Map<String, Object> header = new LinkedHashMap<>();
+        List<Object> inputHeaders = new ArrayList<>();
+        int binarySize = 0;
+        for (InferInput in : inputs) {
+            inputHeaders.add(in.toHeader());
+            binarySize += in.getData().length;
+        }
+        header.put("inputs", inputHeaders);
+        if (outputs != null && !outputs.isEmpty()) {
+            List<Object> outputHeaders = new ArrayList<>();
+            for (InferRequestedOutput out : outputs) {
+                outputHeaders.add(out.toHeader());
+            }
+            header.put("outputs", outputHeaders);
+        } else {
+            Map<String, Object> params = new LinkedHashMap<>();
+            params.put("binary_data_output", true);
+            header.put("parameters", params);
+        }
+        byte[] json = Json.write(header).getBytes(StandardCharsets.UTF_8);
+        byte[] body = new byte[json.length + binarySize];
+        System.arraycopy(json, 0, body, 0, json.length);
+        int offset = json.length;
+        for (InferInput in : inputs) {
+            byte[] data = in.getData();
+            System.arraycopy(data, 0, body, offset, data.length);
+            offset += data.length;
+        }
+        HttpRequest req = HttpRequest.newBuilder()
+            .uri(URI.create(base + "/v2/models/" + model + "/infer"))
+            .timeout(requestTimeout)
+            .header("Content-Type", "application/octet-stream")
+            .header("Inference-Header-Content-Length",
+                    Integer.toString(json.length))
+            .POST(HttpRequest.BodyPublishers.ofByteArray(body))
+            .build();
+        return new Request(req);
+    }
+
+    private InferResult parseInferResponse(HttpResponse<byte[]> resp) {
+        byte[] body = resp.body();
+        String headerLen = resp.headers()
+            .firstValue("Inference-Header-Content-Length").orElse(null);
+        int jsonLength = headerLen != null
+            ? Integer.parseInt(headerLen) : body.length;
+        if (resp.statusCode() != 200) {
+            String message = new String(body, StandardCharsets.UTF_8);
+            throw new InferenceException(
+                "inference failed (HTTP " + resp.statusCode() + "): " + message);
+        }
+        return new InferResult(body, jsonLength);
+    }
+
+    private HttpResponse<String> get(String path)
+            throws IOException, InterruptedException {
+        HttpRequest req = HttpRequest.newBuilder()
+            .uri(URI.create(base + path))
+            .timeout(requestTimeout)
+            .GET()
+            .build();
+        return http.send(req, HttpResponse.BodyHandlers.ofString());
+    }
+
+    private HttpResponse<String> postJson(String path, String body)
+            throws IOException, InterruptedException {
+        HttpRequest req = HttpRequest.newBuilder()
+            .uri(URI.create(base + path))
+            .timeout(requestTimeout)
+            .header("Content-Type", "application/json")
+            .POST(HttpRequest.BodyPublishers.ofString(body))
+            .build();
+        return http.send(req, HttpResponse.BodyHandlers.ofString());
+    }
+
+    private HttpResponse<String> checked(HttpResponse<String> resp) {
+        if (resp.statusCode() != 200) {
+            throw new InferenceException(
+                "request failed (HTTP " + resp.statusCode() + "): " + resp.body());
+        }
+        return resp;
+    }
+
+    private void checkedBytes(HttpResponse<String> resp) {
+        checked(resp);
+    }
+
+    /** Unchecked client exception (mirrors InferenceServerException). */
+    public static class InferenceException extends RuntimeException {
+        public InferenceException(String message) { super(message); }
+    }
+}

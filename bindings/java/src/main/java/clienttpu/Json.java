@@ -1,0 +1,200 @@
+// Minimal JSON reader/writer so the client has zero external dependencies
+// (the reference Java client pulls in fastjson; this stack keeps the wheel
+// small — same motive as the C++ client's in-repo json.cc).
+package clienttpu;
+
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+public final class Json {
+    private Json() {}
+
+    // ---- writer ----
+
+    public static String write(Object value) {
+        StringBuilder sb = new StringBuilder();
+        writeValue(value, sb);
+        return sb.toString();
+    }
+
+    @SuppressWarnings("unchecked")
+    private static void writeValue(Object v, StringBuilder sb) {
+        if (v == null) {
+            sb.append("null");
+        } else if (v instanceof String) {
+            writeString((String) v, sb);
+        } else if (v instanceof Map) {
+            sb.append('{');
+            boolean first = true;
+            for (Map.Entry<String, Object> e : ((Map<String, Object>) v).entrySet()) {
+                if (!first) sb.append(',');
+                first = false;
+                writeString(e.getKey(), sb);
+                sb.append(':');
+                writeValue(e.getValue(), sb);
+            }
+            sb.append('}');
+        } else if (v instanceof List) {
+            sb.append('[');
+            boolean first = true;
+            for (Object e : (List<Object>) v) {
+                if (!first) sb.append(',');
+                first = false;
+                writeValue(e, sb);
+            }
+            sb.append(']');
+        } else {
+            sb.append(v.toString()); // Number / Boolean
+        }
+    }
+
+    private static void writeString(String s, StringBuilder sb) {
+        sb.append('"');
+        for (int i = 0; i < s.length(); i++) {
+            char c = s.charAt(i);
+            switch (c) {
+                case '"': sb.append("\\\""); break;
+                case '\\': sb.append("\\\\"); break;
+                case '\n': sb.append("\\n"); break;
+                case '\r': sb.append("\\r"); break;
+                case '\t': sb.append("\\t"); break;
+                default:
+                    if (c < 0x20) {
+                        sb.append(String.format("\\u%04x", (int) c));
+                    } else {
+                        sb.append(c);
+                    }
+            }
+        }
+        sb.append('"');
+    }
+
+    // ---- reader ----
+
+    public static Object parse(String text) {
+        Parser p = new Parser(text);
+        Object v = p.parseValue();
+        p.skipWhitespace();
+        if (!p.atEnd()) throw new IllegalArgumentException("trailing JSON data");
+        return v;
+    }
+
+    private static final class Parser {
+        private final String s;
+        private int pos = 0;
+
+        Parser(String s) { this.s = s; }
+
+        boolean atEnd() { return pos >= s.length(); }
+
+        void skipWhitespace() {
+            while (pos < s.length() && Character.isWhitespace(s.charAt(pos))) pos++;
+        }
+
+        Object parseValue() {
+            skipWhitespace();
+            if (atEnd()) throw new IllegalArgumentException("unexpected end of JSON");
+            char c = s.charAt(pos);
+            switch (c) {
+                case '{': return parseObject();
+                case '[': return parseArray();
+                case '"': return parseString();
+                case 't': expect("true"); return Boolean.TRUE;
+                case 'f': expect("false"); return Boolean.FALSE;
+                case 'n': expect("null"); return null;
+                default: return parseNumber();
+            }
+        }
+
+        private void expect(String word) {
+            if (!s.startsWith(word, pos)) {
+                throw new IllegalArgumentException("bad JSON literal at " + pos);
+            }
+            pos += word.length();
+        }
+
+        private Map<String, Object> parseObject() {
+            Map<String, Object> out = new LinkedHashMap<>();
+            pos++; // {
+            skipWhitespace();
+            if (!atEnd() && s.charAt(pos) == '}') { pos++; return out; }
+            while (true) {
+                skipWhitespace();
+                String key = parseString();
+                skipWhitespace();
+                if (atEnd() || s.charAt(pos) != ':') {
+                    throw new IllegalArgumentException("expected ':' at " + pos);
+                }
+                pos++;
+                out.put(key, parseValue());
+                skipWhitespace();
+                if (atEnd()) throw new IllegalArgumentException("unterminated object");
+                char c = s.charAt(pos++);
+                if (c == '}') return out;
+                if (c != ',') throw new IllegalArgumentException("expected ',' at " + pos);
+            }
+        }
+
+        private List<Object> parseArray() {
+            List<Object> out = new ArrayList<>();
+            pos++; // [
+            skipWhitespace();
+            if (!atEnd() && s.charAt(pos) == ']') { pos++; return out; }
+            while (true) {
+                out.add(parseValue());
+                skipWhitespace();
+                if (atEnd()) throw new IllegalArgumentException("unterminated array");
+                char c = s.charAt(pos++);
+                if (c == ']') return out;
+                if (c != ',') throw new IllegalArgumentException("expected ',' at " + pos);
+            }
+        }
+
+        private String parseString() {
+            if (s.charAt(pos) != '"') {
+                throw new IllegalArgumentException("expected string at " + pos);
+            }
+            pos++;
+            StringBuilder sb = new StringBuilder();
+            while (true) {
+                if (atEnd()) throw new IllegalArgumentException("unterminated string");
+                char c = s.charAt(pos++);
+                if (c == '"') return sb.toString();
+                if (c == '\\') {
+                    char e = s.charAt(pos++);
+                    switch (e) {
+                        case '"': sb.append('"'); break;
+                        case '\\': sb.append('\\'); break;
+                        case '/': sb.append('/'); break;
+                        case 'b': sb.append('\b'); break;
+                        case 'f': sb.append('\f'); break;
+                        case 'n': sb.append('\n'); break;
+                        case 'r': sb.append('\r'); break;
+                        case 't': sb.append('\t'); break;
+                        case 'u':
+                            sb.append((char) Integer.parseInt(
+                                s.substring(pos, pos + 4), 16));
+                            pos += 4;
+                            break;
+                        default:
+                            throw new IllegalArgumentException("bad escape \\" + e);
+                    }
+                } else {
+                    sb.append(c);
+                }
+            }
+        }
+
+        private Object parseNumber() {
+            int start = pos;
+            while (!atEnd() && "+-0123456789.eE".indexOf(s.charAt(pos)) >= 0) pos++;
+            String num = s.substring(start, pos);
+            if (num.indexOf('.') >= 0 || num.indexOf('e') >= 0 || num.indexOf('E') >= 0) {
+                return Double.parseDouble(num);
+            }
+            return Long.parseLong(num);
+        }
+    }
+}

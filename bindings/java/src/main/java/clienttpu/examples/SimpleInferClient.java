@@ -1,0 +1,52 @@
+// Example: add_sub inference against the `simple` model
+// (reference src/java/examples SimpleInferClient + MemoryGrowthTest roles;
+// pass --iterations N for a growth soak run).
+package clienttpu.examples;
+
+import clienttpu.InferInput;
+import clienttpu.InferRequestedOutput;
+import clienttpu.InferResult;
+import clienttpu.InferenceServerClient;
+import java.util.List;
+
+public class SimpleInferClient {
+    public static void main(String[] args) throws Exception {
+        String url = "localhost:8000";
+        int iterations = 1;
+        for (int i = 0; i < args.length; i++) {
+            if (args[i].equals("-u")) url = args[++i];
+            if (args[i].equals("--iterations")) iterations = Integer.parseInt(args[++i]);
+        }
+        InferenceServerClient client = new InferenceServerClient(url, 5.0, 30.0);
+        if (!client.isServerLive()) {
+            System.err.println("server not live");
+            System.exit(1);
+        }
+        int[] input0 = new int[16];
+        int[] input1 = new int[16];
+        for (int i = 0; i < 16; i++) { input0[i] = i; input1[i] = 1; }
+
+        InferInput in0 = new InferInput("INPUT0", new long[] {1, 16}, "INT32");
+        in0.setData(input0);
+        InferInput in1 = new InferInput("INPUT1", new long[] {1, 16}, "INT32");
+        in1.setData(input1);
+
+        for (int iter = 0; iter < iterations; iter++) {
+            InferResult result = client.infer(
+                "simple",
+                List.of(in0, in1),
+                List.of(new InferRequestedOutput("OUTPUT0"),
+                        new InferRequestedOutput("OUTPUT1")));
+            int[] sum = result.getOutputAsInts("OUTPUT0");
+            int[] diff = result.getOutputAsInts("OUTPUT1");
+            for (int i = 0; i < 16; i++) {
+                if (sum[i] != input0[i] + input1[i]
+                        || diff[i] != input0[i] - input1[i]) {
+                    System.err.println("incorrect result at " + i);
+                    System.exit(1);
+                }
+            }
+        }
+        System.out.println("PASS : java SimpleInferClient");
+    }
+}

@@ -49,7 +49,7 @@ def compare_main(argv: List[str]) -> int:
         prefix="genai_perf_compare_"
     )
     os.makedirs(artifact_dir, exist_ok=True)
-    names = args.names or [
+    names = args.names if args.names is not None else [
         os.path.splitext(os.path.basename(f))[0] for f in args.files
     ]
     if len(names) != len(args.files):
@@ -65,18 +65,21 @@ def compare_main(argv: List[str]) -> int:
             return 1
         runs.append((name, metrics))
 
+    # statistics() sorts every metric's samples — compute once per run.
+    run_stats = [(name, metrics, metrics.statistics())
+                 for name, metrics in runs]
     rows = [
         ("time to first token avg (ms)",
-         lambda m: m.statistics()["time_to_first_token"].avg / 1e6),
+         lambda m, s: s["time_to_first_token"].avg / 1e6),
         ("time to first token p99 (ms)",
-         lambda m: m.statistics()["time_to_first_token"].p99 / 1e6),
+         lambda m, s: s["time_to_first_token"].p99 / 1e6),
         ("inter-token latency avg (ms)",
-         lambda m: m.statistics()["inter_token_latency"].avg / 1e6),
+         lambda m, s: s["inter_token_latency"].avg / 1e6),
         ("request latency avg (ms)",
-         lambda m: m.statistics()["request_latency"].avg / 1e6),
+         lambda m, s: s["request_latency"].avg / 1e6),
         ("output token throughput (tok/s)",
-         lambda m: m.output_token_throughput),
-        ("request throughput (req/s)", lambda m: m.request_throughput),
+         lambda m, s: m.output_token_throughput),
+        ("request throughput (req/s)", lambda m, s: m.request_throughput),
     ]
     width = max(len(r[0]) for r in rows) + 2
     header = " " * width + "".join(f"{n:>18}" for n, _ in runs)
@@ -84,9 +87,9 @@ def compare_main(argv: List[str]) -> int:
     table = []
     for label, fn in rows:
         values = []
-        for _, metrics in runs:
+        for _, metrics, stats in run_stats:
             try:
-                values.append(fn(metrics))
+                values.append(fn(metrics, stats))
             except Exception:  # noqa: BLE001 - metric absent for this run
                 values.append(float("nan"))
         print(f"{label:<{width}}" + "".join(f"{v:>18.2f}" for v in values))

@@ -70,7 +70,11 @@ def _extract_times_ms(profile_export_path: str):
 
 def _comparison_boxplot(plt, data, labels, ylabel, title, path):
     fig, ax = plt.subplots(figsize=(max(6, 2 * len(labels)), 4))
-    ax.boxplot(data, tick_labels=labels, showfliers=False)
+    ax.boxplot(data, showfliers=False)
+    # set_xticklabels works on all matplotlib versions (the boxplot
+    # tick_labels kwarg needs >= 3.9).
+    ax.set_xticks(range(1, len(labels) + 1))
+    ax.set_xticklabels(labels)
     ax.set_ylabel(ylabel)
     ax.set_title(title)
     fig.tight_layout()

@@ -399,6 +399,9 @@ class OpenAiPerfBackend(PerfBackend):
     def __init__(self, url: str, endpoint: str = "v1/chat/completions"):
         self._base = f"http://{url}/{endpoint.lstrip('/')}"
         self._session = None
+        # payload -> stream-enabled payload (corpora are small and cycled,
+        # so the upgrade parse runs once per distinct payload).
+        self._stream_payloads: Dict[str, str] = {}
 
     def _ensure_session(self):
         import aiohttp
@@ -486,10 +489,14 @@ class OpenAiPerfBackend(PerfBackend):
         import json as jsonlib
 
         payload = self._payload(inputs)
-        if '"stream"' not in payload:
+        upgraded = self._stream_payloads.get(payload)
+        if upgraded is None:
             doc = jsonlib.loads(payload)
-            doc["stream"] = True
-            payload = jsonlib.dumps(doc)
+            upgraded = payload if doc.get("stream") else jsonlib.dumps(
+                {**doc, "stream": True}
+            )
+            self._stream_payloads[payload] = upgraded
+        payload = upgraded
         session = self._ensure_session()
         async with session.post(
             self._base,
