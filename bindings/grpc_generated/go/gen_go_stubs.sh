@@ -16,14 +16,17 @@ cp "$REPO"/client_tpu/protos/model_config.proto \
    "$REPO"/client_tpu/protos/grpc_service.proto \
    "$STAGE/client_tpu/grpc/_generated/"
 
-mkdir -p grpc-client
+# Stubs land in ./clienttpu/grpc with an import path that matches the
+# `go mod init clienttpu-example` step in grpc_simple_client.go.
+MODULE=clienttpu-example
+mkdir -p clienttpu/grpc
 protoc -I "$STAGE" \
-  --go_out=grpc-client --go_opt=paths=source_relative \
-  --go_opt=Mclient_tpu/grpc/_generated/grpc_service.proto=clienttpu/grpc \
-  --go_opt=Mclient_tpu/grpc/_generated/model_config.proto=clienttpu/grpc \
-  --go-grpc_out=grpc-client --go-grpc_opt=paths=source_relative \
-  --go-grpc_opt=Mclient_tpu/grpc/_generated/grpc_service.proto=clienttpu/grpc \
-  --go-grpc_opt=Mclient_tpu/grpc/_generated/model_config.proto=clienttpu/grpc \
+  --go_out=. --go_opt=module=$MODULE \
+  --go_opt=Mclient_tpu/grpc/_generated/grpc_service.proto=$MODULE/clienttpu/grpc \
+  --go_opt=Mclient_tpu/grpc/_generated/model_config.proto=$MODULE/clienttpu/grpc \
+  --go-grpc_out=. --go-grpc_opt=module=$MODULE \
+  --go-grpc_opt=Mclient_tpu/grpc/_generated/grpc_service.proto=$MODULE/clienttpu/grpc \
+  --go-grpc_opt=Mclient_tpu/grpc/_generated/model_config.proto=$MODULE/clienttpu/grpc \
   "$STAGE/client_tpu/grpc/_generated/model_config.proto" \
   "$STAGE/client_tpu/grpc/_generated/grpc_service.proto"
-echo "stubs generated under grpc-client/"
+echo "stubs generated under clienttpu/grpc/"

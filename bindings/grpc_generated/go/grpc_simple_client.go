@@ -14,7 +14,7 @@ import (
 	"log"
 	"time"
 
-	pb "clienttpu/grpc"
+	pb "clienttpu-example/clienttpu/grpc"
 
 	"google.golang.org/grpc"
 	"google.golang.org/grpc/credentials/insecure"

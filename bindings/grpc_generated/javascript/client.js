@@ -5,23 +5,31 @@
 //   node client.js [host:port]
 "use strict";
 
+const fs = require("fs");
+const os = require("os");
 const path = require("path");
 const grpc = require("@grpc/grpc-js");
 const protoLoader = require("@grpc/proto-loader");
 
 const PROTO_DIR = path.join(__dirname, "..", "..", "..", "client_tpu", "protos");
 
+// grpc_service.proto imports model_config.proto via the python package path
+// (client_tpu/grpc/_generated/...), so stage copies under that layout —
+// the same trick the gen_*_stubs.sh scripts use.
+const stage = fs.mkdtempSync(path.join(os.tmpdir(), "ctpu-protos-"));
+const stagedPkg = path.join(stage, "client_tpu", "grpc", "_generated");
+fs.mkdirSync(stagedPkg, { recursive: true });
+for (const name of ["grpc_service.proto", "model_config.proto"]) {
+  fs.copyFileSync(path.join(PROTO_DIR, name), path.join(stagedPkg, name));
+}
+
 const packageDefinition = protoLoader.loadSync(
-  path.join(PROTO_DIR, "grpc_service.proto"),
+  path.join(stagedPkg, "grpc_service.proto"),
   {
     keepCase: true,
     longs: Number,
     enums: String,
-    includeDirs: [
-      PROTO_DIR,
-      // the proto imports via the python package path
-      path.join(__dirname, "..", "..", ".."),
-    ],
+    includeDirs: [stage],
   }
 );
 const inference = grpc.loadPackageDefinition(packageDefinition).inference;

@@ -80,12 +80,12 @@ public class InferenceServerClient {
     // ---- model control ----
 
     public void loadModel(String model) throws IOException, InterruptedException {
-        checkedBytes(postJson("/v2/repository/models/" + model + "/load", "{}"));
+        checked(postJson("/v2/repository/models/" + model + "/load", "{}"));
     }
 
     public void unloadModel(String model)
             throws IOException, InterruptedException {
-        checkedBytes(postJson("/v2/repository/models/" + model + "/unload", "{}"));
+        checked(postJson("/v2/repository/models/" + model + "/unload", "{}"));
     }
 
     // ---- inference ----
@@ -93,30 +93,24 @@ public class InferenceServerClient {
     public InferResult infer(String model, List<InferInput> inputs,
                              List<InferRequestedOutput> outputs)
             throws IOException, InterruptedException {
-        Request req = buildInferRequest(model, inputs, outputs);
-        HttpResponse<byte[]> resp = http.send(
-            req.httpRequest, HttpResponse.BodyHandlers.ofByteArray());
+        HttpRequest req = buildInferRequest(model, inputs, outputs);
+        HttpResponse<byte[]> resp =
+            http.send(req, HttpResponse.BodyHandlers.ofByteArray());
         return parseInferResponse(resp);
     }
 
     public CompletableFuture<InferResult> inferAsync(
             String model, List<InferInput> inputs,
             List<InferRequestedOutput> outputs) {
-        Request req = buildInferRequest(model, inputs, outputs);
-        return http.sendAsync(req.httpRequest,
-                              HttpResponse.BodyHandlers.ofByteArray())
+        HttpRequest req = buildInferRequest(model, inputs, outputs);
+        return http.sendAsync(req, HttpResponse.BodyHandlers.ofByteArray())
             .thenApply(this::parseInferResponse);
     }
 
     // ---- internals ----
 
-    private static final class Request {
-        final HttpRequest httpRequest;
-        Request(HttpRequest r) { httpRequest = r; }
-    }
-
-    private Request buildInferRequest(String model, List<InferInput> inputs,
-                                      List<InferRequestedOutput> outputs) {
+    private HttpRequest buildInferRequest(String model, List<InferInput> inputs,
+                                          List<InferRequestedOutput> outputs) {
         Map<String, Object> header = new LinkedHashMap<>();
         List<Object> inputHeaders = new ArrayList<>();
         int binarySize = 0;
@@ -145,7 +139,7 @@ public class InferenceServerClient {
             System.arraycopy(data, 0, body, offset, data.length);
             offset += data.length;
         }
-        HttpRequest req = HttpRequest.newBuilder()
+        return HttpRequest.newBuilder()
             .uri(URI.create(base + "/v2/models/" + model + "/infer"))
             .timeout(requestTimeout)
             .header("Content-Type", "application/octet-stream")
@@ -153,7 +147,6 @@ public class InferenceServerClient {
                     Integer.toString(json.length))
             .POST(HttpRequest.BodyPublishers.ofByteArray(body))
             .build();
-        return new Request(req);
     }
 
     private InferResult parseInferResponse(HttpResponse<byte[]> resp) {
@@ -197,10 +190,6 @@ public class InferenceServerClient {
                 "request failed (HTTP " + resp.statusCode() + "): " + resp.body());
         }
         return resp;
-    }
-
-    private void checkedBytes(HttpResponse<String> resp) {
-        checked(resp);
     }
 
     /** Unchecked client exception (mirrors InferenceServerException). */

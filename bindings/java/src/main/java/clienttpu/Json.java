@@ -163,6 +163,9 @@ public final class Json {
                 char c = s.charAt(pos++);
                 if (c == '"') return sb.toString();
                 if (c == '\\') {
+                    if (atEnd()) {
+                        throw new IllegalArgumentException("unterminated escape");
+                    }
                     char e = s.charAt(pos++);
                     switch (e) {
                         case '"': sb.append('"'); break;
@@ -174,8 +177,17 @@ public final class Json {
                         case 'r': sb.append('\r'); break;
                         case 't': sb.append('\t'); break;
                         case 'u':
-                            sb.append((char) Integer.parseInt(
-                                s.substring(pos, pos + 4), 16));
+                            if (pos + 4 > s.length()) {
+                                throw new IllegalArgumentException(
+                                    "truncated \\u escape");
+                            }
+                            try {
+                                sb.append((char) Integer.parseInt(
+                                    s.substring(pos, pos + 4), 16));
+                            } catch (NumberFormatException ex) {
+                                throw new IllegalArgumentException(
+                                    "bad \\u escape", ex);
+                            }
                             pos += 4;
                             break;
                         default:
@@ -191,10 +203,16 @@ public final class Json {
             int start = pos;
             while (!atEnd() && "+-0123456789.eE".indexOf(s.charAt(pos)) >= 0) pos++;
             String num = s.substring(start, pos);
-            if (num.indexOf('.') >= 0 || num.indexOf('e') >= 0 || num.indexOf('E') >= 0) {
-                return Double.parseDouble(num);
+            try {
+                if (num.indexOf('.') >= 0 || num.indexOf('e') >= 0
+                        || num.indexOf('E') >= 0) {
+                    return Double.parseDouble(num);
+                }
+                return Long.parseLong(num);
+            } catch (NumberFormatException ex) {
+                throw new IllegalArgumentException(
+                    "bad JSON number at " + start, ex);
             }
-            return Long.parseLong(num);
         }
     }
 }

@@ -1,0 +1,67 @@
+#!/usr/bin/env python3
+"""Image classification client for the JAX ResNet model
+(reference src/python/examples/image_client.py; without PIL, a random or
+.npy image stands in for the decoded JPEG).
+
+Supports classification top-k via the `classification` output parameter,
+like the reference's -c flag.
+"""
+
+import argparse
+
+import numpy as np
+
+import client_tpu.http as httpclient
+
+
+def load_image(path, size):
+    if path and path.endswith(".npy"):
+        img = np.load(path).astype(np.float32)
+    elif path:
+        try:
+            from PIL import Image
+
+            img = np.asarray(
+                Image.open(path).convert("RGB").resize((size, size)),
+                dtype=np.float32,
+            ) / 255.0
+        except ImportError:
+            raise SystemExit("PIL not installed; pass a .npy image instead")
+    else:
+        rng = np.random.default_rng(0)
+        img = rng.random((size, size, 3), dtype=np.float32)
+    if img.shape != (size, size, 3):
+        raise SystemExit(f"expected [{size},{size},3] image, got {img.shape}")
+    return img
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("image", nargs="?", default=None,
+                        help="image path (.npy or PIL-readable); random if omitted")
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("-m", "--model", default="image_classifier")
+    parser.add_argument("-c", "--classes", type=int, default=3,
+                        help="top-k classes to report")
+    parser.add_argument("-b", "--batch-size", type=int, default=1)
+    args = parser.parse_args()
+
+    client = httpclient.InferenceServerClient(args.url)
+    metadata = client.get_model_metadata(args.model)
+    size = metadata["inputs"][0]["shape"][-2]
+    image = load_image(args.image, size)
+    batch = np.stack([image] * args.batch_size)
+
+    inp = httpclient.InferInput("INPUT", list(batch.shape), "FP32")
+    inp.set_data_from_numpy(batch)
+    result = client.infer(args.model, [inp])
+    logits = result.as_numpy("OUTPUT")
+    for logit_row in logits:
+        top = np.argsort(logit_row)[::-1][: args.classes]
+        for rank, cls in enumerate(top):
+            print(f"  {rank + 1}: class {cls} ({logit_row[cls]:.6f})")
+    print(f"PASS: image_client ({args.batch_size} image(s))")
+
+
+if __name__ == "__main__":
+    main()

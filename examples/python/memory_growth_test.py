@@ -1,0 +1,43 @@
+#!/usr/bin/env python3
+"""Soak run watching client RSS growth (reference memory_growth_test.py,
+paired with the C++ memory_leak_test role)."""
+
+import argparse
+import resource
+
+import numpy as np
+
+import client_tpu.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("--iterations", type=int, default=1000)
+    parser.add_argument("--max-growth-mb", type=float, default=64.0)
+    args = parser.parse_args()
+
+    client = httpclient.InferenceServerClient(args.url)
+    inputs = [
+        httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+        httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(np.arange(16, dtype=np.int32).reshape(1, 16))
+    inputs[1].set_data_from_numpy(np.ones([1, 16], dtype=np.int32))
+
+    # warm up, then measure
+    for _ in range(min(100, args.iterations)):
+        client.infer("simple", inputs)
+    start_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    for _ in range(args.iterations):
+        client.infer("simple", inputs)
+    end_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    growth_mb = (end_kb - start_kb) / 1024.0
+    print(f"rss growth over {args.iterations} inferences: {growth_mb:.1f} MB")
+    if growth_mb > args.max_growth_mb:
+        raise SystemExit(f"error: growth {growth_mb:.1f} MB exceeds budget")
+    print("PASS: memory_growth_test")
+
+
+if __name__ == "__main__":
+    main()

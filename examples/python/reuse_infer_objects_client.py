@@ -1,0 +1,38 @@
+#!/usr/bin/env python3
+"""Reuse InferInput/InferRequestedOutput objects across requests
+(reference reuse_infer_objects_client.py)."""
+
+import argparse
+
+import numpy as np
+
+import client_tpu.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("--iterations", type=int, default=8)
+    args = parser.parse_args()
+
+    client = httpclient.InferenceServerClient(args.url)
+    inputs = [
+        httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+        httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    outputs = [
+        httpclient.InferRequestedOutput("OUTPUT0"),
+        httpclient.InferRequestedOutput("OUTPUT1"),
+    ]
+    for i in range(args.iterations):
+        in0 = np.full([1, 16], i, dtype=np.int32)
+        in1 = np.ones([1, 16], dtype=np.int32)
+        inputs[0].set_data_from_numpy(in0)
+        inputs[1].set_data_from_numpy(in1)
+        result = client.infer("simple", inputs, outputs=outputs)
+        assert (result.as_numpy("OUTPUT0") == i + 1).all()
+    print("PASS: reuse_infer_objects_client")
+
+
+if __name__ == "__main__":
+    main()

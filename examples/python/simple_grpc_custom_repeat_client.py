@@ -1,0 +1,37 @@
+#!/usr/bin/env python3
+"""Decoupled streaming: one request -> N token responses
+(reference simple_grpc_custom_repeat_client / decoupled examples)."""
+
+import argparse
+import queue
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("--count", type=int, default=8)
+    args = parser.parse_args()
+
+    responses = queue.Queue()
+    with grpcclient.InferenceServerClient(args.url) as client:
+        client.start_stream(callback=lambda r, e: responses.put((r, e)))
+        inp = grpcclient.InferInput("IN", [args.count], "INT32")
+        inp.set_data_from_numpy(np.arange(args.count, dtype=np.int32))
+        client.async_stream_infer("repeat_int32", [inp])
+        got = []
+        while len(got) < args.count:
+            result, error = responses.get(timeout=30)
+            if error is not None:
+                raise SystemExit(f"error: {error}")
+            got.append(int(result.as_numpy("OUT")[0]))
+        client.stop_stream()
+    assert got == list(range(args.count)), got
+    print("PASS: simple_grpc_custom_repeat_client")
+
+
+if __name__ == "__main__":
+    main()

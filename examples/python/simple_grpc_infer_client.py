@@ -1,0 +1,37 @@
+#!/usr/bin/env python3
+"""Blocking gRPC inference against the `simple` add_sub model
+(reference src/python/examples/simple_grpc_infer_client.py)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+        in1 = np.ones([1, 16], dtype=np.int32)
+        inputs = [
+            grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+            grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(in0)
+        inputs[1].set_data_from_numpy(in1)
+
+        result = client.infer("simple", inputs)
+        out0 = result.as_numpy("OUTPUT0")
+        out1 = result.as_numpy("OUTPUT1")
+        if not ((out0 == in0 + in1).all() and (out1 == in0 - in1).all()):
+            sys.exit("error: incorrect result")
+    print("PASS: simple_grpc_infer_client")
+
+
+if __name__ == "__main__":
+    main()

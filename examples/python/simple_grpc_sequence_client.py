@@ -1,0 +1,43 @@
+#!/usr/bin/env python3
+"""Stateful sequences: correlation id + start/end flags
+(reference simple_grpc_sequence_sync_client.py)."""
+
+import argparse
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        values = [1, 2, 3, 4]
+        with_flags = [(v, i == 0, i == len(values) - 1)
+                      for i, v in enumerate(values)]
+        for sequence_id in (1001, 1002):
+            for value, start, end in with_flags:
+                in0 = np.full([1, 16], value, dtype=np.int32)
+                in1 = np.zeros([1, 16], dtype=np.int32)
+                inputs = [
+                    grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+                    grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+                ]
+                inputs[0].set_data_from_numpy(in0)
+                inputs[1].set_data_from_numpy(in1)
+                result = client.infer(
+                    "simple",
+                    inputs,
+                    sequence_id=sequence_id,
+                    sequence_start=start,
+                    sequence_end=end,
+                )
+                assert (result.as_numpy("OUTPUT0") == value).all()
+    print("PASS: simple_grpc_sequence_client")
+
+
+if __name__ == "__main__":
+    main()

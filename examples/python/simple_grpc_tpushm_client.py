@@ -1,0 +1,53 @@
+#!/usr/bin/env python3
+"""TPU shared-memory data plane: jax.Array -> shared region -> server ->
+shared region -> jax.Array, zero JSON round-trips for tensor bytes.
+
+The TPU-native replacement for the reference's CUDA-IPC example
+(reference simple_grpc_cudashm_client.py); BF16 stays native end to end.
+"""
+
+import argparse
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+import client_tpu.utils.tpu_shared_memory as tpushm
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    import jax.numpy as jnp
+
+    x = jnp.asarray(np.random.randn(1, 32), dtype=jnp.bfloat16)
+    byte_size = 32 * 2
+    input_handle = tpushm.create_shared_memory_region("ex_tpu_in", byte_size)
+    output_handle = tpushm.create_shared_memory_region("ex_tpu_out", byte_size)
+    with grpcclient.InferenceServerClient(args.url) as client:
+        try:
+            tpushm.set_shared_memory_region_from_jax(input_handle, x)
+            client.register_tpu_shared_memory(
+                "ex_tpu_in", tpushm.get_raw_handle(input_handle), 0, byte_size
+            )
+            client.register_tpu_shared_memory(
+                "ex_tpu_out", tpushm.get_raw_handle(output_handle), 0,
+                byte_size,
+            )
+            inp = grpcclient.InferInput("INPUT0", [1, 32], "BF16")
+            inp.set_shared_memory("ex_tpu_in", byte_size)
+            out = grpcclient.InferRequestedOutput("OUTPUT0")
+            out.set_shared_memory("ex_tpu_out", byte_size)
+            client.infer("identity_bf16", [inp], outputs=[out])
+            result = tpushm.as_jax_array(output_handle, "BF16", [1, 32])
+            assert (np.asarray(result) == np.asarray(x)).all()
+            client.unregister_tpu_shared_memory()
+        finally:
+            tpushm.destroy_shared_memory_region(input_handle)
+            tpushm.destroy_shared_memory_region(output_handle)
+    print("PASS: simple_grpc_tpushm_client")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+#!/usr/bin/env python3
+"""asyncio HTTP inference (reference simple_http_aio_infer_client.py)."""
+
+import argparse
+import asyncio
+import sys
+
+import numpy as np
+
+import client_tpu.http.aio as httpclient
+
+
+async def main(url):
+    async with httpclient.InferenceServerClient(url) as client:
+        in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+        in1 = np.ones([1, 16], dtype=np.int32)
+        inputs = [
+            httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+            httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(in0)
+        inputs[1].set_data_from_numpy(in1)
+        result = await client.infer("simple", inputs)
+        if not (result.as_numpy("OUTPUT0") == in0 + in1).all():
+            sys.exit("error: incorrect result")
+    print("PASS: simple_http_aio_infer_client")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    asyncio.run(main(parser.parse_args().url))

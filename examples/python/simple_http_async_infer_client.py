@@ -1,0 +1,47 @@
+#!/usr/bin/env python3
+"""Callback-style async HTTP inference (reference simple_http_async_infer_client.py)."""
+
+import argparse
+import queue
+import sys
+
+import numpy as np
+
+import client_tpu.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    args = parser.parse_args()
+
+    client = httpclient.InferenceServerClient(args.url)
+    in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    in1 = np.ones([1, 16], dtype=np.int32)
+    inputs = [
+        httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+        httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(in0)
+    inputs[1].set_data_from_numpy(in1)
+
+    results = queue.Queue()
+    handles = [
+        client.async_infer(
+            "simple", inputs, callback=lambda r, e: results.put((r, e))
+        )
+        for _ in range(4)
+    ]
+    for handle in handles:
+        handle.get_result(timeout=30)  # also waits for completion
+    for _ in handles:
+        result, error = results.get(timeout=30)
+        if error is not None:
+            sys.exit(f"error: {error}")
+        if not (result.as_numpy("OUTPUT0") == in0 + in1).all():
+            sys.exit("error: incorrect result")
+    print("PASS: simple_http_async_infer_client")
+
+
+if __name__ == "__main__":
+    main()

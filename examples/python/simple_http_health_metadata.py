@@ -1,0 +1,29 @@
+#!/usr/bin/env python3
+"""Health + metadata round (reference simple_http_health_metadata.py)."""
+
+import argparse
+
+import client_tpu.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    args = parser.parse_args()
+
+    client = httpclient.InferenceServerClient(args.url)
+    assert client.is_server_live(), "server not live"
+    assert client.is_server_ready(), "server not ready"
+    assert client.is_model_ready("simple"), "model not ready"
+    meta = client.get_server_metadata()
+    print(f"server: {meta['name']} {meta.get('version', '')}")
+    print(f"extensions: {', '.join(meta.get('extensions', []))}")
+    model = client.get_model_metadata("simple")
+    print(f"model inputs: {[t['name'] for t in model['inputs']]}")
+    stats = client.get_inference_statistics("simple")
+    print(f"statistics: {stats['model_stats'][0]['inference_count']} inferences")
+    print("PASS: simple_http_health_metadata")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+#!/usr/bin/env python3
+"""Blocking HTTP inference against the `simple` add_sub model
+(reference src/python/examples/simple_http_infer_client.py)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import client_tpu.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    client = httpclient.InferenceServerClient(args.url)
+
+    in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    in1 = np.ones([1, 16], dtype=np.int32)
+    inputs = [
+        httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+        httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(in0)
+    inputs[1].set_data_from_numpy(in1)
+    outputs = [
+        httpclient.InferRequestedOutput("OUTPUT0"),
+        httpclient.InferRequestedOutput("OUTPUT1"),
+    ]
+
+    result = client.infer("simple", inputs, outputs=outputs)
+    out0 = result.as_numpy("OUTPUT0")
+    out1 = result.as_numpy("OUTPUT1")
+    if args.verbose:
+        for a, b, s, d in zip(in0.flat, in1.flat, out0.flat, out1.flat):
+            print(f"{a} + {b} = {s}, {a} - {b} = {d}")
+    if not ((out0 == in0 + in1).all() and (out1 == in0 - in1).all()):
+        sys.exit("error: incorrect result")
+    print("PASS: simple_http_infer_client")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+#!/usr/bin/env python3
+"""Model load/unload + repository index (reference simple_http_model_control.py)."""
+
+import argparse
+
+import client_tpu.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("-m", "--model", default="simple")
+    args = parser.parse_args()
+
+    client = httpclient.InferenceServerClient(args.url)
+    index = client.get_model_repository_index()
+    print("repository:", [m["name"] for m in index])
+    client.unload_model(args.model)
+    assert not client.is_model_ready(args.model), "unload did not take"
+    client.load_model(args.model)
+    assert client.is_model_ready(args.model), "load did not take"
+    print("PASS: simple_http_model_control")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+#!/usr/bin/env python3
+"""System shared-memory data plane over HTTP: tensors move through a POSIX
+shm region, the wire carries only region references
+(reference simple_http_shm_client.py)."""
+
+import argparse
+
+import numpy as np
+
+import client_tpu.http as httpclient
+import client_tpu.utils.shared_memory as shm
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    args = parser.parse_args()
+
+    in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    in1 = np.ones([1, 16], dtype=np.int32)
+    byte_size = in0.nbytes
+
+    client = httpclient.InferenceServerClient(args.url)
+    input_handle = shm.create_shared_memory_region(
+        "example_in", "example_in_key", 2 * byte_size
+    )
+    output_handle = shm.create_shared_memory_region(
+        "example_out", "example_out_key", 2 * byte_size
+    )
+    try:
+        shm.set_shared_memory_region(input_handle, [in0, in1])
+        client.register_system_shared_memory(
+            "example_in", "example_in_key", 2 * byte_size
+        )
+        client.register_system_shared_memory(
+            "example_out", "example_out_key", 2 * byte_size
+        )
+        inputs = [
+            httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+            httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_shared_memory("example_in", byte_size)
+        inputs[1].set_shared_memory("example_in", byte_size, offset=byte_size)
+        outputs = [
+            httpclient.InferRequestedOutput("OUTPUT0"),
+            httpclient.InferRequestedOutput("OUTPUT1"),
+        ]
+        outputs[0].set_shared_memory("example_out", byte_size)
+        outputs[1].set_shared_memory("example_out", byte_size, offset=byte_size)
+
+        client.infer("simple", inputs, outputs=outputs)
+        out0 = shm.get_contents_as_numpy(output_handle, np.int32, [1, 16])
+        out1 = shm.get_contents_as_numpy(
+            output_handle, np.int32, [1, 16], offset=byte_size
+        )
+        assert (out0 == in0 + in1).all() and (out1 == in0 - in1).all()
+        client.unregister_system_shared_memory()
+    finally:
+        shm.destroy_shared_memory_region(input_handle)
+        shm.destroy_shared_memory_region(output_handle)
+    print("PASS: simple_http_shm_client")
+
+
+if __name__ == "__main__":
+    main()

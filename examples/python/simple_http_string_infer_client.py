@@ -1,0 +1,32 @@
+#!/usr/bin/env python3
+"""BYTES/string tensors over HTTP (reference simple_http_string_infer_client.py)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import client_tpu.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    args = parser.parse_args()
+
+    client = httpclient.InferenceServerClient(args.url)
+    strings = np.array([["hello", "world", "tpu", "client"]], dtype=object)
+    inp = httpclient.InferInput("INPUT0", [1, 4], "BYTES")
+    inp.set_data_from_numpy(strings)
+    result = client.infer("identity_bytes", [inp])
+    out = result.as_numpy("OUTPUT0")
+    got = [
+        e.decode() if isinstance(e, bytes) else str(e) for e in out.flatten()
+    ]
+    if got != ["hello", "world", "tpu", "client"]:
+        sys.exit(f"error: incorrect result {got}")
+    print("PASS: simple_http_string_infer_client")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Every Python example must actually run against the in-repo server
+(the reference treats examples as integration fixtures the same way)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples", "python")
+
+
+@pytest.fixture(scope="module")
+def server():
+    from client_tpu.models.serving import ImageClassifierModel
+    from client_tpu.server.core import ServerCore
+    from client_tpu.server.model_repository import build_repository
+    from client_tpu.testing import InProcessServer
+
+    repository = build_repository()
+    # small resnet keeps the example fast on CPU
+    repository.add_model(ImageClassifierModel(small=True, num_classes=10))
+    core = ServerCore(repository)
+    with InProcessServer(core=core, builtin_models=False) as s:
+        yield s
+
+
+def run_example(name, server, *args):
+    url = server.grpc_url if "grpc" in name else f"127.0.0.1:{server.http_port}"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, name), "-u", url, *args],
+        capture_output=True, text=True, timeout=180, env=env,
+    )
+    assert out.returncode == 0, f"{name}: {out.stdout}{out.stderr}"
+    assert "PASS" in out.stdout, f"{name}: {out.stdout}{out.stderr}"
+
+
+@pytest.mark.parametrize(
+    "name,args",
+    [
+        ("simple_http_infer_client.py", []),
+        ("simple_grpc_infer_client.py", []),
+        ("simple_http_aio_infer_client.py", []),
+        ("simple_grpc_aio_infer_client.py", []),
+        ("simple_http_async_infer_client.py", []),
+        ("simple_http_string_infer_client.py", []),
+        ("simple_http_health_metadata.py", []),
+        ("simple_http_model_control.py", []),
+        ("simple_grpc_sequence_client.py", []),
+        ("simple_grpc_custom_repeat_client.py", []),
+        ("simple_http_shm_client.py", []),
+        ("simple_grpc_tpushm_client.py", []),
+        ("image_client.py", []),
+        ("reuse_infer_objects_client.py", []),
+        ("memory_growth_test.py", ["--iterations", "50"]),
+    ],
+)
+def test_example(server, name, args):
+    run_example(name, server, *args)
