@@ -159,3 +159,25 @@ def test_plugin_registry():
     c.unregister_plugin()
     with pytest.raises(ValueError):
         c.unregister_plugin()
+
+
+def test_wheel_builds(tmp_path):
+    """Wheel assembly (pure-Python flavor for speed) must succeed and
+    carry the package + entry points."""
+    import os
+    import subprocess
+    import sys
+    import zipfile
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "build_wheel.py"),
+         "--skip-native", "--dist-dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=300, cwd=repo,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    wheels = list(tmp_path.glob("*.whl"))
+    assert len(wheels) == 1
+    names = zipfile.ZipFile(wheels[0]).namelist()
+    assert any("client_tpu/utils/__init__.py" in n for n in names)
+    assert any("entry_points.txt" in n for n in names)

@@ -1,0 +1,94 @@
+#!/usr/bin/env python3
+"""Build the client-tpu wheel, bundling the native artifacts.
+
+Role parity with the reference's wheel assembly
+(reference src/python/library/build_wheel.py:107-180 + setup.py:46-76): the
+wheel carries the pure-Python client, the generated protobuf modules, and —
+when the native tree is built — libcshm_tpu.so plus the perf_analyzer
+binary under client_tpu/_native/, with a platform-specific wheel tag.
+No sed-patching of generated code is needed (protos are staged package-
+correct at generation time, see tools/gen_protos.sh).
+
+Usage: python tools/build_wheel.py [--skip-native] [--dist-dir dist]
+"""
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def build_native(build_dir: str) -> None:
+    subprocess.run(
+        ["cmake", "-S", os.path.join(REPO, "native"), "-B", build_dir,
+         "-G", "Ninja"],
+        check=True,
+    )
+    subprocess.run(["ninja", "-C", build_dir], check=True)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--skip-native", action="store_true",
+                        help="pure-Python wheel (no .so / perf_analyzer)")
+    parser.add_argument("--dist-dir", default=os.path.join(REPO, "dist"))
+    args = parser.parse_args()
+
+    native_dir = os.path.join(REPO, "client_tpu", "_native")
+    # Clean any previous staging: a stale _native/ in the source tree or a
+    # stale setuptools build/lib would silently leak platform binaries into
+    # a py3-none-any wheel.
+    shutil.rmtree(native_dir, ignore_errors=True)
+    for stale in ("lib",) + tuple(
+        d for d in (os.listdir(os.path.join(REPO, "build"))
+                    if os.path.isdir(os.path.join(REPO, "build")) else [])
+        if d.startswith("bdist.")
+    ):
+        shutil.rmtree(os.path.join(REPO, "build", stale), ignore_errors=True)
+
+    platform_tag = None
+    try:
+        if not args.skip_native:
+            build_dir = os.path.join(REPO, "build")
+            build_native(build_dir)
+            os.makedirs(native_dir, exist_ok=True)
+            for artifact in ("libcshm_tpu.so", "perf_analyzer"):
+                src = os.path.join(build_dir, artifact)
+                if not os.path.exists(src):
+                    print(f"error: missing native artifact {src}",
+                          file=sys.stderr)
+                    return 1
+                shutil.copy2(src, os.path.join(native_dir, artifact))
+            with open(os.path.join(native_dir, "__init__.py"), "w") as f:
+                f.write(
+                    '"""Bundled native artifacts '
+                    '(see tools/build_wheel.py)."""\n'
+                )
+            import sysconfig
+
+            platform_tag = sysconfig.get_platform().replace(
+                "-", "_"
+            ).replace(".", "_")
+
+        cmd = [sys.executable, "-m", "build", "--wheel", "--no-isolation",
+               "--outdir", args.dist_dir]
+        if platform_tag:
+            cmd += ["--config-setting=--build-option=--plat-name",
+                    f"--config-setting=--build-option={platform_tag}"]
+        subprocess.run(cmd, check=True, cwd=REPO)
+    finally:
+        shutil.rmtree(native_dir, ignore_errors=True)
+        shutil.rmtree(os.path.join(REPO, "build", "lib"), ignore_errors=True)
+
+    wheels = sorted(
+        f for f in os.listdir(args.dist_dir) if f.endswith(".whl")
+    )
+    print("built:", ", ".join(wheels))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
