@@ -13,6 +13,7 @@ drop-in familiarity: -m, -u, -i, -b, --concurrency-range,
 import argparse
 import asyncio
 import json
+import os
 import sys
 from typing import List, Optional, Tuple
 
@@ -138,6 +139,21 @@ def build_parser() -> argparse.ArgumentParser:
         "--json-summary",
         action="store_true",
         help="print a one-line JSON summary (bench integration)",
+    )
+    from client_tpu.perf.distributed import topology_from_env
+
+    env_world_size, env_rank, env_coordinator = topology_from_env()
+    parser.add_argument(
+        "--world-size", type=int, default=env_world_size,
+        help="multi-process run: process count (MPI-driver equivalent)",
+    )
+    parser.add_argument(
+        "--rank", type=int, default=env_rank,
+        help="multi-process run: this process's rank",
+    )
+    parser.add_argument(
+        "--coordinator", default=env_coordinator,
+        help="rank-0 rendezvous address",
     )
     return parser
 
@@ -265,6 +281,23 @@ async def run(args) -> int:
             parameters=request_parameters or None,
         )
 
+        # Multi-process rendezvous: barrier after setup so all ranks start
+        # measuring together (reference MPIBarrierWorld around Profile).
+        from client_tpu.perf.distributed import DistributedDriver
+
+        # Construction blocks in accept()/connect until the world forms —
+        # keep it (and the barriers) off the event loop.
+        world = await asyncio.to_thread(
+            DistributedDriver,
+            args.world_size,
+            args.rank,
+            args.coordinator,
+        )
+        if world.is_distributed:
+            await asyncio.to_thread(world.barrier)
+            if args.verbose:
+                print(f"rank {args.rank}/{args.world_size} ready")
+
         latency_threshold_us = (
             args.latency_threshold * 1000 if args.latency_threshold else None
         )
@@ -341,6 +374,11 @@ async def run(args) -> int:
             experiments = await profiler.profile_concurrency_range(
                 start, end, step
             )
+
+        if world.is_distributed:
+            # No rank tears its load down while another is still measuring.
+            await asyncio.to_thread(world.barrier)
+        world.close()
 
         for experiment in experiments:
             label = f"{experiment.mode} = {experiment.value:g}"

@@ -1,0 +1,127 @@
+"""Multi-process run coordination (MPI-driver equivalent).
+
+Same TCP rendezvous protocol as the native driver
+(native/perf/distributed.cc): rank 0 listens at the coordinator address,
+other ranks connect and send their rank byte; a barrier is one 'B' byte in
+and one 'A' byte back. Mixed fleets — native perf_analyzer ranks alongside
+Python harness ranks — therefore interoperate.
+
+Reference role: mpi_utils.h:32-85 (dlopen'd MPI, world barrier around
+Profile); world_size <= 1 no-ops the same way an MPI-less run does.
+"""
+
+import os
+import socket
+import time
+from typing import List, Optional
+
+_BARRIER = b"B"
+_ACK = b"A"
+
+# The join handshake carries the rank in one byte; the C++ driver reads it
+# as a signed char, so both sides cap the world at 127 ranks.
+MAX_WORLD_SIZE = 127
+
+
+def topology_from_env():
+    """(world_size, rank, coordinator) from the CTPU_* env vars — the one
+    place the variable names live (cli flags default from here)."""
+    return (
+        int(os.environ.get("CTPU_WORLD_SIZE", "1")),
+        int(os.environ.get("CTPU_RANK", "0")),
+        os.environ.get("CTPU_COORDINATOR", "127.0.0.1:29500"),
+    )
+
+
+class DistributedDriver:
+    def __init__(self, world_size: int = 1, rank: int = 0,
+                 coordinator: str = "127.0.0.1:29500"):
+        if world_size < 1 or rank < 0 or rank >= max(1, world_size):
+            raise ValueError(f"invalid world_size/rank {world_size}/{rank}")
+        if world_size > MAX_WORLD_SIZE:
+            raise ValueError(
+                f"world_size {world_size} exceeds the rendezvous protocol "
+                f"cap of {MAX_WORLD_SIZE}"
+            )
+        self.world_size = world_size
+        self.rank = rank
+        self._listener: Optional[socket.socket] = None
+        self._peers: List[Optional[socket.socket]] = []
+        if world_size > 1:
+            host, port = coordinator.rsplit(":", 1)
+            if rank == 0:
+                self._listen(host, int(port))
+            else:
+                self._connect(host, int(port))
+
+    @classmethod
+    def from_env(cls) -> "DistributedDriver":
+        world_size, rank, coordinator = topology_from_env()
+        return cls(world_size=world_size, rank=rank, coordinator=coordinator)
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.world_size > 1
+
+    def _listen(self, host: str, port: int) -> None:
+        self._listener = socket.create_server(
+            (host, port), reuse_port=False
+        )
+        self._peers = [None] * self.world_size
+        joined = 0
+        while joined < self.world_size - 1:
+            conn, _ = self._listener.accept()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            greeting = conn.recv(1)
+            if not greeting:
+                # Stray connection (scanner / dead peer): drop, keep waiting.
+                conn.close()
+                continue
+            peer_rank = greeting[0]
+            if not 0 < peer_rank < self.world_size or self._peers[peer_rank]:
+                conn.close()
+                raise RuntimeError(f"bad or duplicate rank {peer_rank}")
+            self._peers[peer_rank] = conn
+            joined += 1
+
+    def _connect(self, host: str, port: int,
+                 retries: int = 100, delay_s: float = 0.1) -> None:
+        last = None
+        for _ in range(retries):
+            try:
+                conn = socket.create_connection((host, port), timeout=10)
+                break
+            except OSError as e:
+                last = e
+                time.sleep(delay_s)
+        else:
+            raise RuntimeError(
+                f"rendezvous connect to {host}:{port} failed: {last}"
+            )
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn.settimeout(None)
+        conn.sendall(bytes([self.rank]))
+        self._peers = [conn]
+
+    def barrier(self) -> None:
+        if self.world_size <= 1:
+            return
+        if self.rank == 0:
+            for r in range(1, self.world_size):
+                if self._peers[r].recv(1) != _BARRIER:
+                    raise RuntimeError("rendezvous protocol error")
+            for r in range(1, self.world_size):
+                self._peers[r].sendall(_ACK)
+        else:
+            self._peers[0].sendall(_BARRIER)
+            if self._peers[0].recv(1) != _ACK:
+                raise RuntimeError("rendezvous protocol error")
+
+    def close(self) -> None:
+        for peer in self._peers:
+            if peer is not None:
+                peer.close()
+        if self._listener is not None:
+            self._listener.close()
+        self._peers = []
+        self._listener = None

@@ -125,6 +125,10 @@ std::string Usage() {
       "                              (local = in-process server, no network;\n"
       "                               needs repo root + venv on PYTHONPATH)\n"
       "  --local-zoo-models          local: also load resnet/llm_decode\n"
+      "  --world-size N              multi-process run: process count\n"
+      "  --rank R                    multi-process run: this process's rank\n"
+      "  --coordinator HOST:PORT     rank-0 rendezvous address "
+      "(default 127.0.0.1:29500)\n"
       "  --endpoint PATH             openai endpoint path "
       "(default v1/chat/completions)\n"
       "  --collect-metrics           poll server Prometheus metrics\n"
@@ -134,6 +138,16 @@ std::string Usage() {
 }
 
 Error ParseArgs(int argc, char** argv, PAParams* params) {
+  // Multi-process launchers usually pass topology via env; flags override.
+  if (const char* ws = std::getenv("CTPU_WORLD_SIZE")) {
+    params->world_size = std::atoi(ws);
+  }
+  if (const char* rk = std::getenv("CTPU_RANK")) {
+    params->rank = std::atoi(rk);
+  }
+  if (const char* co = std::getenv("CTPU_COORDINATOR")) {
+    params->coordinator = co;
+  }
   auto need = [&](int i) -> Error {
     if (i + 1 >= argc) {
       return Error(std::string("flag ") + argv[i] + " needs a value");
@@ -258,6 +272,15 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
       params->endpoint = next();
     } else if (arg == "--local-zoo-models") {
       params->local_zoo = true;
+    } else if (arg == "--world-size") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->world_size = std::stoi(next());
+    } else if (arg == "--rank") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->rank = std::stoi(next());
+    } else if (arg == "--coordinator") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->coordinator = next();
     } else if (arg == "--collect-metrics") {
       params->collect_metrics = true;
     } else if (arg == "--metrics-url") {

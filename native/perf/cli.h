@@ -19,6 +19,11 @@ struct PAParams {
   std::string service_kind = "kserve";  // kserve | openai | local
   std::string endpoint;  // openai: path (default v1/chat/completions)
   bool local_zoo = false;  // local: register model-zoo adapters too
+  // Multi-process coordination (MPI-driver equivalent). Defaults pull from
+  // CTPU_WORLD_SIZE / CTPU_RANK / CTPU_COORDINATOR env vars.
+  int world_size = 1;
+  int rank = 0;
+  std::string coordinator = "127.0.0.1:29500";
   std::string protocol = "http";
   int64_t batch_size = 1;
 

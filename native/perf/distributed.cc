@@ -1,0 +1,173 @@
+#include "distributed.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <thread>
+
+namespace ctpu {
+namespace perf {
+
+namespace {
+
+constexpr char kBarrierByte = 'B';
+constexpr char kAckByte = 'A';
+constexpr int kConnectRetries = 100;           // ~10s of startup skew
+constexpr int kConnectRetryDelayMs = 100;
+
+Error ReadByte(int fd, char* out) {
+  while (true) {
+    const ssize_t n = recv(fd, out, 1, 0);
+    if (n == 1) return Error::Success();
+    if (n < 0 && errno == EINTR) continue;
+    return Error(n == 0 ? "peer closed rendezvous connection"
+                        : std::string("rendezvous recv: ") + strerror(errno));
+  }
+}
+
+Error WriteByte(int fd, char byte) {
+  while (true) {
+    const ssize_t n = send(fd, &byte, 1, MSG_NOSIGNAL);
+    if (n == 1) return Error::Success();
+    if (n < 0 && errno == EINTR) continue;
+    return Error(std::string("rendezvous send: ") + strerror(errno));
+  }
+}
+
+Error SplitHostPort(const std::string& addr, std::string* host, int* port) {
+  const size_t colon = addr.rfind(':');
+  if (colon == std::string::npos) {
+    return Error("coordinator must be host:port, got '" + addr + "'");
+  }
+  *host = addr.substr(0, colon);
+  *port = atoi(addr.c_str() + colon + 1);
+  return Error::Success();
+}
+
+}  // namespace
+
+Error DistributedDriver::Create(int world_size, int rank,
+                                const std::string& coordinator,
+                                std::unique_ptr<DistributedDriver>* driver) {
+  if (world_size < 1 || rank < 0 || rank >= std::max(1, world_size)) {
+    return Error("invalid world_size/rank (" + std::to_string(world_size) +
+                 "/" + std::to_string(rank) + ")");
+  }
+  // The join handshake carries the rank in one signed byte.
+  if (world_size > 127) {
+    return Error("world_size " + std::to_string(world_size) +
+                 " exceeds the rendezvous protocol cap of 127");
+  }
+  std::unique_ptr<DistributedDriver> d(
+      new DistributedDriver(world_size, rank));
+  if (world_size > 1) {
+    CTPU_RETURN_IF_ERROR(rank == 0 ? d->Listen(coordinator)
+                                   : d->Connect(coordinator));
+  }
+  *driver = std::move(d);
+  return Error::Success();
+}
+
+DistributedDriver::~DistributedDriver() {
+  for (int fd : peer_fds_) {
+    if (fd >= 0) close(fd);
+  }
+  if (listen_fd_ >= 0) close(listen_fd_);
+}
+
+Error DistributedDriver::Listen(const std::string& coordinator) {
+  std::string host;
+  int port;
+  CTPU_RETURN_IF_ERROR(SplitHostPort(coordinator, &host, &port));
+  listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) return Error("rendezvous socket failed");
+  int one = 1;
+  setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  // Bind the requested host (matching the Python driver); 0.0.0.0 or an
+  // unparseable name falls back to any-interface.
+  if (host.empty() ||
+      inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    addr.sin_addr.s_addr = INADDR_ANY;
+  }
+  if (bind(listen_fd_, reinterpret_cast<struct sockaddr*>(&addr),
+           sizeof(addr)) != 0) {
+    return Error(std::string("rendezvous bind: ") + strerror(errno));
+  }
+  if (listen(listen_fd_, world_size_) != 0) {
+    return Error(std::string("rendezvous listen: ") + strerror(errno));
+  }
+  // Each joining rank sends its rank id; hold one connection per peer.
+  peer_fds_.assign(world_size_, -1);
+  int joined = 0;
+  while (joined < world_size_ - 1) {
+    const int fd = accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) return Error(std::string("rendezvous accept: ") +
+                             strerror(errno));
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    char peer_rank;
+    if (!ReadByte(fd, &peer_rank).IsOk()) {
+      close(fd);  // stray connection (scanner / dead peer): keep waiting
+      continue;
+    }
+    const int r = static_cast<int>(peer_rank);
+    if (r <= 0 || r >= world_size_ || peer_fds_[r] != -1) {
+      close(fd);
+      return Error("rendezvous: bad or duplicate rank " + std::to_string(r));
+    }
+    peer_fds_[r] = fd;
+    ++joined;
+  }
+  return Error::Success();
+}
+
+Error DistributedDriver::Connect(const std::string& coordinator) {
+  std::string host;
+  int port;
+  CTPU_RETURN_IF_ERROR(SplitHostPort(coordinator, &host, &port));
+  std::string err;
+  int fd = -1;
+  for (int attempt = 0; attempt < kConnectRetries; ++attempt) {
+    fd = DialTcp(host, port, 0, &err);
+    if (fd >= 0) break;
+    std::this_thread::sleep_for(
+        std::chrono::milliseconds(kConnectRetryDelayMs));
+  }
+  if (fd < 0) return Error("rendezvous connect to " + coordinator +
+                           " failed: " + err);
+  CTPU_RETURN_IF_ERROR(WriteByte(fd, static_cast<char>(rank_)));
+  peer_fds_.push_back(fd);
+  return Error::Success();
+}
+
+Error DistributedDriver::Barrier() {
+  if (world_size_ <= 1) return Error::Success();
+  if (rank_ == 0) {
+    // Collect one byte from every rank, then release them all.
+    for (int r = 1; r < world_size_; ++r) {
+      char byte;
+      CTPU_RETURN_IF_ERROR(ReadByte(peer_fds_[r], &byte));
+      if (byte != kBarrierByte) return Error("rendezvous protocol error");
+    }
+    for (int r = 1; r < world_size_; ++r) {
+      CTPU_RETURN_IF_ERROR(WriteByte(peer_fds_[r], kAckByte));
+    }
+  } else {
+    CTPU_RETURN_IF_ERROR(WriteByte(peer_fds_[0], kBarrierByte));
+    char byte;
+    CTPU_RETURN_IF_ERROR(ReadByte(peer_fds_[0], &byte));
+    if (byte != kAckByte) return Error("rendezvous protocol error");
+  }
+  return Error::Success();
+}
+
+}  // namespace perf
+}  // namespace ctpu

@@ -1,0 +1,50 @@
+// Multi-process run coordination without MPI.
+//
+// Role parity with the reference's MPIDriver (reference mpi_utils.h:32-85,
+// dlopen'd libmpi + world barrier/bcast around Profile): N perf_analyzer
+// processes — across TPU-VM hosts — start together and stop together so
+// their measurement windows overlap. The TPU-native replacement is a tiny
+// TCP rendezvous: rank 0 listens, other ranks connect, a barrier is one
+// byte each way. Single-process runs (world_size <= 1) no-op exactly like
+// the reference without MPI loaded.
+#pragma once
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace ctpu {
+namespace perf {
+
+class DistributedDriver {
+ public:
+  // coordinator is "host:port"; rank 0 binds it, others connect to it.
+  // world_size <= 1 creates a no-op driver.
+  static Error Create(int world_size, int rank,
+                      const std::string& coordinator,
+                      std::unique_ptr<DistributedDriver>* driver);
+  ~DistributedDriver();
+
+  bool IsDistributed() const { return world_size_ > 1; }
+  int Rank() const { return rank_; }
+  int WorldSize() const { return world_size_; }
+
+  // Blocks until every rank has entered the barrier.
+  Error Barrier();
+
+ private:
+  DistributedDriver(int world_size, int rank)
+      : world_size_(world_size), rank_(rank) {}
+  Error Listen(const std::string& coordinator);
+  Error Connect(const std::string& coordinator);
+
+  int world_size_ = 1;
+  int rank_ = 0;
+  int listen_fd_ = -1;
+  std::vector<int> peer_fds_;  // rank 0: one per other rank; else: [coord]
+};
+
+}  // namespace perf
+}  // namespace ctpu

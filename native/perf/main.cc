@@ -11,6 +11,7 @@
 #include "data_loader.h"
 #include "infer_data.h"
 #include "load_manager.h"
+#include "distributed.h"
 #include "metrics_manager.h"
 #include "model_parser.h"
 #include "profiler.h"
@@ -137,6 +138,21 @@ int main(int argc, char** argv) {
                 parser.Inputs().size());
   }
 
+  // Multi-process rendezvous: all ranks set up first, then cross the
+  // barrier together so measurement windows overlap (reference
+  // MPIBarrierWorld around Profile, perf_analyzer.cc:379,396).
+  std::unique_ptr<DistributedDriver> world;
+  err = DistributedDriver::Create(params.world_size, params.rank,
+                                  params.coordinator, &world);
+  if (!err.IsOk()) return fail(err, "rendezvous");
+  if (world->IsDistributed()) {
+    err = world->Barrier();
+    if (!err.IsOk()) return fail(err, "pre-profile barrier");
+    if (params.verbose) {
+      std::printf("rank %d/%d ready\n", params.rank, params.world_size);
+    }
+  }
+
   std::unique_ptr<MetricsManager> metrics;
   if (params.collect_metrics) {
     // Default endpoint: same host:port as -u, path /metrics. The gRPC port
@@ -236,6 +252,12 @@ int main(int argc, char** argv) {
   }
 
   if (metrics) metrics->StopThread();
+  if (world->IsDistributed()) {
+    // Post-profile barrier: no rank tears down the server's load while
+    // another is still measuring (reference MPIBarrierWorld after Profile).
+    err = world->Barrier();
+    if (!err.IsOk()) return fail(err, "post-profile barrier");
+  }
 
   if (experiments.empty()) {
     std::cerr << "error: no measurements taken" << std::endl;

@@ -1,0 +1,57 @@
+// Rendezvous-driver tests: barrier semantics across in-process "ranks".
+#include <unistd.h>
+
+#include <atomic>
+#include <thread>
+#include <vector>
+
+#include "distributed.h"
+#include "test_framework.h"
+
+namespace {
+
+using ctpu::Error;
+using ctpu::perf::DistributedDriver;
+
+TEST_CASE("distributed: single-process world no-ops") {
+  std::unique_ptr<DistributedDriver> driver;
+  CHECK_OK(DistributedDriver::Create(1, 0, "127.0.0.1:0", &driver));
+  CHECK(!driver->IsDistributed());
+  CHECK_OK(driver->Barrier());
+}
+
+TEST_CASE("distributed: 3-rank barrier holds laggards") {
+  const std::string coord =
+      "127.0.0.1:" + std::to_string(21000 + (getpid() % 9000));
+  std::atomic<int> entered{0};
+  std::atomic<int> released{0};
+  std::vector<std::thread> threads;
+  for (int rank = 0; rank < 3; ++rank) {
+    threads.emplace_back([&, rank] {
+      std::unique_ptr<DistributedDriver> driver;
+      Error err = DistributedDriver::Create(3, rank, coord, &driver);
+      CHECK(err.IsOk());
+      if (!err.IsOk()) return;
+      // Rank 2 arrives late; nobody may pass the barrier before it enters.
+      if (rank == 2) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(200));
+        CHECK_EQ(released.load(), 0);
+      }
+      entered++;
+      CHECK(driver->Barrier().IsOk());
+      CHECK_EQ(entered.load(), 3);  // all entered before anyone returns
+      released++;
+      CHECK(driver->Barrier().IsOk());  // second barrier also works
+    });
+  }
+  for (auto& t : threads) t.join();
+  CHECK_EQ(released.load(), 3);
+}
+
+TEST_CASE("distributed: rejects bad topology") {
+  std::unique_ptr<DistributedDriver> driver;
+  CHECK(!DistributedDriver::Create(2, 5, "127.0.0.1:0", &driver).IsOk());
+  CHECK(!DistributedDriver::Create(0, 0, "127.0.0.1:0", &driver).IsOk());
+}
+
+}  // namespace

@@ -265,3 +265,66 @@ def test_cpp_perf_analyzer_local_inprocess(native_build):
     )
     assert summary["errors"] == 0
     assert summary["throughput"] > 0
+
+
+def test_cpp_perf_analyzer_multiprocess(native_build, live_server):
+    """Two perf_analyzer ranks rendezvous, measure together, and both
+    produce summaries (MPI-driver equivalent, reference mpi_utils)."""
+    port = 20000 + os.getpid() % 10000  # avoid cross-run collisions
+    base = [os.path.join(native_build, "perf_analyzer"),
+            "-m", "simple", "-u", live_server.http_url,
+            "--concurrency-range", "2",
+            "--measurement-interval", "400",
+            "--stability-percentage", "60",
+            "--max-trials", "3",
+            "--json-summary",
+            "--world-size", "2", "--coordinator", f"127.0.0.1:{port}"]
+    procs = [
+        subprocess.Popen(base + ["--rank", str(rank)],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True)
+        for rank in (0, 1)
+    ]
+    outs = [p.communicate(timeout=180) for p in procs]
+    for p, (stdout, stderr) in zip(procs, outs):
+        assert p.returncode == 0, stdout + stderr
+        summary = json.loads(
+            [l for l in stdout.splitlines() if l.startswith("{")][-1]
+        )
+        assert summary["errors"] == 0
+        assert summary["throughput"] > 0
+
+
+def test_python_native_mixed_rendezvous(native_build, live_server):
+    """A Python-harness rank and a native rank share one rendezvous
+    (same wire protocol on both sides)."""
+    import sys
+
+    native = subprocess.Popen(
+        [os.path.join(native_build, "perf_analyzer"),
+         "-m", "simple", "-u", live_server.http_url,
+         "--concurrency-range", "1",
+         "--measurement-interval", "400",
+         "--stability-percentage", "60",
+         "--max-trials", "2",
+         "--world-size", "2", "--rank", "0",
+         "--coordinator", f"127.0.0.1:{20000 + (os.getpid() + 1) % 10000}"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    pyrank = subprocess.Popen(
+        [sys.executable, "-m", "client_tpu.perf.cli",
+         "-m", "simple", "-u", live_server.http_url,
+         "--concurrency-range", "1",
+         "--measurement-interval", "400",
+         "--stability-percentage", "60",
+         "--max-trials", "2",
+         "--world-size", "2", "--rank", "1",
+         "--coordinator", f"127.0.0.1:{20000 + (os.getpid() + 1) % 10000}"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+    )
+    nout = native.communicate(timeout=180)
+    pout = pyrank.communicate(timeout=180)
+    assert native.returncode == 0, nout[0] + nout[1]
+    assert pyrank.returncode == 0, pout[0] + pout[1]
