@@ -6,10 +6,16 @@ throughput over loopback. The reference quick-start reports
 1,407.84 infer/sec (HTTP, concurrency 1, GPU host); vs_baseline is measured
 throughput divided by that number.
 
+Also measures the in-process (no network, no HTTP parsing) throughput by
+driving ServerCore directly at the same concurrency — the role the
+reference's triton_c_api in-process backend plays — and reports
+``ratio_vs_inproc`` (BASELINE.json's target is >= 0.9 of in-process).
+
 Uses the C++ perf_analyzer if built (build/perf_analyzer); otherwise the
-Python async gRPC client drives the load (concurrency 4).
+Python async gRPC client drives the load.
 """
 
+import asyncio
 import json
 import os
 import subprocess
@@ -19,15 +25,14 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_INFER_PER_SEC = 1407.84
-CONCURRENCY = int(os.environ.get("BENCH_CONCURRENCY", "4"))
+CONCURRENCY = int(os.environ.get("BENCH_CONCURRENCY", "32"))
 WARMUP_S = float(os.environ.get("BENCH_WARMUP_S", "2"))
 MEASURE_S = float(os.environ.get("BENCH_MEASURE_S", "8"))
+INPROC_MEASURE_S = float(os.environ.get("BENCH_INPROC_MEASURE_S", "4"))
 
 
 def _bench_python_grpc(grpc_url: str) -> dict:
     """Closed-loop concurrency-N load via the asyncio gRPC client."""
-    import asyncio
-
     import numpy as np
 
     import client_tpu.grpc.aio as grpcclient
@@ -81,6 +86,50 @@ def _bench_python_grpc(grpc_url: str) -> dict:
             }
 
     return asyncio.run(run())
+
+
+def _bench_inprocess(server) -> float:
+    """Client-overhead-free throughput: ServerCore.infer driven directly on
+    the server's event loop at bench concurrency (the reference's
+    triton_c_api / --service-kind local measurement)."""
+    import numpy as np
+
+    from client_tpu.server.core import CoreRequest, CoreTensor
+
+    in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    in1 = np.ones([1, 16], dtype=np.int32)
+    core = server.core
+
+    def make_request():
+        return CoreRequest(
+            model_name="simple",
+            inputs=[
+                CoreTensor("INPUT0", "INT32", [1, 16], in0),
+                CoreTensor("INPUT1", "INT32", [1, 16], in1),
+            ],
+        )
+
+    async def run():
+        count = 0
+        stop_at = 0.0
+
+        async def worker():
+            nonlocal count
+            while time.monotonic() < stop_at:
+                await core.infer(make_request())
+                if time.monotonic() < stop_at:
+                    count += 1
+
+        stop_at = time.monotonic() + min(WARMUP_S, 2.0)
+        await asyncio.gather(*[worker() for _ in range(CONCURRENCY)])
+        count = 0
+        start = time.monotonic()
+        stop_at = start + INPROC_MEASURE_S
+        await asyncio.gather(*[worker() for _ in range(CONCURRENCY)])
+        return count / (time.monotonic() - start)
+
+    future = asyncio.run_coroutine_threadsafe(run(), server._loop)
+    return future.result(timeout=300)
 
 
 def _device_platform_usable(timeout_s: float = 120.0) -> bool:
@@ -151,22 +200,28 @@ def main() -> int:
             result = _bench_python_grpc(server.grpc_url)
             result["harness"] = "python-grpc-aio"
 
+        try:
+            inproc = _bench_inprocess(server)
+        except Exception as e:  # noqa: BLE001 - ratio is best-effort
+            print(f"bench: in-process measurement failed: {e}", file=sys.stderr)
+            inproc = 0.0
+
     value = round(result["throughput"], 2)
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"simple add_sub infer/sec (loopback, concurrency "
-                    f"{CONCURRENCY}, {result['harness']})"
-                ),
-                "value": value,
-                "unit": "infer/sec",
-                "vs_baseline": round(value / BASELINE_INFER_PER_SEC, 3),
-                "p50_us": round(result.get("p50_us", 0.0), 1),
-                "p99_us": round(result.get("p99_us", 0.0), 1),
-            }
-        )
-    )
+    line = {
+        "metric": (
+            f"simple add_sub infer/sec (loopback, concurrency "
+            f"{CONCURRENCY}, {result['harness']})"
+        ),
+        "value": value,
+        "unit": "infer/sec",
+        "vs_baseline": round(value / BASELINE_INFER_PER_SEC, 3),
+        "p50_us": round(result.get("p50_us", 0.0), 1),
+        "p99_us": round(result.get("p99_us", 0.0), 1),
+    }
+    if inproc > 0:
+        line["inproc_infer_per_sec"] = round(inproc, 2)
+        line["ratio_vs_inproc"] = round(value / inproc, 3)
+    print(json.dumps(line))
     return 0
 
 

@@ -75,6 +75,8 @@ class ImageClassifierModel(Model):
         jax.block_until_ready(self._apply(self._variables, dummy))
 
     def execute(self, inputs, parameters):
+        from client_tpu.server.models import run_bucketed
+
         if "INPUT" not in inputs:
             raise InferenceServerException(
                 f"model '{self.name}' expects input INPUT"
@@ -82,7 +84,9 @@ class ImageClassifierModel(Model):
         images = inputs["INPUT"]
         if images.ndim == 3:
             images = images[None]
-        logits = np.asarray(self._apply(self._variables, images))
+        (logits,) = run_bucketed(
+            lambda x: (self._apply(self._variables, x),), images
+        )
         return {"OUTPUT": logits}
 
 

@@ -72,11 +72,18 @@ class DistributedDriver:
         while joined < self.world_size - 1:
             conn, _ = self._listener.accept()
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            greeting = conn.recv(1)
+            # Bound the handshake read so a stray connection that stays open
+            # without sending its rank can't stall the whole rendezvous.
+            conn.settimeout(5.0)
+            try:
+                greeting = conn.recv(1)
+            except (TimeoutError, OSError):
+                greeting = b""
             if not greeting:
-                # Stray connection (scanner / dead peer): drop, keep waiting.
+                # Stray/silent connection (scanner / dead peer): keep waiting.
                 conn.close()
                 continue
+            conn.settimeout(None)  # barriers may legitimately block for long
             peer_rank = greeting[0]
             if not 0 < peer_rank < self.world_size or self._peers[peer_rank]:
                 conn.close()

@@ -32,7 +32,7 @@ def main(argv=None) -> int:
         help="also register the model-zoo adapters (resnet, llm_decode)",
     )
     parser.add_argument(
-        "--max-workers", type=int, default=8, help="model execution threads"
+        "--max-workers", type=int, default=32, help="model execution threads"
     )
     parser.add_argument(
         "--platform",

@@ -106,12 +106,18 @@ class _Stats:
             self.counts[field_name] += 1
             self.ns[field_name] += duration_ns
 
-    def record_success(self, batch: int, queue_ns, in_ns, infer_ns, out_ns):
+    def record_success(
+        self, batch: int, queue_ns, in_ns, infer_ns, out_ns, executions: int = 1
+    ):
+        """Account one successful request. ``executions`` is 0 for requests
+        that shared a dynamically-batched model execution with an earlier
+        request in the same batch (Triton semantics: inference_count counts
+        requests/rows, execution_count counts device executions)."""
         now_ms = int(time.time() * 1000)
         total = queue_ns + in_ns + infer_ns + out_ns
         with self.lock:
             self.inference_count += batch
-            self.execution_count += 1
+            self.execution_count += executions
             self.last_inference = now_ms
             for f, ns in (
                 ("success", total),
@@ -136,18 +142,195 @@ class _Stats:
             }
 
 
+def _to_host(raw: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Materialize model outputs on host with ONE batched transfer.
+
+    Per-array ``np.asarray`` readbacks of device results are the dominant
+    cost on TPU relays (~tens of ms each); ``jax.device_get`` of the whole
+    dict issues a single batched transfer. Models that already return numpy
+    pass through untouched. Runs inside the executor thread so the event
+    loop never blocks on a device round-trip.
+    """
+    if all(isinstance(v, np.ndarray) for v in raw.values()):
+        return raw
+    try:
+        import jax
+
+        raw = jax.device_get(raw)
+    except Exception:  # noqa: BLE001 - fall back to per-array conversion
+        pass
+    return {k: np.asarray(v) for k, v in raw.items()}
+
+
+class _ModelBatcher:
+    """Serial dynamic batcher (the server-side analogue of Triton's
+    ``dynamic_batching`` scheduler).
+
+    While one batch executes on device, newly arriving requests queue; the
+    next batch takes everything compatible that is pending, up to
+    ``max_batch_size`` rows. The execution time itself is the accumulation
+    window — no artificial delay — so a lone request sees no added latency
+    while concurrent load amortizes the device round-trip (which on TPU
+    relays has a large flat per-trip cost; see VERDICT r1 / PERF.md).
+
+    Requests are compatible when their input signature matches: same input
+    names, datatypes, non-batch dims, and parameters. Incompatible requests
+    wait for a batch of their own, preserving arrival order per signature.
+    """
+
+    def __init__(self, core: "ServerCore", model: Model):
+        self.core = core
+        self.model = model
+        # entries: (request, future, signature, rows, arrival_ns)
+        self.pending: List[Any] = []
+        self.running = False
+
+    @staticmethod
+    def _signature(request: CoreRequest):
+        return (
+            tuple(
+                (t.name, t.datatype, tuple(t.shape[1:]))
+                for t in request.inputs
+            ),
+            repr(sorted(request.parameters.items())),
+        )
+
+    def submit(self, request: CoreRequest) -> "asyncio.Future[CoreResponse]":
+        """Validate + enqueue a request; returns a future for its response.
+
+        Per-request validation happens here so a malformed request fails
+        alone instead of poisoning the batch it would have joined.
+        """
+        model = self.model
+        declared = {i["name"] for i in model.inputs}
+        rows = 1
+        if request.inputs:
+            rows = int(request.inputs[0].shape[0]) if request.inputs[0].shape else 1
+            for t in request.inputs:
+                if declared and t.name not in declared:
+                    raise InferenceServerException(
+                        f"unexpected inference input '{t.name}' for model "
+                        f"'{model.name}'"
+                    )
+                if not t.shape or int(t.shape[0]) != rows:
+                    raise InferenceServerException(
+                        f"all inputs must share the batch dimension: input "
+                        f"'{t.name}' shape {list(t.shape)} does not match "
+                        f"batch size {rows}"
+                    )
+            if rows > model.max_batch_size:
+                raise InferenceServerException(
+                    f"inference request batch-size must be <= "
+                    f"{model.max_batch_size} for '{model.name}', got {rows}"
+                )
+        loop = asyncio.get_running_loop()
+        future: asyncio.Future = loop.create_future()
+        self.pending.append(
+            (request, future, self._signature(request), rows, time.monotonic_ns())
+        )
+        if not self.running:
+            self.running = True
+            loop.create_task(self._drain())
+        return future
+
+    async def _drain(self) -> None:
+        try:
+            while self.pending:
+                await self._execute_batch(self._take_batch())
+        finally:
+            self.running = False
+            if self.pending:  # raced with a submit after the while check
+                self.running = True
+                asyncio.get_running_loop().create_task(self._drain())
+
+    def _take_batch(self) -> List[Any]:
+        """Pop the oldest request plus every compatible pending request,
+        bounded by max_batch_size rows (submit() already rejected any
+        single request exceeding the max)."""
+        lead = self.pending[0]
+        signature = lead[2]
+        budget = self.model.max_batch_size
+        taken, kept, rows = [], [], 0
+        for entry in self.pending:
+            if entry[2] == signature and rows + entry[3] <= budget:
+                taken.append(entry)
+                rows += entry[3]
+            else:
+                kept.append(entry)
+        self.pending = kept
+        return taken
+
+    async def _execute_batch(self, entries: List[Any]) -> None:
+        loop = asyncio.get_running_loop()
+        model, core = self.model, self.core
+        stats = core._stats_for(model.name)
+        exec_start = time.monotonic_ns()
+        requests = [e[0] for e in entries]
+        try:
+            merged: Dict[str, np.ndarray] = {}
+            if len(requests) == 1:
+                merged = {t.name: t.data for t in requests[0].inputs}
+            else:
+                for t in requests[0].inputs:
+                    merged[t.name] = np.concatenate(
+                        [
+                            next(i.data for i in r.inputs if i.name == t.name)
+                            for r in requests
+                        ],
+                        axis=0,
+                    )
+            def _run():
+                with model.placement():
+                    return _to_host(model.execute(merged, requests[0].parameters))
+
+            raw = await loop.run_in_executor(core._executor, _run)
+            infer_end = time.monotonic_ns()
+        except Exception as e:  # noqa: BLE001 - fail every request in batch
+            now = time.monotonic_ns()
+            for _req, future, _sig, _rows, arrival in entries:
+                stats.record("fail", now - arrival)
+                if not future.done():
+                    future.set_exception(e)
+            return
+        offset = 0
+        for index, (request, future, _sig, rows, arrival) in enumerate(entries):
+            try:
+                if len(entries) == 1:
+                    sliced = raw
+                else:
+                    sliced = {k: v[offset : offset + rows] for k, v in raw.items()}
+                response = core._package_outputs(model, request, sliced)
+                out_end = time.monotonic_ns()
+                stats.record_success(
+                    rows,
+                    queue_ns=exec_start - arrival,
+                    in_ns=0,
+                    infer_ns=infer_end - exec_start,
+                    out_ns=out_end - infer_end,
+                    executions=1 if index == 0 else 0,
+                )
+                if not future.done():
+                    future.set_result(response)
+            except Exception as e:  # noqa: BLE001 - per-request packaging error
+                stats.record("fail", time.monotonic_ns() - arrival)
+                if not future.done():
+                    future.set_exception(e)
+            offset += rows
+
+
 class ServerCore:
     """The protocol-independent inference engine."""
 
     def __init__(
         self,
         repository: Optional[ModelRepository] = None,
-        max_workers: int = 8,
+        max_workers: int = 32,
     ):
         self.repository = repository or ModelRepository()
         self.shm = SharedMemoryManager()
         self.stats: Dict[str, _Stats] = {}
         self._stats_lock = threading.Lock()
+        self._batchers: Dict[str, _ModelBatcher] = {}
         self._executor = ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="client-tpu-exec"
         )
@@ -217,7 +400,8 @@ class ServerCore:
                     f"unexpected inference input '{t.name}' for model "
                     f"'{model.name}'"
                 )
-        return model.execute(inputs, request.parameters)
+        with model.placement():
+            return _to_host(model.execute(inputs, request.parameters))
 
     def _package_outputs(
         self, model: Model, request: CoreRequest, raw: Dict[str, np.ndarray]
@@ -297,6 +481,19 @@ class ServerCore:
             raise InferenceServerException(
                 f"model '{model.name}' is decoupled; use streaming inference"
             )
+        if model.max_batch_size > 1:
+            batcher = self._batchers.get(model.name)
+            if batcher is None or batcher.model is not model:
+                batcher = _ModelBatcher(self, model)
+                self._batchers[model.name] = batcher
+            try:
+                future = batcher.submit(request)
+            except InferenceServerException:
+                # Validation failures surface synchronously; execution
+                # failures are accounted inside the batcher already.
+                self._stats_for(model.name).record("fail", 0)
+                raise
+            return await future
         stats = self._stats_for(model.name)
         t0 = time.monotonic_ns()
         loop = asyncio.get_running_loop()

@@ -33,6 +33,11 @@ class Model:
     backend: str = "jax"
     max_batch_size: int = 0
     decoupled: bool = False
+    # Placement hint: "" = framework default (the accelerator), "cpu" = the
+    # host JAX backend. Tiny elementwise models should be host-placed: a
+    # TPU-relay round-trip costs a flat ~67 ms per readback (PERF.md), so
+    # only models with real FLOPs (conv/matmul) earn the trip.
+    device: str = ""
     # [{"name", "datatype", "shape"}] — shape without batch dim if
     # max_batch_size > 0, matching Triton config conventions.
     inputs: List[Dict[str, Any]] = []
@@ -91,6 +96,24 @@ class Model:
     def labels(self, output_name: str) -> Optional[List[str]]:
         """Classification labels for an output (None if unlabeled)."""
         return None
+
+    def placement(self):
+        """Context manager placing this model's JAX work per ``device``.
+
+        Honored by the server core around execute() and usable from
+        warmup(). Falls back to the default device when the requested
+        backend is unavailable (e.g. jax_platforms pinned away from cpu).
+        """
+        import contextlib
+
+        if self.device == "cpu":
+            try:
+                import jax
+
+                return jax.default_device(jax.devices("cpu")[0])
+            except Exception:  # noqa: BLE001 - backend unavailable
+                pass
+        return contextlib.nullcontext()
 
     def execute(
         self, inputs: Dict[str, np.ndarray], parameters: Dict[str, Any]

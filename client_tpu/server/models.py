@@ -17,6 +17,39 @@ from client_tpu.server.model_repository import Model
 from client_tpu.utils import InferenceServerException
 
 
+def pad_batch_bucket(rows: int, minimum: int = 1) -> int:
+    """Next power-of-two batch bucket — bounds XLA retraces under dynamic
+    batching to O(log max_batch) compiled programs."""
+    bucket = max(minimum, 1)
+    while bucket < rows:
+        bucket *= 2
+    return bucket
+
+
+def run_bucketed(fn, *arrays):
+    """Zero-pad the leading (batch) dim of every array to a shared
+    power-of-two bucket, call ``fn(*padded)``, read ALL outputs back with
+    ONE batched transfer, and slice back to the true batch size.
+
+    Per-array readbacks cost ~tens of ms each through a TPU relay
+    (PERF.md); the bucket bounds XLA retraces to O(log max_batch).
+    ``fn`` must return a tuple/list of arrays batched on the leading dim.
+    """
+    import jax
+
+    rows = arrays[0].shape[0]
+    bucket = pad_batch_bucket(rows)
+    if bucket != rows:
+        arrays = tuple(
+            np.concatenate(
+                [a, np.zeros((bucket - rows,) + a.shape[1:], a.dtype)]
+            )
+            for a in arrays
+        )
+    outputs = jax.device_get(fn(*arrays))
+    return tuple(np.asarray(o)[:rows] for o in outputs)
+
+
 class AddSubModel(Model):
     """The canonical 'simple' model: OUTPUT0=IN0+IN1, OUTPUT1=IN0-IN1.
 
@@ -26,7 +59,7 @@ class AddSubModel(Model):
 
     platform = "jax"
     backend = "jax"
-    max_batch_size = 8
+    max_batch_size = 64
     inputs = [
         {"name": "INPUT0", "datatype": "INT32", "shape": [16]},
         {"name": "INPUT1", "datatype": "INT32", "shape": [16]},
@@ -35,6 +68,14 @@ class AddSubModel(Model):
         {"name": "OUTPUT0", "datatype": "INT32", "shape": [16]},
         {"name": "OUTPUT1", "datatype": "INT32", "shape": [16]},
     ]
+
+    # Device placement: the reference's quick-start 'simple' config is a
+    # host model (BASELINE.json configs: "'simple' add_sub model (CPU, no
+    # shm)"), and on TPU relays a device round-trip costs a flat ~67 ms per
+    # readback vs ~55 µs on the host JAX backend (measured; PERF.md) — tiny
+    # elementwise models belong on host, accelerator models (resnet, llama)
+    # on TPU.
+    device = "cpu"
 
     def __init__(self, name: str = "simple"):
         self.name = name
@@ -48,9 +89,11 @@ class AddSubModel(Model):
             return a + b, a - b
 
         self._fn = add_sub
-        # Compile for the canonical [1,16] shape so first request is fast.
+        # Compile the batch-1 bucket so the first request is fast; other
+        # power-of-two buckets compile on first use and are cached.
         z = np.zeros([1, 16], dtype=np.int32)
-        jax.block_until_ready(self._fn(z, z))
+        with self.placement():
+            jax.block_until_ready(self._fn(z, z))
 
     def execute(self, inputs, parameters):
         a, b = inputs.get("INPUT0"), inputs.get("INPUT1")
@@ -62,11 +105,8 @@ class AddSubModel(Model):
             raise InferenceServerException(
                 f"INPUT0 shape {list(a.shape)} != INPUT1 shape {list(b.shape)}"
             )
-        out0, out1 = self._fn(a, b)
-        return {
-            "OUTPUT0": np.asarray(out0),
-            "OUTPUT1": np.asarray(out1),
-        }
+        out0, out1 = run_bucketed(self._fn, a, b)
+        return {"OUTPUT0": out0, "OUTPUT1": out1}
 
 
 class IdentityModel(Model):

@@ -159,10 +159,12 @@ class OpenAiFrontend:
                 await resp.write_eof()
                 return resp
             pieces = []
+            completion_tokens = 0
             async for core_response in iterator:
                 ids = _output_ids(core_response)
                 if ids is not None:
                     pieces.append(self.tokenizer.decode(ids))
+                    completion_tokens += len(ids)
             text = " ".join(pieces)
             doc = chunk(None, "stop")
             if is_chat:
@@ -173,10 +175,12 @@ class OpenAiFrontend:
                 }
             else:
                 doc["choices"][0]["text"] = text
+            # Count token ids, not decoupled responses — a response may carry
+            # several ids (streaming path counts the same way).
             doc["usage"] = {
                 "prompt_tokens": len(prompt_ids),
-                "completion_tokens": len(pieces),
-                "total_tokens": len(prompt_ids) + len(pieces),
+                "completion_tokens": completion_tokens,
+                "total_tokens": len(prompt_ids) + completion_tokens,
             }
             return web.json_response(doc)
         except InferenceServerException as e:

@@ -1,5 +1,7 @@
 #include "grpc_client.h"
 
+#include <algorithm>
+#include <cctype>
 #include <cstring>
 #include <sstream>
 
@@ -338,7 +340,13 @@ std::vector<hpack::Header> InferenceServerGrpcClient::BuildHeaders(
     headers.push_back({"grpc-timeout", GrpcTimeoutValue(timeout_us)});
   }
   for (const auto& kv : user_headers) {
-    headers.push_back({kv.first, kv.second});
+    // HTTP/2 field names MUST be lowercase (RFC 7540 §8.1.2); grpc++
+    // lowercases user metadata keys transparently, so do the same rather
+    // than HPACK-encoding a malformed uppercase name.
+    std::string name = kv.first;
+    std::transform(name.begin(), name.end(), name.begin(),
+                   [](unsigned char c) { return std::tolower(c); });
+    headers.push_back({std::move(name), kv.second});
   }
   return headers;
 }

@@ -20,6 +20,14 @@ constexpr char kAckByte = 'A';
 constexpr int kConnectRetries = 100;           // ~10s of startup skew
 constexpr int kConnectRetryDelayMs = 100;
 
+// 0 ms = blocking (clears a previously set timeout).
+void SetRecvTimeoutMs(int fd, int ms) {
+  struct timeval tv;
+  tv.tv_sec = ms / 1000;
+  tv.tv_usec = (ms % 1000) * 1000;
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+}
+
 Error ReadByte(int fd, char* out) {
   while (true) {
     const ssize_t n = recv(fd, out, 1, 0);
@@ -113,11 +121,15 @@ Error DistributedDriver::Listen(const std::string& coordinator) {
     if (fd < 0) return Error(std::string("rendezvous accept: ") +
                              strerror(errno));
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    // Bound the handshake read: a stray connection that stays open without
+    // sending its rank byte must not stall the whole rendezvous.
+    SetRecvTimeoutMs(fd, 5000);
     char peer_rank;
     if (!ReadByte(fd, &peer_rank).IsOk()) {
-      close(fd);  // stray connection (scanner / dead peer): keep waiting
+      close(fd);  // stray or silent connection: keep waiting for real peers
       continue;
     }
+    SetRecvTimeoutMs(fd, 0);  // barriers may legitimately block for long
     const int r = static_cast<int>(peer_rank);
     if (r <= 0 || r >= world_size_ || peer_fds_[r] != -1) {
       close(fd);

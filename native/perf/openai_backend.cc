@@ -166,12 +166,19 @@ Error OpenAiBackendContext::Infer(
     record->start_ns = record->end_ns = RequestTimers::Now();
     return err;
   }
-  // Inject "stream": true for SSE mode (reference ChatCompletionRequest
-  // carries is_stream_; genai-perf payloads may already set it).
-  if (streaming_ && payload.find("\"stream\"") == std::string::npos) {
-    const size_t brace = payload.rfind('}');
-    if (brace != std::string::npos) {
-      payload.insert(brace, ", \"stream\": true");
+  // Force "stream": true for SSE mode by rewriting the parsed JSON —
+  // substring checks would be fooled by "stream": false or by the word
+  // appearing inside a message string (reference ChatCompletionRequest
+  // carries is_stream_ explicitly).
+  if (streaming_) {
+    try {
+      json::Value doc = json::Parse(payload);
+      if (doc.IsObject()) {
+        doc.AsObject()["stream"] = json::Value(true);
+        payload = doc.Dump();
+      }
+    } catch (const std::exception&) {
+      // Leave a non-JSON payload untouched; the server will reject it.
     }
   }
 

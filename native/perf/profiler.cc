@@ -214,9 +214,10 @@ Error InferenceProfiler::ProfileConcurrencyRange(ConcurrencyManager* manager,
 Error InferenceProfiler::ProfileRequestRateRange(RequestRateManager* manager,
                                                  double start, double end,
                                                  double step) {
-  // An explicit 0 step would make the sweep effectively infinite.
+  // A non-positive step would make the sweep effectively infinite;
+  // fractional steps (e.g. 1:5:0.5) are legitimate and pass through.
   for (double rate = start; rate <= end + 1e-9;
-       rate += std::max(1.0, step)) {
+       rate += (step <= 0 ? 1.0 : step)) {
     if (config_.early_exit != nullptr && config_.early_exit->load()) break;
     manager->ChangeRate(rate);
     PerfStatus status;

@@ -1,0 +1,205 @@
+"""Server-side dynamic batching (the Triton dynamic_batching analogue).
+
+Concurrent requests to a batchable model must share device executions:
+inference_count counts requests/rows while execution_count counts model
+executions (reference: Triton statistics extension semantics; scheduler
+behavior reference model_config.proto dynamic_batching).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from client_tpu.server.core import CoreRequest, CoreTensor, ServerCore
+from client_tpu.server.model_repository import Model, ModelRepository
+from client_tpu.utils import InferenceServerException
+
+
+class _CountingBatchModel(Model):
+    """Batchable add-one model that records every execute() batch size."""
+
+    name = "batch_counter"
+    max_batch_size = 16
+    inputs = [{"name": "X", "datatype": "FP32", "shape": [4]}]
+    outputs = [{"name": "Y", "datatype": "FP32", "shape": [4]}]
+
+    def __init__(self):
+        self.batch_sizes = []
+
+    def execute(self, inputs, parameters):
+        x = inputs["X"]
+        self.batch_sizes.append(x.shape[0])
+        return {"Y": x + 1.0}
+
+
+def _request(value: float, rows: int = 1, cols: int = 4, name: str = "X"):
+    data = np.full([rows, cols], value, dtype=np.float32)
+    return CoreRequest(
+        model_name="batch_counter",
+        inputs=[
+            CoreTensor(
+                name=name,
+                datatype="FP32",
+                shape=list(data.shape),
+                data=data,
+            )
+        ],
+    )
+
+
+@pytest.fixture()
+def core():
+    repository = ModelRepository()
+    model = _CountingBatchModel()
+    repository.add_model(model)
+    core = ServerCore(repository)
+    yield core, model
+    core.close()
+
+
+def test_concurrent_requests_share_executions(core):
+    core_obj, model = core
+
+    async def run():
+        return await asyncio.gather(
+            *[core_obj.infer(_request(float(i))) for i in range(12)]
+        )
+
+    responses = asyncio.run(run())
+    for i, resp in enumerate(responses):
+        np.testing.assert_allclose(resp.outputs[0].data, float(i) + 1.0)
+    # All 12 landed before the loop ran the drain task, so far fewer than
+    # 12 executions happened (first batch may be small; the rest coalesce).
+    assert len(model.batch_sizes) < 12
+    assert sum(model.batch_sizes) == 12
+    stats = core_obj.stats["batch_counter"]
+    assert stats.inference_count == 12
+    assert stats.execution_count == len(model.batch_sizes)
+
+
+def test_batch_respects_max_batch_size(core):
+    core_obj, model = core
+
+    async def run():
+        return await asyncio.gather(
+            *[core_obj.infer(_request(1.0, rows=3)) for i in range(10)]
+        )
+
+    responses = asyncio.run(run())
+    assert len(responses) == 10
+    assert all(b <= model.max_batch_size for b in model.batch_sizes)
+    assert sum(model.batch_sizes) == 30
+
+
+def test_varying_rows_share_batches(core):
+    """Requests differing only in their batch dim share a signature and
+    CAN coalesce into one execution."""
+    core_obj, model = core
+
+    async def run():
+        return await asyncio.gather(
+            *(
+                [core_obj.infer(_request(1.0, cols=4)) for _ in range(4)]
+                + [core_obj.infer(_request(2.0, cols=4, rows=2)) for _ in range(2)]
+            )
+        )
+
+    responses = asyncio.run(run())
+    assert len(responses) == 6
+    assert sum(model.batch_sizes) == 8
+
+
+def test_incompatible_signatures_batch_separately(core):
+    """Different non-batch dims must NOT be concatenated into one batch."""
+    core_obj, model = core
+
+    async def run():
+        return await asyncio.gather(
+            *(
+                [core_obj.infer(_request(1.0, cols=4)) for _ in range(3)]
+                + [core_obj.infer(_request(2.0, cols=5)) for _ in range(3)]
+            ),
+            return_exceptions=True,
+        )
+
+    results = asyncio.run(run())
+    # The model itself accepts any cols; what matters is the batcher never
+    # merged cols=4 with cols=5 (np.concatenate would have raised).
+    assert all(not isinstance(r, Exception) for r in results)
+    assert len(model.batch_sizes) >= 2
+    assert sum(model.batch_sizes) == 6
+    for resp, expect in zip(results, [2.0] * 3 + [3.0] * 3):
+        np.testing.assert_allclose(resp.outputs[0].data, expect)
+
+
+def test_over_max_batch_rejected(core):
+    """A single request whose batch dim exceeds max_batch_size errors
+    (Triton semantics) instead of silently executing."""
+    core_obj, model = core
+
+    async def run():
+        return await core_obj.infer(_request(1.0, rows=model.max_batch_size + 1))
+
+    with pytest.raises(InferenceServerException, match="batch-size must be"):
+        asyncio.run(run())
+
+
+def test_different_parameters_not_batched(core):
+    core_obj, model = core
+
+    async def run():
+        r1 = _request(1.0)
+        r2 = _request(2.0)
+        r2.parameters = {"mode": "other"}
+        return await asyncio.gather(core_obj.infer(r1), core_obj.infer(r2))
+
+    asyncio.run(run())
+    # Two signatures -> at least two executions even though both fit one batch.
+    assert len(model.batch_sizes) >= 2
+
+
+def test_bad_request_fails_alone(core):
+    core_obj, model = core
+
+    async def run():
+        good = [core_obj.infer(_request(float(i))) for i in range(3)]
+        bad = core_obj.infer(_request(9.0, name="WRONG"))
+        results = await asyncio.gather(*good, bad, return_exceptions=True)
+        return results
+
+    results = asyncio.run(run())
+    assert all(not isinstance(r, Exception) for r in results[:3])
+    assert isinstance(results[3], InferenceServerException)
+    assert "unexpected inference input" in results[3].message()
+
+
+def test_single_request_no_added_latency_path(core):
+    core_obj, model = core
+
+    async def run():
+        return await core_obj.infer(_request(5.0))
+
+    resp = asyncio.run(run())
+    np.testing.assert_allclose(resp.outputs[0].data, 6.0)
+    assert model.batch_sizes == [1]
+
+
+def test_mismatched_batch_dims_rejected(core):
+    core_obj, model = core
+
+    req = _request(1.0, rows=2)
+    req.inputs.append(
+        CoreTensor(
+            name="X2",
+            datatype="FP32",
+            shape=[3, 4],
+            data=np.zeros([3, 4], dtype=np.float32),
+        )
+    )
+
+    async def run():
+        return await core_obj.infer(req)
+
+    with pytest.raises(InferenceServerException):
+        asyncio.run(run())

@@ -55,7 +55,7 @@ def test_metadata(client):
 
 def test_model_config(client):
     config = client.get_model_config("simple")
-    assert config.config.max_batch_size == 8
+    assert config.config.max_batch_size == 64
     assert config.config.backend == "jax"
     assert not config.config.model_transaction_policy.decoupled
     repeat_config = client.get_model_config("repeat_int32")

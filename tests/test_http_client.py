@@ -61,7 +61,7 @@ def test_model_metadata(client):
 
 def test_model_config(client):
     config = client.get_model_config("simple")
-    assert config["max_batch_size"] == 8
+    assert config["max_batch_size"] == 64
     assert config["backend"] == "jax"
 
 
