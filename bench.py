@@ -200,6 +200,32 @@ def main() -> int:
             result = _bench_python_grpc(server.grpc_url)
             result["harness"] = "python-grpc-aio"
 
+        # Variant row: same load through the tpu-shm data plane (region refs
+        # instead of inline tensors) — the BASELINE.json north-star config.
+        shm_throughput = 0.0
+        if os.path.exists(pa):
+            try:
+                out = subprocess.run(
+                    [
+                        pa,
+                        "-m", "simple",
+                        "-u", server.http_url,
+                        "--shared-memory", "tpu",
+                        "--concurrency-range", str(CONCURRENCY),
+                        "--measurement-interval",
+                        str(int(MEASURE_S * 500)),
+                        "--json-summary",
+                    ],
+                    capture_output=True, text=True, timeout=300,
+                )
+                for line in out.stdout.splitlines():
+                    line = line.strip()
+                    if line.startswith("{"):
+                        shm_throughput = json.loads(line)["throughput"]
+                        break
+            except Exception:
+                shm_throughput = 0.0
+
         try:
             inproc = _bench_inprocess(server)
         except Exception as e:  # noqa: BLE001 - ratio is best-effort
@@ -221,6 +247,8 @@ def main() -> int:
     if inproc > 0:
         line["inproc_infer_per_sec"] = round(inproc, 2)
         line["ratio_vs_inproc"] = round(value / inproc, 3)
+    if shm_throughput > 0:
+        line["tpu_shm_infer_per_sec"] = round(shm_throughput, 2)
     print(json.dumps(line))
     return 0
 

@@ -156,10 +156,9 @@ class LlmDecodeModel(Model):
         """Next power-of-two bucket — bounds XLA retraces to
         O(log max_seq_len) prefill shapes instead of one per prompt
         length."""
-        bucket = minimum
-        while bucket < n:
-            bucket *= 2
-        return bucket
+        from client_tpu.server.models import pad_batch_bucket
+
+        return pad_batch_bucket(n, minimum=minimum)
 
     async def execute_decoupled(
         self, inputs: Dict[str, np.ndarray], parameters: Dict[str, Any]

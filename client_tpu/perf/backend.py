@@ -19,13 +19,30 @@ from client_tpu.utils import InferenceServerException
 
 
 class PerfInferInput:
-    """Backend-independent input tensor description."""
+    """Backend-independent input tensor description.
 
-    def __init__(self, name: str, shape: Sequence[int], datatype: str, data: np.ndarray):
+    When ``shm_region`` is set the request carries only the region
+    reference (the shared-memory data plane); ``data`` is then the staged
+    content for bookkeeping, not serialized onto the wire.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        shape: Sequence[int],
+        datatype: str,
+        data: np.ndarray,
+        shm_region: Optional[str] = None,
+        shm_byte_size: int = 0,
+        shm_offset: int = 0,
+    ):
         self.name = name
         self.shape = list(shape)
         self.datatype = datatype
         self.data = data
+        self.shm_region = shm_region
+        self.shm_byte_size = shm_byte_size
+        self.shm_offset = shm_offset
 
 
 class PerfBackend:
@@ -80,6 +97,42 @@ class PerfBackend:
     async def get_inference_statistics(self, model_name: str = "") -> Dict:
         return {}
 
+    # -- shared-memory data plane (reference client_backend.h:433-485) ------
+
+    async def register_system_shared_memory(
+        self, name: str, key: str, byte_size: int
+    ) -> None:
+        raise InferenceServerException(
+            f"shared memory not supported by the '{self.kind}' backend"
+        )
+
+    async def unregister_system_shared_memory(self, name: str = "") -> None:
+        raise InferenceServerException(
+            f"shared memory not supported by the '{self.kind}' backend"
+        )
+
+    async def register_tpu_shared_memory(
+        self, name: str, raw_handle: bytes, device_id: int, byte_size: int
+    ) -> None:
+        raise InferenceServerException(
+            f"TPU shared memory not supported by the '{self.kind}' backend"
+        )
+
+    async def unregister_tpu_shared_memory(self, name: str = "") -> None:
+        raise InferenceServerException(
+            f"TPU shared memory not supported by the '{self.kind}' backend"
+        )
+
+
+def _build_client_input(mod, t: PerfInferInput):
+    """PerfInferInput -> client InferInput: shm reference or inline data."""
+    x = mod.InferInput(t.name, t.shape, t.datatype)
+    if t.shm_region is not None:
+        x.set_shared_memory(t.shm_region, t.shm_byte_size, t.shm_offset)
+    else:
+        x.set_data_from_numpy(t.data)
+    return x
+
 
 # ---------------------------------------------------------------------------
 
@@ -108,12 +161,23 @@ class HttpPerfBackend(PerfBackend):
         return await self._client.get_inference_statistics(model_name)
 
     def _build_inputs(self, inputs):
-        built = []
-        for t in inputs:
-            x = self._mod.InferInput(t.name, t.shape, t.datatype)
-            x.set_data_from_numpy(t.data)
-            built.append(x)
-        return built
+        return [_build_client_input(self._mod, t) for t in inputs]
+
+    async def register_system_shared_memory(self, name, key, byte_size):
+        await self._client.register_system_shared_memory(name, key, byte_size)
+
+    async def unregister_system_shared_memory(self, name=""):
+        await self._client.unregister_system_shared_memory(name)
+
+    async def register_tpu_shared_memory(
+        self, name, raw_handle, device_id, byte_size
+    ):
+        await self._client.register_tpu_shared_memory(
+            name, raw_handle, device_id, byte_size
+        )
+
+    async def unregister_tpu_shared_memory(self, name=""):
+        await self._client.unregister_tpu_shared_memory(name)
 
     async def infer(
         self,
@@ -168,12 +232,23 @@ class GrpcPerfBackend(PerfBackend):
         )
 
     def _build_inputs(self, inputs):
-        built = []
-        for t in inputs:
-            x = self._mod.InferInput(t.name, t.shape, t.datatype)
-            x.set_data_from_numpy(t.data)
-            built.append(x)
-        return built
+        return [_build_client_input(self._mod, t) for t in inputs]
+
+    async def register_system_shared_memory(self, name, key, byte_size):
+        await self._client.register_system_shared_memory(name, key, byte_size)
+
+    async def unregister_system_shared_memory(self, name=""):
+        await self._client.unregister_system_shared_memory(name)
+
+    async def register_tpu_shared_memory(
+        self, name, raw_handle, device_id, byte_size
+    ):
+        await self._client.register_tpu_shared_memory(
+            name, raw_handle, device_id, byte_size
+        )
+
+    async def unregister_tpu_shared_memory(self, name=""):
+        await self._client.unregister_tpu_shared_memory(name)
 
     async def infer(
         self,
@@ -339,6 +414,9 @@ class MockPerfBackend(PerfBackend):
         self.max_inflight = 0
         # per-request kwargs as issued, for assertions
         self.requests: List[Dict[str, Any]] = []
+        # shared-memory registration accounting (for data-plane tests)
+        self.shm_registrations: List[Dict[str, Any]] = []
+        self.shm_unregistrations: List[str] = []
         self._metadata = metadata or {
             "name": "mock",
             "versions": ["1"],
@@ -384,6 +462,30 @@ class MockPerfBackend(PerfBackend):
         for _ in range(self.responses_per_request):
             await asyncio.sleep(self.latency_s / self.responses_per_request)
             on_response()
+
+    async def register_system_shared_memory(self, name, key, byte_size):
+        self.shm_registrations.append(
+            {"kind": "system", "name": name, "key": key, "byte_size": byte_size}
+        )
+
+    async def unregister_system_shared_memory(self, name=""):
+        self.shm_unregistrations.append(name)
+
+    async def register_tpu_shared_memory(
+        self, name, raw_handle, device_id, byte_size
+    ):
+        self.shm_registrations.append(
+            {
+                "kind": "tpu",
+                "name": name,
+                "raw_handle": raw_handle,
+                "device_id": device_id,
+                "byte_size": byte_size,
+            }
+        )
+
+    async def unregister_tpu_shared_memory(self, name=""):
+        self.shm_unregistrations.append(name)
 
 
 class OpenAiPerfBackend(PerfBackend):

@@ -113,6 +113,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument("--input-data", default=None, help="JSON data file")
     parser.add_argument(
+        "--shared-memory",
+        choices=("none", "system", "tpu"),
+        default="none",
+        help="stage inputs into registered shared-memory regions "
+        "(system or tpu extension) instead of inline tensors",
+    )
+    parser.add_argument(
         "--shape",
         action="append",
         default=[],
@@ -225,6 +232,7 @@ async def run(args) -> int:
         )
         await backend.close()
         return 2
+    shm_plane = None
     try:
         metadata = await backend.get_model_metadata(
             args.model_name, args.model_version
@@ -250,6 +258,13 @@ async def run(args) -> int:
             loader.read_from_json(args.input_data)
         else:
             loader.generate_synthetic()
+
+        if args.shared_memory != "none":
+            from client_tpu.perf.data import ShmDataPlane
+
+            shm_plane = ShmDataPlane(loader, backend, kind=args.shared_memory)
+            await shm_plane.setup()
+            loader = shm_plane
 
         sequence_manager = None
         if args.sequence_length > 0:
@@ -411,6 +426,8 @@ async def run(args) -> int:
             )
         return 0
     finally:
+        if shm_plane is not None:
+            await shm_plane.cleanup()
         await backend.close()
 
 

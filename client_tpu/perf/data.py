@@ -227,3 +227,123 @@ class DataLoader:
             return None
         data = self._params[stream % len(self._params)]
         return data[step % len(data)] if data else None
+
+
+class ShmDataPlane:
+    """Shared-memory data plane over a DataLoader (system or tpu kind).
+
+    The Python twin of the reference's InferDataManagerShm
+    (reference infer_data_manager_shm.cc:1-384): every (stream, step, input)
+    tensor is staged ONCE into a created-and-registered region at
+    :meth:`setup`; :meth:`get_inputs` then returns PerfInferInput objects
+    carrying only region references, so request bodies stay tiny no matter
+    the tensor size. Kind "tpu" registers over the tpu-shm extension with
+    the JSON raw handle (client_tpu.utils.tpu_shared_memory), "system" over
+    the system-shm extension.
+
+    Exposes the DataLoader read API (get_inputs/get_parameters/
+    stream_count/step_count) so load managers can use it as a drop-in.
+    """
+
+    def __init__(self, loader: DataLoader, backend, kind: str = "system",
+                 prefix: Optional[str] = None):
+        if kind not in ("system", "tpu"):
+            raise InferenceServerException(
+                f"unsupported shared-memory kind '{kind}'"
+            )
+        self._loader = loader
+        self._backend = backend
+        self._kind = kind
+        self._prefix = prefix or f"ctpu_pyperf_{os.getpid()}"
+        # (stream, step, input name) -> (region name, byte size)
+        self._refs: Dict[Any, Any] = {}
+        self._handles: List[Any] = []
+        self._registered: List[str] = []
+
+    @property
+    def stream_count(self) -> int:
+        return self._loader.stream_count
+
+    def step_count(self, stream: int) -> int:
+        return self._loader.step_count(stream)
+
+    @staticmethod
+    def _payload(t: PerfInferInput) -> bytes:
+        from client_tpu.utils import serialize_byte_tensor
+
+        if t.datatype == "BYTES":
+            return serialize_byte_tensor(t.data).tobytes()
+        return np.ascontiguousarray(t.data).tobytes()
+
+    async def setup(self) -> None:
+        """Create, fill, and register one region per (stream, step, input)."""
+        for stream in range(self._loader.stream_count):
+            for step in range(self._loader.step_count(stream)):
+                for t in self._loader.get_inputs(stream, step):
+                    payload = self._payload(t)
+                    name = f"{self._prefix}_s{stream}_t{step}_{t.name}"
+                    if self._kind == "tpu":
+                        from client_tpu.utils import tpu_shared_memory as tpushm
+
+                        handle = tpushm.create_shared_memory_region(
+                            name, len(payload)
+                        )
+                        handle.buf(0, len(payload))[:] = payload
+                        await self._backend.register_tpu_shared_memory(
+                            name,
+                            tpushm.get_raw_handle(handle),
+                            handle.device_id(),
+                            len(payload),
+                        )
+                    else:
+                        from client_tpu.utils import shared_memory as sysshm
+
+                        handle = sysshm.create_shared_memory_region(
+                            name, f"/{name}", len(payload)
+                        )
+                        handle.buf(0, len(payload))[:] = payload
+                        await self._backend.register_system_shared_memory(
+                            name, f"/{name}", len(payload)
+                        )
+                    self._handles.append(handle)
+                    self._registered.append(name)
+                    self._refs[(stream, step, t.name)] = (name, len(payload))
+
+    def get_inputs(self, stream: int = 0, step: int = 0) -> List[PerfInferInput]:
+        inputs = self._loader.get_inputs(stream, step)
+        s = stream % self._loader.stream_count
+        t = step % self._loader.step_count(s)
+        for inp in inputs:
+            region, byte_size = self._refs[(s, t, inp.name)]
+            inp.shm_region = region
+            inp.shm_byte_size = byte_size
+        return inputs
+
+    def get_parameters(self, stream: int = 0, step: int = 0):
+        return self._loader.get_parameters(stream, step)
+
+    async def cleanup(self) -> None:
+        """Unregister from the server and free the local mappings."""
+        for name in self._registered:
+            try:
+                if self._kind == "tpu":
+                    await self._backend.unregister_tpu_shared_memory(name)
+                else:
+                    await self._backend.unregister_system_shared_memory(name)
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
+        self._registered.clear()
+        for handle in self._handles:
+            try:
+                if self._kind == "tpu":
+                    from client_tpu.utils import tpu_shared_memory as tpushm
+
+                    tpushm.destroy_shared_memory_region(handle)
+                else:
+                    from client_tpu.utils import shared_memory as sysshm
+
+                    sysshm.destroy_shared_memory_region(handle)
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
+        self._handles.clear()
+        self._refs.clear()

@@ -129,6 +129,11 @@ class _Stats:
                 self.counts[f] += 1
                 self.ns[f] += ns
 
+    def record_execution(self) -> None:
+        """Count a device execution whose every request failed packaging."""
+        with self.lock:
+            self.execution_count += 1
+
     def snapshot(self) -> Dict[str, Any]:
         with self.lock:
             return {
@@ -293,7 +298,11 @@ class _ModelBatcher:
                     future.set_exception(e)
             return
         offset = 0
-        for index, (request, future, _sig, rows, arrival) in enumerate(entries):
+        # The ONE device execution is credited to the first request whose
+        # packaging succeeds; if every request fails packaging it is still
+        # counted (the execution happened regardless).
+        execution_pending = 1
+        for request, future, _sig, rows, arrival in entries:
             try:
                 if len(entries) == 1:
                     sliced = raw
@@ -307,8 +316,9 @@ class _ModelBatcher:
                     in_ns=0,
                     infer_ns=infer_end - exec_start,
                     out_ns=out_end - infer_end,
-                    executions=1 if index == 0 else 0,
+                    executions=execution_pending,
                 )
+                execution_pending = 0
                 if not future.done():
                     future.set_result(response)
             except Exception as e:  # noqa: BLE001 - per-request packaging error
@@ -316,6 +326,8 @@ class _ModelBatcher:
                 if not future.done():
                     future.set_exception(e)
             offset += rows
+        if execution_pending:
+            stats.record_execution()
 
 
 class ServerCore:
@@ -382,6 +394,22 @@ class ServerCore:
         return {"model_stats": result}
 
     # -- inference -----------------------------------------------------------
+
+    @staticmethod
+    def _has_batch_dim(model: Model, request: CoreRequest) -> bool:
+        """True when the request's input shapes include the batch dim.
+
+        Clients may send a batchable model its unbatched form (e.g. an
+        [H, W, 3] image to a [-1, H, W, 3] model); those requests bypass
+        the dynamic batcher — concatenating along axis 0 would corrupt
+        them — and execute singly, as before batching existed.
+        """
+        declared = {i["name"]: i for i in model.inputs}
+        for t in request.inputs:
+            desc = declared.get(t.name)
+            if desc is not None and len(t.shape) == len(desc["shape"]):
+                return False
+        return True
 
     def _resolve_batch(self, model: Model, request: CoreRequest) -> int:
         if not request.inputs:
@@ -481,7 +509,7 @@ class ServerCore:
             raise InferenceServerException(
                 f"model '{model.name}' is decoupled; use streaming inference"
             )
-        if model.max_batch_size > 1:
+        if model.max_batch_size > 1 and self._has_batch_dim(model, request):
             batcher = self._batchers.get(model.name)
             if batcher is None or batcher.model is not model:
                 batcher = _ModelBatcher(self, model)

@@ -546,12 +546,49 @@ Error InferenceServerHttpClient::SystemSharedMemoryStatus(json::Value* status) {
   return JsonGet("v2/systemsharedmemory/status", status);
 }
 
+namespace {
+// Minimal base64 for the raw-handle wire wrapping (RFC 4648, with padding).
+std::string Base64Encode(const std::string& in) {
+  static const char* kTable =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  std::string out;
+  out.reserve(((in.size() + 2) / 3) * 4);
+  size_t i = 0;
+  while (i + 2 < in.size()) {
+    const uint32_t v = (uint8_t(in[i]) << 16) | (uint8_t(in[i + 1]) << 8) |
+                       uint8_t(in[i + 2]);
+    out += kTable[(v >> 18) & 63];
+    out += kTable[(v >> 12) & 63];
+    out += kTable[(v >> 6) & 63];
+    out += kTable[v & 63];
+    i += 3;
+  }
+  if (i + 1 == in.size()) {
+    const uint32_t v = uint8_t(in[i]) << 16;
+    out += kTable[(v >> 18) & 63];
+    out += kTable[(v >> 12) & 63];
+    out += "==";
+  } else if (i + 2 == in.size()) {
+    const uint32_t v = (uint8_t(in[i]) << 16) | (uint8_t(in[i + 1]) << 8);
+    out += kTable[(v >> 18) & 63];
+    out += kTable[(v >> 12) & 63];
+    out += kTable[(v >> 6) & 63];
+    out += '=';
+  }
+  return out;
+}
+}  // namespace
+
 Error InferenceServerHttpClient::RegisterTpuSharedMemory(
-    const std::string& name, const std::string& key, size_t byte_size,
-    size_t offset) {
+    const std::string& name, const std::string& raw_handle, int64_t device_id,
+    size_t byte_size) {
+  // Wire shape mirrors the reference's cudasharedmemory register: the
+  // opaque handle rides base64-wrapped ({"raw_handle": {"b64": ...}}).
+  json::Object handle;
+  handle["b64"] = json::Value(Base64Encode(raw_handle));
   json::Object payload;
-  payload["key"] = json::Value(key);
-  payload["offset"] = json::Value((int64_t)offset);
+  payload["raw_handle"] = json::Value(std::move(handle));
+  payload["device_id"] = json::Value(device_id);
   payload["byte_size"] = json::Value((int64_t)byte_size);
   json::Value out;
   return JsonPost("v2/tpusharedmemory/region/" + name + "/register",

@@ -148,9 +148,12 @@ class InferenceServerHttpClient : public InferenceServerClient {
                                    size_t offset = 0);
   Error UnregisterSystemSharedMemory(const std::string& name = "");
   Error SystemSharedMemoryStatus(json::Value* status);
+  // raw_handle is the JSON region handle (the
+  // client_tpu.utils.tpu_shared_memory.get_raw_handle document), carried
+  // base64-wrapped on the wire like the reference's CUDA handle.
   Error RegisterTpuSharedMemory(const std::string& name,
-                                const std::string& key, size_t byte_size,
-                                size_t offset = 0);
+                                const std::string& raw_handle,
+                                int64_t device_id, size_t byte_size);
   Error UnregisterTpuSharedMemory(const std::string& name = "");
   Error TpuSharedMemoryStatus(json::Value* status);
 

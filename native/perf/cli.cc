@@ -109,7 +109,9 @@ std::string Usage() {
       "  --warmup-request-period S   warmup seconds before measuring\n"
       "  --input-data FILE           input-data JSON\n"
       "  --shape NAME:D1,D2,...      shape override for dynamic dims\n"
-      "  --shared-memory MODE        none | system\n"
+      "  --shared-memory MODE        none | system | tpu\n"
+      "  --output-shared-memory-size BYTES  redirect outputs to per-worker\n"
+      "                              shm regions of this size (shm modes)\n"
       "  --streaming                 streaming mode flag\n"
       "  --sequence-length N         sequence length (default 20)\n"
       "  --sequence-length-variation P  +-pct length variation\n"
@@ -233,6 +235,15 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
     } else if (arg == "--shared-memory") {
       CTPU_RETURN_IF_ERROR(need(i));
       params->shared_memory = next();
+      if (params->shared_memory != "none" &&
+          params->shared_memory != "system" &&
+          params->shared_memory != "tpu") {
+        return Error("--shared-memory must be none, system, or tpu");
+      }
+    } else if (arg == "--output-shared-memory-size") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->output_shared_memory_size =
+          static_cast<size_t>(std::atoll(next().c_str()));
     } else if (arg == "--streaming") {
       params->streaming = true;
     } else if (arg == "--sequence-length") {

@@ -46,7 +46,8 @@ struct PAParams {
 
   std::string input_data_file;
   std::map<std::string, std::vector<int64_t>> shape_overrides;
-  std::string shared_memory = "none";  // none | system
+  std::string shared_memory = "none";  // none | system | tpu
+  size_t output_shared_memory_size = 0;  // 0 = outputs returned inline
   bool streaming = false;
 
   int sequence_length = 20;

@@ -76,6 +76,23 @@ class ClientBackend {
     (void)name;
     return Error("shared memory not supported by this backend");
   }
+
+  // TPU shared-memory registration (the CUDA-IPC replacement data plane;
+  // reference client_backend.h RegisterCudaSharedMemory). raw_handle is the
+  // JSON region handle (tpu_shared_memory.get_raw_handle document).
+  virtual Error RegisterTpuSharedMemory(const std::string& name,
+                                        const std::string& raw_handle,
+                                        int64_t device_id, size_t byte_size) {
+    (void)name;
+    (void)raw_handle;
+    (void)device_id;
+    (void)byte_size;
+    return Error("TPU shared memory not supported by this backend");
+  }
+  virtual Error UnregisterTpuSharedMemory(const std::string& name) {
+    (void)name;
+    return Error("TPU shared memory not supported by this backend");
+  }
 };
 
 struct BackendFactoryConfig {

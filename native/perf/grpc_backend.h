@@ -79,6 +79,16 @@ class GrpcClientBackend : public ClientBackend {
   Error UnregisterSystemSharedMemory(const std::string& name) override {
     return client_->UnregisterSystemSharedMemory(name);
   }
+  Error RegisterTpuSharedMemory(const std::string& name,
+                                const std::string& raw_handle,
+                                int64_t device_id,
+                                size_t byte_size) override {
+    return client_->RegisterTpuSharedMemory(name, raw_handle, device_id,
+                                            byte_size);
+  }
+  Error UnregisterTpuSharedMemory(const std::string& name) override {
+    return client_->UnregisterTpuSharedMemory(name);
+  }
 
  private:
   GrpcClientBackend(std::string url, bool streaming)

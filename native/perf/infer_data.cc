@@ -9,7 +9,56 @@
 namespace ctpu {
 namespace perf {
 
+namespace {
+// The JSON region handle the tpu-shm extension exchanges
+// (client_tpu.utils.tpu_shared_memory.get_raw_handle document).
+std::string TpuRawHandle(const std::string& shm_key, size_t byte_size) {
+  json::Object handle;
+  handle["kind"] = json::Value("tpu-host-pinned");
+  handle["shm_key"] = json::Value(shm_key);
+  handle["byte_size"] = json::Value((int64_t)byte_size);
+  handle["device_id"] = json::Value((int64_t)0);
+  return json::Value(std::move(handle)).Dump();
+}
+}  // namespace
+
 InferDataManagerShm::~InferDataManagerShm() { Cleanup(); }
+
+Error InferDataManagerShm::CreateAndRegister(const std::string& name,
+                                             size_t byte_size,
+                                             Region* region) {
+  region->name = name;
+  region->key = "/" + name;
+  region->byte_size = byte_size;
+  CTPU_RETURN_IF_ERROR(
+      CreateSharedMemoryRegion(region->key, byte_size, &region->fd));
+  Error err = MapSharedMemory(region->fd, 0, byte_size, &region->addr);
+  if (err.IsOk()) {
+    err = kind_ == ShmKind::TPU
+              ? backend_->RegisterTpuSharedMemory(
+                    name, TpuRawHandle(region->key, byte_size),
+                    /*device_id=*/0, byte_size)
+              : backend_->RegisterSystemSharedMemory(name, region->key,
+                                                     byte_size);
+  }
+  if (!err.IsOk()) {
+    // Release the partially-built region: a failed registration must not
+    // leak the mapping/fd or leave the /dev/shm file behind.
+    if (region->addr != nullptr) {
+      UnmapSharedMemory(region->addr, region->byte_size);
+      region->addr = nullptr;
+    }
+    CloseSharedMemory(region->fd);
+    UnlinkSharedMemoryRegion(region->key);
+    region->fd = -1;
+  }
+  return err;
+}
+
+Error InferDataManagerShm::Unregister(const std::string& name) {
+  if (kind_ == ShmKind::TPU) return backend_->UnregisterTpuSharedMemory(name);
+  return backend_->UnregisterSystemSharedMemory(name);
+}
 
 Error InferDataManagerShm::Init() {
   if (initialized_) return Error::Success();
@@ -23,18 +72,12 @@ Error InferDataManagerShm::Init() {
       size_t input_index = 0;
       for (const TensorData& tensor : data.tensors) {
         Region region;
-        region.name = prefix_ + "_" + pid + "_s" + std::to_string(stream) +
-                      "_t" + std::to_string(step) + "_i" +
-                      std::to_string(input_index);
-        region.key = "/" + region.name;
-        region.byte_size = tensor.bytes.size();
-        CTPU_RETURN_IF_ERROR(CreateSharedMemoryRegion(
-            region.key, region.byte_size, &region.fd));
-        CTPU_RETURN_IF_ERROR(MapSharedMemory(region.fd, 0, region.byte_size,
-                                             &region.addr));
+        const std::string name =
+            prefix_ + "_" + pid + "_s" + std::to_string(stream) + "_t" +
+            std::to_string(step) + "_i" + std::to_string(input_index);
+        CTPU_RETURN_IF_ERROR(
+            CreateAndRegister(name, tensor.bytes.size(), &region));
         std::memcpy(region.addr, tensor.bytes.data(), region.byte_size);
-        CTPU_RETURN_IF_ERROR(backend_->RegisterSystemSharedMemory(
-            region.name, region.key, region.byte_size));
         regions_.back().back().push_back(region);
         input_index++;
       }
@@ -44,15 +87,43 @@ Error InferDataManagerShm::Init() {
   return Error::Success();
 }
 
-Error InferDataManagerShm::Prepare(size_t stream, size_t step,
+Error InferDataManagerShm::EnsureOutputRegions(size_t slot,
+                                               std::vector<Region>** out) {
+  std::lock_guard<std::mutex> lk(output_mu_);
+  auto it = output_regions_.find(slot);
+  if (it != output_regions_.end()) {
+    *out = &it->second;
+    return Error::Success();
+  }
+  std::string pid = std::to_string(getpid());
+  std::vector<Region> regions;
+  for (size_t i = 0; i < output_descs_.size(); ++i) {
+    Region region;
+    const std::string name = prefix_ + "_" + pid + "_o" +
+                             std::to_string(slot) + "_" + std::to_string(i);
+    Error err = CreateAndRegister(name, output_shm_size_, &region);
+    if (!err.IsOk()) {
+      Error first;
+      for (auto& r : regions) ReleaseRegion(&r, &first);
+      return err;
+    }
+    regions.push_back(region);
+  }
+  auto inserted = output_regions_.emplace(slot, std::move(regions));
+  *out = &inserted.first->second;
+  return Error::Success();
+}
+
+Error InferDataManagerShm::Prepare(size_t slot, size_t stream, size_t step,
                                    PreparedRequest* request) {
-  const StepData& data =
-      loader_->GetStep(stream, step);
+  const StepData& data = loader_->GetStep(stream, step);
   const auto& step_regions =
       regions_[stream % regions_.size()]
               [step % regions_[stream % regions_.size()].size()];
   request->inputs.clear();
   request->input_ptrs.clear();
+  request->outputs.clear();
+  request->output_ptrs.clear();
   for (size_t i = 0; i < data.tensors.size(); ++i) {
     const TensorData& tensor = data.tensors[i];
     auto input = std::make_unique<InferInput>(tensor.name, tensor.shape,
@@ -62,33 +133,58 @@ Error InferDataManagerShm::Prepare(size_t stream, size_t step,
     request->input_ptrs.push_back(input.get());
     request->inputs.push_back(std::move(input));
   }
+  if (output_shm_size_ > 0 && !output_descs_.empty()) {
+    std::vector<Region>* out_regions = nullptr;
+    CTPU_RETURN_IF_ERROR(EnsureOutputRegions(slot, &out_regions));
+    for (size_t i = 0; i < output_descs_.size(); ++i) {
+      auto output = std::make_unique<InferRequestedOutput>(
+          output_descs_[i].name);
+      CTPU_RETURN_IF_ERROR(output->SetSharedMemory(
+          (*out_regions)[i].name, (*out_regions)[i].byte_size, 0));
+      request->output_ptrs.push_back(output.get());
+      request->outputs.push_back(std::move(output));
+    }
+  }
   request->step_parameters =
       data.parameters.IsNull() ? nullptr : &data.parameters;
   return Error::Success();
 }
 
+void InferDataManagerShm::ReleaseRegion(Region* region, Error* first) {
+  auto keep_first = [first](const Error& err) {
+    if (!err.IsOk() && first->IsOk()) *first = err;
+  };
+  keep_first(Unregister(region->name));
+  if (region->addr != nullptr) {
+    keep_first(UnmapSharedMemory(region->addr, region->byte_size));
+    region->addr = nullptr;
+  }
+  if (region->fd >= 0) {
+    keep_first(CloseSharedMemory(region->fd));
+    keep_first(UnlinkSharedMemoryRegion(region->key));
+    region->fd = -1;
+  }
+}
+
 Error InferDataManagerShm::Cleanup() {
   Error first;
-  auto keep_first = [&first](const Error& err) {
-    if (!err.IsOk() && first.IsOk()) first = err;
-  };
   for (auto& stream : regions_) {
     for (auto& step : stream) {
       for (auto& region : step) {
-        keep_first(backend_->UnregisterSystemSharedMemory(region.name));
-        if (region.addr != nullptr) {
-          keep_first(UnmapSharedMemory(region.addr, region.byte_size));
-          region.addr = nullptr;
-        }
-        if (region.fd >= 0) {
-          keep_first(CloseSharedMemory(region.fd));
-          keep_first(UnlinkSharedMemoryRegion(region.key));
-          region.fd = -1;
-        }
+        ReleaseRegion(&region, &first);
       }
     }
   }
   regions_.clear();
+  {
+    std::lock_guard<std::mutex> lk(output_mu_);
+    for (auto& entry : output_regions_) {
+      for (auto& region : entry.second) {
+        ReleaseRegion(&region, &first);
+      }
+    }
+    output_regions_.clear();
+  }
   initialized_ = false;
   return first;
 }

@@ -4,19 +4,25 @@
 #pragma once
 
 #include <memory>
+#include <mutex>
+#include <unordered_map>
 #include <vector>
 
 #include "client_backend.h"
 #include "data_loader.h"
+#include "model_parser.h"
 
 namespace ctpu {
 namespace perf {
 
-// A prepared request: owns the InferInput objects (their raw buffers point
-// into loader- or shm-owned storage, which outlives the request).
+// A prepared request: owns the InferInput/InferRequestedOutput objects
+// (their raw buffers point into loader- or shm-owned storage, which
+// outlives the request).
 struct PreparedRequest {
   std::vector<std::unique_ptr<InferInput>> inputs;
   std::vector<InferInput*> input_ptrs;
+  std::vector<std::unique_ptr<InferRequestedOutput>> outputs;
+  std::vector<const InferRequestedOutput*> output_ptrs;
   const json::Value* step_parameters = nullptr;  // may be null
 };
 
@@ -24,7 +30,9 @@ class IInferDataManager {
  public:
   virtual ~IInferDataManager() = default;
   virtual Error Init() = 0;
-  virtual Error Prepare(size_t stream, size_t step,
+  // slot identifies the issuing worker — shared-memory output regions are
+  // per-slot so concurrent in-flight requests never write the same pages.
+  virtual Error Prepare(size_t slot, size_t stream, size_t step,
                         PreparedRequest* request) = 0;
   virtual Error Cleanup() { return Error::Success(); }
 };
@@ -37,7 +45,9 @@ class InferDataManager : public IInferDataManager {
 
   Error Init() override { return Error::Success(); }
 
-  Error Prepare(size_t stream, size_t step, PreparedRequest* request) override {
+  Error Prepare(size_t slot, size_t stream, size_t step,
+                PreparedRequest* request) override {
+    (void)slot;
     const StepData& data = loader_->GetStep(stream, step);
     request->inputs.clear();
     request->input_ptrs.clear();
@@ -61,16 +71,35 @@ class InferDataManager : public IInferDataManager {
 
 // Shared-memory mode: every (stream, step, input) tensor is staged once
 // into a registered /dev/shm region at Init; requests then carry only
-// region references (reference infer_data_manager_shm.cc:1-384).
+// region references (reference infer_data_manager_shm.cc:1-384). Two
+// kinds: SYSTEM registers over the system-shm extension, TPU registers
+// the same pinned host pages over the tpu-shm extension (the CUDA-IPC
+// replacement; reference infer_data_manager_shm.h:56-67 CreateCUDAIPCHandle
+// → here a JSON raw handle naming the shm key).
+//
+// When output_shm_size > 0, requested outputs are redirected into per-slot
+// regions as well (reference --output-shared-memory-size): per-slot because
+// concurrent requests would otherwise race on the same output pages.
 class InferDataManagerShm : public IInferDataManager {
  public:
+  enum class ShmKind { SYSTEM, TPU };
+
   InferDataManagerShm(const DataLoader* loader, ClientBackend* backend,
+                      ShmKind kind = ShmKind::SYSTEM,
+                      size_t output_shm_size = 0,
+                      std::vector<TensorDesc> output_descs = {},
                       const std::string& region_prefix = "ctpu_perf")
-      : loader_(loader), backend_(backend), prefix_(region_prefix) {}
+      : loader_(loader),
+        backend_(backend),
+        kind_(kind),
+        output_shm_size_(output_shm_size),
+        output_descs_(std::move(output_descs)),
+        prefix_(region_prefix) {}
   ~InferDataManagerShm() override;
 
   Error Init() override;
-  Error Prepare(size_t stream, size_t step, PreparedRequest* request) override;
+  Error Prepare(size_t slot, size_t stream, size_t step,
+                PreparedRequest* request) override;
   Error Cleanup() override;
 
  private:
@@ -82,11 +111,24 @@ class InferDataManagerShm : public IInferDataManager {
     size_t byte_size = 0;
   };
 
+  // Create + map + register one region (kind_ selects the extension).
+  Error CreateAndRegister(const std::string& name, size_t byte_size,
+                          Region* region);
+  Error Unregister(const std::string& name);
+  void ReleaseRegion(Region* region, Error* first);
+  // Per-slot output regions, created lazily on first use by that slot.
+  Error EnsureOutputRegions(size_t slot, std::vector<Region>** out);
+
   const DataLoader* loader_;
   ClientBackend* backend_;
+  ShmKind kind_;
+  size_t output_shm_size_;
+  std::vector<TensorDesc> output_descs_;
   std::string prefix_;
   // regions[stream][step][input index]
   std::vector<std::vector<std::vector<Region>>> regions_;
+  std::mutex output_mu_;
+  std::unordered_map<size_t, std::vector<Region>> output_regions_;
   bool initialized_ = false;
 };
 

@@ -8,7 +8,7 @@ namespace perf {
 void LoadManager::IssueOne(BackendContext* ctx, size_t slot, size_t stream,
                            size_t step) {
   PreparedRequest request;
-  Error err = data_->Prepare(stream, step, &request);
+  Error err = data_->Prepare(slot, stream, step, &request);
   if (!err.IsOk()) {
     ReportWorkerError(err);
     return;
@@ -37,7 +37,8 @@ void LoadManager::IssueOne(BackendContext* ctx, size_t slot, size_t stream,
 
   RequestRecord record;
   record.request_id = request_id;
-  ctx->Infer(options, request.input_ptrs, {}, &record);  // errors are data
+  // errors are data (recorded, not raised)
+  ctx->Infer(options, request.input_ptrs, request.output_ptrs, &record);
   record.sequence_id = options.sequence_id;
   {
     std::lock_guard<std::mutex> lk(records_mu_);

@@ -91,8 +91,13 @@ int main(int argc, char** argv) {
   if (!err.IsOk()) return fail(err, "load input data");
 
   std::unique_ptr<IInferDataManager> data_manager;
-  if (params.shared_memory == "system") {
-    data_manager.reset(new InferDataManagerShm(&loader, backend.get()));
+  if (params.shared_memory == "system" || params.shared_memory == "tpu") {
+    const auto kind = params.shared_memory == "tpu"
+                          ? InferDataManagerShm::ShmKind::TPU
+                          : InferDataManagerShm::ShmKind::SYSTEM;
+    data_manager.reset(new InferDataManagerShm(
+        &loader, backend.get(), kind, params.output_shared_memory_size,
+        parser.Outputs()));
   } else if (params.shared_memory == "none") {
     data_manager.reset(new InferDataManager(&loader));
   } else {

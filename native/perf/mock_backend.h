@@ -77,6 +77,16 @@ class MockClientBackend : public ClientBackend {
     shm_unregister_count++;
     return Error::Success();
   }
+  Error RegisterTpuSharedMemory(const std::string&, const std::string& handle,
+                                int64_t, size_t) override {
+    tpu_shm_register_count++;
+    last_tpu_raw_handle = handle;
+    return Error::Success();
+  }
+  Error UnregisterTpuSharedMemory(const std::string&) override {
+    tpu_shm_unregister_count++;
+    return Error::Success();
+  }
 
   // -- accounting (read by tests) -----------------------------------------
   std::atomic<uint64_t> request_count{0};
@@ -85,6 +95,9 @@ class MockClientBackend : public ClientBackend {
   std::atomic<int> context_count{0};
   std::atomic<int> shm_register_count{0};
   std::atomic<int> shm_unregister_count{0};
+  std::atomic<int> tpu_shm_register_count{0};
+  std::atomic<int> tpu_shm_unregister_count{0};
+  std::string last_tpu_raw_handle;
   // sequence accounting: per-sequence observed (starts, steps, ended)
   struct SeqStat {
     int starts = 0;

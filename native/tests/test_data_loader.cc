@@ -105,7 +105,7 @@ TEST_CASE("infer data: plain manager points at loader bytes") {
   CHECK_OK(loader.GenerateSynthetic());
   InferDataManager data(&loader);
   PreparedRequest request;
-  CHECK_OK(data.Prepare(0, 0, &request));
+  CHECK_OK(data.Prepare(0, 0, 0, &request));
   CHECK_EQ(request.input_ptrs.size(), 1u);
   CHECK_EQ(request.input_ptrs[0]->Name(), "IN");
   CHECK_EQ(request.input_ptrs[0]->TotalByteSize(), 32u);  // FP32[8]
@@ -121,15 +121,76 @@ TEST_CASE("infer data: shm manager registers regions and uses refs") {
   DataLoader loader(&parser, 1);
   CHECK_OK(loader.GenerateSynthetic());
   {
-    InferDataManagerShm data(&loader, &backend, "ctpu_test");
+    InferDataManagerShm data(&loader, &backend,
+                         InferDataManagerShm::ShmKind::SYSTEM, 0,
+                         {}, "ctpu_test");
     CHECK_OK(data.Init());
     CHECK_EQ(backend.shm_register_count.load(), 1);
     PreparedRequest request;
-    CHECK_OK(data.Prepare(0, 0, &request));
+    CHECK_OK(data.Prepare(0, 0, 0, &request));
     CHECK(request.input_ptrs[0]->IsSharedMemory());
     CHECK_EQ(request.input_ptrs[0]->SharedMemoryByteSize(), 32u);
     CHECK_OK(data.Cleanup());
     CHECK_EQ(backend.shm_unregister_count.load(), 1);
+  }
+}
+
+TEST_CASE("infer data: tpu shm manager registers raw-handle regions") {
+  MockClientBackend backend;
+  ModelParser parser;
+  CHECK_OK(parser.Init(&backend, "mock", ""));
+  DataLoader loader(&parser, 1);
+  CHECK_OK(loader.GenerateSynthetic());
+  {
+    InferDataManagerShm data(&loader, &backend,
+                             InferDataManagerShm::ShmKind::TPU, 0, {},
+                             "ctpu_test_tpu");
+    CHECK_OK(data.Init());
+    CHECK_EQ(backend.tpu_shm_register_count.load(), 1);
+    CHECK_EQ(backend.shm_register_count.load(), 0);
+    // raw handle is the tpu_shared_memory JSON document
+    json::Value handle = json::Parse(backend.last_tpu_raw_handle);
+    CHECK_EQ(handle["kind"].AsString(), "tpu-host-pinned");
+    CHECK_EQ(handle["byte_size"].AsInt(), 32);
+    CHECK(handle["shm_key"].AsString().find("ctpu_test_tpu") !=
+          std::string::npos);
+    PreparedRequest request;
+    CHECK_OK(data.Prepare(0, 0, 0, &request));
+    CHECK(request.input_ptrs[0]->IsSharedMemory());
+    CHECK_OK(data.Cleanup());
+    CHECK_EQ(backend.tpu_shm_unregister_count.load(), 1);
+  }
+}
+
+TEST_CASE("infer data: per-slot output regions when output size set") {
+  MockClientBackend backend;
+  ModelParser parser;
+  CHECK_OK(parser.Init(&backend, "mock", ""));
+  DataLoader loader(&parser, 1);
+  CHECK_OK(loader.GenerateSynthetic());
+  {
+    std::vector<TensorDesc> outputs;
+    outputs.push_back({"OUT", "FP32", {8}});
+    InferDataManagerShm data(&loader, &backend,
+                             InferDataManagerShm::ShmKind::SYSTEM, 64,
+                             outputs, "ctpu_test_out");
+    CHECK_OK(data.Init());
+    const int after_init = backend.shm_register_count.load();
+    PreparedRequest r0, r1, r0_again;
+    CHECK_OK(data.Prepare(/*slot=*/0, 0, 0, &r0));
+    CHECK_OK(data.Prepare(/*slot=*/1, 0, 0, &r1));
+    CHECK_OK(data.Prepare(/*slot=*/0, 0, 0, &r0_again));
+    // one output region per distinct slot, reused across requests
+    CHECK_EQ(backend.shm_register_count.load(), after_init + 2);
+    CHECK_EQ(r0.output_ptrs.size(), 1u);
+    CHECK(r0.output_ptrs[0]->IsSharedMemory());
+    CHECK_EQ(r0.output_ptrs[0]->SharedMemoryByteSize(), 64u);
+    // distinct slots get distinct regions (no write races)
+    CHECK(r0.output_ptrs[0]->SharedMemoryName() !=
+          r1.output_ptrs[0]->SharedMemoryName());
+    CHECK_EQ(r0.output_ptrs[0]->SharedMemoryName(),
+             r0_again.output_ptrs[0]->SharedMemoryName());
+    CHECK_OK(data.Cleanup());
   }
 }
 

@@ -203,3 +203,39 @@ def test_mismatched_batch_dims_rejected(core):
 
     with pytest.raises(InferenceServerException):
         asyncio.run(run())
+
+
+def test_unbatched_form_bypasses_batcher():
+    """A batchable model may receive its unbatched input form (e.g. an
+    [H, W, 3] image to a [-1, H, W, 3] model); such requests must bypass
+    batch-dim validation and concatenation."""
+
+    class _FlexModel(Model):
+        name = "flex"
+        max_batch_size = 8
+        inputs = [{"name": "X", "datatype": "FP32", "shape": [4, 4, 3]}]
+        outputs = [{"name": "Y", "datatype": "FP32", "shape": [4, 4, 3]}]
+
+        def execute(self, inputs, parameters):
+            x = inputs["X"]
+            if x.ndim == 3:
+                x = x[None]
+            return {"Y": x + 1.0}
+
+    repository = ModelRepository()
+    repository.add_model(_FlexModel())
+    core_obj = ServerCore(repository)
+    try:
+        data = np.zeros([4, 4, 3], dtype=np.float32)
+        req = CoreRequest(
+            model_name="flex",
+            inputs=[CoreTensor("X", "FP32", [4, 4, 3], data)],
+        )
+
+        async def run():
+            return await core_obj.infer(req)
+
+        resp = asyncio.run(run())
+        assert resp.outputs[0].shape == [1, 4, 4, 3]
+    finally:
+        core_obj.close()

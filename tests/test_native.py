@@ -107,6 +107,38 @@ def test_cpp_perf_analyzer_shm_live(native_build, live_server):
     assert summary["throughput"] > 0
 
 
+def test_cpp_perf_analyzer_tpushm_live(native_build, live_server):
+    """The north-star data plane: perf_analyzer staging inputs AND outputs
+    through tpu-shm regions (BASELINE.json gRPC+TPU-shm config; reference
+    infer_data_manager_shm.cc CUDA path)."""
+    out = subprocess.run(
+        [os.path.join(native_build, "perf_analyzer"),
+         "-m", "simple", "-u", live_server.http_url,
+         "--shared-memory", "tpu",
+         "--output-shared-memory-size", "256",
+         "--concurrency-range", "2",
+         "--measurement-interval", "400",
+         "--stability-percentage", "60",
+         "--max-trials", "3",
+         "--json-summary"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    summary = json.loads(
+        [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+    )
+    assert summary["errors"] == 0
+    assert summary["throughput"] > 0
+    # regions were registered over the tpu extension and cleaned up
+    import client_tpu.http as httpclient
+
+    client = httpclient.InferenceServerClient(live_server.http_url)
+    try:
+        assert client.get_tpu_shared_memory_status() == []
+    finally:
+        client.close()
+
+
 @pytest.fixture(scope="module")
 def live_grpc_server():
     from client_tpu.testing import InProcessServer

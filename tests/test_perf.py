@@ -447,3 +447,72 @@ def test_cli_end_to_end(tmp_path, capsys):
     summary = json.loads(out.strip().splitlines()[-1])
     assert summary["throughput"] > 10
     assert csv_path.exists() and export_path.exists()
+
+
+def test_shm_data_plane_tpu_mock():
+    """ShmDataPlane stages the corpus once, registers tpu regions with JSON
+    raw handles, rewrites inputs to region refs, and cleans up."""
+    import asyncio
+    import json as _json
+
+    from client_tpu.perf.backend import MockPerfBackend
+    from client_tpu.perf.data import DataLoader, ShmDataPlane
+
+    backend = MockPerfBackend()
+
+    async def run():
+        metadata = await backend.get_model_metadata("mock")
+        loader = DataLoader(metadata)
+        loader.generate_synthetic()
+        plane = ShmDataPlane(loader, backend, kind="tpu")
+        await plane.setup()
+        assert len(backend.shm_registrations) == 1
+        reg = backend.shm_registrations[0]
+        assert reg["kind"] == "tpu"
+        handle = _json.loads(bytes(reg["raw_handle"]).decode())
+        assert handle["kind"] == "tpu-host-pinned"
+        assert handle["byte_size"] == reg["byte_size"] == 32  # FP32[8]
+        inputs = plane.get_inputs(0, 0)
+        assert inputs[0].shm_region == reg["name"]
+        assert inputs[0].shm_byte_size == 32
+        await plane.cleanup()
+        assert backend.shm_unregistrations == [reg["name"]]
+
+    asyncio.run(run())
+
+
+def test_cli_end_to_end_tpu_shm(tmp_path, capsys):
+    """Live CLI run over gRPC with --shared-memory tpu (the BASELINE.json
+    north-star config shape, small scale)."""
+    from client_tpu.perf.cli import main
+    from client_tpu.testing import InProcessServer
+
+    with InProcessServer(http=False) as server:
+        code = main(
+            [
+                "-m", "simple",
+                "-u", f"127.0.0.1:{server.grpc_port}",
+                "-i", "grpc",
+                "--shared-memory", "tpu",
+                "--concurrency-range", "2",
+                "--measurement-interval", "300",
+                "--stability-percentage", "60",
+                "--max-trials", "5",
+                "--json-summary",
+            ]
+        )
+        assert code == 0
+        out = capsys.readouterr().out
+        summary = json.loads(out.strip().splitlines()[-1])
+        assert summary["throughput"] > 10
+        # all tpu regions unregistered at teardown
+        import client_tpu.grpc as grpcclient
+
+        client = grpcclient.InferenceServerClient(
+            f"127.0.0.1:{server.grpc_port}"
+        )
+        try:
+            status = client.get_tpu_shared_memory_status(as_json=True)
+            assert not status.get("regions")
+        finally:
+            client.close()
