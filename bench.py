@@ -213,7 +213,7 @@ def main() -> int:
                         "--shared-memory", "tpu",
                         "--concurrency-range", str(CONCURRENCY),
                         "--measurement-interval",
-                        str(int(MEASURE_S * 500)),
+                        str(int(MEASURE_S * 1000)),
                         "--json-summary",
                     ],
                     capture_output=True, text=True, timeout=300,

@@ -289,12 +289,18 @@ class ShmDataPlane:
                             name, len(payload)
                         )
                         handle.buf(0, len(payload))[:] = payload
-                        await self._backend.register_tpu_shared_memory(
-                            name,
-                            tpushm.get_raw_handle(handle),
-                            handle.device_id(),
-                            len(payload),
-                        )
+                        try:
+                            await self._backend.register_tpu_shared_memory(
+                                name,
+                                tpushm.get_raw_handle(handle),
+                                handle.device_id(),
+                                len(payload),
+                            )
+                        except Exception:
+                            # A failed registration must not leak the
+                            # /dev/shm file (native twin does the same).
+                            tpushm.destroy_shared_memory_region(handle)
+                            raise
                     else:
                         from client_tpu.utils import shared_memory as sysshm
 
@@ -302,9 +308,13 @@ class ShmDataPlane:
                             name, f"/{name}", len(payload)
                         )
                         handle.buf(0, len(payload))[:] = payload
-                        await self._backend.register_system_shared_memory(
-                            name, f"/{name}", len(payload)
-                        )
+                        try:
+                            await self._backend.register_system_shared_memory(
+                                name, f"/{name}", len(payload)
+                            )
+                        except Exception:
+                            sysshm.destroy_shared_memory_region(handle)
+                            raise
                     self._handles.append(handle)
                     self._registered.append(name)
                     self._refs[(stream, step, t.name)] = (name, len(payload))

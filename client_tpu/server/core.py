@@ -100,6 +100,10 @@ class _Stats:
         self.inference_count = 0
         self.execution_count = 0
         self.last_inference = 0
+        # Decoupled response statistics, keyed by response index (Triton's
+        # response_stats map: key "0" aggregates first responses, so its
+        # success ns/count is the average time-to-first-response).
+        self.response_stats: Dict[str, Dict[str, List[int]]] = {}
 
     def record(self, field_name: str, duration_ns: int) -> None:
         with self.lock:
@@ -134,9 +138,44 @@ class _Stats:
         with self.lock:
             self.execution_count += 1
 
+    RESPONSE_FIELDS = (
+        "success",
+        "compute_infer",
+        "compute_output",
+        "empty_response",
+    )
+
+    def record_response(
+        self,
+        index: int,
+        infer_ns: int,
+        out_ns: int,
+        latency_ns: int,
+        empty: bool,
+    ) -> None:
+        """Account one decoupled response (Triton response_stats shape):
+        ``infer_ns`` = model time since the previous response, ``out_ns`` =
+        packaging, ``latency_ns`` = cumulative since request start."""
+        with self.lock:
+            entry = self.response_stats.setdefault(
+                str(index), {f: [0, 0] for f in self.RESPONSE_FIELDS}
+            )
+            if empty:
+                # Disjoint categories (Triton semantics): an empty response
+                # is not a success and carries no compute samples.
+                entry["empty_response"][0] += 1
+                entry["empty_response"][1] += latency_ns
+                return
+            entry["success"][0] += 1
+            entry["success"][1] += latency_ns
+            entry["compute_infer"][0] += 1
+            entry["compute_infer"][1] += infer_ns
+            entry["compute_output"][0] += 1
+            entry["compute_output"][1] += out_ns
+
     def snapshot(self) -> Dict[str, Any]:
         with self.lock:
-            return {
+            snap = {
                 "inference_count": self.inference_count,
                 "execution_count": self.execution_count,
                 "last_inference": self.last_inference,
@@ -145,6 +184,19 @@ class _Stats:
                     for f in self.FIELDS
                 },
             }
+            if self.response_stats:
+                # Decoupled per-response statistics (Triton response_stats
+                # wire shape). The reference's client-side stats treat a
+                # stream as one opaque request — its own known blind spot
+                # (grpc_client.cc:1650-1653); don't inherit that.
+                snap["response_stats"] = {
+                    key: {
+                        f: {"count": v[0], "ns": v[1]}
+                        for f, v in fields.items()
+                    }
+                    for key, fields in self.response_stats.items()
+                }
+            return snap
 
 
 def _to_host(raw: Dict[str, Any]) -> Dict[str, np.ndarray]:
@@ -402,20 +454,30 @@ class ServerCore:
         Clients may send a batchable model its unbatched form (e.g. an
         [H, W, 3] image to a [-1, H, W, 3] model); those requests bypass
         the dynamic batcher — concatenating along axis 0 would corrupt
-        them — and execute singly, as before batching existed.
+        them — and execute singly, as before batching existed. Only a
+        request where EVERY declared input matches its unbatched rank
+        counts; mixed-rank requests stay on the batcher path so its
+        batch-dim validation rejects them.
         """
         declared = {i["name"]: i for i in model.inputs}
-        for t in request.inputs:
-            desc = declared.get(t.name)
-            if desc is not None and len(t.shape) == len(desc["shape"]):
-                return False
-        return True
+        matches = [
+            len(t.shape) == len(declared[t.name]["shape"])
+            for t in request.inputs
+            if t.name in declared
+        ]
+        return not (matches and all(matches))
 
     def _resolve_batch(self, model: Model, request: CoreRequest) -> int:
         if not request.inputs:
             return 1
         shape = request.inputs[0].shape
-        return int(shape[0]) if (model.max_batch_size > 0 and shape) else 1
+        if (
+            model.max_batch_size > 0
+            and shape
+            and self._has_batch_dim(model, request)
+        ):
+            return int(shape[0])
+        return 1
 
     def _run_model(
         self, model: Model, request: CoreRequest
@@ -556,6 +618,13 @@ class ServerCore:
         model = self.repository.get(request.model_name, request.model_version)
         stats = self._stats_for(model.name)
         t0 = time.monotonic_ns()
+        # Split the stream's lifetime into model-compute vs output-packaging
+        # time, and record time-to-first-response — the reference's stats
+        # treat a stream as one opaque request (its own known blind spot,
+        # grpc_client.cc:1650-1653); don't inherit that.
+        packaging_ns = 0
+        prev_ns = t0
+        index = 0
         try:
             if not model.decoupled:
                 yield await self.infer(request)
@@ -563,6 +632,7 @@ class ServerCore:
             inputs = {t.name: t.data for t in request.inputs}
             async for raw in model.execute_decoupled(inputs, request.parameters):
                 final = raw.pop("__final__", False) if isinstance(raw, dict) else False
+                p0 = time.monotonic_ns()
                 if raw:
                     response = self._package_outputs(model, request, raw)
                 else:
@@ -574,6 +644,17 @@ class ServerCore:
                     )
                 if final:
                     response.parameters["triton_final_response"] = True
+                p1 = time.monotonic_ns()
+                packaging_ns += p1 - p0
+                stats.record_response(
+                    index,
+                    infer_ns=p0 - prev_ns,
+                    out_ns=p1 - p0,
+                    latency_ns=p1 - t0,
+                    empty=not raw,
+                )
+                prev_ns = p1
+                index += 1
                 yield response
         except Exception:
             stats.record("fail", time.monotonic_ns() - t0)
@@ -584,8 +665,8 @@ class ServerCore:
                 self._resolve_batch(model, request),
                 queue_ns=0,
                 in_ns=0,
-                infer_ns=t1 - t0,
-                out_ns=0,
+                infer_ns=(t1 - t0) - packaging_ns,
+                out_ns=packaging_ns,
             )
 
     # -- wire-side input decoding -------------------------------------------

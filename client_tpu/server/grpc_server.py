@@ -175,6 +175,14 @@ class _Servicer(GRPCInferenceServiceServicer):
                 target = getattr(entry.inference_stats, field)
                 target.count = duration["count"]
                 target.ns = duration["ns"]
+            # Decoupled per-response statistics (response_stats map keyed
+            # by response index; key "0" aggregates first responses).
+            for key, fields in snap.get("response_stats", {}).items():
+                rs = entry.response_stats[key]
+                for field, duration in fields.items():
+                    target = getattr(rs, field)
+                    target.count = duration["count"]
+                    target.ns = duration["ns"]
         return response
 
     # -- repository ----------------------------------------------------------

@@ -89,12 +89,18 @@ Error InferDataManagerShm::Init() {
 
 Error InferDataManagerShm::EnsureOutputRegions(size_t slot,
                                                std::vector<Region>** out) {
-  std::lock_guard<std::mutex> lk(output_mu_);
-  auto it = output_regions_.find(slot);
-  if (it != output_regions_.end()) {
-    *out = &it->second;
-    return Error::Success();
+  {
+    std::lock_guard<std::mutex> lk(output_mu_);
+    auto it = output_regions_.find(slot);
+    if (it != output_regions_.end()) {
+      *out = &it->second;
+      return Error::Success();
+    }
   }
+  // Create + register outside the lock: registration is a network RPC and
+  // holding the mutex across it would serialize every worker's ramp-up.
+  // Slot ids are worker-unique, so two threads never build the same slot;
+  // the lost-race discard below is pure belt-and-braces.
   std::string pid = std::to_string(getpid());
   std::vector<Region> regions;
   for (size_t i = 0; i < output_descs_.size(); ++i) {
@@ -108,6 +114,14 @@ Error InferDataManagerShm::EnsureOutputRegions(size_t slot,
       return err;
     }
     regions.push_back(region);
+  }
+  std::lock_guard<std::mutex> lk(output_mu_);
+  auto it = output_regions_.find(slot);
+  if (it != output_regions_.end()) {
+    Error first;
+    for (auto& r : regions) ReleaseRegion(&r, &first);
+    *out = &it->second;
+    return Error::Success();
   }
   auto inserted = output_regions_.emplace(slot, std::move(regions));
   *out = &inserted.first->second;
