@@ -140,6 +140,8 @@ class _Stats:
 
     RESPONSE_FIELDS = (
         "success",
+        "fail",
+        "cancel",
         "compute_infer",
         "compute_output",
         "empty_response",
@@ -172,6 +174,20 @@ class _Stats:
             entry["compute_infer"][1] += infer_ns
             entry["compute_output"][0] += 1
             entry["compute_output"][1] += out_ns
+
+    def record_response_failure(
+        self, index: int, latency_ns: int, cancelled: bool = False
+    ) -> None:
+        """Account a response slot that errored (or was cancelled) mid-stream
+        — the per-response twin of the aggregate 'fail' field, mirroring the
+        fail/cancel entries of Triton's InferResponseStatistics."""
+        with self.lock:
+            entry = self.response_stats.setdefault(
+                str(index), {f: [0, 0] for f in self.RESPONSE_FIELDS}
+            )
+            key = "cancel" if cancelled else "fail"
+            entry[key][0] += 1
+            entry[key][1] += latency_ns
 
     def snapshot(self) -> Dict[str, Any]:
         with self.lock:
@@ -303,16 +319,25 @@ class _ModelBatcher:
     def _take_batch(self) -> List[Any]:
         """Pop the oldest request plus every compatible pending request,
         bounded by max_batch_size rows (submit() already rejected any
-        single request exceeding the max)."""
+        single request exceeding the max). Scanning stops at the first
+        same-signature entry that does not fit the row budget, so arrival
+        order within a signature is preserved."""
         lead = self.pending[0]
         signature = lead[2]
         budget = self.model.max_batch_size
         taken, kept, rows = [], [], 0
+        signature_full = False
         for entry in self.pending:
-            if entry[2] == signature and rows + entry[3] <= budget:
+            if (
+                entry[2] == signature
+                and not signature_full
+                and rows + entry[3] <= budget
+            ):
                 taken.append(entry)
                 rows += entry[3]
             else:
+                if entry[2] == signature:
+                    signature_full = True
                 kept.append(entry)
         self.pending = kept
         return taken
@@ -457,7 +482,13 @@ class ServerCore:
         them — and execute singly, as before batching existed. Only a
         request where EVERY declared input matches its unbatched rank
         counts; mixed-rank requests stay on the batcher path so its
-        batch-dim validation rejects them.
+        batch-dim validation rejects them. A model that declares no input
+        metadata (or a request whose inputs match none of the declared
+        names) gives nothing to compare ranks against — those requests
+        execute singly rather than risking a concatenation along a dim 0
+        that may not be a batch dim. For the same reason no max_batch_size
+        check applies to them (dim 0 cannot be assumed to be a batch count),
+        and they book inference_count 1 per request.
         """
         declared = {i["name"]: i for i in model.inputs}
         matches = [
@@ -465,7 +496,9 @@ class ServerCore:
             for t in request.inputs
             if t.name in declared
         ]
-        return not (matches and all(matches))
+        if not matches:
+            return False
+        return not all(matches)
 
     def _resolve_batch(self, model: Model, request: CoreRequest) -> int:
         if not request.inputs:
@@ -625,6 +658,18 @@ class ServerCore:
         packaging_ns = 0
         prev_ns = t0
         index = 0
+        final_delivered = False
+
+        def _book_success() -> None:
+            t1 = time.monotonic_ns()
+            stats.record_success(
+                self._resolve_batch(model, request),
+                queue_ns=0,
+                in_ns=0,
+                infer_ns=(t1 - t0) - packaging_ns,
+                out_ns=packaging_ns,
+            )
+
         try:
             if not model.decoupled:
                 yield await self.infer(request)
@@ -655,19 +700,39 @@ class ServerCore:
                 )
                 prev_ns = p1
                 index += 1
+                # A close/cancel that arrives while suspended at this yield
+                # means the yielded value WAS delivered — so a final-marked
+                # response makes the stream complete, not cancelled (clients
+                # routinely stop iterating at triton_final_response).
+                final_delivered = final
                 yield response
+        except (asyncio.CancelledError, GeneratorExit):
+            # Task cancellation (gRPC stream teardown) and generator close
+            # (HTTP/OpenAI front-end client disconnect): if the final
+            # response was already delivered this is normal completion;
+            # otherwise book a cancel entry at the in-flight response index.
+            if model.decoupled:
+                if final_delivered:
+                    _book_success()
+                else:
+                    stats.record_response_failure(
+                        index, time.monotonic_ns() - t0, cancelled=True
+                    )
+            raise
         except Exception:
-            stats.record("fail", time.monotonic_ns() - t0)
+            # Only the decoupled path accounts here: non-decoupled requests
+            # were delegated to infer(), which already recorded the failure
+            # (recording again would double-count it).
+            if model.decoupled:
+                now = time.monotonic_ns()
+                # Book the in-flight response slot too, not just the
+                # aggregate: response_stats mirrors Triton's
+                # InferResponseStatistics, which carries fail entries.
+                stats.record_response_failure(index, now - t0)
+                stats.record("fail", now - t0)
             raise
         else:
-            t1 = time.monotonic_ns()
-            stats.record_success(
-                self._resolve_batch(model, request),
-                queue_ns=0,
-                in_ns=0,
-                infer_ns=(t1 - t0) - packaging_ns,
-                out_ns=packaging_ns,
-            )
+            _book_success()
 
     # -- wire-side input decoding -------------------------------------------
 

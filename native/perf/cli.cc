@@ -242,8 +242,11 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
       }
     } else if (arg == "--output-shared-memory-size") {
       CTPU_RETURN_IF_ERROR(need(i));
-      params->output_shared_memory_size =
-          static_cast<size_t>(std::atoll(next().c_str()));
+      long long size = std::stoll(next());
+      if (size < 0) {
+        return Error("--output-shared-memory-size must be >= 0");
+      }
+      params->output_shared_memory_size = static_cast<size_t>(size);
     } else if (arg == "--streaming") {
       params->streaming = true;
     } else if (arg == "--sequence-length") {

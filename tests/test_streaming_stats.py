@@ -66,6 +66,91 @@ def test_decoupled_stats_split_under_load():
         core.close()
 
 
+def test_mid_stream_failure_books_per_response_fail_entry():
+    """A mid-stream exception must land in response_stats[index].fail, not
+    only the aggregate 'fail' field (InferResponseStatistics parity)."""
+
+    class ExplodingModel(RepeatModel):
+        async def execute_decoupled(self, inputs, parameters):
+            yield {"OUT": np.array([1], dtype=np.int32), "__final__": False}
+            raise RuntimeError("boom mid-stream")
+
+    repository = ModelRepository()
+    repository.add_model(ExplodingModel())
+    core = ServerCore(repository)
+    try:
+        async def run():
+            out = []
+            async for response in core.infer_decoupled(
+                _repeat_request([1, 2, 3])
+            ):
+                out.append(response)
+            return out
+
+        try:
+            asyncio.run(run())
+            raise AssertionError("expected mid-stream failure")
+        except RuntimeError:
+            pass
+        snap = core.statistics("repeat_int32")["model_stats"][0]
+        assert snap["inference_stats"]["fail"]["count"] == 1
+        rs = snap["response_stats"]
+        # response 0 succeeded; the failure is booked at in-flight index 1
+        assert rs["0"]["success"]["count"] == 1
+        assert rs["1"]["fail"]["count"] == 1
+        assert rs["1"]["fail"]["ns"] > 0
+        assert rs["1"]["success"]["count"] == 0
+    finally:
+        core.close()
+
+
+def test_abandoned_stream_books_cancel_entry():
+    """Generator close (the front-end's client-disconnect path) must book a
+    cancel entry at the in-flight response index, like task cancellation."""
+    repository = ModelRepository()
+    repository.add_model(RepeatModel())
+    core = ServerCore(repository)
+    try:
+        async def run():
+            gen = core.infer_decoupled(_repeat_request([1, 2, 3, 4, 5]))
+            async for _response in gen:
+                break  # client disconnects after the first response
+            await gen.aclose()
+
+        asyncio.run(run())
+        rs = core.statistics("repeat_int32")["model_stats"][0]["response_stats"]
+        assert rs["0"]["success"]["count"] == 1
+        assert rs["1"]["cancel"]["count"] == 1
+        assert rs["1"]["cancel"]["ns"] > 0
+    finally:
+        core.close()
+
+
+def test_break_on_final_response_is_success_not_cancel():
+    """Stopping iteration at the triton_final_response-marked response (the
+    standard decoupled-client pattern) is normal completion: aggregate
+    success books, and no phantom cancel entry appears past the end."""
+    repository = ModelRepository()
+    repository.add_model(RepeatModel())
+    core = ServerCore(repository)
+    try:
+        async def run():
+            gen = core.infer_decoupled(_repeat_request([1, 2, 3], delay_us=0))
+            async for response in gen:
+                if response.parameters.get("triton_final_response"):
+                    break
+            await gen.aclose()
+
+        asyncio.run(run())
+        snap = core.statistics("repeat_int32")["model_stats"][0]
+        assert snap["inference_stats"]["success"]["count"] == 1
+        rs = snap["response_stats"]
+        assert set(rs) == {"0", "1", "2"}
+        assert all(rs[k]["cancel"]["count"] == 0 for k in rs)
+    finally:
+        core.close()
+
+
 def test_non_decoupled_stream_has_no_decoupled_stats():
     from client_tpu.server.models import AddSubModel
 
