@@ -1,0 +1,151 @@
+"""Bidirectional transformer encoder (BERT-family) in pure JAX.
+
+Role: the serving model behind BASELINE.json's "perf_analyzer concurrency
+sweep: BERT-large JAX python_backend" config (the reference drives a
+python_backend BERT through perf_analyzer; here the same role is a jitted
+JAX encoder behind :class:`client_tpu.models.serving.TextEncoderModel`).
+
+TPU-first design, same conventions as :mod:`client_tpu.models.llama`:
+
+- parameters are a plain pytree with a ``param_specs`` twin for
+  tensor-parallel placement (heads/FFN hidden over ``tp``);
+- one jitted ``forward`` over static shapes — variable-length batches are
+  padded to power-of-two length buckets by the server (bounding XLA
+  retraces to O(log max_len)) and masked inside the model, so the MXU
+  always sees dense [B, L, D] matmuls;
+- bfloat16 matmuls with float32 layernorm/softmax accumulation (the
+  standard TPU recipe).
+"""
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from client_tpu.parallel import TP_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    d_model: int = 1024       # BERT-large
+    n_layers: int = 24
+    n_heads: int = 16
+    d_ff: int = 4096
+    max_seq_len: int = 512
+    pad_token_id: int = 0
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def tiny(**overrides) -> "BertConfig":
+        """Small config for tests/benches off-device (compiles in seconds)."""
+        base = dict(
+            vocab_size=1024,
+            d_model=64,
+            n_layers=2,
+            n_heads=4,
+            d_ff=128,
+            max_seq_len=256,
+        )
+        base.update(overrides)
+        return BertConfig(**base)
+
+
+def init_params(key, config: BertConfig) -> Dict[str, Any]:
+    """Initialize a parameter pytree (truncated-normal-ish scaled init)."""
+    keys = iter(jax.random.split(key, 6 + 8 * config.n_layers))
+    dt = config.dtype
+
+    def dense(k, shape, scale=None):
+        scale = scale or (1.0 / np.sqrt(shape[0]))
+        return (jax.random.normal(k, shape) * scale).astype(dt)
+
+    params: Dict[str, Any] = {
+        "tok_emb": dense(next(keys), (config.vocab_size, config.d_model), 0.02),
+        "pos_emb": dense(next(keys), (config.max_seq_len, config.d_model), 0.02),
+        "emb_ln_scale": jnp.ones((config.d_model,), jnp.float32),
+        "emb_ln_bias": jnp.zeros((config.d_model,), jnp.float32),
+        "layers": [],
+    }
+    for _ in range(config.n_layers):
+        params["layers"].append(
+            {
+                "wq": dense(next(keys), (config.d_model, config.d_model)),
+                "wk": dense(next(keys), (config.d_model, config.d_model)),
+                "wv": dense(next(keys), (config.d_model, config.d_model)),
+                "wo": dense(next(keys), (config.d_model, config.d_model)),
+                "w1": dense(next(keys), (config.d_model, config.d_ff)),
+                "w2": dense(next(keys), (config.d_ff, config.d_model)),
+                "ln1_scale": jnp.ones((config.d_model,), jnp.float32),
+                "ln2_scale": jnp.ones((config.d_model,), jnp.float32),
+            }
+        )
+    return params
+
+
+def param_specs(config: BertConfig) -> Dict[str, Any]:
+    """PartitionSpec twin of the param pytree (Megatron-style TP)."""
+    layer = {
+        "wq": P(None, TP_AXIS),
+        "wk": P(None, TP_AXIS),
+        "wv": P(None, TP_AXIS),
+        "wo": P(TP_AXIS, None),
+        "w1": P(None, TP_AXIS),
+        "w2": P(TP_AXIS, None),
+        "ln1_scale": P(None),
+        "ln2_scale": P(None),
+    }
+    return {
+        "tok_emb": P(TP_AXIS, None),
+        "pos_emb": P(None, None),
+        "emb_ln_scale": P(None),
+        "emb_ln_bias": P(None),
+        "layers": [dict(layer) for _ in range(config.n_layers)],
+    }
+
+
+def _layernorm(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(-1, keepdims=True)
+    var = ((x32 - mean) ** 2).mean(-1, keepdims=True)
+    return ((x32 - mean) * jax.lax.rsqrt(var + eps) * scale).astype(x.dtype)
+
+
+def forward(params, input_ids, config: BertConfig):
+    """Encode ``input_ids`` [B, L] -> (hidden [B, L, D], pooled [B, D]).
+
+    Padding positions (== pad_token_id) are masked out of attention and of
+    the mean-pool, so bucket padding never changes the result.
+    """
+    B, L = input_ids.shape
+    mask = (input_ids != config.pad_token_id)  # [B, L] bool
+    h = params["tok_emb"][input_ids] + params["pos_emb"][:L][None, :, :]
+    h = _layernorm(h, params["emb_ln_scale"], config.norm_eps)
+
+    neg = jnp.asarray(-1e9, jnp.float32)
+    attn_bias = jnp.where(mask[:, None, None, :], 0.0, neg)  # [B,1,1,L]
+
+    for layer in params["layers"]:
+        x = _layernorm(h, layer["ln1_scale"], config.norm_eps)
+        q = (x @ layer["wq"]).reshape(B, L, config.n_heads, config.head_dim)
+        k = (x @ layer["wk"]).reshape(B, L, config.n_heads, config.head_dim)
+        v = (x @ layer["wv"]).reshape(B, L, config.n_heads, config.head_dim)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        scores = scores / np.sqrt(config.head_dim) + attn_bias
+        probs = jax.nn.softmax(scores, axis=-1).astype(config.dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, L, -1)
+        h = h + ctx @ layer["wo"]
+        x = _layernorm(h, layer["ln2_scale"], config.norm_eps)
+        h = h + jax.nn.gelu(x @ layer["w1"]) @ layer["w2"]
+
+    denom = jnp.maximum(mask.sum(-1, keepdims=True), 1).astype(jnp.float32)
+    pooled = (h.astype(jnp.float32) * mask[:, :, None]).sum(1) / denom
+    return h, pooled.astype(jnp.float32)

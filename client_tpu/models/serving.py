@@ -90,6 +90,90 @@ class ImageClassifierModel(Model):
         return {"OUTPUT": logits}
 
 
+class TextEncoderModel(Model):
+    """BERT-family text encoder: INPUT_IDS [-1] INT32 -> EMBEDDING [D].
+
+    The serving half of BASELINE.json's "BERT-large concurrency sweep"
+    config. Declares ``allow_ragged_batch``: concurrent requests of
+    different sequence lengths share one execution — the batcher pads the
+    ragged dim to a power-of-two bucket (zero = BERT pad token, masked
+    inside the model), so the device sees dense [B, L, D] matmuls and XLA
+    retraces stay O(log max_len).
+    """
+
+    max_batch_size = 16
+    platform = "jax"
+    backend = "jax"
+    allow_ragged_batch = True
+    ragged_pad_value = 0  # == BertConfig.pad_token_id; masked in the model
+    inputs = [{"name": "INPUT_IDS", "datatype": "INT32", "shape": [-1]}]
+
+    def __init__(self, name: str = "text_encoder", config=None, params=None):
+        from client_tpu.models import bert
+
+        self.name = name
+        self._config = config or bert.BertConfig.tiny()
+        self.ragged_dim_cap = self._config.max_seq_len
+        self._params = params
+        self._fn = None
+        self.outputs = [
+            {
+                "name": "EMBEDDING",
+                "datatype": "FP32",
+                "shape": [self._config.d_model],
+            }
+        ]
+
+    def warmup(self) -> None:
+        import jax
+
+        from client_tpu.models import bert
+
+        if self._params is None:
+            self._params = bert.init_params(
+                jax.random.PRNGKey(0), self._config
+            )
+        config = self._config
+        self._fn = jax.jit(
+            lambda params, ids: bert.forward(params, ids, config)[1]
+        )
+        dummy = np.zeros([1, 8], dtype=np.int32)
+        jax.block_until_ready(self._fn(self._params, dummy))
+
+    def execute(self, inputs, parameters):
+        from client_tpu.server.models import pad_batch_bucket
+
+        if "INPUT_IDS" not in inputs:
+            raise InferenceServerException(
+                f"model '{self.name}' expects input INPUT_IDS"
+            )
+        ids = np.asarray(inputs["INPUT_IDS"], dtype=np.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        if ids.shape[1] > self._config.max_seq_len:
+            raise InferenceServerException(
+                f"sequence length {ids.shape[1]} exceeds max "
+                f"{self._config.max_seq_len}"
+            )
+        # Bucket both dims so direct (unbatched) calls also hit cached
+        # compilations; the batcher already bucketed the ragged dim for
+        # merged batches, in which case these pads are no-ops.
+        rows, length = ids.shape
+        row_bucket = pad_batch_bucket(rows)
+        len_bucket = min(
+            pad_batch_bucket(length, minimum=8), self._config.max_seq_len
+        )
+        if (row_bucket, len_bucket) != (rows, length):
+            padded = np.zeros([row_bucket, len_bucket], dtype=np.int32)
+            padded[:rows, :length] = ids
+        else:
+            padded = ids
+        import jax
+
+        pooled = np.asarray(jax.device_get(self._fn(self._params, padded)))
+        return {"EMBEDDING": pooled[:rows]}
+
+
 class LlmDecodeModel(Model):
     """Decoupled LLM decode: INPUT_IDS -> one OUTPUT_IDS token per response.
 
@@ -206,9 +290,18 @@ class LlmDecodeModel(Model):
 
 def register_zoo_models(repository, small: bool = True) -> None:
     """Install the model-zoo adapters (small variants by default)."""
+    from client_tpu.models import bert
+
     repository.add_model(
         ImageClassifierModel(
             "image_classifier", image_size=64 if small else 224, small=small
         )
     )
     repository.add_model(LlmDecodeModel())
+    repository.add_model(
+        TextEncoderModel(
+            config=bert.BertConfig.tiny()
+            if small
+            else bert.BertConfig()
+        )
+    )

@@ -41,6 +41,13 @@ def main(argv=None) -> int:
         "site default — useful for dev loops on hosts where the default "
         "platform is a remote TPU relay",
     )
+    parser.add_argument(
+        "--grpc-frontend",
+        choices=["native", "aio", "auto"],
+        default="auto",
+        help="gRPC front-end implementation: 'native' (C++ h2 server, the "
+        "fast path), 'aio' (grpc.aio), 'auto' = native when built",
+    )
     args = parser.parse_args(argv)
 
     if args.platform:
@@ -59,22 +66,42 @@ def main(argv=None) -> int:
     core = ServerCore(repository, max_workers=args.max_workers)
 
     async def serve() -> None:
-        from client_tpu.server.grpc_server import serve_grpc
         from client_tpu.server.http_server import serve_http
 
+        impl = args.grpc_frontend
+        if impl == "auto":
+            from client_tpu.server.native_frontend import native_available
+
+            impl = "native" if native_available() else "aio"
+
         http_runner = await serve_http(core, args.host, args.http_port)
-        grpc_server, grpc_port = await serve_grpc(
-            core, args.host, args.grpc_port
-        )
+        native_frontend = None
+        grpc_server = None
+        if impl == "native":
+            from client_tpu.server.native_frontend import serve_grpc_native
+
+            native_frontend, grpc_port = await serve_grpc_native(
+                core, args.host, args.grpc_port
+            )
+        else:
+            from client_tpu.server.grpc_server import serve_grpc
+
+            grpc_server, grpc_port = await serve_grpc(
+                core, args.host, args.grpc_port
+            )
         print(
             f"client_tpu server listening: http={args.host}:"
-            f"{http_runner.addresses[0][1]} grpc={args.host}:{grpc_port}",
+            f"{http_runner.addresses[0][1]} grpc={args.host}:{grpc_port} "
+            f"({impl})",
             flush=True,
         )
         try:
             await asyncio.Event().wait()
         finally:
-            await grpc_server.stop(grace=2)
+            if native_frontend is not None:
+                native_frontend.stop()
+            if grpc_server is not None:
+                await grpc_server.stop(grace=2)
             await http_runner.cleanup()
 
     try:

@@ -1,0 +1,367 @@
+"""Shared gRPC method codec: proto bytes <-> ServerCore calls.
+
+Every non-inference RPC of inference.GRPCInferenceService is a synchronous
+request->response exchange over :class:`ServerCore`. This module implements
+them once, operating on serialized protobuf messages, so both front-ends —
+the grpc.aio servicer (`grpc_server.py`) and the native C++ h2 front-end
+(`native_frontend.py`), which hands undecoded method payloads to Python —
+share one implementation (reference: the per-method handlers in
+src/grpc/grpc_server.cc are likewise shared across that server's endpoints).
+"""
+
+from typing import Any, Callable, Dict, Tuple
+
+from client_tpu.grpc._generated import grpc_service_pb2 as pb
+from client_tpu.grpc._generated import model_config_pb2 as mc
+from client_tpu.server.core import (
+    SERVER_EXTENSIONS,
+    SERVER_NAME,
+    SERVER_VERSION,
+    ServerCore,
+)
+from client_tpu.utils import InferenceServerException
+
+# gRPC status codes (subset used here; numeric so the native front-end can
+# put them straight into the grpc-status trailer).
+GRPC_OK = 0
+GRPC_INVALID_ARGUMENT = 3
+GRPC_NOT_FOUND = 5
+GRPC_UNIMPLEMENTED = 12
+GRPC_INTERNAL = 13
+GRPC_UNAVAILABLE = 14
+
+
+def status_code_for(message: str) -> int:
+    """Map an InferenceServerException message to a gRPC status code."""
+    lowered = message.lower()
+    if "not found" in lowered or "unknown model" in lowered:
+        return GRPC_NOT_FOUND
+    if "not ready" in lowered or "unavailable" in lowered:
+        return GRPC_UNAVAILABLE
+    if "not implemented" in lowered or "no cuda" in lowered:
+        return GRPC_UNIMPLEMENTED
+    return GRPC_INVALID_ARGUMENT
+
+
+class RpcError(Exception):
+    """A method failure carrying its gRPC status code."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def params_to_dict(proto_params) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for key, p in proto_params.items():
+        which = p.WhichOneof("parameter_choice")
+        if which is not None:
+            out[key] = getattr(p, which)
+    return out
+
+
+def dict_to_params(values: Dict[str, Any], proto_params) -> None:
+    for key, value in values.items():
+        if isinstance(value, bool):
+            proto_params[key].bool_param = value
+        elif isinstance(value, int):
+            proto_params[key].int64_param = value
+        elif isinstance(value, float):
+            proto_params[key].double_param = value
+        else:
+            proto_params[key].string_param = str(value)
+
+
+# -- per-method handlers (request proto -> response proto) -------------------
+
+
+def _server_live(core: ServerCore, request):
+    return pb.ServerLiveResponse(live=core.live)
+
+
+def _server_ready(core: ServerCore, request):
+    return pb.ServerReadyResponse(ready=core.live)
+
+
+def _model_ready(core: ServerCore, request):
+    return pb.ModelReadyResponse(
+        ready=core.repository.is_ready(request.name, request.version)
+    )
+
+
+def _server_metadata(core: ServerCore, request):
+    return pb.ServerMetadataResponse(
+        name=SERVER_NAME, version=SERVER_VERSION, extensions=SERVER_EXTENSIONS
+    )
+
+
+def _model_metadata(core: ServerCore, request):
+    model = core.repository.get(request.name, request.version)
+    meta = model.metadata()
+    response = pb.ModelMetadataResponse(
+        name=meta["name"],
+        versions=meta["versions"],
+        platform=meta["platform"],
+    )
+    for io_key, target in (
+        ("inputs", response.inputs),
+        ("outputs", response.outputs),
+    ):
+        for tensor in meta[io_key]:
+            target.add(
+                name=tensor["name"],
+                datatype=tensor["datatype"],
+                shape=tensor["shape"],
+            )
+    return response
+
+
+def _model_config(core: ServerCore, request):
+    model = core.repository.get(request.name, request.version)
+    cfg = model.config()
+    proto = mc.ModelConfig(
+        name=cfg["name"],
+        platform=cfg["platform"],
+        backend=cfg["backend"],
+        max_batch_size=cfg["max_batch_size"],
+    )
+    for tensor in cfg["input"]:
+        proto.input.add(
+            name=tensor["name"],
+            data_type=mc.DataType.Value(tensor["data_type"]),
+            dims=tensor["dims"],
+        )
+    for tensor in cfg["output"]:
+        proto.output.add(
+            name=tensor["name"],
+            data_type=mc.DataType.Value(tensor["data_type"]),
+            dims=tensor["dims"],
+        )
+    proto.model_transaction_policy.decoupled = cfg["model_transaction_policy"][
+        "decoupled"
+    ]
+    return pb.ModelConfigResponse(config=proto)
+
+
+def _model_statistics(core: ServerCore, request):
+    stats = core.statistics(request.name, request.version)
+    response = pb.ModelStatisticsResponse()
+    for snap in stats["model_stats"]:
+        entry = response.model_stats.add(
+            name=snap["name"],
+            version=snap["version"],
+            last_inference=snap["last_inference"],
+            inference_count=snap["inference_count"],
+            execution_count=snap["execution_count"],
+        )
+        for field, duration in snap["inference_stats"].items():
+            target = getattr(entry.inference_stats, field)
+            target.count = duration["count"]
+            target.ns = duration["ns"]
+        for key, fields in snap.get("response_stats", {}).items():
+            rs = entry.response_stats[key]
+            for field, duration in fields.items():
+                target = getattr(rs, field)
+                target.count = duration["count"]
+                target.ns = duration["ns"]
+    return response
+
+
+def _repository_index(core: ServerCore, request):
+    response = pb.RepositoryIndexResponse()
+    for entry in core.repository.index():
+        if request.ready and entry["state"] != "READY":
+            continue
+        response.models.add(**entry)
+    return response
+
+
+def _repository_model_load(core: ServerCore, request):
+    params = params_to_dict(request.parameters)
+    config = params.get("config")
+    core.repository.load(
+        request.model_name,
+        config_override=config if isinstance(config, str) else None,
+    )
+    return pb.RepositoryModelLoadResponse()
+
+
+def _repository_model_unload(core: ServerCore, request):
+    core.repository.unload(request.model_name)
+    return pb.RepositoryModelUnloadResponse()
+
+
+def _system_shm_status(core: ServerCore, request):
+    response = pb.SystemSharedMemoryStatusResponse()
+    for name, region in core.shm.status("system", request.name).items():
+        response.regions[name].name = region["name"]
+        response.regions[name].key = region["key"]
+        response.regions[name].offset = region["offset"]
+        response.regions[name].byte_size = region["byte_size"]
+    return response
+
+
+def _system_shm_register(core: ServerCore, request):
+    core.shm.register_system(
+        request.name, request.key, request.offset, request.byte_size
+    )
+    return pb.SystemSharedMemoryRegisterResponse()
+
+
+def _system_shm_unregister(core: ServerCore, request):
+    if request.name:
+        core.shm.unregister(request.name, kind="system")
+    else:
+        core.shm.unregister_all(kind="system")
+    return pb.SystemSharedMemoryUnregisterResponse()
+
+
+def _cuda_shm_status(core: ServerCore, request):
+    return pb.CudaSharedMemoryStatusResponse()
+
+
+def _cuda_shm_register(core: ServerCore, request):
+    raise RpcError(
+        GRPC_UNIMPLEMENTED,
+        "this server has no CUDA devices; use TPU or system shared memory",
+    )
+
+
+def _cuda_shm_unregister(core: ServerCore, request):
+    return pb.CudaSharedMemoryUnregisterResponse()
+
+
+def _tpu_shm_status(core: ServerCore, request):
+    response = pb.TpuSharedMemoryStatusResponse()
+    for name, region in core.shm.status("tpu", request.name).items():
+        response.regions[name].name = region["name"]
+        response.regions[name].device_id = region["device_id"]
+        response.regions[name].byte_size = region["byte_size"]
+        response.regions[name].key = region["key"]
+    return response
+
+
+def _tpu_shm_register(core: ServerCore, request):
+    core.shm.register_tpu(
+        request.name, request.raw_handle, request.device_id, request.byte_size
+    )
+    return pb.TpuSharedMemoryRegisterResponse()
+
+
+def _tpu_shm_unregister(core: ServerCore, request):
+    if request.name:
+        core.shm.unregister(request.name, kind="tpu")
+    else:
+        core.shm.unregister_all(kind="tpu")
+    return pb.TpuSharedMemoryUnregisterResponse()
+
+
+def _trace_setting(core: ServerCore, request):
+    if request.settings:
+        for key, value in request.settings.items():
+            if value.value:
+                core.trace_settings[key] = list(value.value)
+    response = pb.TraceSettingResponse()
+    for key, value in core.trace_settings.items():
+        values = value if isinstance(value, list) else [str(value)]
+        response.settings[key].value.extend([str(v) for v in values])
+    return response
+
+
+def _log_settings(core: ServerCore, request):
+    for key, value in request.settings.items():
+        which = value.WhichOneof("parameter_choice")
+        if which is not None:
+            core.log_settings[key] = getattr(value, which)
+    response = pb.LogSettingsResponse()
+    for key, value in core.log_settings.items():
+        if isinstance(value, bool):
+            response.settings[key].bool_param = value
+        elif isinstance(value, int):
+            response.settings[key].uint32_param = value
+        else:
+            response.settings[key].string_param = str(value)
+    return response
+
+
+# method name (last :path segment) -> (request class, handler)
+METHODS: Dict[str, Tuple[Any, Callable]] = {
+    "ServerLive": (pb.ServerLiveRequest, _server_live),
+    "ServerReady": (pb.ServerReadyRequest, _server_ready),
+    "ModelReady": (pb.ModelReadyRequest, _model_ready),
+    "ServerMetadata": (pb.ServerMetadataRequest, _server_metadata),
+    "ModelMetadata": (pb.ModelMetadataRequest, _model_metadata),
+    "ModelConfig": (pb.ModelConfigRequest, _model_config),
+    "ModelStatistics": (pb.ModelStatisticsRequest, _model_statistics),
+    "RepositoryIndex": (pb.RepositoryIndexRequest, _repository_index),
+    "RepositoryModelLoad": (pb.RepositoryModelLoadRequest, _repository_model_load),
+    "RepositoryModelUnload": (
+        pb.RepositoryModelUnloadRequest,
+        _repository_model_unload,
+    ),
+    "SystemSharedMemoryStatus": (
+        pb.SystemSharedMemoryStatusRequest,
+        _system_shm_status,
+    ),
+    "SystemSharedMemoryRegister": (
+        pb.SystemSharedMemoryRegisterRequest,
+        _system_shm_register,
+    ),
+    "SystemSharedMemoryUnregister": (
+        pb.SystemSharedMemoryUnregisterRequest,
+        _system_shm_unregister,
+    ),
+    "CudaSharedMemoryStatus": (
+        pb.CudaSharedMemoryStatusRequest,
+        _cuda_shm_status,
+    ),
+    "CudaSharedMemoryRegister": (
+        pb.CudaSharedMemoryRegisterRequest,
+        _cuda_shm_register,
+    ),
+    "CudaSharedMemoryUnregister": (
+        pb.CudaSharedMemoryUnregisterRequest,
+        _cuda_shm_unregister,
+    ),
+    "TpuSharedMemoryStatus": (pb.TpuSharedMemoryStatusRequest, _tpu_shm_status),
+    "TpuSharedMemoryRegister": (
+        pb.TpuSharedMemoryRegisterRequest,
+        _tpu_shm_register,
+    ),
+    "TpuSharedMemoryUnregister": (
+        pb.TpuSharedMemoryUnregisterRequest,
+        _tpu_shm_unregister,
+    ),
+    "TraceSetting": (pb.TraceSettingRequest, _trace_setting),
+    "LogSettings": (pb.LogSettingsRequest, _log_settings),
+}
+
+
+def handle_method(core: ServerCore, method: str, request_proto):
+    """Run one non-inference method on a decoded request proto.
+
+    Returns the response proto; raises :class:`RpcError` on failure.
+    """
+    entry = METHODS.get(method)
+    if entry is None:
+        raise RpcError(GRPC_UNIMPLEMENTED, f"unknown method '{method}'")
+    try:
+        return entry[1](core, request_proto)
+    except RpcError:
+        raise
+    except InferenceServerException as e:
+        raise RpcError(status_code_for(e.message()), e.message()) from e
+
+
+def handle_method_bytes(core: ServerCore, method: str, payload: bytes) -> bytes:
+    """Wire-level entry for the native front-end: parse, run, serialize."""
+    entry = METHODS.get(method)
+    if entry is None:
+        raise RpcError(GRPC_UNIMPLEMENTED, f"unknown method '{method}'")
+    request = entry[0]()
+    try:
+        request.ParseFromString(payload)
+    except Exception as e:  # noqa: BLE001 - malformed wire bytes
+        raise RpcError(GRPC_INTERNAL, f"failed to parse {method} request: {e}")
+    return handle_method(core, method, request).SerializeToString()

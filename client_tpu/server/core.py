@@ -249,6 +249,12 @@ class _ModelBatcher:
     Requests are compatible when their input signature matches: same input
     names, datatypes, non-batch dims, and parameters. Incompatible requests
     wait for a batch of their own, preserving arrival order per signature.
+
+    Models with ``allow_ragged_batch`` relax the shape part of the
+    signature: dims declared -1 are excluded, and at merge time those dims
+    are zero-padded to a shared power-of-two bucket (Triton's ragged
+    batching, server-side) — so concurrent BERT/LLM requests of different
+    sequence lengths share one device execution.
     """
 
     def __init__(self, core: "ServerCore", model: Model):
@@ -257,16 +263,76 @@ class _ModelBatcher:
         # entries: (request, future, signature, rows, arrival_ns)
         self.pending: List[Any] = []
         self.running = False
+        # Hot-path caches (submit()/signature run per request).
+        self._declared = {i["name"] for i in model.inputs}
+        self._declared_shapes = {
+            i["name"]: list(i["shape"]) for i in model.inputs
+        }
+        self._ragged = bool(getattr(model, "allow_ragged_batch", False))
 
-    @staticmethod
-    def _signature(request: CoreRequest):
+    def _signature(self, request: CoreRequest):
+        if not self._ragged:
+            return (
+                tuple(
+                    (t.name, t.datatype, tuple(t.shape[1:]))
+                    for t in request.inputs
+                ),
+                repr(sorted(request.parameters.items()))
+                if request.parameters
+                else "",
+            )
+        sig = []
+        for t in request.inputs:
+            declared = self._declared_shapes.get(t.name)
+            dims = tuple(t.shape[1:])
+            if declared is not None and len(declared) == len(dims):
+                # Drop ragged (-1) dims: they merge via padding. The rank
+                # stays in the signature so a wrong-rank request can never
+                # share (and poison) a well-formed batch.
+                dims = tuple(
+                    d for d, dd in zip(dims, declared) if dd != -1
+                )
+            sig.append((t.name, t.datatype, len(t.shape), dims))
         return (
-            tuple(
-                (t.name, t.datatype, tuple(t.shape[1:]))
-                for t in request.inputs
-            ),
-            repr(sorted(request.parameters.items())),
+            tuple(sig),
+            repr(sorted(request.parameters.items()))
+            if request.parameters
+            else "",
         )
+
+    def _pad_ragged(self, name: str, arrays: List[np.ndarray]) -> List[np.ndarray]:
+        """Zero-pad the -1-declared dims of `arrays` to a shared
+        power-of-two bucket so they concatenate along axis 0."""
+        from client_tpu.server.models import pad_batch_bucket
+
+        declared = self._declared_shapes.get(name)
+        rank = arrays[0].ndim
+        if declared is None or len(declared) != rank - 1:
+            return arrays
+        cap = getattr(self.model, "ragged_dim_cap", None)
+        targets = []
+        for ax in range(1, rank):
+            if declared[ax - 1] == -1:
+                bucket = pad_batch_bucket(max(a.shape[ax] for a in arrays))
+                if cap is not None:
+                    # The bucket must not exceed the model's hard limit: a
+                    # batch of individually-valid requests would otherwise
+                    # be rejected wholesale (cap >= every member, so the
+                    # clamped bucket still covers the batch).
+                    bucket = min(bucket, cap)
+                targets.append(bucket)
+            else:
+                targets.append(arrays[0].shape[ax])
+        out = []
+        pad_value = getattr(self.model, "ragged_pad_value", 0)
+        for a in arrays:
+            pads = [(0, 0)] + [
+                (0, targets[ax - 1] - a.shape[ax]) for ax in range(1, rank)
+            ]
+            if any(p[1] for p in pads):
+                a = np.pad(a, pads, constant_values=pad_value)
+            out.append(a)
+        return out
 
     def submit(self, request: CoreRequest) -> "asyncio.Future[CoreResponse]":
         """Validate + enqueue a request; returns a future for its response.
@@ -275,7 +341,7 @@ class _ModelBatcher:
         alone instead of poisoning the batch it would have joined.
         """
         model = self.model
-        declared = {i["name"] for i in model.inputs}
+        declared = self._declared
         rows = 1
         if request.inputs:
             rows = int(request.inputs[0].shape[0]) if request.inputs[0].shape else 1
@@ -354,13 +420,13 @@ class _ModelBatcher:
                 merged = {t.name: t.data for t in requests[0].inputs}
             else:
                 for t in requests[0].inputs:
-                    merged[t.name] = np.concatenate(
-                        [
-                            next(i.data for i in r.inputs if i.name == t.name)
-                            for r in requests
-                        ],
-                        axis=0,
-                    )
+                    arrays = [
+                        next(i.data for i in r.inputs if i.name == t.name)
+                        for r in requests
+                    ]
+                    if self._ragged:
+                        arrays = self._pad_ragged(t.name, arrays)
+                    merged[t.name] = np.concatenate(arrays, axis=0)
             def _run():
                 with model.placement():
                     return _to_host(model.execute(merged, requests[0].parameters))
@@ -473,6 +539,15 @@ class ServerCore:
     # -- inference -----------------------------------------------------------
 
     @staticmethod
+    def _declared_ranks(model: Model) -> Dict[str, int]:
+        """name -> declared rank, cached on the model (hot path)."""
+        ranks = getattr(model, "_ctpu_declared_ranks", None)
+        if ranks is None:
+            ranks = {i["name"]: len(i["shape"]) for i in model.inputs}
+            model._ctpu_declared_ranks = ranks
+        return ranks
+
+    @staticmethod
     def _has_batch_dim(model: Model, request: CoreRequest) -> bool:
         """True when the request's input shapes include the batch dim.
 
@@ -490,9 +565,9 @@ class ServerCore:
         check applies to them (dim 0 cannot be assumed to be a batch count),
         and they book inference_count 1 per request.
         """
-        declared = {i["name"]: i for i in model.inputs}
+        declared = ServerCore._declared_ranks(model)
         matches = [
-            len(t.shape) == len(declared[t.name]["shape"])
+            len(t.shape) == declared[t.name]
             for t in request.inputs
             if t.name in declared
         ]
@@ -597,8 +672,15 @@ class ServerCore:
         out = np.array(rows, dtype=np.object_)
         return out.reshape(list(arr.shape[:-1]) + [k])
 
-    async def infer(self, request: CoreRequest) -> CoreResponse:
-        """Execute a request->response inference (decoupled models rejected)."""
+    def infer_nowait(self, request: CoreRequest) -> "asyncio.Future":
+        """Submit a request->response inference; returns its future.
+
+        The allocation-free twin of :meth:`infer` for callback-style
+        front-ends (the native gRPC bridge): batchable requests go straight
+        to the batcher's future — no coroutine, no task. Other requests
+        fall back to a task wrapping the slow path. Raises synchronously on
+        validation errors.
+        """
         model = self.repository.get(request.model_name, request.model_version)
         if model.decoupled:
             raise InferenceServerException(
@@ -610,13 +692,30 @@ class ServerCore:
                 batcher = _ModelBatcher(self, model)
                 self._batchers[model.name] = batcher
             try:
-                future = batcher.submit(request)
+                return batcher.submit(request)
             except InferenceServerException:
                 # Validation failures surface synchronously; execution
                 # failures are accounted inside the batcher already.
                 self._stats_for(model.name).record("fail", 0)
                 raise
-            return await future
+        return asyncio.ensure_future(self._infer_single(model, request))
+
+    async def infer(self, request: CoreRequest) -> CoreResponse:
+        """Execute a request->response inference (decoupled models rejected)."""
+        model = self.repository.get(request.model_name, request.model_version)
+        if model.decoupled:
+            raise InferenceServerException(
+                f"model '{model.name}' is decoupled; use streaming inference"
+            )
+        if model.max_batch_size > 1 and self._has_batch_dim(model, request):
+            return await self.infer_nowait(request)
+        # Awaited single path: run the coroutine inline — no Task.
+        return await self._infer_single(model, request)
+
+    async def _infer_single(
+        self, model: Model, request: CoreRequest
+    ) -> CoreResponse:
+        """Unbatched execution path (max_batch_size <= 1 or no batch dim)."""
         stats = self._stats_for(model.name)
         t0 = time.monotonic_ns()
         loop = asyncio.get_running_loop()

@@ -3,24 +3,25 @@
 Implements inference.GRPCInferenceService including decoupled
 ``ModelStreamInfer`` (one stream, many responses per request — the token
 streaming path) and the system/TPU shared-memory registration RPCs.
+
+The non-inference methods are implemented once in
+:mod:`client_tpu.server._grpc_codec` (shared with the native C++ h2
+front-end); this module binds them into grpc.aio and keeps only the
+inference request/response tensor conversion local.
 """
 
-import asyncio
-from typing import Any, Dict, List
+from typing import List
 
 import grpc
 import numpy as np
 
 from client_tpu.grpc._generated import grpc_service_pb2 as pb
-from client_tpu.grpc._generated import model_config_pb2 as mc
 from client_tpu.grpc._service_stubs import (
     GRPCInferenceServiceServicer,
     add_GRPCInferenceServiceServicer_to_server,
 )
+from client_tpu.server import _grpc_codec as codec
 from client_tpu.server.core import (
-    SERVER_EXTENSIONS,
-    SERVER_NAME,
-    SERVER_VERSION,
     CoreRequest,
     CoreRequestedOutput,
     CoreResponse,
@@ -33,37 +34,15 @@ from client_tpu.utils import (
 
 MAX_GRPC_MESSAGE_SIZE = 2**31 - 1  # INT32_MAX, both directions
 
+_INT_TO_STATUS_CODE = {
+    code.value[0]: code for code in grpc.StatusCode if code.value
+}
+
 
 def _status_for(message: str) -> grpc.StatusCode:
-    lowered = message.lower()
-    if "not found" in lowered or "unknown model" in lowered:
-        return grpc.StatusCode.NOT_FOUND
-    if "not ready" in lowered or "unavailable" in lowered:
-        return grpc.StatusCode.UNAVAILABLE
-    if "not implemented" in lowered or "no cuda" in lowered:
-        return grpc.StatusCode.UNIMPLEMENTED
-    return grpc.StatusCode.INVALID_ARGUMENT
-
-
-def _params_to_dict(proto_params) -> Dict[str, Any]:
-    out: Dict[str, Any] = {}
-    for key, p in proto_params.items():
-        which = p.WhichOneof("parameter_choice")
-        if which is not None:
-            out[key] = getattr(p, which)
-    return out
-
-
-def _dict_to_params(values: Dict[str, Any], proto_params) -> None:
-    for key, value in values.items():
-        if isinstance(value, bool):
-            proto_params[key].bool_param = value
-        elif isinstance(value, int):
-            proto_params[key].int64_param = value
-        elif isinstance(value, float):
-            proto_params[key].double_param = value
-        else:
-            proto_params[key].string_param = str(value)
+    return _INT_TO_STATUS_CODE.get(
+        codec.status_code_for(message), grpc.StatusCode.INVALID_ARGUMENT
+    )
 
 
 _CONTENTS_FIELD = {
@@ -82,344 +61,135 @@ _CONTENTS_FIELD = {
 }
 
 
+def build_core_request(core: ServerCore, request: pb.ModelInferRequest) -> CoreRequest:
+    core_request = CoreRequest(
+        model_name=request.model_name,
+        model_version=request.model_version,
+        id=request.id,
+        parameters=codec.params_to_dict(request.parameters),
+    )
+    # raw_input_contents entries are consumed in order by the inputs that
+    # are NOT sourced from shared memory (Triton semantics: shm inputs
+    # contribute no raw entry).
+    n_raw = len(request.raw_input_contents)
+    raw_index = 0
+    for tensor in request.inputs:
+        params = codec.params_to_dict(tensor.parameters)
+        shm_region = params.get("shared_memory_region")
+        raw = None
+        json_data = None
+        if shm_region is not None:
+            pass
+        elif raw_index < n_raw:
+            raw = request.raw_input_contents[raw_index]
+            raw_index += 1
+        elif tensor.HasField("contents"):
+            field = _CONTENTS_FIELD.get(tensor.datatype)
+            if field is None:
+                raise InferenceServerException(
+                    f"datatype '{tensor.datatype}' has no proto contents "
+                    "representation; use raw_input_contents"
+                )
+            json_data = list(getattr(tensor.contents, field))
+        core_request.inputs.append(
+            core.decode_input(
+                tensor.name,
+                tensor.datatype,
+                list(tensor.shape),
+                raw=raw,
+                json_data=json_data,
+                shm_region=shm_region,
+                shm_byte_size=int(params.get("shared_memory_byte_size", 0)),
+                shm_offset=int(params.get("shared_memory_offset", 0)),
+            )
+        )
+    if raw_index != n_raw:
+        raise InferenceServerException(
+            f"raw_input_contents has {n_raw} entries but only "
+            f"{raw_index} non-shared-memory inputs consumed them"
+        )
+    for out in request.outputs:
+        params = codec.params_to_dict(out.parameters)
+        core_request.outputs.append(
+            CoreRequestedOutput(
+                name=out.name,
+                classification=int(params.get("classification", 0)),
+                shm_region=params.get("shared_memory_region"),
+                shm_byte_size=int(params.get("shared_memory_byte_size", 0)),
+                shm_offset=int(params.get("shared_memory_offset", 0)),
+            )
+        )
+    return core_request
+
+
+def build_proto_response(core_response: CoreResponse) -> pb.ModelInferResponse:
+    response = pb.ModelInferResponse(
+        model_name=core_response.model_name,
+        model_version=core_response.model_version,
+        id=core_response.id,
+    )
+    codec.dict_to_params(core_response.parameters, response.parameters)
+    for tensor in core_response.outputs:
+        out = response.outputs.add(
+            name=tensor.name,
+            datatype=tensor.datatype,
+            shape=tensor.shape,
+        )
+        if tensor.name in core_response.shm_outputs:
+            region, size, offset = core_response.shm_outputs[tensor.name]
+            out.parameters["shared_memory_region"].string_param = region
+            out.parameters["shared_memory_byte_size"].int64_param = size
+            if offset:
+                out.parameters["shared_memory_offset"].int64_param = offset
+            response.raw_output_contents.append(b"")
+        elif tensor.datatype == "BYTES":
+            response.raw_output_contents.append(
+                serialize_byte_tensor(tensor.data).tobytes()
+            )
+        else:
+            response.raw_output_contents.append(
+                np.ascontiguousarray(tensor.data).tobytes()
+            )
+    return response
+
+
+def _delegated(method_name: str):
+    async def handler(self, request, context):
+        try:
+            return codec.handle_method(self.core, method_name, request)
+        except codec.RpcError as e:
+            await context.abort(
+                _INT_TO_STATUS_CODE.get(e.status, grpc.StatusCode.UNKNOWN),
+                e.message,
+            )
+
+    handler.__name__ = method_name
+    return handler
+
+
 class _Servicer(GRPCInferenceServiceServicer):
     def __init__(self, core: ServerCore):
         self.core = core
 
-    # -- health / metadata ---------------------------------------------------
-
-    async def ServerLive(self, request, context):
-        return pb.ServerLiveResponse(live=self.core.live)
-
-    async def ServerReady(self, request, context):
-        return pb.ServerReadyResponse(ready=self.core.live)
-
-    async def ModelReady(self, request, context):
-        return pb.ModelReadyResponse(
-            ready=self.core.repository.is_ready(request.name, request.version)
-        )
-
-    async def ServerMetadata(self, request, context):
-        return pb.ServerMetadataResponse(
-            name=SERVER_NAME,
-            version=SERVER_VERSION,
-            extensions=SERVER_EXTENSIONS,
-        )
-
-    async def ModelMetadata(self, request, context):
-        try:
-            model = self.core.repository.get(request.name, request.version)
-        except InferenceServerException as e:
-            await context.abort(_status_for(e.message()), e.message())
-        meta = model.metadata()
-        response = pb.ModelMetadataResponse(
-            name=meta["name"],
-            versions=meta["versions"],
-            platform=meta["platform"],
-        )
-        for io_key, target in (("inputs", response.inputs), ("outputs", response.outputs)):
-            for tensor in meta[io_key]:
-                target.add(
-                    name=tensor["name"],
-                    datatype=tensor["datatype"],
-                    shape=tensor["shape"],
-                )
-        return response
-
-    async def ModelConfig(self, request, context):
-        try:
-            model = self.core.repository.get(request.name, request.version)
-        except InferenceServerException as e:
-            await context.abort(_status_for(e.message()), e.message())
-        cfg = model.config()
-        proto = mc.ModelConfig(
-            name=cfg["name"],
-            platform=cfg["platform"],
-            backend=cfg["backend"],
-            max_batch_size=cfg["max_batch_size"],
-        )
-        for tensor in cfg["input"]:
-            proto.input.add(
-                name=tensor["name"],
-                data_type=mc.DataType.Value(tensor["data_type"]),
-                dims=tensor["dims"],
-            )
-        for tensor in cfg["output"]:
-            proto.output.add(
-                name=tensor["name"],
-                data_type=mc.DataType.Value(tensor["data_type"]),
-                dims=tensor["dims"],
-            )
-        proto.model_transaction_policy.decoupled = cfg[
-            "model_transaction_policy"
-        ]["decoupled"]
-        return pb.ModelConfigResponse(config=proto)
-
-    # -- statistics ----------------------------------------------------------
-
-    async def ModelStatistics(self, request, context):
-        try:
-            stats = self.core.statistics(request.name, request.version)
-        except InferenceServerException as e:
-            await context.abort(_status_for(e.message()), e.message())
-        response = pb.ModelStatisticsResponse()
-        for snap in stats["model_stats"]:
-            entry = response.model_stats.add(
-                name=snap["name"],
-                version=snap["version"],
-                last_inference=snap["last_inference"],
-                inference_count=snap["inference_count"],
-                execution_count=snap["execution_count"],
-            )
-            for field, duration in snap["inference_stats"].items():
-                target = getattr(entry.inference_stats, field)
-                target.count = duration["count"]
-                target.ns = duration["ns"]
-            # Decoupled per-response statistics (response_stats map keyed
-            # by response index; key "0" aggregates first responses).
-            for key, fields in snap.get("response_stats", {}).items():
-                rs = entry.response_stats[key]
-                for field, duration in fields.items():
-                    target = getattr(rs, field)
-                    target.count = duration["count"]
-                    target.ns = duration["ns"]
-        return response
-
-    # -- repository ----------------------------------------------------------
-
-    async def RepositoryIndex(self, request, context):
-        response = pb.RepositoryIndexResponse()
-        for entry in self.core.repository.index():
-            if request.ready and entry["state"] != "READY":
-                continue
-            response.models.add(**entry)
-        return response
-
-    async def RepositoryModelLoad(self, request, context):
-        params = _params_to_dict(request.parameters)
-        config = params.get("config")
-        try:
-            self.core.repository.load(
-                request.model_name,
-                config_override=config if isinstance(config, str) else None,
-            )
-        except InferenceServerException as e:
-            await context.abort(_status_for(e.message()), e.message())
-        return pb.RepositoryModelLoadResponse()
-
-    async def RepositoryModelUnload(self, request, context):
-        try:
-            self.core.repository.unload(request.model_name)
-        except InferenceServerException as e:
-            await context.abort(_status_for(e.message()), e.message())
-        return pb.RepositoryModelUnloadResponse()
-
-    # -- shared memory -------------------------------------------------------
-
-    async def SystemSharedMemoryStatus(self, request, context):
-        response = pb.SystemSharedMemoryStatusResponse()
-        for name, region in self.core.shm.status("system", request.name).items():
-            response.regions[name].name = region["name"]
-            response.regions[name].key = region["key"]
-            response.regions[name].offset = region["offset"]
-            response.regions[name].byte_size = region["byte_size"]
-        return response
-
-    async def SystemSharedMemoryRegister(self, request, context):
-        try:
-            self.core.shm.register_system(
-                request.name, request.key, request.offset, request.byte_size
-            )
-        except InferenceServerException as e:
-            await context.abort(_status_for(e.message()), e.message())
-        return pb.SystemSharedMemoryRegisterResponse()
-
-    async def SystemSharedMemoryUnregister(self, request, context):
-        if request.name:
-            self.core.shm.unregister(request.name, kind="system")
-        else:
-            self.core.shm.unregister_all(kind="system")
-        return pb.SystemSharedMemoryUnregisterResponse()
-
-    async def CudaSharedMemoryStatus(self, request, context):
-        return pb.CudaSharedMemoryStatusResponse()
-
-    async def CudaSharedMemoryRegister(self, request, context):
-        await context.abort(
-            grpc.StatusCode.UNIMPLEMENTED,
-            "this server has no CUDA devices; use TPU or system shared memory",
-        )
-
-    async def CudaSharedMemoryUnregister(self, request, context):
-        return pb.CudaSharedMemoryUnregisterResponse()
-
-    async def TpuSharedMemoryStatus(self, request, context):
-        response = pb.TpuSharedMemoryStatusResponse()
-        for name, region in self.core.shm.status("tpu", request.name).items():
-            response.regions[name].name = region["name"]
-            response.regions[name].device_id = region["device_id"]
-            response.regions[name].byte_size = region["byte_size"]
-            response.regions[name].key = region["key"]
-        return response
-
-    async def TpuSharedMemoryRegister(self, request, context):
-        try:
-            self.core.shm.register_tpu(
-                request.name,
-                request.raw_handle,
-                request.device_id,
-                request.byte_size,
-            )
-        except InferenceServerException as e:
-            await context.abort(_status_for(e.message()), e.message())
-        return pb.TpuSharedMemoryRegisterResponse()
-
-    async def TpuSharedMemoryUnregister(self, request, context):
-        if request.name:
-            self.core.shm.unregister(request.name, kind="tpu")
-        else:
-            self.core.shm.unregister_all(kind="tpu")
-        return pb.TpuSharedMemoryUnregisterResponse()
-
-    # -- trace / log ---------------------------------------------------------
-
-    async def TraceSetting(self, request, context):
-        if request.settings:
-            for key, value in request.settings.items():
-                if value.value:
-                    self.core.trace_settings[key] = list(value.value)
-        response = pb.TraceSettingResponse()
-        for key, value in self.core.trace_settings.items():
-            values = value if isinstance(value, list) else [str(value)]
-            response.settings[key].value.extend([str(v) for v in values])
-        return response
-
-    async def LogSettings(self, request, context):
-        for key, value in request.settings.items():
-            which = value.WhichOneof("parameter_choice")
-            if which is not None:
-                self.core.log_settings[key] = getattr(value, which)
-        response = pb.LogSettingsResponse()
-        for key, value in self.core.log_settings.items():
-            if isinstance(value, bool):
-                response.settings[key].bool_param = value
-            elif isinstance(value, int):
-                response.settings[key].uint32_param = value
-            else:
-                response.settings[key].string_param = str(value)
-        return response
-
     # -- inference -----------------------------------------------------------
-
-    def _build_core_request(self, request: pb.ModelInferRequest) -> CoreRequest:
-        core_request = CoreRequest(
-            model_name=request.model_name,
-            model_version=request.model_version,
-            id=request.id,
-            parameters=_params_to_dict(request.parameters),
-        )
-        # raw_input_contents entries are consumed in order by the inputs that
-        # are NOT sourced from shared memory (Triton semantics: shm inputs
-        # contribute no raw entry).
-        n_raw = len(request.raw_input_contents)
-        raw_index = 0
-        for tensor in request.inputs:
-            params = _params_to_dict(tensor.parameters)
-            shm_region = params.get("shared_memory_region")
-            raw = None
-            json_data = None
-            if shm_region is not None:
-                pass
-            elif raw_index < n_raw:
-                raw = request.raw_input_contents[raw_index]
-                raw_index += 1
-            elif tensor.HasField("contents"):
-                field = _CONTENTS_FIELD.get(tensor.datatype)
-                if field is None:
-                    raise InferenceServerException(
-                        f"datatype '{tensor.datatype}' has no proto contents "
-                        "representation; use raw_input_contents"
-                    )
-                json_data = list(getattr(tensor.contents, field))
-            core_request.inputs.append(
-                self.core.decode_input(
-                    tensor.name,
-                    tensor.datatype,
-                    list(tensor.shape),
-                    raw=raw,
-                    json_data=json_data,
-                    shm_region=shm_region,
-                    shm_byte_size=int(params.get("shared_memory_byte_size", 0)),
-                    shm_offset=int(params.get("shared_memory_offset", 0)),
-                )
-            )
-        if raw_index != n_raw:
-            raise InferenceServerException(
-                f"raw_input_contents has {n_raw} entries but only "
-                f"{raw_index} non-shared-memory inputs consumed them"
-            )
-        for out in request.outputs:
-            params = _params_to_dict(out.parameters)
-            core_request.outputs.append(
-                CoreRequestedOutput(
-                    name=out.name,
-                    classification=int(params.get("classification", 0)),
-                    shm_region=params.get("shared_memory_region"),
-                    shm_byte_size=int(params.get("shared_memory_byte_size", 0)),
-                    shm_offset=int(params.get("shared_memory_offset", 0)),
-                )
-            )
-        return core_request
-
-    def _build_proto_response(
-        self, core_response: CoreResponse
-    ) -> pb.ModelInferResponse:
-        response = pb.ModelInferResponse(
-            model_name=core_response.model_name,
-            model_version=core_response.model_version,
-            id=core_response.id,
-        )
-        _dict_to_params(core_response.parameters, response.parameters)
-        for tensor in core_response.outputs:
-            out = response.outputs.add(
-                name=tensor.name,
-                datatype=tensor.datatype,
-                shape=tensor.shape,
-            )
-            if tensor.name in core_response.shm_outputs:
-                region, size, offset = core_response.shm_outputs[tensor.name]
-                out.parameters["shared_memory_region"].string_param = region
-                out.parameters["shared_memory_byte_size"].int64_param = size
-                if offset:
-                    out.parameters["shared_memory_offset"].int64_param = offset
-                response.raw_output_contents.append(b"")
-            elif tensor.datatype == "BYTES":
-                response.raw_output_contents.append(
-                    serialize_byte_tensor(tensor.data).tobytes()
-                )
-            else:
-                response.raw_output_contents.append(
-                    np.ascontiguousarray(tensor.data).tobytes()
-                )
-        return response
 
     async def ModelInfer(self, request, context):
         try:
-            core_request = self._build_core_request(request)
+            core_request = build_core_request(self.core, request)
             core_response = await self.core.infer(core_request)
         except InferenceServerException as e:
             await context.abort(_status_for(e.message()), e.message())
-        return self._build_proto_response(core_response)
+        return build_proto_response(core_response)
 
     async def ModelStreamInfer(self, request_iterator, context):
         async for request in request_iterator:
             try:
-                core_request = self._build_core_request(request)
+                core_request = build_core_request(self.core, request)
                 async for core_response in self.core.infer_decoupled(
                     core_request
                 ):
                     yield pb.ModelStreamInferResponse(
-                        infer_response=self._build_proto_response(core_response)
+                        infer_response=build_proto_response(core_response)
                     )
             except InferenceServerException as e:
                 error = pb.ModelStreamInferResponse(
@@ -427,6 +197,11 @@ class _Servicer(GRPCInferenceServiceServicer):
                     infer_response=pb.ModelInferResponse(id=request.id),
                 )
                 yield error
+
+
+# Bind every non-inference method to the shared codec implementation.
+for _method in codec.METHODS:
+    setattr(_Servicer, _method, _delegated(_method))
 
 
 async def serve_grpc(core: ServerCore, host: str = "0.0.0.0", port: int = 8001):

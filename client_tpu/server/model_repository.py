@@ -42,6 +42,18 @@ class Model:
     # max_batch_size > 0, matching Triton config conventions.
     inputs: List[Dict[str, Any]] = []
     outputs: List[Dict[str, Any]] = []
+    # Mixed-shape dynamic batching (the server-side half of Triton's ragged
+    # batching, reference docs ragged_batching.md): when True, concurrent
+    # requests whose shapes differ ONLY in dims the model declares as -1
+    # share one execution — the batcher zero-pads those dims to a shared
+    # power-of-two bucket (bounding XLA retraces) before concatenating.
+    # The model must tolerate padding (e.g. mask pad_token positions).
+    allow_ragged_batch: bool = False
+    ragged_pad_value: int = 0
+    # Hard upper bound for padded ragged dims (e.g. max sequence length);
+    # the batcher clamps its power-of-two bucket here so merging can never
+    # push a batch past a limit its members individually respect.
+    ragged_dim_cap: Optional[int] = None
 
     def metadata(self) -> Dict[str, Any]:
         return {

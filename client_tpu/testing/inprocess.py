@@ -21,10 +21,12 @@ class InProcessServer:
         self,
         core: Optional[ServerCore] = None,
         http: bool = True,
-        grpc: bool = True,
+        grpc=True,
         host: str = "127.0.0.1",
         builtin_models: bool = True,
     ):
+        """`grpc` may be True (native front-end when built, else grpc.aio),
+        "native", "aio", or False."""
         if core is None:
             core = ServerCore(ModelRepository())
         self.core = core
@@ -33,7 +35,12 @@ class InProcessServer:
 
             register_builtin_models(self.core.repository)
         self._want_http = http
+        if grpc is True:
+            from client_tpu.server.native_frontend import native_available
+
+            grpc = "native" if native_available() else "aio"
         self._want_grpc = grpc
+        self.grpc_impl: Optional[str] = grpc if grpc else None
         self._host = host
         self.http_port: Optional[int] = None
         self.grpc_port: Optional[int] = None
@@ -72,12 +79,19 @@ class InProcessServer:
         self._stop = asyncio.Event()
         http_runner = None
         grpc_server = None
+        native_frontend = None
         if self._want_http:
             from client_tpu.server.http_server import serve_http
 
             http_runner = await serve_http(self.core, self._host, 0)
             self.http_port = http_runner.addresses[0][1]
-        if self._want_grpc:
+        if self._want_grpc == "native":
+            from client_tpu.server.native_frontend import serve_grpc_native
+
+            native_frontend, self.grpc_port = await serve_grpc_native(
+                self.core, self._host, 0
+            )
+        elif self._want_grpc:
             from client_tpu.server.grpc_server import serve_grpc
 
             grpc_server, self.grpc_port = await serve_grpc(
@@ -85,6 +99,8 @@ class InProcessServer:
             )
         self._ready.set()
         await self._stop.wait()
+        if native_frontend is not None:
+            native_frontend.stop()
         if grpc_server is not None:
             await grpc_server.stop(grace=1)
         if http_runner is not None:

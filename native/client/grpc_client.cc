@@ -285,6 +285,81 @@ struct StreamState {
   std::string grpc_message;
 };
 
+// ---------------------------------------------------------------------------
+// Channel cache: clients for the same URL share HTTP/2 connections, up to
+// CTPU_GRPC_CHANNEL_MAX_SHARE_COUNT users per connection (default 6; 0 or
+// negative disables sharing). Role parity with the reference's gRPC channel
+// cache (reference src/c++/library/grpc_client.cc:47-152,
+// TRITON_CLIENT_GRPC_CHANNEL_MAX_SHARE_COUNT): under concurrency-N load,
+// N workers multiplex ~N/6 connections, so wire reads/writes batch and the
+// per-request syscall cost amortizes.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+int ChannelMaxShare() {
+  static const int count = [] {
+    const char* v = getenv("CTPU_GRPC_CHANNEL_MAX_SHARE_COUNT");
+    if (v == nullptr || *v == '\0') return 6;
+    return atoi(v);
+  }();
+  return count;
+}
+
+struct ChannelCache {
+  struct Entry {
+    std::shared_ptr<h2::Connection> conn;
+    int users = 0;
+  };
+  std::mutex mu;
+  std::map<std::string, std::vector<Entry>> by_url;
+
+  // Returns a cached (or new) connection and counts `who` as a user.
+  std::shared_ptr<h2::Connection> Acquire(const std::string& key,
+                                          const std::string& host, int port,
+                                          std::string* err) {
+    std::lock_guard<std::mutex> lk(mu);
+    auto& entries = by_url[key];
+    // Drop dead connections no longer used by anyone.
+    entries.erase(std::remove_if(entries.begin(), entries.end(),
+                                 [](const Entry& e) {
+                                   return !e.conn->alive() && e.users == 0;
+                                 }),
+                  entries.end());
+    for (auto& e : entries) {
+      if (e.conn->alive() && e.users < ChannelMaxShare()) {
+        e.users++;
+        return e.conn;
+      }
+    }
+    auto conn = std::shared_ptr<h2::Connection>(
+        h2::Connection::Connect(host, port, err).release());
+    if (conn == nullptr) return nullptr;
+    entries.push_back(Entry{conn, 1});
+    return conn;
+  }
+
+  void Release(const std::string& key,
+               const std::shared_ptr<h2::Connection>& conn) {
+    std::lock_guard<std::mutex> lk(mu);
+    auto it = by_url.find(key);
+    if (it == by_url.end()) return;
+    for (auto& e : it->second) {
+      if (e.conn == conn && e.users > 0) {
+        e.users--;
+        break;
+      }
+    }
+  }
+};
+
+ChannelCache& Cache() {
+  static ChannelCache* cache = new ChannelCache();
+  return *cache;
+}
+
+}  // namespace
+
 Error InferenceServerGrpcClient::Create(
     std::unique_ptr<InferenceServerGrpcClient>* client, const std::string& url,
     bool verbose) {
@@ -307,6 +382,10 @@ InferenceServerGrpcClient::InferenceServerGrpcClient(std::string host,
 
 InferenceServerGrpcClient::~InferenceServerGrpcClient() {
   StopStream();
+  std::lock_guard<std::mutex> lk(conn_mu_);
+  if (conn_ != nullptr && shared_channel_) {
+    Cache().Release(host_ + ":" + std::to_string(port_), conn_);
+  }
 }
 
 std::shared_ptr<h2::Connection> InferenceServerGrpcClient::Conn() {
@@ -318,8 +397,19 @@ Error InferenceServerGrpcClient::EnsureConnection() {
   std::lock_guard<std::mutex> lk(conn_mu_);
   if (conn_ && conn_->alive()) return Error::Success();
   std::string err;
-  conn_ = std::shared_ptr<h2::Connection>(
-      h2::Connection::Connect(host_, port_, &err).release());
+  const std::string key = host_ + ":" + std::to_string(port_);
+  if (conn_ != nullptr && shared_channel_) {
+    Cache().Release(key, conn_);  // dead shared connection: drop our claim
+    conn_ = nullptr;
+  }
+  if (ChannelMaxShare() > 0) {
+    conn_ = Cache().Acquire(key, host_, port_, &err);
+    shared_channel_ = conn_ != nullptr;
+  } else {
+    conn_ = std::shared_ptr<h2::Connection>(
+        h2::Connection::Connect(host_, port_, &err).release());
+    shared_channel_ = false;
+  }
   if (!conn_) return Error("gRPC connect failed: " + err);
   return Error::Success();
 }
@@ -369,15 +459,18 @@ Error InferenceServerGrpcClient::Call(const std::string& method,
   };
 
   std::shared_ptr<h2::Connection> conn = Conn();
-  const int32_t sid =
-      conn->StartStream(BuildHeaders(method, headers, timeout_us), false, ev);
+  const std::string body = FrameMessage(req);
+  size_t sent = 0;
+  const int32_t sid = conn->StartStreamWithData(
+      BuildHeaders(method, headers, timeout_us), body.data(), body.size(),
+      true, ev, &sent);
   if (sid < 0) return Error("gRPC stream open failed (connection lost)");
   // One deadline covers send (flow-control stalls) AND the response wait.
   const auto deadline = std::chrono::steady_clock::now() +
                         std::chrono::microseconds(timeout_us);
   bool send_stalled = false;
-  const std::string body = FrameMessage(req);
-  if (!conn->SendData(sid, body.data(), body.size(), true,
+  if (sent < body.size() &&
+      !conn->SendData(sid, body.data() + sent, body.size() - sent, true,
                       static_cast<int64_t>(timeout_us))) {
     // The stream was registered; h2 fires on_close for it (now or at
     // connection teardown) — wait below rather than double-report. A

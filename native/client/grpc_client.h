@@ -199,6 +199,9 @@ class InferenceServerGrpcClient : public InferenceServerClient {
   // shared_ptr: in-flight calls hold a reference so a reconnect (which
   // replaces conn_) can never free a connection out from under them.
   std::shared_ptr<h2::Connection> conn_;
+  // True when conn_ came from the URL-keyed channel cache (shared with
+  // other clients, CTPU_GRPC_CHANNEL_MAX_SHARE_COUNT users each).
+  bool shared_channel_ = false;
   std::shared_ptr<h2::Connection> Conn();
 
   // Streaming state (one active stream max, like the reference which

@@ -203,6 +203,86 @@ int32_t Connection::StartStream(const std::vector<hpack::Header>& headers,
   return static_cast<int32_t>(id);
 }
 
+int32_t Connection::StartStreamWithData(
+    const std::vector<hpack::Header>& headers, const void* data, size_t len,
+    bool end_stream, StreamEvents events, size_t* sent) {
+  std::string block;
+  hpack::Encode(headers, &block);
+  uint32_t id;
+  bool ok;
+  size_t data_sent = 0;
+  {
+    std::lock_guard<std::mutex> wlk(write_mu_);
+    size_t max_frame;
+    size_t quota;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      if (dead_.load()) return -1;
+      id = next_stream_id_;
+      next_stream_id_ += 2;
+      auto s = std::make_shared<Stream>();
+      s->events = std::move(events);
+      s->send_window = peer_initial_window_;
+      // Claim the whole first window slice up front (under mu_, atomically
+      // with the quota decision) so concurrent senders cannot double-spend.
+      int64_t avail = std::min(conn_send_window_, s->send_window);
+      quota = avail > 0 ? std::min(len, static_cast<size_t>(avail)) : 0;
+      conn_send_window_ -= quota;
+      s->send_window -= quota;
+      streams_[id] = std::move(s);
+      max_frame = peer_max_frame_;
+    }
+    // One buffer: HEADERS (+CONTINUATIONs) + DATA chunks, one WriteAll.
+    std::string buf;
+    buf.reserve(9 + block.size() + quota + 9 * (1 + quota / max_frame));
+    size_t off = 0;
+    bool first = true;
+    do {
+      const size_t n = std::min(block.size() - off, max_frame);
+      uint8_t flags = 0;
+      if (off + n == block.size()) flags |= kFlagEndHeaders;
+      if (first && end_stream && len == 0) flags |= kFlagEndStream;
+      uint8_t fh[9];
+      PutU32(fh, static_cast<uint32_t>(n) << 8);
+      fh[3] = first ? kFrameHeaders : kFrameContinuation;
+      fh[4] = flags;
+      PutU32(fh + 5, id);
+      buf.append(reinterpret_cast<char*>(fh), 9);
+      buf.append(block.data() + off, n);
+      first = false;
+      off += n;
+    } while (off < block.size());
+    const uint8_t* p = static_cast<const uint8_t*>(data);
+    while (data_sent < quota) {
+      const size_t n = std::min(quota - data_sent, max_frame);
+      uint8_t flags =
+          (end_stream && data_sent + n == len) ? kFlagEndStream : 0;
+      uint8_t fh[9];
+      PutU32(fh, static_cast<uint32_t>(n) << 8);
+      fh[3] = kFrameData;
+      fh[4] = flags;
+      PutU32(fh + 5, id);
+      buf.append(reinterpret_cast<char*>(fh), 9);
+      buf.append(reinterpret_cast<const char*>(p) + data_sent, n);
+      data_sent += n;
+    }
+    ok = WriteAll(buf.data(), buf.size());
+  }
+  *sent = data_sent;
+  if (!ok) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto it = streams_.find(id);
+    if (it != streams_.end() && !it->second->closed) {
+      it->second->closed = true;
+      streams_.erase(it);
+      window_cv_.notify_all();
+      return -1;
+    }
+    // Connection died concurrently; FailAllStreams already fired on_close.
+  }
+  return static_cast<int32_t>(id);
+}
+
 bool Connection::SendData(int32_t stream_id, const void* data, size_t len,
                           bool end_stream, int64_t timeout_us) {
   const uint8_t* p = static_cast<const uint8_t*>(data);

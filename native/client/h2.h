@@ -55,6 +55,15 @@ class Connection {
   int32_t StartStream(const std::vector<hpack::Header>& headers,
                       bool end_stream, StreamEvents events);
 
+  // Opens a stream AND sends as much of `data` as flow control allows in
+  // ONE socket write (HEADERS + DATA frames coalesced) — on a unary gRPC
+  // call this halves the send syscalls. *sent reports how many data bytes
+  // went out; the caller pushes any remainder through SendData. Returns the
+  // stream id, or -1 if the connection is dead.
+  int32_t StartStreamWithData(const std::vector<hpack::Header>& headers,
+                              const void* data, size_t len, bool end_stream,
+                              StreamEvents events, size_t* sent);
+
   // Sends DATA on an open stream, chunked to the peer's max frame size and
   // blocking on send flow control. Returns false if the stream/connection
   // died first, or if timeout_us > 0 elapsed while blocked on flow control.

@@ -1,0 +1,1285 @@
+// Native gRPC front-end for the Python inference server.
+//
+// A CPython extension module (client_tpu._native_frontend) embedding an h2c
+// gRPC server (h2_server.{h,cc}). C++ threads own the sockets, HPACK, flow
+// control, and protobuf parsing; Python is entered only to (a) dispatch a
+// decoded inference request onto the core's event loop and (b) answer the
+// rare non-inference RPCs. This removes the per-request cost that makes a
+// pure-Python gRPC front-end the throughput bottleneck (PERF.md): wire work
+// runs without the GIL, and the GIL-bound slice per request shrinks to
+// building a handful of numpy views.
+//
+// Role parity: the reference serves gRPC via tritonserver's C++ grpc
+// endpoint (its client repo drives that server, e.g. reference
+// src/c++/library/grpc_client.cc expects these method semantics). Here the
+// equivalent endpoint is built from this repo's own h2 layer instead of
+// grpc++.
+//
+// Bridge contract (see client_tpu/server/native_frontend.py):
+//   start(host, port, dispatch, rpc, cancel) -> frontend id
+//   port(id) -> bound port
+//   stop(id)
+//   complete(handle, model, version, request_id, outputs, params,
+//            final, error, status)
+// dispatch(handle, model, version, request_id, inputs, outputs, params,
+//          streaming) is called WITH the GIL from reader threads; `inputs`
+// tensors carry zero-copy memoryviews into the request proto, which stays
+// alive until the final complete() for that handle.
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include <zlib.h>
+
+#include "client_tpu/grpc/_generated/grpc_service.pb.h"
+#include "h2_server.h"
+
+namespace ctpu {
+namespace frontend {
+
+namespace {
+
+constexpr char kServicePrefix[] = "/inference.GRPCInferenceService/";
+
+// gRPC status codes used wire-side.
+constexpr int kGrpcOk = 0;
+constexpr int kGrpcInvalidArgument = 3;
+constexpr int kGrpcUnimplemented = 12;
+constexpr int kGrpcInternal = 13;
+
+std::string PercentEncode(const std::string& in) {
+  static const char* hex = "0123456789ABCDEF";
+  std::string out;
+  out.reserve(in.size());
+  for (unsigned char c : in) {
+    if (c >= 0x20 && c <= 0x7e && c != '%') {
+      out.push_back(static_cast<char>(c));
+    } else {
+      out.push_back('%');
+      out.push_back(hex[c >> 4]);
+      out.push_back(hex[c & 0xf]);
+    }
+  }
+  return out;
+}
+
+// 5-byte gRPC message framing.
+std::string FrameMessage(const std::string& body) {
+  std::string out;
+  out.reserve(body.size() + 5);
+  out.push_back('\0');
+  uint32_t len = static_cast<uint32_t>(body.size());
+  out.push_back(static_cast<char>((len >> 24) & 0xff));
+  out.push_back(static_cast<char>((len >> 16) & 0xff));
+  out.push_back(static_cast<char>((len >> 8) & 0xff));
+  out.push_back(static_cast<char>(len & 0xff));
+  out.append(body);
+  return out;
+}
+
+std::vector<hpack::Header> ResponseHeaders() {
+  return {{":status", "200"},
+          {"content-type", "application/grpc"},
+          {"grpc-accept-encoding", "identity,gzip,deflate"}};
+}
+
+// Inflates a gzip- or zlib-wrapped gRPC message (grpc-encoding gzip /
+// deflate). Returns false on corrupt input.
+bool InflateMessage(const std::string& in, std::string* out) {
+  z_stream zs;
+  memset(&zs, 0, sizeof(zs));
+  // 15+32: zlib auto-detects gzip vs zlib headers.
+  if (inflateInit2(&zs, 15 + 32) != Z_OK) return false;
+  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(in.data()));
+  zs.avail_in = static_cast<uInt>(in.size());
+  char buf[64 * 1024];
+  int rc = Z_OK;
+  while (rc != Z_STREAM_END) {
+    zs.next_out = reinterpret_cast<Bytef*>(buf);
+    zs.avail_out = sizeof(buf);
+    rc = inflate(&zs, Z_NO_FLUSH);
+    if (rc != Z_OK && rc != Z_STREAM_END) {
+      inflateEnd(&zs);
+      return false;
+    }
+    out->append(buf, sizeof(buf) - zs.avail_out);
+    if (rc == Z_OK && zs.avail_in == 0 && zs.avail_out != 0) {
+      inflateEnd(&zs);
+      return false;  // truncated stream
+    }
+  }
+  inflateEnd(&zs);
+  return true;
+}
+
+std::vector<hpack::Header> Trailers(int status, const std::string& message) {
+  std::vector<hpack::Header> t{{"grpc-status", std::to_string(status)}};
+  if (!message.empty()) t.push_back({"grpc-message", PercentEncode(message)});
+  return t;
+}
+
+// Appends `v` to `*out` little-endian over `width` bytes (KServe raw tensor
+// byte order; x86/TPU hosts are little-endian, memcpy would do, but be
+// explicit so the conversion is portable).
+template <typename T>
+void AppendLE(std::string* out, T v, size_t width) {
+  uint64_t bits;
+  if (sizeof(T) == 8 && !std::is_integral<T>::value) {
+    double d = static_cast<double>(v);
+    memcpy(&bits, &d, 8);
+  } else if (sizeof(T) == 4 && !std::is_integral<T>::value) {
+    float f = static_cast<float>(v);
+    uint32_t b32;
+    memcpy(&b32, &f, 4);
+    bits = b32;
+  } else {
+    bits = static_cast<uint64_t>(static_cast<int64_t>(v));
+  }
+  for (size_t i = 0; i < width; ++i) {
+    out->push_back(static_cast<char>((bits >> (8 * i)) & 0xff));
+  }
+}
+
+// Converts typed InferTensorContents to the raw little-endian layout
+// decode_input() expects. Returns false for datatype/contents mismatches.
+bool ContentsToRaw(const std::string& datatype,
+                   const inference::InferTensorContents& c, std::string* out) {
+  if (datatype == "BOOL") {
+    for (bool v : c.bool_contents()) out->push_back(v ? 1 : 0);
+  } else if (datatype == "INT8") {
+    for (int32_t v : c.int_contents()) AppendLE(out, v, 1);
+  } else if (datatype == "INT16") {
+    for (int32_t v : c.int_contents()) AppendLE(out, v, 2);
+  } else if (datatype == "INT32") {
+    for (int32_t v : c.int_contents()) AppendLE(out, v, 4);
+  } else if (datatype == "INT64") {
+    for (int64_t v : c.int64_contents()) AppendLE(out, v, 8);
+  } else if (datatype == "UINT8") {
+    for (uint32_t v : c.uint_contents()) AppendLE(out, v, 1);
+  } else if (datatype == "UINT16") {
+    for (uint32_t v : c.uint_contents()) AppendLE(out, v, 2);
+  } else if (datatype == "UINT32") {
+    for (uint32_t v : c.uint_contents()) AppendLE(out, v, 4);
+  } else if (datatype == "UINT64") {
+    for (uint64_t v : c.uint64_contents()) AppendLE(out, v, 8);
+  } else if (datatype == "FP32") {
+    for (float v : c.fp32_contents()) AppendLE(out, v, 4);
+  } else if (datatype == "FP64") {
+    for (double v : c.fp64_contents()) AppendLE(out, v, 8);
+  } else if (datatype == "BYTES") {
+    for (const std::string& v : c.bytes_contents()) {
+      uint32_t len = static_cast<uint32_t>(v.size());
+      for (size_t i = 0; i < 4; ++i) {
+        out->push_back(static_cast<char>((len >> (8 * i)) & 0xff));
+      }
+      out->append(v);
+    }
+  } else {
+    return false;
+  }
+  return true;
+}
+
+// InferParameter map -> new Python dict.
+PyObject* ParamsToDict(
+    const google::protobuf::Map<std::string, inference::InferParameter>&
+        params) {
+  PyObject* dict = PyDict_New();
+  if (dict == nullptr) return nullptr;
+  for (const auto& kv : params) {
+    PyObject* value = nullptr;
+    switch (kv.second.parameter_choice_case()) {
+      case inference::InferParameter::kBoolParam:
+        value = PyBool_FromLong(kv.second.bool_param());
+        break;
+      case inference::InferParameter::kInt64Param:
+        value = PyLong_FromLongLong(kv.second.int64_param());
+        break;
+      case inference::InferParameter::kStringParam:
+        value = PyUnicode_FromStringAndSize(
+            kv.second.string_param().data(),
+            static_cast<Py_ssize_t>(kv.second.string_param().size()));
+        break;
+      case inference::InferParameter::kDoubleParam:
+        value = PyFloat_FromDouble(kv.second.double_param());
+        break;
+      case inference::InferParameter::kUint64Param:
+        value = PyLong_FromUnsignedLongLong(kv.second.uint64_param());
+        break;
+      default:
+        continue;
+    }
+    if (value == nullptr ||
+        PyDict_SetItemString(dict, kv.first.c_str(), value) != 0) {
+      Py_XDECREF(value);
+      Py_DECREF(dict);
+      return nullptr;
+    }
+    Py_DECREF(value);
+  }
+  return dict;
+}
+
+// Python value -> InferParameter (response parameters).
+void SetParam(inference::InferParameter* p, PyObject* value) {
+  if (PyBool_Check(value)) {
+    p->set_bool_param(value == Py_True);
+  } else if (PyLong_Check(value)) {
+    p->set_int64_param(PyLong_AsLongLong(value));
+  } else if (PyFloat_Check(value)) {
+    p->set_double_param(PyFloat_AsDouble(value));
+  } else if (PyUnicode_Check(value)) {
+    Py_ssize_t len = 0;
+    const char* s = PyUnicode_AsUTF8AndSize(value, &len);
+    if (s != nullptr) p->set_string_param(std::string(s, len));
+  } else {
+    PyObject* repr = PyObject_Str(value);
+    if (repr != nullptr) {
+      const char* s = PyUnicode_AsUTF8(repr);
+      if (s != nullptr) p->set_string_param(s);
+      Py_DECREF(repr);
+    }
+  }
+}
+
+struct Frontend;
+
+// Owner of everything a request's zero-copy numpy views point into: the
+// parsed proto (raw_input_contents strings) and any typed-contents
+// conversions. Shared between the Pending entry and every ReqBuffer object
+// handed to Python, so a client cancel freeing the Pending can never pull
+// memory out from under an in-flight model execution.
+struct ReqBuffers {
+  std::unique_ptr<inference::ModelInferRequest> request;
+  std::vector<std::unique_ptr<std::string>> converted;
+};
+
+// One gRPC request in flight to Python.
+struct Pending {
+  Frontend* fe = nullptr;
+  std::shared_ptr<h2srv::ServerConnection> conn;
+  uint32_t stream_id = 0;
+  bool streaming = false;
+  bool cancelled = false;
+  std::shared_ptr<ReqBuffers> bufs;
+};
+
+// A read-only buffer-protocol view into ReqBuffers-owned memory. numpy's
+// frombuffer keeps a reference (via PyBuffer_FillInfo's view->obj), so the
+// arrays themselves keep the request alive — no lifetime contract needed
+// from the model code.
+struct ReqBufferObject {
+  PyObject_HEAD
+  std::shared_ptr<ReqBuffers>* owner;
+  const char* data;
+  Py_ssize_t len;
+};
+
+int ReqBuffer_getbuffer(PyObject* self, Py_buffer* view, int flags) {
+  auto* o = reinterpret_cast<ReqBufferObject*>(self);
+  return PyBuffer_FillInfo(view, self, const_cast<char*>(o->data), o->len,
+                           1 /* readonly */, flags);
+}
+
+void ReqBuffer_dealloc(PyObject* self) {
+  auto* o = reinterpret_cast<ReqBufferObject*>(self);
+  delete o->owner;
+  Py_TYPE(self)->tp_free(self);
+}
+
+PyBufferProcs kReqBufferAsBuffer = {ReqBuffer_getbuffer, nullptr};
+
+PyTypeObject ReqBufferType = {
+    PyVarObject_HEAD_INIT(nullptr, 0) "client_tpu._native_frontend.ReqBuffer",
+    sizeof(ReqBufferObject),
+    0,                 // tp_itemsize
+    ReqBuffer_dealloc, // tp_dealloc
+};
+
+PyObject* MakeReqBuffer(const std::shared_ptr<ReqBuffers>& bufs,
+                        const std::string& raw) {
+  auto* obj = PyObject_New(ReqBufferObject, &ReqBufferType);
+  if (obj == nullptr) return nullptr;
+  obj->owner = new std::shared_ptr<ReqBuffers>(bufs);
+  obj->data = raw.data();
+  obj->len = static_cast<Py_ssize_t>(raw.size());
+  return reinterpret_cast<PyObject*>(obj);
+}
+
+// Per-h2-stream gRPC state.
+struct GrpcStream {
+  enum Kind { kUnary, kStreamInfer, kOther };
+  Kind kind = kOther;
+  std::string method;        // last :path segment
+  std::string encoding;      // request grpc-encoding (identity/gzip/deflate)
+  std::string msg_buf;       // accumulating inbound gRPC frames
+  bool headers_sent = false;
+  bool end_stream_seen = false;
+  bool finished = false;     // trailers queued or reset
+  int pending = 0;           // dispatched, not-yet-final requests
+};
+
+struct Frontend {
+  uint64_t id = 0;
+  std::unique_ptr<h2srv::Listener> listener;
+  PyObject* rpc_cb = nullptr;
+  PyObject* cancel_cb = nullptr;
+  std::atomic<bool> stopped{false};
+
+  std::mutex mu;  // streams + conns registry
+  // Connections stay registered (and alive via shared_ptr) until close.
+  std::map<h2srv::ServerConnection*, std::shared_ptr<h2srv::ServerConnection>>
+      conns;
+  std::map<std::pair<h2srv::ServerConnection*, uint32_t>, GrpcStream> streams;
+
+  // Parsed inference requests ready for Python, drained in batches by the
+  // bridge's pump thread (wait_requests). Readers never touch the GIL on
+  // the inference path — that is the point of the queue.
+  std::mutex q_mu;
+  std::condition_variable q_cv;
+  std::deque<uint64_t> ready;
+  bool q_stopped = false;
+};
+
+// Global registries (a process hosts at most a handful of front-ends; the
+// Frontend structs stay for the life of the process so late completions
+// after stop() are safe no-ops).
+std::mutex g_mu;
+std::map<uint64_t, Frontend*> g_frontends;
+uint64_t g_next_frontend_id = 1;
+std::map<uint64_t, std::unique_ptr<Pending>> g_pending;
+uint64_t g_next_handle = 1;
+
+class GilHolder {
+ public:
+  GilHolder() : state_(PyGILState_Ensure()) {}
+  ~GilHolder() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+void SendErrorTrailers(h2srv::ServerConnection* conn, uint32_t stream_id,
+                       bool headers_sent, int status,
+                       const std::string& message) {
+  if (!headers_sent) {
+    // trailers-only response
+    auto headers = ResponseHeaders();
+    auto trailers = Trailers(status, message);
+    headers.insert(headers.end(), trailers.begin(), trailers.end());
+    conn->SendHeaders(stream_id, headers, true);
+  } else {
+    conn->SendTrailers(stream_id, Trailers(status, message));
+  }
+}
+
+// -- request dispatch into Python -------------------------------------------
+
+// Builds one request tuple for the bridge:
+//   (handle, model, version, request_id, inputs, outputs, params, streaming)
+// Called with the GIL. Returns a new reference, or nullptr on failure.
+PyObject* BuildRequestTuple(uint64_t handle, Pending* pending) {
+  const inference::ModelInferRequest& req = *pending->bufs->request;
+
+  PyObject* inputs = PyList_New(req.inputs_size());
+  if (inputs == nullptr) return nullptr;
+  int raw_index = 0;
+  int n_raw = req.raw_input_contents_size();
+  size_t converted_index = 0;
+  for (int i = 0; i < req.inputs_size(); ++i) {
+    const auto& t = req.inputs(i);
+    PyObject* shape = PyTuple_New(t.shape_size());
+    for (int d = 0; d < t.shape_size(); ++d) {
+      PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(t.shape(d)));
+    }
+    PyObject* data = Py_None;
+    PyObject* shm = Py_None;
+    bool has_shm = false;
+    int64_t shm_size = 0, shm_offset = 0;
+    std::string shm_region;
+    for (const auto& kv : t.parameters()) {
+      if (kv.first == "shared_memory_region") {
+        shm_region = kv.second.string_param();
+        has_shm = true;
+      } else if (kv.first == "shared_memory_byte_size") {
+        shm_size = kv.second.int64_param();
+      } else if (kv.first == "shared_memory_offset") {
+        shm_offset = kv.second.int64_param();
+      }
+    }
+    if (has_shm) {
+      shm = Py_BuildValue("(sLL)", shm_region.c_str(),
+                          static_cast<long long>(shm_size),
+                          static_cast<long long>(shm_offset));
+    } else if (raw_index < n_raw) {
+      data = MakeReqBuffer(pending->bufs, req.raw_input_contents(raw_index++));
+    } else if (t.has_contents()) {
+      data = MakeReqBuffer(pending->bufs,
+                           *pending->bufs->converted[converted_index++]);
+    }
+    if (data == Py_None) Py_INCREF(Py_None);
+    if (shm == Py_None) Py_INCREF(Py_None);
+    if (data == nullptr || shape == nullptr) {
+      Py_XDECREF(shape);
+      Py_XDECREF(data);
+      Py_DECREF(inputs);
+      return nullptr;
+    }
+    PyObject* item = Py_BuildValue("(ssNNN)", t.name().c_str(),
+                                   t.datatype().c_str(), shape, data, shm);
+    if (item == nullptr) {
+      Py_DECREF(inputs);
+      return nullptr;
+    }
+    PyList_SET_ITEM(inputs, i, item);
+  }
+
+  PyObject* outputs = PyList_New(req.outputs_size());
+  for (int i = 0; i < req.outputs_size(); ++i) {
+    const auto& o = req.outputs(i);
+    long long classification = 0;
+    std::string shm_region;
+    bool has_shm = false;
+    long long shm_size = 0, shm_offset = 0;
+    for (const auto& kv : o.parameters()) {
+      if (kv.first == "classification") {
+        classification = kv.second.int64_param();
+      } else if (kv.first == "shared_memory_region") {
+        shm_region = kv.second.string_param();
+        has_shm = true;
+      } else if (kv.first == "shared_memory_byte_size") {
+        shm_size = kv.second.int64_param();
+      } else if (kv.first == "shared_memory_offset") {
+        shm_offset = kv.second.int64_param();
+      }
+    }
+    PyObject* shm;
+    if (has_shm) {
+      shm = Py_BuildValue("(sLL)", shm_region.c_str(), shm_size, shm_offset);
+    } else {
+      shm = Py_None;
+      Py_INCREF(shm);
+    }
+    PyObject* item =
+        Py_BuildValue("(sLN)", o.name().c_str(), classification, shm);
+    PyList_SET_ITEM(outputs, i, item);
+  }
+
+  PyObject* params = ParamsToDict(req.parameters());
+  if (params == nullptr) {
+    Py_DECREF(inputs);
+    Py_DECREF(outputs);
+    return nullptr;
+  }
+
+  return Py_BuildValue("(KsssNNNi)", static_cast<unsigned long long>(handle),
+                       req.model_name().c_str(), req.model_version().c_str(),
+                       req.id().c_str(), inputs, outputs, params,
+                       pending->streaming ? 1 : 0);
+}
+
+// Parses framed gRPC messages out of `buf` (inflating per `encoding` when
+// the compressed flag is set); returns complete message bodies. On
+// malformed framing/compression sets *bad and *bad_reason.
+std::vector<std::string> ExtractMessages(std::string* buf,
+                                         const std::string& encoding,
+                                         bool* bad, std::string* bad_reason) {
+  std::vector<std::string> out;
+  size_t off = 0;
+  while (buf->size() - off >= 5) {
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(buf->data()) + off;
+    uint32_t len = (uint32_t(p[1]) << 24) | (uint32_t(p[2]) << 16) |
+                   (uint32_t(p[3]) << 8) | uint32_t(p[4]);
+    if (len > (1u << 30)) {
+      *bad = true;
+      *bad_reason = "gRPC message length exceeds 1 GiB";
+      return out;
+    }
+    if (buf->size() - off - 5 < len) break;
+    if (p[0] != 0) {
+      if (encoding != "gzip" && encoding != "deflate") {
+        *bad = true;
+        *bad_reason = "unsupported message compression (grpc-encoding '" +
+                      encoding + "')";
+        return out;
+      }
+      std::string inflated;
+      if (!InflateMessage(std::string(buf->data() + off + 5, len),
+                          &inflated)) {
+        *bad = true;
+        *bad_reason = "corrupt " + encoding + "-compressed gRPC message";
+        return out;
+      }
+      out.push_back(std::move(inflated));
+    } else {
+      out.emplace_back(buf->data() + off + 5, len);
+    }
+    off += 5 + len;
+  }
+  buf->erase(0, off);
+  return out;
+}
+
+void DispatchInfer(Frontend* fe, h2srv::ServerConnection* conn,
+                   uint32_t stream_id, std::string message, bool streaming) {
+  auto pending = std::make_unique<Pending>();
+  pending->fe = fe;
+  pending->stream_id = stream_id;
+  pending->streaming = streaming;
+  pending->bufs = std::make_shared<ReqBuffers>();
+  pending->bufs->request = std::make_unique<inference::ModelInferRequest>();
+  if (!pending->bufs->request->ParseFromString(message)) {
+    GrpcStream* gs = nullptr;
+    {
+      std::lock_guard<std::mutex> lk(fe->mu);
+      auto it = fe->streams.find({conn, stream_id});
+      if (it != fe->streams.end()) gs = &it->second;
+      if (gs != nullptr) gs->finished = true;
+    }
+    SendErrorTrailers(conn, stream_id, gs ? gs->headers_sent : false,
+                      kGrpcInternal, "failed to parse ModelInferRequest");
+    return;
+  }
+  // Pre-convert typed contents so dispatch passes uniform raw buffers.
+  for (const auto& t : pending->bufs->request->inputs()) {
+    bool from_shm = false;
+    for (const auto& kv : t.parameters()) {
+      if (kv.first == "shared_memory_region") from_shm = true;
+    }
+    if (from_shm) continue;
+    if (pending->bufs->request->raw_input_contents_size() > 0) continue;
+    if (!t.has_contents()) continue;
+    auto raw = std::make_unique<std::string>();
+    if (!ContentsToRaw(t.datatype(), t.contents(), raw.get())) {
+      std::lock_guard<std::mutex> lk(fe->mu);
+      auto it = fe->streams.find({conn, stream_id});
+      bool headers_sent = it != fe->streams.end() && it->second.headers_sent;
+      if (it != fe->streams.end()) it->second.finished = true;
+      SendErrorTrailers(conn, stream_id, headers_sent, kGrpcInvalidArgument,
+                        "datatype '" + t.datatype() +
+                            "' has no proto contents representation");
+      return;
+    }
+    pending->bufs->converted.push_back(std::move(raw));
+  }
+
+  uint64_t handle;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    handle = g_next_handle++;
+    {
+      std::lock_guard<std::mutex> lk(fe->mu);
+      auto cit = fe->conns.find(conn);
+      auto sit = fe->streams.find({conn, stream_id});
+      if (cit == fe->conns.end() || sit == fe->streams.end() ||
+          sit->second.finished) {
+        // Connection/stream torn down between parse and dispatch; the peer
+        // is gone (on_accept ordering guarantees registration otherwise).
+        return;
+      }
+      pending->conn = cit->second;
+      sit->second.pending++;
+    }
+    g_pending.emplace(handle, std::move(pending));
+  }
+
+  // Hand to the bridge's pump thread; the reader never touches the GIL on
+  // the inference path.
+  {
+    std::lock_guard<std::mutex> lk(fe->q_mu);
+    fe->ready.push_back(handle);
+  }
+  fe->q_cv.notify_one();
+}
+
+// wait_requests(id, max_n, timeout_ms): blocks (GIL released) for parsed
+// inference requests; returns a list of request tuples (possibly empty on
+// timeout), or None when the frontend is stopping.
+PyObject* WaitRequests(PyObject* self, PyObject* args) {
+  (void)self;
+  unsigned long long id;
+  int max_n;
+  int timeout_ms;
+  if (!PyArg_ParseTuple(args, "Kii", &id, &max_n, &timeout_ms)) {
+    return nullptr;
+  }
+  Frontend* fe;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_frontends.find(id);
+    if (it == g_frontends.end()) Py_RETURN_NONE;
+    fe = it->second;
+  }
+  std::vector<uint64_t> handles;
+  bool stopped = false;
+  Py_BEGIN_ALLOW_THREADS;
+  {
+    std::unique_lock<std::mutex> lk(fe->q_mu);
+    fe->q_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [fe] {
+      return fe->q_stopped || !fe->ready.empty();
+    });
+    stopped = fe->q_stopped && fe->ready.empty();
+    while (!fe->ready.empty() && static_cast<int>(handles.size()) < max_n) {
+      handles.push_back(fe->ready.front());
+      fe->ready.pop_front();
+    }
+  }
+  Py_END_ALLOW_THREADS;
+  if (stopped) Py_RETURN_NONE;
+
+  PyObject* result = PyList_New(0);
+  if (result == nullptr) return nullptr;
+  for (uint64_t handle : handles) {
+    Pending* pending;
+    {
+      std::lock_guard<std::mutex> g(g_mu);
+      auto it = g_pending.find(handle);
+      if (it == g_pending.end()) continue;  // cancelled before delivery
+      pending = it->second.get();
+    }
+    // Safe without g_mu: every pending-freeing path (final complete(),
+    // stop()) runs in Python-called code holding the GIL, and this thread
+    // holds the GIL continuously from the lookup through the tuple build.
+    PyObject* tuple = BuildRequestTuple(handle, pending);
+    if (tuple == nullptr) {
+      PyErr_Print();
+      continue;
+    }
+    PyList_Append(result, tuple);
+    Py_DECREF(tuple);
+  }
+  return result;
+}
+
+// Non-inference methods: one synchronous Python call handles parse +
+// execute + serialize (client_tpu/server/_grpc_codec.py).
+void DispatchSlowPath(Frontend* fe, h2srv::ServerConnection* conn,
+                      uint32_t stream_id, const std::string& method,
+                      const std::string& message) {
+  int status = kGrpcInternal;
+  std::string err = "rpc handler failed";
+  std::string payload;
+  {
+    GilHolder gil;
+    PyObject* result =
+        PyObject_CallFunction(fe->rpc_cb, "sy#", method.c_str(),
+                              message.data(),
+                              static_cast<Py_ssize_t>(message.size()));
+    if (result != nullptr && PyTuple_Check(result) &&
+        PyTuple_GET_SIZE(result) == 3) {
+      status = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(result, 0)));
+      PyObject* body = PyTuple_GET_ITEM(result, 1);
+      char* buf = nullptr;
+      Py_ssize_t len = 0;
+      if (PyBytes_Check(body) &&
+          PyBytes_AsStringAndSize(body, &buf, &len) == 0) {
+        payload.assign(buf, static_cast<size_t>(len));
+      }
+      PyObject* msg = PyTuple_GET_ITEM(result, 2);
+      if (PyUnicode_Check(msg)) {
+        const char* s = PyUnicode_AsUTF8(msg);
+        if (s != nullptr) err = s;
+      }
+    } else if (result == nullptr) {
+      PyErr_Print();
+    }
+    Py_XDECREF(result);
+  }
+  {
+    std::lock_guard<std::mutex> lk(fe->mu);
+    auto it = fe->streams.find({conn, stream_id});
+    if (it == fe->streams.end() || it->second.finished) return;
+    it->second.finished = true;
+  }
+  if (status != kGrpcOk) {
+    SendErrorTrailers(conn, stream_id, false, status, err);
+    return;
+  }
+  conn->SendHeaders(stream_id, ResponseHeaders(), false);
+  conn->SendData(stream_id, FrameMessage(payload), false);
+  conn->SendTrailers(stream_id, Trailers(kGrpcOk, ""));
+}
+
+// -- connection callbacks ----------------------------------------------------
+
+void OnHeaders(Frontend* fe, h2srv::ServerConnection* conn,
+               uint32_t stream_id, std::vector<hpack::Header> headers,
+               bool end_stream) {
+  std::string path;
+  std::string encoding;
+  for (const auto& h : headers) {
+    if (h.name == ":path") path = h.value;
+    if (h.name == "grpc-encoding") encoding = h.value;
+  }
+  GrpcStream gs;
+  gs.encoding = std::move(encoding);
+  if (path.rfind(kServicePrefix, 0) == 0) {
+    gs.method = path.substr(sizeof(kServicePrefix) - 1);
+    if (gs.method == "ModelInfer") {
+      gs.kind = GrpcStream::kUnary;
+    } else if (gs.method == "ModelStreamInfer") {
+      gs.kind = GrpcStream::kStreamInfer;
+    } else {
+      gs.kind = GrpcStream::kOther;
+    }
+  } else {
+    {
+      std::lock_guard<std::mutex> lk(fe->mu);
+      GrpcStream bad;
+      bad.finished = true;
+      fe->streams[{conn, stream_id}] = bad;
+    }
+    SendErrorTrailers(conn, stream_id, false, kGrpcUnimplemented,
+                      "unknown service in path '" + path + "'");
+    return;
+  }
+  gs.end_stream_seen = end_stream;
+  {
+    std::lock_guard<std::mutex> lk(fe->mu);
+    fe->streams[{conn, stream_id}] = gs;
+  }
+  if (end_stream) {
+    // Requests need a body; an empty-body unary call is an error, an empty
+    // stream completes cleanly.
+    if (gs.kind == GrpcStream::kStreamInfer) {
+      {
+        std::lock_guard<std::mutex> lk(fe->mu);
+        fe->streams[{conn, stream_id}].finished = true;
+      }
+      conn->SendHeaders(stream_id, ResponseHeaders(), false);
+      conn->SendTrailers(stream_id, Trailers(kGrpcOk, ""));
+    } else {
+      std::lock_guard<std::mutex> lk(fe->mu);
+      fe->streams[{conn, stream_id}].finished = true;
+      SendErrorTrailers(conn, stream_id, false, kGrpcInternal,
+                        "request body missing");
+    }
+  }
+}
+
+void OnData(Frontend* fe, h2srv::ServerConnection* conn, uint32_t stream_id,
+            const uint8_t* data, size_t len, bool end_stream) {
+  GrpcStream::Kind kind;
+  std::string method;
+  std::vector<std::string> messages;
+  bool bad = false;
+  std::string bad_reason = "malformed gRPC message framing";
+  bool finish_stream_now = false;
+  bool headers_already_sent = false;
+  bool unary_ready = false;
+  std::string unary_message;
+  {
+    std::lock_guard<std::mutex> lk(fe->mu);
+    auto it = fe->streams.find({conn, stream_id});
+    if (it == fe->streams.end() || it->second.finished) return;
+    GrpcStream& gs = it->second;
+    gs.msg_buf.append(reinterpret_cast<const char*>(data), len);
+    if (end_stream) gs.end_stream_seen = true;
+    kind = gs.kind;
+    method = gs.method;
+    if (kind == GrpcStream::kStreamInfer) {
+      messages = ExtractMessages(&gs.msg_buf, gs.encoding, &bad, &bad_reason);
+      if (end_stream && !bad && gs.msg_buf.empty() && messages.empty() &&
+          gs.pending == 0) {
+        // Either an empty stream, or every request already completed its
+        // final response before the half-close arrived.
+        gs.finished = true;
+        finish_stream_now = true;
+        headers_already_sent = gs.headers_sent;
+      }
+    } else {
+      // Unary + slow path: wait for END_STREAM, then expect one message.
+      if (end_stream) {
+        messages =
+            ExtractMessages(&gs.msg_buf, gs.encoding, &bad, &bad_reason);
+        if (!bad && (messages.size() != 1 || !gs.msg_buf.empty())) bad = true;
+        if (!bad) {
+          unary_ready = true;
+          unary_message = std::move(messages[0]);
+          messages.clear();
+        } else {
+          gs.finished = true;
+        }
+      }
+    }
+    if (bad) gs.finished = true;
+  }
+  if (bad) {
+    SendErrorTrailers(conn, stream_id, false, kGrpcInternal, bad_reason);
+    return;
+  }
+  if (finish_stream_now) {
+    if (!headers_already_sent) {
+      conn->SendHeaders(stream_id, ResponseHeaders(), false);
+    }
+    conn->SendTrailers(stream_id, Trailers(kGrpcOk, ""));
+    return;
+  }
+  if (kind == GrpcStream::kStreamInfer) {
+    for (auto& m : messages) {
+      DispatchInfer(fe, conn, stream_id, std::move(m), true);
+    }
+    // If the client half-closed and nothing is pending (all messages
+    // errored out before dispatch), close the stream.
+    bool close_now = false;
+    {
+      std::lock_guard<std::mutex> lk(fe->mu);
+      auto it = fe->streams.find({conn, stream_id});
+      if (it != fe->streams.end() && it->second.end_stream_seen &&
+          !it->second.finished && it->second.pending == 0 &&
+          it->second.msg_buf.empty()) {
+        it->second.finished = true;
+        close_now = true;
+      }
+    }
+    if (close_now) {
+      bool headers_sent = false;
+      {
+        std::lock_guard<std::mutex> lk(fe->mu);
+        auto it = fe->streams.find({conn, stream_id});
+        if (it != fe->streams.end()) headers_sent = it->second.headers_sent;
+      }
+      if (!headers_sent) {
+        conn->SendHeaders(stream_id, ResponseHeaders(), false);
+      }
+      conn->SendTrailers(stream_id, Trailers(kGrpcOk, ""));
+    }
+  } else if (unary_ready) {
+    if (kind == GrpcStream::kUnary) {
+      DispatchInfer(fe, conn, stream_id, std::move(unary_message), false);
+    } else {
+      DispatchSlowPath(fe, conn, stream_id, method, unary_message);
+    }
+  }
+}
+
+void CancelPending(Frontend* fe, h2srv::ServerConnection* conn,
+                   int32_t stream_id /* -1 = every stream */) {
+  std::vector<uint64_t> handles;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    for (auto& kv : g_pending) {
+      Pending* p = kv.second.get();
+      if (p->fe != fe || p->conn.get() != conn) continue;
+      if (stream_id >= 0 && p->stream_id != uint32_t(stream_id)) continue;
+      if (p->cancelled) continue;
+      p->cancelled = true;
+      handles.push_back(kv.first);
+    }
+  }
+  if (handles.empty() || fe->cancel_cb == nullptr) return;
+  GilHolder gil;
+  for (uint64_t h : handles) {
+    PyObject* r = PyObject_CallFunction(
+        fe->cancel_cb, "K", static_cast<unsigned long long>(h));
+    if (r == nullptr) {
+      PyErr_Print();
+    } else {
+      Py_DECREF(r);
+    }
+  }
+}
+
+void OnReset(Frontend* fe, h2srv::ServerConnection* conn, uint32_t stream_id,
+             uint32_t error_code) {
+  (void)error_code;
+  {
+    std::lock_guard<std::mutex> lk(fe->mu);
+    auto it = fe->streams.find({conn, stream_id});
+    if (it != fe->streams.end()) it->second.finished = true;
+  }
+  CancelPending(fe, conn, static_cast<int32_t>(stream_id));
+}
+
+void OnClose(Frontend* fe, h2srv::ServerConnection* conn) {
+  {
+    std::lock_guard<std::mutex> lk(fe->mu);
+    fe->conns.erase(conn);
+    for (auto it = fe->streams.begin(); it != fe->streams.end();) {
+      if (it->first.first == conn) {
+        it = fe->streams.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+  CancelPending(fe, conn, -1);
+}
+
+void OnAccept(Frontend* fe, std::shared_ptr<h2srv::ServerConnection> conn) {
+  std::lock_guard<std::mutex> lk(fe->mu);
+  fe->conns[conn.get()] = std::move(conn);
+}
+
+// -- module functions --------------------------------------------------------
+
+PyObject* Start(PyObject* self, PyObject* args) {
+  (void)self;
+  const char* host;
+  int port;
+  PyObject* rpc;
+  PyObject* cancel;
+  if (!PyArg_ParseTuple(args, "siOO", &host, &port, &rpc, &cancel)) {
+    return nullptr;
+  }
+  if (!PyCallable_Check(rpc) ||
+      !(cancel == Py_None || PyCallable_Check(cancel))) {
+    PyErr_SetString(PyExc_TypeError, "callbacks must be callable");
+    return nullptr;
+  }
+  auto* fe = new Frontend();
+  Py_INCREF(rpc);
+  fe->rpc_cb = rpc;
+  if (cancel != Py_None) {
+    Py_INCREF(cancel);
+    fe->cancel_cb = cancel;
+  }
+
+  h2srv::ConnectionCallbacks cbs;
+  cbs.on_accept = [fe](std::shared_ptr<h2srv::ServerConnection> c) {
+    OnAccept(fe, std::move(c));
+  };
+  cbs.on_headers = [fe](h2srv::ServerConnection* c, uint32_t sid,
+                        std::vector<hpack::Header> h, bool es) {
+    OnHeaders(fe, c, sid, std::move(h), es);
+  };
+  cbs.on_data = [fe](h2srv::ServerConnection* c, uint32_t sid,
+                     const uint8_t* d, size_t l, bool es) {
+    OnData(fe, c, sid, d, l, es);
+  };
+  cbs.on_reset = [fe](h2srv::ServerConnection* c, uint32_t sid, uint32_t ec) {
+    OnReset(fe, c, sid, ec);
+  };
+  cbs.on_close = [fe](h2srv::ServerConnection* c) { OnClose(fe, c); };
+
+  std::string err;
+  std::unique_ptr<h2srv::Listener> listener;
+  Py_BEGIN_ALLOW_THREADS;
+  listener = h2srv::Listener::Start(host, port, cbs, &err);
+  Py_END_ALLOW_THREADS;
+  if (listener == nullptr) {
+    delete fe;
+    PyErr_SetString(PyExc_OSError, err.c_str());
+    return nullptr;
+  }
+  fe->listener = std::move(listener);
+
+  uint64_t id;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    id = g_next_frontend_id++;
+    fe->id = id;
+    g_frontends[id] = fe;
+  }
+  return PyLong_FromUnsignedLongLong(id);
+}
+
+Frontend* LookupFrontend(uint64_t id) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_frontends.find(id);
+  return it == g_frontends.end() ? nullptr : it->second;
+}
+
+PyObject* Port(PyObject* self, PyObject* args) {
+  (void)self;
+  unsigned long long id;
+  if (!PyArg_ParseTuple(args, "K", &id)) return nullptr;
+  Frontend* fe = LookupFrontend(id);
+  if (fe == nullptr || fe->listener == nullptr) {
+    PyErr_SetString(PyExc_ValueError, "unknown frontend id");
+    return nullptr;
+  }
+  return PyLong_FromLong(fe->listener->port());
+}
+
+PyObject* Stop(PyObject* self, PyObject* args) {
+  (void)self;
+  unsigned long long id;
+  if (!PyArg_ParseTuple(args, "K", &id)) return nullptr;
+  Frontend* fe = LookupFrontend(id);
+  if (fe == nullptr) Py_RETURN_NONE;
+  if (fe->stopped.exchange(true)) Py_RETURN_NONE;
+  // Release the pump thread first, then join the socket threads (which may
+  // be waiting on the GIL — hence ALLOW_THREADS).
+  {
+    std::lock_guard<std::mutex> lk(fe->q_mu);
+    fe->q_stopped = true;
+    fe->ready.clear();
+  }
+  fe->q_cv.notify_all();
+  Py_BEGIN_ALLOW_THREADS;
+  fe->listener->Stop();
+  Py_END_ALLOW_THREADS;
+  // Drop every pending request of this frontend (their protos and buffers).
+  std::vector<std::unique_ptr<Pending>> dropped;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    for (auto it = g_pending.begin(); it != g_pending.end();) {
+      if (it->second->fe == fe) {
+        dropped.push_back(std::move(it->second));
+        it = g_pending.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+  dropped.clear();
+  {
+    std::lock_guard<std::mutex> lk(fe->mu);
+    fe->conns.clear();
+    fe->streams.clear();
+  }
+  Py_RETURN_NONE;
+}
+
+// complete(handle, model, version, request_id, outputs, params, final,
+//          error, status)
+// outputs: [(name, datatype, shape, data_or_None, shm_or_None), ...]
+PyObject* Complete(PyObject* self, PyObject* args) {
+  (void)self;
+  unsigned long long handle;
+  const char* model_name;
+  const char* model_version;
+  const char* request_id;
+  PyObject* outputs;
+  PyObject* params;
+  int final_flag;
+  PyObject* error_obj;
+  int status;
+  if (!PyArg_ParseTuple(args, "KsssOOiOi", &handle, &model_name,
+                        &model_version, &request_id, &outputs, &params,
+                        &final_flag, &error_obj, &status)) {
+    return nullptr;
+  }
+
+  // Look up (and on final, remove) the pending entry. Field values are
+  // copied out under the lock — a non-final lookup must not retain the raw
+  // pointer, since stop() can free the entry concurrently.
+  std::unique_ptr<Pending> owned;  // on final: keeps request buffers alive
+                                   // until the response bytes are queued
+  Frontend* fe;
+  std::shared_ptr<h2srv::ServerConnection> conn_ref;
+  uint32_t stream_id;
+  bool streaming;
+  bool cancelled;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_pending.find(handle);
+    if (it == g_pending.end()) Py_RETURN_NONE;  // stopped/raced: drop
+    Pending* pending = it->second.get();
+    fe = pending->fe;
+    conn_ref = pending->conn;
+    stream_id = pending->stream_id;
+    streaming = pending->streaming;
+    cancelled = pending->cancelled;
+    if (final_flag) {
+      owned = std::move(it->second);
+      g_pending.erase(it);
+    }
+  }
+  h2srv::ServerConnection* conn = conn_ref.get();
+
+  if (cancelled || !conn->alive()) {
+    // Peer is gone; nothing to write. (On final the entry frees here.)
+    Py_RETURN_NONE;
+  }
+
+  std::string error_msg;
+  bool has_error = false;
+  if (error_obj != Py_None) {
+    if (PyUnicode_Check(error_obj)) {
+      const char* s = PyUnicode_AsUTF8(error_obj);
+      if (s != nullptr) error_msg = s;
+    }
+    has_error = true;
+    if (status == 0) status = kGrpcInternal;
+  }
+
+  // Build the response proto (unless this is a unary error, which is
+  // trailers-only).
+  std::string body;
+  if (!has_error || streaming) {
+    inference::ModelInferResponse resp;
+    resp.set_model_name(model_name);
+    resp.set_model_version(model_version);
+    resp.set_id(request_id);
+    if (params != Py_None && PyDict_Check(params)) {
+      PyObject* key;
+      PyObject* value;
+      Py_ssize_t pos = 0;
+      while (PyDict_Next(params, &pos, &key, &value)) {
+        const char* k = PyUnicode_Check(key) ? PyUnicode_AsUTF8(key) : nullptr;
+        if (k == nullptr) continue;
+        SetParam(&(*resp.mutable_parameters())[k], value);
+      }
+    }
+    if (!has_error && outputs != Py_None) {
+      Py_ssize_t n = PySequence_Size(outputs);
+      for (Py_ssize_t i = 0; i < n; ++i) {
+        PyObject* item = PySequence_GetItem(outputs, i);
+        if (item == nullptr || !PyTuple_Check(item) ||
+            PyTuple_GET_SIZE(item) != 5) {
+          Py_XDECREF(item);
+          PyErr_SetString(PyExc_TypeError,
+                          "output item must be a 5-tuple "
+                          "(name, datatype, shape, data, shm)");
+          return nullptr;
+        }
+        PyObject* name = PyTuple_GET_ITEM(item, 0);
+        PyObject* datatype = PyTuple_GET_ITEM(item, 1);
+        PyObject* shape = PyTuple_GET_ITEM(item, 2);
+        PyObject* data = PyTuple_GET_ITEM(item, 3);
+        PyObject* shm = PyTuple_GET_ITEM(item, 4);
+        auto* out = resp.add_outputs();
+        out->set_name(PyUnicode_AsUTF8(name));
+        out->set_datatype(PyUnicode_AsUTF8(datatype));
+        PyObject* shape_fast =
+            PySequence_Fast(shape, "shape must be a sequence");
+        if (shape_fast == nullptr) {
+          Py_DECREF(item);
+          return nullptr;
+        }
+        Py_ssize_t ndim = PySequence_Fast_GET_SIZE(shape_fast);
+        for (Py_ssize_t d = 0; d < ndim; ++d) {
+          out->add_shape(
+              PyLong_AsLongLong(PySequence_Fast_GET_ITEM(shape_fast, d)));
+        }
+        Py_DECREF(shape_fast);
+        if (shm != Py_None) {
+          // Output redirected to shared memory: parameters + empty raw.
+          PyObject* region = PyTuple_GET_ITEM(shm, 0);
+          PyObject* size = PyTuple_GET_ITEM(shm, 1);
+          PyObject* offset = PyTuple_GET_ITEM(shm, 2);
+          auto& p = *out->mutable_parameters();
+          p["shared_memory_region"].set_string_param(
+              PyUnicode_AsUTF8(region));
+          p["shared_memory_byte_size"].set_int64_param(
+              PyLong_AsLongLong(size));
+          long long off = PyLong_AsLongLong(offset);
+          if (off) p["shared_memory_offset"].set_int64_param(off);
+          resp.add_raw_output_contents();
+        } else {
+          Py_buffer view;
+          if (PyObject_GetBuffer(data, &view, PyBUF_C_CONTIGUOUS) != 0) {
+            Py_DECREF(item);
+            return nullptr;
+          }
+          resp.add_raw_output_contents()->assign(
+              static_cast<const char*>(view.buf),
+              static_cast<size_t>(view.len));
+          PyBuffer_Release(&view);
+        }
+        Py_DECREF(item);
+      }
+    }
+    if (streaming) {
+      inference::ModelStreamInferResponse wrapper;
+      if (has_error) {
+        wrapper.set_error_message(error_msg);
+        wrapper.mutable_infer_response()->set_id(request_id);
+      } else {
+        *wrapper.mutable_infer_response() = std::move(resp);
+      }
+      body = wrapper.SerializeAsString();
+    } else {
+      body = resp.SerializeAsString();
+    }
+  }
+
+  // Wire writes are queue-and-return; do them without the GIL anyway since
+  // HPACK/framing of large bodies costs a memcpy or two.
+  Py_BEGIN_ALLOW_THREADS;
+  if (!streaming) {
+    std::lock_guard<std::mutex> lk(fe->mu);
+    auto it = fe->streams.find({conn, stream_id});
+    if (it != fe->streams.end() && !it->second.finished) {
+      it->second.finished = true;
+      it->second.pending--;
+      if (has_error) {
+        SendErrorTrailers(conn, stream_id, it->second.headers_sent, status,
+                          error_msg);
+      } else {
+        if (!it->second.headers_sent) {
+          it->second.headers_sent = true;
+          conn->SendHeaders(stream_id, ResponseHeaders(), false);
+        }
+        conn->SendData(stream_id, FrameMessage(body), false);
+        conn->SendTrailers(stream_id, Trailers(kGrpcOk, ""));
+      }
+    }
+  } else {
+    bool close_stream = false;
+    bool send_headers = false;
+    bool drop = false;
+    {
+      std::lock_guard<std::mutex> lk(fe->mu);
+      auto it = fe->streams.find({conn, stream_id});
+      if (it == fe->streams.end() || it->second.finished) {
+        drop = true;
+      } else {
+        if (!it->second.headers_sent) {
+          it->second.headers_sent = true;
+          send_headers = true;
+        }
+        if (final_flag) {
+          it->second.pending--;
+          if (it->second.end_stream_seen && it->second.pending == 0) {
+            it->second.finished = true;
+            close_stream = true;
+          }
+        }
+      }
+    }
+    if (!drop) {
+      if (send_headers) {
+        conn->SendHeaders(stream_id, ResponseHeaders(), false);
+      }
+      conn->SendData(stream_id, FrameMessage(body), false);
+      if (close_stream) {
+        conn->SendTrailers(stream_id, Trailers(kGrpcOk, ""));
+      }
+    }
+  }
+  Py_END_ALLOW_THREADS;
+  Py_RETURN_NONE;
+}
+
+PyMethodDef kMethods[] = {
+    {"start", Start, METH_VARARGS,
+     "start(host, port, rpc, cancel) -> frontend id"},
+    {"port", Port, METH_VARARGS, "port(id) -> bound TCP port"},
+    {"stop", Stop, METH_VARARGS, "stop(id)"},
+    {"wait_requests", WaitRequests, METH_VARARGS,
+     "wait_requests(id, max_n, timeout_ms) -> [request tuples] | None"},
+    {"complete", Complete, METH_VARARGS,
+     "complete(handle, model, version, request_id, outputs, params, final, "
+     "error, status)"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+struct PyModuleDef kModule = {
+    PyModuleDef_HEAD_INIT, "_native_frontend",
+    "Native h2c gRPC front-end for the client_tpu server.", -1, kMethods,
+    nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+}  // namespace frontend
+}  // namespace ctpu
+
+extern "C" PyMODINIT_FUNC PyInit__native_frontend(void) {
+  ctpu::frontend::ReqBufferType.tp_flags = Py_TPFLAGS_DEFAULT;
+  ctpu::frontend::ReqBufferType.tp_as_buffer =
+      &ctpu::frontend::kReqBufferAsBuffer;
+  ctpu::frontend::ReqBufferType.tp_new = nullptr;  // C++-constructed only
+  if (PyType_Ready(&ctpu::frontend::ReqBufferType) < 0) return nullptr;
+  return PyModule_Create(&ctpu::frontend::kModule);
+}

@@ -1,0 +1,185 @@
+"""Integration tests for the native C++ gRPC front-end.
+
+The default gRPC front-end is the native h2 server (native/frontend/); the
+generic client tests in test_grpc_client.py already run against it. This
+file covers the behaviors specific to the native implementation: wire-level
+compression, large inline tensors (flow-control), mid-run connection churn,
+streaming half-close orderings, and the aio fallback staying available.
+"""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.grpc.aio as aio_grpcclient
+from client_tpu.testing import InProcessServer
+from client_tpu.utils import InferenceServerException
+
+
+@pytest.fixture(scope="module")
+def server():
+    from client_tpu.server.native_frontend import native_available
+
+    if not native_available():
+        pytest.skip("native frontend not built")
+    with InProcessServer(http=False, grpc="native") as s:
+        assert s.grpc_impl == "native"
+        yield s
+
+
+def _simple_inputs(batch=1):
+    in0 = np.arange(16 * batch, dtype=np.int32).reshape(batch, 16)
+    in1 = np.ones([batch, 16], dtype=np.int32)
+    a = grpcclient.InferInput("INPUT0", [batch, 16], "INT32")
+    a.set_data_from_numpy(in0)
+    b = grpcclient.InferInput("INPUT1", [batch, 16], "INT32")
+    b.set_data_from_numpy(in1)
+    return in0, in1, [a, b]
+
+
+def test_gzip_compression(server):
+    with grpcclient.InferenceServerClient(server.grpc_url) as client:
+        in0, in1, inputs = _simple_inputs()
+        result = client.infer(
+            "simple", inputs, compression_algorithm="gzip"
+        )
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+        result = client.infer(
+            "simple", inputs, compression_algorithm="deflate"
+        )
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), in0 - in1)
+
+
+def test_large_inline_tensor(server):
+    """A multi-MB inline tensor exercises inbound AND outbound h2 flow
+    control (window updates both directions)."""
+    with grpcclient.InferenceServerClient(server.grpc_url) as client:
+        data = np.random.rand(1, 1 << 20).astype(np.float32)  # 4 MiB
+        inp = grpcclient.InferInput("INPUT0", list(data.shape), "FP32")
+        inp.set_data_from_numpy(data)
+        result = client.infer("identity_fp32", [inp])
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), data)
+
+
+def test_streaming_after_unary_churn(server):
+    """Regression: a stream whose final response lands BEFORE the client
+    half-close must not resend response headers (grpc kills the transport
+    with 'trailing metadata without end-of-stream')."""
+
+    async def run():
+        async with aio_grpcclient.InferenceServerClient(
+            server.grpc_url
+        ) as c:
+            in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+            in1 = np.ones([1, 16], dtype=np.int32)
+            a = aio_grpcclient.InferInput("INPUT0", [1, 16], "INT32")
+            a.set_data_from_numpy(in0)
+            b = aio_grpcclient.InferInput("INPUT1", [1, 16], "INT32")
+            b.set_data_from_numpy(in1)
+            await asyncio.gather(
+                *[c.infer("simple", [a, b]) for _ in range(8)]
+            )
+            for _ in range(3):
+                values = np.array([5, 6], dtype=np.int32)
+
+                async def requests():
+                    inp = aio_grpcclient.InferInput("IN", [2], "INT32")
+                    inp.set_data_from_numpy(values)
+                    yield {"model_name": "repeat_int32", "inputs": [inp]}
+
+                received = []
+                async for result, error in c.stream_infer(requests()):
+                    assert error is None
+                    received.append(int(result.as_numpy("OUT")[0]))
+                    if result.get_response(as_json=True).get(
+                        "parameters", {}
+                    ).get("triton_final_response", {}).get("bool_param"):
+                        break
+                assert received == [5, 6]
+
+    asyncio.run(run())
+
+
+def test_stream_error_message(server):
+    """Errors on a stream come back as in-band error responses, and the
+    stream keeps serving subsequent requests."""
+
+    async def run():
+        async with aio_grpcclient.InferenceServerClient(
+            server.grpc_url
+        ) as c:
+            async def requests():
+                bad = aio_grpcclient.InferInput("IN", [1], "INT32")
+                bad.set_data_from_numpy(np.array([1], dtype=np.int32))
+                yield {"model_name": "no_such_model", "inputs": [bad]}
+                good = aio_grpcclient.InferInput("IN", [1], "INT32")
+                good.set_data_from_numpy(np.array([42], dtype=np.int32))
+                yield {"model_name": "repeat_int32", "inputs": [good]}
+
+            errors, values = [], []
+            async for result, error in c.stream_infer(requests()):
+                if error is not None:
+                    errors.append(str(error))
+                else:
+                    values.append(int(result.as_numpy("OUT")[0]))
+                    break
+            assert any("no_such_model" in e or "not found" in e.lower()
+                       for e in errors)
+            assert values == [42]
+
+    asyncio.run(run())
+
+
+def test_concurrent_connections_churn(server):
+    """Connections opening/closing mid-run must not lose in-flight
+    requests on other connections (regression: accept/registration race)."""
+    errors = []
+    counts = [0] * 8
+
+    def worker(i):
+        try:
+            with grpcclient.InferenceServerClient(server.grpc_url) as client:
+                in0, in1, inputs = _simple_inputs()
+                for _ in range(20):
+                    result = client.infer("simple", inputs)
+                    np.testing.assert_array_equal(
+                        result.as_numpy("OUTPUT0"), in0 + in1
+                    )
+                    counts[i] += 1
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors
+    assert all(c == 20 for c in counts)
+
+
+def test_unknown_method_unimplemented(server):
+    """An unknown RPC yields UNIMPLEMENTED, not a transport error."""
+    import grpc
+
+    channel = grpc.insecure_channel(server.grpc_url)
+    stub = channel.unary_unary(
+        "/inference.GRPCInferenceService/NoSuchMethod",
+        request_serializer=lambda x: x,
+        response_deserializer=lambda x: x,
+    )
+    with pytest.raises(grpc.RpcError) as err:
+        stub(b"")
+    assert err.value.code() == grpc.StatusCode.UNIMPLEMENTED
+    channel.close()
+
+
+def test_aio_frontend_still_available():
+    """The grpc.aio implementation stays usable via the explicit option."""
+    with InProcessServer(http=False, grpc="aio") as s:
+        assert s.grpc_impl == "aio"
+        with grpcclient.InferenceServerClient(s.grpc_url) as client:
+            assert client.is_server_live()
