@@ -111,7 +111,11 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="use this latency percentile for stability (default: avg)",
     )
-    parser.add_argument("--input-data", default=None, help="JSON data file")
+    parser.add_argument(
+        "--input-data",
+        default=None,
+        help="JSON data file, or a directory of per-input raw files",
+    )
     parser.add_argument(
         "--shared-memory",
         choices=("none", "system", "tpu"),
@@ -237,11 +241,33 @@ async def run(args) -> int:
         metadata = await backend.get_model_metadata(
             args.model_name, args.model_version
         )
+        async def _is_sequence(config, depth=0) -> bool:
+            """Scheduler auto-detection incl. the ensemble composing-model
+            walk (reference model_parser.cc WalkEnsemble): a sequence
+            composing model makes the whole ensemble sequence-controlled."""
+            if "sequence_batching" in config:
+                return True
+            steps = config.get("ensemble_scheduling", {}).get("step", [])
+            if depth >= 8 or not steps:
+                return False
+            for step in steps:
+                try:
+                    sub = await backend.get_model_config(
+                        step.get("model_name", ""), ""
+                    )
+                except Exception:  # noqa: BLE001 - composing unreadable
+                    continue
+                if await _is_sequence(sub, depth + 1):
+                    return True
+            return False
+
+        sequence_model = False
         try:
             config = await backend.get_model_config(
                 args.model_name, args.model_version
             )
             batched = int(config.get("max_batch_size", 0) or 0) > 0
+            sequence_model = await _is_sequence(config)
         except Exception:  # noqa: BLE001 - config extension is optional
             batched = False
         shape_overrides = {}
@@ -254,7 +280,9 @@ async def run(args) -> int:
             shape_overrides=shape_overrides,
             batched=batched,
         )
-        if args.input_data:
+        if args.input_data and os.path.isdir(args.input_data):
+            loader.read_from_dir(args.input_data)
+        elif args.input_data:
             loader.read_from_json(args.input_data)
         else:
             loader.generate_synthetic()
@@ -267,9 +295,9 @@ async def run(args) -> int:
             loader = shm_plane
 
         sequence_manager = None
-        if args.sequence_length > 0:
+        if args.sequence_length > 0 or sequence_model:
             sequence_manager = SequenceManager(
-                length_mean=args.sequence_length
+                length_mean=args.sequence_length or 20
             )
             common_seq = {"num_sequence_slots": args.num_of_sequences}
         else:

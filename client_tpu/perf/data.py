@@ -1,9 +1,11 @@
 """Input-data management for the perf harness.
 
 The reference's DataLoader (reference src/c++/perf_analyzer/data_loader.h:
-41-229) supports synthetic generation, a directory of files, and multi-
-stream JSON corpora; this module covers the same three sources over model
-metadata, producing PerfInferInput sets per (stream, step).
+41-229) supports synthetic generation, multi-stream JSON corpora, and a
+directory of per-input files; this module implements all three
+(:meth:`DataLoader.generate_synthetic`, :meth:`DataLoader.read_from_json`,
+:meth:`DataLoader.read_from_dir`) over model metadata, producing
+PerfInferInput sets per (stream, step).
 """
 
 import base64
@@ -146,6 +148,45 @@ class DataLoader:
             params = [[p[0] for p in params]]
         self._streams = streams
         self._params = params
+
+    def read_from_dir(self, path: str) -> None:
+        """Load a directory of per-input files (reference ReadDataFromDir,
+        data_loader.h:63): each input reads ``<dir>/<input name>`` — raw
+        little-endian bytes validated against the resolved shape for
+        numeric dtypes, the whole file as a single element for BYTES.
+        Produces one stream with one step.
+        """
+        step: Dict[str, np.ndarray] = {}
+        for desc in self._input_descs():
+            name = desc["name"]
+            datatype = desc["datatype"]
+            fpath = os.path.join(path, name)
+            if not os.path.exists(fpath):
+                raise InferenceServerException(
+                    f"input data directory '{path}' has no file for input "
+                    f"'{name}'"
+                )
+            with open(fpath, "rb") as f:
+                raw = f.read()
+            if datatype == "BYTES":
+                step[name] = np.array([raw], dtype=np.object_)
+                continue
+            shape = _resolve_shape(
+                self._batched_shape(desc.get("shape", [])),
+                self._batch_size,
+                name,
+                self._shape_overrides,
+            )
+            np_dtype = triton_to_np_dtype(datatype)
+            expected = int(np.prod(shape)) * np.dtype(np_dtype).itemsize
+            if len(raw) != expected:
+                raise InferenceServerException(
+                    f"file '{fpath}' holds {len(raw)} bytes but input "
+                    f"'{name}' needs {expected} for shape {shape}"
+                )
+            step[name] = np.frombuffer(raw, dtype=np_dtype).reshape(shape)
+        self._streams = [[step]]
+        self._params = [[None]]
 
     def _parse_step(self, step: Dict, descs: Dict) -> Dict[str, np.ndarray]:
         out: Dict[str, np.ndarray] = {}

@@ -141,6 +141,19 @@ def _model_config(core: ServerCore, request):
     proto.model_transaction_policy.decoupled = cfg["model_transaction_policy"][
         "decoupled"
     ]
+    # Scheduler declarations (reference model_parser.cc detection inputs).
+    if "dynamic_batching" in cfg:
+        proto.dynamic_batching.SetInParent()
+    if "sequence_batching" in cfg:
+        proto.sequence_batching.SetInParent()
+    if "ensemble_scheduling" in cfg:
+        for step in cfg["ensemble_scheduling"].get("step", []):
+            entry = proto.ensemble_scheduling.step.add(
+                model_name=step["model_name"],
+                model_version=int(step.get("model_version", -1)),
+            )
+            entry.input_map.update(step.get("input_map", {}))
+            entry.output_map.update(step.get("output_map", {}))
     return pb.ModelConfigResponse(config=proto)
 
 

@@ -54,6 +54,13 @@ class Model:
     # the batcher clamps its power-of-two bucket here so merging can never
     # push a batch past a limit its members individually respect.
     ragged_dim_cap: Optional[int] = None
+    # Scheduler declarations, surfaced through the model-configuration
+    # extension so clients (perf_analyzer's ModelParser, reference
+    # model_parser.cc scheduler-kind detection) can auto-detect how to
+    # drive the model. dynamic_batching is emitted automatically for
+    # batchable models (the core batcher is always on for them).
+    sequence_batching: Optional[Dict[str, Any]] = None
+    ensemble_scheduling: Optional[Dict[str, Any]] = None
 
     def metadata(self) -> Dict[str, Any]:
         return {
@@ -81,7 +88,7 @@ class Model:
         }
 
     def config(self) -> Dict[str, Any]:
-        return {
+        config = {
             "name": self.name,
             "platform": self.platform,
             "backend": self.backend,
@@ -104,6 +111,20 @@ class Model:
             ],
             "model_transaction_policy": {"decoupled": self.decoupled},
         }
+        if self.sequence_batching is not None:
+            config["sequence_batching"] = dict(self.sequence_batching)
+        elif self.max_batch_size > 1 and self.ensemble_scheduling is None:
+            # Batchable models ride the core's dynamic batcher; declare it
+            # the way Triton configs do so clients can see the scheduler.
+            # Ensembles never declare it (the proto's scheduling_choice is
+            # a oneof — both protocols must report the same scheduler).
+            config["dynamic_batching"] = {}
+        if self.ensemble_scheduling is not None:
+            config["ensemble_scheduling"] = {
+                "step": [dict(s) for s in
+                         self.ensemble_scheduling.get("step", [])]
+            }
+        return config
 
     def labels(self, output_name: str) -> Optional[List[str]]:
         """Classification labels for an output (None if unlabeled)."""

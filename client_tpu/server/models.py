@@ -167,6 +167,122 @@ class RepeatModel(Model):
             }
 
 
+class SequenceAccumulatorModel(Model):
+    """Stateful sequence model: OUTPUT = running sum of INPUT per sequence.
+
+    Declares ``sequence_batching`` in its config so clients auto-detect the
+    scheduler kind (reference model_parser.cc sequence detection; the
+    perf harness then drives it with sequence_id/start/end control
+    parameters instead of needing a --sequence-model flag). State is keyed
+    by the request's ``sequence_id`` parameter; ``sequence_start`` resets,
+    ``sequence_end`` evicts.
+    """
+
+    max_batch_size = 0
+    sequence_batching: Dict[str, Any] = {}
+    inputs = [{"name": "INPUT", "datatype": "INT32", "shape": [1]}]
+    outputs = [{"name": "OUTPUT", "datatype": "INT32", "shape": [1]}]
+
+    def __init__(self, name: str = "sequence_accumulate"):
+        import threading
+
+        self.name = name
+        self._totals: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def execute(self, inputs, parameters):
+        if "INPUT" not in inputs:
+            raise InferenceServerException(
+                f"model '{self.name}' expects input INPUT"
+            )
+        seq_id = int(parameters.get("sequence_id", 0))
+        if seq_id == 0:
+            raise InferenceServerException(
+                f"model '{self.name}' is a sequence model; requests need a "
+                "non-zero sequence_id"
+            )
+        value = int(np.asarray(inputs["INPUT"]).reshape(-1)[0])
+        with self._lock:
+            if parameters.get("sequence_start"):
+                self._totals[seq_id] = 0
+            if seq_id not in self._totals:
+                raise InferenceServerException(
+                    f"sequence {seq_id} has no open state; send "
+                    "sequence_start first"
+                )
+            # int32 wraparound semantics: load generators feed arbitrary
+            # int32 values, and a running sum must not overflow numpy's
+            # bounds checking.
+            self._totals[seq_id] = (self._totals[seq_id] + value) & 0xFFFFFFFF
+            total = self._totals[seq_id]
+            if parameters.get("sequence_end"):
+                del self._totals[seq_id]
+        return {
+            "OUTPUT": np.array([total], dtype=np.uint32).astype(np.int32)
+        }
+
+
+class EnsembleModel(Model):
+    """Composes other models into a pipeline (Triton ensembles).
+
+    The config declares ``ensemble_scheduling.step`` entries with Triton's
+    semantics: each step's ``input_map`` maps the composing model's input
+    name to an ensemble-scope tensor name, ``output_map`` maps its outputs
+    into ensemble scope. Steps execute in order inside ONE server-side
+    execution — intermediate tensors never touch the wire (the reason
+    ensembles exist; reference docs architecture.md ensemble section).
+    """
+
+    platform = "ensemble"
+    backend = "ensemble"
+
+    def __init__(self, name, repository, inputs, outputs, steps,
+                 max_batch_size: int = 0):
+        self.name = name
+        self._repository = repository
+        self.inputs = inputs
+        self.outputs = outputs
+        self.max_batch_size = max_batch_size
+        self._steps = steps
+        self.ensemble_scheduling = {"step": steps}
+
+    def warmup(self) -> None:
+        for step in self._steps:
+            model = self._repository.get(step["model_name"])
+            if model.decoupled:
+                raise InferenceServerException(
+                    f"ensemble '{self.name}' cannot compose decoupled "
+                    f"model '{model.name}'"
+                )
+
+    def execute(self, inputs, parameters):
+        pool = dict(inputs)
+        for step in self._steps:
+            model = self._repository.get(step["model_name"])
+            sub_inputs = {}
+            for comp_name, ens_name in step["input_map"].items():
+                if ens_name not in pool:
+                    raise InferenceServerException(
+                        f"ensemble '{self.name}' step "
+                        f"'{step['model_name']}' needs tensor '{ens_name}' "
+                        "which no prior step produced"
+                    )
+                sub_inputs[comp_name] = pool[ens_name]
+            with model.placement():
+                # Request parameters flow to every composing model
+                # (sequence controls, sampling knobs, ...), matching the
+                # core's behavior on non-ensemble paths.
+                raw = model.execute(sub_inputs, parameters)
+            for comp_name, ens_name in step["output_map"].items():
+                if comp_name not in raw:
+                    raise InferenceServerException(
+                        f"composing model '{step['model_name']}' produced "
+                        f"no output '{comp_name}'"
+                    )
+                pool[ens_name] = raw[comp_name]
+        return {o["name"]: pool[o["name"]] for o in self.outputs}
+
+
 def register_builtin_models(repository) -> None:
     """Install the fixture models into a repository."""
     repository.add_model(AddSubModel())
@@ -174,3 +290,36 @@ def register_builtin_models(repository) -> None:
     repository.add_model(IdentityModel("identity_bf16", "BF16"))
     repository.add_model(BytesIdentityModel())
     repository.add_model(RepeatModel())
+    repository.add_model(SequenceAccumulatorModel())
+    # Demo ensemble: simple -> simple. OUTPUT0 = 2*INPUT0, OUTPUT1 =
+    # 2*INPUT1 ((a+b)+(a-b), (a+b)-(a-b)).
+    repository.add_model(
+        EnsembleModel(
+            "add_sub_chain",
+            repository,
+            inputs=[
+                {"name": "INPUT0", "datatype": "INT32", "shape": [16]},
+                {"name": "INPUT1", "datatype": "INT32", "shape": [16]},
+            ],
+            outputs=[
+                {"name": "OUTPUT0", "datatype": "INT32", "shape": [16]},
+                {"name": "OUTPUT1", "datatype": "INT32", "shape": [16]},
+            ],
+            steps=[
+                {
+                    "model_name": "simple",
+                    "input_map": {"INPUT0": "INPUT0", "INPUT1": "INPUT1"},
+                    "output_map": {"OUTPUT0": "mid0", "OUTPUT1": "mid1"},
+                },
+                {
+                    "model_name": "simple",
+                    "input_map": {"INPUT0": "mid0", "INPUT1": "mid1"},
+                    "output_map": {
+                        "OUTPUT0": "OUTPUT0",
+                        "OUTPUT1": "OUTPUT1",
+                    },
+                },
+            ],
+            max_batch_size=64,
+        )
+    )

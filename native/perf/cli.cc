@@ -107,7 +107,9 @@ std::string Usage() {
       "  --latency-threshold MS      stop sweep past this latency\n"
       "  --percentile P              latency percentile for stability\n"
       "  --warmup-request-period S   warmup seconds before measuring\n"
-      "  --input-data FILE           input-data JSON\n"
+      "  --input-data FILE|DIR       input-data JSON, or a directory of\n"
+      "                              per-input files (raw bytes; BYTES =\n"
+      "                              whole file as one element)\n"
       "  --shape NAME:D1,D2,...      shape override for dynamic dims\n"
       "  --shared-memory MODE        none | system | tpu\n"
       "  --output-shared-memory-size BYTES  redirect outputs to per-worker\n"
@@ -116,7 +118,9 @@ std::string Usage() {
       "  --sequence-length N         sequence length (default 20)\n"
       "  --sequence-length-variation P  +-pct length variation\n"
       "  --num-of-sequences N        concurrent sequences (default 4)\n"
-      "  --sequence-model            treat model as sequence model\n"
+      "  --sequence-model            DEPRECATED override: sequence models\n"
+      "                              are auto-detected from the model\n"
+      "                              config's sequence_batching\n"
       "  --request-parameter N:V:T   custom request parameter\n"
       "  --max-threads N             open-loop pool size (default 32)\n"
       "  --random-seed N             seed for schedules/data\n"

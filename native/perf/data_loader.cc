@@ -251,6 +251,54 @@ Error DataLoader::ReadFromJson(const std::string& path) {
   return Error::Success();
 }
 
+Error DataLoader::ReadFromDir(const std::string& path) {
+  // One file per input, named after the input (reference ReadDataFromDir,
+  // data_loader.h:63): raw little-endian bytes for numeric dtypes
+  // (validated against the resolved shape), whole-file single element for
+  // BYTES. Produces one stream with one step.
+  StepData step;
+  for (const TensorDesc& desc : parser_->Inputs()) {
+    const std::string file = path + "/" + desc.name;
+    std::ifstream f(file, std::ios::binary);
+    if (!f) {
+      return Error("input data directory '" + path + "' has no file for "
+                   "input '" + desc.name + "'");
+    }
+    std::stringstream ss;
+    ss << f.rdbuf();
+    std::string raw = ss.str();
+    TensorData tensor;
+    tensor.name = desc.name;
+    tensor.datatype = desc.datatype;
+    if (desc.datatype == "BYTES") {
+      // whole file = one string element
+      tensor.shape = {1};
+      uint32_t len = (uint32_t)raw.size();
+      tensor.bytes.append(reinterpret_cast<const char*>(&len), 4);
+      tensor.bytes.append(raw);
+    } else {
+      CTPU_RETURN_IF_ERROR(ResolveShape(desc, &tensor.shape));
+      int64_t elem = DtypeByteSize(desc.datatype);
+      if (elem <= 0) {
+        return Error("cannot load dtype '" + desc.datatype +
+                     "' from a directory file");
+      }
+      int64_t expected = ShapeNumElements(tensor.shape) * elem;
+      if ((int64_t)raw.size() != expected) {
+        return Error("file '" + file + "' holds " +
+                     std::to_string(raw.size()) + " bytes but input '" +
+                     desc.name + "' needs " + std::to_string(expected) +
+                     " for its shape");
+      }
+      tensor.bytes = std::move(raw);
+    }
+    step.tensors.push_back(std::move(tensor));
+  }
+  streams_.clear();
+  streams_.push_back({std::move(step)});
+  return Error::Success();
+}
+
 const StepData& DataLoader::GetStep(size_t stream, size_t step) const {
   const auto& s = streams_[stream % streams_.size()];
   return s[step % s.size()];

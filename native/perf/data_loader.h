@@ -45,6 +45,11 @@ class DataLoader {
   // Load the --input-data JSON document (reference ReadDataFromJSON).
   Error ReadFromJson(const std::string& path);
 
+  // Load a directory of per-input files (reference ReadDataFromDir,
+  // data_loader.h:63): raw bytes per numeric input, whole file as a single
+  // BYTES element.
+  Error ReadFromDir(const std::string& path);
+
   size_t StreamCount() const { return streams_.size(); }
   size_t StepCount(size_t stream) const {
     return stream < streams_.size() ? streams_[stream].size() : 0;

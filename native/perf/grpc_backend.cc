@@ -63,7 +63,24 @@ Error GrpcClientBackend::ModelConfig(json::Value* config,
   if (mc.has_sequence_batching()) obj["sequence_batching"] = json::Object{};
   if (mc.has_dynamic_batching()) obj["dynamic_batching"] = json::Object{};
   if (mc.has_ensemble_scheduling()) {
-    obj["ensemble_scheduling"] = json::Object{};
+    // Full step list so ModelParser can walk the composing models
+    // (reference model_parser.cc GetEnsembleSchedulerType).
+    json::Array steps;
+    for (const auto& s : mc.ensemble_scheduling().step()) {
+      json::Object step;
+      step["model_name"] = s.model_name();
+      step["model_version"] = json::Value(int64_t{s.model_version()});
+      json::Object imap;
+      for (const auto& kv : s.input_map()) imap[kv.first] = kv.second;
+      json::Object omap;
+      for (const auto& kv : s.output_map()) omap[kv.first] = kv.second;
+      step["input_map"] = json::Value(std::move(imap));
+      step["output_map"] = json::Value(std::move(omap));
+      steps.push_back(json::Value(std::move(step)));
+    }
+    json::Object sched;
+    sched["step"] = json::Value(std::move(steps));
+    obj["ensemble_scheduling"] = json::Value(std::move(sched));
   }
   if (mc.has_model_transaction_policy()) {
     json::Object policy;

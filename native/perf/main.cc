@@ -1,6 +1,8 @@
 // perf_analyzer entry point (reference main.cc:33-39 + the object wiring
 // of PerfAnalyzer::CreateAnalyzerObjects, perf_analyzer.cc:72-289).
 
+#include <sys/stat.h>
+
 #include <csignal>
 #include <cstdio>
 #include <fstream>
@@ -84,7 +86,13 @@ int main(int argc, char** argv) {
   DataLoader loader(&parser, params.batch_size, params.shape_overrides,
                     params.random_seed);
   if (!params.input_data_file.empty()) {
-    err = loader.ReadFromJson(params.input_data_file);
+    struct stat st;
+    if (stat(params.input_data_file.c_str(), &st) == 0 &&
+        S_ISDIR(st.st_mode)) {
+      err = loader.ReadFromDir(params.input_data_file);
+    } else {
+      err = loader.ReadFromJson(params.input_data_file);
+    }
   } else {
     err = loader.GenerateSynthetic();
   }
@@ -141,6 +149,13 @@ int main(int argc, char** argv) {
     std::printf("model: %s (max_batch_size %ld, %zu inputs)\n",
                 parser.ModelName().c_str(), (long)parser.MaxBatchSize(),
                 parser.Inputs().size());
+    if (!parser.ComposingModels().empty()) {
+      std::printf("ensemble composing models:");
+      for (const auto& name : parser.ComposingModels()) {
+        std::printf(" %s", name.c_str());
+      }
+      std::printf("%s\n", parser.IsDecoupled() ? " (decoupled)" : "");
+    }
   }
 
   // Multi-process rendezvous: all ranks set up first, then cross the

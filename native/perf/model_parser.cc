@@ -47,6 +47,51 @@ Error ModelParser::Init(ClientBackend* backend, const std::string& model_name,
   if (policy.IsObject() && policy["decoupled"].IsBool()) {
     decoupled_ = policy["decoupled"].AsBool();
   }
+  if (scheduler_ == SchedulerType::ENSEMBLE) {
+    CTPU_RETURN_IF_ERROR(WalkEnsemble(backend, config, 0));
+  }
+  return Error::Success();
+}
+
+// Walks ensemble composing models (reference model_parser.cc
+// GetEnsembleSchedulerType + composing-model walk, used at
+// perf_analyzer.cc:147-148): a sequence or decoupled composing model makes
+// the whole ensemble behave that way from the client's perspective, so the
+// harness must auto-drive it accordingly.
+Error ModelParser::WalkEnsemble(ClientBackend* backend,
+                                const json::Value& config, int depth) {
+  if (depth > 8) {
+    return Error("ensemble nesting exceeds depth 8 (cycle?)");
+  }
+  const json::Value& sched = config["ensemble_scheduling"];
+  if (!sched.IsObject() || !sched["step"].IsArray()) return Error::Success();
+  for (const auto& step : sched["step"].AsArray()) {
+    if (!step.IsObject() || !step["model_name"].IsString()) continue;
+    const std::string name = step["model_name"].AsString();
+    bool seen = false;
+    for (const auto& c : composing_models_) seen = seen || c == name;
+    if (seen) continue;
+    composing_models_.push_back(name);
+    json::Value sub_config;
+    Error err = backend->ModelConfig(&sub_config, name, "");
+    if (!err.IsOk()) {
+      return Error("ensemble composing model '" + name +
+                   "' is not loadable: " + err.Message());
+    }
+    const json::Value& sub_policy = sub_config["model_transaction_policy"];
+    if (sub_policy.IsObject() && sub_policy["decoupled"].IsBool() &&
+        sub_policy["decoupled"].AsBool()) {
+      decoupled_ = true;
+    }
+    if (sub_config.Has("sequence_batching")) {
+      // A sequence composing model means requests must carry sequence
+      // controls end to end.
+      scheduler_ = SchedulerType::SEQUENCE;
+    }
+    if (sub_config.Has("ensemble_scheduling")) {
+      CTPU_RETURN_IF_ERROR(WalkEnsemble(backend, sub_config, depth + 1));
+    }
+  }
   return Error::Success();
 }
 

@@ -31,14 +31,23 @@ class ModelParser {
   bool IsDecoupled() const { return decoupled_; }
   const std::vector<TensorDesc>& Inputs() const { return inputs_; }
   const std::vector<TensorDesc>& Outputs() const { return outputs_; }
+  // Ensembles: composing model names discovered by the config walk
+  // (transitively, nested ensembles included).
+  const std::vector<std::string>& ComposingModels() const {
+    return composing_models_;
+  }
 
  private:
+  Error WalkEnsemble(ClientBackend* backend, const json::Value& config,
+                     int depth);
+
   std::string model_name_;
   int64_t max_batch_size_ = 0;
   SchedulerType scheduler_ = SchedulerType::NONE;
   bool decoupled_ = false;
   std::vector<TensorDesc> inputs_;
   std::vector<TensorDesc> outputs_;
+  std::vector<std::string> composing_models_;
 };
 
 }  // namespace perf

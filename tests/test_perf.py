@@ -516,3 +516,42 @@ def test_cli_end_to_end_tpu_shm(tmp_path, capsys):
             assert not status.get("regions")
         finally:
             client.close()
+
+
+def test_dataloader_directory(tmp_path):
+    """--input-data <dir>: per-input raw files (reference ReadDataFromDir,
+    data_loader.h:63)."""
+    data = np.arange(8, dtype=np.float32)
+    (tmp_path / "IN").write_bytes(data.tobytes())
+    loader = DataLoader(META)
+    loader.read_from_dir(str(tmp_path))
+    inputs = loader.get_inputs()
+    assert len(inputs) == 1
+    np.testing.assert_array_equal(inputs[0].data, data.reshape(8))
+
+    # wrong byte count is a hard error, not silent truncation
+    (tmp_path / "IN").write_bytes(data.tobytes()[:-4])
+    loader2 = DataLoader(META)
+    with pytest.raises(InferenceServerException, match="28 bytes"):
+        loader2.read_from_dir(str(tmp_path))
+
+    # missing file names the input
+    loader3 = DataLoader(
+        {"name": "m", "inputs": [{"name": "MISSING", "datatype": "FP32",
+                                  "shape": [1]}]}
+    )
+    with pytest.raises(InferenceServerException, match="MISSING"):
+        loader3.read_from_dir(str(tmp_path))
+
+
+def test_dataloader_directory_bytes(tmp_path):
+    """BYTES inputs read the whole file as one element."""
+    (tmp_path / "TEXT").write_bytes(b"hello world")
+    meta = {
+        "name": "m",
+        "inputs": [{"name": "TEXT", "datatype": "BYTES", "shape": [1]}],
+    }
+    loader = DataLoader(meta)
+    loader.read_from_dir(str(tmp_path))
+    inputs = loader.get_inputs()
+    assert inputs[0].data[0] == b"hello world"
