@@ -181,41 +181,16 @@ def _bench_inprocess(server) -> float:
     return future.result(timeout=300)
 
 
-def _device_platform_usable(timeout_s: float = 120.0) -> bool:
-    """Probe (in a subprocess) that the default jax platform can compile
-    and run a trivial program. The TPU relay in some environments wedges
-    after an unclean client exit; bench must still emit its JSON line."""
-    code = (
-        "import jax, jax.numpy as jnp;"
-        "jax.block_until_ready(jax.jit(lambda a: a + 1)(jnp.zeros((4, 4))))"
-    )
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True,
-            timeout=timeout_s,
-        )
-        return proc.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
-
-
 def main() -> int:
-    if not _device_platform_usable() and "CLIENT_TPU_BENCH_CPU" not in os.environ:
-        # A wedged TPU relay hangs ANY jax backend init in this process
-        # (the relay hook intercepts backend lookup), so an in-process
-        # platform switch is not enough: re-exec with the relay hook's
-        # trigger env removed and the platform pinned to CPU.
+    from tools.bench_common import REEXEC_SENTINEL, device_platform, reexec_on_cpu
+
+    if not device_platform() and REEXEC_SENTINEL not in os.environ:
         print(
             "bench: default jax platform unusable (TPU relay stuck?); "
             "re-executing on CPU",
             file=sys.stderr,
         )
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["CLIENT_TPU_BENCH_CPU"] = "1"
-        os.execve(sys.executable, [sys.executable, __file__], env)
+        reexec_on_cpu([__file__])
 
     from client_tpu.testing import InProcessServer
 

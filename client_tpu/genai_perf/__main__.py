@@ -1,0 +1,6 @@
+"""``python -m client_tpu.genai_perf`` entry point."""
+
+from client_tpu.genai_perf.main import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

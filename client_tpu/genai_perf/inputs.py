@@ -34,6 +34,57 @@ def synthesize_prompt(
     return " ".join(rng.choice(_WORDS) for _ in range(count))
 
 
+def load_dataset_prompts(
+    path: str, dataset_format: str = "auto", limit: int = 0
+) -> List[str]:
+    """Read prompts from a local dataset export (offline twin of the
+    reference's hosted-dataset fetchers, reference genai-perf
+    llm_inputs/llm_inputs.py:149-360).
+
+    Supported record schemas (JSON list or JSONL of objects):
+
+    - ``openorca``: ``system_prompt`` + ``question`` concatenated
+      (reference OPEN_ORCA handling);
+    - ``cnn_dailymail``: ``article`` (reference CNN_DAILY_MAIL handling);
+    - ``plain``: ``prompt`` or ``text`` field;
+    - ``auto`` (default): pick per record from the fields present.
+    """
+    records: List[Dict] = []
+    with open(path, encoding="utf-8-sig") as f:  # tolerate a UTF-8 BOM
+        text = f.read()
+    body = text.lstrip()
+    if body.startswith("["):
+        records = json.loads(body)
+    else:  # JSONL
+        for line in body.splitlines():
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    prompts: List[str] = []
+    for rec in records:
+        if not isinstance(rec, dict):
+            continue
+        prompt = None
+        if dataset_format in ("openorca", "auto") and "question" in rec:
+            system = rec.get("system_prompt", "")
+            prompt = (system + " " + rec["question"]).strip()
+        elif dataset_format in ("cnn_dailymail", "auto") and "article" in rec:
+            prompt = rec["article"]
+        elif dataset_format in ("plain", "auto"):
+            prompt = rec.get("prompt") or rec.get("text")
+        if prompt:
+            prompts.append(prompt)
+        if limit and len(prompts) >= limit:
+            break
+    if not prompts:
+        raise ValueError(
+            f"dataset file '{path}' yielded no prompts for format "
+            f"'{dataset_format}' (expected question/article/prompt/text "
+            "fields)"
+        )
+    return prompts
+
+
 def create_llm_inputs(
     path: str,
     num_prompts: int = 100,
@@ -47,16 +98,31 @@ def create_llm_inputs(
     seed: int = 0,
     model: str = "",
     streaming: bool = False,
+    dataset_path: Optional[str] = None,
+    dataset_format: str = "auto",
 ) -> Dict:
-    """Write a perf-harness input-data JSON of synthetic LLM requests.
+    """Write a perf-harness input-data JSON of LLM requests.
 
-    Returns the generated document (also written to ``path``).
+    Prompts are synthetic by default; with ``dataset_path`` they come from
+    a local dataset export instead (OpenOrca/CNN_DailyMail/plain schemas,
+    cycled when shorter than ``num_prompts``). Returns the generated
+    document (also written to ``path``).
     """
     rng = random.Random(seed)
     tokenizer = tokenizer or SyntheticTokenizer()
+    dataset = (
+        load_dataset_prompts(dataset_path, dataset_format)
+        if dataset_path
+        else None
+    )
     entries: List[Dict] = []
-    for _ in range(num_prompts):
-        prompt = synthesize_prompt(rng, input_tokens_mean, input_tokens_stddev)
+    for i in range(num_prompts):
+        if dataset is not None:
+            prompt = dataset[i % len(dataset)]
+        else:
+            prompt = synthesize_prompt(
+                rng, input_tokens_mean, input_tokens_stddev
+            )
         if output_format == "kserve-ids":
             # length follows the sampled distribution — no clipping to the
             # mean, or above-mean prefill lengths would never occur

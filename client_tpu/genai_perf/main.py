@@ -159,6 +159,18 @@ def build_parser() -> argparse.ArgumentParser:
         " v1/chat/completions or v1/completions)",
     )
     parser.add_argument("--input-name", default="INPUT_IDS")
+    parser.add_argument(
+        "--input-dataset",
+        default=None,
+        help="local dataset export (JSON/JSONL) to draw prompts from "
+        "instead of synthesizing (OpenOrca/CNN_DailyMail/plain schemas)",
+    )
+    parser.add_argument(
+        "--dataset-format",
+        default="auto",
+        choices=["auto", "openorca", "cnn_dailymail", "plain"],
+        help="record schema of --input-dataset",
+    )
     parser.add_argument("--num-prompts", type=int, default=50)
     parser.add_argument("--synthetic-input-tokens-mean", type=int, default=64)
     parser.add_argument(
@@ -166,7 +178,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument("--output-tokens-mean", type=int, default=16)
     parser.add_argument("--output-tokens-stddev", type=float, default=0.0)
-    parser.add_argument("--tokenizer", default="synthetic")
+    parser.add_argument(
+        "--tokenizer",
+        default="bpe",
+        help="'bpe' (bundled real subword tokenizer, default), "
+        "'synthetic' (word-hash), or a local HF tokenizer name",
+    )
     parser.add_argument("--concurrency", type=int, default=1)
     parser.add_argument("--request-rate", type=float, default=None)
     parser.add_argument("--measurement-interval", "-p", type=int, default=4000)
@@ -212,8 +229,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     from client_tpu.perf import cli as perf_cli
 
     args = build_parser().parse_args(argv)
+    from client_tpu.genai_perf.logging import getLogger, init_logging
+
+    init_logging(verbose=args.verbose)
+    log = getLogger("main")
     artifact_dir = args.artifact_dir or tempfile.mkdtemp(prefix="genai_perf_")
     os.makedirs(artifact_dir, exist_ok=True)
+    log.info("artifact dir: %s", artifact_dir)
     inputs_path = os.path.join(artifact_dir, "llm_inputs.json")
     export_path = os.path.join(artifact_dir, args.profile_export_file)
 
@@ -240,6 +262,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
 
     tokenizer = get_tokenizer(args.tokenizer)
+    log.info(
+        "generating %d prompts (%s) with tokenizer %s",
+        args.num_prompts,
+        args.input_dataset or "synthetic",
+        type(tokenizer).__name__,
+    )
     create_llm_inputs(
         inputs_path,
         num_prompts=args.num_prompts,
@@ -252,7 +280,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         tokenizer=tokenizer,
         model=args.model,
         streaming=openai and args.streaming,
+        dataset_path=args.input_dataset,
+        dataset_format=args.dataset_format,
     )
+    log.info("profiling model %s at %s", args.model, args.url)
 
     # Build the perf-harness invocation (reference wrapper.Profiler role).
     perf_args = [

@@ -1,15 +1,22 @@
 """Tokenizer abstraction for genai-perf.
 
 The reference wraps HF AutoTokenizer (reference genai-perf tokenizer.py:
-1-49). Here a HF tokenizer is used when one is available locally, with a
-hashing fallback tokenizer for hermetic/zero-egress environments (the
-in-repo decode model consumes raw token ids, so the tokenizer's job is
-synthetic-prompt token accounting, not fidelity).
+1-49). This framework is built for zero-egress TPU environments, so the
+default is a REAL byte-level BPE tokenizer bundled with the package
+(assets/bpe8k.json, trained offline with the HF ``tokenizers`` library —
+same algorithm family as Llama/GPT tokenizers), giving deterministic
+subword token accounting without any network access. A named HF tokenizer
+is used when its files are available locally; the crc32 word-hash
+tokenizer remains as an explicit last-resort fallback.
 """
 
+import os
 from typing import List, Optional
 
 DEFAULT_TOKENIZER = "hf-internal-testing/llama-tokenizer"
+_BUNDLED_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "assets", "bpe8k.json"
+)
 
 
 class SyntheticTokenizer:
@@ -17,7 +24,9 @@ class SyntheticTokenizer:
 
     Uses crc32 rather than ``hash()`` so ids are stable across interpreter
     processes (PYTHONHASHSEED randomizes str hashing) — input corpora must
-    be reproducible run-to-run.
+    be reproducible run-to-run. Token counts equal word counts, which
+    undercounts vs subword tokenizers (see tests/test_genai_perf.py
+    fidelity fixture); prefer the bundled BPE.
     """
 
     def __init__(self, vocab_size: int = 32000):
@@ -38,21 +47,62 @@ class SyntheticTokenizer:
         return {"input_ids": self.encode(text)}
 
 
+class BundledBPETokenizer:
+    """The in-repo byte-level BPE tokenizer (assets/bpe8k.json).
+
+    A real subword tokenizer: merges learned by the standard BPE trainer,
+    byte-level pre-tokenization (every input encodable, no OOV). Token
+    counts behave like production LLM tokenizers (≈1.2-1.8 tokens/word on
+    English prose) rather than the 1 token/word of the hash fallback.
+    """
+
+    def __init__(self, path: str = _BUNDLED_PATH):
+        from tokenizers import Tokenizer
+
+        self._tok = Tokenizer.from_file(path)
+        self.vocab_size = self._tok.get_vocab_size()
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok.encode(text).ids
+
+    def decode(self, ids) -> str:
+        return self._tok.decode(list(ids))
+
+    def __call__(self, text: str):
+        return {"input_ids": self.encode(text)}
+
+
 def get_tokenizer(name: Optional[str] = None, vocab_size: int = 32000):
-    """Load a HF tokenizer if possible, else the synthetic fallback."""
-    if name in (None, "", "synthetic"):
+    """Resolve a tokenizer by name.
+
+    - None/""/"bpe"/"default": the bundled BPE (real subword counting);
+    - "synthetic": the crc32 word-hash fallback;
+    - anything else: HF AutoTokenizer with local files, falling back to
+      the bundled BPE (with a warning) when unavailable.
+    """
+    import sys
+
+    if name == "synthetic":
         return SyntheticTokenizer(vocab_size)
+    if name in (None, "", "bpe", "default"):
+        try:
+            return BundledBPETokenizer()
+        except Exception as e:  # noqa: BLE001 - tokenizers lib missing
+            print(
+                f"genai-perf: warning: bundled BPE unavailable ({e}); "
+                "falling back to the synthetic word-hash tokenizer",
+                file=sys.stderr,
+            )
+            return SyntheticTokenizer(vocab_size)
     try:
         from transformers import AutoTokenizer
 
         return AutoTokenizer.from_pretrained(name, local_files_only=True)
     except Exception as e:  # noqa: BLE001 - offline environments
-        import sys
-
         print(
             f"genai-perf: warning: could not load tokenizer '{name}' "
-            f"({e}); falling back to the synthetic tokenizer — token "
-            "counts will not match the requested tokenizer",
+            f"({e}); using the bundled BPE tokenizer — counts are real "
+            "subword counts but not identical to the requested tokenizer",
             file=sys.stderr,
         )
-        return SyntheticTokenizer(vocab_size)
+        return get_tokenizer("bpe", vocab_size)

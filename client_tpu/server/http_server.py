@@ -222,6 +222,40 @@ class HttpServer:
                 f'tpu_inference_fail_count{{model="{model}"}} '
                 f'{stats["fail"]["count"]}'
             )
+        # Device duty cycle: fraction of wall time the server spent inside
+        # model executions since the previous scrape — the TPU swap-in for
+        # the reference's nv_gpu_utilization (SURVEY §5; reference
+        # metrics.h:37-42). Computed from the statistics extension's
+        # compute_infer counters, so it needs no device-side profiler.
+        import time as _time
+
+        # Only device-placed models count toward TPU duty: host-placed
+        # models (device == "cpu", e.g. the tiny 'simple' fixture) execute
+        # on the host and must not report the TPU as busy.
+        device_models = set()
+        for entry in self.core.repository.index():
+            try:
+                model = self.core.repository.get(entry["name"])
+            except Exception:  # noqa: BLE001 - racing an unload
+                continue
+            if getattr(model, "device", "") != "cpu":
+                device_models.add(entry["name"])
+        total_compute_ns = sum(
+            ms["inference_stats"]["compute_infer"]["ns"]
+            for ms in self.core.statistics()["model_stats"]
+            if ms["name"] in device_models
+        )
+        now_ns = _time.monotonic_ns()
+        prev = getattr(self, "_metrics_prev", None)
+        duty = 0.0
+        if prev is not None and now_ns > prev[0]:
+            duty = (total_compute_ns - prev[1]) / (now_ns - prev[0])
+            duty = max(0.0, min(1.0, duty))
+        self._metrics_prev = (now_ns, total_compute_ns)
+        lines.append("# TYPE tpu_duty_cycle gauge")
+        lines.append(f"tpu_duty_cycle {duty:.6f}")
+        lines.append("# TYPE tpu_device_compute_ns_total counter")
+        lines.append(f"tpu_device_compute_ns_total {total_compute_ns}")
         lines.append("# TYPE tpu_memory_used_bytes gauge")
         try:
             import jax

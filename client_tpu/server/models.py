@@ -247,12 +247,22 @@ class EnsembleModel(Model):
         self.ensemble_scheduling = {"step": steps}
 
     def warmup(self) -> None:
+        produced = {i["name"] for i in self.inputs}
         for step in self._steps:
             model = self._repository.get(step["model_name"])
             if model.decoupled:
                 raise InferenceServerException(
                     f"ensemble '{self.name}' cannot compose decoupled "
                     f"model '{model.name}'"
+                )
+            produced.update(step["output_map"].values())
+        # Output coverage is statically checkable: every declared ensemble
+        # output must be produced by some step (or be a passthrough input).
+        for out in self.outputs:
+            if out["name"] not in produced:
+                raise InferenceServerException(
+                    f"ensemble '{self.name}' declares output "
+                    f"'{out['name']}' but no step's output_map produces it"
                 )
 
     def execute(self, inputs, parameters):
@@ -280,6 +290,12 @@ class EnsembleModel(Model):
                         f"no output '{comp_name}'"
                     )
                 pool[ens_name] = raw[comp_name]
+        missing = [o["name"] for o in self.outputs if o["name"] not in pool]
+        if missing:
+            raise InferenceServerException(
+                f"ensemble '{self.name}' produced no tensor for declared "
+                f"outputs {missing}"
+            )
         return {o["name"]: pool[o["name"]] for o in self.outputs}
 
 

@@ -34,6 +34,18 @@ Error CreateSharedMemoryRegion(const std::string& shm_key, size_t byte_size,
 
 Error MapSharedMemory(int shm_fd, size_t offset, size_t byte_size,
                       void** shm_addr) {
+  // Validate against the object size first: POSIX lets mmap succeed past
+  // the end of the object and then SIGBUS on access — surface a clean
+  // error instead (reference shm_utils maps only within the region).
+  struct stat st;
+  if (fstat(shm_fd, &st) != 0) {
+    return Errno("unable to stat shared memory fd");
+  }
+  if ((off_t)(offset + byte_size) > st.st_size) {
+    return Error("shared memory map of " + std::to_string(byte_size) +
+                 " bytes at offset " + std::to_string(offset) +
+                 " exceeds the region size " + std::to_string(st.st_size));
+  }
   void* addr = mmap(nullptr, byte_size, PROT_READ | PROT_WRITE, MAP_SHARED,
                     shm_fd, (off_t)offset);
   if (addr == MAP_FAILED) {

@@ -183,7 +183,7 @@ void ServerConnection::ReaderLoop() {
     AppendFrame(&out, kFrameWindowUpdate, 0, 0, wu, 4);
     {
       std::lock_guard<std::mutex> lk(mu_);
-      wq_.push_front(WriteItem{ItemKind::kRaw, 0, std::move(out), {}, false, 0});
+      wq_.push_back(WriteItem{ItemKind::kRaw, 0, std::move(out), {}, false, 0});
     }
     wq_cv_.notify_all();
   }
@@ -436,7 +436,10 @@ void ServerConnection::MaybeSendWindowUpdates(uint32_t stream_id) {
 }
 
 void ServerConnection::EnqueueRawLocked(std::string frame) {
-  wq_.push_front(WriteItem{ItemKind::kRaw, 0, std::move(frame), {}, false, 0});
+  // FIFO, not front-priority: the connection's FIRST frame must be our
+  // SETTINGS (the server preface, RFC 7540 §3.5) — a SETTINGS ack jumping
+  // the queue ahead of it is a protocol violation strict peers reject.
+  wq_.push_back(WriteItem{ItemKind::kRaw, 0, std::move(frame), {}, false, 0});
 }
 
 void ServerConnection::EnqueueRaw(std::string frame) {

@@ -212,6 +212,11 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
     } else if (arg == "--request-distribution") {
       CTPU_RETURN_IF_ERROR(need(i));
       params->request_distribution = next();
+      if (params->request_distribution != "constant" &&
+          params->request_distribution != "poisson") {
+        return Error("--request-distribution must be constant or poisson, "
+                     "got '" + params->request_distribution + "'");
+      }
     } else if (arg == "--measurement-interval" || arg == "-p") {
       CTPU_RETURN_IF_ERROR(need(i));
       params->measurement_interval_ms = std::stod(next());
@@ -323,6 +328,10 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
   }
   if (params->protocol != "http" && params->protocol != "grpc") {
     return Error("-i must be http or grpc, got '" + params->protocol + "'");
+  }
+  if (params->batch_size < 1) {
+    return Error("-b must be >= 1, got " +
+                 std::to_string(params->batch_size));
   }
   if (params->service_kind != "kserve" && params->service_kind != "openai" &&
       params->service_kind != "local") {

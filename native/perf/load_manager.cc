@@ -134,12 +134,19 @@ void RequestRateManager::StartCustomIntervals(std::vector<double> intervals_s) {
 }
 
 void RequestRateManager::SchedulerLoop(std::function<double()> next_interval) {
-  uint64_t next_fire = RequestTimers::Now();
+  auto now_ns = [this] {
+    return now_fn_ ? now_fn_() : RequestTimers::Now();
+  };
+  uint64_t next_fire = now_ns();
   while (!stopping_.load()) {
-    uint64_t now = RequestTimers::Now();
+    uint64_t now = now_ns();
     if (now < next_fire) {
-      std::this_thread::sleep_for(
-          std::chrono::nanoseconds(next_fire - now));
+      if (sleep_until_fn_) {
+        sleep_until_fn_(next_fire);
+      } else {
+        std::this_thread::sleep_for(
+            std::chrono::nanoseconds(next_fire - now));
+      }
     } else {
       slip_ns_.fetch_add(now - next_fire);
     }

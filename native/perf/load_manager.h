@@ -148,6 +148,16 @@ class RequestRateManager : public LoadManager {
 
   uint64_t ScheduleSlipNs() const { return slip_ns_.load(); }
 
+  // Test seam: fake clock for schedule-adherence tests (the role of the
+  // reference's mocked schedule clock in test_request_rate_manager.cc).
+  // `now` returns fake steady-clock ns; `sleep_until` is invoked instead
+  // of a real sleep when the schedule is ahead of now().
+  void SetClockForTest(std::function<uint64_t()> now,
+                       std::function<void(uint64_t)> sleep_until) {
+    now_fn_ = std::move(now);
+    sleep_until_fn_ = std::move(sleep_until);
+  }
+
  private:
   void StartPool();
   void SchedulerLoop(std::function<double()> next_interval);
@@ -155,6 +165,8 @@ class RequestRateManager : public LoadManager {
 
   Distribution distribution_;
   std::mt19937_64 rng_;
+  std::function<uint64_t()> now_fn_;
+  std::function<void(uint64_t)> sleep_until_fn_;
   std::thread scheduler_;
   std::vector<std::thread> pool_;
   std::deque<uint64_t> fire_times_ns_;  // absolute steady-clock ns

@@ -296,6 +296,7 @@ int main(int argc, char** argv) {
   }
   std::printf("\n%s", ConsoleReport(experiments).c_str());
 
+  TpuMetrics tpu_metrics;
   if (metrics) {
     auto summary = metrics->Summary();
     if (!summary.empty()) {
@@ -305,10 +306,25 @@ int main(int argc, char** argv) {
                     kv.second.min, kv.second.avg, kv.second.max);
       }
     }
+    tpu_metrics = metrics->Typed();
+    if (tpu_metrics.any) {
+      std::printf("\nTPU metrics:\n");
+      std::printf("  duty cycle avg/max: %.4f / %.4f\n",
+                  tpu_metrics.duty_cycle.avg, tpu_metrics.duty_cycle.max);
+      if (tpu_metrics.hbm_used_bytes.samples > 0) {
+        std::printf("  HBM used avg/max: %.1f / %.1f MB (limit %.1f MB)\n",
+                    tpu_metrics.hbm_used_bytes.avg / 1e6,
+                    tpu_metrics.hbm_used_bytes.max / 1e6,
+                    tpu_metrics.hbm_limit_bytes.max / 1e6);
+      }
+      std::printf("  device compute during run: %.1f ms\n",
+                  tpu_metrics.device_compute_ns_delta / 1e6);
+    }
   }
 
   if (!params.csv_file.empty()) {
-    err = WriteCsv(experiments, params.csv_file);
+    err = WriteCsv(experiments, params.csv_file,
+                   tpu_metrics.any ? &tpu_metrics : nullptr);
     if (!err.IsOk()) return fail(err, "write csv");
   }
   if (!params.profile_export_file.empty()) {

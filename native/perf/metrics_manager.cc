@@ -2,6 +2,7 @@
 
 #include <algorithm>
 #include <cstdlib>
+#include <cstring>
 
 #include "http_client.h"
 
@@ -111,6 +112,56 @@ void MetricsManager::StopThread() {
 std::map<std::string, MetricSummary> MetricsManager::Summary() {
   std::lock_guard<std::mutex> lk(mu_);
   return summary_;
+}
+
+namespace {
+
+// Accumulates per-device gauge summaries into one (summing min/avg/max —
+// right for per-device byte gauges whose devices are scraped together).
+void SumInto(MetricSummary* into, const MetricSummary& s) {
+  if (into->samples == 0) {
+    *into = s;
+    return;
+  }
+  into->min += s.min;
+  into->max += s.max;
+  into->avg += s.avg;
+  into->last += s.last;
+  into->samples = std::max(into->samples, s.samples);
+}
+
+bool KeyIs(const std::string& key, const char* name) {
+  // Matches "name" or "name{labels}".
+  size_t n = strlen(name);
+  return key.compare(0, n, name) == 0 &&
+         (key.size() == n || key[n] == '{');
+}
+
+}  // namespace
+
+TpuMetrics MetricsManager::Typed() {
+  TpuMetrics out;
+  for (const auto& kv : Summary()) {
+    const std::string& key = kv.first;
+    const MetricSummary& s = kv.second;
+    if (KeyIs(key, "tpu_duty_cycle")) {
+      out.duty_cycle = s;
+      out.any = true;
+    } else if (KeyIs(key, "tpu_memory_used_bytes")) {
+      SumInto(&out.hbm_used_bytes, s);
+      out.any = true;
+    } else if (KeyIs(key, "tpu_memory_limit_bytes")) {
+      SumInto(&out.hbm_limit_bytes, s);
+      out.any = true;
+    } else if (KeyIs(key, "tpu_memory_utilization")) {
+      if (s.max > out.hbm_utilization.max) out.hbm_utilization = s;
+      out.any = true;
+    } else if (KeyIs(key, "tpu_device_compute_ns_total")) {
+      out.device_compute_ns_delta = s.max - s.min;
+      out.any = true;
+    }
+  }
+  return out;
 }
 
 }  // namespace perf

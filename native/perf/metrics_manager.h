@@ -30,6 +30,19 @@ struct MetricSummary {
   size_t samples = 0;
 };
 
+// Typed TPU metrics mapped from the scraped gauges — the TPU swap-in for
+// the reference's typed GPU utilization/power/memory records
+// (reference metrics.h:37-42; SURVEY §5 names the duty-cycle/HBM
+// equivalents). `any` is false when the endpoint exposed none of them.
+struct TpuMetrics {
+  MetricSummary duty_cycle;        // tpu_duty_cycle (0..1)
+  MetricSummary hbm_used_bytes;    // tpu_memory_used_bytes, summed/devices
+  MetricSummary hbm_limit_bytes;   // tpu_memory_limit_bytes, summed/devices
+  MetricSummary hbm_utilization;   // tpu_memory_utilization, max device
+  double device_compute_ns_delta = 0.0;  // tpu_device_compute_ns_total rise
+  bool any = false;
+};
+
 class MetricsManager {
  public:
   // url: "host:port", path: e.g. "/metrics".
@@ -45,6 +58,10 @@ class MetricsManager {
   // Aggregates over all samples since Start(). Key is the full metric line
   // key incl. labels (e.g. tpu_memory_used_bytes{device="0"}).
   std::map<std::string, MetricSummary> Summary();
+
+  // The typed TPU view over Summary() (reference MetricsManager hands
+  // typed Metrics records to the reporter).
+  TpuMetrics Typed();
 
   // Parses one Prometheus text document into key->value (exposed for tests).
   static std::map<std::string, double> ParsePrometheus(

@@ -89,6 +89,9 @@ class MockClientBackend : public ClientBackend {
   }
 
   // -- accounting (read by tests) -----------------------------------------
+  // Runtime latency override (0 = use options_.latency_us); lets tests
+  // flip the simulated latency mid-run (stability-window edge cases).
+  std::atomic<uint64_t> latency_us_override{0};
   std::atomic<uint64_t> request_count{0};
   std::atomic<int> inflight{0};
   std::atomic<int> max_inflight{0};
@@ -128,9 +131,11 @@ inline Error MockBackendContext::Infer(
   }
   record->start_ns = RequestTimers::Now();
   int responses = std::max(1, b->options_.responses_per_request);
+  uint64_t lat = b->latency_us_override.load();
+  if (lat == 0) lat = b->options_.latency_us;
   for (int i = 0; i < responses; ++i) {
     std::this_thread::sleep_for(
-        std::chrono::microseconds(b->options_.latency_us / responses));
+        std::chrono::microseconds(lat / responses));
     record->response_ns.push_back(RequestTimers::Now());
   }
   record->end_ns = RequestTimers::Now();

@@ -93,7 +93,7 @@ std::string DetailedReport(const ProfileExperiment& experiment) {
 }
 
 Error WriteCsv(const std::vector<ProfileExperiment>& experiments,
-               const std::string& path) {
+               const std::string& path, const TpuMetrics* tpu) {
   std::ofstream f(path);
   if (!f) return Error("cannot open CSV report file '" + path + "'");
   std::vector<int> percentile_cols;
@@ -111,7 +111,18 @@ Error WriteCsv(const std::vector<ProfileExperiment>& experiments,
     << ",Inferences/Second,Client Send/Recv,Server Queue,"
        "Server Compute Input,Server Compute Infer,Server Compute Output";
   for (int q : percentile_cols) f << ",p" << q << " latency";
-  f << ",Avg latency\n";
+  f << ",Avg latency";
+  // Typed TPU metric columns (reference report_writer.cc appends the GPU
+  // utilization/power/memory columns the same way).
+  // "Run" prefix: the values are aggregated over the WHOLE run (the
+  // metrics poller is process-lifetime), not per sweep point — labeled so
+  // a multi-point sweep is not misread as per-experiment utilization.
+  const bool with_tpu = tpu != nullptr && tpu->any;
+  if (with_tpu) {
+    f << ",Run Avg TPU Duty Cycle,Run Max TPU Duty Cycle,"
+         "Run Avg HBM Used (MB),HBM Limit (MB),Run Max HBM Utilization";
+  }
+  f << "\n";
   for (const auto& e : experiments) {
     const PerfStatus& s = e.status;
     char buf[256];
@@ -124,8 +135,17 @@ Error WriteCsv(const std::vector<ProfileExperiment>& experiments,
       std::snprintf(buf, sizeof(buf), ",%.0f", Pct(s, q));
       f << buf;
     }
-    std::snprintf(buf, sizeof(buf), ",%.0f\n", s.avg_latency_us);
+    std::snprintf(buf, sizeof(buf), ",%.0f", s.avg_latency_us);
     f << buf;
+    if (with_tpu) {
+      std::snprintf(buf, sizeof(buf), ",%.4f,%.4f,%.1f,%.1f,%.4f",
+                    tpu->duty_cycle.avg, tpu->duty_cycle.max,
+                    tpu->hbm_used_bytes.avg / 1e6,
+                    tpu->hbm_limit_bytes.max / 1e6,
+                    tpu->hbm_utilization.max);
+      f << buf;
+    }
+    f << "\n";
   }
   return Error::Success();
 }

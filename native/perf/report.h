@@ -7,6 +7,7 @@
 #include <string>
 #include <vector>
 
+#include "metrics_manager.h"
 #include "profiler.h"
 
 namespace ctpu {
@@ -14,8 +15,10 @@ namespace perf {
 
 std::string ConsoleReport(const std::vector<ProfileExperiment>& experiments);
 std::string DetailedReport(const ProfileExperiment& experiment);
+// `tpu` (optional): typed TPU metrics appended as CSV columns (reference
+// report_writer.cc GPU columns).
 Error WriteCsv(const std::vector<ProfileExperiment>& experiments,
-               const std::string& path);
+               const std::string& path, const TpuMetrics* tpu = nullptr);
 Error ExportProfile(const std::vector<ProfileExperiment>& experiments,
                     const std::string& path,
                     const std::string& service_kind = "kserve",

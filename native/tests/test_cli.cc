@@ -1,0 +1,325 @@
+// CLI option-table tests (reference test_command_line_parser.cc role:
+// every option parses into the expected field, invalid combinations are
+// rejected with a message).
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "cli.h"
+#include "test_framework.h"
+
+using namespace ctpu;
+using namespace ctpu::perf;
+
+namespace {
+
+// Builds argv from a list and parses.
+Error Parse(std::vector<std::string> args, PAParams* params) {
+  std::vector<std::string> full = {"perf_analyzer"};
+  full.insert(full.end(), args.begin(), args.end());
+  std::vector<char*> argv;
+  for (auto& a : full) argv.push_back(const_cast<char*>(a.c_str()));
+  return ParseArgs((int)argv.size(), argv.data(), params);
+}
+
+Error ParseSimple(std::vector<std::string> extra, PAParams* params) {
+  std::vector<std::string> args = {"-m", "simple"};
+  args.insert(args.end(), extra.begin(), extra.end());
+  return Parse(args, params);
+}
+
+}  // namespace
+
+TEST_CASE("cli: model name is required") {
+  PAParams p;
+  Error err = Parse({"-u", "host:80"}, &p);
+  CHECK(!err.IsOk());
+  CHECK(err.Message().find("-m") != std::string::npos);
+}
+
+TEST_CASE("cli: defaults") {
+  PAParams p;
+  CHECK_OK(ParseSimple({}, &p));
+  CHECK_EQ(p.model_name, "simple");
+  CHECK_EQ(p.url, "localhost:8000");
+  CHECK_EQ(p.protocol, "http");
+  CHECK_EQ(p.batch_size, 1);
+  CHECK_NEAR(p.measurement_interval_ms, 5000, 1e-9);
+  CHECK_NEAR(p.stability_percentage, 10, 1e-9);
+  CHECK_EQ(p.max_trials, (size_t)10);
+  CHECK_EQ(p.shared_memory, "none");
+  CHECK_EQ(p.sequence_length, 20);
+  CHECK_EQ(p.num_of_sequences, (size_t)4);
+  CHECK_EQ(p.max_threads, (size_t)32);
+  CHECK(!p.streaming);
+  CHECK(!p.verbose);
+}
+
+TEST_CASE("cli: url and model version") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"-u", "1.2.3.4:9000", "-x", "7"}, &p));
+  CHECK_EQ(p.url, "1.2.3.4:9000");
+  CHECK(p.url_set);
+  CHECK_EQ(p.model_version, "7");
+}
+
+TEST_CASE("cli: protocol http/grpc accepted, others rejected") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"-i", "grpc"}, &p));
+  CHECK_EQ(p.protocol, "grpc");
+  PAParams p2;
+  Error err = ParseSimple({"-i", "carrier-pigeon"}, &p2);
+  CHECK(!err.IsOk());
+  CHECK(err.Message().find("http or grpc") != std::string::npos);
+}
+
+TEST_CASE("cli: concurrency range start:end:step") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"--concurrency-range", "2:16:2"}, &p));
+  CHECK(p.has_concurrency_range);
+  CHECK_EQ(p.concurrency_start, (size_t)2);
+  CHECK_EQ(p.concurrency_end, (size_t)16);
+  CHECK_EQ(p.concurrency_step, (size_t)2);
+}
+
+TEST_CASE("cli: concurrency single value") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"--concurrency-range", "8"}, &p));
+  CHECK_EQ(p.concurrency_start, (size_t)8);
+  CHECK_EQ(p.concurrency_end, (size_t)8);
+}
+
+TEST_CASE("cli: request rate range") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"--request-rate-range", "100:400:100"}, &p));
+  CHECK(p.has_request_rate_range);
+  CHECK_NEAR(p.rate_start, 100, 1e-9);
+  CHECK_NEAR(p.rate_end, 400, 1e-9);
+  CHECK_NEAR(p.rate_step, 100, 1e-9);
+}
+
+TEST_CASE("cli: request distribution constant/poisson") {
+  PAParams p;
+  CHECK_OK(ParseSimple(
+      {"--request-rate-range", "10", "--request-distribution", "poisson"},
+      &p));
+  CHECK_EQ(p.request_distribution, "poisson");
+  PAParams p2;
+  Error err = ParseSimple(
+      {"--request-rate-range", "10", "--request-distribution", "uniform"},
+      &p2);
+  CHECK(!err.IsOk());
+}
+
+TEST_CASE("cli: periodic concurrency range + request period") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"--periodic-concurrency-range", "1:8:1",
+                        "--request-period", "5"},
+                       &p));
+  CHECK(p.has_periodic_range);
+  CHECK_EQ(p.periodic_start, (size_t)1);
+  CHECK_EQ(p.periodic_end, (size_t)8);
+  CHECK_EQ(p.request_period, (size_t)5);
+}
+
+TEST_CASE("cli: measurement knobs") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"--measurement-interval", "750",
+                        "--stability-percentage", "25",
+                        "--max-trials", "3",
+                        "--latency-threshold", "90",
+                        "--percentile", "95"},
+                       &p));
+  CHECK_NEAR(p.measurement_interval_ms, 750, 1e-9);
+  CHECK_NEAR(p.stability_percentage, 25, 1e-9);
+  CHECK_EQ(p.max_trials, (size_t)3);
+  CHECK_NEAR(p.latency_threshold_ms, 90, 1e-9);
+  CHECK_EQ(p.percentile, 95);
+}
+
+TEST_CASE("cli: shape overrides accumulate") {
+  PAParams p;
+  CHECK_OK(ParseSimple(
+      {"--shape", "IN:3,224,224", "--shape", "MASK:128"}, &p));
+  REQUIRE(p.shape_overrides.count("IN") == 1);
+  CHECK_EQ(p.shape_overrides["IN"].size(), (size_t)3);
+  CHECK_EQ(p.shape_overrides["IN"][1], 224);
+  REQUIRE(p.shape_overrides.count("MASK") == 1);
+  CHECK_EQ(p.shape_overrides["MASK"][0], 128);
+}
+
+TEST_CASE("cli: malformed shape rejected") {
+  PAParams p;
+  Error err = ParseSimple({"--shape", "no-colon"}, &p);
+  CHECK(!err.IsOk());
+}
+
+TEST_CASE("cli: shared memory modes") {
+  for (const char* mode : {"none", "system", "tpu"}) {
+    PAParams p;
+    CHECK_OK(ParseSimple({"--shared-memory", mode}, &p));
+    CHECK_EQ(p.shared_memory, mode);
+  }
+  PAParams p;
+  Error err = ParseSimple({"--shared-memory", "cuda"}, &p);
+  CHECK(!err.IsOk());
+}
+
+TEST_CASE("cli: output shared memory size") {
+  PAParams p;
+  CHECK_OK(ParseSimple(
+      {"--shared-memory", "system", "--output-shared-memory-size", "65536"},
+      &p));
+  CHECK_EQ(p.output_shared_memory_size, (size_t)65536);
+}
+
+TEST_CASE("cli: streaming requires grpc or openai") {
+  PAParams p;
+  Error err = ParseSimple({"--streaming"}, &p);  // http kserve: invalid
+  CHECK(!err.IsOk());
+  PAParams p2;
+  CHECK_OK(ParseSimple({"--streaming", "-i", "grpc"}, &p2));
+  CHECK(p2.streaming);
+}
+
+TEST_CASE("cli: sequence options") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"--sequence-length", "40",
+                        "--sequence-length-variation", "10",
+                        "--num-of-sequences", "9",
+                        "--sequence-model"},
+                       &p));
+  CHECK_EQ(p.sequence_length, 40);
+  CHECK_NEAR(p.sequence_length_variation, 10, 1e-9);
+  CHECK_EQ(p.num_of_sequences, (size_t)9);
+  CHECK(p.force_sequences);
+}
+
+TEST_CASE("cli: request parameters accumulate typed values") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"--request-parameter", "max_tokens:64:int",
+                        "--request-parameter", "greedy:true:bool"},
+                       &p));
+  CHECK_EQ(p.request_parameters.size(), (size_t)2);
+  CHECK(p.request_parameters.count("max_tokens") == 1);
+}
+
+TEST_CASE("cli: input data file and batch size") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"--input-data", "/tmp/x.json", "-b", "4"}, &p));
+  CHECK_EQ(p.input_data_file, "/tmp/x.json");
+  CHECK_EQ(p.batch_size, 4);
+}
+
+TEST_CASE("cli: report files and json summary") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"-f", "out.csv",
+                        "--profile-export-file", "prof.json",
+                        "--json-summary"},
+                       &p));
+  CHECK_EQ(p.csv_file, "out.csv");
+  CHECK_EQ(p.profile_export_file, "prof.json");
+  CHECK(p.json_summary);
+}
+
+TEST_CASE("cli: service kinds") {
+  PAParams p;
+  CHECK_OK(ParseSimple(
+      {"--service-kind", "openai", "--endpoint", "v1/completions",
+       "--input-data", "x.json"},
+      &p));
+  CHECK_EQ(p.service_kind, "openai");
+  CHECK_EQ(p.endpoint, "v1/completions");
+  PAParams p2;
+  Error err = ParseSimple({"--service-kind", "bogus"}, &p2);
+  CHECK(!err.IsOk());
+}
+
+TEST_CASE("cli: openai requires input data") {
+  PAParams p;
+  Error err = ParseSimple({"--service-kind", "openai"}, &p);
+  CHECK(!err.IsOk());
+  CHECK(err.Message().find("--input-data") != std::string::npos);
+}
+
+TEST_CASE("cli: metrics collection options") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"--collect-metrics",
+                        "--metrics-url", "host:8000/metrics",
+                        "--metrics-interval", "250"},
+                       &p));
+  CHECK(p.collect_metrics);
+  CHECK_EQ(p.metrics_url, "host:8000/metrics");
+  CHECK_NEAR(p.metrics_interval_ms, 250, 1e-9);
+}
+
+TEST_CASE("cli: distributed run options") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"--world-size", "4", "--rank", "2",
+                        "--coordinator", "10.0.0.1:29000"},
+                       &p));
+  CHECK_EQ(p.world_size, 4);
+  CHECK_EQ(p.rank, 2);
+  CHECK_EQ(p.coordinator, "10.0.0.1:29000");
+}
+
+TEST_CASE("cli: misc knobs") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"--max-threads", "12", "--random-seed", "99",
+                        "--warmup-request-period", "2", "-v"},
+                       &p));
+  CHECK_EQ(p.max_threads, (size_t)12);
+  CHECK_EQ(p.random_seed, (uint64_t)99);
+  CHECK_NEAR(p.warmup_s, 2, 1e-9);
+  CHECK(p.verbose);
+}
+
+TEST_CASE("cli: unknown flag is an error naming the flag") {
+  PAParams p;
+  Error err = ParseSimple({"--no-such-flag"}, &p);
+  CHECK(!err.IsOk());
+  CHECK(err.Message().find("--no-such-flag") != std::string::npos);
+}
+
+TEST_CASE("cli: flag missing its value is an error") {
+  PAParams p;
+  Error err = ParseSimple({"--concurrency-range"}, &p);
+  CHECK(!err.IsOk());
+}
+
+TEST_CASE("cli: usage text covers every documented flag") {
+  std::string usage = Usage();
+  for (const char* flag :
+       {"-m", "-u", "-i", "-b", "--concurrency-range",
+        "--request-rate-range", "--request-intervals",
+        "--periodic-concurrency-range", "--measurement-interval",
+        "--stability-percentage", "--max-trials", "--latency-threshold",
+        "--percentile", "--input-data", "--shape", "--shared-memory",
+        "--output-shared-memory-size", "--streaming", "--sequence-length",
+        "--num-of-sequences", "--request-parameter", "--max-threads",
+        "--random-seed", "--profile-export-file", "--json-summary",
+        "--service-kind", "--world-size", "--rank", "--coordinator",
+        "--collect-metrics", "--metrics-url", "--metrics-interval"}) {
+    CHECK(usage.find(flag) != std::string::npos);
+  }
+}
+
+TEST_CASE("cli: request intervals replay file") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"--request-intervals", "/tmp/iv.txt"}, &p));
+  CHECK_EQ(p.request_intervals_file, "/tmp/iv.txt");
+}
+
+TEST_CASE("cli: local service kind with zoo models") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"--service-kind", "local", "--local-zoo-models"}, &p));
+  CHECK_EQ(p.service_kind, "local");
+  CHECK(p.local_zoo);
+}
+
+TEST_CASE("cli: batch size must be positive") {
+  PAParams p;
+  Error err = ParseSimple({"-b", "0"}, &p);
+  // 0 rows per request can never produce a valid KServe batch
+  CHECK(!err.IsOk() || p.batch_size >= 1);
+}

@@ -1,0 +1,177 @@
+// Directory input-data loading (reference ReadDataFromDir,
+// data_loader.h:63) + profiler stability-window edge cases.
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <thread>
+
+#include "data_loader.h"
+#include "mock_backend.h"
+#include "model_parser.h"
+#include "profiler.h"
+#include "test_framework.h"
+
+using namespace ctpu;
+using namespace ctpu::perf;
+
+namespace {
+
+struct DirFixture {
+  std::string path;
+
+  DirFixture() {
+    char tmpl[] = "/tmp/ctpu_dirdata_XXXXXX";
+    path = mkdtemp(tmpl);
+  }
+  ~DirFixture() {
+    std::remove((path + "/IN").c_str());
+    std::remove((path + "/TEXT").c_str());
+    rmdir(path.c_str());
+  }
+  void Write(const std::string& name, const std::string& bytes) {
+    std::ofstream f(path + "/" + name, std::ios::binary);
+    f.write(bytes.data(), (std::streamsize)bytes.size());
+  }
+};
+
+ModelParser MockParser(std::shared_ptr<MockClientBackend>* out) {
+  *out = std::make_shared<MockClientBackend>(MockClientBackend::Options());
+  ModelParser parser;
+  CHECK_OK(parser.Init(out->get(), "mock", ""));
+  return parser;
+}
+
+}  // namespace
+
+TEST_CASE("data dir: per-input raw file loads with exact byte validation") {
+  std::shared_ptr<MockClientBackend> mock;
+  ModelParser parser = MockParser(&mock);  // mock model: IN FP32 [8]
+  DirFixture dir;
+  std::string bytes(8 * 4, '\0');
+  for (int i = 0; i < 8; ++i) {
+    float v = (float)i;
+    memcpy(&bytes[i * 4], &v, 4);
+  }
+  dir.Write("IN", bytes);
+  DataLoader loader(&parser, 1);
+  CHECK_OK(loader.ReadFromDir(dir.path));
+  CHECK_EQ(loader.StreamCount(), (size_t)1);
+  CHECK_EQ(loader.StepCount(0), (size_t)1);
+  const StepData& step = loader.GetStep(0, 0);
+  REQUIRE(step.tensors.size() == 1);
+  CHECK_EQ(step.tensors[0].name, "IN");
+  CHECK_EQ(step.tensors[0].bytes, bytes);
+}
+
+TEST_CASE("data dir: wrong byte count is a hard error naming the file") {
+  std::shared_ptr<MockClientBackend> mock;
+  ModelParser parser = MockParser(&mock);
+  DirFixture dir;
+  dir.Write("IN", "short");
+  DataLoader loader(&parser, 1);
+  Error err = loader.ReadFromDir(dir.path);
+  CHECK(!err.IsOk());
+  CHECK(err.Message().find("IN") != std::string::npos);
+  CHECK(err.Message().find("5 bytes") != std::string::npos);
+}
+
+TEST_CASE("data dir: missing input file names the input") {
+  std::shared_ptr<MockClientBackend> mock;
+  ModelParser parser = MockParser(&mock);
+  DirFixture dir;  // empty
+  DataLoader loader(&parser, 1);
+  Error err = loader.ReadFromDir(dir.path);
+  CHECK(!err.IsOk());
+  CHECK(err.Message().find("IN") != std::string::npos);
+}
+
+// -- profiler stability edge cases ------------------------------------------
+
+namespace {
+
+struct ProfHarness {
+  std::shared_ptr<MockClientBackend> mock;
+  std::shared_ptr<ClientBackend> backend;
+  ModelParser parser;
+  std::unique_ptr<DataLoader> loader;
+  std::unique_ptr<InferDataManager> data;
+  LoadConfig config;
+
+  explicit ProfHarness(uint64_t latency_us) {
+    MockClientBackend::Options options;
+    options.latency_us = latency_us;
+    mock = std::make_shared<MockClientBackend>(options);
+    backend = mock;
+    CHECK_OK(parser.Init(mock.get(), "mock", ""));
+    loader.reset(new DataLoader(&parser, 1));
+    CHECK_OK(loader->GenerateSynthetic());
+    data.reset(new InferDataManager(loader.get()));
+    config.model_name = "mock";
+    config.max_threads = 4;
+  }
+};
+
+}  // namespace
+
+TEST_CASE("profiler: oscillating latency exhausts max_trials and reports "
+          "unstable") {
+  ProfHarness h(500);
+  ConcurrencyManager manager(h.backend, h.data.get(), h.config);
+  ProfilerConfig config;
+  config.measurement_interval_s = 0.04;
+  config.stability_pct = 0.5;  // band so tight oscillation never settles
+  config.max_trials = 3;
+  InferenceProfiler profiler(&manager, config);
+  std::atomic<bool> stop{false};
+  std::thread flipper([&] {
+    bool fast = true;
+    while (!stop.load()) {
+      h.mock->latency_us_override.store(fast ? 200 : 4000);
+      fast = !fast;
+      std::this_thread::sleep_for(std::chrono::milliseconds(15));
+    }
+  });
+  CHECK_OK(profiler.ProfileConcurrencyRange(&manager, 2, 2, 1));
+  stop.store(true);
+  flipper.join();
+  REQUIRE(profiler.Experiments().size() == 1);
+  CHECK(!profiler.Experiments()[0].stable);
+}
+
+TEST_CASE("profiler: a wide stability band settles in few windows") {
+  ProfHarness h(300);
+  ConcurrencyManager manager(h.backend, h.data.get(), h.config);
+  ProfilerConfig config;
+  config.measurement_interval_s = 0.05;
+  config.stability_pct = 500.0;  // everything is "stable"
+  config.max_trials = 10;
+  InferenceProfiler profiler(&manager, config);
+  CHECK_OK(profiler.ProfileConcurrencyRange(&manager, 2, 2, 1));
+  REQUIRE(profiler.Experiments().size() == 1);
+  CHECK(profiler.Experiments()[0].stable);
+  CHECK(profiler.Experiments()[0].status.throughput > 0);
+}
+
+TEST_CASE("profiler: early-exit flag stops after the current window") {
+  ProfHarness h(500);
+  ConcurrencyManager manager(h.backend, h.data.get(), h.config);
+  std::atomic<bool> early{true};  // raised before the run starts
+  ProfilerConfig config;
+  config.measurement_interval_s = 0.05;
+  config.stability_pct = 0.01;  // would never stabilize on its own
+  config.max_trials = 50;
+  config.early_exit = &early;
+  InferenceProfiler profiler(&manager, config);
+  auto t0 = std::chrono::steady_clock::now();
+  CHECK_OK(profiler.ProfileConcurrencyRange(&manager, 2, 2, 1));
+  auto elapsed = std::chrono::duration_cast<std::chrono::milliseconds>(
+                     std::chrono::steady_clock::now() - t0)
+                     .count();
+  // 50 trials x 50ms would be 2.5s; early exit must cut that short.
+  CHECK(elapsed < 1000);
+}

@@ -1,0 +1,78 @@
+// Typed TPU metric mapping + Prometheus parse edge cases (reference
+// metrics.h:37-42 typed records, metrics_manager.h:45-92).
+#include "metrics_manager.h"
+#include "test_framework.h"
+
+using namespace ctpu;
+using namespace ctpu::perf;
+
+TEST_CASE("prometheus parse: comments, labels, and floats") {
+  auto m = MetricsManager::ParsePrometheus(
+      "# HELP tpu_duty_cycle busy fraction\n"
+      "# TYPE tpu_duty_cycle gauge\n"
+      "tpu_duty_cycle 0.75\n"
+      "tpu_memory_used_bytes{device=\"0\"} 1048576\n"
+      "tpu_memory_used_bytes{device=\"1\"} 2097152\n"
+      "weird_metric 1e3\n");
+  CHECK_EQ(m.size(), (size_t)4);
+  CHECK_NEAR(m["tpu_duty_cycle"], 0.75, 1e-9);
+  CHECK_NEAR(m["tpu_memory_used_bytes{device=\"0\"}"], 1048576, 1e-9);
+  CHECK_NEAR(m["weird_metric"], 1000, 1e-9);
+}
+
+TEST_CASE("prometheus parse: malformed lines are skipped, not fatal") {
+  auto m = MetricsManager::ParsePrometheus(
+      "ok_metric 5\n"
+      "no_value_here\n"
+      "bad_value abc\n"
+      "\n"
+      "trailing_ok 7\n");
+  CHECK_NEAR(m["ok_metric"], 5, 1e-9);
+  CHECK_NEAR(m["trailing_ok"], 7, 1e-9);
+  CHECK_EQ(m.count("no_value_here"), (size_t)0);
+}
+
+namespace {
+
+// Builds a MetricsManager with a canned summary by scraping nothing —
+// instead drive Typed() through the public surface: feed ParsePrometheus
+// outputs through a locally-built summary via a subclass-free trick:
+// (Typed() reads Summary(), which is private state) — so these tests
+// exercise Typed() through a real Start()/scrape would need a server;
+// instead validate the mapping rules on a manager that never started by
+// constructing the summary through repeated ParsePrometheus + manual
+// aggregation mirroring Loop()'s update rule. To keep this honest, the
+// aggregation helper below IS the documented update rule.
+MetricSummary Agg(std::initializer_list<double> samples) {
+  MetricSummary s;
+  for (double v : samples) {
+    if (s.samples == 0) {
+      s.min = s.max = v;
+    } else {
+      s.min = std::min(s.min, v);
+      s.max = std::max(s.max, v);
+    }
+    s.avg = (s.avg * s.samples + v) / (s.samples + 1);
+    s.last = v;
+    s.samples++;
+  }
+  return s;
+}
+
+}  // namespace
+
+TEST_CASE("metric summary aggregation: min/avg/max/last") {
+  MetricSummary s = Agg({2.0, 4.0, 6.0});
+  CHECK_NEAR(s.min, 2.0, 1e-9);
+  CHECK_NEAR(s.max, 6.0, 1e-9);
+  CHECK_NEAR(s.avg, 4.0, 1e-9);
+  CHECK_NEAR(s.last, 6.0, 1e-9);
+  CHECK_EQ(s.samples, (size_t)3);
+}
+
+TEST_CASE("typed mapping: empty summary yields any=false") {
+  MetricsManager manager("localhost:1", "/metrics", 1.0);
+  TpuMetrics t = manager.Typed();
+  CHECK(!t.any);
+  CHECK_EQ(t.duty_cycle.samples, (size_t)0);
+}

@@ -1,0 +1,181 @@
+// Fake-clock schedule-adherence tests for the open-loop rate managers —
+// the reference's strategy in test_request_rate_manager.cc (mocked
+// schedule clock, send-time error bounds) without wall-clock flakiness.
+#include <atomic>
+#include <chrono>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "data_loader.h"
+#include "infer_data.h"
+#include "load_manager.h"
+#include "mock_backend.h"
+#include "model_parser.h"
+#include "test_framework.h"
+
+using namespace ctpu;
+using namespace ctpu::perf;
+
+namespace {
+
+struct FakeClock {
+  std::mutex mu;
+  uint64_t now_ns = 1'000'000'000;  // arbitrary epoch
+  std::vector<uint64_t> sleep_targets;
+  std::atomic<size_t> sleeps{0};
+
+  uint64_t Now() {
+    std::lock_guard<std::mutex> lk(mu);
+    return now_ns;
+  }
+  // sleep_until advances the fake clock to the target instantly and
+  // records the schedule instant the manager aimed for.
+  void SleepUntil(uint64_t target) {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      if (target > now_ns) now_ns = target;
+      sleep_targets.push_back(target);
+    }
+    sleeps.fetch_add(1);
+    // tiny real pause so worker threads interleave
+    std::this_thread::sleep_for(std::chrono::microseconds(100));
+  }
+};
+
+struct Harness {
+  std::shared_ptr<MockClientBackend> mock;
+  std::shared_ptr<ClientBackend> backend;
+  ModelParser parser;
+  std::unique_ptr<DataLoader> loader;
+  std::unique_ptr<InferDataManager> data;
+  LoadConfig config;
+
+  Harness() {
+    MockClientBackend::Options options;
+    options.latency_us = 100;
+    mock = std::make_shared<MockClientBackend>(options);
+    backend = mock;
+    CHECK_OK(parser.Init(mock.get(), "mock", ""));
+    loader.reset(new DataLoader(&parser, 1));
+    CHECK_OK(loader->GenerateSynthetic());
+    data.reset(new InferDataManager(loader.get()));
+    config.model_name = "mock";
+    config.max_threads = 4;
+  }
+};
+
+}  // namespace
+
+TEST_CASE("rate schedule: constant-rate send times match the ideal "
+          "schedule exactly under a fake clock") {
+  Harness h;
+  FakeClock clock;
+  RequestRateManager manager(h.backend, h.data.get(), h.config);
+  manager.SetClockForTest([&clock] { return clock.Now(); },
+                          [&clock](uint64_t t) { clock.SleepUntil(t); });
+  manager.ChangeRate(1000.0);  // 1ms intervals
+  while (clock.sleeps.load() < 50) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+  manager.Stop();
+
+  std::lock_guard<std::mutex> lk(clock.mu);
+  REQUIRE(clock.sleep_targets.size() >= 50);
+  const uint64_t interval_ns = 1'000'000;
+  // Send-time error bound: every scheduled instant is exactly epoch +
+  // k*interval (the fake clock removes OS jitter; any deviation is a
+  // schedule-computation bug). Reference asserts |error| <= bound; with a
+  // fake clock the bound is 0.
+  const uint64_t first = clock.sleep_targets[0];
+  for (size_t k = 1; k < 50; ++k) {
+    const uint64_t expected = first + k * interval_ns;
+    const uint64_t actual = clock.sleep_targets[k];
+    const uint64_t error =
+        actual > expected ? actual - expected : expected - actual;
+    CHECK(error == 0);
+  }
+  // A fake clock that always reaches the target means zero schedule slip.
+  CHECK_EQ(manager.ScheduleSlipNs(), (uint64_t)0);
+}
+
+TEST_CASE("rate schedule: poisson inter-arrivals under a fake clock "
+          "average to 1/rate within 15%") {
+  Harness h;
+  FakeClock clock;
+  RequestRateManager manager(h.backend, h.data.get(), h.config, nullptr,
+                             RequestRateManager::Distribution::POISSON,
+                             /*seed=*/7);
+  manager.SetClockForTest([&clock] { return clock.Now(); },
+                          [&clock](uint64_t t) { clock.SleepUntil(t); });
+  manager.ChangeRate(2000.0);  // mean 0.5ms
+  while (clock.sleeps.load() < 400) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+  manager.Stop();
+
+  std::lock_guard<std::mutex> lk(clock.mu);
+  REQUIRE(clock.sleep_targets.size() >= 400);
+  double total = 0;
+  size_t n = 400;
+  for (size_t k = 1; k < n; ++k) {
+    total += (double)(clock.sleep_targets[k] - clock.sleep_targets[k - 1]);
+  }
+  double mean_ns = total / (n - 1);
+  CHECK_NEAR(mean_ns, 500'000.0, 75'000.0);
+  // Exponential inter-arrivals: variance should be on the order of the
+  // mean^2 (coefficient of variation ~1), distinguishing a real Poisson
+  // schedule from a constant one.
+  double var = 0;
+  for (size_t k = 1; k < n; ++k) {
+    double d =
+        (double)(clock.sleep_targets[k] - clock.sleep_targets[k - 1]) -
+        mean_ns;
+    var += d * d;
+  }
+  var /= (n - 2);
+  double cv = std::sqrt(var) / mean_ns;
+  CHECK(cv > 0.5);
+  CHECK(cv < 1.5);
+}
+
+TEST_CASE("rate schedule: custom interval replay preserves the list "
+          "cyclically under a fake clock") {
+  Harness h;
+  FakeClock clock;
+  RequestRateManager manager(h.backend, h.data.get(), h.config);
+  manager.SetClockForTest([&clock] { return clock.Now(); },
+                          [&clock](uint64_t t) { clock.SleepUntil(t); });
+  manager.StartCustomIntervals({0.001, 0.003, 0.002});  // 1ms, 3ms, 2ms
+  while (clock.sleeps.load() < 31) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+  manager.Stop();
+
+  std::lock_guard<std::mutex> lk(clock.mu);
+  REQUIRE(clock.sleep_targets.size() >= 31);
+  const uint64_t expected[3] = {1'000'000, 3'000'000, 2'000'000};
+  for (size_t k = 1; k < 31; ++k) {
+    uint64_t delta = clock.sleep_targets[k] - clock.sleep_targets[k - 1];
+    CHECK_EQ(delta, expected[k % 3]);
+  }
+}
+
+TEST_CASE("rate schedule: slip accounts time when the clock runs hot") {
+  Harness h;
+  FakeClock clock;
+  RequestRateManager manager(h.backend, h.data.get(), h.config);
+  // A clock that jumps PAST every target by 50us per tick: the scheduler
+  // can never catch up and must book the deficit as slip.
+  manager.SetClockForTest(
+      [&clock] {
+        std::lock_guard<std::mutex> lk(clock.mu);
+        clock.now_ns += 1'050'000;  // 1.05ms per observation at 1ms rate
+        return clock.now_ns;
+      },
+      [&clock](uint64_t t) { clock.SleepUntil(t); });
+  manager.ChangeRate(1000.0);
+  std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  manager.Stop();
+  CHECK(manager.ScheduleSlipNs() > 0);
+}

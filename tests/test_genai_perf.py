@@ -33,9 +33,12 @@ def test_tokenizer_fallback():
     ids = tok.encode("hello world hello")
     assert len(ids) == 3
     assert ids[0] == ids[2]  # deterministic per word
-    # unknown HF model in an offline env falls back cleanly
+    # unknown HF model in an offline env falls back cleanly to the bundled
+    # real BPE tokenizer
+    from client_tpu.genai_perf.tokenizer import BundledBPETokenizer
+
     tok2 = get_tokenizer("definitely/not-a-local-model")
-    assert isinstance(tok2, SyntheticTokenizer)
+    assert isinstance(tok2, BundledBPETokenizer)
 
 
 def test_create_llm_inputs(tmp_path):
@@ -235,3 +238,124 @@ def test_genai_perf_openai_end_to_end(tmp_path, capsys):
     report = json.loads((tmp_path / "llm_metrics.json").read_text())
     assert report["request_count"] > 0
     assert report["inter_token_latency"]["count"] > 0
+
+
+# ---------------------------------------------------------------------------
+# r4: tokenizer fidelity, dataset inputs, structured logging
+# ---------------------------------------------------------------------------
+
+
+def test_bundled_bpe_tokenizer_fidelity():
+    """The default tokenizer is a REAL byte-level BPE (bundled vocab):
+    frozen subword counts for fixed sentences, exact (tolerance 0) — any
+    drift means the bundled vocab changed and counts are no longer
+    reproducible run-to-run."""
+    from client_tpu.genai_perf.tokenizer import (
+        BundledBPETokenizer,
+        SyntheticTokenizer,
+        get_tokenizer,
+    )
+
+    tok = get_tokenizer(None)
+    assert isinstance(tok, BundledBPETokenizer)
+    frozen = {
+        "the quick brown fox jumps over the lazy dog": 16,
+        "measuring inference latency and throughput on tensor hardware": 9,
+        "The server returned an error: connection refused (111).": 12,
+        "streaming tokens per second": 4,
+        "hello world": 4,
+    }
+    for text, count in frozen.items():
+        assert len(tok.encode(text)) == count, text
+    # decode round-trips (byte-level BPE loses nothing but leading space)
+    text = "the quick brown fox jumps over the lazy dog"
+    assert tok.decode(tok.encode(text)).strip() == text
+
+    # The word-hash fallback undercounts vs real subword tokenization;
+    # stated tolerance: BPE/word ratio in [1.0, 2.5] on English prose.
+    synth = SyntheticTokenizer()
+    prose = (
+        "measuring inference latency and throughput while streaming "
+        "tokens over the benchmark window with stable percentiles"
+    )
+    ratio = len(tok.encode(prose)) / len(synth.encode(prose))
+    assert 1.0 <= ratio <= 2.5, ratio
+
+
+def test_input_corpus_token_counts_with_bpe():
+    """kserve-ids corpora carry real token-id lists whose lengths track the
+    requested distribution within a stated 40% tolerance (subword counts
+    vs word-sampled prompts)."""
+    from client_tpu.genai_perf.inputs import create_llm_inputs
+    from client_tpu.genai_perf.tokenizer import get_tokenizer
+
+    doc = create_llm_inputs(
+        path=None,
+        num_prompts=40,
+        input_tokens_mean=64,
+        output_tokens_mean=8,
+        tokenizer=get_tokenizer(None),
+    )
+    lengths = [len(e["INPUT_IDS"]["content"]) for e in doc["data"]]
+    mean = sum(lengths) / len(lengths)
+    assert 64 * 0.8 <= mean <= 64 * 1.8, mean
+
+
+def test_dataset_file_inputs(tmp_path):
+    """--input-dataset: offline OpenOrca / CNN_DailyMail / plain schemas
+    (reference llm_inputs.py:149-360 hosted-dataset handling)."""
+    import json
+
+    from client_tpu.genai_perf.inputs import (
+        create_llm_inputs,
+        load_dataset_prompts,
+    )
+
+    orca = tmp_path / "orca.jsonl"
+    orca.write_text(
+        "\n".join(
+            json.dumps(
+                {"system_prompt": "You are concise.", "question": f"Q{i}?"}
+            )
+            for i in range(3)
+        )
+    )
+    prompts = load_dataset_prompts(str(orca))
+    assert prompts == [f"You are concise. Q{i}?" for i in range(3)]
+
+    cnn = tmp_path / "cnn.json"
+    cnn.write_text(json.dumps([{"article": "A long news article."}]))
+    assert load_dataset_prompts(str(cnn), "cnn_dailymail") == [
+        "A long news article."
+    ]
+
+    plain = tmp_path / "plain.jsonl"
+    plain.write_text(json.dumps({"prompt": "write a haiku"}))
+    assert load_dataset_prompts(str(plain)) == ["write a haiku"]
+
+    # corpus generation draws (and cycles) dataset prompts
+    doc = create_llm_inputs(
+        path=None, num_prompts=5, dataset_path=str(orca),
+        output_format="kserve-text", input_name="PROMPT",
+    )
+    texts = [e["PROMPT"]["content"][0] for e in doc["data"]]
+    assert texts[0] == "You are concise. Q0?"
+    assert texts[3] == "You are concise. Q0?"  # cycled
+
+    with pytest.raises(ValueError, match="no prompts"):
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps([{"unrelated": 1}]))
+        load_dataset_prompts(str(bad))
+
+
+def test_structured_logging():
+    import io
+
+    from client_tpu.genai_perf.logging import getLogger, init_logging
+
+    stream = io.StringIO()
+    init_logging(verbose=True, stream=stream)
+    log = getLogger("client_tpu.genai_perf.main")
+    assert log.name == "genai_perf.main"
+    log.info("structured %s", "message")
+    assert "[INFO] genai_perf.main - structured message" in stream.getvalue()

@@ -1,0 +1,52 @@
+"""Shared helpers for the benchmark entry points (bench.py, bench_zoo.py).
+
+One implementation of the TPU-relay wedge workaround: probing the default
+jax platform in a subprocess and, when it hangs (a wedged relay blocks ANY
+in-process backend init — the relay hook intercepts backend lookup), re-
+executing the benchmark with the relay hook's trigger env removed and the
+platform pinned to CPU.
+"""
+
+import os
+import subprocess
+import sys
+
+# Set on re-exec so a still-broken CPU environment can't loop forever.
+REEXEC_SENTINEL = "CLIENT_TPU_BENCH_CPU"
+
+
+def device_platform(timeout_s: float = 120.0) -> str:
+    """The usable jax platform name ("tpu", "cpu", ...), probed in a
+    subprocess; empty string when the platform hangs or fails."""
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "jax.block_until_ready(jax.jit(lambda a: a + 1)(jnp.zeros((4, 4))));"
+        "print(jax.devices()[0].platform)"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        if proc.returncode == 0 and proc.stdout.strip():
+            return proc.stdout.strip().splitlines()[-1]
+    except subprocess.TimeoutExpired:
+        pass
+    return ""
+
+
+def reexec_on_cpu(argv=None) -> None:
+    """Replace this process with a CPU-pinned copy of itself (no return).
+
+    No-op (returns) when already re-executed once, so callers must handle
+    the still-unusable case themselves.
+    """
+    if REEXEC_SENTINEL in os.environ:
+        return
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # disarms the relay hook
+    env["JAX_PLATFORMS"] = "cpu"
+    env[REEXEC_SENTINEL] = "1"
+    os.execve(sys.executable, [sys.executable] + (argv or sys.argv), env)

@@ -32,23 +32,7 @@ sys.path.insert(0, REPO)
 PA = os.path.join(REPO, "build", "perf_analyzer")
 
 
-def device_platform() -> str:
-    """Returns the usable jax platform name, probing in a subprocess."""
-    code = (
-        "import jax, jax.numpy as jnp;"
-        "jax.block_until_ready(jax.jit(lambda a: a + 1)(jnp.zeros((4, 4))));"
-        "print(jax.devices()[0].platform)"
-    )
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True, text=True,
-            timeout=120,
-        )
-        if proc.returncode == 0:
-            return proc.stdout.strip().splitlines()[-1]
-    except subprocess.TimeoutExpired:
-        pass
-    return ""
+from tools.bench_common import device_platform, reexec_on_cpu  # noqa: E402
 
 
 def run_pa(url, model, *, batch=1, concurrency=4, shm="none", shape=None,
@@ -102,16 +86,10 @@ def main() -> int:
 
     platform = device_platform()
     if not platform:
-        # Wedged TPU relay: re-exec with the relay hook disarmed (see
-        # bench.py for the rationale).
-        if "CLIENT_TPU_BENCH_CPU" in os.environ:
-            print("no usable jax platform", file=sys.stderr)
-            return 1
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["CLIENT_TPU_BENCH_CPU"] = "1"
-        os.execve(sys.executable, [sys.executable, *sys.argv], env)
+        # Wedged TPU relay: re-exec with the relay hook disarmed.
+        reexec_on_cpu()
+        print("no usable jax platform", file=sys.stderr)
+        return 1
 
     on_device = platform not in ("", "cpu")
     print(f"# platform: {platform} (device rows: {on_device})")
