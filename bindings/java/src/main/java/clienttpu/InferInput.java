@@ -27,14 +27,34 @@ public class InferInput {
     public long[] getShape() { return shape; }
     public byte[] getData() { return data; }
 
-    public void setData(int[] values) { data = BinaryProtocol.packInts(values); }
-    public void setData(long[] values) { data = BinaryProtocol.packLongs(values); }
-    public void setData(float[] values) { data = BinaryProtocol.packFloats(values); }
-    public void setData(double[] values) { data = BinaryProtocol.packDoubles(values); }
-    public void setData(String[] values) { data = BinaryProtocol.packStrings(values); }
-    public void setRaw(byte[] raw) { data = raw; }
+    public void setData(int[] values) { clearSharedMemory(); data = BinaryProtocol.packInts(values); }
+    public void setData(long[] values) { clearSharedMemory(); data = BinaryProtocol.packLongs(values); }
+    public void setData(float[] values) { clearSharedMemory(); data = BinaryProtocol.packFloats(values); }
+    public void setData(double[] values) { clearSharedMemory(); data = BinaryProtocol.packDoubles(values); }
+    public void setData(String[] values) { clearSharedMemory(); data = BinaryProtocol.packStrings(values); }
+    public void setRaw(byte[] raw) { clearSharedMemory(); data = raw; }
 
-    /** JSON header fragment (binary_data_size parameter included). */
+    /** Revert to inline data (mirrors the reference client's reset of shm
+     *  params on every set_data call). */
+    public void clearSharedMemory() {
+        this.shmRegion = null;
+        this.shmByteSize = 0;
+        this.shmOffset = 0;
+    }
+
+    /** Source this input from a registered shared-memory region instead of
+     *  inline bytes (system-shm extension). */
+    public void setSharedMemory(String regionName, long byteSize,
+                                long offset) {
+        this.shmRegion = regionName;
+        this.shmByteSize = byteSize;
+        this.shmOffset = offset;
+        this.data = new byte[0];  // shm inputs carry no inline bytes
+    }
+
+    public boolean isSharedMemory() { return shmRegion != null; }
+
+    /** JSON header fragment (binary_data_size or shared-memory params). */
     Map<String, Object> toHeader() {
         Map<String, Object> tensor = new LinkedHashMap<>();
         tensor.put("name", name);
@@ -43,8 +63,18 @@ public class InferInput {
         tensor.put("shape", dims);
         tensor.put("datatype", datatype);
         Map<String, Object> params = new LinkedHashMap<>();
-        params.put("binary_data_size", (long) data.length);
+        if (shmRegion != null) {
+            params.put("shared_memory_region", shmRegion);
+            params.put("shared_memory_byte_size", shmByteSize);
+            if (shmOffset != 0) params.put("shared_memory_offset", shmOffset);
+        } else {
+            params.put("binary_data_size", (long) data.length);
+        }
         tensor.put("parameters", params);
         return tensor;
     }
+
+    private String shmRegion;
+    private long shmByteSize;
+    private long shmOffset;
 }

@@ -19,13 +19,38 @@ public class InferRequestedOutput {
 
     public String getName() { return name; }
 
+    /** Redirect this output into a registered shared-memory region. */
+    public void setSharedMemory(String regionName, long byteSize,
+                                long offset) {
+        this.shmRegion = regionName;
+        this.shmByteSize = byteSize;
+        this.shmOffset = offset;
+    }
+
+    /** Revert to the binary_data path (symmetric with InferInput). */
+    public void unsetSharedMemory() {
+        this.shmRegion = null;
+        this.shmByteSize = 0;
+        this.shmOffset = 0;
+    }
+
     Map<String, Object> toHeader() {
         Map<String, Object> out = new LinkedHashMap<>();
         out.put("name", name);
         Map<String, Object> params = new LinkedHashMap<>();
-        params.put("binary_data", binaryData);
+        if (shmRegion != null) {
+            params.put("shared_memory_region", shmRegion);
+            params.put("shared_memory_byte_size", shmByteSize);
+            if (shmOffset != 0) params.put("shared_memory_offset", shmOffset);
+        } else {
+            params.put("binary_data", binaryData);
+        }
         if (classCount > 0) params.put("classification", (long) classCount);
         out.put("parameters", params);
         return out;
     }
+
+    private String shmRegion;
+    private long shmByteSize;
+    private long shmOffset;
 }

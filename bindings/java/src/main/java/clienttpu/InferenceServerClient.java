@@ -88,6 +88,43 @@ public class InferenceServerClient {
         checked(postJson("/v2/repository/models/" + model + "/unload", "{}"));
     }
 
+    // ---- shared memory (system-shm extension) ----
+
+    public void registerSystemSharedMemory(String name, String key,
+                                           long byteSize, long offset)
+            throws IOException, InterruptedException {
+        Map<String, Object> body = new LinkedHashMap<>();
+        body.put("key", key);
+        body.put("offset", offset);
+        body.put("byte_size", byteSize);
+        checked(postJson("/v2/systemsharedmemory/region/" + name + "/register",
+                         Json.write(body)));
+    }
+
+    public void registerSystemSharedMemory(String name, String key,
+                                           long byteSize)
+            throws IOException, InterruptedException {
+        registerSystemSharedMemory(name, key, byteSize, 0);
+    }
+
+    public void unregisterSystemSharedMemory(String name)
+            throws IOException, InterruptedException {
+        checked(postJson(
+            "/v2/systemsharedmemory/region/" + name + "/unregister", "{}"));
+    }
+
+    public void unregisterSystemSharedMemory()
+            throws IOException, InterruptedException {
+        checked(postJson("/v2/systemsharedmemory/unregister", "{}"));
+    }
+
+    @SuppressWarnings("unchecked")
+    public List<Object> getSystemSharedMemoryStatus()
+            throws IOException, InterruptedException {
+        return (List<Object>)
+            Json.parse(checked(get("/v2/systemsharedmemory/status")).body());
+    }
+
     // ---- inference ----
 
     public InferResult infer(String model, List<InferInput> inputs,

@@ -1,0 +1,69 @@
+// Pure-JDK system shared-memory region.
+//
+// Role parity with the reference Java client's shm utilities: on Linux,
+// POSIX shm_open("/name") IS a file at /dev/shm/name, so a mapped
+// FileChannel over that path interoperates byte-for-byte with the server's
+// shm manager (and the C++/Python clients) — no JNI needed.
+package clienttpu;
+
+import java.io.IOException;
+import java.io.RandomAccessFile;
+import java.nio.MappedByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.channels.FileChannel;
+import java.nio.file.Files;
+import java.nio.file.Path;
+
+public class SystemSharedMemoryRegion implements AutoCloseable {
+    private final String key;        // "/name" (POSIX shm key)
+    private final long byteSize;
+    private final RandomAccessFile file;
+    private final MappedByteBuffer buffer;
+
+    /** Creates (or truncates) the region and maps it read/write. */
+    public SystemSharedMemoryRegion(String key, long byteSize)
+            throws IOException {
+        if (!key.startsWith("/")) {
+            throw new IllegalArgumentException(
+                "shm key must start with '/', got " + key);
+        }
+        this.key = key;
+        this.byteSize = byteSize;
+        this.file = new RandomAccessFile("/dev/shm" + key, "rw");
+        this.file.setLength(byteSize);
+        this.buffer = file.getChannel()
+            .map(FileChannel.MapMode.READ_WRITE, 0, byteSize);
+        this.buffer.order(ByteOrder.LITTLE_ENDIAN);
+    }
+
+    public String getKey() { return key; }
+    public long getByteSize() { return byteSize; }
+
+    /** The mapped buffer (little-endian, the KServe raw tensor layout). */
+    public MappedByteBuffer buffer() { return buffer; }
+
+    public void write(long offset, byte[] data) {
+        MappedByteBuffer dup = buffer;
+        dup.position((int) offset);
+        dup.put(data);
+        dup.rewind();
+    }
+
+    public byte[] read(long offset, int length) {
+        byte[] out = new byte[length];
+        MappedByteBuffer dup = buffer;
+        dup.position((int) offset);
+        dup.get(out);
+        dup.rewind();
+        return out;
+    }
+
+    /** Closes the mapping; {@link #destroy()} also removes the region. */
+    @Override
+    public void close() throws IOException { file.close(); }
+
+    public void destroy() throws IOException {
+        close();
+        Files.deleteIfExists(Path.of("/dev/shm" + key));
+    }
+}

@@ -1,0 +1,118 @@
+// Memory-growth stress test: many sync + async inferences (and shm
+// register/unregister churn) must not grow the heap unboundedly.
+//
+// Role parity with the reference Java client's MemoryGrowthTest
+// (reference src/java/src/main/java/triton/client/examples/
+// MemoryGrowthTest.java): run N iterations, sample used heap after GC at
+// the start and end, fail when growth exceeds the budget.
+//
+// Run:  java clienttpu.examples.MemoryGrowthTest [-u host:port]
+//       [-i iterations] [-b max growth MB]
+package clienttpu.examples;
+
+import clienttpu.InferInput;
+import clienttpu.InferRequestedOutput;
+import clienttpu.InferResult;
+import clienttpu.InferenceServerClient;
+import clienttpu.SystemSharedMemoryRegion;
+
+import java.util.List;
+import java.util.concurrent.CompletableFuture;
+
+public class MemoryGrowthTest {
+    private static long usedHeapAfterGc() {
+        for (int i = 0; i < 3; ++i) {
+            System.gc();
+            try { Thread.sleep(50); } catch (InterruptedException ignored) {}
+        }
+        Runtime rt = Runtime.getRuntime();
+        return rt.totalMemory() - rt.freeMemory();
+    }
+
+    public static void main(String[] args) throws Exception {
+        String url = "localhost:8000";
+        int iterations = 2000;
+        long budgetMb = 64;
+        for (int i = 0; i < args.length; ++i) {
+            if (args[i].equals("-u") && i + 1 < args.length) url = args[++i];
+            if (args[i].equals("-i") && i + 1 < args.length) {
+                iterations = Integer.parseInt(args[++i]);
+            }
+            if (args[i].equals("-b") && i + 1 < args.length) {
+                budgetMb = Long.parseLong(args[++i]);
+            }
+        }
+
+        InferenceServerClient client =
+            new InferenceServerClient(url, 5.0, 30.0);
+        if (!client.isServerLive()) {
+            System.err.println("error: server not live at " + url);
+            System.exit(1);
+        }
+
+        int[] in0 = new int[16];
+        int[] in1 = new int[16];
+        for (int i = 0; i < 16; ++i) { in0[i] = i; in1[i] = 1; }
+
+        // Warm up allocator pools / JIT before the baseline sample.
+        for (int i = 0; i < 100; ++i) runOnce(client, in0, in1, i);
+        long before = usedHeapAfterGc();
+
+        for (int i = 0; i < iterations; ++i) runOnce(client, in0, in1, i);
+
+        // Shared-memory churn: register/write/infer/unregister each round.
+        String key = "/ctpu_java_mgt_" + ProcessHandle.current().pid();
+        for (int i = 0; i < Math.max(1, iterations / 20); ++i) {
+            try (SystemSharedMemoryRegion region =
+                     new SystemSharedMemoryRegion(key, 128)) {
+                byte[] raw = new byte[128];
+                region.write(0, raw);
+                client.registerSystemSharedMemory("java_mgt", key, 128);
+                InferInput a = new InferInput(
+                    "INPUT0", new long[]{1, 16}, "INT32");
+                a.setSharedMemory("java_mgt", 64, 0);
+                InferInput b = new InferInput(
+                    "INPUT1", new long[]{1, 16}, "INT32");
+                b.setSharedMemory("java_mgt", 64, 64);
+                client.infer("simple", List.of(a, b), List.of());
+                client.unregisterSystemSharedMemory("java_mgt");
+                region.destroy();
+            }
+        }
+
+        long after = usedHeapAfterGc();
+        long growthMb = Math.max(0, after - before) / (1024 * 1024);
+        System.out.println("heap growth over " + iterations + " iterations: "
+                           + growthMb + " MB (budget " + budgetMb + " MB)");
+        if (growthMb > budgetMb) {
+            System.err.println("FAIL : MemoryGrowthTest (unbounded growth)");
+            System.exit(1);
+        }
+        System.out.println("PASS : MemoryGrowthTest");
+    }
+
+    private static void runOnce(InferenceServerClient client, int[] in0,
+                                int[] in1, int i) throws Exception {
+        InferInput a = new InferInput("INPUT0", new long[]{1, 16}, "INT32");
+        a.setData(in0);
+        InferInput b = new InferInput("INPUT1", new long[]{1, 16}, "INT32");
+        b.setData(in1);
+        List<InferRequestedOutput> outputs =
+            List.of(new InferRequestedOutput("OUTPUT0"));
+        if (i % 2 == 0) {
+            InferResult result = client.infer("simple", List.of(a, b), outputs);
+            int[] sum = result.getOutputAsInts("OUTPUT0");
+            if (sum[3] != in0[3] + in1[3]) {
+                throw new IllegalStateException("wrong sync result");
+            }
+        } else {
+            CompletableFuture<InferResult> future =
+                client.inferAsync("simple", List.of(a, b), outputs);
+            InferResult result = future.join();
+            int[] sum = result.getOutputAsInts("OUTPUT0");
+            if (sum[3] != in0[3] + in1[3]) {
+                throw new IllegalStateException("wrong async result");
+            }
+        }
+    }
+}

@@ -8,8 +8,7 @@ inter-token latencies, and token/request throughput, reduce to Statistics
 
 import dataclasses
 import json
-import math
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Sequence
 
 
 @dataclasses.dataclass

@@ -5,7 +5,7 @@ into numpy/jax arrays. Capability parity with reference
 src/python/library/tritonclient/grpc/_infer_result.py.
 """
 
-from typing import Any, Dict, List, Optional
+from typing import Dict, Optional
 
 import numpy as np
 

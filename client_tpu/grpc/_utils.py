@@ -3,7 +3,7 @@
 Reference semantics: src/python/library/tritonclient/grpc/_utils.py:80-158.
 """
 
-from typing import Any, Dict, Optional, Sequence
+from typing import Any, Dict, Optional
 
 import grpc
 

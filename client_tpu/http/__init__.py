@@ -12,8 +12,7 @@ threads: calls serialize onto the private loop's connection pool.
 """
 
 import concurrent.futures
-import functools
-from typing import Any, Dict, Optional, Sequence
+from typing import Optional
 
 from client_tpu._sync_runner import EventLoopRunner
 from client_tpu.http import aio as _aio

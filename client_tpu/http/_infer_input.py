@@ -12,11 +12,9 @@ import numpy as np
 
 from client_tpu.utils import (
     InferenceServerException,
-    bfloat16,
     np_to_triton_dtype,
     serialize_byte_tensor,
-    triton_to_np_dtype,
-)
+    )
 
 
 class InferInput:

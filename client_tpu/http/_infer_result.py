@@ -8,8 +8,7 @@ native ``ml_dtypes.bfloat16`` arrays and a ``as_jax()`` accessor.
 """
 
 import json
-import struct
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 import numpy as np
 

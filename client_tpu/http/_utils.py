@@ -16,7 +16,7 @@ BF16 tensors always travel binary: JSON has no sane BF16 representation
 import gzip
 import json
 import zlib
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from client_tpu.utils import InferenceServerException
 

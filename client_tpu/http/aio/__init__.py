@@ -11,9 +11,8 @@ Method surface parity with the reference HTTP client
 the TPU shared-memory registration trio that replaces the CUDA one.
 """
 
-import asyncio
 import json
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, Optional, Sequence
 
 import aiohttp
 

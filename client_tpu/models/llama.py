@@ -22,8 +22,7 @@ flagship entry for the driver's __graft_entry__.
 """
 
 import dataclasses
-import functools
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp

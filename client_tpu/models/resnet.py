@@ -12,7 +12,6 @@ from typing import Any, Callable, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 import flax.linen as nn
 

@@ -11,7 +11,7 @@ SPMD machinery lives here:
 - spec helpers for parameter/activation sharding.
 """
 
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import jax
 import numpy as np

@@ -10,8 +10,7 @@ strategy, SURVEY.md §4 tier 1).
 """
 
 import asyncio
-import time
-from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -327,7 +326,6 @@ class LocalPerfBackend(PerfBackend):
     def _build_request(
         self, model_name, inputs, model_version, request_id, parameters
     ):
-        from client_tpu.utils import np_to_triton_dtype
 
         request = self._CoreRequest(
             model_name=model_name,

@@ -11,7 +11,7 @@ PerfInferInput sets per (stream, step).
 import base64
 import json
 import os
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 

@@ -9,7 +9,7 @@ drives all in-flight requests; workers are tasks, not threads.
 import asyncio
 import itertools
 import time
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 

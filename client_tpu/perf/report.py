@@ -8,7 +8,7 @@ timestamps) that genai-perf consumes
 """
 
 import json
-from typing import List, Optional, Sequence
+from typing import Optional, Sequence
 
 from client_tpu.perf.profiler import ProfileExperiment
 

@@ -8,7 +8,7 @@ collision-free sequence-id assignment, configurable sequence length with
 
 import itertools
 import threading
-from typing import Dict, Optional
+from typing import Dict
 
 import numpy as np
 

@@ -10,7 +10,6 @@ front-end); this module binds them into grpc.aio and keeps only the
 inference request/response tensor conversion local.
 """
 
-from typing import List
 
 import grpc
 import numpy as np

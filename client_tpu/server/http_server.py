@@ -10,7 +10,7 @@ import base64
 import gzip
 import json
 import zlib
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 import numpy as np
 from aiohttp import web

@@ -6,13 +6,11 @@ per request via an async generator, mirroring Triton's decoupled transaction
 policy (reference model_config.proto ModelTransactionPolicy).
 """
 
-import asyncio
 import importlib.util
 import json
 import os
 import threading
-import time
-from typing import Any, AsyncIterator, Callable, Dict, List, Optional
+from typing import Any, AsyncIterator, Dict, List, Optional
 
 import numpy as np
 

@@ -9,7 +9,7 @@ functions, not torch/CUDA.
 """
 
 import asyncio
-from typing import Any, AsyncIterator, Dict, List
+from typing import Any, AsyncIterator, Dict
 
 import numpy as np
 

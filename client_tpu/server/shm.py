@@ -17,7 +17,7 @@ import json
 import mmap
 import os
 import threading
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 from client_tpu.utils import InferenceServerException
 

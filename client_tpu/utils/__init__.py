@@ -8,7 +8,7 @@ whereas the reference only supports BF16 through a float32-truncation hack
 """
 
 import struct
-from typing import Iterable, List, Optional, Sequence, Union
+from typing import List, Optional, Sequence, Union
 
 import numpy as np
 

@@ -10,9 +10,8 @@ data path (bulk np copies into the mapping) is already zero-Python-loop.
 
 import mmap
 import os
-import struct
 import threading
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import numpy as np
 

@@ -30,10 +30,9 @@ boundary costs one transfer per direction.
 """
 
 import json
-import os
 import threading
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 

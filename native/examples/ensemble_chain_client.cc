@@ -1,0 +1,85 @@
+// Ensemble example: the add_sub_chain pipeline (simple -> simple) runs
+// entirely server-side; intermediate tensors never cross the wire
+// (reference ensemble_image_client.cc role on the in-repo demo ensemble).
+
+#include <cstdint>
+#include <iostream>
+#include <memory>
+#include <vector>
+
+#include "grpc_client.h"
+
+namespace {
+
+void FailOnError(const ctpu::Error& err, const char* what) {
+  if (!err.IsOk()) {
+    std::cerr << "error: " << what << ": " << err.Message() << std::endl;
+    exit(1);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8001";
+  bool verbose = false;
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    if (arg == "-u" && i + 1 < argc) url = argv[++i];
+    if (arg == "-v") verbose = true;
+  }
+
+  std::unique_ptr<ctpu::InferenceServerGrpcClient> client;
+  FailOnError(ctpu::InferenceServerGrpcClient::Create(&client, url, verbose),
+              "create client");
+
+  // The config's ensemble_scheduling declares the composing steps.
+  inference::ModelConfigResponse config;
+  FailOnError(client->ModelConfig(&config, "add_sub_chain"), "model config");
+  if (config.config().ensemble_scheduling().step_size() != 2) {
+    std::cerr << "error: expected a 2-step ensemble" << std::endl;
+    return 1;
+  }
+  if (verbose) {
+    for (const auto& step : config.config().ensemble_scheduling().step()) {
+      std::cout << "  step: " << step.model_name() << std::endl;
+    }
+  }
+
+  std::vector<int32_t> a(16), b(16);
+  for (int i = 0; i < 16; ++i) {
+    a[i] = 3 * i;
+    b[i] = 7;
+  }
+  ctpu::InferInput input0("INPUT0", {1, 16}, "INT32");
+  ctpu::InferInput input1("INPUT1", {1, 16}, "INT32");
+  FailOnError(input0.AppendRaw(reinterpret_cast<const uint8_t*>(a.data()),
+                               a.size() * sizeof(int32_t)),
+              "set INPUT0");
+  FailOnError(input1.AppendRaw(reinterpret_cast<const uint8_t*>(b.data()),
+                               b.size() * sizeof(int32_t)),
+              "set INPUT1");
+
+  ctpu::InferOptions options("add_sub_chain");
+  ctpu::InferResult* raw = nullptr;
+  FailOnError(client->Infer(&raw, options, {&input0, &input1}), "infer");
+  std::unique_ptr<ctpu::InferResult> result(raw);
+  FailOnError(result->RequestStatus(), "request status");
+
+  // (a+b)+(a-b) = 2a, (a+b)-(a-b) = 2b
+  const uint8_t* out0;
+  const uint8_t* out1;
+  size_t n0, n1;
+  FailOnError(result->RawData("OUTPUT0", &out0, &n0), "OUTPUT0");
+  FailOnError(result->RawData("OUTPUT1", &out1, &n1), "OUTPUT1");
+  const int32_t* o0 = reinterpret_cast<const int32_t*>(out0);
+  const int32_t* o1 = reinterpret_cast<const int32_t*>(out1);
+  for (int i = 0; i < 16; ++i) {
+    if (o0[i] != 2 * a[i] || o1[i] != 2 * b[i]) {
+      std::cerr << "error: wrong ensemble result at " << i << std::endl;
+      return 1;
+    }
+  }
+  std::cout << "PASS : ensemble_chain_client" << std::endl;
+  return 0;
+}

@@ -1,0 +1,102 @@
+// Image classification example against the image_classifier (ResNet)
+// model, using the classification extension for top-K labels
+// (reference src/c++/examples/image_client.cc role; input here is a
+// synthetic image or a raw FP32 file instead of a JPEG decoder — the
+// image pipeline is the server's, not the wire's).
+
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <memory>
+#include <random>
+#include <vector>
+
+#include "grpc_client.h"
+
+namespace {
+
+void FailOnError(const ctpu::Error& err, const char* what) {
+  if (!err.IsOk()) {
+    std::cerr << "error: " << what << ": " << err.Message() << std::endl;
+    exit(1);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8001";
+  std::string model = "image_classifier";
+  std::string image_file;  // raw FP32 HxWx3 file; empty = synthetic
+  int topk = 3;
+  bool verbose = false;
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    if (arg == "-u" && i + 1 < argc) url = argv[++i];
+    if (arg == "-m" && i + 1 < argc) model = argv[++i];
+    if (arg == "-c" && i + 1 < argc) topk = atoi(argv[++i]);
+    if (arg == "-f" && i + 1 < argc) image_file = argv[++i];
+    if (arg == "-v") verbose = true;
+  }
+
+  std::unique_ptr<ctpu::InferenceServerGrpcClient> client;
+  FailOnError(ctpu::InferenceServerGrpcClient::Create(&client, url, verbose),
+              "create client");
+
+  // Image geometry from model metadata ([-1, H, W, 3]).
+  inference::ModelMetadataResponse metadata;
+  FailOnError(client->ModelMetadata(&metadata, model), "model metadata");
+  if (metadata.inputs_size() != 1) {
+    std::cerr << "error: expected one image input" << std::endl;
+    return 1;
+  }
+  const auto& shape = metadata.inputs(0).shape();
+  int64_t h = shape[shape.size() - 3];
+  int64_t w = shape[shape.size() - 2];
+  const size_t pixels = (size_t)(h * w * 3);
+
+  std::vector<float> image(pixels);
+  if (!image_file.empty()) {
+    std::ifstream f(image_file, std::ios::binary);
+    if (!f.read(reinterpret_cast<char*>(image.data()),
+                (std::streamsize)(pixels * sizeof(float)))) {
+      std::cerr << "error: image file must hold " << pixels
+                << " raw FP32 values (" << h << "x" << w << "x3)"
+                << std::endl;
+      return 1;
+    }
+  } else {
+    std::mt19937 rng(42);
+    std::uniform_real_distribution<float> dist(0.f, 1.f);
+    for (auto& v : image) v = dist(rng);
+  }
+
+  ctpu::InferInput input(metadata.inputs(0).name(), {1, h, w, 3}, "FP32");
+  FailOnError(input.AppendRaw(reinterpret_cast<const uint8_t*>(image.data()),
+                              image.size() * sizeof(float)),
+              "set image");
+  // classification extension: server returns "score:index[:label]" strings
+  ctpu::InferRequestedOutput output(metadata.outputs(0).name(),
+                                    (size_t)topk);
+
+  ctpu::InferOptions options(model);
+  ctpu::InferResult* raw = nullptr;
+  FailOnError(client->Infer(&raw, options, {&input}, {&output}), "infer");
+  std::unique_ptr<ctpu::InferResult> result(raw);
+  FailOnError(result->RequestStatus(), "request status");
+
+  std::vector<std::string> classes;
+  FailOnError(result->StringData(metadata.outputs(0).name(), &classes),
+              "classification strings");
+  if ((int)classes.size() != topk) {
+    std::cerr << "error: expected " << topk << " classes, got "
+              << classes.size() << std::endl;
+    return 1;
+  }
+  for (const auto& entry : classes) {
+    std::cout << "    " << entry << std::endl;
+  }
+  std::cout << "PASS : image_client" << std::endl;
+  return 0;
+}

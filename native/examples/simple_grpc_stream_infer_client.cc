@@ -1,0 +1,97 @@
+// Decoupled streaming example: one request to the repeat_int32 model
+// yields one response per element over a ModelStreamInfer bidi stream
+// (reference decoupled custom_repeat example / stream_infer client role).
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <iostream>
+#include <memory>
+#include <mutex>
+#include <vector>
+
+#include "grpc_client.h"
+
+namespace {
+
+void FailOnError(const ctpu::Error& err, const char* what) {
+  if (!err.IsOk()) {
+    std::cerr << "error: " << what << ": " << err.Message() << std::endl;
+    exit(1);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8001";
+  bool verbose = false;
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    if (arg == "-u" && i + 1 < argc) url = argv[++i];
+    if (arg == "-v") verbose = true;
+  }
+
+  std::unique_ptr<ctpu::InferenceServerGrpcClient> client;
+  FailOnError(ctpu::InferenceServerGrpcClient::Create(&client, url, verbose),
+              "create client");
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<int32_t> received;
+  bool saw_final = false;
+
+  FailOnError(
+      client->StartStream([&](ctpu::InferResult* r) {
+        std::unique_ptr<ctpu::InferResult> result(r);
+        std::lock_guard<std::mutex> lk(mu);
+        if (!result->RequestStatus().IsOk()) {
+          std::cerr << "stream error: " << result->RequestStatus().Message()
+                    << std::endl;
+          saw_final = true;
+          cv.notify_all();
+          return;
+        }
+        const uint8_t* out;
+        size_t n;
+        if (result->RawData("OUT", &out, &n).IsOk() && n >= 4) {
+          received.push_back(*reinterpret_cast<const int32_t*>(out));
+        }
+        if (received.size() >= 5) saw_final = true;
+        cv.notify_all();
+      }),
+      "start stream");
+
+  const int32_t values[5] = {7, 11, 13, 17, 19};
+  ctpu::InferInput input("IN", {5}, "INT32");
+  FailOnError(input.AppendRaw(reinterpret_cast<const uint8_t*>(values),
+                              sizeof(values)),
+              "set IN");
+  ctpu::InferOptions options("repeat_int32");
+  options.request_id = "stream-1";
+  FailOnError(client->AsyncStreamInfer(options, {&input}), "stream infer");
+
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    if (!cv.wait_for(lk, std::chrono::seconds(30),
+                     [&] { return received.size() >= 5 && saw_final; })) {
+      std::cerr << "error: timed out with " << received.size()
+                << " responses" << std::endl;
+      return 1;
+    }
+  }
+  FailOnError(client->StopStream(), "stop stream");
+
+  for (int i = 0; i < 5; ++i) {
+    if (received[i] != values[i]) {
+      std::cerr << "error: response " << i << " = " << received[i]
+                << ", want " << values[i] << std::endl;
+      return 1;
+    }
+  }
+  if (verbose) {
+    std::cout << "received 5 streamed tokens" << std::endl;
+  }
+  std::cout << "PASS : simple_grpc_stream_infer_client" << std::endl;
+  return 0;
+}

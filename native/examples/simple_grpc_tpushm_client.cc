@@ -1,0 +1,109 @@
+// TPU shared-memory example: the tpu-shm extension's JSON raw handle
+// (host-pinned staging region the server uploads to device from) replaces
+// the reference's cudaIpcMemHandle flow
+// (reference src/c++/examples/simple_grpc_cudashm_client.cc role).
+
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <iostream>
+#include <memory>
+#include <vector>
+
+#include "grpc_client.h"
+#include "json.h"
+#include "shm_utils.h"
+
+namespace {
+
+void FailOnError(const ctpu::Error& err, const char* what) {
+  if (!err.IsOk()) {
+    std::cerr << "error: " << what << ": " << err.Message() << std::endl;
+    exit(1);
+  }
+}
+
+// The JSON handle client_tpu.utils.tpu_shared_memory.get_raw_handle emits.
+std::string TpuRawHandle(const std::string& shm_key, size_t byte_size) {
+  ctpu::json::Object handle;
+  handle["kind"] = ctpu::json::Value("tpu-host-pinned");
+  handle["shm_key"] = ctpu::json::Value(shm_key);
+  handle["byte_size"] = ctpu::json::Value((int64_t)byte_size);
+  handle["device_id"] = ctpu::json::Value((int64_t)0);
+  return ctpu::json::Value(std::move(handle)).Dump();
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8001";
+  bool verbose = false;
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    if (arg == "-u" && i + 1 < argc) url = argv[++i];
+    if (arg == "-v") verbose = true;
+  }
+
+  std::unique_ptr<ctpu::InferenceServerGrpcClient> client;
+  FailOnError(ctpu::InferenceServerGrpcClient::Create(&client, url, verbose),
+              "create client");
+
+  const size_t kBytes = 16 * sizeof(int32_t) * 2;
+  const std::string key = "/ctpu_example_tpushm_" + std::to_string(getpid());
+  int fd = -1;
+  void* addr = nullptr;
+  FailOnError(ctpu::CreateSharedMemoryRegion(key, kBytes, &fd),
+              "create region");
+  FailOnError(ctpu::MapSharedMemory(fd, 0, kBytes, &addr), "map region");
+  int32_t* data = static_cast<int32_t*>(addr);
+  for (int i = 0; i < 16; ++i) {
+    data[i] = 10 + i;  // INPUT0
+    data[16 + i] = 2;  // INPUT1
+  }
+
+  FailOnError(client->UnregisterTpuSharedMemory(), "unregister all");
+  FailOnError(client->RegisterTpuSharedMemory(
+                  "example_tpu", TpuRawHandle(key, kBytes), /*device_id=*/0,
+                  kBytes),
+              "register tpu region");
+
+  // Status RPC reflects the registration.
+  inference::TpuSharedMemoryStatusResponse status;
+  FailOnError(client->TpuSharedMemoryStatus(&status), "tpu shm status");
+  if (status.regions().count("example_tpu") == 0) {
+    std::cerr << "error: registered region missing from status" << std::endl;
+    return 1;
+  }
+
+  ctpu::InferInput input0("INPUT0", {1, 16}, "INT32");
+  ctpu::InferInput input1("INPUT1", {1, 16}, "INT32");
+  FailOnError(input0.SetSharedMemory("example_tpu", 64, 0), "INPUT0 shm");
+  FailOnError(input1.SetSharedMemory("example_tpu", 64, 64), "INPUT1 shm");
+
+  ctpu::InferOptions options("simple");
+  ctpu::InferResult* raw = nullptr;
+  FailOnError(client->Infer(&raw, options, {&input0, &input1}), "infer");
+  std::unique_ptr<ctpu::InferResult> result(raw);
+  FailOnError(result->RequestStatus(), "request status");
+
+  const uint8_t* out0;
+  size_t n0;
+  FailOnError(result->RawData("OUTPUT0", &out0, &n0), "OUTPUT0");
+  const int32_t* sum = reinterpret_cast<const int32_t*>(out0);
+  for (int i = 0; i < 16; ++i) {
+    if (sum[i] != data[i] + data[16 + i]) {
+      std::cerr << "error: wrong result at " << i << std::endl;
+      return 1;
+    }
+  }
+
+  FailOnError(client->UnregisterTpuSharedMemory("example_tpu"),
+              "unregister");
+  ctpu::UnmapSharedMemory(addr, kBytes);
+  ctpu::CloseSharedMemory(fd);
+  ctpu::UnlinkSharedMemoryRegion(key);
+
+  std::cout << "PASS : simple_grpc_tpushm_client" << std::endl;
+  return 0;
+}

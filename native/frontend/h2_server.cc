@@ -674,7 +674,9 @@ Listener::~Listener() { Stop(); }
 
 void Listener::AcceptLoop() {
   for (;;) {
-    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    int listen_fd = listen_fd_.load();
+    if (listen_fd < 0) return;
+    int fd = ::accept(listen_fd, nullptr, nullptr);
     if (fd < 0) {
       if (stopping_.load()) return;
       continue;
@@ -713,12 +715,14 @@ void Listener::Reap(bool all) {
 
 void Listener::Stop() {
   if (stopping_.exchange(true)) return;
-  if (listen_fd_ >= 0) {
-    ::shutdown(listen_fd_, SHUT_RDWR);
-    ::close(listen_fd_);
-    listen_fd_ = -1;
-  }
+  // shutdown() unblocks accept(); the fd is closed only AFTER the acceptor
+  // joins so a concurrently-accepted fd number can never be confused with
+  // a recycled listener fd.
+  int fd = listen_fd_.load();
+  if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
   if (acceptor_.joinable()) acceptor_.join();
+  fd = listen_fd_.exchange(-1);
+  if (fd >= 0) ::close(fd);
   Reap(true);
 }
 

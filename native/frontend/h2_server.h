@@ -172,7 +172,9 @@ class Listener {
   void AcceptLoop();
   void Reap(bool all);
 
-  int listen_fd_ = -1;
+  // Atomic: Stop() shuts the socket down from another thread while
+  // AcceptLoop blocks in accept() on it (close happens only after join).
+  std::atomic<int> listen_fd_{-1};
   int port_ = 0;
   ConnectionCallbacks cbs_;
   std::thread acceptor_;

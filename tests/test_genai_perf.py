@@ -3,12 +3,10 @@ in-repo llm_decode model (reference genai-perf test suite role)."""
 
 import json
 
-import numpy as np
 import pytest
 
 from client_tpu.genai_perf.inputs import create_llm_inputs
 from client_tpu.genai_perf.metrics import (
-    LLMMetrics,
     LLMProfileDataParser,
     Statistics,
     console_table,

@@ -49,16 +49,6 @@ def live_server():
         yield server
 
 
-def test_cpp_example_client(native_build, live_server):
-    out = subprocess.run(
-        [os.path.join(native_build, "simple_http_infer_client"),
-         "-u", live_server.http_url],
-        capture_output=True, text=True, timeout=60,
-    )
-    assert out.returncode == 0, out.stdout + out.stderr
-    assert "PASS" in out.stdout
-
-
 def test_cpp_perf_analyzer_live(native_build, live_server, tmp_path):
     export = tmp_path / "export.json"
     csv = tmp_path / "report.csv"
@@ -434,3 +424,47 @@ def test_cpp_perf_analyzer_ensemble(native_build, live_grpc_server):
     )
     assert summary["throughput"] > 0
     assert summary["errors"] == 0
+
+
+@pytest.fixture(scope="module")
+def live_zoo_grpc_server():
+    """gRPC server with the zoo models (image_classifier for image_client)."""
+    from client_tpu.models.serving import register_zoo_models
+    from client_tpu.server.core import ServerCore
+    from client_tpu.server.model_repository import ModelRepository
+    from client_tpu.testing import InProcessServer
+
+    repo = ModelRepository()
+    core = ServerCore(repo)
+    register_zoo_models(repo, small=True)
+    with InProcessServer(core=core, host="127.0.0.1", http=True) as server:
+        yield server
+
+
+@pytest.mark.parametrize(
+    "example",
+    [
+        "simple_http_infer_client",
+        "simple_grpc_infer_client",
+        "simple_grpc_shm_client",
+        "simple_grpc_tpushm_client",
+        "simple_grpc_sequence_client",
+        "simple_grpc_stream_infer_client",
+        "image_client",
+        "ensemble_chain_client",
+    ],
+)
+def test_cpp_example_suite(native_build, live_zoo_grpc_server, example):
+    """Every C++ example binary smoke-runs against a live server
+    (reference src/c++/examples/ is its de-facto integration suite)."""
+    url = (
+        live_zoo_grpc_server.http_url
+        if "http" in example
+        else live_zoo_grpc_server.grpc_url
+    )
+    out = subprocess.run(
+        [os.path.join(native_build, example), "-u", url],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "PASS" in out.stdout

@@ -16,7 +16,6 @@ import pytest
 import client_tpu.grpc as grpcclient
 import client_tpu.grpc.aio as aio_grpcclient
 from client_tpu.testing import InProcessServer
-from client_tpu.utils import InferenceServerException
 
 
 @pytest.fixture(scope="module")

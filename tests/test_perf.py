@@ -7,7 +7,7 @@ import json
 import numpy as np
 import pytest
 
-from client_tpu.perf.backend import MockPerfBackend, PerfInferInput
+from client_tpu.perf.backend import MockPerfBackend
 from client_tpu.perf.data import DataLoader
 from client_tpu.perf.load_manager import (
     ConcurrencyManager,

@@ -12,7 +12,6 @@ import client_tpu.http as httpclient
 import client_tpu.utils.shared_memory as shm
 import client_tpu.utils.tpu_shared_memory as tpushm
 from client_tpu.testing import InProcessServer
-from client_tpu.utils import bfloat16
 
 
 # ---------------------------------------------------------------------------
