@@ -1,0 +1,260 @@
+"""TensorFlow-Serving and TorchServe REST compatibility front-ends.
+
+Thin protocol adapters over :class:`ServerCore`, giving the perf harness's
+``tensorflow_serving`` / ``torchserve`` backends (reference
+client_backend/tensorflow_serving/, client_backend/torchserve/) live
+endpoints to drive:
+
+- TFS row format (REST API): ``POST /v1/models/<m>:predict`` with
+  ``{"instances": [...]}`` -> ``{"predictions": [...]}``;
+  ``GET /v1/models/<m>`` (status) and ``GET /v1/models/<m>/metadata``
+  (simplified signature block carrying name/dtype/shape per tensor).
+- TorchServe inference API: ``POST /predictions/<m>`` with a raw tensor
+  body (or a JSON list) -> JSON prediction list; ``GET /ping``.
+
+These adapt the WIRE protocols; model semantics stay KServe (dtypes and
+shapes come from the model's own metadata).
+"""
+
+import json
+from typing import Any, Dict
+
+import numpy as np
+from aiohttp import web
+
+from client_tpu.server.core import CoreRequest, CoreTensor, ServerCore
+from client_tpu.utils import (
+    InferenceServerException,
+    triton_to_np_dtype,
+)
+
+_TF_DTYPES = {
+    "FP32": "DT_FLOAT",
+    "FP64": "DT_DOUBLE",
+    "INT32": "DT_INT32",
+    "INT64": "DT_INT64",
+    "INT16": "DT_INT16",
+    "INT8": "DT_INT8",
+    "UINT8": "DT_UINT8",
+    "UINT16": "DT_UINT16",
+    "BOOL": "DT_BOOL",
+    "BYTES": "DT_STRING",
+}
+
+
+class CompatFrontends:
+    """Registers the TFS + TorchServe routes on the aiohttp app."""
+
+    def __init__(self, core: ServerCore):
+        self.core = core
+
+    def add_routes(self, app: web.Application, guarded) -> None:
+        r = app.router
+        r.add_get("/ping", guarded(self.handle_ping))
+        r.add_post("/predictions/{model}", guarded(self.handle_torchserve))
+        # ':' is not an aiohttp separator, so '<name>:predict' arrives as
+        # one path segment.
+        r.add_get("/v1/models/{model_op}", guarded(self.handle_tfs_get))
+        r.add_get(
+            "/v1/models/{model}/metadata", guarded(self.handle_tfs_metadata)
+        )
+        r.add_post("/v1/models/{model_op}", guarded(self.handle_tfs_post))
+
+    # -- TorchServe ----------------------------------------------------------
+
+    async def handle_ping(self, request):
+        return web.json_response(
+            {"status": "Healthy" if self.core.live else "Unhealthy"}
+        )
+
+    async def handle_torchserve(self, request):
+        model_name = request.match_info["model"]
+        model = self.core.repository.get(model_name)
+        if len(model.inputs) != 1:
+            raise InferenceServerException(
+                f"torchserve adapter serves single-input models; "
+                f"'{model_name}' declares {len(model.inputs)}"
+            )
+        desc = model.inputs[0]
+        body = await request.read()
+        shape = self._resolved_shape(model, desc)
+        content_type = request.headers.get("Content-Type", "")
+        if content_type.startswith("application/json"):
+            values = json.loads(body)
+            arr = np.asarray(values, dtype=triton_to_np_dtype(
+                desc["datatype"]))
+        else:
+            np_dtype = triton_to_np_dtype(desc["datatype"])
+            arr = np.frombuffer(body, dtype=np_dtype)
+            try:
+                arr = arr.reshape(shape)
+            except ValueError:
+                arr = arr.reshape([1, -1] if model.max_batch_size > 0
+                                  else [-1])
+        if model.max_batch_size > 0 and arr.ndim == len(desc["shape"]):
+            # Batchable models declare shapes without the batch dim; a bare
+            # instance gains it. Non-batchable shapes are already complete.
+            arr = arr[None]
+        response = await self.core.infer(
+            CoreRequest(
+                model_name=model_name,
+                inputs=[
+                    CoreTensor(
+                        desc["name"],
+                        desc["datatype"],
+                        list(arr.shape),
+                        arr,
+                    )
+                ],
+            )
+        )
+        out = response.outputs[0].data
+        return web.json_response(np.asarray(out).tolist())
+
+    # -- TensorFlow Serving --------------------------------------------------
+
+    async def handle_tfs_get(self, request):
+        model_op = request.match_info["model_op"]
+        model = self.core.repository.get(model_op)
+        ready = self.core.repository.is_ready(model.name, "")
+        return web.json_response(
+            {
+                "model_version_status": [
+                    {
+                        "version": model.version,
+                        "state": "AVAILABLE" if ready else "LOADING",
+                        "status": {"error_code": "OK", "error_message": ""},
+                    }
+                ]
+            }
+        )
+
+    async def handle_tfs_metadata(self, request):
+        model = self.core.repository.get(request.match_info["model"])
+
+        def tensor_block(descs):
+            block: Dict[str, Any] = {}
+            for d in descs:
+                dims = [{"size": str(s)} for s in ([-1] + list(d["shape"])
+                        if model.max_batch_size > 0 else d["shape"])]
+                block[d["name"]] = {
+                    "dtype": _TF_DTYPES.get(d["datatype"], "DT_INVALID"),
+                    "tensor_shape": {"dim": dims},
+                    "name": d["name"],
+                }
+            return block
+
+        return web.json_response(
+            {
+                "model_spec": {"name": model.name,
+                               "version": model.version},
+                "metadata": {
+                    "signature_def": {
+                        "signature_def": {
+                            "serving_default": {
+                                "inputs": tensor_block(model.inputs),
+                                "outputs": tensor_block(model.outputs),
+                            }
+                        }
+                    }
+                },
+            }
+        )
+
+    async def handle_tfs_post(self, request):
+        model_op = request.match_info["model_op"]
+        if not model_op.endswith(":predict"):
+            raise InferenceServerException(
+                f"unsupported TFS verb in '{model_op}' (only :predict)"
+            )
+        model_name = model_op[: -len(":predict")]
+        model = self.core.repository.get(model_name)
+        payload = json.loads(await request.read())
+        inputs = []
+        if "instances" in payload:
+            # Row format: one entry per batch row. Single-input models take
+            # bare values; multi-input models take {name: value} objects.
+            rows = payload["instances"]
+            if not rows:
+                raise InferenceServerException("'instances' is empty")
+            if isinstance(rows[0], dict):
+                names = rows[0].keys()
+                for i, row in enumerate(rows):
+                    if not isinstance(row, dict) or row.keys() != names:
+                        raise InferenceServerException(
+                            f"instance {i} does not carry the same inputs "
+                            f"as instance 0 ({sorted(names)})"
+                        )
+                for name in names:
+                    desc = self._input_desc(model, name)
+                    arr = np.asarray(
+                        [row[name] for row in rows],
+                        dtype=triton_to_np_dtype(desc["datatype"]),
+                    )
+                    inputs.append(
+                        CoreTensor(name, desc["datatype"], list(arr.shape),
+                                   arr)
+                    )
+            else:
+                if len(model.inputs) != 1:
+                    raise InferenceServerException(
+                        "bare 'instances' rows need a single-input model"
+                    )
+                desc = model.inputs[0]
+                arr = np.asarray(
+                    rows, dtype=triton_to_np_dtype(desc["datatype"])
+                )
+                inputs.append(
+                    CoreTensor(desc["name"], desc["datatype"],
+                               list(arr.shape), arr)
+                )
+        elif "inputs" in payload:
+            # Column format: {name: full tensor} (or a bare tensor for
+            # single-input models).
+            cols = payload["inputs"]
+            if not isinstance(cols, dict):
+                desc = model.inputs[0]
+                arr = np.asarray(
+                    cols, dtype=triton_to_np_dtype(desc["datatype"])
+                )
+                cols = {desc["name"]: arr}
+            for name, values in cols.items():
+                desc = self._input_desc(model, name)
+                arr = np.asarray(
+                    values, dtype=triton_to_np_dtype(desc["datatype"])
+                )
+                inputs.append(
+                    CoreTensor(name, desc["datatype"], list(arr.shape), arr)
+                )
+        else:
+            raise InferenceServerException(
+                "TFS predict body needs 'instances' or 'inputs'"
+            )
+
+        response = await self.core.infer(
+            CoreRequest(model_name=model_name, inputs=inputs)
+        )
+        if len(response.outputs) == 1:
+            predictions = np.asarray(response.outputs[0].data).tolist()
+        else:
+            predictions = {
+                t.name: np.asarray(t.data).tolist()
+                for t in response.outputs
+            }
+        return web.json_response({"predictions": predictions})
+
+    # -- helpers -------------------------------------------------------------
+
+    @staticmethod
+    def _input_desc(model, name):
+        for d in model.inputs:
+            if d["name"] == name:
+                return d
+        raise InferenceServerException(
+            f"model '{model.name}' has no input '{name}'"
+        )
+
+    @staticmethod
+    def _resolved_shape(model, desc):
+        shape = [1] + [int(s) for s in desc["shape"]]
+        return [s if s > 0 else -1 for s in shape]

@@ -123,6 +123,10 @@ class HttpServer:
         from client_tpu.server.openai_frontend import OpenAiFrontend
 
         OpenAiFrontend(self.core).add_routes(self.app, _guarded)
+        # TFS + TorchServe REST compatibility (perf-harness backends).
+        from client_tpu.server.compat_frontends import CompatFrontends
+
+        CompatFrontends(self.core).add_routes(self.app, _guarded)
 
     # -- health / metadata ---------------------------------------------------
 

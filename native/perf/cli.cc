@@ -127,7 +127,8 @@ std::string Usage() {
       "  -f FILE                     CSV report path\n"
       "  --profile-export-file FILE  per-request JSON export\n"
       "  --json-summary              print one-line JSON summary\n"
-      "  --service-kind KIND         kserve (default) | openai | local\n"
+      "  --service-kind KIND         kserve (default) | openai | local |\n"
+      "                              tfserving | torchserve\n"
       "                              (local = in-process server, no network;\n"
       "                               needs repo root + venv on PYTHONPATH)\n"
       "  --local-zoo-models          local: also load resnet/llm_decode\n"
@@ -334,9 +335,23 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
                  std::to_string(params->batch_size));
   }
   if (params->service_kind != "kserve" && params->service_kind != "openai" &&
-      params->service_kind != "local") {
-    return Error("--service-kind must be kserve, openai or local, got '" +
-                 params->service_kind + "'");
+      params->service_kind != "local" &&
+      params->service_kind != "tfserving" &&
+      params->service_kind != "torchserve") {
+    return Error("--service-kind must be kserve, openai, local, tfserving "
+                 "or torchserve, got '" + params->service_kind + "'");
+  }
+  if (params->service_kind == "tfserving" ||
+      params->service_kind == "torchserve") {
+    if (params->shared_memory != "none") {
+      return Error("--shared-memory is not supported by the " +
+                   params->service_kind + " service kind");
+    }
+    if (params->protocol != "http") {
+      return Error("--service-kind " + params->service_kind +
+                   " is REST-only; -i " + params->protocol +
+                   " is not supported");
+    }
   }
   if (params->streaming &&
       !((params->service_kind == "kserve" && params->protocol == "grpc") ||

@@ -5,6 +5,8 @@
 #include "local_backend.h"
 #include "mock_backend.h"
 #include "openai_backend.h"
+#include "tfs_backend.h"
+#include "torchserve_backend.h"
 
 namespace ctpu {
 namespace perf {
@@ -23,6 +25,11 @@ Error CreateClientBackend(const BackendFactoryConfig& config,
     case BackendKind::LOCAL:
       return LocalClientBackend::Create(config.verbose, config.local_zoo,
                                         backend);
+    case BackendKind::TFS:
+      return TfsClientBackend::Create(config.url, config.verbose, backend);
+    case BackendKind::TORCHSERVE:
+      return TorchServeClientBackend::Create(config.url, config.verbose,
+                                             backend);
     case BackendKind::MOCK:
       backend->reset(new MockClientBackend());
       return Error::Success();

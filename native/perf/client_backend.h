@@ -24,7 +24,15 @@
 namespace ctpu {
 namespace perf {
 
-enum class BackendKind { KSERVE_HTTP, KSERVE_GRPC, OPENAI, LOCAL, MOCK };
+enum class BackendKind {
+  KSERVE_HTTP,
+  KSERVE_GRPC,
+  OPENAI,
+  LOCAL,
+  TFS,
+  TORCHSERVE,
+  MOCK,
+};
 
 // One worker's issuing handle; not thread-safe (one context per thread).
 class BackendContext {

@@ -75,6 +75,14 @@ int main(int argc, char** argv) {
     backend_config.kind = BackendKind::LOCAL;
     backend_config.local_zoo = params.local_zoo;
   }
+  if (params.service_kind == "tfserving") {
+    backend_config.kind = BackendKind::TFS;
+    if (!params.url_set) backend_config.url = "localhost:8501";
+  }
+  if (params.service_kind == "torchserve") {
+    backend_config.kind = BackendKind::TORCHSERVE;
+    if (!params.url_set) backend_config.url = "localhost:8080";
+  }
   std::shared_ptr<ClientBackend> backend;
   err = CreateClientBackend(backend_config, &backend);
   if (!err.IsOk()) return fail(err, "create backend");

@@ -1,0 +1,250 @@
+#include "tfs_backend.h"
+
+#include <cstring>
+
+namespace ctpu {
+namespace perf {
+
+namespace {
+
+// Floats emit as doubles; integers via the int64 constructor so values
+// above 2^53 survive JSON encoding exactly.
+template <typename T>
+void AppendNumbers(const std::string& bytes, json::Array* flat) {
+  const size_t n = bytes.size() / sizeof(T);
+  const T* p = reinterpret_cast<const T*>(bytes.data());
+  for (size_t i = 0; i < n; ++i) {
+    if (std::is_integral<T>::value) {
+      flat->push_back(json::Value((int64_t)p[i]));
+    } else {
+      flat->push_back(json::Value((double)p[i]));
+    }
+  }
+}
+
+// Nests a flat value list per the non-leading dims (row-major).
+json::Value Nest(const std::vector<json::Value>& flat, size_t* index,
+                 const std::vector<int64_t>& shape, size_t dim) {
+  if (dim == shape.size()) {
+    return flat[(*index)++];
+  }
+  json::Array arr;
+  for (int64_t i = 0; i < shape[dim]; ++i) {
+    arr.push_back(Nest(flat, index, shape, dim + 1));
+  }
+  return json::Value(std::move(arr));
+}
+
+}  // namespace
+
+Error TensorBytesToJson(const std::string& datatype,
+                        const std::vector<int64_t>& shape,
+                        const std::string& bytes, json::Value* out) {
+  json::Array flat;
+  if (datatype == "FP32") AppendNumbers<float>(bytes, &flat);
+  else if (datatype == "FP64") AppendNumbers<double>(bytes, &flat);
+  else if (datatype == "INT32") AppendNumbers<int32_t>(bytes, &flat);
+  else if (datatype == "INT64") AppendNumbers<int64_t>(bytes, &flat);
+  else if (datatype == "INT16") AppendNumbers<int16_t>(bytes, &flat);
+  else if (datatype == "INT8") AppendNumbers<int8_t>(bytes, &flat);
+  else if (datatype == "UINT8") AppendNumbers<uint8_t>(bytes, &flat);
+  else if (datatype == "UINT16") AppendNumbers<uint16_t>(bytes, &flat);
+  else if (datatype == "BOOL") AppendNumbers<uint8_t>(bytes, &flat);
+  else {
+    return Error("TFS row format cannot carry dtype '" + datatype + "'");
+  }
+  int64_t expected = 1;
+  for (int64_t d : shape) expected *= d;
+  if ((int64_t)flat.size() != expected) {
+    return Error("tensor bytes hold " + std::to_string(flat.size()) +
+                 " elements but shape needs " + std::to_string(expected));
+  }
+  size_t index = 0;
+  json::Array rows;
+  // Leading dim = batch rows (TFS row format). json::Array IS a
+  // vector<Value>, so Nest consumes `flat` directly — no element copies.
+  std::vector<int64_t> row_shape(shape.begin() + 1, shape.end());
+  int64_t nrows = shape.empty() ? 1 : shape[0];
+  for (int64_t r = 0; r < nrows; ++r) {
+    rows.push_back(Nest(flat, &index, row_shape, 0));
+  }
+  *out = json::Value(std::move(rows));
+  return Error::Success();
+}
+
+Error TfsClientBackend::Create(const std::string& url, bool verbose,
+                               std::shared_ptr<ClientBackend>* backend) {
+  const size_t colon = url.rfind(':');
+  if (colon == std::string::npos) {
+    return Error("url must be host:port, got '" + url + "'");
+  }
+  backend->reset(new TfsClientBackend(url.substr(0, colon),
+                                      std::atoi(url.c_str() + colon + 1),
+                                      verbose));
+  return Error::Success();
+}
+
+Error TfsClientBackend::ModelMetadata(json::Value* metadata,
+                                      const std::string& model_name,
+                                      const std::string& model_version) {
+  (void)model_version;
+  HttpConnection conn(host_, port_);
+  int status = 0;
+  std::string headers, body;
+  CTPU_RETURN_IF_ERROR(conn.Roundtrip(
+      "GET", "v1/models/" + model_name + "/metadata", {}, nullptr, 0,
+      &status, &headers, &body));
+  if (status != 200) {
+    return Error("TFS metadata returned HTTP " + std::to_string(status) +
+                 ": " + body);
+  }
+  json::Value doc;
+  try {
+    doc = json::Parse(body);
+  } catch (const std::exception& e) {
+    return Error(std::string("malformed TFS metadata: ") + e.what());
+  }
+  const json::Value& sig =
+      doc["metadata"]["signature_def"]["signature_def"]["serving_default"];
+  if (!sig.IsObject()) {
+    return Error("TFS metadata has no serving_default signature");
+  }
+  // Normalize into the KServe metadata shape the harness uses everywhere.
+  std::string bad_dtype_msg;
+  std::string* bad_dtype = &bad_dtype_msg;
+  auto convert = [bad_dtype](const json::Value& block) {
+    json::Array tensors;
+    if (!block.IsObject()) return tensors;
+    for (const auto& kv : block.AsObject()) {
+      json::Object t;
+      t["name"] = kv.first;
+      const std::string dtype = kv.second["dtype"].IsString()
+                                    ? kv.second["dtype"].AsString()
+                                    : "";
+      const std::string mapped = dtype == "DT_FLOAT" ? "FP32"
+                                 : dtype == "DT_DOUBLE" ? "FP64"
+                                 : dtype == "DT_INT32" ? "INT32"
+                                 : dtype == "DT_INT64" ? "INT64"
+                                 : dtype == "DT_INT16" ? "INT16"
+                                 : dtype == "DT_INT8" ? "INT8"
+                                 : dtype == "DT_UINT8" ? "UINT8"
+                                 : dtype == "DT_UINT16" ? "UINT16"
+                                 : dtype == "DT_BOOL" ? "BOOL"
+                                 : dtype == "DT_STRING" ? "BYTES"
+                                                        : "";
+      if (mapped.empty()) {
+        // Surface unsupported dtypes at startup, not as per-request
+        // failures against synthesized wrong-typed data.
+        *bad_dtype = "signature tensor '" + kv.first +
+                     "' has unsupported dtype '" + dtype + "'";
+        return tensors;
+      }
+      t["datatype"] = mapped;
+      json::Array shape;
+      const json::Value& dims = kv.second["tensor_shape"]["dim"];
+      if (dims.IsArray()) {
+        for (const auto& d : dims.AsArray()) {
+          int64_t size = d["size"].IsString()
+                             ? std::atoll(d["size"].AsString().c_str())
+                             : d["size"].AsInt();
+          shape.push_back(json::Value(size));
+        }
+      }
+      t["shape"] = json::Value(std::move(shape));
+      tensors.push_back(json::Value(std::move(t)));
+    }
+    return tensors;
+  };
+  json::Object meta;
+  meta["name"] = model_name;
+  meta["inputs"] = json::Value(convert(sig["inputs"]));
+  meta["outputs"] = json::Value(convert(sig["outputs"]));
+  if (!bad_dtype_msg.empty()) {
+    return Error("TFS model '" + model_name + "': " + bad_dtype_msg);
+  }
+  *metadata = json::Value(std::move(meta));
+  return Error::Success();
+}
+
+Error TfsClientBackend::ModelConfig(json::Value* config,
+                                    const std::string& model_name,
+                                    const std::string& model_version) {
+  (void)model_version;
+  // TFS has no Triton-style config; leading -1 dims in the signature play
+  // the batch-dim role (reference tfserve backend does the same).
+  json::Object obj;
+  obj["name"] = model_name;
+  obj["max_batch_size"] = json::Value((int64_t)0);
+  *config = json::Value(std::move(obj));
+  return Error::Success();
+}
+
+Error TfsBackendContext::Infer(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    RequestRecord* record) {
+  (void)outputs;
+  json::Object body;
+  json::Array instances;
+  if (inputs.size() == 1) {
+    std::string raw;
+    inputs[0]->ConcatenatedData(&raw);
+    json::Value rows;
+    CTPU_RETURN_IF_ERROR(TensorBytesToJson(inputs[0]->Datatype(),
+                                           inputs[0]->Shape(), raw, &rows));
+    instances = rows.AsArray();
+  } else {
+    // Row objects: {name: row} — all inputs must share the batch dim.
+    std::vector<json::Value> per_input;
+    int64_t nrows = -1;
+    for (const InferInput* input : inputs) {
+      std::string raw;
+      input->ConcatenatedData(&raw);
+      json::Value rows;
+      CTPU_RETURN_IF_ERROR(
+          TensorBytesToJson(input->Datatype(), input->Shape(), raw, &rows));
+      int64_t n = (int64_t)rows.AsArray().size();
+      if (nrows >= 0 && n != nrows) {
+        return Error("TFS row format needs a shared batch dim");
+      }
+      nrows = n;
+      per_input.push_back(std::move(rows));
+    }
+    for (int64_t r = 0; r < nrows; ++r) {
+      json::Object row;
+      for (size_t i = 0; i < inputs.size(); ++i) {
+        row[inputs[i]->Name()] = per_input[i].AsArray()[r];
+      }
+      instances.push_back(json::Value(std::move(row)));
+    }
+  }
+  body["instances"] = json::Value(std::move(instances));
+  const std::string payload = json::Value(std::move(body)).Dump();
+
+  record->request_id = 0;
+  record->start_ns = RequestTimers::Now();
+  int status = 0;
+  std::string resp_headers, resp_body;
+  Error err = conn_.Roundtrip(
+      "POST", "v1/models/" + options.model_name + ":predict",
+      {"Content-Type: application/json"}, payload.data(), payload.size(),
+      &status, &resp_headers, &resp_body,
+      (int64_t)options.client_timeout_us);
+  record->end_ns = RequestTimers::Now();
+  record->response_ns.push_back(record->end_ns);
+  if (!err.IsOk()) {
+    record->success = false;
+    record->error = err.Message();
+    return err;
+  }
+  if (status != 200) {
+    record->success = false;
+    record->error = "TFS predict HTTP " + std::to_string(status);
+    return Error(record->error + ": " + resp_body.substr(0, 200));
+  }
+  record->success = true;
+  return Error::Success();
+}
+
+}  // namespace perf
+}  // namespace ctpu

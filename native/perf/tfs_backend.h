@@ -1,0 +1,60 @@
+// TensorFlow-Serving REST backend (role parity with the reference's
+// tensorflow_serving client backend, reference
+// client_backend/tensorflow_serving/): drives /v1/models/<m>:predict with
+// row-format JSON instances; metadata comes from the TFS metadata
+// endpoint's signature block. No shm / streaming (same restrictions the
+// reference documents for this service kind).
+#pragma once
+
+#include "client_backend.h"
+#include "http_client.h"
+
+namespace ctpu {
+namespace perf {
+
+class TfsBackendContext : public BackendContext {
+ public:
+  TfsBackendContext(const std::string& host, int port)
+      : conn_(host, port) {}
+
+  Error Infer(const InferOptions& options,
+              const std::vector<InferInput*>& inputs,
+              const std::vector<const InferRequestedOutput*>& outputs,
+              RequestRecord* record) override;
+
+ private:
+  HttpConnection conn_;
+};
+
+class TfsClientBackend : public ClientBackend {
+ public:
+  static Error Create(const std::string& url, bool verbose,
+                      std::shared_ptr<ClientBackend>* backend);
+
+  BackendKind Kind() const override { return BackendKind::TFS; }
+  Error ModelMetadata(json::Value* metadata, const std::string& model_name,
+                      const std::string& model_version) override;
+  Error ModelConfig(json::Value* config, const std::string& model_name,
+                    const std::string& model_version) override;
+  std::unique_ptr<BackendContext> CreateContext() override {
+    return std::unique_ptr<BackendContext>(
+        new TfsBackendContext(host_, port_));
+  }
+
+ private:
+  TfsClientBackend(std::string host, int port, bool verbose)
+      : host_(std::move(host)), port_(port), verbose_(verbose) {}
+
+  std::string host_;
+  int port_ = 0;
+  bool verbose_ = false;
+};
+
+// Converts raw little-endian tensor bytes to a JSON value list (row major,
+// nested per shape). Exposed for the torchserve/tfs unit tests.
+Error TensorBytesToJson(const std::string& datatype,
+                        const std::vector<int64_t>& shape,
+                        const std::string& bytes, json::Value* out);
+
+}  // namespace perf
+}  // namespace ctpu

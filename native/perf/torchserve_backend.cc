@@ -1,0 +1,113 @@
+#include "torchserve_backend.h"
+
+#include <cstring>
+
+namespace ctpu {
+namespace perf {
+
+Error TorchServeClientBackend::Create(
+    const std::string& url, bool verbose,
+    std::shared_ptr<ClientBackend>* backend) {
+  const size_t colon = url.rfind(':');
+  if (colon == std::string::npos) {
+    return Error("url must be host:port, got '" + url + "'");
+  }
+  auto* b = new TorchServeClientBackend(
+      url.substr(0, colon), std::atoi(url.c_str() + colon + 1), verbose);
+  // Health probe (GET /ping, the TorchServe inference-API health check).
+  HttpConnection conn(b->host_, b->port_);
+  int status = 0;
+  std::string headers, body;
+  Error err =
+      conn.Roundtrip("GET", "ping", {}, nullptr, 0, &status, &headers,
+                     &body);
+  if (!err.IsOk() || status != 200) {
+    delete b;
+    return Error("TorchServe /ping failed: " +
+                 (err.IsOk() ? "HTTP " + std::to_string(status)
+                             : err.Message()));
+  }
+  backend->reset(b);
+  return Error::Success();
+}
+
+Error TorchServeClientBackend::ModelMetadata(json::Value* metadata,
+                                             const std::string& model_name,
+                                             const std::string& model_version) {
+  (void)model_version;
+  // Fabricated contract (reference torchserve backend does the same): one
+  // dynamic BYTES input carrying the request body.
+  json::Object meta;
+  meta["name"] = model_name;
+  json::Array inputs;
+  json::Object in;
+  in["name"] = "data";
+  in["datatype"] = "BYTES";
+  json::Array shape;
+  shape.push_back(json::Value((int64_t)-1));
+  in["shape"] = json::Value(std::move(shape));
+  inputs.push_back(json::Value(std::move(in)));
+  meta["inputs"] = json::Value(std::move(inputs));
+  meta["outputs"] = json::Value(json::Array{});
+  *metadata = json::Value(std::move(meta));
+  return Error::Success();
+}
+
+Error TorchServeClientBackend::ModelConfig(json::Value* config,
+                                           const std::string& model_name,
+                                           const std::string& model_version) {
+  (void)model_version;
+  json::Object obj;
+  obj["name"] = model_name;
+  obj["max_batch_size"] = json::Value((int64_t)0);
+  *config = json::Value(std::move(obj));
+  return Error::Success();
+}
+
+Error TorchServeBackendContext::Infer(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    RequestRecord* record) {
+  (void)outputs;
+  if (inputs.empty()) {
+    return Error("torchserve backend needs one input");
+  }
+  std::string raw;
+  inputs[0]->ConcatenatedData(&raw);
+  // BYTES tensors carry a 4-byte length prefix per element; a single
+  // element unwraps to its payload (file bytes, JSON, ...). Non-BYTES
+  // tensors post their raw bytes unchanged.
+  std::string body = raw;
+  if (inputs[0]->Datatype() == "BYTES" && raw.size() >= 4) {
+    uint32_t len;
+    std::memcpy(&len, raw.data(), 4);
+    if (len == raw.size() - 4) body = raw.substr(4);
+  }
+
+  record->request_id = 0;
+  record->start_ns = RequestTimers::Now();
+  int status = 0;
+  std::string resp_headers, resp_body;
+  Error err = conn_.Roundtrip(
+      "POST", "predictions/" + options.model_name,
+      {"Content-Type: application/octet-stream"}, body.data(), body.size(),
+      &status, &resp_headers, &resp_body,
+      (int64_t)options.client_timeout_us);
+  record->end_ns = RequestTimers::Now();
+  record->response_ns.push_back(record->end_ns);
+  if (!err.IsOk()) {
+    record->success = false;
+    record->error = err.Message();
+    return err;
+  }
+  if (status != 200) {
+    record->success = false;
+    record->error = "TorchServe predict HTTP " + std::to_string(status);
+    return Error(record->error + ": " + resp_body.substr(0, 200));
+  }
+  record->success = true;
+  return Error::Success();
+}
+
+}  // namespace perf
+}  // namespace ctpu

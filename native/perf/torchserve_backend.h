@@ -1,0 +1,54 @@
+// TorchServe REST backend (role parity with the reference's torchserve
+// client backend, reference client_backend/torchserve/): POSTs the first
+// input's raw bytes to /predictions/<model>. Like the reference, model
+// metadata is fabricated client-side (TorchServe's management API carries
+// no tensor signatures) — a single BYTES "data" input the data loader
+// fills from --input-data, or raw tensor bytes via --shape overrides.
+#pragma once
+
+#include "client_backend.h"
+#include "http_client.h"
+
+namespace ctpu {
+namespace perf {
+
+class TorchServeBackendContext : public BackendContext {
+ public:
+  TorchServeBackendContext(const std::string& host, int port)
+      : conn_(host, port) {}
+
+  Error Infer(const InferOptions& options,
+              const std::vector<InferInput*>& inputs,
+              const std::vector<const InferRequestedOutput*>& outputs,
+              RequestRecord* record) override;
+
+ private:
+  HttpConnection conn_;
+};
+
+class TorchServeClientBackend : public ClientBackend {
+ public:
+  static Error Create(const std::string& url, bool verbose,
+                      std::shared_ptr<ClientBackend>* backend);
+
+  BackendKind Kind() const override { return BackendKind::TORCHSERVE; }
+  Error ModelMetadata(json::Value* metadata, const std::string& model_name,
+                      const std::string& model_version) override;
+  Error ModelConfig(json::Value* config, const std::string& model_name,
+                    const std::string& model_version) override;
+  std::unique_ptr<BackendContext> CreateContext() override {
+    return std::unique_ptr<BackendContext>(
+        new TorchServeBackendContext(host_, port_));
+  }
+
+ private:
+  TorchServeClientBackend(std::string host, int port, bool verbose)
+      : host_(std::move(host)), port_(port), verbose_(verbose) {}
+
+  std::string host_;
+  int port_ = 0;
+  bool verbose_ = false;
+};
+
+}  // namespace perf
+}  // namespace ctpu

@@ -1,0 +1,98 @@
+"""TFS + TorchServe REST compatibility front-ends (the live endpoints the
+perf harness's tensorflow_serving/torchserve backends drive)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from client_tpu.testing import InProcessServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    with InProcessServer(grpc=False) as s:
+        yield s
+
+
+def _get(server, path):
+    return urllib.request.urlopen(
+        f"http://{server.http_url}{path}", timeout=30
+    )
+
+
+def _post(server, path, body, content_type="application/json"):
+    req = urllib.request.Request(
+        f"http://{server.http_url}{path}",
+        data=body,
+        headers={"Content-Type": content_type},
+    )
+    return urllib.request.urlopen(req, timeout=30)
+
+
+def test_torchserve_ping(server):
+    with _get(server, "/ping") as r:
+        assert json.load(r)["status"] == "Healthy"
+
+
+def test_torchserve_predict_raw_and_json(server):
+    # raw int32 bytes (identity passthrough is simplest single-input model)
+    data = np.arange(6, dtype=np.float32)
+    with _post(server, "/predictions/identity_fp32", data.tobytes(),
+               "application/octet-stream") as r:
+        out = json.load(r)
+    assert np.allclose(np.asarray(out).reshape(-1), data)
+    # JSON body
+    with _post(server, "/predictions/identity_fp32",
+               json.dumps([1.5, 2.5]).encode()) as r:
+        out = json.load(r)
+    assert np.allclose(np.asarray(out).reshape(-1), [1.5, 2.5])
+
+
+def test_tfs_status_and_metadata(server):
+    with _get(server, "/v1/models/simple") as r:
+        status = json.load(r)
+    assert status["model_version_status"][0]["state"] == "AVAILABLE"
+    with _get(server, "/v1/models/simple/metadata") as r:
+        meta = json.load(r)
+    sig = meta["metadata"]["signature_def"]["signature_def"][
+        "serving_default"
+    ]
+    assert sig["inputs"]["INPUT0"]["dtype"] == "DT_INT32"
+    # batchable model: leading -1 batch dim in the signature shape
+    dims = [d["size"] for d in sig["inputs"]["INPUT0"]["tensor_shape"]["dim"]]
+    assert dims == ["-1", "16"]
+
+
+def test_tfs_predict_row_format(server):
+    body = {
+        "instances": [
+            {"INPUT0": list(range(16)), "INPUT1": [1] * 16},
+            {"INPUT0": [5] * 16, "INPUT1": [2] * 16},
+        ]
+    }
+    with _post(server, "/v1/models/simple:predict",
+               json.dumps(body).encode()) as r:
+        doc = json.load(r)
+    # multi-output model -> name-keyed predictions
+    sums = np.asarray(doc["predictions"]["OUTPUT0"])
+    assert sums.shape == (2, 16)
+    assert sums[0][3] == 4  # 3 + 1
+    assert sums[1][0] == 7  # 5 + 2
+
+
+def test_tfs_predict_column_format(server):
+    body = {"inputs": {"INPUT0": [[1] * 16], "INPUT1": [[9] * 16]}}
+    with _post(server, "/v1/models/simple:predict",
+               json.dumps(body).encode()) as r:
+        doc = json.load(r)
+    assert np.asarray(doc["predictions"]["OUTPUT1"])[0][0] == -8
+
+
+def test_tfs_bad_verb(server):
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _post(server, "/v1/models/simple:explain", b"{}")
+    assert err.value.code == 400

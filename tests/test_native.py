@@ -468,3 +468,61 @@ def test_cpp_example_suite(native_build, live_zoo_grpc_server, example):
     )
     assert out.returncode == 0, out.stdout + out.stderr
     assert "PASS" in out.stdout
+
+
+def test_cpp_perf_analyzer_tfserving(native_build, live_zoo_grpc_server):
+    """--service-kind tfserving drives the TFS REST adapter: metadata from
+    the signature block, row-format JSON instances (reference
+    client_backend/tensorflow_serving/ role)."""
+    out = subprocess.run(
+        [os.path.join(native_build, "perf_analyzer"),
+         "-m", "text_encoder", "-u", live_zoo_grpc_server.http_url,
+         "--service-kind", "tfserving",
+         "--shape", "INPUT_IDS:8",
+         "--warmup-request-period", "1",
+         "--concurrency-range", "2",
+         "--measurement-interval", "1000",
+         "--stability-percentage", "80",
+         "--max-trials", "2",
+         "--json-summary"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    summary = json.loads(
+        [l for l in out.stdout.splitlines() if l.strip().startswith("{")][0]
+    )
+    assert summary["throughput"] > 0
+    assert summary["errors"] == 0
+
+
+def test_cpp_perf_analyzer_torchserve(native_build, live_zoo_grpc_server,
+                                      tmp_path):
+    """--service-kind torchserve posts raw bodies to /predictions/<m>
+    (reference client_backend/torchserve/ role; like the reference, input
+    bytes come from --input-data)."""
+    import numpy as np
+
+    # TorchServe's fabricated contract is a BYTES 'data' input; feed it the
+    # raw int32 tensor the text_encoder adapter will decode.
+    (tmp_path / "data").write_bytes(
+        np.arange(1, 9, dtype=np.int32).tobytes()
+    )
+    out = subprocess.run(
+        [os.path.join(native_build, "perf_analyzer"),
+         "-m", "text_encoder", "-u", live_zoo_grpc_server.http_url,
+         "--service-kind", "torchserve",
+         "--input-data", str(tmp_path),
+         "--warmup-request-period", "1",
+         "--concurrency-range", "2",
+         "--measurement-interval", "1000",
+         "--stability-percentage", "80",
+         "--max-trials", "2",
+         "--json-summary"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    summary = json.loads(
+        [l for l in out.stdout.splitlines() if l.strip().startswith("{")][0]
+    )
+    assert summary["throughput"] > 0
+    assert summary["errors"] == 0
