@@ -53,6 +53,81 @@ def generate_plots(profile_export_path: str, artifact_dir: str) -> None:
         fig.savefig(os.path.join(artifact_dir, "token_timeline.png"))
         plt.close(fig)
 
+    # Inter-token latency distribution (reference token-to-token plot).
+    itls = []
+    by_position = {}  # token index -> [itl_ms]
+    for r in timeline:
+        stamps = r["response_timestamps"]
+        for k in range(1, len(stamps)):
+            itl_ms = (stamps[k] - stamps[k - 1]) / 1e6
+            itls.append(itl_ms)
+            by_position.setdefault(k, []).append(itl_ms)
+    if itls:
+        fig, ax = plt.subplots(figsize=(6, 4))
+        ax.hist(itls, bins=40)
+        ax.set_xlabel("inter-token latency (ms)")
+        ax.set_ylabel("token transitions")
+        ax.set_title("ITL distribution")
+        fig.tight_layout()
+        fig.savefig(os.path.join(artifact_dir, "itl_distribution.png"))
+        plt.close(fig)
+
+    # ITL by token position: exposes warm-up / cache-growth trends the
+    # aggregate histogram hides (reference per-position token plot).
+    if by_position:
+        all_positions = sorted(by_position)
+        positions = all_positions[:256]
+        means = [sum(by_position[p]) / len(by_position[p])
+                 for p in positions]
+        p95s = [sorted(by_position[p])[int(0.95 * (len(by_position[p]) - 1))]
+                for p in positions]
+        fig, ax = plt.subplots(figsize=(7, 4))
+        ax.plot(positions, means, label="mean")
+        ax.plot(positions, p95s, label="p95", linestyle="--")
+        ax.set_xlabel("output token position")
+        ax.set_ylabel("inter-token latency (ms)")
+        title = "ITL by token position"
+        if len(all_positions) > len(positions):
+            title += (f" (first {len(positions)} of "
+                      f"{len(all_positions)} positions)")
+        ax.set_title(title)
+        ax.legend()
+        fig.tight_layout()
+        fig.savefig(os.path.join(artifact_dir, "itl_by_position.png"))
+        plt.close(fig)
+
+    # Output-token count distribution.
+    counts = [len(r["response_timestamps"]) for r in timeline]
+    if counts:
+        fig, ax = plt.subplots(figsize=(6, 4))
+        ax.hist(counts, bins=min(30, max(counts) - min(counts) + 1 or 1))
+        ax.set_xlabel("output tokens per request")
+        ax.set_ylabel("requests")
+        ax.set_title("Output token counts")
+        fig.tight_layout()
+        fig.savefig(os.path.join(artifact_dir, "output_tokens.png"))
+        plt.close(fig)
+
+    # Rolling token throughput over the run (1s buckets). Empty seconds
+    # plot as zero — a stall must read as a stall, not as interpolated
+    # sustained throughput.
+    arrivals = [t for r in timeline for t in r["response_timestamps"]]
+    if arrivals:
+        base = min(arrivals)
+        buckets = {}
+        for t in arrivals:
+            b = int((t - base) / 1e9)
+            buckets[b] = buckets.get(b, 0) + 1
+        xs = list(range(0, max(buckets) + 1))
+        fig, ax = plt.subplots(figsize=(7, 4))
+        ax.plot(xs, [buckets.get(x, 0) for x in xs])
+        ax.set_xlabel("time (s)")
+        ax.set_ylabel("tokens / s")
+        ax.set_title("Token throughput over the run")
+        fig.tight_layout()
+        fig.savefig(os.path.join(artifact_dir, "throughput_over_time.png"))
+        plt.close(fig)
+
 
 def _extract_times_ms(profile_export_path: str):
     """(ttfts_ms, latencies_ms) from a profile export's first experiment."""

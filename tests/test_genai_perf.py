@@ -357,3 +357,41 @@ def test_structured_logging():
     assert log.name == "genai_perf.main"
     log.info("structured %s", "message")
     assert "[INFO] genai_perf.main - structured message" in stream.getvalue()
+
+
+def test_generate_plots_full_set(tmp_path):
+    """All six per-run plots render from a profile export (reference
+    genai-perf plots/ coverage)."""
+    pytest.importorskip("matplotlib")
+    from client_tpu.genai_perf.plots import generate_plots
+
+    ms = 1_000_000
+    doc = {
+        "experiments": [
+            {
+                "experiment": {"mode": "concurrency", "value": 1},
+                "requests": [
+                    {
+                        "timestamp": i * ms,
+                        "response_timestamps": [
+                            (i + 3 + k) * ms for k in range(5)
+                        ],
+                        "success": True,
+                    }
+                    for i in range(12)
+                ],
+            }
+        ]
+    }
+    export = tmp_path / "profile.json"
+    export.write_text(json.dumps(doc))
+    generate_plots(str(export), str(tmp_path))
+    for name in (
+        "ttft_distribution.png",
+        "token_timeline.png",
+        "itl_distribution.png",
+        "itl_by_position.png",
+        "output_tokens.png",
+        "throughput_over_time.png",
+    ):
+        assert (tmp_path / name).exists(), name
