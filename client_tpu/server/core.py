@@ -604,9 +604,17 @@ class ServerCore:
     def _package_outputs(
         self, model: Model, request: CoreRequest, raw: Dict[str, np.ndarray]
     ) -> CoreResponse:
-        requested = request.outputs or [
-            CoreRequestedOutput(name=o["name"]) for o in model.outputs
-        ]
+        requested = request.outputs
+        if not requested:
+            # Hot path: the default "all declared outputs" list is
+            # per-model-constant; cache it on the model object.
+            requested = getattr(model, "_ctpu_default_outputs", None)
+            if requested is None:
+                requested = [
+                    CoreRequestedOutput(name=o["name"])
+                    for o in model.outputs
+                ]
+                model._ctpu_default_outputs = requested
         out_tensors: List[CoreTensor] = []
         shm_outputs: Dict[str, Any] = {}
         for req_out in requested:
