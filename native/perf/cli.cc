@@ -107,6 +107,10 @@ std::string Usage() {
       "  --latency-threshold MS      stop sweep past this latency\n"
       "  --percentile P              latency percentile for stability\n"
       "  --warmup-request-period S   warmup seconds before measuring\n"
+      "  --input-tensor-format F     binary (default) | json HTTP bodies\n"
+      "  --trace-level L             forward trace level(s) to the server\n"
+      "  --trace-rate N / --trace-count N / --log-frequency N\n"
+      "                              forwarded trace knobs (trace API)\n"
       "  --input-data FILE|DIR       input-data JSON, or a directory of\n"
       "                              per-input files (raw bytes; BYTES =\n"
       "                              whole file as one element)\n"
@@ -236,6 +240,26 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
     } else if (arg == "--warmup-request-period") {
       CTPU_RETURN_IF_ERROR(need(i));
       params->warmup_s = std::stod(next());
+    } else if (arg == "--input-tensor-format") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->input_tensor_format = next();
+      if (params->input_tensor_format != "binary" &&
+          params->input_tensor_format != "json") {
+        return Error("--input-tensor-format must be binary or json, got '" +
+                     params->input_tensor_format + "'");
+      }
+    } else if (arg == "--trace-level") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->trace_settings["trace_level"].push_back(next());
+    } else if (arg == "--trace-rate") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->trace_settings["trace_rate"] = {next()};
+    } else if (arg == "--trace-count") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->trace_settings["trace_count"] = {next()};
+    } else if (arg == "--log-frequency") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->trace_settings["log_frequency"] = {next()};
     } else if (arg == "--input-data") {
       CTPU_RETURN_IF_ERROR(need(i));
       params->input_data_file = next();
@@ -340,6 +364,10 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
       params->service_kind != "torchserve") {
     return Error("--service-kind must be kserve, openai, local, tfserving "
                  "or torchserve, got '" + params->service_kind + "'");
+  }
+  if (params->input_tensor_format == "json" &&
+      !(params->service_kind == "kserve" && params->protocol == "http")) {
+    return Error("--input-tensor-format json applies to kserve HTTP only");
   }
   if (params->service_kind == "tfserving" ||
       params->service_kind == "torchserve") {

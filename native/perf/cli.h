@@ -45,6 +45,12 @@ struct PAParams {
   double warmup_s = 0;
 
   std::string input_data_file;
+  // binary (default) | json: HTTP inference body tensor encoding
+  // (reference kInputTensorFormat).
+  std::string input_tensor_format = "binary";
+  // Forwarded to the server's trace API before the run (reference
+  // client_backend.h:296): --trace-level/-rate/-count/--log-frequency.
+  std::map<std::string, std::vector<std::string>> trace_settings;
   std::map<std::string, std::vector<int64_t>> shape_overrides;
   std::string shared_memory = "none";  // none | system | tpu
   size_t output_shared_memory_size = 0;  // 0 = outputs returned inline

@@ -15,7 +15,8 @@ Error CreateClientBackend(const BackendFactoryConfig& config,
                           std::shared_ptr<ClientBackend>* backend) {
   switch (config.kind) {
     case BackendKind::KSERVE_HTTP:
-      return HttpClientBackend::Create(config.url, config.verbose, backend);
+      return HttpClientBackend::Create(config.url, config.verbose, backend,
+                                       config.json_tensor_format);
     case BackendKind::KSERVE_GRPC:
       return GrpcClientBackend::Create(config.url, config.verbose,
                                        config.streaming, backend);

@@ -101,6 +101,14 @@ class ClientBackend {
     (void)name;
     return Error("TPU shared memory not supported by this backend");
   }
+
+  // Forward trace settings to the server before the run (reference
+  // client_backend.h:296 UpdateTraceSettings; kserve kinds only).
+  virtual Error UpdateTraceSettings(
+      const std::map<std::string, std::vector<std::string>>& settings) {
+    (void)settings;
+    return Error("trace settings are not supported by this backend");
+  }
 };
 
 struct BackendFactoryConfig {
@@ -113,6 +121,9 @@ struct BackendFactoryConfig {
   std::string endpoint;
   // LOCAL only: also register the model-zoo adapters (resnet, llm_decode).
   bool local_zoo = false;
+  // KSERVE_HTTP only: send tensors as JSON data lists instead of the
+  // binary extension (--input-tensor-format json).
+  bool json_tensor_format = false;
 };
 
 // reference ClientBackendFactory::Create (client_backend.h:292)

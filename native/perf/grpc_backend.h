@@ -89,6 +89,12 @@ class GrpcClientBackend : public ClientBackend {
   Error UnregisterTpuSharedMemory(const std::string& name) override {
     return client_->UnregisterTpuSharedMemory(name);
   }
+  Error UpdateTraceSettings(
+      const std::map<std::string, std::vector<std::string>>& settings)
+      override {
+    inference::TraceSettingResponse response;
+    return client_->UpdateTraceSettings(&response, "", settings);
+  }
 
  private:
   GrpcClientBackend(std::string url, bool streaming)

@@ -1,5 +1,7 @@
 #include "http_backend.h"
 
+#include "tensor_json.h"
+
 #include <cctype>
 #include <cstdlib>
 
@@ -7,13 +9,15 @@ namespace ctpu {
 namespace perf {
 
 Error HttpClientBackend::Create(const std::string& url, bool verbose,
-                                std::shared_ptr<ClientBackend>* backend) {
+                                std::shared_ptr<ClientBackend>* backend,
+                                bool json_body) {
   size_t colon = url.rfind(':');
   if (colon == std::string::npos) {
     return Error("url must be host:port, got '" + url + "'");
   }
   auto* b = new HttpClientBackend(url.substr(0, colon),
-                                  std::atoi(url.c_str() + colon + 1));
+                                  std::atoi(url.c_str() + colon + 1),
+                                  json_body);
   Error err = InferenceServerHttpClient::Create(&b->client_, url, verbose,
                                                 /*async_workers=*/0);
   if (!err.IsOk()) {
@@ -49,6 +53,7 @@ Error HttpBackendContext::Infer(
     const InferOptions& options, const std::vector<InferInput*>& inputs,
     const std::vector<const InferRequestedOutput*>& outputs,
     RequestRecord* record) {
+  if (json_body_) return InferJson(options, inputs, outputs, record);
   record->start_ns = RequestTimers::Now();
 
   std::string body;
@@ -105,6 +110,157 @@ Error HttpBackendContext::Infer(
     record->error = err.Message();
   }
   return err;
+}
+
+// --input-tensor-format json: pure-JSON request body, tensor data as
+// "data" lists (reference command_line_parser kInputTensorFormat +
+// http_client JSON path). Slower on purpose — the mode exists to measure
+// exactly that trade against the binary extension.
+Error HttpBackendContext::InferJson(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    RequestRecord* record) {
+  record->start_ns = RequestTimers::Now();
+  json::Object doc;
+  if (!options.request_id.empty()) doc["id"] = options.request_id;
+  // Request-level parameters: sequence controls, priority, timeout, and
+  // --request-parameter values — same set the binary path emits
+  // (http_client.cc GenerateRequestBody).
+  json::Object req_params;
+  if (!options.sequence_id_str.empty()) {
+    req_params["sequence_id"] = json::Value(options.sequence_id_str);
+    req_params["sequence_start"] = json::Value(options.sequence_start);
+    req_params["sequence_end"] = json::Value(options.sequence_end);
+  } else if (options.sequence_id != 0) {
+    req_params["sequence_id"] = json::Value((int64_t)options.sequence_id);
+    req_params["sequence_start"] = json::Value(options.sequence_start);
+    req_params["sequence_end"] = json::Value(options.sequence_end);
+  }
+  if (options.priority != 0) {
+    req_params["priority"] = json::Value((int64_t)options.priority);
+  }
+  if (options.server_timeout_us != 0) {
+    req_params["timeout"] = json::Value((int64_t)options.server_timeout_us);
+  }
+  for (const auto& kv : options.parameters) {
+    try {
+      req_params[kv.first] = json::Parse(kv.second);
+    } catch (const std::exception&) {
+      return Error("request parameter '" + kv.first +
+                   "' is not valid JSON: " + kv.second);
+    }
+  }
+  if (!req_params.empty()) {
+    doc["parameters"] = json::Value(std::move(req_params));
+  }
+  json::Array ins;
+  for (const InferInput* input : inputs) {
+    json::Object t;
+    t["name"] = input->Name();
+    t["datatype"] = input->Datatype();
+    json::Array shape;
+    for (int64_t d : input->Shape()) shape.push_back(json::Value(d));
+    t["shape"] = json::Value(std::move(shape));
+    if (input->IsSharedMemory()) {
+      json::Object params;
+      params["shared_memory_region"] = input->SharedMemoryName();
+      params["shared_memory_byte_size"] =
+          json::Value((int64_t)input->SharedMemoryByteSize());
+      if (input->SharedMemoryOffset() != 0) {
+        params["shared_memory_offset"] =
+            json::Value((int64_t)input->SharedMemoryOffset());
+      }
+      t["parameters"] = json::Value(std::move(params));
+    } else {
+      std::string raw;
+      input->ConcatenatedData(&raw);
+      json::Array data;
+      CTPU_RETURN_IF_ERROR(
+          TensorBytesToFlatJson(input->Datatype(), raw, &data));
+      t["data"] = json::Value(std::move(data));
+    }
+    ins.push_back(json::Value(std::move(t)));
+  }
+  doc["inputs"] = json::Value(std::move(ins));
+  if (!outputs.empty()) {
+    json::Array outs;
+    for (const InferRequestedOutput* out : outputs) {
+      json::Object t;
+      t["name"] = out->Name();
+      json::Object params;
+      if (out->IsSharedMemory()) {
+        params["shared_memory_region"] = out->SharedMemoryName();
+        params["shared_memory_byte_size"] =
+            json::Value((int64_t)out->SharedMemoryByteSize());
+        if (out->SharedMemoryOffset() != 0) {
+          params["shared_memory_offset"] =
+              json::Value((int64_t)out->SharedMemoryOffset());
+        }
+      } else {
+        params["binary_data"] = json::Value(false);
+      }
+      if (out->ClassCount() > 0) {
+        params["classification"] = json::Value((int64_t)out->ClassCount());
+      }
+      t["parameters"] = json::Value(std::move(params));
+      outs.push_back(json::Value(std::move(t)));
+    }
+    doc["outputs"] = json::Value(std::move(outs));
+  }
+  const std::string body = json::Value(std::move(doc)).Dump();
+
+  std::string uri = "v2/models/" + options.model_name;
+  if (!options.model_version.empty()) {
+    uri += "/versions/" + options.model_version;
+  }
+  uri += "/infer";
+  uint64_t send_start = RequestTimers::Now();
+  int status = 0;
+  std::string resp_headers, resp_body;
+  Error err = conn_.Roundtrip("POST", uri,
+                              {"Content-Type: application/json"},
+                              body.data(), body.size(), &status,
+                              &resp_headers, &resp_body,
+                              options.client_timeout_us);
+  uint64_t recv_end = RequestTimers::Now();
+  record->send_ns = send_start - record->start_ns;
+  record->recv_ns = recv_end - send_start;
+  record->response_ns.push_back(recv_end);
+  record->end_ns = RequestTimers::Now();
+  if (!err.IsOk()) {
+    record->success = false;
+    record->error = err.Message();
+    return err;
+  }
+  if (status != 200) {
+    record->success = false;
+    record->error = "HTTP " + std::to_string(status);
+    return Error(record->error + ": " + resp_body.substr(0, 200));
+  }
+  record->success = true;
+  return Error::Success();
+}
+
+Error HttpClientBackend::UpdateTraceSettings(
+    const std::map<std::string, std::vector<std::string>>& settings) {
+  json::Object doc;
+  for (const auto& kv : settings) {
+    json::Array values;
+    for (const auto& v : kv.second) values.push_back(json::Value(v));
+    doc[kv.first] = json::Value(std::move(values));
+  }
+  const std::string body = json::Value(std::move(doc)).Dump();
+  HttpConnection conn(host_, port_);
+  int status = 0;
+  std::string resp_headers, resp_body;
+  CTPU_RETURN_IF_ERROR(conn.Roundtrip(
+      "POST", "v2/trace/setting", {"Content-Type: application/json"},
+      body.data(), body.size(), &status, &resp_headers, &resp_body));
+  if (status != 200) {
+    return Error("trace setting update returned HTTP " +
+                 std::to_string(status) + ": " + resp_body.substr(0, 200));
+  }
+  return Error::Success();
 }
 
 }  // namespace perf

@@ -11,8 +11,9 @@ namespace perf {
 
 class HttpBackendContext : public BackendContext {
  public:
-  HttpBackendContext(const std::string& host, int port)
-      : conn_(host, port) {}
+  HttpBackendContext(const std::string& host, int port,
+                     bool json_body = false)
+      : conn_(host, port), json_body_(json_body) {}
 
   Error Infer(const InferOptions& options,
               const std::vector<InferInput*>& inputs,
@@ -20,13 +21,23 @@ class HttpBackendContext : public BackendContext {
               RequestRecord* record) override;
 
  private:
+  Error InferJson(const InferOptions& options,
+                  const std::vector<InferInput*>& inputs,
+                  const std::vector<const InferRequestedOutput*>& outputs,
+                  RequestRecord* record);
+
   HttpConnection conn_;
+  bool json_body_ = false;
 };
 
 class HttpClientBackend : public ClientBackend {
  public:
+  // json_body: send tensors as JSON "data" lists instead of the binary
+  // extension (--input-tensor-format json; reference command_line_parser
+  // kInputTensorFormat).
   static Error Create(const std::string& url, bool verbose,
-                      std::shared_ptr<ClientBackend>* backend);
+                      std::shared_ptr<ClientBackend>* backend,
+                      bool json_body = false);
 
   BackendKind Kind() const override { return BackendKind::KSERVE_HTTP; }
   Error ModelMetadata(json::Value* metadata, const std::string& model_name,
@@ -42,7 +53,7 @@ class HttpClientBackend : public ClientBackend {
       const std::string& model_name) override;
   std::unique_ptr<BackendContext> CreateContext() override {
     return std::unique_ptr<BackendContext>(
-        new HttpBackendContext(host_, port_));
+        new HttpBackendContext(host_, port_, json_body_));
   }
   Error RegisterSystemSharedMemory(const std::string& name,
                                    const std::string& key,
@@ -62,13 +73,17 @@ class HttpClientBackend : public ClientBackend {
   Error UnregisterTpuSharedMemory(const std::string& name) override {
     return client_->UnregisterTpuSharedMemory(name);
   }
+  Error UpdateTraceSettings(
+      const std::map<std::string, std::vector<std::string>>& settings)
+      override;
 
  private:
-  HttpClientBackend(std::string host, int port)
-      : host_(std::move(host)), port_(port) {}
+  HttpClientBackend(std::string host, int port, bool json_body)
+      : host_(std::move(host)), port_(port), json_body_(json_body) {}
 
   std::string host_;
   int port_;
+  bool json_body_ = false;
   std::unique_ptr<InferenceServerHttpClient> client_;
 };
 

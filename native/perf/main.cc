@@ -83,9 +83,15 @@ int main(int argc, char** argv) {
     backend_config.kind = BackendKind::TORCHSERVE;
     if (!params.url_set) backend_config.url = "localhost:8080";
   }
+  backend_config.json_tensor_format = params.input_tensor_format == "json";
   std::shared_ptr<ClientBackend> backend;
   err = CreateClientBackend(backend_config, &backend);
   if (!err.IsOk()) return fail(err, "create backend");
+
+  if (!params.trace_settings.empty()) {
+    err = backend->UpdateTraceSettings(params.trace_settings);
+    if (!err.IsOk()) return fail(err, "forward trace settings");
+  }
 
   ModelParser parser;
   err = parser.Init(backend.get(), params.model_name, params.model_version);

@@ -1,0 +1,116 @@
+#include "tensor_json.h"
+
+#include <cstring>
+#include <type_traits>
+
+namespace ctpu {
+namespace perf {
+
+namespace {
+
+// Floats emit as doubles; integers via the int64 constructor so values
+// above 2^53 survive JSON encoding exactly.
+template <typename T>
+void AppendNumbers(const std::string& bytes, json::Array* flat) {
+  const size_t n = bytes.size() / sizeof(T);
+  const T* p = reinterpret_cast<const T*>(bytes.data());
+  for (size_t i = 0; i < n; ++i) {
+    if (std::is_integral<T>::value) {
+      flat->push_back(json::Value((int64_t)p[i]));
+    } else {
+      flat->push_back(json::Value((double)p[i]));
+    }
+  }
+}
+
+json::Value Nest(const std::vector<json::Value>& flat, size_t* index,
+                 const std::vector<int64_t>& shape, size_t dim) {
+  if (dim == shape.size()) {
+    return flat[(*index)++];
+  }
+  json::Array arr;
+  for (int64_t i = 0; i < shape[dim]; ++i) {
+    arr.push_back(Nest(flat, index, shape, dim + 1));
+  }
+  return json::Value(std::move(arr));
+}
+
+}  // namespace
+
+Error TensorBytesToJson(const std::string& datatype,
+                        const std::vector<int64_t>& shape,
+                        const std::string& bytes, json::Value* out) {
+  json::Array flat;
+  if (datatype == "FP32") AppendNumbers<float>(bytes, &flat);
+  else if (datatype == "FP64") AppendNumbers<double>(bytes, &flat);
+  else if (datatype == "INT32") AppendNumbers<int32_t>(bytes, &flat);
+  else if (datatype == "INT64") AppendNumbers<int64_t>(bytes, &flat);
+  else if (datatype == "INT16") AppendNumbers<int16_t>(bytes, &flat);
+  else if (datatype == "INT8") AppendNumbers<int8_t>(bytes, &flat);
+  else if (datatype == "UINT8") AppendNumbers<uint8_t>(bytes, &flat);
+  else if (datatype == "UINT16") AppendNumbers<uint16_t>(bytes, &flat);
+  else if (datatype == "UINT32") AppendNumbers<uint32_t>(bytes, &flat);
+  else if (datatype == "UINT64") AppendNumbers<uint64_t>(bytes, &flat);
+  else if (datatype == "BOOL") AppendNumbers<uint8_t>(bytes, &flat);
+  else {
+    return Error("TFS row format cannot carry dtype '" + datatype + "'");
+  }
+  int64_t expected = 1;
+  for (int64_t d : shape) expected *= d;
+  if ((int64_t)flat.size() != expected) {
+    return Error("tensor bytes hold " + std::to_string(flat.size()) +
+                 " elements but shape needs " + std::to_string(expected));
+  }
+  size_t index = 0;
+  json::Array rows;
+  // Leading dim = batch rows (TFS row format). json::Array IS a
+  // vector<Value>, so Nest consumes `flat` directly — no element copies.
+  std::vector<int64_t> row_shape(shape.begin() + 1, shape.end());
+  int64_t nrows = shape.empty() ? 1 : shape[0];
+  for (int64_t r = 0; r < nrows; ++r) {
+    rows.push_back(Nest(flat, &index, row_shape, 0));
+  }
+  *out = json::Value(std::move(rows));
+  return Error::Success();
+}
+
+Error TensorBytesToFlatJson(const std::string& datatype,
+                            const std::string& bytes, json::Array* out) {
+  if (datatype == "BYTES") {
+    // 4-byte-length-prefixed elements -> JSON strings.
+    size_t off = 0;
+    while (off + 4 <= bytes.size()) {
+      uint32_t len;
+      std::memcpy(&len, bytes.data() + off, 4);
+      off += 4;
+      if (off + len > bytes.size()) {
+        return Error("malformed BYTES tensor in JSON conversion");
+      }
+      out->push_back(json::Value(bytes.substr(off, len)));
+      off += len;
+    }
+    if (off != bytes.size()) {
+      return Error("trailing bytes in BYTES tensor");
+    }
+    return Error::Success();
+  }
+  if (datatype == "FP32") AppendNumbers<float>(bytes, out);
+  else if (datatype == "FP64") AppendNumbers<double>(bytes, out);
+  else if (datatype == "INT32") AppendNumbers<int32_t>(bytes, out);
+  else if (datatype == "INT64") AppendNumbers<int64_t>(bytes, out);
+  else if (datatype == "INT16") AppendNumbers<int16_t>(bytes, out);
+  else if (datatype == "INT8") AppendNumbers<int8_t>(bytes, out);
+  else if (datatype == "UINT8") AppendNumbers<uint8_t>(bytes, out);
+  else if (datatype == "UINT16") AppendNumbers<uint16_t>(bytes, out);
+  else if (datatype == "UINT32") AppendNumbers<uint32_t>(bytes, out);
+  else if (datatype == "UINT64") AppendNumbers<uint64_t>(bytes, out);
+  else if (datatype == "BOOL") AppendNumbers<uint8_t>(bytes, out);
+  else {
+    return Error("JSON tensor format cannot carry dtype '" + datatype +
+                 "'");
+  }
+  return Error::Success();
+}
+
+}  // namespace perf
+}  // namespace ctpu

@@ -1,0 +1,27 @@
+// Raw-tensor-bytes <-> JSON conversion shared by the REST-flavored
+// backends (KServe --input-tensor-format json, TFS row format).
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common.h"
+#include "json.h"
+
+namespace ctpu {
+namespace perf {
+
+// Nested row-major JSON per `shape` with the leading dim as batch rows
+// (TFS row format).
+Error TensorBytesToJson(const std::string& datatype,
+                        const std::vector<int64_t>& shape,
+                        const std::string& bytes, json::Value* out);
+
+// Flat KServe JSON "data" list (numbers; strings for length-prefixed
+// BYTES).
+Error TensorBytesToFlatJson(const std::string& datatype,
+                            const std::string& bytes, json::Array* out);
+
+}  // namespace perf
+}  // namespace ctpu

@@ -2,75 +2,10 @@
 
 #include <cstring>
 
+#include "tensor_json.h"
+
 namespace ctpu {
 namespace perf {
-
-namespace {
-
-// Floats emit as doubles; integers via the int64 constructor so values
-// above 2^53 survive JSON encoding exactly.
-template <typename T>
-void AppendNumbers(const std::string& bytes, json::Array* flat) {
-  const size_t n = bytes.size() / sizeof(T);
-  const T* p = reinterpret_cast<const T*>(bytes.data());
-  for (size_t i = 0; i < n; ++i) {
-    if (std::is_integral<T>::value) {
-      flat->push_back(json::Value((int64_t)p[i]));
-    } else {
-      flat->push_back(json::Value((double)p[i]));
-    }
-  }
-}
-
-// Nests a flat value list per the non-leading dims (row-major).
-json::Value Nest(const std::vector<json::Value>& flat, size_t* index,
-                 const std::vector<int64_t>& shape, size_t dim) {
-  if (dim == shape.size()) {
-    return flat[(*index)++];
-  }
-  json::Array arr;
-  for (int64_t i = 0; i < shape[dim]; ++i) {
-    arr.push_back(Nest(flat, index, shape, dim + 1));
-  }
-  return json::Value(std::move(arr));
-}
-
-}  // namespace
-
-Error TensorBytesToJson(const std::string& datatype,
-                        const std::vector<int64_t>& shape,
-                        const std::string& bytes, json::Value* out) {
-  json::Array flat;
-  if (datatype == "FP32") AppendNumbers<float>(bytes, &flat);
-  else if (datatype == "FP64") AppendNumbers<double>(bytes, &flat);
-  else if (datatype == "INT32") AppendNumbers<int32_t>(bytes, &flat);
-  else if (datatype == "INT64") AppendNumbers<int64_t>(bytes, &flat);
-  else if (datatype == "INT16") AppendNumbers<int16_t>(bytes, &flat);
-  else if (datatype == "INT8") AppendNumbers<int8_t>(bytes, &flat);
-  else if (datatype == "UINT8") AppendNumbers<uint8_t>(bytes, &flat);
-  else if (datatype == "UINT16") AppendNumbers<uint16_t>(bytes, &flat);
-  else if (datatype == "BOOL") AppendNumbers<uint8_t>(bytes, &flat);
-  else {
-    return Error("TFS row format cannot carry dtype '" + datatype + "'");
-  }
-  int64_t expected = 1;
-  for (int64_t d : shape) expected *= d;
-  if ((int64_t)flat.size() != expected) {
-    return Error("tensor bytes hold " + std::to_string(flat.size()) +
-                 " elements but shape needs " + std::to_string(expected));
-  }
-  size_t index = 0;
-  json::Array rows;
-  // Leading dim = batch rows (TFS row format). json::Array IS a
-  // vector<Value>, so Nest consumes `flat` directly — no element copies.
-  std::vector<int64_t> row_shape(shape.begin() + 1, shape.end());
-  int64_t nrows = shape.empty() ? 1 : shape[0];
-  for (int64_t r = 0; r < nrows; ++r) {
-    rows.push_back(Nest(flat, &index, row_shape, 0));
-  }
-  *out = json::Value(std::move(rows));
-  return Error::Success();
-}
 
 Error TfsClientBackend::Create(const std::string& url, bool verbose,
                                std::shared_ptr<ClientBackend>* backend) {

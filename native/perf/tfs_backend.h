@@ -50,11 +50,5 @@ class TfsClientBackend : public ClientBackend {
   bool verbose_ = false;
 };
 
-// Converts raw little-endian tensor bytes to a JSON value list (row major,
-// nested per shape). Exposed for the torchserve/tfs unit tests.
-Error TensorBytesToJson(const std::string& datatype,
-                        const std::vector<int64_t>& shape,
-                        const std::string& bytes, json::Value* out);
-
 }  // namespace perf
 }  // namespace ctpu
