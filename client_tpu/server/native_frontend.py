@@ -185,6 +185,13 @@ class NativeGrpcFrontend:
                     parameters=params,
                 )
                 for name, datatype, shape, data, shm in inputs:
+                    if type(data) is np.ndarray:
+                        # Fastest path: the C++ side already built the
+                        # zero-copy view (shape/dtype validated there).
+                        request.inputs.append(
+                            CoreTensor(name, datatype, list(shape), data)
+                        )
+                        continue
                     if shm is None and data is not None:
                         # Hot path: raw bytes -> numpy view. frombuffer /
                         # reshape validate the byte count against the shape.

@@ -28,6 +28,10 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
+#define PY_ARRAY_UNIQUE_SYMBOL ctpu_frontend_ARRAY_API
+#include <numpy/arrayobject.h>
+
 #include <atomic>
 #include <chrono>
 #include <cstring>
@@ -314,6 +318,81 @@ PyObject* MakeReqBuffer(const std::shared_ptr<ReqBuffers>& bufs,
   return reinterpret_cast<PyObject*>(obj);
 }
 
+// numpy dtype for a KServe datatype; NPY_NOTYPE = no direct mapping
+// (BYTES needs Python-side deserialization; BF16 is an ml_dtypes type).
+int NumpyTypeFor(const std::string& datatype) {
+  if (datatype == "FP32") return NPY_FLOAT32;
+  if (datatype == "INT32") return NPY_INT32;
+  if (datatype == "INT64") return NPY_INT64;
+  if (datatype == "FP64") return NPY_FLOAT64;
+  if (datatype == "FP16") return NPY_FLOAT16;
+  if (datatype == "UINT8") return NPY_UINT8;
+  if (datatype == "INT8") return NPY_INT8;
+  if (datatype == "UINT16") return NPY_UINT16;
+  if (datatype == "UINT32") return NPY_UINT32;
+  if (datatype == "UINT64") return NPY_UINT64;
+  if (datatype == "INT16") return NPY_INT16;
+  if (datatype == "BOOL") return NPY_BOOL;
+  return NPY_NOTYPE;
+}
+
+// Builds a zero-copy read-only ndarray over request-owned memory (base =
+// a ReqBuffer, so the array keeps the request alive). Returns nullptr
+// (without a Python error) when the dtype/shape don't map — the caller
+// falls back to handing Python the raw buffer.
+PyObject* MakeReqArray(const std::shared_ptr<ReqBuffers>& bufs,
+                       const std::string& raw, const std::string& datatype,
+                       const google::protobuf::RepeatedField<int64_t>& shape) {
+  const int npy_type = NumpyTypeFor(datatype);
+  if (npy_type == NPY_NOTYPE) return nullptr;
+  npy_intp dims[32];
+  if (shape.size() > 32) return nullptr;
+  // Overflow-safe element count on attacker-controlled dims: cap the
+  // running product well below NPY_MAX_INTP so `total * elsize` can never
+  // wrap into a spurious match against raw.size().
+  constexpr unsigned long long kMaxElements = 1ULL << 40;
+  unsigned long long total = 1;
+  for (int i = 0; i < shape.size(); ++i) {
+    if (shape.Get(i) < 0) return nullptr;
+    dims[i] = (npy_intp)shape.Get(i);
+    unsigned long long d = (unsigned long long)shape.Get(i);
+    if (d != 0 && total > kMaxElements / (d ? d : 1)) return nullptr;
+    total *= d;
+    if (total > kMaxElements) return nullptr;
+  }
+  PyArray_Descr* descr = PyArray_DescrFromType(npy_type);
+  if (descr == nullptr) {
+    PyErr_Clear();
+    return nullptr;
+  }
+  if ((unsigned long long)raw.size() !=
+      total * (unsigned long long)PyDataType_ELSIZE(descr)) {
+    Py_DECREF(descr);
+    return nullptr;  // size mismatch: let the Python path raise cleanly
+  }
+  PyObject* arr = PyArray_NewFromDescr(
+      &PyArray_Type, descr, shape.size(), dims, /*strides=*/nullptr,
+      const_cast<char*>(raw.data()), /*flags=*/NPY_ARRAY_C_CONTIGUOUS,
+      nullptr);
+  if (arr == nullptr) {
+    PyErr_Clear();  // caller falls back to the raw-buffer path
+    return nullptr;
+  }
+  PyObject* base = MakeReqBuffer(bufs, raw);
+  if (base == nullptr) {
+    Py_DECREF(arr);
+    PyErr_Clear();
+    return nullptr;
+  }
+  if (PyArray_SetBaseObject(reinterpret_cast<PyArrayObject*>(arr), base) !=
+      0) {
+    Py_DECREF(arr);  // SetBaseObject stole base even on failure
+    PyErr_Clear();
+    return nullptr;
+  }
+  return arr;
+}
+
 // Per-h2-stream gRPC state.
 struct GrpcStream {
   enum Kind { kUnary, kStreamInfer, kOther };
@@ -420,10 +499,16 @@ PyObject* BuildRequestTuple(uint64_t handle, Pending* pending) {
                           static_cast<long long>(shm_size),
                           static_cast<long long>(shm_offset));
     } else if (raw_index < n_raw) {
-      data = MakeReqBuffer(pending->bufs, req.raw_input_contents(raw_index++));
+      const std::string& raw = req.raw_input_contents(raw_index++);
+      // Fast path: a ready ndarray view (the bridge skips
+      // frombuffer/reshape); BYTES/BF16/mismatches fall back to the raw
+      // buffer, which the bridge decodes + validates.
+      data = MakeReqArray(pending->bufs, raw, t.datatype(), t.shape());
+      if (data == nullptr) data = MakeReqBuffer(pending->bufs, raw);
     } else if (t.has_contents()) {
-      data = MakeReqBuffer(pending->bufs,
-                           *pending->bufs->converted[converted_index++]);
+      const std::string& raw = *pending->bufs->converted[converted_index++];
+      data = MakeReqArray(pending->bufs, raw, t.datatype(), t.shape());
+      if (data == nullptr) data = MakeReqBuffer(pending->bufs, raw);
     }
     if (data == Py_None) Py_INCREF(Py_None);
     if (shm == Py_None) Py_INCREF(Py_None);
@@ -1276,6 +1361,7 @@ struct PyModuleDef kModule = {
 }  // namespace ctpu
 
 extern "C" PyMODINIT_FUNC PyInit__native_frontend(void) {
+  import_array();  // numpy C API (zero-copy request arrays)
   ctpu::frontend::ReqBufferType.tp_flags = Py_TPFLAGS_DEFAULT;
   ctpu::frontend::ReqBufferType.tp_as_buffer =
       &ctpu::frontend::kReqBufferAsBuffer;
