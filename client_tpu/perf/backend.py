@@ -626,6 +626,200 @@ class OpenAiPerfBackend(PerfBackend):
                         on_response()
 
 
+class _RestSessionMixin:
+    """Shared lazy aiohttp session for REST backends: unbounded connector
+    (a capped connector would queue client-side and corrupt latency, same
+    reason OpenAiPerfBackend uses limit=0) and close() that resets so a
+    reused backend reopens cleanly."""
+
+    _session = None
+
+    async def _sess(self):
+        if self._session is None or self._session.closed:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(limit=0)
+            )
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+class TfsPerfBackend(_RestSessionMixin, PerfBackend):
+    """TensorFlow-Serving REST backend (the Python twin of the C++
+    tfs_backend; reference client_backend/tensorflow_serving/ role):
+    row-format :predict, metadata normalized from the signature block."""
+
+    kind = "tfserving"
+
+    _TF_TO_KSERVE = {
+        "DT_FLOAT": "FP32", "DT_DOUBLE": "FP64", "DT_INT32": "INT32",
+        "DT_INT64": "INT64", "DT_INT16": "INT16", "DT_INT8": "INT8",
+        "DT_UINT8": "UINT8", "DT_UINT16": "UINT16", "DT_BOOL": "BOOL",
+        "DT_STRING": "BYTES",
+    }
+
+    def __init__(self, url: str):
+        self._base = url if url.startswith("http") else f"http://{url}"
+
+    async def get_model_metadata(self, model_name, model_version=""):
+        session = await self._sess()
+        async with session.get(
+            f"{self._base}/v1/models/{model_name}/metadata"
+        ) as resp:
+            if resp.status != 200:
+                raise InferenceServerException(
+                    f"TFS metadata returned HTTP {resp.status}"
+                )
+            doc = await resp.json()
+        sig = (
+            doc.get("metadata", {})
+            .get("signature_def", {})
+            .get("signature_def", {})
+            .get("serving_default", {})
+        )
+
+        def convert(block):
+            tensors = []
+            for name, desc in block.items():
+                dtype = self._TF_TO_KSERVE.get(desc.get("dtype", ""))
+                if dtype is None:
+                    raise InferenceServerException(
+                        f"signature tensor '{name}' has unsupported dtype "
+                        f"'{desc.get('dtype')}'"
+                    )
+                dims = [
+                    int(d.get("size", -1))
+                    for d in desc.get("tensor_shape", {}).get("dim", [])
+                ]
+                tensors.append(
+                    {"name": name, "datatype": dtype, "shape": dims}
+                )
+            return tensors
+
+        return {
+            "name": model_name,
+            "inputs": convert(sig.get("inputs", {})),
+            "outputs": convert(sig.get("outputs", {})),
+        }
+
+    async def get_model_config(self, model_name, model_version=""):
+        # TFS has no Triton-style config; the signature's leading -1 dims
+        # play the batch-dim role.
+        return {"name": model_name, "max_batch_size": 0}
+
+    async def infer(self, model_name, inputs, model_version="",
+                    request_id="", parameters=None, sequence_id=0,
+                    sequence_start=False, sequence_end=False):
+        def rows_for(t):
+            values = np.asarray(t.data)
+            if t.datatype == "BYTES":
+                # TFS REST string tensors ride as {"b64": ...} objects.
+                import base64
+
+                def b64(v):
+                    if isinstance(v, str):
+                        v = v.encode("utf-8")
+                    return {"b64": base64.b64encode(v).decode("ascii")}
+
+                return [
+                    b64(v) for v in values.reshape(-1)
+                ] if values.ndim <= 1 else [
+                    [b64(v) for v in row.reshape(-1)] for row in values
+                ]
+            return values.tolist()
+
+        if len(inputs) == 1:
+            instances = rows_for(inputs[0])
+        else:
+            rows = None
+            per_input = {}
+            for t in inputs:
+                values = rows_for(t)
+                if rows is None:
+                    rows = len(values)
+                elif len(values) != rows:
+                    raise InferenceServerException(
+                        "TFS row format needs a shared batch dim"
+                    )
+                per_input[t.name] = values
+            instances = [
+                {name: per_input[name][r] for name in per_input}
+                for r in range(rows or 0)
+            ]
+        session = await self._sess()
+        async with session.post(
+            f"{self._base}/v1/models/{model_name}:predict",
+            json={"instances": instances},
+        ) as resp:
+            body = await resp.read()
+            if resp.status != 200:
+                raise InferenceServerException(
+                    f"TFS predict HTTP {resp.status}: {body[:200]!r}"
+                )
+
+
+class TorchServePerfBackend(_RestSessionMixin, PerfBackend):
+    """TorchServe REST backend (Python twin of the C++ torchserve_backend;
+    reference client_backend/torchserve/ role): raw-body /predictions/<m>,
+    fabricated single-BYTES-input contract."""
+
+    kind = "torchserve"
+
+    def __init__(self, url: str):
+        self._base = url if url.startswith("http") else f"http://{url}"
+
+    async def connect(self) -> None:
+        session = await self._sess()
+        async with session.get(f"{self._base}/ping") as resp:
+            if resp.status != 200:
+                raise InferenceServerException(
+                    f"TorchServe /ping failed: HTTP {resp.status}"
+                )
+
+    async def get_model_metadata(self, model_name, model_version=""):
+        return {
+            "name": model_name,
+            "inputs": [
+                {"name": "data", "datatype": "BYTES", "shape": [-1]}
+            ],
+            "outputs": [],
+        }
+
+    async def get_model_config(self, model_name, model_version=""):
+        return {"name": model_name, "max_batch_size": 0}
+
+    async def infer(self, model_name, inputs, model_version="",
+                    request_id="", parameters=None, sequence_id=0,
+                    sequence_start=False, sequence_end=False):
+        if not inputs:
+            raise InferenceServerException("torchserve backend needs input")
+        t = inputs[0]
+        if t.datatype == "BYTES":
+            flat = np.asarray(t.data, dtype=object).reshape(-1)
+            body = flat[0] if len(flat) else b""
+            if isinstance(body, str):
+                body = body.encode("utf-8")
+        else:
+            body = np.ascontiguousarray(t.data).tobytes()
+        session = await self._sess()
+        async with session.post(
+            f"{self._base}/predictions/{model_name}",
+            data=body,
+            headers={"Content-Type": "application/octet-stream"},
+        ) as resp:
+            payload = await resp.read()
+            if resp.status != 200:
+                raise InferenceServerException(
+                    f"TorchServe predict HTTP {resp.status}: "
+                    f"{payload[:200]!r}"
+                )
+
+
 def create_backend(
     kind: str,
     url: str = "",
@@ -639,6 +833,10 @@ def create_backend(
         return GrpcPerfBackend(url)
     if kind == "openai":
         return OpenAiPerfBackend(url, **kwargs)
+    if kind == "tfserving":
+        return TfsPerfBackend(url)
+    if kind == "torchserve":
+        return TorchServePerfBackend(url)
     if kind == "local":
         if core is None:
             raise InferenceServerException(

@@ -47,8 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--service-kind",
         default="kserve",
-        choices=["kserve", "openai"],
-        help="kserve (default) or an OpenAI-compatible endpoint",
+        choices=["kserve", "openai", "tfserving", "torchserve"],
+        help="kserve (default), an OpenAI-compatible endpoint, or the "
+        "TFS/TorchServe REST protocols",
     )
     parser.add_argument(
         "--endpoint",
@@ -209,6 +210,7 @@ def parse_request_parameters(specs):
 
 async def run(args) -> int:
     from client_tpu.perf.backend import create_backend
+    from client_tpu.utils import InferenceServerException
     from client_tpu.perf.data import DataLoader
     from client_tpu.perf.load_manager import (
         ConcurrencyManager,
@@ -226,16 +228,40 @@ async def run(args) -> int:
 
     if args.service_kind == "openai":
         backend = create_backend("openai", args.url, endpoint=args.endpoint)
+    elif args.service_kind in ("tfserving", "torchserve"):
+        if args.protocol != "http":
+            print(
+                f"error: --service-kind {args.service_kind} is REST-only; "
+                f"-i {args.protocol} is not supported",
+                file=sys.stderr,
+            )
+            return 2
+        if args.shared_memory != "none":
+            print(
+                f"error: --shared-memory is not supported by the "
+                f"{args.service_kind} service kind",
+                file=sys.stderr,
+            )
+            return 2
+        backend = create_backend(args.service_kind, args.url)
     else:
         backend = create_backend(args.protocol, args.url)
     if args.streaming and not backend.supports_streaming:
-        print(
-            f"error: --streaming is not supported by the '{args.protocol}' "
-            "protocol; use -i grpc",
-            file=sys.stderr,
-        )
+        if args.service_kind in ("tfserving", "torchserve"):
+            hint = (f"the {args.service_kind} service kind never supports "
+                    "streaming")
+        else:
+            hint = f"the '{args.protocol}' protocol; use -i grpc"
+        print(f"error: --streaming is not supported by {hint}",
+              file=sys.stderr)
         await backend.close()
         return 2
+    try:
+        await backend.connect()
+    except InferenceServerException as e:
+        print(f"error: backend connect: {e}", file=sys.stderr)
+        await backend.close()
+        return 1
     shm_plane = None
     try:
         metadata = await backend.get_model_metadata(

@@ -177,7 +177,7 @@ class CompatFrontends:
             rows = payload["instances"]
             if not rows:
                 raise InferenceServerException("'instances' is empty")
-            if isinstance(rows[0], dict):
+            if isinstance(rows[0], dict) and set(rows[0].keys()) != {"b64"}:
                 names = rows[0].keys()
                 for i, row in enumerate(rows):
                     if not isinstance(row, dict) or row.keys() != names:
@@ -187,9 +187,8 @@ class CompatFrontends:
                         )
                 for name in names:
                     desc = self._input_desc(model, name)
-                    arr = np.asarray(
-                        [row[name] for row in rows],
-                        dtype=triton_to_np_dtype(desc["datatype"]),
+                    arr = self._decode_values(
+                        desc, [row[name] for row in rows]
                     )
                     inputs.append(
                         CoreTensor(name, desc["datatype"], list(arr.shape),
@@ -201,9 +200,7 @@ class CompatFrontends:
                         "bare 'instances' rows need a single-input model"
                     )
                 desc = model.inputs[0]
-                arr = np.asarray(
-                    rows, dtype=triton_to_np_dtype(desc["datatype"])
-                )
+                arr = self._decode_values(desc, rows)
                 inputs.append(
                     CoreTensor(desc["name"], desc["datatype"],
                                list(arr.shape), arr)
@@ -220,9 +217,7 @@ class CompatFrontends:
                 cols = {desc["name"]: arr}
             for name, values in cols.items():
                 desc = self._input_desc(model, name)
-                arr = np.asarray(
-                    values, dtype=triton_to_np_dtype(desc["datatype"])
-                )
+                arr = self._decode_values(desc, values)
                 inputs.append(
                     CoreTensor(name, desc["datatype"], list(arr.shape), arr)
                 )
@@ -234,16 +229,50 @@ class CompatFrontends:
         response = await self.core.infer(
             CoreRequest(model_name=model_name, inputs=inputs)
         )
+
+        def encode(t):
+            arr = np.asarray(t.data)
+            if t.datatype == "BYTES":
+                import base64
+
+                flat = [
+                    {"b64": base64.b64encode(
+                        v if isinstance(v, bytes) else str(v).encode()
+                    ).decode("ascii")}
+                    for v in arr.reshape(-1)
+                ]
+                return np.array(flat, dtype=object).reshape(
+                    arr.shape
+                ).tolist()
+            return arr.tolist()
+
         if len(response.outputs) == 1:
-            predictions = np.asarray(response.outputs[0].data).tolist()
+            predictions = encode(response.outputs[0])
         else:
-            predictions = {
-                t.name: np.asarray(t.data).tolist()
-                for t in response.outputs
-            }
+            predictions = {t.name: encode(t) for t in response.outputs}
         return web.json_response({"predictions": predictions})
 
     # -- helpers -------------------------------------------------------------
+
+    @staticmethod
+    def _decode_values(desc, values):
+        """JSON values -> ndarray; TFS string tensors arrive as
+        {"b64": ...} objects (the REST API's binary encoding)."""
+        if desc["datatype"] == "BYTES":
+            import base64
+
+            def decode(v):
+                if isinstance(v, dict) and "b64" in v:
+                    return base64.b64decode(v["b64"])
+                if isinstance(v, str):
+                    return v.encode("utf-8")
+                return bytes(v)
+
+            flat = np.asarray(values, dtype=object)
+            return np.array(
+                [decode(v) for v in flat.reshape(-1)], dtype=object
+            ).reshape(flat.shape)
+        return np.asarray(values, dtype=triton_to_np_dtype(desc["datatype"]))
 
     @staticmethod
     def _input_desc(model, name):
