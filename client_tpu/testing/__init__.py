@@ -1,3 +1,27 @@
 """Test/bench harness utilities (in-process server, fixtures)."""
 
+import os
+
 from client_tpu.testing.inprocess import InProcessServer  # noqa: F401
+
+
+def hermetic_child_env(base=None, repo_path=None):
+    """Environment for hermetic-tier child processes: JAX pinned to the
+    host backend even on machines whose sitecustomize force-registers a
+    TPU-relay PJRT plugin.
+
+    ``JAX_PLATFORMS=cpu`` alone is not enough there: the injected
+    sitecustomize calls ``jax.config.update("jax_platforms", ...)`` at
+    interpreter startup, and a config update outranks the env var. Its
+    whole body is gated on ``PALLAS_AXON_POOL_IPS``, so dropping that
+    variable keeps children on the host backend (and alive when the
+    relay is unreachable). Device-tier benches must NOT use this.
+    """
+    env = dict(os.environ if base is None else base)
+    if repo_path:
+        env["PYTHONPATH"] = (
+            repo_path + os.pathsep + env.get("PYTHONPATH", "")
+        )
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env

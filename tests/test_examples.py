@@ -27,10 +27,10 @@ def server():
 
 
 def run_example(name, server, *args):
+    from client_tpu.testing import hermetic_child_env
+
     url = server.grpc_url if "grpc" in name else f"127.0.0.1:{server.http_port}"
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["JAX_PLATFORMS"] = "cpu"
+    env = hermetic_child_env(repo_path=REPO)
     out = subprocess.run(
         [sys.executable, os.path.join(EXAMPLES, name), "-u", url, *args],
         capture_output=True, text=True, timeout=180, env=env,

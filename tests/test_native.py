@@ -268,9 +268,9 @@ def test_cpp_perf_analyzer_openai_sse(native_build, live_llm_server,
 def test_cpp_perf_analyzer_local_inprocess(native_build):
     """--service-kind local embeds CPython and runs the ServerCore
     in-process (triton_c_api analogue): no sockets in the path."""
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["JAX_PLATFORMS"] = "cpu"
+    from client_tpu.testing import hermetic_child_env
+
+    env = hermetic_child_env(repo_path=REPO)
     out = subprocess.run(
         [os.path.join(native_build, "perf_analyzer"),
          "-m", "simple", "--service-kind", "local",
@@ -333,8 +333,9 @@ def test_python_native_mixed_rendezvous(native_build, live_server):
          "--coordinator", f"127.0.0.1:{20000 + (os.getpid() + 1) % 10000}"],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
     )
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    from client_tpu.testing import hermetic_child_env
+
+    env = hermetic_child_env(repo_path=REPO)
     pyrank = subprocess.Popen(
         [sys.executable, "-m", "client_tpu.perf.cli",
          "-m", "simple", "-u", live_server.http_url,
