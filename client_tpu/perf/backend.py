@@ -10,11 +10,15 @@ strategy, SURVEY.md §4 tier 1).
 """
 
 import asyncio
+import contextlib
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from client_tpu.utils import InferenceServerException
+from client_tpu.utils import (
+    TF_TO_KSERVE_DTYPE,
+    InferenceServerException,
+)
 
 
 class PerfInferInput:
@@ -486,7 +490,47 @@ class MockPerfBackend(PerfBackend):
         self.shm_unregistrations.append(name)
 
 
-class OpenAiPerfBackend(PerfBackend):
+class _RestSessionMixin:
+    """Shared lazy aiohttp session for REST backends: unbounded connector
+    (a capped connector would queue client-side and corrupt latency) and
+    close() that resets so a reused backend reopens cleanly.
+
+    ``_rest()`` is the request path: it maps transport-level failures
+    (connection refused, reset, timeout) to InferenceServerException so
+    callers — the CLI's connect handler in particular — see one error
+    type for both protocol and transport problems."""
+
+    _session = None
+
+    async def _sess(self):
+        if self._session is None or self._session.closed:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(limit=0)
+            )
+        return self._session
+
+    @contextlib.asynccontextmanager
+    async def _rest(self, method: str, url: str, **kwargs):
+        import aiohttp
+
+        session = await self._sess()
+        try:
+            async with session.request(method, url, **kwargs) as resp:
+                yield resp
+        except (aiohttp.ClientError, OSError, asyncio.TimeoutError) as e:
+            raise InferenceServerException(
+                f"{method} {url} failed: {e}"
+            ) from e
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+class OpenAiPerfBackend(_RestSessionMixin, PerfBackend):
     """OpenAI-compatible endpoint backend with SSE streaming (role of the
     reference openai client backend, client_backend/openai/openai_client.h).
 
@@ -498,24 +542,9 @@ class OpenAiPerfBackend(PerfBackend):
 
     def __init__(self, url: str, endpoint: str = "v1/chat/completions"):
         self._base = f"http://{url}/{endpoint.lstrip('/')}"
-        self._session = None
         # payload -> stream-enabled payload (corpora are small and cycled,
         # so the upgrade parse runs once per distinct payload).
         self._stream_payloads: Dict[str, str] = {}
-
-    def _ensure_session(self):
-        import aiohttp
-
-        if self._session is None:
-            self._session = aiohttp.ClientSession(
-                connector=aiohttp.TCPConnector(limit=0)
-            )
-        return self._session
-
-    async def close(self) -> None:
-        if self._session is not None:
-            await self._session.close()
-            self._session = None
 
     async def get_model_metadata(self, model_name, model_version=""):
         # No KServe metadata on OpenAI endpoints; fabricate the payload
@@ -549,8 +578,8 @@ class OpenAiPerfBackend(PerfBackend):
         )
 
     async def infer(self, model_name, inputs, **kwargs):
-        session = self._ensure_session()
-        async with session.post(
+        async with self._rest(
+            "POST",
             self._base,
             data=self._payload(inputs).encode(),
             headers={"Content-Type": "application/json"},
@@ -597,8 +626,8 @@ class OpenAiPerfBackend(PerfBackend):
             )
             self._stream_payloads[payload] = upgraded
         payload = upgraded
-        session = self._ensure_session()
-        async with session.post(
+        async with self._rest(
+            "POST",
             self._base,
             data=payload.encode(),
             headers={"Content-Type": "application/json"},
@@ -626,29 +655,6 @@ class OpenAiPerfBackend(PerfBackend):
                         on_response()
 
 
-class _RestSessionMixin:
-    """Shared lazy aiohttp session for REST backends: unbounded connector
-    (a capped connector would queue client-side and corrupt latency, same
-    reason OpenAiPerfBackend uses limit=0) and close() that resets so a
-    reused backend reopens cleanly."""
-
-    _session = None
-
-    async def _sess(self):
-        if self._session is None or self._session.closed:
-            import aiohttp
-
-            self._session = aiohttp.ClientSession(
-                connector=aiohttp.TCPConnector(limit=0)
-            )
-        return self._session
-
-    async def close(self) -> None:
-        if self._session is not None:
-            await self._session.close()
-            self._session = None
-
-
 class TfsPerfBackend(_RestSessionMixin, PerfBackend):
     """TensorFlow-Serving REST backend (the Python twin of the C++
     tfs_backend; reference client_backend/tensorflow_serving/ role):
@@ -656,20 +662,12 @@ class TfsPerfBackend(_RestSessionMixin, PerfBackend):
 
     kind = "tfserving"
 
-    _TF_TO_KSERVE = {
-        "DT_FLOAT": "FP32", "DT_DOUBLE": "FP64", "DT_INT32": "INT32",
-        "DT_INT64": "INT64", "DT_INT16": "INT16", "DT_INT8": "INT8",
-        "DT_UINT8": "UINT8", "DT_UINT16": "UINT16", "DT_BOOL": "BOOL",
-        "DT_STRING": "BYTES",
-    }
-
     def __init__(self, url: str):
         self._base = url if url.startswith("http") else f"http://{url}"
 
     async def get_model_metadata(self, model_name, model_version=""):
-        session = await self._sess()
-        async with session.get(
-            f"{self._base}/v1/models/{model_name}/metadata"
+        async with self._rest(
+            "GET", f"{self._base}/v1/models/{model_name}/metadata"
         ) as resp:
             if resp.status != 200:
                 raise InferenceServerException(
@@ -686,7 +684,7 @@ class TfsPerfBackend(_RestSessionMixin, PerfBackend):
         def convert(block):
             tensors = []
             for name, desc in block.items():
-                dtype = self._TF_TO_KSERVE.get(desc.get("dtype", ""))
+                dtype = TF_TO_KSERVE_DTYPE.get(desc.get("dtype", ""))
                 if dtype is None:
                     raise InferenceServerException(
                         f"signature tensor '{name}' has unsupported dtype "
@@ -751,8 +749,8 @@ class TfsPerfBackend(_RestSessionMixin, PerfBackend):
                 {name: per_input[name][r] for name in per_input}
                 for r in range(rows or 0)
             ]
-        session = await self._sess()
-        async with session.post(
+        async with self._rest(
+            "POST",
             f"{self._base}/v1/models/{model_name}:predict",
             json={"instances": instances},
         ) as resp:
@@ -774,8 +772,7 @@ class TorchServePerfBackend(_RestSessionMixin, PerfBackend):
         self._base = url if url.startswith("http") else f"http://{url}"
 
     async def connect(self) -> None:
-        session = await self._sess()
-        async with session.get(f"{self._base}/ping") as resp:
+        async with self._rest("GET", f"{self._base}/ping") as resp:
             if resp.status != 200:
                 raise InferenceServerException(
                     f"TorchServe /ping failed: HTTP {resp.status}"
@@ -806,8 +803,8 @@ class TorchServePerfBackend(_RestSessionMixin, PerfBackend):
                 body = body.encode("utf-8")
         else:
             body = np.ascontiguousarray(t.data).tobytes()
-        session = await self._sess()
-        async with session.post(
+        async with self._rest(
+            "POST",
             f"{self._base}/predictions/{model_name}",
             data=body,
             headers={"Content-Type": "application/octet-stream"},

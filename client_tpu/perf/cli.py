@@ -473,12 +473,20 @@ async def run(args) -> int:
                         "p50_us": best.status.latency_percentiles_us.get(50, 0),
                         "p99_us": best.status.latency_percentiles_us.get(99, 0),
                         "count": best.status.request_count,
+                        "errors": best.status.error_count,
                         "mode": best.mode,
                         "value": best.value,
                     }
                 )
             )
         return 0
+    except InferenceServerException as e:
+        # Setup/transport failures (unreachable endpoint, bad metadata,
+        # unsupported model) end the run with a message, not a traceback —
+        # per-request errors during measurement are recorded in the
+        # experiment records instead and never raise to here.
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     finally:
         if shm_plane is not None:
             await shm_plane.cleanup()

@@ -24,22 +24,10 @@ from aiohttp import web
 
 from client_tpu.server.core import CoreRequest, CoreTensor, ServerCore
 from client_tpu.utils import (
+    KSERVE_TO_TF_DTYPE as _TF_DTYPES,
     InferenceServerException,
     triton_to_np_dtype,
 )
-
-_TF_DTYPES = {
-    "FP32": "DT_FLOAT",
-    "FP64": "DT_DOUBLE",
-    "INT32": "DT_INT32",
-    "INT64": "DT_INT64",
-    "INT16": "DT_INT16",
-    "INT8": "DT_INT8",
-    "UINT8": "DT_UINT8",
-    "UINT16": "DT_UINT16",
-    "BOOL": "DT_BOOL",
-    "BYTES": "DT_STRING",
-}
 
 
 class CompatFrontends:

@@ -32,6 +32,8 @@ __all__ = [
     "serialized_byte_size",
     "num_elements",
     "bfloat16",
+    "KSERVE_TO_TF_DTYPE",
+    "TF_TO_KSERVE_DTYPE",
 ]
 
 
@@ -100,6 +102,24 @@ if bfloat16 is not None:
 
 _TRITON_TO_NP = {v: k for k, v in _NP_TO_TRITON.items()}
 _TRITON_TO_NP["BYTES"] = np.dtype(object)
+
+# TensorFlow wire dtype names <-> KServe, the single source for both the
+# TFS compat front-end (server side) and the tfserving perf backend
+# (client side); reference maps these per-component in
+# tfserve_grpc_client and the TFS signature parser.
+KSERVE_TO_TF_DTYPE = {
+    "FP32": "DT_FLOAT",
+    "FP64": "DT_DOUBLE",
+    "INT32": "DT_INT32",
+    "INT64": "DT_INT64",
+    "INT16": "DT_INT16",
+    "INT8": "DT_INT8",
+    "UINT8": "DT_UINT8",
+    "UINT16": "DT_UINT16",
+    "BOOL": "DT_BOOL",
+    "BYTES": "DT_STRING",
+}
+TF_TO_KSERVE_DTYPE = {v: k for k, v in KSERVE_TO_TF_DTYPE.items()}
 
 _FIXED_BYTE_SIZES = {
     "BOOL": 1,

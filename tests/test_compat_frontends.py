@@ -98,7 +98,16 @@ def test_tfs_bad_verb(server):
     assert err.value.code == 400
 
 
-def test_python_harness_tfserving(server):
+def _summary(capsys):
+    import json as _json
+
+    out = capsys.readouterr().out
+    return _json.loads(
+        [l for l in out.splitlines() if l.strip().startswith("{")][-1]
+    )
+
+
+def test_python_harness_tfserving(server, capsys):
     """The Python perf CLI drives the TFS protocol end to end (harness
     parity with the C++ tfs_backend)."""
     from client_tpu.perf import cli as perf_cli
@@ -116,21 +125,28 @@ def test_python_harness_tfserving(server):
         "--json-summary",
     ])
     assert code == 0
+    summary = _summary(capsys)
+    assert summary["errors"] == 0
+    assert summary["throughput"] > 0
 
 
-def test_python_harness_torchserve(server, tmp_path):
+def test_python_harness_torchserve(server, tmp_path, capsys):
+    """Raw-body /predictions/<m> driven from a directory corpus (the C++
+    twin feeds the same bytes; torchserve adapters decode raw tensors)."""
     from client_tpu.perf import cli as perf_cli
-    import json as _json
+    import numpy as np
 
-    payload = tmp_path / "inputs.json"
-    payload.write_text(_json.dumps({
-        "data": [{"data": {"content": ["[1.5, 2.5]"], "shape": [1]}}]
-    }))
+    # The fabricated torchserve contract is a single BYTES input named
+    # 'data'; the server adapter np.frombuffer()s the posted body with the
+    # model's dtype, so feed raw float32 bytes.
+    (tmp_path / "data").write_bytes(
+        np.asarray([1.5, 2.5], dtype=np.float32).tobytes()
+    )
     code = perf_cli.main([
         "-m", "identity_fp32",
         "-u", server.http_url,
         "--service-kind", "torchserve",
-        "--input-data", str(payload),
+        "--input-data", str(tmp_path),
         "--concurrency-range", "2",
         "--measurement-interval", "400",
         "--stability-percentage", "80",
@@ -138,6 +154,24 @@ def test_python_harness_torchserve(server, tmp_path):
         "--json-summary",
     ])
     assert code == 0
+    summary = _summary(capsys)
+    assert summary["errors"] == 0
+    assert summary["throughput"] > 0
+
+
+def test_python_harness_torchserve_unreachable():
+    """Transport failures surface as a clean CLI error, not a traceback
+    (aiohttp errors are wrapped into InferenceServerException)."""
+    from client_tpu.perf import cli as perf_cli
+
+    code = perf_cli.main([
+        "-m", "simple",
+        "-u", "127.0.0.1:1",
+        "--service-kind", "torchserve",
+        "--concurrency-range", "1",
+        "--max-trials", "1",
+    ])
+    assert code == 1
 
 
 def test_tfs_predict_string_tensor_b64(server):
