@@ -446,6 +446,14 @@ Error InferenceServerGrpcClient::Call(const std::string& method,
                                       google::protobuf::Message* resp,
                                       const Headers& headers,
                                       uint64_t timeout_us) {
+  return CallFramed(method, FrameMessage(req), resp, headers, timeout_us);
+}
+
+Error InferenceServerGrpcClient::CallFramed(const std::string& method,
+                                            const std::string& body,
+                                            google::protobuf::Message* resp,
+                                            const Headers& headers,
+                                            uint64_t timeout_us) {
   CTPU_RETURN_IF_ERROR(EnsureConnection());
   auto st = std::make_shared<UnaryCallState>();
   h2::StreamEvents ev;
@@ -459,7 +467,6 @@ Error InferenceServerGrpcClient::Call(const std::string& method,
   };
 
   std::shared_ptr<h2::Connection> conn = Conn();
-  const std::string body = FrameMessage(req);
   size_t sent = 0;
   const int32_t sid = conn->StartStreamWithData(
       BuildHeaders(method, headers, timeout_us), body.data(), body.size(),
@@ -800,6 +807,32 @@ Error InferenceServerGrpcClient::Infer(
   // full RTT as send time.
   Error err = Call("ModelInfer", request, response.get(), headers,
                    options.client_timeout_us);
+  timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
+  if (!err.IsOk()) return err;
+  UpdateInferStat(timers);
+  InferResultGrpc::Create(result, std::move(response));
+  return Error::Success();
+}
+
+Error InferenceServerGrpcClient::PrepareInferBody(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    std::string* framed) {
+  inference::ModelInferRequest request;
+  CTPU_RETURN_IF_ERROR(FillInferRequest(options, inputs, outputs, &request));
+  *framed = FrameMessage(request);
+  return Error::Success();
+}
+
+Error InferenceServerGrpcClient::InferFramed(InferResult** result,
+                                             const std::string& framed,
+                                             uint64_t client_timeout_us,
+                                             const Headers& headers) {
+  RequestTimers timers;
+  timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
+  auto response = std::make_shared<inference::ModelInferResponse>();
+  Error err = CallFramed("ModelInfer", framed, response.get(), headers,
+                         client_timeout_us);
   timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
   if (!err.IsOk()) return err;
   UpdateInferStat(timers);

@@ -147,6 +147,21 @@ class InferenceServerGrpcClient : public InferenceServerClient {
               const std::vector<InferInput*>& inputs,
               const std::vector<const InferRequestedOutput*>& outputs = {},
               const Headers& headers = {});
+  // Serialize a ModelInfer request once into a framed gRPC message body
+  // that InferFramed can resend without rebuilding the proto (the
+  // reference reuses the request proto across sends, PreRunProcessing,
+  // grpc_client.cc:1419-1580; pre-framing also skips re-serialization).
+  // The body is connection-independent.
+  Error PrepareInferBody(
+      const InferOptions& options, const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs,
+      std::string* framed);
+  // Unary inference with a body from PrepareInferBody. client_timeout_us
+  // plays InferOptions::client_timeout_us's role; the server-side timeout
+  // and every other option are baked into the body.
+  Error InferFramed(InferResult** result, const std::string& framed,
+                    uint64_t client_timeout_us = 0,
+                    const Headers& headers = {});
   Error AsyncInfer(OnCompleteFn callback, const InferOptions& options,
                    const std::vector<InferInput*>& inputs,
                    const std::vector<const InferRequestedOutput*>& outputs = {},
@@ -184,6 +199,10 @@ class InferenceServerGrpcClient : public InferenceServerClient {
   Error Call(const std::string& method, const google::protobuf::Message& req,
              google::protobuf::Message* resp, const Headers& headers,
              uint64_t timeout_us = 0);
+  // Call with an already-framed message body (no serialization).
+  Error CallFramed(const std::string& method, const std::string& body,
+                   google::protobuf::Message* resp, const Headers& headers,
+                   uint64_t timeout_us = 0);
   std::vector<hpack::Header> BuildHeaders(const std::string& method,
                                           const Headers& user_headers,
                                           uint64_t timeout_us);

@@ -45,6 +45,25 @@ class BackendContext {
                       const std::vector<InferInput*>& inputs,
                       const std::vector<const InferRequestedOutput*>& outputs,
                       RequestRecord* record) = 0;
+
+  // Prepared-request cache contract: the load manager tags deterministic
+  // (non-sequence) requests with a nonzero token identifying the corpus
+  // (stream, step) before calling Infer; a backend that can reuse a
+  // previously built wire request for that token reports HasPrepared true,
+  // and Infer with the token set may then be called with EMPTY
+  // inputs/outputs. Data is immutable after DataLoader init, so tokens
+  // never invalidate. Backends without a cache inherit the no-op (the
+  // manager then always prepares inputs). The reference reuses the request
+  // proto per context (PreRunProcessing, grpc_client.cc:1419-1580); this
+  // extends the idea to the framed wire bytes.
+  void SetNextCacheToken(uint64_t token) { cache_token_ = token; }
+  virtual bool HasPrepared(uint64_t token) const {
+    (void)token;
+    return false;
+  }
+
+ protected:
+  uint64_t cache_token_ = 0;
 };
 
 class ClientBackend {

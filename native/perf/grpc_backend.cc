@@ -232,7 +232,30 @@ Error GrpcBackendContext::Infer(
   }
   record->start_ns = RequestTimers::Now();
   InferResult* raw = nullptr;
-  err = client_->Infer(&raw, options, inputs, outputs);
+  std::shared_ptr<const std::string> cached =
+      cache_token_ != 0 ? body_cache_->Find(cache_token_) : nullptr;
+  if (cached != nullptr) {
+    err = client_->InferFramed(&raw, *cached, options.client_timeout_us);
+  } else if (cache_token_ != 0) {
+    // Bake an EMPTY wire id into the shared body: a reused per-send id
+    // would be a lie on every resend (unary correlation is by h2 stream;
+    // the harness's record ids stay host-side).
+    InferOptions idless = options;
+    idless.request_id.clear();
+    std::string framed;
+    err = client_->PrepareInferBody(idless, inputs, outputs, &framed);
+    if (err.IsOk()) {
+      // Insert BEFORE the blocking send: concurrent contexts missing the
+      // same token can then hit immediately instead of all rebuilding the
+      // body during the first in-flight window. A send failure doesn't
+      // invalidate the body — it is deterministic for this token.
+      std::shared_ptr<const std::string> body =
+          body_cache_->Insert(cache_token_, std::move(framed));
+      err = client_->InferFramed(&raw, *body, options.client_timeout_us);
+    }
+  } else {
+    err = client_->Infer(&raw, options, inputs, outputs);
+  }
   record->end_ns = RequestTimers::Now();
   record->response_ns.push_back(record->end_ns);
   if (!err.IsOk()) {

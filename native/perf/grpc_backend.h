@@ -7,6 +7,7 @@
 
 #include <condition_variable>
 #include <mutex>
+#include <unordered_map>
 
 #include "client_backend.h"
 #include "grpc_client.h"
@@ -14,19 +15,67 @@
 namespace ctpu {
 namespace perf {
 
+// Framed unary request bodies by cache token, shared by every context of
+// one backend (bodies are immutable and connection-independent, so
+// per-context copies would just multiply the corpus by the concurrency
+// level). Size-capped: oversized corpora fall back to per-send builds
+// rather than holding the whole corpus in memory again.
+struct PreparedBodyCache {
+  static constexpr size_t kMaxBytes = 64ull << 20;
+
+  std::shared_ptr<const std::string> Find(uint64_t token) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = map_.find(token);
+    return it == map_.end() ? nullptr : it->second;
+  }
+  // Returns the cached body for the token: the inserted one, the earlier
+  // winner of a racing insert, or (over the size cap) an uncached
+  // shared_ptr the caller still sends from.
+  std::shared_ptr<const std::string> Insert(uint64_t token,
+                                            std::string body) {
+    auto owned = std::make_shared<const std::string>(std::move(body));
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = map_.find(token);
+    if (it != map_.end()) return it->second;
+    if (bytes_ >= kMaxBytes) return owned;
+    bytes_ += owned->size();
+    map_.emplace(token, owned);
+    return owned;
+  }
+  bool Has(uint64_t token) {
+    std::lock_guard<std::mutex> lk(mu_);
+    return map_.count(token) != 0;
+  }
+
+ private:
+  std::mutex mu_;
+  std::unordered_map<uint64_t, std::shared_ptr<const std::string>> map_;
+  size_t bytes_ = 0;
+};
+
 class GrpcBackendContext : public BackendContext {
  public:
   // streaming: drive requests over one ModelStreamInfer bidi stream.
   // decoupled: a request is complete at the triton_final_response marker
   // (otherwise responses map 1:1 to requests).
-  GrpcBackendContext(std::string url, bool streaming, bool decoupled)
-      : url_(std::move(url)), streaming_(streaming), decoupled_(decoupled) {}
+  GrpcBackendContext(std::string url, bool streaming, bool decoupled,
+                     std::shared_ptr<PreparedBodyCache> body_cache)
+      : url_(std::move(url)),
+        streaming_(streaming),
+        decoupled_(decoupled),
+        body_cache_(std::move(body_cache)) {}
   ~GrpcBackendContext() override;
 
   Error Infer(const InferOptions& options,
               const std::vector<InferInput*>& inputs,
               const std::vector<const InferRequestedOutput*>& outputs,
               RequestRecord* record) override;
+
+  bool HasPrepared(uint64_t token) const override {
+    // Streaming correlates responses by per-send request id, which a
+    // reused body cannot carry.
+    return !streaming_ && body_cache_->Has(token);
+  }
 
  private:
   Error EnsureClient();
@@ -40,6 +89,7 @@ class GrpcBackendContext : public BackendContext {
   bool decoupled_;
   std::unique_ptr<InferenceServerGrpcClient> client_;
   bool stream_started_ = false;
+  std::shared_ptr<PreparedBodyCache> body_cache_;
 
   // In-flight stream request state (one outstanding request per context;
   // contexts are single-threaded by contract). Responses are correlated by
@@ -69,7 +119,7 @@ class GrpcClientBackend : public ClientBackend {
       const std::string& model_name) override;
   std::unique_ptr<BackendContext> CreateContext() override {
     return std::unique_ptr<BackendContext>(
-        new GrpcBackendContext(url_, streaming_, decoupled_));
+        new GrpcBackendContext(url_, streaming_, decoupled_, body_cache_));
   }
   Error RegisterSystemSharedMemory(const std::string& name,
                                    const std::string& key,
@@ -104,6 +154,8 @@ class GrpcClientBackend : public ClientBackend {
   bool streaming_;
   bool decoupled_ = false;  // learned from ModelConfig
   std::unique_ptr<InferenceServerGrpcClient> client_;
+  std::shared_ptr<PreparedBodyCache> body_cache_ =
+      std::make_shared<PreparedBodyCache>();
 };
 
 }  // namespace perf

@@ -26,6 +26,23 @@ struct PreparedRequest {
   const json::Value* step_parameters = nullptr;  // may be null
 };
 
+// Packs a prepared-request cache token: wrapped corpus coordinates plus
+// the slot when the request depends on it (per-slot output shm regions).
+// Nonzero by construction (step field is +1). Coordinates that overflow
+// their field widths (16-bit slot, 24-bit stream/step) yield 0 — an
+// uncacheable request — rather than colliding with another coordinate's
+// token, which would resend the wrong cached body.
+inline uint64_t PackCacheToken(size_t slot_field, size_t stream_wrapped,
+                               size_t step_wrapped) {
+  if (slot_field >= (1ull << 16) || stream_wrapped + 1 >= (1ull << 24) ||
+      step_wrapped + 1 >= (1ull << 24)) {
+    return 0;
+  }
+  return (static_cast<uint64_t>(slot_field) << 48) |
+         ((static_cast<uint64_t>(stream_wrapped) + 1) << 24) |
+         (static_cast<uint64_t>(step_wrapped) + 1);
+}
+
 class IInferDataManager {
  public:
   virtual ~IInferDataManager() = default;
@@ -34,6 +51,12 @@ class IInferDataManager {
   // per-slot so concurrent in-flight requests never write the same pages.
   virtual Error Prepare(size_t slot, size_t stream, size_t step,
                         PreparedRequest* request) = 0;
+  // Canonical token for the backend's prepared-request cache: equal tokens
+  // guarantee Prepare() yields an identical wire request (coordinates are
+  // wrapped the same way GetStep wraps; slot is encoded only when output
+  // regions make the request slot-dependent). 0 = not cacheable.
+  virtual uint64_t CacheToken(size_t slot, size_t stream,
+                              size_t step) const = 0;
   virtual Error Cleanup() { return Error::Success(); }
 };
 
@@ -63,6 +86,13 @@ class InferDataManager : public IInferDataManager {
     request->step_parameters =
         data.parameters.IsNull() ? nullptr : &data.parameters;
     return Error::Success();
+  }
+
+  uint64_t CacheToken(size_t slot, size_t stream,
+                      size_t step) const override {
+    (void)slot;  // inputs reference shared corpus bytes; slot-independent
+    const size_t sw = stream % loader_->StreamCount();
+    return PackCacheToken(0, sw, step % loader_->StepCount(sw));
   }
 
  private:
@@ -100,6 +130,15 @@ class InferDataManagerShm : public IInferDataManager {
   Error Init() override;
   Error Prepare(size_t slot, size_t stream, size_t step,
                 PreparedRequest* request) override;
+  uint64_t CacheToken(size_t slot, size_t stream,
+                      size_t step) const override {
+    // Output regions are per-slot, so the token carries the slot whenever
+    // outputs ride shared memory; inputs are per-(stream, step) regions.
+    const size_t sw = stream % loader_->StreamCount();
+    const size_t slot_field =
+        (output_shm_size_ > 0 && !output_descs_.empty()) ? slot + 1 : 0;
+    return PackCacheToken(slot_field, sw, step % loader_->StepCount(sw));
+  }
   Error Cleanup() override;
 
  private:

@@ -1,12 +1,40 @@
 #include "load_manager.h"
 
 #include <chrono>
+#include <cstdlib>
 
 namespace ctpu {
 namespace perf {
 
 void LoadManager::IssueOne(BackendContext* ctx, size_t slot, size_t stream,
                            size_t step) {
+  // Non-sequence requests are deterministic per corpus coordinate, so the
+  // backend may resend a previously built wire request (sequence options
+  // change per send and defeat caching). On a hit, input preparation is
+  // skipped entirely. CTPU_PERF_NO_PREPARED_CACHE=1 disables reuse for
+  // A/B measurement.
+  static const bool cache_disabled = [] {
+    const char* v = getenv("CTPU_PERF_NO_PREPARED_CACHE");
+    return v != nullptr && v[0] == '1';
+  }();
+  const uint64_t token = (sequences_ == nullptr && !cache_disabled)
+                             ? data_->CacheToken(slot, stream, step)
+                             : 0;
+  ctx->SetNextCacheToken(token);
+  if (token != 0 && ctx->HasPrepared(token)) {
+    InferOptions options(config_.model_name);
+    options.model_version = config_.model_version;
+    options.client_timeout_us = config_.client_timeout_us;
+    RequestRecord record;
+    record.request_id = request_seq_.fetch_add(1);
+    static const std::vector<InferInput*> kNoInputs;
+    static const std::vector<const InferRequestedOutput*> kNoOutputs;
+    ctx->Infer(options, kNoInputs, kNoOutputs, &record);
+    std::lock_guard<std::mutex> lk(records_mu_);
+    records_.push_back(std::move(record));
+    return;
+  }
+
   PreparedRequest request;
   Error err = data_->Prepare(slot, stream, step, &request);
   if (!err.IsOk()) {

@@ -7,6 +7,7 @@
 #include <atomic>
 #include <chrono>
 #include <mutex>
+#include <set>
 #include <thread>
 
 #include "client_backend.h"
@@ -26,8 +27,11 @@ class MockBackendContext : public BackendContext {
               const std::vector<const InferRequestedOutput*>& outputs,
               RequestRecord* record) override;
 
+  bool HasPrepared(uint64_t token) const override;
+
  private:
   MockClientBackend* backend_;
+  std::set<uint64_t> seen_tokens_;
 };
 
 class MockClientBackend : public ClientBackend {
@@ -35,6 +39,10 @@ class MockClientBackend : public ClientBackend {
   struct Options {
     // simulated per-request latency
     uint64_t latency_us = 1000;
+    // simulate a backend with a prepared-request cache (the gRPC
+    // backend's framed-body reuse): contexts report HasPrepared for any
+    // token they have sent once
+    bool prepared_cache = false;
     // every Nth request fails (0 = never; reference SetReturnStatuses role)
     int error_every = 0;
     // responses per request (decoupled simulation)
@@ -101,6 +109,10 @@ class MockClientBackend : public ClientBackend {
   std::atomic<int> tpu_shm_register_count{0};
   std::atomic<int> tpu_shm_unregister_count{0};
   std::string last_tpu_raw_handle;
+  // prepared-cache accounting: sends issued from a cached request (their
+  // Infer call carries empty inputs by contract)
+  std::atomic<uint64_t> prepared_hits{0};
+  std::atomic<uint64_t> empty_input_sends{0};
   // sequence accounting: per-sequence observed (starts, steps, ended)
   struct SeqStat {
     int starts = 0;
@@ -113,10 +125,23 @@ class MockClientBackend : public ClientBackend {
   Options options_;
 };
 
+inline bool MockBackendContext::HasPrepared(uint64_t token) const {
+  return backend_->options_.prepared_cache &&
+         seen_tokens_.count(token) != 0;
+}
+
 inline Error MockBackendContext::Infer(
-    const InferOptions& options, const std::vector<InferInput*>&,
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
     const std::vector<const InferRequestedOutput*>&, RequestRecord* record) {
   auto* b = backend_;
+  if (b->options_.prepared_cache && cache_token_ != 0) {
+    if (seen_tokens_.count(cache_token_) != 0) {
+      b->prepared_hits++;
+      if (inputs.empty()) b->empty_input_sends++;
+    } else {
+      seen_tokens_.insert(cache_token_);
+    }
+  }
   uint64_t n = ++b->request_count;
   int cur = ++b->inflight;
   int prev = b->max_inflight.load();

@@ -251,3 +251,89 @@ TEST_CASE("report: csv + export + summary are well formed") {
   json::Value sv = json::Parse(summary);
   CHECK(sv["throughput"].AsDouble() > 0);
 }
+
+namespace {
+
+// Counts Prepare() calls while delegating to a real InferDataManager —
+// verifies the manager skips input preparation once the backend holds a
+// prepared wire request for the token.
+struct CountingDataManager : public IInferDataManager {
+  InferDataManager inner;
+  std::atomic<int> prepares{0};
+  explicit CountingDataManager(const DataLoader* loader) : inner(loader) {}
+  Error Init() override { return inner.Init(); }
+  Error Prepare(size_t slot, size_t stream, size_t step,
+                PreparedRequest* request) override {
+    prepares++;
+    return inner.Prepare(slot, stream, step, request);
+  }
+  uint64_t CacheToken(size_t slot, size_t stream,
+                      size_t step) const override {
+    return inner.CacheToken(slot, stream, step);
+  }
+};
+
+}  // namespace
+
+TEST_CASE("prepared cache: repeat sends skip Prepare and carry no inputs") {
+  MockClientBackend::Options options;
+  options.latency_us = 500;
+  options.prepared_cache = true;
+  Harness h(options);
+  CountingDataManager counting(h.loader.get());
+  ConcurrencyManager manager(h.backend, &counting, h.config);
+  manager.ChangeConcurrency(4);
+  SleepMs(120);
+  manager.Stop();
+  const uint64_t total = h.mock->request_count.load();
+  const uint64_t hits = h.mock->prepared_hits.load();
+  CHECK(total > 20u);
+  // synthetic corpus: one stream, one step -> each of the 4 contexts
+  // prepares exactly once, every later send is a cache hit
+  CHECK_EQ(counting.prepares.load(), 4);
+  CHECK_EQ(hits, total - 4);
+  // and the manager passed empty inputs on every hit (the contract that
+  // lets the gRPC backend resend its framed body untouched)
+  CHECK_EQ(h.mock->empty_input_sends.load(), hits);
+}
+
+TEST_CASE("prepared cache: sequence runs never use it") {
+  MockClientBackend::Options options;
+  options.latency_us = 500;
+  options.prepared_cache = true;
+  Harness h(options);
+  CountingDataManager counting(h.loader.get());
+  SequenceManager sequences(/*start_id=*/1, h.config.max_threads,
+                            /*sequence_length=*/4);
+  ConcurrencyManager manager(h.backend, &counting, h.config, &sequences);
+  manager.ChangeConcurrency(2);
+  SleepMs(80);
+  manager.Stop();
+  // sequence options vary per send: every request prepared fresh
+  CHECK_EQ(static_cast<uint64_t>(counting.prepares.load()),
+           h.mock->request_count.load());
+  CHECK_EQ(h.mock->prepared_hits.load(), 0u);
+}
+
+TEST_CASE("prepared cache: tokens wrap corpus coordinates and encode slot "
+          "only for shm output regions") {
+  Harness h;
+  InferDataManager plain(h.loader.get());
+  // one stream, one step in the synthetic corpus: steps wrap to the same
+  // token; slots never matter for the plain manager
+  CHECK_EQ(plain.CacheToken(0, 0, 0), plain.CacheToken(0, 0, 1));
+  CHECK_EQ(plain.CacheToken(0, 0, 0), plain.CacheToken(3, 0, 0));
+  CHECK_EQ(plain.CacheToken(0, 0, 0), plain.CacheToken(0, 1, 0));
+  CHECK(plain.CacheToken(0, 0, 0) != 0u);
+  // shm manager without output regions: slot-independent too
+  InferDataManagerShm shm_no_out(h.loader.get(), h.backend.get(),
+                                 InferDataManagerShm::ShmKind::SYSTEM);
+  CHECK_EQ(shm_no_out.CacheToken(0, 0, 0), shm_no_out.CacheToken(5, 0, 0));
+  // with output regions the request bakes per-slot region names: the token
+  // must separate slots
+  InferDataManagerShm shm_out(
+      h.loader.get(), h.backend.get(), InferDataManagerShm::ShmKind::SYSTEM,
+      /*output_shm_size=*/64, {TensorDesc{"OUT", "FP32", {8}}});
+  CHECK(shm_out.CacheToken(0, 0, 0) != shm_out.CacheToken(1, 0, 0));
+  CHECK_EQ(shm_out.CacheToken(2, 0, 0), shm_out.CacheToken(2, 0, 1));
+}
