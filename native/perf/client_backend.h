@@ -14,7 +14,9 @@
 #include <functional>
 #include <map>
 #include <memory>
+#include <mutex>
 #include <string>
+#include <unordered_map>
 #include <vector>
 
 #include "common.h"
@@ -64,6 +66,46 @@ class BackendContext {
 
  protected:
   uint64_t cache_token_ = 0;
+};
+
+// Prepared wire-request store shared by every context of one backend
+// (bodies are immutable and connection-independent; per-context copies
+// would multiply the corpus by the concurrency level). Size-capped:
+// oversized corpora fall back to per-send builds rather than holding the
+// whole corpus in memory again.
+template <typename V>
+class PreparedCache {
+ public:
+  static constexpr size_t kMaxBytes = 64ull << 20;
+
+  std::shared_ptr<const V> Find(uint64_t token) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = map_.find(token);
+    return it == map_.end() ? nullptr : it->second;
+  }
+  // Returns the cached value for the token: the inserted one, the earlier
+  // winner of a racing insert, or (over the size cap) an uncached
+  // shared_ptr the caller still sends from. `bytes` is the value's cap
+  // accounting weight.
+  std::shared_ptr<const V> Insert(uint64_t token, V value, size_t bytes) {
+    auto owned = std::make_shared<const V>(std::move(value));
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = map_.find(token);
+    if (it != map_.end()) return it->second;
+    if (bytes_ >= kMaxBytes) return owned;
+    bytes_ += bytes;
+    map_.emplace(token, owned);
+    return owned;
+  }
+  bool Has(uint64_t token) {
+    std::lock_guard<std::mutex> lk(mu_);
+    return map_.count(token) != 0;
+  }
+
+ private:
+  std::mutex mu_;
+  std::unordered_map<uint64_t, std::shared_ptr<const V>> map_;
+  size_t bytes_ = 0;
 };
 
 class ClientBackend {

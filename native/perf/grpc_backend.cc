@@ -249,8 +249,9 @@ Error GrpcBackendContext::Infer(
       // same token can then hit immediately instead of all rebuilding the
       // body during the first in-flight window. A send failure doesn't
       // invalidate the body — it is deterministic for this token.
+      const size_t weight = framed.size();
       std::shared_ptr<const std::string> body =
-          body_cache_->Insert(cache_token_, std::move(framed));
+          body_cache_->Insert(cache_token_, std::move(framed), weight);
       err = client_->InferFramed(&raw, *body, options.client_timeout_us);
     }
   } else {

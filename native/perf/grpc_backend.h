@@ -7,7 +7,6 @@
 
 #include <condition_variable>
 #include <mutex>
-#include <unordered_map>
 
 #include "client_backend.h"
 #include "grpc_client.h"
@@ -15,43 +14,8 @@
 namespace ctpu {
 namespace perf {
 
-// Framed unary request bodies by cache token, shared by every context of
-// one backend (bodies are immutable and connection-independent, so
-// per-context copies would just multiply the corpus by the concurrency
-// level). Size-capped: oversized corpora fall back to per-send builds
-// rather than holding the whole corpus in memory again.
-struct PreparedBodyCache {
-  static constexpr size_t kMaxBytes = 64ull << 20;
-
-  std::shared_ptr<const std::string> Find(uint64_t token) {
-    std::lock_guard<std::mutex> lk(mu_);
-    auto it = map_.find(token);
-    return it == map_.end() ? nullptr : it->second;
-  }
-  // Returns the cached body for the token: the inserted one, the earlier
-  // winner of a racing insert, or (over the size cap) an uncached
-  // shared_ptr the caller still sends from.
-  std::shared_ptr<const std::string> Insert(uint64_t token,
-                                            std::string body) {
-    auto owned = std::make_shared<const std::string>(std::move(body));
-    std::lock_guard<std::mutex> lk(mu_);
-    auto it = map_.find(token);
-    if (it != map_.end()) return it->second;
-    if (bytes_ >= kMaxBytes) return owned;
-    bytes_ += owned->size();
-    map_.emplace(token, owned);
-    return owned;
-  }
-  bool Has(uint64_t token) {
-    std::lock_guard<std::mutex> lk(mu_);
-    return map_.count(token) != 0;
-  }
-
- private:
-  std::mutex mu_;
-  std::unordered_map<uint64_t, std::shared_ptr<const std::string>> map_;
-  size_t bytes_ = 0;
-};
+// Framed unary gRPC request bodies by cache token.
+using PreparedBodyCache = PreparedCache<std::string>;
 
 class GrpcBackendContext : public BackendContext {
  public:

@@ -56,10 +56,30 @@ Error HttpBackendContext::Infer(
   if (json_body_) return InferJson(options, inputs, outputs, record);
   record->start_ns = RequestTimers::Now();
 
-  std::string body;
-  size_t header_length = 0;
-  CTPU_RETURN_IF_ERROR(InferenceServerHttpClient::GenerateRequestBody(
-      &body, &header_length, options, inputs, outputs));
+  // Prepared-request reuse (same contract as the gRPC backend): resend a
+  // previously built binary-protocol body for deterministic corpus
+  // coordinates; cached bodies carry an empty request id.
+  std::shared_ptr<const PreparedHttpBody> prepared =
+      cache_token_ != 0 ? body_cache_->Find(cache_token_) : nullptr;
+  PreparedHttpBody built;  // backs the non-cached path, no heap wrapper
+  const PreparedHttpBody* request_body = prepared.get();
+  if (request_body == nullptr) {
+    if (cache_token_ != 0) {
+      InferOptions idless = options;
+      idless.request_id.clear();
+      CTPU_RETURN_IF_ERROR(InferenceServerHttpClient::GenerateRequestBody(
+          &built.body, &built.header_length, idless, inputs, outputs));
+      const size_t weight = built.body.size();
+      prepared = body_cache_->Insert(cache_token_, std::move(built), weight);
+      request_body = prepared.get();
+    } else {
+      CTPU_RETURN_IF_ERROR(InferenceServerHttpClient::GenerateRequestBody(
+          &built.body, &built.header_length, options, inputs, outputs));
+      request_body = &built;
+    }
+  }
+  const std::string& body = request_body->body;
+  const size_t header_length = request_body->header_length;
 
   std::string uri = "v2/models/" + options.model_name;
   if (!options.model_version.empty()) {

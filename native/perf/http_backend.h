@@ -9,16 +9,31 @@
 namespace ctpu {
 namespace perf {
 
+// A built binary-protocol request: JSON header + concatenated raw tensor
+// bytes, plus the header length the wire prefixes.
+struct PreparedHttpBody {
+  std::string body;
+  size_t header_length = 0;
+};
+using PreparedHttpCache = PreparedCache<PreparedHttpBody>;
+
 class HttpBackendContext : public BackendContext {
  public:
-  HttpBackendContext(const std::string& host, int port,
-                     bool json_body = false)
-      : conn_(host, port), json_body_(json_body) {}
+  HttpBackendContext(const std::string& host, int port, bool json_body,
+                     std::shared_ptr<PreparedHttpCache> body_cache)
+      : conn_(host, port),
+        json_body_(json_body),
+        body_cache_(std::move(body_cache)) {}
 
   Error Infer(const InferOptions& options,
               const std::vector<InferInput*>& inputs,
               const std::vector<const InferRequestedOutput*>& outputs,
               RequestRecord* record) override;
+
+  bool HasPrepared(uint64_t token) const override {
+    // The JSON tensor format is a debugging path; keep it build-per-send.
+    return !json_body_ && body_cache_->Has(token);
+  }
 
  private:
   Error InferJson(const InferOptions& options,
@@ -28,6 +43,7 @@ class HttpBackendContext : public BackendContext {
 
   HttpConnection conn_;
   bool json_body_ = false;
+  std::shared_ptr<PreparedHttpCache> body_cache_;
 };
 
 class HttpClientBackend : public ClientBackend {
@@ -53,7 +69,7 @@ class HttpClientBackend : public ClientBackend {
       const std::string& model_name) override;
   std::unique_ptr<BackendContext> CreateContext() override {
     return std::unique_ptr<BackendContext>(
-        new HttpBackendContext(host_, port_, json_body_));
+        new HttpBackendContext(host_, port_, json_body_, body_cache_));
   }
   Error RegisterSystemSharedMemory(const std::string& name,
                                    const std::string& key,
@@ -85,6 +101,8 @@ class HttpClientBackend : public ClientBackend {
   int port_;
   bool json_body_ = false;
   std::unique_ptr<InferenceServerHttpClient> client_;
+  std::shared_ptr<PreparedHttpCache> body_cache_ =
+      std::make_shared<PreparedHttpCache>();
 };
 
 }  // namespace perf
