@@ -1,0 +1,79 @@
+// Health probes + server/model metadata + model config over gRPC.
+//
+// Parity with reference src/c++/examples/simple_grpc_health_metadata.cc.
+
+#include <iostream>
+#include <memory>
+#include <string>
+
+#include "grpc_client.h"
+
+namespace {
+
+void FailOnError(const ctpu::Error& err, const char* what) {
+  if (!err.IsOk()) {
+    std::cerr << "error: " << what << ": " << err.Message() << std::endl;
+    exit(1);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8001";
+  std::string model_name = "simple";
+  bool verbose = false;
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    if (arg == "-u" && i + 1 < argc) url = argv[++i];
+    if (arg == "-m" && i + 1 < argc) model_name = argv[++i];
+    if (arg == "-v") verbose = true;
+  }
+
+  std::unique_ptr<ctpu::InferenceServerGrpcClient> client;
+  FailOnError(ctpu::InferenceServerGrpcClient::Create(&client, url, verbose),
+              "create client");
+
+  bool live = false, ready = false, model_ready = false;
+  FailOnError(client->IsServerLive(&live), "server live");
+  FailOnError(client->IsServerReady(&ready), "server ready");
+  FailOnError(client->IsModelReady(&model_ready, model_name), "model ready");
+  if (!live || !ready || !model_ready) {
+    std::cerr << "error: live=" << live << " ready=" << ready
+              << " model_ready=" << model_ready << std::endl;
+    return 1;
+  }
+
+  inference::ServerMetadataResponse server_meta;
+  FailOnError(client->ServerMetadata(&server_meta), "server metadata");
+  if (server_meta.name().empty() || server_meta.version().empty()) {
+    std::cerr << "error: empty server metadata" << std::endl;
+    return 1;
+  }
+
+  inference::ModelMetadataResponse model_meta;
+  FailOnError(client->ModelMetadata(&model_meta, model_name),
+              "model metadata");
+  if (model_meta.name() != model_name || model_meta.inputs_size() == 0) {
+    std::cerr << "error: bad model metadata" << std::endl;
+    return 1;
+  }
+
+  inference::ModelConfigResponse config;
+  FailOnError(client->ModelConfig(&config, model_name), "model config");
+  if (config.config().name() != model_name) {
+    std::cerr << "error: config name mismatch" << std::endl;
+    return 1;
+  }
+
+  if (verbose) {
+    std::cout << "server: " << server_meta.name() << " "
+              << server_meta.version() << std::endl;
+    std::cout << "model: " << model_meta.name() << " inputs "
+              << model_meta.inputs_size() << " outputs "
+              << model_meta.outputs_size() << " max_batch_size "
+              << config.config().max_batch_size() << std::endl;
+  }
+  std::cout << "PASS : simple_grpc_health_metadata" << std::endl;
+  return 0;
+}

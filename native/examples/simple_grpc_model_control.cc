@@ -1,0 +1,72 @@
+// Model repository control over gRPC: index, unload, reload.
+//
+// Parity with reference src/c++/examples/simple_grpc_model_control.cc
+// (load/unload + readiness transitions; index plays the repository-scan
+// role).
+
+#include <iostream>
+#include <memory>
+#include <string>
+
+#include "grpc_client.h"
+
+namespace {
+
+void FailOnError(const ctpu::Error& err, const char* what) {
+  if (!err.IsOk()) {
+    std::cerr << "error: " << what << ": " << err.Message() << std::endl;
+    exit(1);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8001";
+  std::string model_name = "simple";
+  bool verbose = false;
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    if (arg == "-u" && i + 1 < argc) url = argv[++i];
+    if (arg == "-m" && i + 1 < argc) model_name = argv[++i];
+    if (arg == "-v") verbose = true;
+  }
+
+  std::unique_ptr<ctpu::InferenceServerGrpcClient> client;
+  FailOnError(ctpu::InferenceServerGrpcClient::Create(&client, url, verbose),
+              "create client");
+
+  inference::RepositoryIndexResponse index;
+  FailOnError(client->ModelRepositoryIndex(&index), "repository index");
+  bool found = false;
+  for (const auto& m : index.models()) {
+    if (m.name() == model_name) found = true;
+    if (verbose) std::cout << "index: " << m.name() << " " << m.state()
+                           << std::endl;
+  }
+  if (!found) {
+    std::cerr << "error: '" << model_name << "' not in repository index"
+              << std::endl;
+    return 1;
+  }
+
+  FailOnError(client->UnloadModel(model_name), "unload");
+  bool ready = true;
+  FailOnError(client->IsModelReady(&ready, model_name),
+              "model ready after unload");
+  if (ready) {
+    std::cerr << "error: model still ready after unload" << std::endl;
+    return 1;
+  }
+
+  FailOnError(client->LoadModel(model_name), "load");
+  FailOnError(client->IsModelReady(&ready, model_name),
+              "model ready after load");
+  if (!ready) {
+    std::cerr << "error: model not ready after load" << std::endl;
+    return 1;
+  }
+
+  std::cout << "PASS : simple_grpc_model_control" << std::endl;
+  return 0;
+}

@@ -1,0 +1,82 @@
+// BYTES (string) tensor round-trip over gRPC against identity_bytes.
+//
+// Parity with reference src/c++/examples/simple_grpc_string_infer_client.cc:
+// string tensors ride the 4-byte-length-prefixed BYTES serialization
+// (client_tpu.utils serialize_byte_tensor is the Python twin).
+
+#include <cstdint>
+#include <cstring>
+#include <iostream>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "grpc_client.h"
+
+namespace {
+
+void FailOnError(const ctpu::Error& err, const char* what) {
+  if (!err.IsOk()) {
+    std::cerr << "error: " << what << ": " << err.Message() << std::endl;
+    exit(1);
+  }
+}
+
+// Parse a BYTES tensor payload (uint32-LE length prefix per element).
+std::vector<std::string> ParseBytesTensor(const uint8_t* buf, size_t size) {
+  std::vector<std::string> out;
+  size_t pos = 0;
+  while (pos + 4 <= size) {
+    uint32_t len;
+    std::memcpy(&len, buf + pos, 4);
+    pos += 4;
+    if (pos + len > size) break;
+    out.emplace_back(reinterpret_cast<const char*>(buf + pos), len);
+    pos += len;
+  }
+  return out;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8001";
+  bool verbose = false;
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    if (arg == "-u" && i + 1 < argc) url = argv[++i];
+    if (arg == "-v") verbose = true;
+  }
+
+  std::unique_ptr<ctpu::InferenceServerGrpcClient> client;
+  FailOnError(ctpu::InferenceServerGrpcClient::Create(&client, url, verbose),
+              "create client");
+
+  const std::vector<std::string> strings = {"hello", "", "tpu \xF0\x9F\x8C\x8A",
+                                            std::string("\0binary\0", 8)};
+  ctpu::InferInput input("INPUT0", {static_cast<int64_t>(strings.size())},
+                         "BYTES");
+  FailOnError(input.AppendFromString(strings), "set INPUT0");
+  ctpu::InferRequestedOutput output("OUTPUT0");
+  ctpu::InferOptions options("identity_bytes");
+
+  ctpu::InferResult* raw = nullptr;
+  FailOnError(client->Infer(&raw, options, {&input}, {&output}), "infer");
+  std::unique_ptr<ctpu::InferResult> result(raw);
+  FailOnError(result->RequestStatus(), "request status");
+
+  const uint8_t* data;
+  size_t size;
+  FailOnError(result->RawData("OUTPUT0", &data, &size), "OUTPUT0 data");
+  const std::vector<std::string> echoed = ParseBytesTensor(data, size);
+  if (echoed != strings) {
+    std::cerr << "error: BYTES round-trip mismatch (" << echoed.size()
+              << " elements back)" << std::endl;
+    return 1;
+  }
+  if (verbose) {
+    for (const auto& s : echoed) std::cout << "echo: " << s << std::endl;
+  }
+  std::cout << "PASS : simple_grpc_string_infer_client" << std::endl;
+  return 0;
+}

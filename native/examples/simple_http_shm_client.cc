@@ -1,0 +1,116 @@
+// System shared-memory example: inputs AND outputs ride /dev/shm regions,
+// only region references cross the wire.
+//
+// Role parity with reference src/c++/examples/simple_http_shm_client.cc
+// (create regions, register, infer with shm-backed IO, validate, clean up).
+
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <iostream>
+#include <memory>
+#include <vector>
+
+#include "http_client.h"
+#include "shm_utils.h"
+
+namespace {
+
+void FailOnError(const ctpu::Error& err, const char* what) {
+  if (!err.IsOk()) {
+    std::cerr << "error: " << what << ": " << err.Message() << std::endl;
+    exit(1);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  bool verbose = false;
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    if (arg == "-u" && i + 1 < argc) url = argv[++i];
+    if (arg == "-v") verbose = true;
+  }
+
+  std::unique_ptr<ctpu::InferenceServerHttpClient> client;
+  FailOnError(ctpu::InferenceServerHttpClient::Create(&client, url, verbose),
+              "create client");
+
+  const size_t kInputBytes = 16 * sizeof(int32_t) * 2;   // both inputs
+  const size_t kOutputBytes = 16 * sizeof(int32_t) * 2;  // both outputs
+  const std::string pid = std::to_string(getpid());
+  const std::string in_key = "/ctpu_hexample_in_" + pid;
+  const std::string out_key = "/ctpu_hexample_out_" + pid;
+
+  // Create + map + fill the input region: INPUT0 then INPUT1 back to back.
+  int in_fd = -1;
+  void* in_addr = nullptr;
+  FailOnError(ctpu::CreateSharedMemoryRegion(in_key, kInputBytes, &in_fd),
+              "create input region");
+  FailOnError(ctpu::MapSharedMemory(in_fd, 0, kInputBytes, &in_addr),
+              "map input region");
+  int32_t* in = static_cast<int32_t*>(in_addr);
+  for (int i = 0; i < 16; ++i) {
+    in[i] = i;       // INPUT0
+    in[16 + i] = 1;  // INPUT1
+  }
+  int out_fd = -1;
+  void* out_addr = nullptr;
+  FailOnError(ctpu::CreateSharedMemoryRegion(out_key, kOutputBytes, &out_fd),
+              "create output region");
+  FailOnError(ctpu::MapSharedMemory(out_fd, 0, kOutputBytes, &out_addr),
+              "map output region");
+
+  // Register both regions with the server.
+  FailOnError(client->UnregisterSystemSharedMemory(), "unregister all");
+  FailOnError(
+      client->RegisterSystemSharedMemory("hexample_in", in_key, kInputBytes),
+      "register input region");
+  FailOnError(
+      client->RegisterSystemSharedMemory("hexample_out", out_key,
+                                         kOutputBytes),
+      "register output region");
+
+  // Inputs reference the region (offsets select INPUT0 / INPUT1).
+  ctpu::InferInput input0("INPUT0", {1, 16}, "INT32");
+  ctpu::InferInput input1("INPUT1", {1, 16}, "INT32");
+  FailOnError(input0.SetSharedMemory("hexample_in", 64, 0), "INPUT0 shm");
+  FailOnError(input1.SetSharedMemory("hexample_in", 64, 64), "INPUT1 shm");
+  ctpu::InferRequestedOutput output0("OUTPUT0");
+  ctpu::InferRequestedOutput output1("OUTPUT1");
+  FailOnError(output0.SetSharedMemory("hexample_out", 64, 0), "OUTPUT0 shm");
+  FailOnError(output1.SetSharedMemory("hexample_out", 64, 64), "OUTPUT1 shm");
+
+  ctpu::InferOptions options("simple");
+  std::unique_ptr<ctpu::InferResult> result;
+  FailOnError(client->Infer(&result, options, {&input0, &input1},
+                            {&output0, &output1}),
+              "infer");
+  FailOnError(result->RequestStatus(), "request status");
+
+  // Outputs landed in OUR mapping — read them straight from the region.
+  const int32_t* out = static_cast<const int32_t*>(out_addr);
+  for (int i = 0; i < 16; ++i) {
+    if (out[i] != in[i] + in[16 + i] || out[16 + i] != in[i] - in[16 + i]) {
+      std::cerr << "error: wrong shm output at " << i << std::endl;
+      return 1;
+    }
+  }
+
+  FailOnError(client->UnregisterSystemSharedMemory("hexample_in"),
+              "unregister input");
+  FailOnError(client->UnregisterSystemSharedMemory("hexample_out"),
+              "unregister output");
+  ctpu::UnmapSharedMemory(in_addr, kInputBytes);
+  ctpu::UnmapSharedMemory(out_addr, kOutputBytes);
+  ctpu::CloseSharedMemory(in_fd);
+  ctpu::CloseSharedMemory(out_fd);
+  ctpu::UnlinkSharedMemoryRegion(in_key);
+  ctpu::UnlinkSharedMemoryRegion(out_key);
+
+  std::cout << "PASS : simple_http_shm_client" << std::endl;
+  return 0;
+}

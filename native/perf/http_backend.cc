@@ -64,18 +64,31 @@ Error HttpBackendContext::Infer(
   PreparedHttpBody built;  // backs the non-cached path, no heap wrapper
   const PreparedHttpBody* request_body = prepared.get();
   if (request_body == nullptr) {
+    Error build_err;
     if (cache_token_ != 0) {
       InferOptions idless = options;
       idless.request_id.clear();
-      CTPU_RETURN_IF_ERROR(InferenceServerHttpClient::GenerateRequestBody(
-          &built.body, &built.header_length, idless, inputs, outputs));
-      const size_t weight = built.body.size();
-      prepared = body_cache_->Insert(cache_token_, std::move(built), weight);
-      request_body = prepared.get();
+      build_err = InferenceServerHttpClient::GenerateRequestBody(
+          &built.body, &built.header_length, idless, inputs, outputs);
+      if (build_err.IsOk()) {
+        const size_t weight = built.body.size();
+        prepared =
+            body_cache_->Insert(cache_token_, std::move(built), weight);
+        request_body = prepared.get();
+      }
     } else {
-      CTPU_RETURN_IF_ERROR(InferenceServerHttpClient::GenerateRequestBody(
-          &built.body, &built.header_length, options, inputs, outputs));
+      build_err = InferenceServerHttpClient::GenerateRequestBody(
+          &built.body, &built.header_length, options, inputs, outputs);
       request_body = &built;
+    }
+    if (!build_err.IsOk()) {
+      // Record the failure like a transport error would be: the load
+      // manager keeps every record ("errors are data") and an early
+      // return without end_ns would underflow the latency math.
+      record->success = false;
+      record->error = build_err.Message();
+      record->end_ns = RequestTimers::Now();
+      return build_err;
     }
   }
   const std::string& body = request_body->body;

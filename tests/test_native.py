@@ -453,6 +453,15 @@ def live_zoo_grpc_server():
         "simple_grpc_stream_infer_client",
         "image_client",
         "ensemble_chain_client",
+        "simple_grpc_string_infer_client",
+        "simple_http_string_infer_client",
+        "simple_http_shm_client",
+        "simple_grpc_async_infer_client",
+        "simple_grpc_health_metadata",
+        "simple_grpc_model_control",
+        "simple_grpc_infer_multi_client",
+        "simple_grpc_custom_repeat_client",
+        "reuse_infer_objects_client",
     ],
 )
 def test_cpp_example_suite(native_build, live_zoo_grpc_server, example):
