@@ -17,25 +17,37 @@ namespace {
 std::unique_ptr<InferenceServerGrpcClient> MakeClient() {
   std::unique_ptr<InferenceServerGrpcClient> client;
   // Create() is lazy: no dial until the first call, so a dead endpoint is
-  // fine for pure body-building tests.
+  // fine for pure body-building tests. Callers REQUIRE non-null before use.
   CHECK_OK(InferenceServerGrpcClient::Create(&client, "127.0.0.1:1", false));
   return client;
 }
 
-// Strip + validate the 5-byte gRPC message frame, return the proto bytes.
-std::string Unframe(const std::string& framed) {
+// Strip + validate the 5-byte gRPC message frame into *payload; false (with
+// a recorded CHECK failure) on a malformed frame so callers can REQUIRE.
+bool Unframe(const std::string& framed, std::string* payload) {
   CHECK(framed.size() >= 5u);
+  if (framed.size() < 5u) return false;
   CHECK_EQ(framed[0], 0);  // uncompressed
   uint32_t len = (uint8_t(framed[1]) << 24) | (uint8_t(framed[2]) << 16) |
                  (uint8_t(framed[3]) << 8) | uint8_t(framed[4]);
   CHECK_EQ(static_cast<size_t>(len), framed.size() - 5);
-  return framed.substr(5);
+  *payload = framed.substr(5);
+  return true;
 }
+
+// Shared preamble: parse the framed body back into *request.
+#define REQUIRE_PARSED(framed, request)           \
+  do {                                            \
+    std::string payload_;                         \
+    REQUIRE(Unframe((framed), &payload_));        \
+    REQUIRE((request).ParseFromString(payload_)); \
+  } while (0)
 
 }  // namespace
 
 TEST_CASE("prepared body: frames a parseable ModelInferRequest") {
   auto client = MakeClient();
+  REQUIRE(client != nullptr);
   std::vector<int32_t> data = {1, 2, 3, 4};
   InferInput input("IN", {1, 4}, "INT32");
   CHECK_OK(input.AppendRaw(reinterpret_cast<const uint8_t*>(data.data()),
@@ -49,28 +61,29 @@ TEST_CASE("prepared body: frames a parseable ModelInferRequest") {
   std::string framed;
   CHECK_OK(client->PrepareInferBody(options, {&input}, {&output}, &framed));
   inference::ModelInferRequest request;
-  CHECK(request.ParseFromString(Unframe(framed)));
+  REQUIRE_PARSED(framed, request);
   CHECK_EQ(request.model_name(), "m");
   CHECK_EQ(request.model_version(), "2");
   CHECK_EQ(request.id(), "req-7");
   CHECK_EQ(request.parameters().at("priority").uint64_param(), 5u);
-  CHECK_EQ(request.inputs_size(), 1);
+  REQUIRE(request.inputs_size() == 1);
   CHECK_EQ(request.inputs(0).name(), "IN");
   CHECK_EQ(request.inputs(0).datatype(), "INT32");
   CHECK_EQ(request.inputs(0).shape_size(), 2);
   CHECK_EQ(request.inputs(0).shape(1), 4);
-  CHECK_EQ(request.raw_input_contents_size(), 1);
+  REQUIRE(request.raw_input_contents_size() == 1);
   CHECK_EQ(request.raw_input_contents(0).size(), sizeof(int32_t) * 4);
   CHECK_EQ(std::memcmp(request.raw_input_contents(0).data(), data.data(),
                        sizeof(int32_t) * 4),
            0);
-  CHECK_EQ(request.outputs_size(), 1);
+  REQUIRE(request.outputs_size() == 1);
   CHECK_EQ(
       request.outputs(0).parameters().at("classification").int64_param(), 3);
 }
 
 TEST_CASE("prepared body: empty request id stays empty on the wire") {
   auto client = MakeClient();
+  REQUIRE(client != nullptr);
   std::vector<float> data = {1.5f};
   InferInput input("IN", {1}, "FP32");
   CHECK_OK(input.AppendRaw(reinterpret_cast<const uint8_t*>(data.data()),
@@ -79,7 +92,7 @@ TEST_CASE("prepared body: empty request id stays empty on the wire") {
   std::string framed;
   CHECK_OK(client->PrepareInferBody(options, {&input}, {}, &framed));
   inference::ModelInferRequest request;
-  CHECK(request.ParseFromString(Unframe(framed)));
+  REQUIRE_PARSED(framed, request);
   CHECK_EQ(request.id(), "");
   CHECK_EQ(request.parameters().size(), 0u);
 }
@@ -87,6 +100,7 @@ TEST_CASE("prepared body: empty request id stays empty on the wire") {
 TEST_CASE("prepared body: shared-memory inputs carry region refs, no raw "
           "bytes") {
   auto client = MakeClient();
+  REQUIRE(client != nullptr);
   InferInput input("IN", {16}, "FP32");
   CHECK_OK(input.SetSharedMemory("region_a", 64, 128));
   InferRequestedOutput output("OUT");
@@ -95,7 +109,7 @@ TEST_CASE("prepared body: shared-memory inputs carry region refs, no raw "
   std::string framed;
   CHECK_OK(client->PrepareInferBody(options, {&input}, {&output}, &framed));
   inference::ModelInferRequest request;
-  CHECK(request.ParseFromString(Unframe(framed)));
+  REQUIRE_PARSED(framed, request);
   const auto& in_params = request.inputs(0).parameters();
   CHECK_EQ(in_params.at("shared_memory_region").string_param(), "region_a");
   CHECK_EQ(in_params.at("shared_memory_byte_size").int64_param(), 64);
@@ -107,6 +121,7 @@ TEST_CASE("prepared body: shared-memory inputs carry region refs, no raw "
 
 TEST_CASE("prepared body: sequence options are baked into the body") {
   auto client = MakeClient();
+  REQUIRE(client != nullptr);
   std::vector<int32_t> data = {9};
   InferInput input("IN", {1}, "INT32");
   CHECK_OK(input.AppendRaw(reinterpret_cast<const uint8_t*>(data.data()),
@@ -117,7 +132,7 @@ TEST_CASE("prepared body: sequence options are baked into the body") {
   std::string framed;
   CHECK_OK(client->PrepareInferBody(options, {&input}, {}, &framed));
   inference::ModelInferRequest request;
-  CHECK(request.ParseFromString(Unframe(framed)));
+  REQUIRE_PARSED(framed, request);
   CHECK_EQ(request.parameters().at("sequence_id").int64_param(), 42);
   CHECK(request.parameters().at("sequence_start").bool_param());
   CHECK_EQ(request.parameters().count("sequence_end"), 1u);
