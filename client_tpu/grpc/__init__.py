@@ -511,6 +511,53 @@ class InferenceServerClient(InferenceServerClientBase):
         )
         return InferResult(response)
 
+    @staticmethod
+    def prepare_request(
+        model_name: str,
+        inputs: Sequence[InferInput],
+        model_version: str = "",
+        outputs: Optional[Sequence[InferRequestedOutput]] = None,
+        request_id: str = "",
+        sequence_id: Union[int, str] = 0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+        priority: int = 0,
+        timeout: Optional[int] = None,
+        parameters: Optional[Dict[str, Any]] = None,
+    ):
+        """Build a reusable ``ModelInferRequest`` for :meth:`infer_prepared`
+        (reference PreRunProcessing proto reuse, grpc_client.cc:1419-1580)."""
+        return get_inference_request(
+            model_name,
+            inputs,
+            model_version=model_version,
+            request_id=request_id,
+            outputs=outputs,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout,
+            parameters=parameters,
+        )
+
+    def infer_prepared(
+        self,
+        request,
+        client_timeout: Optional[float] = None,
+        headers: Optional[Dict[str, str]] = None,
+        compression_algorithm: Optional[str] = None,
+    ) -> InferResult:
+        """Send a request built by :meth:`prepare_request` (reusable)."""
+        response = self._call(
+            "ModelInfer",
+            request,
+            headers,
+            client_timeout,
+            compression_algorithm=compression_algorithm,
+        )
+        return InferResult(response)
+
     def async_infer(
         self,
         model_name: str,

@@ -320,6 +320,59 @@ class InferenceServerClient(InferenceServerClientBase):
 
     # -- inference -----------------------------------------------------------
 
+    @staticmethod
+    def prepare_request(
+        model_name: str,
+        inputs: Sequence[InferInput],
+        model_version: str = "",
+        outputs: Optional[Sequence[InferRequestedOutput]] = None,
+        request_id: str = "",
+        sequence_id: Union[int, str] = 0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+        priority: int = 0,
+        timeout: Optional[int] = None,
+        parameters: Optional[Dict[str, Any]] = None,
+    ):
+        """Build a reusable ``ModelInferRequest`` for :meth:`infer_prepared`.
+
+        The reference reuses the request proto across sends
+        (reference grpc_client.cc:1419-1580 PreRunProcessing); building
+        once and resending skips per-send input marshalling entirely.
+        """
+        return get_inference_request(
+            model_name,
+            inputs,
+            model_version=model_version,
+            request_id=request_id,
+            outputs=outputs,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout,
+            parameters=parameters,
+        )
+
+    async def infer_prepared(
+        self,
+        request,
+        client_timeout: Optional[float] = None,
+        headers: Optional[Dict[str, str]] = None,
+        compression_algorithm: Optional[str] = None,
+    ) -> InferResult:
+        """Send a request built by :meth:`prepare_request` (reusable)."""
+        try:
+            response = await self._client_stub.ModelInfer(
+                request,
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+                compression=_grpc_compression(compression_algorithm),
+            )
+        except grpc.RpcError as e:
+            raise rpc_error_to_exception(e) from None
+        return InferResult(response)
+
     async def infer(
         self,
         model_name: str,

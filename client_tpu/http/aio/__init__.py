@@ -444,6 +444,33 @@ class InferenceServerClient(InferenceServerClientBase):
         round trip (reference http/_client.py:1304-1330 static twin)."""
         return InferResult(response_body, header_length)
 
+    async def infer_with_body(
+        self,
+        model_name: str,
+        body: bytes,
+        json_size: Optional[int],
+        model_version: str = "",
+        headers: Optional[Dict[str, str]] = None,
+        query_params: Optional[Dict[str, Any]] = None,
+        timeout: Optional[float] = None,
+    ) -> InferResult:
+        """Send a body built by :meth:`generate_request_body` (reusable —
+        deterministic request bodies can be built once and resent; the
+        reference's static GenerateRequestBody serves the same offline
+        role, reference http_client.cc:1286-1351)."""
+        extra_headers = dict(headers) if headers else {}
+        if json_size is not None:
+            extra_headers[HEADER_CONTENT_LENGTH] = str(json_size)
+        status, rbody, rheaders = await self._post(
+            model_infer_uri(model_name, model_version),
+            body,
+            extra_headers,
+            query_params,
+            timeout=timeout,
+        )
+        raise_if_error(status, rbody)
+        return InferResult.from_response(rbody, rheaders)
+
     async def infer(
         self,
         model_name: str,

@@ -66,6 +66,17 @@ class PerfBackend:
     async def get_model_config(self, model_name: str, model_version: str = "") -> Dict:
         raise NotImplementedError
 
+    # Backends that can reuse a prepared wire request for deterministic
+    # corpus coordinates set this True and accept a ``cache_token`` kwarg
+    # on infer() (the load manager probes the flag before passing one —
+    # the C++ twin is BackendContext::HasPrepared/SetNextCacheToken).
+    supports_prepared = False
+
+    def has_prepared(self, cache_token) -> bool:
+        """True when infer(cache_token=...) will reuse a stored wire
+        request — the caller may then skip input preparation entirely."""
+        return False
+
     async def infer(
         self,
         model_name: str,
@@ -140,7 +151,37 @@ def _build_client_input(mod, t: PerfInferInput):
 # ---------------------------------------------------------------------------
 
 
-class HttpPerfBackend(PerfBackend):
+class _PreparedRequestCacheMixin:
+    """Prepared-request reuse shared by the HTTP and gRPC backends:
+    corpus token -> built wire request, size-capped like the C++ twin so
+    huge corpora fall back to per-send builds instead of doubling their
+    memory. Cache misses build with an EMPTY wire id (a baked per-send id
+    would repeat on every resend)."""
+
+    supports_prepared = True
+    _PREPARED_CAP_BYTES = 64 << 20
+
+    def _init_prepared(self):
+        self._prepared: Dict[Any, Any] = {}
+        self._prepared_bytes = 0
+
+    def has_prepared(self, cache_token) -> bool:
+        return cache_token in self._prepared
+
+    def _get_or_build_prepared(self, cache_token, build, weigh):
+        """Cached value for the token, building (and cap-accounting via
+        ``weigh(value)``) on a miss. asyncio single-thread: no await
+        between probe and store, so no duplicate-build race."""
+        value = self._prepared.get(cache_token)
+        if value is None:
+            value = build()
+            if self._prepared_bytes < self._PREPARED_CAP_BYTES:
+                self._prepared_bytes += weigh(value)
+                self._prepared[cache_token] = value
+        return value
+
+
+class HttpPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
     kind = "http"
 
     def __init__(self, url: str, concurrency: int = 128):
@@ -150,6 +191,7 @@ class HttpPerfBackend(PerfBackend):
         self._client = httpclient.InferenceServerClient(
             url, concurrency=concurrency
         )
+        self._init_prepared()
 
     async def close(self) -> None:
         await self._client.close()
@@ -192,7 +234,24 @@ class HttpPerfBackend(PerfBackend):
         sequence_id=0,
         sequence_start=False,
         sequence_end=False,
+        cache_token=None,
     ):
+        if cache_token is not None:
+            body, json_size = self._get_or_build_prepared(
+                cache_token,
+                lambda: self._client.generate_request_body(
+                    self._build_inputs(inputs),
+                    parameters=parameters,
+                    sequence_id=sequence_id,
+                    sequence_start=sequence_start,
+                    sequence_end=sequence_end,
+                ),
+                lambda prepared: len(prepared[0]),
+            )
+            await self._client.infer_with_body(
+                model_name, body, json_size, model_version=model_version
+            )
+            return
         await self._client.infer(
             model_name,
             self._build_inputs(inputs),
@@ -205,7 +264,7 @@ class HttpPerfBackend(PerfBackend):
         )
 
 
-class GrpcPerfBackend(PerfBackend):
+class GrpcPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
     kind = "grpc"
     supports_streaming = True
 
@@ -214,6 +273,7 @@ class GrpcPerfBackend(PerfBackend):
 
         self._mod = grpcclient
         self._client = grpcclient.InferenceServerClient(url)
+        self._init_prepared()
 
     async def close(self) -> None:
         await self._client.close()
@@ -263,7 +323,24 @@ class GrpcPerfBackend(PerfBackend):
         sequence_id=0,
         sequence_start=False,
         sequence_end=False,
+        cache_token=None,
     ):
+        if cache_token is not None:
+            request = self._get_or_build_prepared(
+                cache_token,
+                lambda: self._client.prepare_request(
+                    model_name,
+                    self._build_inputs(inputs),
+                    model_version=model_version,
+                    parameters=parameters,
+                    sequence_id=sequence_id,
+                    sequence_start=sequence_start,
+                    sequence_end=sequence_end,
+                ),
+                lambda request: request.ByteSize(),
+            )
+            await self._client.infer_prepared(request)
+            return
         await self._client.infer(
             model_name,
             self._build_inputs(inputs),

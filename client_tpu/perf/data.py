@@ -69,6 +69,20 @@ class DataLoader:
     def step_count(self, stream: int) -> int:
         return len(self._streams[stream])
 
+    def cache_token(self, stream: int, step: int) -> tuple:
+        """Canonical key for a backend's prepared-request cache: equal
+        tokens guarantee get_inputs()/get_parameters() yield an identical
+        request (the corpus is immutable after loading; coordinates wrap
+        the same way get_inputs wraps). C++ twin:
+        IInferDataManager::CacheToken."""
+        if not self._streams:
+            raise InferenceServerException(
+                "no input data loaded; call generate_synthetic or "
+                "read_from_json"
+            )
+        s = stream % len(self._streams)
+        return (s, step % len(self._streams[s]))
+
     def _input_descs(self):
         return self._metadata.get("inputs", [])
 
@@ -307,6 +321,12 @@ class ShmDataPlane:
 
     def step_count(self, stream: int) -> int:
         return self._loader.step_count(stream)
+
+    def cache_token(self, stream: int, step: int) -> tuple:
+        # Region references are deterministic per wrapped (stream, step);
+        # there are no per-slot regions in the Python plane, so the
+        # loader's token is already canonical.
+        return self._loader.cache_token(stream, step)
 
     @staticmethod
     def _payload(t: PerfInferInput) -> bytes:

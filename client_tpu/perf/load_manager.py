@@ -8,6 +8,7 @@ drives all in-flight requests; workers are tasks, not threads.
 
 import asyncio
 import itertools
+import os
 import time
 from typing import Dict, List, Optional, Sequence
 
@@ -43,6 +44,16 @@ class LoadManager:
         self._request_counter = itertools.count()
         self._tasks: List[asyncio.Task] = []
         self._stopping = False
+        # Prepared-request reuse (C++ twin: IssueOne's cache tokens):
+        # non-sequence unary requests are deterministic per corpus
+        # coordinate, so capable backends resend a previously built wire
+        # request. CTPU_PERF_NO_PREPARED_CACHE=1 forces per-send builds
+        # for A/B runs.
+        self._prepared_enabled = (
+            backend.supports_prepared
+            and sequence_manager is None
+            and os.environ.get("CTPU_PERF_NO_PREPARED_CACHE") != "1"
+        )
 
     # -- issuing -------------------------------------------------------------
 
@@ -61,11 +72,21 @@ class LoadManager:
             seq_kwargs = self.sequences.next_step(
                 slot if slot is not None else stream
             )
-        inputs = self.data_loader.get_inputs(stream, step)
-        parameters = self.parameters
-        step_params = self.data_loader.get_parameters(stream, step)
-        if step_params:
-            parameters = {**(parameters or {}), **step_params}
+        cache_token = None
+        if self._prepared_enabled and not self.streaming:
+            cache_token = self.data_loader.cache_token(stream, step)
+        if cache_token is not None and self.backend.has_prepared(cache_token):
+            # Prepared hit: the backend resends its stored wire request —
+            # skip input/parameter preparation entirely (C++ twin:
+            # IssueOne's cache-hit path).
+            inputs = ()
+            parameters = None
+        else:
+            inputs = self.data_loader.get_inputs(stream, step)
+            parameters = self.parameters
+            step_params = self.data_loader.get_parameters(stream, step)
+            if step_params:
+                parameters = {**(parameters or {}), **step_params}
         record = RequestRecord(start_ns=time.monotonic_ns(), request_id=request_id)
         try:
             if self.streaming and self.backend.supports_streaming:
@@ -82,6 +103,11 @@ class LoadManager:
                     **seq_kwargs,
                 )
             else:
+                extra = (
+                    {"cache_token": cache_token}
+                    if cache_token is not None
+                    else {}
+                )
                 await self.backend.infer(
                     self.model_name,
                     inputs,
@@ -89,6 +115,7 @@ class LoadManager:
                     request_id=request_id,
                     parameters=parameters,
                     **seq_kwargs,
+                    **extra,
                 )
                 record.response_ns.append(time.monotonic_ns())
         except asyncio.CancelledError:

@@ -248,3 +248,15 @@ def test_aio_client(server):
             assert received == [9, 8, 7]
 
     asyncio.run(run())
+
+
+def test_infer_prepared_reuse(client):
+    """prepare_request builds once; infer_prepared resends it (the
+    reference reuses the request proto across sends, PreRunProcessing)."""
+    in0, in1, inputs = _simple_inputs()
+    request = client.prepare_request("simple", inputs)
+    assert request.id == ""  # reusable: no baked per-send id
+    for _ in range(3):
+        result = client.infer_prepared(request)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), in0 - in1)

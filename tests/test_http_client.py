@@ -274,3 +274,24 @@ def test_aio_client(server):
 def test_client_context_manager(server):
     with httpclient.InferenceServerClient(server.http_url) as c:
         assert c.is_server_live()
+
+
+def test_aio_infer_with_body(server):
+    """A generate_request_body body is reusable across sends
+    (prepared-request reuse; reference static GenerateRequestBody role)."""
+    async def run():
+        async with aio_httpclient.InferenceServerClient(server.http_url) as c:
+            in0, in1, inputs = _simple_inputs()
+            body, json_size = c.generate_request_body(inputs)
+            for _ in range(3):
+                result = await c.infer_with_body(
+                    "simple", body, json_size
+                )
+                np.testing.assert_array_equal(
+                    result.as_numpy("OUTPUT0"), in0 + in1
+                )
+                np.testing.assert_array_equal(
+                    result.as_numpy("OUTPUT1"), in0 - in1
+                )
+
+    asyncio.run(run())

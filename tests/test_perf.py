@@ -555,3 +555,86 @@ def test_dataloader_directory_bytes(tmp_path):
     loader.read_from_dir(str(tmp_path))
     inputs = loader.get_inputs()
     assert inputs[0].data[0] == b"hello world"
+
+
+# ---------------------------------------------------------------------------
+# prepared-request reuse (C++ twin: IssueOne cache tokens)
+# ---------------------------------------------------------------------------
+
+
+class _PreparedMockBackend(MockPerfBackend):
+    """Mock with the prepared-cache contract: remembers tokens it has
+    sent and reports has_prepared for them (gRPC/HTTP backend shape)."""
+
+    supports_prepared = True
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.tokens = []
+        self.prepared = set()
+        self.empty_input_hits = 0
+
+    def has_prepared(self, cache_token):
+        return cache_token in self.prepared
+
+    async def infer(self, model_name, inputs, cache_token=None, **kwargs):
+        if cache_token is not None:
+            self.tokens.append(cache_token)
+            if cache_token in self.prepared and len(inputs) == 0:
+                self.empty_input_hits += 1
+            self.prepared.add(cache_token)
+        return await super().infer(model_name, inputs, **kwargs)
+
+
+def test_prepared_cache_skips_input_preparation():
+    """Repeat sends of a corpus coordinate reach the backend with the
+    token and EMPTY inputs once the backend holds the wire request."""
+    async def run():
+        backend = _PreparedMockBackend(latency_s=0.001)
+        manager = ConcurrencyManager(backend, "mock", make_loader())
+        await manager.change_concurrency(4)
+        await asyncio.sleep(0.25)
+        await manager.stop()
+        return backend
+
+    backend = asyncio.run(run())
+    assert backend.request_count > 20
+    # synthetic corpus = one (stream, step): a single distinct token
+    assert len(set(backend.tokens)) == 1
+    # every send after the first was a hit carrying no inputs
+    assert backend.empty_input_hits == len(backend.tokens) - 1
+
+
+def test_prepared_cache_disabled_for_sequences():
+    async def run():
+        backend = _PreparedMockBackend(latency_s=0.001)
+        manager = ConcurrencyManager(
+            backend,
+            "mock",
+            make_loader(),
+            sequence_manager=SequenceManager(length_mean=3),
+        )
+        await manager.change_concurrency(2)
+        await asyncio.sleep(0.1)
+        await manager.stop()
+        return backend
+
+    backend = asyncio.run(run())
+    assert backend.request_count > 0
+    assert backend.tokens == []
+
+
+def test_prepared_cache_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("CTPU_PERF_NO_PREPARED_CACHE", "1")
+
+    async def run():
+        backend = _PreparedMockBackend(latency_s=0.001)
+        manager = ConcurrencyManager(backend, "mock", make_loader())
+        await manager.change_concurrency(2)
+        await asyncio.sleep(0.1)
+        await manager.stop()
+        return backend
+
+    backend = asyncio.run(run())
+    assert backend.request_count > 0
+    assert backend.tokens == []
