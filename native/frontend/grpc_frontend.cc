@@ -89,6 +89,29 @@ std::string FrameMessage(const std::string& body) {
   return out;
 }
 
+// Serialize a proto directly into a framed gRPC message: one buffer, no
+// intermediate body string (SerializeAsString + FrameMessage would copy
+// the whole payload twice).
+std::string FrameSerialized(const google::protobuf::MessageLite& msg) {
+  const size_t n = msg.ByteSizeLong();
+  std::string out;
+#if defined(__cpp_lib_string_resize_and_overwrite)
+  // Skip the value-initializing memset of the payload bytes that
+  // resize() would do right before protobuf overwrites them.
+  out.resize_and_overwrite(n + 5, [](char*, size_t size) { return size; });
+#else
+  out.resize(n + 5);
+#endif
+  out[0] = '\0';
+  out[1] = static_cast<char>((n >> 24) & 0xff);
+  out[2] = static_cast<char>((n >> 16) & 0xff);
+  out[3] = static_cast<char>((n >> 8) & 0xff);
+  out[4] = static_cast<char>(n & 0xff);
+  msg.SerializeWithCachedSizesToArray(
+      reinterpret_cast<uint8_t*>(&out[5]));
+  return out;
+}
+
 std::vector<hpack::Header> ResponseHeaders() {
   return {{":status", "200"},
           {"content-type", "application/grpc"},
@@ -1189,10 +1212,12 @@ PyObject* Complete(PyObject* self, PyObject* args) {
   }
 
   // Build the response proto (unless this is a unary error, which is
-  // trailers-only).
-  std::string body;
+  // trailers-only). Building touches Python objects and needs the GIL;
+  // serialization + framing below run with it released.
+  inference::ModelInferResponse resp;
+  inference::ModelStreamInferResponse stream_wrapper;
+  bool have_body = false;
   if (!has_error || streaming) {
-    inference::ModelInferResponse resp;
     resp.set_model_name(model_name);
     resp.set_model_version(model_version);
     resp.set_id(request_id);
@@ -1266,22 +1291,24 @@ PyObject* Complete(PyObject* self, PyObject* args) {
       }
     }
     if (streaming) {
-      inference::ModelStreamInferResponse wrapper;
       if (has_error) {
-        wrapper.set_error_message(error_msg);
-        wrapper.mutable_infer_response()->set_id(request_id);
+        stream_wrapper.set_error_message(error_msg);
+        stream_wrapper.mutable_infer_response()->set_id(request_id);
       } else {
-        *wrapper.mutable_infer_response() = std::move(resp);
+        *stream_wrapper.mutable_infer_response() = std::move(resp);
       }
-      body = wrapper.SerializeAsString();
-    } else {
-      body = resp.SerializeAsString();
     }
+    have_body = true;
   }
 
-  // Wire writes are queue-and-return; do them without the GIL anyway since
-  // HPACK/framing of large bodies costs a memcpy or two.
+  // Serialize + frame + wire writes without the GIL: the payload copies
+  // and HPACK/framing are pure C++ work.
   Py_BEGIN_ALLOW_THREADS;
+  std::string body;
+  if (have_body) {
+    body = streaming ? FrameSerialized(stream_wrapper)
+                     : FrameSerialized(resp);
+  }
   if (!streaming) {
     std::lock_guard<std::mutex> lk(fe->mu);
     auto it = fe->streams.find({conn, stream_id});
@@ -1296,7 +1323,7 @@ PyObject* Complete(PyObject* self, PyObject* args) {
           it->second.headers_sent = true;
           conn->SendHeaders(stream_id, ResponseHeaders(), false);
         }
-        conn->SendData(stream_id, FrameMessage(body), false);
+        conn->SendData(stream_id, std::move(body), false);
         conn->SendTrailers(stream_id, Trailers(kGrpcOk, ""));
       }
     }
@@ -1327,7 +1354,7 @@ PyObject* Complete(PyObject* self, PyObject* args) {
       if (send_headers) {
         conn->SendHeaders(stream_id, ResponseHeaders(), false);
       }
-      conn->SendData(stream_id, FrameMessage(body), false);
+      conn->SendData(stream_id, std::move(body), false);
       if (close_stream) {
         conn->SendTrailers(stream_id, Trailers(kGrpcOk, ""));
       }
