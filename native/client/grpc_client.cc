@@ -362,7 +362,7 @@ ChannelCache& Cache() {
 
 Error InferenceServerGrpcClient::Create(
     std::unique_ptr<InferenceServerGrpcClient>* client, const std::string& url,
-    bool verbose) {
+    bool verbose, const KeepAliveOptions& keepalive) {
   std::string rest = url;
   const size_t scheme = rest.find("://");
   if (scheme != std::string::npos) rest = rest.substr(scheme + 3);
@@ -372,13 +372,18 @@ Error InferenceServerGrpcClient::Create(
   }
   const std::string host = rest.substr(0, colon);
   const int port = atoi(rest.c_str() + colon + 1);
-  client->reset(new InferenceServerGrpcClient(host, port, verbose));
+  client->reset(
+      new InferenceServerGrpcClient(host, port, verbose, keepalive));
   return Error::Success();
 }
 
 InferenceServerGrpcClient::InferenceServerGrpcClient(std::string host,
-                                                     int port, bool verbose)
-    : InferenceServerClient(verbose), host_(std::move(host)), port_(port) {}
+                                                     int port, bool verbose,
+                                                     KeepAliveOptions keepalive)
+    : InferenceServerClient(verbose),
+      host_(std::move(host)),
+      port_(port),
+      keepalive_(keepalive) {}
 
 InferenceServerGrpcClient::~InferenceServerGrpcClient() {
   StopStream();
@@ -391,6 +396,11 @@ InferenceServerGrpcClient::~InferenceServerGrpcClient() {
 std::shared_ptr<h2::Connection> InferenceServerGrpcClient::Conn() {
   std::lock_guard<std::mutex> lk(conn_mu_);
   return conn_;
+}
+
+uint64_t InferenceServerGrpcClient::KeepAliveAcks() {
+  std::lock_guard<std::mutex> lk(conn_mu_);
+  return conn_ ? conn_->KeepAliveAcks() : 0;
 }
 
 Error InferenceServerGrpcClient::EnsureConnection() {
@@ -411,6 +421,13 @@ Error InferenceServerGrpcClient::EnsureConnection() {
     shared_channel_ = false;
   }
   if (!conn_) return Error("gRPC connect failed: " + err);
+  if (keepalive_.keepalive_time_ms < 0x7fffffff) {
+    // Idempotent per connection; on shared channels the first enabler's
+    // settings win (documented on Create).
+    conn_->EnableKeepAlive(keepalive_.keepalive_time_ms,
+                           keepalive_.keepalive_timeout_ms,
+                           keepalive_.keepalive_permit_without_calls);
+  }
   return Error::Success();
 }
 

@@ -67,15 +67,32 @@ class InferResultGrpc : public InferResult {
   Error request_status_;
 };
 
+// Keepalive tuning (reference grpc_client.h:62-99 KeepAliveOptions):
+// implemented as h2 PING probes on the client's connection. The default
+// keepalive_time_ms (INT32_MAX) means "never ping" — same as gRPC's.
+struct KeepAliveOptions {
+  int64_t keepalive_time_ms = 0x7fffffff;
+  int64_t keepalive_timeout_ms = 20000;
+  bool keepalive_permit_without_calls = false;
+};
+
 class InferenceServerGrpcClient : public InferenceServerClient {
  public:
   using OnCompleteFn = std::function<void(InferResult*)>;
   using OnMultiCompleteFn = std::function<void(std::vector<InferResult*>*)>;
 
   // url is "host:port" (no scheme) or "grpc://host:port". Cleartext h2c.
+  // Keepalive (when enabled) applies to the connection this client ends
+  // up using — note shared channels (CTPU_GRPC_CHANNEL_MAX_SHARE_COUNT)
+  // adopt the FIRST enabling client's settings.
   static Error Create(std::unique_ptr<InferenceServerGrpcClient>* client,
-                      const std::string& url, bool verbose = false);
+                      const std::string& url, bool verbose = false,
+                      const KeepAliveOptions& keepalive = {});
   ~InferenceServerGrpcClient() override;
+
+  // Keepalive PING ACKs observed on the current connection (0 when
+  // keepalive is off or no connection is up).
+  uint64_t KeepAliveAcks();
 
   // --- health / metadata (reference grpc_client.h:161-203) ---
   Error IsServerLive(bool* live, const Headers& headers = {});
@@ -192,7 +209,8 @@ class InferenceServerGrpcClient : public InferenceServerClient {
   Error StopStream();
 
  private:
-  InferenceServerGrpcClient(std::string host, int port, bool verbose);
+  InferenceServerGrpcClient(std::string host, int port, bool verbose,
+                            KeepAliveOptions keepalive);
 
   Error EnsureConnection();
   // One unary gRPC call: serialize req, open stream, await trailers.
@@ -213,6 +231,7 @@ class InferenceServerGrpcClient : public InferenceServerClient {
 
   std::string host_;
   int port_ = 0;
+  KeepAliveOptions keepalive_;
 
   std::mutex conn_mu_;
   // shared_ptr: in-flight calls hold a reference so a reconnect (which

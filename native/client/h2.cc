@@ -106,6 +106,7 @@ std::unique_ptr<Connection> Connection::Connect(const std::string& host,
 
 Connection::~Connection() {
   Shutdown("connection destroyed");
+  if (keepalive_.joinable()) keepalive_.join();
   if (reader_.joinable()) reader_.join();
   if (fd_ >= 0) {
     ::close(fd_);  // Shutdown() only half-closes; release the fd here
@@ -347,7 +348,55 @@ void Connection::Shutdown(const std::string& reason) {
   if (!was_dead && fd_ >= 0) {
     ::shutdown(fd_, SHUT_RDWR);
   }
+  {
+    std::lock_guard<std::mutex> lk(ka_mu_);
+    ka_stop_ = true;
+  }
+  ka_cv_.notify_all();
   FailAllStreams(reason);
+}
+
+void Connection::EnableKeepAlive(int64_t interval_ms, int64_t timeout_ms,
+                                 bool permit_without_calls) {
+  if (interval_ms <= 0 || dead_.load()) return;
+  // Shared channels: two clients can race to enable on one connection;
+  // the check-and-spawn must be atomic (assigning to a joinable
+  // std::thread would terminate the process).
+  std::lock_guard<std::mutex> lk(ka_mu_);
+  if (keepalive_.joinable() || ka_stop_) return;
+  keepalive_ = std::thread([this, interval_ms, timeout_ms,
+                            permit_without_calls] {
+    KeepAliveLoop(interval_ms, timeout_ms, permit_without_calls);
+  });
+}
+
+void Connection::KeepAliveLoop(int64_t interval_ms, int64_t timeout_ms,
+                               bool permit_without_calls) {
+  std::unique_lock<std::mutex> lk(ka_mu_);
+  while (!ka_stop_) {
+    if (ka_cv_.wait_for(lk, std::chrono::milliseconds(interval_ms),
+                        [this] { return ka_stop_; })) {
+      return;
+    }
+    if (!permit_without_calls) {
+      std::lock_guard<std::mutex> slk(mu_);
+      if (streams_.empty()) continue;  // idle and not permitted: skip
+    }
+    const uint64_t acks_before = ka_acks_;
+    lk.unlock();
+    uint8_t payload[8] = {'c', 't', 'p', 'u', 'k', 'a', 0, 0};
+    SendFrame(kFramePing, 0, 0, payload, 8);
+    lk.lock();
+    const bool acked = ka_cv_.wait_for(
+        lk, std::chrono::milliseconds(timeout_ms),
+        [&] { return ka_stop_ || ka_acks_ != acks_before; });
+    if (ka_stop_) return;
+    if (!acked) {
+      lk.unlock();
+      Shutdown("keepalive ping timed out");
+      return;
+    }
+  }
 }
 
 void Connection::FailAllStreams(const std::string& reason) {
@@ -596,6 +645,12 @@ void Connection::HandleFrame(uint8_t type, uint8_t flags, uint32_t stream_id,
     case kFramePing: {
       if (!(flags & kFlagAck) && len == 8) {
         SendFrame(kFramePing, kFlagAck, 0, payload, 8);
+      } else if (flags & kFlagAck) {
+        {
+          std::lock_guard<std::mutex> lk(ka_mu_);
+          ka_acks_++;
+        }
+        ka_cv_.notify_all();
       }
       break;
     }

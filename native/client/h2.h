@@ -72,6 +72,20 @@ class Connection {
 
   void ResetStream(int32_t stream_id, uint32_t error_code);
 
+  // h2-level keepalive (reference KeepAliveOptions role,
+  // grpc_client.h:62-99): a timer thread sends PING every `interval_ms`;
+  // a PING that goes unacknowledged for `timeout_ms` shuts the
+  // connection down (failing all streams, which surfaces to callers as a
+  // transport error). When `permit_without_calls` is false, pings pause
+  // while no streams are open. Idempotent; call once after Connect.
+  void EnableKeepAlive(int64_t interval_ms, int64_t timeout_ms,
+                       bool permit_without_calls);
+  // PING ACKs observed (keepalive probes answered by the peer).
+  uint64_t KeepAliveAcks() {
+    std::lock_guard<std::mutex> lk(ka_mu_);
+    return ka_acks_;
+  }
+
   bool alive() const { return !dead_.load(); }
   // Closes the socket and fails all open streams.
   void Shutdown(const std::string& reason);
@@ -102,9 +116,20 @@ class Connection {
                          std::unique_lock<std::mutex>* lk);
   void FailAllStreams(const std::string& reason);
 
+  void KeepAliveLoop(int64_t interval_ms, int64_t timeout_ms,
+                     bool permit_without_calls);
+
   int fd_ = -1;
   std::thread reader_;
   std::atomic<bool> dead_{false};
+
+  // Keepalive state: the loop waits on ka_cv_ both between pings and for
+  // the ACK; the reader thread signals ACKs, Shutdown signals exit.
+  std::thread keepalive_;
+  std::mutex ka_mu_;
+  std::condition_variable ka_cv_;
+  bool ka_stop_ = false;
+  uint64_t ka_acks_ = 0;  // count of PING ACKs seen
 
   std::mutex mu_;  // guards streams_, windows, hpack decoder, settings
   std::condition_variable window_cv_;

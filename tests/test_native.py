@@ -461,6 +461,7 @@ def live_zoo_grpc_server():
         "simple_grpc_model_control",
         "simple_grpc_infer_multi_client",
         "simple_grpc_custom_repeat_client",
+        "simple_grpc_keepalive_client",
         "reuse_infer_objects_client",
     ],
 )
