@@ -136,6 +136,7 @@ class InferenceServerClient:
     unregister_tpu_shared_memory = _delegated("unregister_tpu_shared_memory")
     # inference
     infer = _delegated("infer")
+    infer_with_body = _delegated("infer_with_body")
 
     generate_request_body = staticmethod(
         _aio.InferenceServerClient.generate_request_body

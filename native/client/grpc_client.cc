@@ -1,5 +1,7 @@
 #include "grpc_client.h"
 
+#include <zlib.h>
+
 #include <algorithm>
 #include <cctype>
 #include <cstring>
@@ -65,6 +67,43 @@ std::string FrameMessage(const google::protobuf::Message& msg) {
   body[3] = static_cast<char>((len >> 8) & 0xff);
   body[4] = static_cast<char>(len & 0xff);
   return body;
+}
+
+// Re-frames a gRPC message with its payload deflated (gzip wrapper when
+// `gzip` is true, zlib stream otherwise) and the compressed flag set.
+// The server side auto-detects both wrappers (grpc-encoding gzip /
+// deflate).
+bool CompressFramed(const std::string& framed, bool gzip, std::string* out) {
+  if (framed.size() < 5) return false;
+  z_stream zs;
+  memset(&zs, 0, sizeof(zs));
+  if (deflateInit2(&zs, Z_DEFAULT_COMPRESSION, Z_DEFLATED,
+                   gzip ? 15 + 16 : 15, 8, Z_DEFAULT_STRATEGY) != Z_OK) {
+    return false;
+  }
+  const size_t n = framed.size() - 5;
+  std::string payload;
+  payload.resize(deflateBound(&zs, static_cast<uLong>(n)));
+  zs.next_in = reinterpret_cast<Bytef*>(
+      const_cast<char*>(framed.data() + 5));
+  zs.avail_in = static_cast<uInt>(n);
+  zs.next_out = reinterpret_cast<Bytef*>(&payload[0]);
+  zs.avail_out = static_cast<uInt>(payload.size());
+  const int rc = deflate(&zs, Z_FINISH);
+  const size_t out_n = payload.size() - zs.avail_out;
+  deflateEnd(&zs);
+  if (rc != Z_STREAM_END) return false;
+  payload.resize(out_n);
+  out->clear();
+  out->reserve(out_n + 5);
+  out->push_back('\x01');  // compressed flag
+  const uint32_t len = static_cast<uint32_t>(out_n);
+  out->push_back(static_cast<char>((len >> 24) & 0xff));
+  out->push_back(static_cast<char>((len >> 16) & 0xff));
+  out->push_back(static_cast<char>((len >> 8) & 0xff));
+  out->push_back(static_cast<char>(len & 0xff));
+  out->append(payload);
+  return true;
 }
 
 // Formats a grpc-timeout header value. The gRPC spec caps the value at
@@ -398,6 +437,20 @@ std::shared_ptr<h2::Connection> InferenceServerGrpcClient::Conn() {
   return conn_;
 }
 
+Error InferenceServerGrpcClient::SetCompression(
+    const std::string& algorithm) {
+  if (algorithm == "none" || algorithm.empty()) {
+    compression_.clear();
+    return Error::Success();
+  }
+  if (algorithm != "deflate" && algorithm != "gzip") {
+    return Error("unsupported compression algorithm '" + algorithm +
+                 "' (none, deflate, gzip)");
+  }
+  compression_ = algorithm;
+  return Error::Success();
+}
+
 uint64_t InferenceServerGrpcClient::KeepAliveAcks() {
   std::lock_guard<std::mutex> lk(conn_mu_);
   return conn_ ? conn_->KeepAliveAcks() : 0;
@@ -443,6 +496,9 @@ std::vector<hpack::Header> InferenceServerGrpcClient::BuildHeaders(
       {"te", "trailers"},
       {"user-agent", "ctpu-grpc/1.0"},
   };
+  if (!compression_.empty()) {
+    headers.push_back({"grpc-encoding", compression_});
+  }
   if (timeout_us > 0) {
     headers.push_back({"grpc-timeout", GrpcTimeoutValue(timeout_us)});
   }
@@ -484,17 +540,26 @@ Error InferenceServerGrpcClient::CallFramed(const std::string& method,
   };
 
   std::shared_ptr<h2::Connection> conn = Conn();
+  // Compress unless disabled or the body is already a compressed frame
+  // (prepared bodies built under an active compression setting arrive
+  // pre-compressed; flag byte 0x01).
+  std::string deflated;
+  const std::string* wire = &body;
+  if (!compression_.empty() && !body.empty() && body[0] == '\0' &&
+      CompressFramed(body, compression_ == "gzip", &deflated)) {
+    wire = &deflated;
+  }
   size_t sent = 0;
   const int32_t sid = conn->StartStreamWithData(
-      BuildHeaders(method, headers, timeout_us), body.data(), body.size(),
+      BuildHeaders(method, headers, timeout_us), wire->data(), wire->size(),
       true, ev, &sent);
   if (sid < 0) return Error("gRPC stream open failed (connection lost)");
   // One deadline covers send (flow-control stalls) AND the response wait.
   const auto deadline = std::chrono::steady_clock::now() +
                         std::chrono::microseconds(timeout_us);
   bool send_stalled = false;
-  if (sent < body.size() &&
-      !conn->SendData(sid, body.data() + sent, body.size() - sent, true,
+  if (sent < wire->size() &&
+      !conn->SendData(sid, wire->data() + sent, wire->size() - sent, true,
                       static_cast<int64_t>(timeout_us))) {
     // The stream was registered; h2 fires on_close for it (now or at
     // connection teardown) — wait below rather than double-report. A
@@ -838,6 +903,15 @@ Error InferenceServerGrpcClient::PrepareInferBody(
   inference::ModelInferRequest request;
   CTPU_RETURN_IF_ERROR(FillInferRequest(options, inputs, outputs, &request));
   *framed = FrameMessage(request);
+  if (!compression_.empty()) {
+    // Bake the compression in: prepared bodies are cached and resent, so
+    // compress once here instead of per send (CallFramed skips bodies
+    // whose compressed flag is already set).
+    std::string deflated;
+    if (CompressFramed(*framed, compression_ == "gzip", &deflated)) {
+      *framed = std::move(deflated);
+    }
+  }
   return Error::Success();
 }
 
@@ -892,7 +966,12 @@ Error InferenceServerGrpcClient::AsyncInfer(
       BuildHeaders("ModelInfer", headers, options.client_timeout_us), false,
       ev);
   if (sid < 0) return Error("gRPC stream open failed (connection lost)");
-  const std::string body = FrameMessage(request);
+  std::string body = FrameMessage(request);
+  std::string deflated;
+  if (!compression_.empty() &&
+      CompressFramed(body, compression_ == "gzip", &deflated)) {
+    body = std::move(deflated);
+  }
   // If the send fails the stream is already registered and on_close WILL
   // fire with the transport error — report success here so the callback is
   // the single delivery path (no double signaling).
@@ -1122,7 +1201,12 @@ Error InferenceServerGrpcClient::AsyncStreamInfer(
   }
   inference::ModelInferRequest request;
   CTPU_RETURN_IF_ERROR(FillInferRequest(options, inputs, outputs, &request));
-  const std::string body = FrameMessage(request);
+  std::string body = FrameMessage(request);
+  std::string deflated;
+  if (!compression_.empty() &&
+      CompressFramed(body, compression_ == "gzip", &deflated)) {
+    body = std::move(deflated);
+  }
   if (!conn->SendData(sid, body.data(), body.size(), false)) {
     return Error("stream write failed (connection lost)");
   }

@@ -94,6 +94,12 @@ class InferenceServerGrpcClient : public InferenceServerClient {
   // keepalive is off or no connection is up).
   uint64_t KeepAliveAcks();
 
+  // Per-message request compression (reference
+  // --grpc-compression-algorithm): "none" (default), "deflate" (zlib
+  // stream) or "gzip". Applies to every subsequent RPC on this client;
+  // the grpc-encoding header is added automatically.
+  Error SetCompression(const std::string& algorithm);
+
   // --- health / metadata (reference grpc_client.h:161-203) ---
   Error IsServerLive(bool* live, const Headers& headers = {});
   Error IsServerReady(bool* ready, const Headers& headers = {});
@@ -232,6 +238,7 @@ class InferenceServerGrpcClient : public InferenceServerClient {
   std::string host_;
   int port_ = 0;
   KeepAliveOptions keepalive_;
+  std::string compression_;  // "" = none; "deflate" | "gzip"
 
   std::mutex conn_mu_;
   // shared_ptr: in-flight calls hold a reference so a reconnect (which

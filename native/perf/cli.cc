@@ -102,9 +102,14 @@ std::string Usage() {
       "  --request-period N          requests per periodic step\n"
       "  --request-distribution D    constant | poisson\n"
       "  --measurement-interval MS   window length (default 5000)\n"
+      "  --measurement-mode M        time_windows | count_windows\n"
+      "  --measurement-request-count N  window size in requests\n"
+      "                              (count_windows; default 50)\n"
       "  --stability-percentage P    stability band (default 10)\n"
       "  --max-trials N              max windows per point (default 10)\n"
       "  --latency-threshold MS      stop sweep past this latency\n"
+      "  --binary-search             bisect the range for the highest\n"
+      "                              value meeting --latency-threshold\n"
       "  --percentile P              latency percentile for stability\n"
       "  --warmup-request-period S   warmup seconds before measuring\n"
       "  --input-tensor-format F     binary (default) | json HTTP bodies\n"
@@ -114,6 +119,11 @@ std::string Usage() {
       "  --input-data FILE|DIR       input-data JSON, or a directory of\n"
       "                              per-input files (raw bytes; BYTES =\n"
       "                              whole file as one element)\n"
+      "  --data-directory DIR        alias of --input-data <dir>\n"
+      "  --string-data S             fixed value for synthetic BYTES\n"
+      "  --string-length N           random synthetic BYTES of this\n"
+      "                              length (default: deterministic\n"
+      "                              synthetic_<i> values)\n"
       "  --shape NAME:D1,D2,...      shape override for dynamic dims\n"
       "  --shared-memory MODE        none | system | tpu\n"
       "  --output-shared-memory-size BYTES  redirect outputs to per-worker\n"
@@ -122,6 +132,7 @@ std::string Usage() {
       "  --sequence-length N         sequence length (default 20)\n"
       "  --sequence-length-variation P  +-pct length variation\n"
       "  --num-of-sequences N        concurrent sequences (default 4)\n"
+      "  --sequence-id-range S[:E]   sequence id window (end exclusive)\n"
       "  --sequence-model            DEPRECATED override: sequence models\n"
       "                              are auto-detected from the model\n"
       "                              config's sequence_batching\n"
@@ -142,6 +153,13 @@ std::string Usage() {
       "(default 127.0.0.1:29500)\n"
       "  --endpoint PATH             openai endpoint path "
       "(default v1/chat/completions)\n"
+      "  --grpc-compression-algorithm A  none | deflate | gzip request\n"
+      "                              message compression (-i grpc)\n"
+      "  --model-repository DIR      extra model directory (--service-kind\n"
+      "                              local; scanned into the repository)\n"
+      "  --verbose-csv               add percentile columns to the CSV\n"
+      "  --async / --sync            accepted for reference compatibility\n"
+      "  --version                   print version and exit\n"
       "  --collect-metrics           poll server Prometheus metrics\n"
       "  --metrics-url HOST:PORT/P   metrics endpoint (default <url>/metrics)\n"
       "  --metrics-interval MS       poll interval (default 1000)\n"
@@ -260,7 +278,7 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
     } else if (arg == "--log-frequency") {
       CTPU_RETURN_IF_ERROR(need(i));
       params->trace_settings["log_frequency"] = {next()};
-    } else if (arg == "--input-data") {
+    } else if (arg == "--input-data" || arg == "--data-directory") {
       CTPU_RETURN_IF_ERROR(need(i));
       params->input_data_file = next();
     } else if (arg == "--shape") {
@@ -339,6 +357,43 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
       params->metrics_interval_ms = std::stod(next());
     } else if (arg == "-v" || arg == "--verbose") {
       params->verbose = true;
+    } else if (arg == "--verbose-csv") {
+      params->verbose_csv = true;
+    } else if (arg == "--version") {
+      return Error("version");
+    } else if (arg == "--measurement-mode") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->measurement_mode = next();
+    } else if (arg == "--measurement-request-count") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->measurement_request_count =
+          static_cast<size_t>(std::stoull(next()));
+    } else if (arg == "--binary-search") {
+      params->binary_search = true;
+    } else if (arg == "--string-data") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->string_data = next();
+    } else if (arg == "--string-length") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->string_length = static_cast<size_t>(std::stoull(next()));
+    } else if (arg == "--sequence-id-range") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      const std::string value = next();
+      const size_t colon = value.find(':');
+      params->sequence_id_start = std::stoull(value.substr(0, colon));
+      params->sequence_id_end =
+          colon == std::string::npos ? 0
+                                     : std::stoull(value.substr(colon + 1));
+    } else if (arg == "--model-repository") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->model_repository = next();
+    } else if (arg == "--grpc-compression-algorithm") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->grpc_compression = next();
+    } else if (arg == "--async" || arg == "--sync") {
+      // Accepted for reference drop-in compatibility: this harness issues
+      // unary requests from dedicated slots either way (the async/sync
+      // distinction is a grpc++/CQ artifact the h2 client doesn't have).
     } else if (arg == "-h" || arg == "--help") {
       return Error("help");
     } else {
@@ -390,6 +445,46 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
   if (params->service_kind == "openai" && params->input_data_file.empty()) {
     return Error("--service-kind openai requires --input-data with "
                  "'payload' entries (request JSON bodies)");
+  }
+  if (params->measurement_mode != "time_windows" &&
+      params->measurement_mode != "count_windows") {
+    return Error("--measurement-mode must be time_windows or count_windows, "
+                 "got '" + params->measurement_mode + "'");
+  }
+  if (params->measurement_request_count == 0) {
+    return Error("--measurement-request-count must be >= 1");
+  }
+  if (params->binary_search) {
+    if (params->latency_threshold_ms <= 0) {
+      return Error("--binary-search requires --latency-threshold");
+    }
+    if (!params->has_concurrency_range && !params->has_request_rate_range) {
+      return Error("--binary-search requires --concurrency-range or "
+                   "--request-rate-range");
+    }
+  }
+  if (params->sequence_id_end != 0 &&
+      params->sequence_id_end <= params->sequence_id_start) {
+    return Error("--sequence-id-range end must be > start");
+  }
+  if (params->sequence_id_end != 0 &&
+      params->sequence_id_end - params->sequence_id_start <
+          params->num_of_sequences) {
+    return Error("--sequence-id-range is smaller than --num-of-sequences (" +
+                 std::to_string(params->num_of_sequences) +
+                 " concurrent sequences need that many ids)");
+  }
+  if (params->grpc_compression != "none" &&
+      params->grpc_compression != "deflate" &&
+      params->grpc_compression != "gzip") {
+    return Error("--grpc-compression-algorithm must be none, deflate or "
+                 "gzip, got '" + params->grpc_compression + "'");
+  }
+  if (params->grpc_compression != "none" && params->protocol != "grpc") {
+    return Error("--grpc-compression-algorithm requires -i grpc");
+  }
+  if (!params->model_repository.empty() && params->service_kind != "local") {
+    return Error("--model-repository applies to --service-kind local");
   }
   int modes = (params->has_concurrency_range ? 1 : 0) +
               (params->has_request_rate_range ? 1 : 0) +

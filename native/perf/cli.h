@@ -38,13 +38,27 @@ struct PAParams {
   std::string request_distribution = "constant";
 
   double measurement_interval_ms = 5000;
+  // time_windows (interval-bounded) | count_windows (request-count-
+  // bounded; reference kMeasurementModeCountWindows).
+  std::string measurement_mode = "time_windows";
+  size_t measurement_request_count = 50;
   double stability_percentage = 10;
   size_t max_trials = 10;
   double latency_threshold_ms = 0;
+  // Binary-search the concurrency/rate range for the highest value whose
+  // stabilized latency meets --latency-threshold (reference Profile<T>
+  // binary mode, inference_profiler.h:254-307).
+  bool binary_search = false;
   int percentile = 0;  // 0 = use average latency for stability
   double warmup_s = 0;
 
   std::string input_data_file;
+  // Synthetic BYTES generation: fixed value, or random printable strings
+  // of string_length (reference kStringData / kStringLength). 0 keeps the
+  // legacy deterministic "synthetic_<i>" values (and C++/Python harness
+  // parity); the reference default is 128.
+  std::string string_data;
+  size_t string_length = 0;
   // binary (default) | json: HTTP inference body tensor encoding
   // (reference kInputTensorFormat).
   std::string input_tensor_format = "binary";
@@ -56,6 +70,10 @@ struct PAParams {
   size_t output_shared_memory_size = 0;  // 0 = outputs returned inline
   bool streaming = false;
 
+  // Sequence id allocation window (reference kSequenceIdRange
+  // "start:end"); end 0 = unbounded.
+  uint64_t sequence_id_start = 1;
+  uint64_t sequence_id_end = 0;
   int sequence_length = 20;
   double sequence_length_variation = 20.0;
   size_t num_of_sequences = 4;
@@ -65,9 +83,16 @@ struct PAParams {
   size_t max_threads = 32;
   uint64_t random_seed = 0;
 
+  // local service kind: scan this directory into the embedded repository
+  // (reference --model-repository for the c_api backend).
+  std::string model_repository;
+  // none | deflate | gzip: per-message gRPC request compression
+  // (reference kGrpcCompressionAlgorithm).
+  std::string grpc_compression = "none";
   std::string csv_file;
   std::string profile_export_file;
   bool json_summary = false;
+  bool verbose_csv = false;
   bool collect_metrics = false;
   std::string metrics_url;  // "host:port/path"; empty = derive from url
   double metrics_interval_ms = 1000.0;

@@ -19,12 +19,14 @@ Error CreateClientBackend(const BackendFactoryConfig& config,
                                        config.json_tensor_format);
     case BackendKind::KSERVE_GRPC:
       return GrpcClientBackend::Create(config.url, config.verbose,
-                                       config.streaming, backend);
+                                       config.streaming, backend,
+                                       config.grpc_compression);
     case BackendKind::OPENAI:
       return OpenAiClientBackend::Create(config.url, config.endpoint,
                                          config.streaming, backend);
     case BackendKind::LOCAL:
       return LocalClientBackend::Create(config.verbose, config.local_zoo,
+                                        config.local_model_repository,
                                         backend);
     case BackendKind::TFS:
       return TfsClientBackend::Create(config.url, config.verbose, backend);

@@ -182,9 +182,15 @@ struct BackendFactoryConfig {
   std::string endpoint;
   // LOCAL only: also register the model-zoo adapters (resnet, llm_decode).
   bool local_zoo = false;
+  // LOCAL only: extra model directory scanned into the embedded
+  // repository (reference --model-repository for the c_api backend).
+  std::string local_model_repository;
   // KSERVE_HTTP only: send tensors as JSON data lists instead of the
   // binary extension (--input-tensor-format json).
   bool json_tensor_format = false;
+  // KSERVE_GRPC only: per-message request compression
+  // (--grpc-compression-algorithm): "" | "deflate" | "gzip".
+  std::string grpc_compression;
 };
 
 // reference ClientBackendFactory::Create (client_backend.h:292)

@@ -114,7 +114,20 @@ Error DataLoader::GenerateSynthetic(bool zero_data) {
     int64_t count = ShapeNumElements(tensor.shape);
     if (desc.datatype == "BYTES") {
       for (int64_t i = 0; i < count; ++i) {
-        std::string s = "synthetic_" + std::to_string(i);
+        std::string s;
+        if (!string_data_.empty()) {
+          s = string_data_;  // reference --string-data fixed value
+        } else if (string_length_ > 0) {
+          // Random printable bytes: a repeating pattern would deflate at
+          // pathological ratios and skew compression benchmarks.
+          std::uniform_int_distribution<int> printable(0x20, 0x7e);
+          s.reserve(string_length_);
+          for (size_t k = 0; k < string_length_; ++k) {
+            s.push_back(static_cast<char>(printable(rng_)));
+          }
+        } else {
+          s = "synthetic_" + std::to_string(i);
+        }
         uint32_t len = (uint32_t)s.size();
         tensor.bytes.append(reinterpret_cast<const char*>(&len), 4);
         tensor.bytes.append(s);

@@ -39,6 +39,14 @@ class DataLoader {
         shape_overrides_(std::move(shape_overrides)),
         rng_(seed) {}
 
+  // Synthetic BYTES generation knobs (reference --string-data /
+  // --string-length); call before GenerateSynthetic. length 0 keeps the
+  // legacy "synthetic_<i>" values.
+  void SetStringOptions(std::string string_data, size_t string_length) {
+    string_data_ = std::move(string_data);
+    string_length_ = string_length;
+  }
+
   // One stream, one step of random data per input (reference GenerateData).
   Error GenerateSynthetic(bool zero_data = false);
 
@@ -64,6 +72,8 @@ class DataLoader {
                           TensorData* out);
 
   const ModelParser* parser_;
+  std::string string_data_;
+  size_t string_length_ = 0;
   int64_t batch_size_;
   std::map<std::string, std::vector<int64_t>> shape_overrides_;
   std::mt19937_64 rng_;

@@ -25,8 +25,9 @@ json::Value TensorsToJson(
 
 Error GrpcClientBackend::Create(const std::string& url, bool verbose,
                                 bool streaming,
-                                std::shared_ptr<ClientBackend>* backend) {
-  auto* b = new GrpcClientBackend(url, streaming);
+                                std::shared_ptr<ClientBackend>* backend,
+                                const std::string& compression) {
+  auto* b = new GrpcClientBackend(url, streaming, compression);
   Error err = InferenceServerGrpcClient::Create(&b->client_, url, verbose);
   if (!err.IsOk()) {
     delete b;
@@ -126,6 +127,9 @@ Error GrpcBackendContext::EnsureClient() {
   if (client_) return Error::Success();
   CTPU_RETURN_IF_ERROR(
       InferenceServerGrpcClient::Create(&client_, url_, false));
+  if (!compression_.empty()) {
+    CTPU_RETURN_IF_ERROR(client_->SetCompression(compression_));
+  }
   if (streaming_) {
     // One response-timestamping callback serves every request this context
     // issues (requests are sequential per context).

@@ -23,10 +23,12 @@ class GrpcBackendContext : public BackendContext {
   // decoupled: a request is complete at the triton_final_response marker
   // (otherwise responses map 1:1 to requests).
   GrpcBackendContext(std::string url, bool streaming, bool decoupled,
+                     std::string compression,
                      std::shared_ptr<PreparedBodyCache> body_cache)
       : url_(std::move(url)),
         streaming_(streaming),
         decoupled_(decoupled),
+        compression_(std::move(compression)),
         body_cache_(std::move(body_cache)) {}
   ~GrpcBackendContext() override;
 
@@ -51,6 +53,7 @@ class GrpcBackendContext : public BackendContext {
   std::string url_;
   bool streaming_;
   bool decoupled_;
+  std::string compression_;  // "" = none
   std::unique_ptr<InferenceServerGrpcClient> client_;
   bool stream_started_ = false;
   std::shared_ptr<PreparedBodyCache> body_cache_;
@@ -71,7 +74,8 @@ class GrpcBackendContext : public BackendContext {
 class GrpcClientBackend : public ClientBackend {
  public:
   static Error Create(const std::string& url, bool verbose, bool streaming,
-                      std::shared_ptr<ClientBackend>* backend);
+                      std::shared_ptr<ClientBackend>* backend,
+                      const std::string& compression = "");
 
   BackendKind Kind() const override { return BackendKind::KSERVE_GRPC; }
   Error ModelMetadata(json::Value* metadata, const std::string& model_name,
@@ -82,8 +86,8 @@ class GrpcClientBackend : public ClientBackend {
       std::map<std::string, std::pair<uint64_t, uint64_t>>* stats,
       const std::string& model_name) override;
   std::unique_ptr<BackendContext> CreateContext() override {
-    return std::unique_ptr<BackendContext>(
-        new GrpcBackendContext(url_, streaming_, decoupled_, body_cache_));
+    return std::unique_ptr<BackendContext>(new GrpcBackendContext(
+        url_, streaming_, decoupled_, compression_, body_cache_));
   }
   Error RegisterSystemSharedMemory(const std::string& name,
                                    const std::string& key,
@@ -111,11 +115,15 @@ class GrpcClientBackend : public ClientBackend {
   }
 
  private:
-  GrpcClientBackend(std::string url, bool streaming)
-      : url_(std::move(url)), streaming_(streaming) {}
+  GrpcClientBackend(std::string url, bool streaming,
+                    std::string compression)
+      : url_(std::move(url)),
+        streaming_(streaming),
+        compression_(std::move(compression)) {}
 
   std::string url_;
   bool streaming_;
+  std::string compression_;
   bool decoupled_ = false;  // learned from ModelConfig
   std::unique_ptr<InferenceServerGrpcClient> client_;
   std::shared_ptr<PreparedBodyCache> body_cache_ =

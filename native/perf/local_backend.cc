@@ -112,7 +112,8 @@ Error PyErrorToError(const char* what) {
 
 }  // namespace
 
-Error PythonRuntime::Boot(bool zoo, std::string* err_detail) {
+Error PythonRuntime::Boot(bool zoo, const std::string& model_repository,
+                          std::string* err_detail) {
   std::lock_guard<std::mutex> lk(g_boot_mu);
   if (g_py.runner != nullptr) return Error::Success();
   if (g_py.handle == nullptr) {
@@ -137,9 +138,12 @@ Error PythonRuntime::Boot(bool zoo, std::string* err_detail) {
     err = Error(*err_detail);
   } else {
     void* zoo_obj = g_py.BoolFromLong(zoo ? 1 : 0);
+    void* repo_obj = g_py.UnicodeFromString(model_repository.c_str());
     void* name = g_py.UnicodeFromString("start");
-    g_py.runner = g_py.CallMethodObjArgs(module, name, zoo_obj, nullptr);
+    g_py.runner =
+        g_py.CallMethodObjArgs(module, name, zoo_obj, repo_obj, nullptr);
     g_py.DecRef(name);
+    g_py.DecRef(repo_obj);
     g_py.DecRef(zoo_obj);
     if (g_py.runner == nullptr) {
       err = PyErrorToError("embedded.start()");
@@ -221,10 +225,11 @@ Error PythonRuntime::CallJson(const char* method, const std::string& model,
 // ---------------------------------------------------------------------------
 
 Error LocalClientBackend::Create(bool verbose, bool zoo,
+                                 const std::string& model_repository,
                                  std::shared_ptr<ClientBackend>* backend) {
   (void)verbose;
   std::string detail;
-  CTPU_RETURN_IF_ERROR(PythonRuntime::Boot(zoo, &detail));
+  CTPU_RETURN_IF_ERROR(PythonRuntime::Boot(zoo, model_repository, &detail));
   backend->reset(new LocalClientBackend());
   return Error::Success();
 }

@@ -26,7 +26,8 @@ class PythonRuntime {
  public:
   // Loads libpython, initializes the interpreter, imports
   // client_tpu.server.embedded and calls start(zoo=...). Idempotent.
-  static Error Boot(bool zoo, std::string* err_detail);
+  static Error Boot(bool zoo, const std::string& model_repository,
+                    std::string* err_detail);
 
   // infer(model, request_body, header_len) -> (ok, resp_header_len, body).
   static Error Infer(const std::string& model, const std::string& body,
@@ -50,6 +51,7 @@ class LocalBackendContext : public BackendContext {
 class LocalClientBackend : public ClientBackend {
  public:
   static Error Create(bool verbose, bool zoo,
+                      const std::string& model_repository,
                       std::shared_ptr<ClientBackend>* backend);
 
   BackendKind Kind() const override { return BackendKind::LOCAL; }

@@ -44,6 +44,10 @@ int main(int argc, char** argv) {
   PAParams params;
   Error err = ParseArgs(argc, argv, &params);
   if (!err.IsOk()) {
+    if (err.Message() == "version") {
+      std::cout << "perf_analyzer (client_tpu) 1.0.0" << std::endl;
+      return 0;
+    }
     if (err.Message() == "help") {
       std::cout << Usage();
       return 0;
@@ -65,6 +69,9 @@ int main(int argc, char** argv) {
   if (params.protocol == "grpc") {
     backend_config.kind = BackendKind::KSERVE_GRPC;
     if (!params.url_set) backend_config.url = "localhost:8001";
+    if (params.grpc_compression != "none") {
+      backend_config.grpc_compression = params.grpc_compression;
+    }
   }
   if (params.service_kind == "openai") {
     backend_config.kind = BackendKind::OPENAI;
@@ -74,6 +81,7 @@ int main(int argc, char** argv) {
   if (params.service_kind == "local") {
     backend_config.kind = BackendKind::LOCAL;
     backend_config.local_zoo = params.local_zoo;
+    backend_config.local_model_repository = params.model_repository;
   }
   if (params.service_kind == "tfserving") {
     backend_config.kind = BackendKind::TFS;
@@ -108,6 +116,7 @@ int main(int argc, char** argv) {
       err = loader.ReadFromJson(params.input_data_file);
     }
   } else {
+    loader.SetStringOptions(params.string_data, params.string_length);
     err = loader.GenerateSynthetic();
   }
   if (!err.IsOk()) return fail(err, "load input data");
@@ -136,8 +145,9 @@ int main(int argc, char** argv) {
       params.force_sequences;
   if (sequence_model) {
     sequences.reset(new SequenceManager(
-        1, params.num_of_sequences, params.sequence_length,
-        params.sequence_length_variation, params.random_seed));
+        params.sequence_id_start, params.num_of_sequences,
+        params.sequence_length, params.sequence_length_variation,
+        params.random_seed, params.sequence_id_end));
   }
 
   LoadConfig load_config;
@@ -154,6 +164,10 @@ int main(int argc, char** argv) {
   profiler_config.max_trials = params.max_trials;
   profiler_config.latency_threshold_us =
       params.latency_threshold_ms * 1000.0;
+  profiler_config.count_windows =
+      params.measurement_mode == "count_windows";
+  profiler_config.measurement_request_count =
+      params.measurement_request_count;
   profiler_config.stability_percentile = params.percentile;
   profiler_config.warmup_s = params.warmup_s;
   profiler_config.verbose = params.verbose;
@@ -213,6 +227,7 @@ int main(int argc, char** argv) {
   }
 
   std::vector<ProfileExperiment> experiments;
+  int summary_pick = -1;  // binary search: index of the answer experiment
   if (params.has_periodic_range) {
     PeriodicConcurrencyManager manager(
         backend, data_manager.get(), load_config, params.periodic_start,
@@ -238,12 +253,16 @@ int main(int argc, char** argv) {
             : RequestRateManager::Distribution::CONSTANT,
         params.random_seed);
     InferenceProfiler profiler(&manager, profiler_config);
-    err = profiler.ProfileRequestRateRange(
-        &manager, params.rate_start,
-        params.rate_end > 0 ? params.rate_end : params.rate_start,
-        params.rate_step);
+    const double rate_end =
+        params.rate_end > 0 ? params.rate_end : params.rate_start;
+    err = params.binary_search
+              ? profiler.ProfileRequestRateBinary(&manager,
+                                                  params.rate_start, rate_end)
+              : profiler.ProfileRequestRateRange(&manager, params.rate_start,
+                                                 rate_end, params.rate_step);
     if (!err.IsOk()) return fail(err, "profile");
     experiments = profiler.Experiments();
+    if (params.binary_search) summary_pick = profiler.BinarySearchAnswer();
   } else if (!params.request_intervals_file.empty()) {
     std::ifstream f(params.request_intervals_file);
     if (!f) {
@@ -278,9 +297,15 @@ int main(int argc, char** argv) {
     ConcurrencyManager manager(backend, data_manager.get(), load_config,
                                sequences.get());
     InferenceProfiler profiler(&manager, profiler_config);
-    err = profiler.ProfileConcurrencyRange(
-        &manager, params.concurrency_start, params.concurrency_end,
-        params.concurrency_step);
+    err = params.binary_search
+              ? profiler.ProfileConcurrencyBinary(&manager,
+                                                  params.concurrency_start,
+                                                  params.concurrency_end)
+              : profiler.ProfileConcurrencyRange(&manager,
+                                                 params.concurrency_start,
+                                                 params.concurrency_end,
+                                                 params.concurrency_step);
+    if (params.binary_search) summary_pick = profiler.BinarySearchAnswer();
     if (!err.IsOk()) return fail(err, "profile");
     experiments = profiler.Experiments();
   }
@@ -338,7 +363,8 @@ int main(int argc, char** argv) {
 
   if (!params.csv_file.empty()) {
     err = WriteCsv(experiments, params.csv_file,
-                   tpu_metrics.any ? &tpu_metrics : nullptr);
+                   tpu_metrics.any ? &tpu_metrics : nullptr,
+                   params.verbose_csv);
     if (!err.IsOk()) return fail(err, "write csv");
   }
   if (!params.profile_export_file.empty()) {
@@ -347,7 +373,7 @@ int main(int argc, char** argv) {
     if (!err.IsOk()) return fail(err, "write profile export");
   }
   if (params.json_summary) {
-    std::printf("%s\n", JsonSummary(experiments).c_str());
+    std::printf("%s\n", JsonSummary(experiments, summary_pick).c_str());
   }
   data_manager->Cleanup();
   return 0;

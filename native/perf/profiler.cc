@@ -39,8 +39,21 @@ Error InferenceProfiler::MeasureWindow(PerfStatus* status) {
                                            manager_->Config().model_name);
   manager_->SwapRecords();  // discard partial records
   uint64_t start_ns = RequestTimers::Now();
-  std::this_thread::sleep_for(std::chrono::duration<double>(
-      config_.measurement_interval_s));
+  if (config_.count_windows) {
+    // Request-count-bounded window: poll until enough NEW requests
+    // completed; the measurement interval is the hard cap so a stalled
+    // server can't hang the run.
+    const uint64_t deadline_ns =
+        start_ns +
+        (uint64_t)(config_.measurement_interval_s * 1e9);
+    while (manager_->RecordCount() < config_.measurement_request_count &&
+           RequestTimers::Now() < deadline_ns) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(2));
+    }
+  } else {
+    std::this_thread::sleep_for(std::chrono::duration<double>(
+        config_.measurement_interval_s));
+  }
   CTPU_RETURN_IF_ERROR(manager_->CheckHealth());
   uint64_t end_ns = RequestTimers::Now();
   std::vector<RequestRecord> records = manager_->SwapRecords();
@@ -177,6 +190,109 @@ Error InferenceProfiler::ProfilePoint(PerfStatus* status, bool* stable) {
     for (auto& r : window_records_[i]) last_records_.push_back(r);
   }
   return Error::Success();
+}
+
+
+namespace {
+
+// Shared bisect driver: probe(value) must run the point and return its
+// stabilized latency via *latency_us (0 when no requests completed).
+template <typename T, typename Probe>
+Error BisectRange(T start, T end, double threshold_us, Probe probe,
+                  std::atomic<bool>* early_exit) {
+  T lo = start;
+  T hi = end;
+  while (lo <= hi) {
+    if (early_exit != nullptr && early_exit->load()) break;
+    const T mid = lo + (hi - lo) / 2;
+    double latency_us = 0;
+    CTPU_RETURN_IF_ERROR(probe(mid, &latency_us));
+    const bool meets = latency_us > 0 && latency_us <= threshold_us;
+    if (meets) {
+      if (mid >= hi) break;
+      lo = mid + 1;
+    } else {
+      if (mid <= lo) break;
+      hi = mid - 1;
+    }
+  }
+  return Error::Success();
+}
+
+}  // namespace
+
+Error InferenceProfiler::ProfileConcurrencyBinary(ConcurrencyManager* manager,
+                                                  size_t start, size_t end) {
+  binary_answer_ = -1;
+  Error err = BisectRange<size_t>(
+      start, end, config_.latency_threshold_us,
+      [&](size_t concurrency, double* latency_us) -> Error {
+        manager->ChangeConcurrency(concurrency);
+        PerfStatus status;
+        bool stable = false;
+        CTPU_RETURN_IF_ERROR(ProfilePoint(&status, &stable));
+        status.concurrency = concurrency;
+        ProfileExperiment experiment;
+        experiment.mode = "concurrency";
+        experiment.value = (double)concurrency;
+        experiment.status = status;
+        experiment.records = std::move(last_records_);
+        experiment.stable = stable;
+        experiments_.push_back(std::move(experiment));
+        *latency_us =
+            status.request_count ? StabilizingLatency(status) : 0.0;
+        if (*latency_us > 0 && *latency_us <= config_.latency_threshold_us &&
+            (binary_answer_ < 0 ||
+             (double)concurrency > experiments_[binary_answer_].value)) {
+          binary_answer_ = (int)experiments_.size() - 1;
+        }
+        if (config_.verbose) {
+          std::printf("  binary search: concurrency %zu -> %.0f us %s\n",
+                      concurrency, *latency_us,
+                      (*latency_us > 0 &&
+                       *latency_us <= config_.latency_threshold_us)
+                          ? "(meets threshold)"
+                          : "(over threshold)");
+        }
+        return Error::Success();
+      },
+      config_.early_exit);
+  manager->Stop();
+  return err;
+}
+
+Error InferenceProfiler::ProfileRequestRateBinary(RequestRateManager* manager,
+                                                  double start, double end) {
+  binary_answer_ = -1;
+  // Bisect on integral rates: sub-req/s granularity is below measurement
+  // noise for any workload where the binary mode makes sense.
+  Error err = BisectRange<int64_t>(
+      (int64_t)start, (int64_t)end, config_.latency_threshold_us,
+      [&](int64_t rate, double* latency_us) -> Error {
+        manager->ChangeRate((double)rate);
+        PerfStatus status;
+        bool stable = false;
+        CTPU_RETURN_IF_ERROR(ProfilePoint(&status, &stable));
+        status.request_rate = (double)rate;
+        ProfileExperiment experiment;
+        experiment.mode = "request_rate";
+        experiment.value = (double)rate;
+        experiment.status = status;
+        experiment.records = std::move(last_records_);
+        experiment.stable = stable;
+        experiments_.push_back(std::move(experiment));
+        *latency_us =
+            status.request_count ? StabilizingLatency(status) : 0.0;
+        if (*latency_us > 0 && *latency_us <= config_.latency_threshold_us &&
+            (binary_answer_ < 0 ||
+             (double)rate > experiments_[binary_answer_].value)) {
+          binary_answer_ = (int)experiments_.size() - 1;
+        }
+        return Error::Success();
+      },
+      config_.early_exit);
+  manager->Stop();
+  return err;
 }
 
 Error InferenceProfiler::ProfileConcurrencyRange(ConcurrencyManager* manager,

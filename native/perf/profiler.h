@@ -23,6 +23,11 @@ struct ProfileExperiment {
 
 struct ProfilerConfig {
   double measurement_interval_s = 5.0;
+  // count_windows: a window ends after measurement_request_count NEW
+  // requests instead of after the interval (reference
+  // --measurement-mode count_windows); the interval then caps the wait.
+  bool count_windows = false;
+  size_t measurement_request_count = 50;
   double stability_pct = 10.0;
   size_t max_trials = 10;
   double latency_threshold_us = 0;  // 0 = no threshold
@@ -48,12 +53,24 @@ class InferenceProfiler {
                                 size_t end, size_t step);
   Error ProfileRequestRateRange(RequestRateManager* manager, double start,
                                 double end, double step);
+  // Bisect [start, end] for the highest value whose stabilized latency
+  // meets latency_threshold_us (reference Profile<T> binary mode,
+  // inference_profiler.h:254-307). Every probed point is recorded as an
+  // experiment in bisect order; BinarySearchAnswer() indexes the answer.
+  Error ProfileConcurrencyBinary(ConcurrencyManager* manager, size_t start,
+                                 size_t end);
+  Error ProfileRequestRateBinary(RequestRateManager* manager, double start,
+                                 double end);
   Error ProfileCustomIntervals(RequestRateManager* manager,
                                const std::vector<double>& intervals_s);
 
   const std::vector<ProfileExperiment>& Experiments() const {
     return experiments_;
   }
+
+  // Index (into Experiments()) of the highest threshold-meeting probe of
+  // the last binary search; -1 when no probe met the threshold.
+  int BinarySearchAnswer() const { return binary_answer_; }
 
  private:
   Error MeasureWindow(PerfStatus* status);
@@ -64,6 +81,7 @@ class InferenceProfiler {
   LoadManager* manager_;
   ProfilerConfig config_;
   std::vector<ProfileExperiment> experiments_;
+  int binary_answer_ = -1;
   std::vector<RequestRecord> last_records_;
   std::vector<std::vector<RequestRecord>> window_records_;
 };

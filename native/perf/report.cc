@@ -93,7 +93,8 @@ std::string DetailedReport(const ProfileExperiment& experiment) {
 }
 
 Error WriteCsv(const std::vector<ProfileExperiment>& experiments,
-               const std::string& path, const TpuMetrics* tpu) {
+               const std::string& path, const TpuMetrics* tpu,
+               bool verbose) {
   std::ofstream f(path);
   if (!f) return Error("cannot open CSV report file '" + path + "'");
   std::vector<int> percentile_cols;
@@ -112,6 +113,7 @@ Error WriteCsv(const std::vector<ProfileExperiment>& experiments,
        "Server Compute Input,Server Compute Infer,Server Compute Output";
   for (int q : percentile_cols) f << ",p" << q << " latency";
   f << ",Avg latency";
+  if (verbose) f << ",Std latency,Errors,Responses/Second";
   // Typed TPU metric columns (reference report_writer.cc appends the GPU
   // utilization/power/memory columns the same way).
   // "Run" prefix: the values are aggregated over the WHOLE run (the
@@ -137,6 +139,11 @@ Error WriteCsv(const std::vector<ProfileExperiment>& experiments,
     }
     std::snprintf(buf, sizeof(buf), ",%.0f", s.avg_latency_us);
     f << buf;
+    if (verbose) {
+      std::snprintf(buf, sizeof(buf), ",%.0f,%zu,%.2f", s.std_latency_us,
+                    s.error_count, s.response_throughput);
+      f << buf;
+    }
     if (with_tpu) {
       std::snprintf(buf, sizeof(buf), ",%.4f,%.4f,%.1f,%.1f,%.4f",
                     tpu->duty_cycle.avg, tpu->duty_cycle.max,
@@ -188,12 +195,17 @@ Error ExportProfile(const std::vector<ProfileExperiment>& experiments,
   return Error::Success();
 }
 
-std::string JsonSummary(const std::vector<ProfileExperiment>& experiments) {
-  // summarize the best (max-throughput) experiment
+std::string JsonSummary(const std::vector<ProfileExperiment>& experiments,
+                        int pick) {
+  // summarize the picked experiment, else the max-throughput one
   const ProfileExperiment* best = nullptr;
-  for (const auto& e : experiments) {
-    if (best == nullptr || e.status.throughput > best->status.throughput) {
-      best = &e;
+  if (pick >= 0 && (size_t)pick < experiments.size()) {
+    best = &experiments[pick];
+  } else {
+    for (const auto& e : experiments) {
+      if (best == nullptr || e.status.throughput > best->status.throughput) {
+        best = &e;
+      }
     }
   }
   json::Object out;

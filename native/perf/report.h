@@ -17,14 +17,20 @@ std::string ConsoleReport(const std::vector<ProfileExperiment>& experiments);
 std::string DetailedReport(const ProfileExperiment& experiment);
 // `tpu` (optional): typed TPU metrics appended as CSV columns (reference
 // report_writer.cc GPU columns).
+// verbose adds std-dev/error/response-throughput columns
+// (reference --verbose-csv role).
 Error WriteCsv(const std::vector<ProfileExperiment>& experiments,
-               const std::string& path, const TpuMetrics* tpu = nullptr);
+               const std::string& path, const TpuMetrics* tpu = nullptr,
+               bool verbose = false);
 Error ExportProfile(const std::vector<ProfileExperiment>& experiments,
                     const std::string& path,
                     const std::string& service_kind = "kserve",
                     const std::string& endpoint = "");
 // One-line JSON for bench drivers: {"throughput": ..., "p50_us": ...}.
-std::string JsonSummary(const std::vector<ProfileExperiment>& experiments);
+// pick >= 0 summarizes that experiment (binary search's answer);
+// otherwise the max-throughput one.
+std::string JsonSummary(const std::vector<ProfileExperiment>& experiments,
+                        int pick = -1);
 
 }  // namespace perf
 }  // namespace ctpu

@@ -13,9 +13,17 @@ namespace perf {
 
 class SequenceManager {
  public:
+  // end_id 0 = unbounded (one shared monotonic counter; never reuses an
+  // id). Otherwise ids come from [start_id, end_id), partitioned into one
+  // stripe per slot so a fast slot can never lap a slow one onto a LIVE
+  // id (the CLI validates the window covers the concurrent-sequence
+  // count, so every stripe is non-empty).
   SequenceManager(uint64_t start_id, size_t num_slots, int sequence_length,
-                  double length_variation_pct = 0.0, uint64_t seed = 0)
+                  double length_variation_pct = 0.0, uint64_t seed = 0,
+                  uint64_t end_id = 0)
       : next_id_(start_id),
+        start_id_(start_id),
+        end_id_(end_id),
         length_(sequence_length),
         variation_pct_(length_variation_pct),
         rng_(seed),
@@ -34,7 +42,19 @@ class SequenceManager {
     Slot& slot = slots_[slot_index % slots_.size()];
     StepFlags flags;
     if (slot.remaining == 0) {
-      slot.id = next_id_++;
+      if (end_id_ == 0) {
+        slot.id = next_id_++;
+      } else {
+        const size_t index = slot_index % slots_.size();
+        const uint64_t window = end_id_ - start_id_;
+        const uint64_t stripe = window / slots_.size();
+        const uint64_t base = start_id_ + index * stripe;
+        // the last stripe absorbs the remainder
+        const uint64_t size =
+            index + 1 == slots_.size() ? window - index * stripe : stripe;
+        slot.id = base + slot.serial % size;
+        slot.serial++;
+      }
       slot.remaining = SampleLength();
       flags.start = true;
     }
@@ -62,10 +82,13 @@ class SequenceManager {
   struct Slot {
     uint64_t id = 0;
     int remaining = 0;
+    uint64_t serial = 0;  // per-slot allocation count (ranged mode)
   };
 
   std::mutex mu_;
   uint64_t next_id_;
+  uint64_t start_id_ = 1;
+  uint64_t end_id_ = 0;
   int length_;
   double variation_pct_;
   std::mt19937_64 rng_;

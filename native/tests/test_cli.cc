@@ -323,3 +323,95 @@ TEST_CASE("cli: batch size must be positive") {
   // 0 rows per request can never produce a valid KServe batch
   CHECK(!err.IsOk() || p.batch_size >= 1);
 }
+
+TEST_CASE("cli: version flag short-circuits") {
+  PAParams p;
+  Error err = Parse({"--version"}, &p);
+  CHECK(!err.IsOk());
+  CHECK_EQ(err.Message(), "version");
+}
+
+TEST_CASE("cli: measurement mode + request count") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"--measurement-mode", "count_windows",
+                        "--measurement-request-count", "123"},
+                       &p));
+  CHECK_EQ(p.measurement_mode, "count_windows");
+  CHECK_EQ(p.measurement_request_count, 123u);
+  PAParams bad;
+  CHECK(!ParseSimple({"--measurement-mode", "nope"}, &bad).IsOk());
+  CHECK(!ParseSimple({"--measurement-request-count", "0"}, &bad).IsOk());
+}
+
+TEST_CASE("cli: binary search needs a threshold and a range") {
+  PAParams p;
+  CHECK(!ParseSimple({"--binary-search"}, &p).IsOk());
+  PAParams p2;
+  CHECK(!ParseSimple({"--binary-search", "--latency-threshold", "5"}, &p2)
+             .IsOk());
+  PAParams ok;
+  CHECK_OK(ParseSimple({"--binary-search", "--latency-threshold", "5",
+                        "--concurrency-range", "1:16"},
+                       &ok));
+  CHECK(ok.binary_search);
+}
+
+TEST_CASE("cli: sequence id range parses and validates") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"--sequence-id-range", "100:200"}, &p));
+  CHECK_EQ(p.sequence_id_start, 100u);
+  CHECK_EQ(p.sequence_id_end, 200u);
+  PAParams open_ended;
+  CHECK_OK(ParseSimple({"--sequence-id-range", "50"}, &open_ended));
+  CHECK_EQ(open_ended.sequence_id_start, 50u);
+  CHECK_EQ(open_ended.sequence_id_end, 0u);
+  PAParams bad;
+  CHECK(!ParseSimple({"--sequence-id-range", "9:9"}, &bad).IsOk());
+  // window must cover the concurrent sequences
+  CHECK(!ParseSimple({"--sequence-id-range", "1:3",
+                      "--num-of-sequences", "4"},
+                     &bad)
+             .IsOk());
+}
+
+TEST_CASE("cli: string data knobs") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"--string-data", "abc", "--string-length", "7"}, &p));
+  CHECK_EQ(p.string_data, "abc");
+  CHECK_EQ(p.string_length, 7u);
+}
+
+TEST_CASE("cli: grpc compression validates algorithm and protocol") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"-i", "grpc", "--grpc-compression-algorithm",
+                        "deflate"},
+                       &p));
+  CHECK_EQ(p.grpc_compression, "deflate");
+  PAParams bad_algo;
+  CHECK(!ParseSimple({"-i", "grpc", "--grpc-compression-algorithm", "lz4"},
+                     &bad_algo)
+             .IsOk());
+  PAParams bad_proto;
+  CHECK(!ParseSimple({"--grpc-compression-algorithm", "gzip"}, &bad_proto)
+             .IsOk());
+}
+
+TEST_CASE("cli: model repository is local-kind only") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"--service-kind", "local", "--model-repository",
+                        "/tmp/x"},
+                       &p));
+  CHECK_EQ(p.model_repository, "/tmp/x");
+  PAParams bad;
+  CHECK(!ParseSimple({"--model-repository", "/tmp/x"}, &bad).IsOk());
+}
+
+TEST_CASE("cli: data-directory aliases input-data; async/sync accepted") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"--data-directory", "/tmp/d", "--async", "--sync"},
+                       &p));
+  CHECK_EQ(p.input_data_file, "/tmp/d");
+  PAParams v;
+  CHECK_OK(ParseSimple({"--verbose-csv"}, &v));
+  CHECK(v.verbose_csv);
+}

@@ -337,3 +337,78 @@ TEST_CASE("prepared cache: tokens wrap corpus coordinates and encode slot "
   CHECK(shm_out.CacheToken(0, 0, 0) != shm_out.CacheToken(1, 0, 0));
   CHECK_EQ(shm_out.CacheToken(2, 0, 0), shm_out.CacheToken(2, 0, 1));
 }
+
+TEST_CASE("profiler: count_windows ends a window at the request count") {
+  MockClientBackend::Options options;
+  options.latency_us = 1000;
+  Harness h(options);
+  ConcurrencyManager manager(h.backend, h.data.get(), h.config);
+  manager.ChangeConcurrency(4);
+  ProfilerConfig config;
+  config.measurement_interval_s = 5.0;  // cap only; count should end first
+  config.count_windows = true;
+  config.measurement_request_count = 40;
+  config.stability_pct = 95.0;
+  config.max_trials = 3;
+  InferenceProfiler profiler(&manager, config);
+  PerfStatus status;
+  bool stable = false;
+  const uint64_t t0 = RequestTimers::Now();
+  CHECK_OK(profiler.ProfilePoint(&status, &stable));
+  const double elapsed_s = (RequestTimers::Now() - t0) / 1e9;
+  manager.Stop();
+  // ~4 in-flight at 1 ms each -> 40 requests in ~10 ms/window; three
+  // count-bounded windows must finish far below the 5 s/window cap.
+  CHECK(elapsed_s < 4.0);
+  CHECK(status.request_count >= 40u);
+}
+
+TEST_CASE("profiler: binary search converges to the range edges") {
+  MockClientBackend::Options options;
+  options.latency_us = 2000;
+  Harness h(options);
+  ProfilerConfig config;
+  config.measurement_interval_s = 0.05;
+  config.stability_pct = 95.0;
+  config.max_trials = 3;
+  {
+    // Generous threshold: every probe meets it -> search walks up to end.
+    config.latency_threshold_us = 1e9;
+    ConcurrencyManager manager(h.backend, h.data.get(), h.config);
+    InferenceProfiler profiler(&manager, config);
+    CHECK_OK(profiler.ProfileConcurrencyBinary(&manager, 1, 8));
+    const auto& exps = profiler.Experiments();
+    CHECK(exps.size() >= 2u);
+    CHECK_EQ(exps.back().value, 8.0);
+    // the answer is the highest meeting probe
+    REQUIRE(profiler.BinarySearchAnswer() >= 0);
+    CHECK_EQ(exps[profiler.BinarySearchAnswer()].value, 8.0);
+  }
+  {
+    // Impossible threshold: every probe misses -> search walks down to
+    // start.
+    config.latency_threshold_us = 1.0;
+    ConcurrencyManager manager(h.backend, h.data.get(), h.config);
+    InferenceProfiler profiler(&manager, config);
+    CHECK_OK(profiler.ProfileConcurrencyBinary(&manager, 1, 8));
+    const auto& exps = profiler.Experiments();
+    CHECK(exps.size() >= 2u);
+    CHECK_EQ(exps.back().value, 1.0);
+    CHECK_EQ(profiler.BinarySearchAnswer(), -1);  // nothing met 1 us
+  }
+}
+
+TEST_CASE("sequence manager: id range wraps within the window") {
+  SequenceManager sequences(/*start_id=*/10, /*num_slots=*/2,
+                            /*sequence_length=*/2,
+                            /*length_variation_pct=*/0.0, /*seed=*/0,
+                            /*end_id=*/14);
+  std::set<uint64_t> seen;
+  for (int i = 0; i < 40; ++i) {
+    auto flags = sequences.NextStep(i % 2);
+    seen.insert(flags.sequence_id);
+    CHECK(flags.sequence_id >= 10u);
+    CHECK(flags.sequence_id < 14u);
+  }
+  CHECK_EQ(seen.size(), 4u);  // all four ids in the window get used
+}

@@ -180,6 +180,53 @@ def test_cpp_perf_analyzer_grpc(native_build, live_grpc_server):
     assert summary["throughput"] > 0
 
 
+@pytest.mark.parametrize("algorithm", ["deflate", "gzip"])
+def test_cpp_perf_analyzer_grpc_compression(native_build, live_grpc_server,
+                                            algorithm):
+    """--grpc-compression-algorithm: per-message deflate/gzip request
+    bodies, inflated by the server (reference kGrpcCompressionAlgorithm)."""
+    out = subprocess.run(
+        [os.path.join(native_build, "perf_analyzer"),
+         "-m", "simple", "-u", live_grpc_server.grpc_url, "-i", "grpc",
+         "--grpc-compression-algorithm", algorithm,
+         "--concurrency-range", "2",
+         "--measurement-interval", "500",
+         "--stability-percentage", "60",
+         "--max-trials", "3",
+         "--json-summary"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    summary = json.loads(
+        [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+    )
+    assert summary["errors"] == 0
+    assert summary["throughput"] > 0
+
+
+def test_cpp_perf_analyzer_binary_search(native_build, live_grpc_server):
+    """--binary-search bisects the concurrency range against the latency
+    threshold (reference Profile<T> binary mode)."""
+    out = subprocess.run(
+        [os.path.join(native_build, "perf_analyzer"),
+         "-m", "simple", "-u", live_grpc_server.grpc_url, "-i", "grpc",
+         "--binary-search", "--concurrency-range", "1:8",
+         "--latency-threshold", "10000",
+         "--measurement-interval", "400",
+         "--stability-percentage", "60",
+         "--max-trials", "2",
+         "--json-summary"],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    summary = json.loads(
+        [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+    )
+    # a 10-second budget is unreachable on loopback: search ends at 8
+    assert summary["value"] == 8
+    assert summary["errors"] == 0
+
+
 def test_cpp_perf_analyzer_grpc_streaming_decoupled(native_build,
                                                     live_grpc_server):
     """Decoupled bidi streaming: one request -> N timestamped responses."""
