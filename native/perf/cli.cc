@@ -157,7 +157,8 @@ std::string Usage() {
       "                              message compression (-i grpc)\n"
       "  --model-repository DIR      extra model directory (--service-kind\n"
       "                              local; scanned into the repository)\n"
-      "  --verbose-csv               add percentile columns to the CSV\n"
+      "  --verbose-csv               add std-dev/error/response-rate\n"
+      "                              columns to the CSV\n"
       "  --async / --sync            accepted for reference compatibility\n"
       "  --version                   print version and exit\n"
       "  --collect-metrics           poll server Prometheus metrics\n"

@@ -133,6 +133,9 @@ void RequestRateManager::StartPool() {
 
 void RequestRateManager::ChangeRate(double rate) {
   Stop();
+  // A non-positive rate would make the schedule interval infinite and the
+  // scheduler thread unjoinable; clamp to a token trickle instead.
+  if (rate <= 0) rate = 0.1;
   stopping_.store(false);
   StartPool();
   if (distribution_ == Distribution::POISSON) {

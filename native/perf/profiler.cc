@@ -174,6 +174,20 @@ Error InferenceProfiler::ProfilePoint(PerfStatus* status, bool* stable) {
       }
       return Error::Success();
     }
+    // A point consistently past the latency budget can never stabilize
+    // (IsStable requires every recent window under the threshold); three
+    // straight over-threshold windows settle the verdict without burning
+    // the remaining trials — the callers (sweep stop / bisect descend)
+    // only need the measured latency.
+    if (config_.latency_threshold_us > 0 && windows.size() >= 3) {
+      bool all_over = true;
+      for (size_t i = windows.size() - 3; i < windows.size(); ++i) {
+        all_over = all_over && windows[i].request_count > 0 &&
+                   StabilizingLatency(windows[i]) >
+                       config_.latency_threshold_us;
+      }
+      if (all_over) break;
+    }
   }
   if (windows.empty()) {
     *status = PerfStatus();
@@ -221,6 +235,38 @@ Error BisectRange(T start, T end, double threshold_us, Probe probe,
 
 }  // namespace
 
+Error InferenceProfiler::ProbeBinaryPoint(const char* mode, double value,
+                                          double* latency_us) {
+  PerfStatus status;
+  bool stable = false;
+  CTPU_RETURN_IF_ERROR(ProfilePoint(&status, &stable));
+  if (std::string(mode) == "concurrency") {
+    status.concurrency = (size_t)value;
+  } else {
+    status.request_rate = value;
+  }
+  ProfileExperiment experiment;
+  experiment.mode = mode;
+  experiment.value = value;
+  experiment.status = status;
+  experiment.records = std::move(last_records_);
+  experiment.stable = stable;
+  experiments_.push_back(std::move(experiment));
+  *latency_us = status.request_count ? StabilizingLatency(status) : 0.0;
+  const bool meets =
+      *latency_us > 0 && *latency_us <= config_.latency_threshold_us;
+  if (meets && (binary_answer_ < 0 ||
+                value > experiments_[binary_answer_].value)) {
+    binary_answer_ = (int)experiments_.size() - 1;
+  }
+  if (config_.verbose) {
+    std::printf("  binary search: %s %g -> %.0f us %s\n", mode, value,
+                *latency_us, meets ? "(meets threshold)"
+                                   : "(over threshold)");
+  }
+  return Error::Success();
+}
+
 Error InferenceProfiler::ProfileConcurrencyBinary(ConcurrencyManager* manager,
                                                   size_t start, size_t end) {
   binary_answer_ = -1;
@@ -228,33 +274,8 @@ Error InferenceProfiler::ProfileConcurrencyBinary(ConcurrencyManager* manager,
       start, end, config_.latency_threshold_us,
       [&](size_t concurrency, double* latency_us) -> Error {
         manager->ChangeConcurrency(concurrency);
-        PerfStatus status;
-        bool stable = false;
-        CTPU_RETURN_IF_ERROR(ProfilePoint(&status, &stable));
-        status.concurrency = concurrency;
-        ProfileExperiment experiment;
-        experiment.mode = "concurrency";
-        experiment.value = (double)concurrency;
-        experiment.status = status;
-        experiment.records = std::move(last_records_);
-        experiment.stable = stable;
-        experiments_.push_back(std::move(experiment));
-        *latency_us =
-            status.request_count ? StabilizingLatency(status) : 0.0;
-        if (*latency_us > 0 && *latency_us <= config_.latency_threshold_us &&
-            (binary_answer_ < 0 ||
-             (double)concurrency > experiments_[binary_answer_].value)) {
-          binary_answer_ = (int)experiments_.size() - 1;
-        }
-        if (config_.verbose) {
-          std::printf("  binary search: concurrency %zu -> %.0f us %s\n",
-                      concurrency, *latency_us,
-                      (*latency_us > 0 &&
-                       *latency_us <= config_.latency_threshold_us)
-                          ? "(meets threshold)"
-                          : "(over threshold)");
-        }
-        return Error::Success();
+        return ProbeBinaryPoint("concurrency", (double)concurrency,
+                                latency_us);
       },
       config_.early_exit);
   manager->Stop();
@@ -264,31 +285,15 @@ Error InferenceProfiler::ProfileConcurrencyBinary(ConcurrencyManager* manager,
 Error InferenceProfiler::ProfileRequestRateBinary(RequestRateManager* manager,
                                                   double start, double end) {
   binary_answer_ = -1;
-  // Bisect on integral rates: sub-req/s granularity is below measurement
-  // noise for any workload where the binary mode makes sense.
+  // Bisect on integral rates >= 1: sub-req/s granularity is below
+  // measurement noise for any workload where the binary mode makes sense,
+  // and rate 0 has no schedule.
   Error err = BisectRange<int64_t>(
-      (int64_t)start, (int64_t)end, config_.latency_threshold_us,
+      std::max<int64_t>(1, (int64_t)start),
+      std::max<int64_t>(1, (int64_t)end), config_.latency_threshold_us,
       [&](int64_t rate, double* latency_us) -> Error {
         manager->ChangeRate((double)rate);
-        PerfStatus status;
-        bool stable = false;
-        CTPU_RETURN_IF_ERROR(ProfilePoint(&status, &stable));
-        status.request_rate = (double)rate;
-        ProfileExperiment experiment;
-        experiment.mode = "request_rate";
-        experiment.value = (double)rate;
-        experiment.status = status;
-        experiment.records = std::move(last_records_);
-        experiment.stable = stable;
-        experiments_.push_back(std::move(experiment));
-        *latency_us =
-            status.request_count ? StabilizingLatency(status) : 0.0;
-        if (*latency_us > 0 && *latency_us <= config_.latency_threshold_us &&
-            (binary_answer_ < 0 ||
-             (double)rate > experiments_[binary_answer_].value)) {
-          binary_answer_ = (int)experiments_.size() - 1;
-        }
-        return Error::Success();
+        return ProbeBinaryPoint("request_rate", (double)rate, latency_us);
       },
       config_.early_exit);
   manager->Stop();

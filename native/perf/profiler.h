@@ -74,6 +74,9 @@ class InferenceProfiler {
 
  private:
   Error MeasureWindow(PerfStatus* status);
+  // One binary-search probe at the already-applied load value: measure,
+  // record the experiment, track the best threshold-meeting answer.
+  Error ProbeBinaryPoint(const char* mode, double value, double* latency_us);
   bool IsStable(const std::vector<PerfStatus>& windows) const;
   double StabilizingLatency(const PerfStatus& status) const;
   PerfStatus Merge(const std::vector<PerfStatus>& windows) const;
