@@ -611,7 +611,8 @@ Error InferenceServerHttpClient::TpuSharedMemoryStatus(json::Value* status) {
 Error InferenceServerHttpClient::GenerateRequestBody(
     std::string* body, size_t* header_length, const InferOptions& options,
     const std::vector<InferInput*>& inputs,
-    const std::vector<const InferRequestedOutput*>& outputs) {
+    const std::vector<const InferRequestedOutput*>& outputs,
+    bool binary_output) {
   json::Object req;
   if (!options.request_id.empty()) {
     req["id"] = json::Value(options.request_id);
@@ -688,16 +689,17 @@ Error InferenceServerHttpClient::GenerateRequestBody(
         if (out->ClassCount() > 0) {
           jparams["classification"] = json::Value((int64_t)out->ClassCount());
         }
-        jparams["binary_data"] = json::Value(out->BinaryData());
+        jparams["binary_data"] =
+            json::Value(binary_output && out->BinaryData());
       }
       if (!jparams.empty()) jout["parameters"] = json::Value(std::move(jparams));
       jouts.push_back(json::Value(std::move(jout)));
     }
     req["outputs"] = json::Value(std::move(jouts));
   } else {
-    // No explicit outputs: ask for everything as binary
-    // (reference http/_utils.py:131-139 semantics).
-    params["binary_data_output"] = json::Value(true);
+    // No explicit outputs: ask for everything in the chosen format
+    // (reference http/_utils.py:131-139 semantics; binary by default).
+    params["binary_data_output"] = json::Value(binary_output);
   }
   if (!params.empty()) req["parameters"] = json::Value(std::move(params));
 

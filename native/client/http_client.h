@@ -170,10 +170,13 @@ class InferenceServerHttpClient : public InferenceServerClient {
 
   // Offline request construction / response parse
   // (reference http_client.cc:1286-1351).
+  // binary_output=false asks the server for JSON "data" arrays instead
+  // of the binary extension (reference TensorFormat::JSON response side).
   static Error GenerateRequestBody(
       std::string* body, size_t* header_length, const InferOptions& options,
       const std::vector<InferInput*>& inputs,
-      const std::vector<const InferRequestedOutput*>& outputs);
+      const std::vector<const InferRequestedOutput*>& outputs,
+      bool binary_output = true);
   static Error ParseResponseBody(std::unique_ptr<InferResult>* result,
                                  std::string&& body, size_t header_length);
 

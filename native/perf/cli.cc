@@ -113,6 +113,8 @@ std::string Usage() {
       "  --percentile P              latency percentile for stability\n"
       "  --warmup-request-period S   warmup seconds before measuring\n"
       "  --input-tensor-format F     binary (default) | json HTTP bodies\n"
+      "  --output-tensor-format F    binary (default) | json HTTP\n"
+      "                              response tensors\n"
       "  --trace-level L             forward trace level(s) to the server\n"
       "  --trace-rate N / --trace-count N / --log-frequency N\n"
       "                              forwarded trace knobs (trace API)\n"
@@ -362,6 +364,9 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
       params->verbose_csv = true;
     } else if (arg == "--version") {
       return Error("version");
+    } else if (arg == "--output-tensor-format") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->output_tensor_format = next();
     } else if (arg == "--measurement-mode") {
       CTPU_RETURN_IF_ERROR(need(i));
       params->measurement_mode = next();
@@ -424,6 +429,15 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
   if (params->input_tensor_format == "json" &&
       !(params->service_kind == "kserve" && params->protocol == "http")) {
     return Error("--input-tensor-format json applies to kserve HTTP only");
+  }
+  if (params->output_tensor_format != "binary" &&
+      params->output_tensor_format != "json") {
+    return Error("--output-tensor-format must be binary or json, got '" +
+                 params->output_tensor_format + "'");
+  }
+  if (params->output_tensor_format == "json" &&
+      !(params->service_kind == "kserve" && params->protocol == "http")) {
+    return Error("--output-tensor-format json applies to kserve HTTP only");
   }
   if (params->service_kind == "tfserving" ||
       params->service_kind == "torchserve") {

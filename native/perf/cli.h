@@ -62,6 +62,9 @@ struct PAParams {
   // binary (default) | json: HTTP inference body tensor encoding
   // (reference kInputTensorFormat).
   std::string input_tensor_format = "binary";
+  // binary (default) | json: HTTP response tensor encoding
+  // (reference kOutputTensorFormat).
+  std::string output_tensor_format = "binary";
   // Forwarded to the server's trace API before the run (reference
   // client_backend.h:296): --trace-level/-rate/-count/--log-frequency.
   std::map<std::string, std::vector<std::string>> trace_settings;

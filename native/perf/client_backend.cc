@@ -16,7 +16,8 @@ Error CreateClientBackend(const BackendFactoryConfig& config,
   switch (config.kind) {
     case BackendKind::KSERVE_HTTP:
       return HttpClientBackend::Create(config.url, config.verbose, backend,
-                                       config.json_tensor_format);
+                                       config.json_tensor_format,
+                                       config.json_output_format);
     case BackendKind::KSERVE_GRPC:
       return GrpcClientBackend::Create(config.url, config.verbose,
                                        config.streaming, backend,

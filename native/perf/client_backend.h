@@ -188,6 +188,9 @@ struct BackendFactoryConfig {
   // KSERVE_HTTP only: send tensors as JSON data lists instead of the
   // binary extension (--input-tensor-format json).
   bool json_tensor_format = false;
+  // KSERVE_HTTP only: ask for JSON response data instead of the binary
+  // extension (--output-tensor-format json).
+  bool json_output_format = false;
   // KSERVE_GRPC only: per-message request compression
   // (--grpc-compression-algorithm): "" | "deflate" | "gzip".
   std::string grpc_compression;

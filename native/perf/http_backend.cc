@@ -10,14 +10,14 @@ namespace perf {
 
 Error HttpClientBackend::Create(const std::string& url, bool verbose,
                                 std::shared_ptr<ClientBackend>* backend,
-                                bool json_body) {
+                                bool json_body, bool json_output) {
   size_t colon = url.rfind(':');
   if (colon == std::string::npos) {
     return Error("url must be host:port, got '" + url + "'");
   }
   auto* b = new HttpClientBackend(url.substr(0, colon),
                                   std::atoi(url.c_str() + colon + 1),
-                                  json_body);
+                                  json_body, json_output);
   Error err = InferenceServerHttpClient::Create(&b->client_, url, verbose,
                                                 /*async_workers=*/0);
   if (!err.IsOk()) {
@@ -69,7 +69,8 @@ Error HttpBackendContext::Infer(
       InferOptions idless = options;
       idless.request_id.clear();
       build_err = InferenceServerHttpClient::GenerateRequestBody(
-          &built.body, &built.header_length, idless, inputs, outputs);
+          &built.body, &built.header_length, idless, inputs, outputs,
+          !json_output_);
       if (build_err.IsOk()) {
         const size_t weight = built.body.size();
         prepared =
@@ -78,7 +79,8 @@ Error HttpBackendContext::Infer(
       }
     } else {
       build_err = InferenceServerHttpClient::GenerateRequestBody(
-          &built.body, &built.header_length, options, inputs, outputs);
+          &built.body, &built.header_length, options, inputs, outputs,
+          !json_output_);
       request_body = &built;
     }
     if (!build_err.IsOk()) {
@@ -230,7 +232,10 @@ Error HttpBackendContext::InferJson(
               json::Value((int64_t)out->SharedMemoryOffset());
         }
       } else {
-        params["binary_data"] = json::Value(false);
+        // Honor --output-tensor-format independently of the request body
+        // format (json request bodies default to json responses, but an
+        // explicit binary output selection must win).
+        params["binary_data"] = json::Value(!json_output_);
       }
       if (out->ClassCount() > 0) {
         params["classification"] = json::Value((int64_t)out->ClassCount());

@@ -20,9 +20,11 @@ using PreparedHttpCache = PreparedCache<PreparedHttpBody>;
 class HttpBackendContext : public BackendContext {
  public:
   HttpBackendContext(const std::string& host, int port, bool json_body,
+                     bool json_output,
                      std::shared_ptr<PreparedHttpCache> body_cache)
       : conn_(host, port),
         json_body_(json_body),
+        json_output_(json_output),
         body_cache_(std::move(body_cache)) {}
 
   Error Infer(const InferOptions& options,
@@ -43,6 +45,7 @@ class HttpBackendContext : public BackendContext {
 
   HttpConnection conn_;
   bool json_body_ = false;
+  bool json_output_ = false;  // --output-tensor-format json
   std::shared_ptr<PreparedHttpCache> body_cache_;
 };
 
@@ -53,7 +56,7 @@ class HttpClientBackend : public ClientBackend {
   // kInputTensorFormat).
   static Error Create(const std::string& url, bool verbose,
                       std::shared_ptr<ClientBackend>* backend,
-                      bool json_body = false);
+                      bool json_body = false, bool json_output = false);
 
   BackendKind Kind() const override { return BackendKind::KSERVE_HTTP; }
   Error ModelMetadata(json::Value* metadata, const std::string& model_name,
@@ -68,8 +71,8 @@ class HttpClientBackend : public ClientBackend {
       std::map<std::string, std::pair<uint64_t, uint64_t>>* stats,
       const std::string& model_name) override;
   std::unique_ptr<BackendContext> CreateContext() override {
-    return std::unique_ptr<BackendContext>(
-        new HttpBackendContext(host_, port_, json_body_, body_cache_));
+    return std::unique_ptr<BackendContext>(new HttpBackendContext(
+        host_, port_, json_body_, json_output_, body_cache_));
   }
   Error RegisterSystemSharedMemory(const std::string& name,
                                    const std::string& key,
@@ -94,12 +97,17 @@ class HttpClientBackend : public ClientBackend {
       override;
 
  private:
-  HttpClientBackend(std::string host, int port, bool json_body)
-      : host_(std::move(host)), port_(port), json_body_(json_body) {}
+  HttpClientBackend(std::string host, int port, bool json_body,
+                    bool json_output)
+      : host_(std::move(host)),
+        port_(port),
+        json_body_(json_body),
+        json_output_(json_output) {}
 
   std::string host_;
   int port_;
   bool json_body_ = false;
+  bool json_output_ = false;
   std::unique_ptr<InferenceServerHttpClient> client_;
   std::shared_ptr<PreparedHttpCache> body_cache_ =
       std::make_shared<PreparedHttpCache>();

@@ -92,6 +92,7 @@ int main(int argc, char** argv) {
     if (!params.url_set) backend_config.url = "localhost:8080";
   }
   backend_config.json_tensor_format = params.input_tensor_format == "json";
+  backend_config.json_output_format = params.output_tensor_format == "json";
   std::shared_ptr<ClientBackend> backend;
   err = CreateClientBackend(backend_config, &backend);
   if (!err.IsOk()) return fail(err, "create backend");

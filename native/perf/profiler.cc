@@ -174,11 +174,12 @@ Error InferenceProfiler::ProfilePoint(PerfStatus* status, bool* stable) {
       }
       return Error::Success();
     }
-    // A point consistently past the latency budget can never stabilize
-    // (IsStable requires every recent window under the threshold); three
-    // straight over-threshold windows settle the verdict without burning
-    // the remaining trials — the callers (sweep stop / bisect descend)
-    // only need the measured latency.
+    // A point consistently past the latency budget cannot satisfy
+    // IsStable (it requires every recent window under the threshold);
+    // three straight over-threshold windows settle the verdict without
+    // burning the remaining trials — UNLESS latency is still improving
+    // (cold-start/JIT warmup transients recover and would stabilize in a
+    // later window), in which case keep measuring.
     if (config_.latency_threshold_us > 0 && windows.size() >= 3) {
       bool all_over = true;
       for (size_t i = windows.size() - 3; i < windows.size(); ++i) {
@@ -186,7 +187,11 @@ Error InferenceProfiler::ProfilePoint(PerfStatus* status, bool* stable) {
                    StabilizingLatency(windows[i]) >
                        config_.latency_threshold_us;
       }
-      if (all_over) break;
+      const bool improving =
+          all_over &&
+          StabilizingLatency(windows.back()) <
+              0.98 * StabilizingLatency(windows[windows.size() - 3]);
+      if (all_over && !improving) break;
     }
   }
   if (windows.empty()) {

@@ -415,3 +415,15 @@ TEST_CASE("cli: data-directory aliases input-data; async/sync accepted") {
   CHECK_OK(ParseSimple({"--verbose-csv"}, &v));
   CHECK(v.verbose_csv);
 }
+
+TEST_CASE("cli: output tensor format validates value and transport") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"--output-tensor-format", "json"}, &p));
+  CHECK_EQ(p.output_tensor_format, "json");
+  PAParams bad_value;
+  CHECK(!ParseSimple({"--output-tensor-format", "xml"}, &bad_value).IsOk());
+  PAParams bad_proto;
+  CHECK(!ParseSimple({"-i", "grpc", "--output-tensor-format", "json"},
+                     &bad_proto)
+             .IsOk());
+}
