@@ -157,6 +157,8 @@ std::string Usage() {
       "(default v1/chat/completions)\n"
       "  --grpc-compression-algorithm A  none | deflate | gzip request\n"
       "                              message compression (-i grpc)\n"
+      "  --model-signature-name S    TFS signature block (default\n"
+      "                              serving_default)\n"
       "  --model-repository DIR      extra model directory (--service-kind\n"
       "                              local; scanned into the repository)\n"
       "  --verbose-csv               add std-dev/error/response-rate\n"
@@ -390,6 +392,9 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
       params->sequence_id_end =
           colon == std::string::npos ? 0
                                      : std::stoull(value.substr(colon + 1));
+    } else if (arg == "--model-signature-name") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->model_signature_name = next();
     } else if (arg == "--model-repository") {
       CTPU_RETURN_IF_ERROR(need(i));
       params->model_repository = next();
@@ -500,6 +505,11 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
   }
   if (!params->model_repository.empty() && params->service_kind != "local") {
     return Error("--model-repository applies to --service-kind local");
+  }
+  if (params->model_signature_name != "serving_default" &&
+      params->service_kind != "tfserving") {
+    return Error("--model-signature-name applies to --service-kind "
+                 "tfserving");
   }
   int modes = (params->has_concurrency_range ? 1 : 0) +
               (params->has_request_rate_range ? 1 : 0) +

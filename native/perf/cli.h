@@ -89,6 +89,9 @@ struct PAParams {
   // local service kind: scan this directory into the embedded repository
   // (reference --model-repository for the c_api backend).
   std::string model_repository;
+  // tfserving: signature block to read the tensor contract from
+  // (reference --model-signature-name).
+  std::string model_signature_name = "serving_default";
   // none | deflate | gzip: per-message gRPC request compression
   // (reference kGrpcCompressionAlgorithm).
   std::string grpc_compression = "none";

@@ -30,7 +30,8 @@ Error CreateClientBackend(const BackendFactoryConfig& config,
                                         config.local_model_repository,
                                         backend);
     case BackendKind::TFS:
-      return TfsClientBackend::Create(config.url, config.verbose, backend);
+      return TfsClientBackend::Create(config.url, config.verbose, backend,
+                                      config.tfs_signature_name);
     case BackendKind::TORCHSERVE:
       return TorchServeClientBackend::Create(config.url, config.verbose,
                                              backend);

@@ -194,6 +194,9 @@ struct BackendFactoryConfig {
   // KSERVE_GRPC only: per-message request compression
   // (--grpc-compression-algorithm): "" | "deflate" | "gzip".
   std::string grpc_compression;
+  // TFS only: signature block naming the tensor contract
+  // (--model-signature-name).
+  std::string tfs_signature_name = "serving_default";
 };
 
 // reference ClientBackendFactory::Create (client_backend.h:292)

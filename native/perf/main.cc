@@ -85,6 +85,7 @@ int main(int argc, char** argv) {
   }
   if (params.service_kind == "tfserving") {
     backend_config.kind = BackendKind::TFS;
+    backend_config.tfs_signature_name = params.model_signature_name;
     if (!params.url_set) backend_config.url = "localhost:8501";
   }
   if (params.service_kind == "torchserve") {

@@ -8,14 +8,15 @@ namespace ctpu {
 namespace perf {
 
 Error TfsClientBackend::Create(const std::string& url, bool verbose,
-                               std::shared_ptr<ClientBackend>* backend) {
+                               std::shared_ptr<ClientBackend>* backend,
+                               const std::string& signature_name) {
   const size_t colon = url.rfind(':');
   if (colon == std::string::npos) {
     return Error("url must be host:port, got '" + url + "'");
   }
   backend->reset(new TfsClientBackend(url.substr(0, colon),
                                       std::atoi(url.c_str() + colon + 1),
-                                      verbose));
+                                      verbose, signature_name));
   return Error::Success();
 }
 
@@ -40,9 +41,9 @@ Error TfsClientBackend::ModelMetadata(json::Value* metadata,
     return Error(std::string("malformed TFS metadata: ") + e.what());
   }
   const json::Value& sig =
-      doc["metadata"]["signature_def"]["signature_def"]["serving_default"];
+      doc["metadata"]["signature_def"]["signature_def"][signature_name_];
   if (!sig.IsObject()) {
-    return Error("TFS metadata has no serving_default signature");
+    return Error("TFS metadata has no '" + signature_name_ + "' signature");
   }
   // Normalize into the KServe metadata shape the harness uses everywhere.
   std::string bad_dtype_msg;

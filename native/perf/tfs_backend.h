@@ -28,8 +28,11 @@ class TfsBackendContext : public BackendContext {
 
 class TfsClientBackend : public ClientBackend {
  public:
+  // signature_name: which signature block drives the tensor contract
+  // (reference --model-signature-name; default serving_default).
   static Error Create(const std::string& url, bool verbose,
-                      std::shared_ptr<ClientBackend>* backend);
+                      std::shared_ptr<ClientBackend>* backend,
+                      const std::string& signature_name = "serving_default");
 
   BackendKind Kind() const override { return BackendKind::TFS; }
   Error ModelMetadata(json::Value* metadata, const std::string& model_name,
@@ -42,12 +45,17 @@ class TfsClientBackend : public ClientBackend {
   }
 
  private:
-  TfsClientBackend(std::string host, int port, bool verbose)
-      : host_(std::move(host)), port_(port), verbose_(verbose) {}
+  TfsClientBackend(std::string host, int port, bool verbose,
+                   std::string signature_name)
+      : host_(std::move(host)),
+        port_(port),
+        verbose_(verbose),
+        signature_name_(std::move(signature_name)) {}
 
   std::string host_;
   int port_ = 0;
   bool verbose_ = false;
+  std::string signature_name_ = "serving_default";
 };
 
 }  // namespace perf

@@ -427,3 +427,13 @@ TEST_CASE("cli: output tensor format validates value and transport") {
                      &bad_proto)
              .IsOk());
 }
+
+TEST_CASE("cli: model signature name is tfserving-only") {
+  PAParams p;
+  CHECK_OK(ParseSimple({"--service-kind", "tfserving",
+                        "--model-signature-name", "predict"},
+                       &p));
+  CHECK_EQ(p.model_signature_name, "predict");
+  PAParams bad;
+  CHECK(!ParseSimple({"--model-signature-name", "predict"}, &bad).IsOk());
+}
