@@ -196,30 +196,35 @@ def main() -> int:
 
     result = None
     client_cpu = 0.0
-    server_cpu0 = _cpu_seconds(os.getpid())
+    server_cpu = 0.0
     with InProcessServer(host="127.0.0.1") as server:
         have_pa = os.path.exists(PA)
         if have_pa:
-            server_cpu0 = _cpu_seconds(os.getpid())
             # Best of two passes: the bench host is a shared single-core
             # box and a single pass regularly loses 10-20% to unrelated
             # load; the conventional best-of-N keeps the recorded artifact
-            # from penalizing the build for host noise. CPU attribution
-            # uses both passes (it is per-request, noise-insensitive).
+            # from penalizing the build for host noise. Per-pass CPU
+            # deltas accumulate only for passes that produced a parseable
+            # summary (with a request count), so the per-request
+            # attribution basis always matches the requests it covers.
             summary = None
-            client_cpu = 0.0
             requests_seen = 0
             for _ in range(2):
+                pass_server_cpu0 = _cpu_seconds(os.getpid())
                 s, cpu = _perf_analyzer_row(server.grpc_url)
-                if s is None:
+                pass_server_cpu = _cpu_seconds(os.getpid()) - pass_server_cpu0
+                if s is None or not s.get("count"):
                     continue
                 client_cpu += cpu
-                requests_seen += s.get("count", 0)
+                server_cpu += pass_server_cpu
+                requests_seen += s["count"]
                 if summary is None or s["throughput"] > summary["throughput"]:
                     summary = s
             if summary is not None and requests_seen:
-                # scale the per-request cpu basis to the reported pass
-                client_cpu *= summary.get("count", 0) / requests_seen
+                # scale both attribution bases to the reported pass
+                scale = summary["count"] / requests_seen
+                client_cpu *= scale
+                server_cpu *= scale
             if summary is not None:
                 result = {
                     "throughput": summary["throughput"],
@@ -228,10 +233,6 @@ def main() -> int:
                     "count": summary.get("count", 0),
                     "harness": f"perf_analyzer(c++)/grpc-{server.grpc_impl}",
                 }
-        server_cpu = _cpu_seconds(os.getpid()) - server_cpu0
-        if result is not None and requests_seen:
-            # the delta spans both passes; rescale to the reported pass
-            server_cpu *= result["count"] / requests_seen
         if result is None:
             result = _bench_python_grpc(server.grpc_url)
             result["harness"] = "python-grpc-aio"
