@@ -98,6 +98,25 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--stability-percentage", "-s", type=float, default=10.0
     )
+    parser.add_argument(
+        "--measurement-mode",
+        choices=("time_windows", "count_windows"),
+        default="time_windows",
+        help="window boundary: elapsed interval, or request count with "
+        "the interval as a hard cap",
+    )
+    parser.add_argument(
+        "--measurement-request-count",
+        type=int,
+        default=50,
+        help="window size in requests (count_windows)",
+    )
+    parser.add_argument(
+        "--binary-search",
+        action="store_true",
+        help="bisect --concurrency-range for the highest value meeting "
+        "--latency-threshold",
+    )
     parser.add_argument("--max-trials", "-r", type=int, default=10)
     parser.add_argument(
         "--latency-threshold",
@@ -378,12 +397,15 @@ async def run(args) -> int:
                 stability_pct=args.stability_percentage,
                 max_trials=args.max_trials,
                 latency_threshold_us=latency_threshold_us,
+                count_windows=args.measurement_mode == "count_windows",
+                measurement_request_count=args.measurement_request_count,
                 percentiles=percentiles,
                 stability_percentile=args.percentile,
                 warmup_requests=args.warmup_request_count,
                 verbose=args.verbose,
             )
 
+        profiler = None
         if args.periodic_concurrency_range:
             start, end, step = _parse_range(args.periodic_concurrency_range)
             manager = PeriodicConcurrencyManager(
@@ -433,16 +455,26 @@ async def run(args) -> int:
                 **common,
             )
             profiler = make_profiler(manager)
-            experiments = await profiler.profile_request_rate_range(
-                start, end, step
-            )
+            if args.binary_search:
+                experiments = await profiler.profile_request_rate_binary(
+                    int(start), int(end)
+                )
+            else:
+                experiments = await profiler.profile_request_rate_range(
+                    start, end, step
+                )
         else:
             start, end, step = _parse_range(args.concurrency_range or "1")
             manager = ConcurrencyManager(backend, **common)
             profiler = make_profiler(manager)
-            experiments = await profiler.profile_concurrency_range(
-                start, end, step
-            )
+            if args.binary_search:
+                experiments = await profiler.profile_concurrency_binary(
+                    start, end
+                )
+            else:
+                experiments = await profiler.profile_concurrency_range(
+                    start, end, step
+                )
 
         if world.is_distributed:
             # No rank tears its load down while another is still measuring.
@@ -466,6 +498,12 @@ async def run(args) -> int:
             )
         if args.json_summary and experiments:
             best = max(experiments, key=lambda e: e.status.throughput)
+            if (
+                args.binary_search
+                and profiler is not None
+                and profiler.binary_search_answer()
+            ):
+                best = profiler.binary_search_answer()
             print(
                 json.dumps(
                     {
@@ -494,7 +532,16 @@ async def run(args) -> int:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.binary_search:
+        if not args.latency_threshold:
+            parser.error("--binary-search requires --latency-threshold")
+        if args.periodic_concurrency_range or args.request_intervals:
+            parser.error(
+                "--binary-search requires --concurrency-range or "
+                "--request-rate-range"
+            )
     if (
         sum(
             bool(x)

@@ -134,6 +134,11 @@ class LoadManager:
         records, self.records = self.records, []
         return records
 
+    def record_count(self) -> int:
+        """Records accumulated since the last swap (count-bounded
+        measurement windows poll this)."""
+        return len(self.records)
+
     def check_health(self) -> None:
         """Raise if any worker task died unexpectedly (reference
         CheckHealth)."""

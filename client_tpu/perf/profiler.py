@@ -43,6 +43,8 @@ class InferenceProfiler:
         stability_pct: float = 10.0,
         max_trials: int = 10,
         latency_threshold_us: Optional[float] = None,
+        count_windows: bool = False,
+        measurement_request_count: int = 50,
         percentiles: Sequence[int] = (50, 90, 95, 99),
         stability_percentile: Optional[int] = None,
         warmup_s: float = 0.0,
@@ -54,6 +56,12 @@ class InferenceProfiler:
         self.stability_pct = stability_pct
         self.max_trials = max_trials
         self.latency_threshold_us = latency_threshold_us
+        # count_windows: a window ends after measurement_request_count NEW
+        # requests instead of after the interval, which then caps the wait
+        # (reference --measurement-mode count_windows; C++ twin
+        # ProfilerConfig.count_windows).
+        self.count_windows = count_windows
+        self.measurement_request_count = measurement_request_count
         self.percentiles = tuple(percentiles)
         # latency metric for stability + threshold checks: the given
         # percentile, or average latency when None (reference --percentile)
@@ -62,6 +70,7 @@ class InferenceProfiler:
         self.warmup_requests = warmup_requests
         self.verbose = verbose
         self.experiments: List[ProfileExperiment] = []
+        self._binary_answer: Optional[ProfileExperiment] = None
 
     def _stabilizing_latency(self, status: PerfStatus) -> float:
         if self.stability_percentile is None:
@@ -107,7 +116,15 @@ class InferenceProfiler:
         before = await self._server_stats(self.manager.model_name)
         self.manager.swap_records()  # discard partial records
         start_ns = time.monotonic_ns()
-        await asyncio.sleep(self.measurement_interval_s)
+        if self.count_windows:
+            deadline = start_ns + int(self.measurement_interval_s * 1e9)
+            while (
+                self.manager.record_count() < self.measurement_request_count
+                and time.monotonic_ns() < deadline
+            ):
+                await asyncio.sleep(0.002)
+        else:
+            await asyncio.sleep(self.measurement_interval_s)
         self.manager.check_health()
         end_ns = time.monotonic_ns()
         records = self.manager.swap_records()
@@ -299,6 +316,89 @@ class InferenceProfiler:
             rate += step
         await self.manager.stop()
         return results
+
+    def binary_search_answer(self) -> Optional[ProfileExperiment]:
+        """The highest threshold-meeting probe of the last binary search
+        (None when nothing met the threshold)."""
+        return self._binary_answer
+
+    async def _probe_binary_point(self, mode: str, value) -> float:
+        """One bisect probe at the already-applied load value; returns the
+        stabilized latency (0.0 when no requests completed)."""
+        status, stable = await self.profile_point()
+        if mode == "concurrency":
+            status.concurrency = int(value)
+        else:
+            status.request_rate = float(value)
+        experiment = ProfileExperiment(
+            mode=mode,
+            value=value,
+            status=status,
+            records=self._last_records,
+        )
+        self.experiments.append(experiment)
+        latency = (
+            self._stabilizing_latency(status) if status.request_count else 0.0
+        )
+        meets = 0.0 < latency <= (self.latency_threshold_us or 0.0)
+        if meets and (
+            self._binary_answer is None
+            or value > self._binary_answer.value
+        ):
+            self._binary_answer = experiment
+        if self.verbose:
+            verdict = "meets threshold" if meets else "over threshold"
+            print(f"  binary search: {mode} {value} -> "
+                  f"{latency:.0f} us ({verdict})")
+        return latency
+
+    async def _profile_binary(self, mode: str, start: int, end: int, apply):
+        """Shared bisect driver: apply(value) retargets the manager, then
+        the probe measures/records. Returns only THIS search's probes."""
+        if not self.latency_threshold_us:
+            raise ValueError("binary search needs latency_threshold_us")
+        self._binary_answer = None
+        first = len(self.experiments)
+        lo, hi = start, end
+        while lo <= hi:
+            mid = lo + (hi - lo) // 2
+            await apply(mid)
+            latency = await self._probe_binary_point(mode, mid)
+            if 0.0 < latency <= self.latency_threshold_us:
+                if mid >= hi:
+                    break
+                lo = mid + 1
+            else:
+                if mid <= lo:
+                    break
+                hi = mid - 1
+        await self.manager.stop()
+        return self.experiments[first:]
+
+    async def profile_concurrency_binary(
+        self, start: int, end: int
+    ) -> List[ProfileExperiment]:
+        """Bisect [start, end] for the highest concurrency whose
+        stabilized latency meets latency_threshold_us (reference
+        Profile<T> binary mode; C++ twin ProfileConcurrencyBinary)."""
+        assert isinstance(self.manager, ConcurrencyManager)
+        return await self._profile_binary(
+            "concurrency", start, end, self.manager.change_concurrency
+        )
+
+    async def profile_request_rate_binary(
+        self, start: int, end: int
+    ) -> List[ProfileExperiment]:
+        """Rate twin of profile_concurrency_binary (integral rates >= 1;
+        C++ twin ProfileRequestRateBinary)."""
+        assert isinstance(self.manager, RequestRateManager)
+
+        async def apply(rate):
+            await self.manager.change_rate(float(rate))
+
+        return await self._profile_binary(
+            "request_rate", max(1, start), max(1, end), apply
+        )
 
     async def profile_custom_intervals(
         self, intervals_s: Sequence[float]

@@ -638,3 +638,82 @@ def test_prepared_cache_env_kill_switch(monkeypatch):
     backend = asyncio.run(run())
     assert backend.request_count > 0
     assert backend.tokens == []
+
+
+def test_profiler_count_windows_ends_at_request_count():
+    """count_windows: a window closes once enough NEW requests completed
+    (the interval is only a cap) — C++ twin in test_load_managers.cc."""
+    from client_tpu.perf.profiler import InferenceProfiler
+
+    async def run():
+        backend = MockPerfBackend(latency_s=0.001)
+        manager = ConcurrencyManager(backend, "mock", make_loader())
+        await manager.change_concurrency(4)
+        profiler = InferenceProfiler(
+            manager,
+            measurement_interval_s=5.0,  # cap only
+            count_windows=True,
+            measurement_request_count=40,
+            stability_pct=95.0,
+            max_trials=3,
+        )
+        import time as _time
+
+        t0 = _time.monotonic()
+        status, _stable = await profiler.profile_point()
+        elapsed = _time.monotonic() - t0
+        await manager.stop()
+        return status, elapsed
+
+    status, elapsed = asyncio.run(run())
+    assert elapsed < 4.0  # far below the 3 x 5s interval cap
+    assert status.request_count >= 40
+
+
+def test_profiler_binary_search_converges():
+    from client_tpu.perf.profiler import InferenceProfiler
+
+    async def run(threshold_us):
+        backend = MockPerfBackend(latency_s=0.002)
+        manager = ConcurrencyManager(backend, "mock", make_loader())
+        profiler = InferenceProfiler(
+            manager,
+            measurement_interval_s=0.05,
+            stability_pct=95.0,
+            max_trials=3,
+            latency_threshold_us=threshold_us,
+        )
+        await profiler.profile_concurrency_binary(1, 8)
+        answer = profiler.binary_search_answer()
+        await manager.stop()
+        return profiler.experiments, answer
+
+    # generous threshold: every probe meets it -> answer is the range end
+    experiments, answer = asyncio.run(run(1e9))
+    assert len(experiments) >= 2
+    assert answer is not None and answer.value == 8
+    # impossible threshold: nothing meets it
+    experiments, answer = asyncio.run(run(1.0))
+    assert answer is None
+
+
+def test_profiler_request_rate_binary():
+    from client_tpu.perf.profiler import InferenceProfiler
+
+    async def run():
+        backend = MockPerfBackend(latency_s=0.001)
+        manager = RequestRateManager(backend, "mock", make_loader())
+        profiler = InferenceProfiler(
+            manager,
+            measurement_interval_s=0.05,
+            stability_pct=95.0,
+            max_trials=3,
+            latency_threshold_us=1e9,
+        )
+        probes = await profiler.profile_request_rate_binary(1, 64)
+        return probes, profiler.binary_search_answer()
+
+    probes, answer = asyncio.run(run())
+    assert probes  # only this search's probes are returned
+    assert all(p.mode == "request_rate" for p in probes)
+    assert answer is not None and answer.value == 64
