@@ -84,6 +84,48 @@ Error ParseRequestParameter(const std::string& value,
   return Error::Success();
 }
 
+Error ParseU64(const std::string& value, const char* what, uint64_t* out) {
+  if (value.empty() ||
+      value.find_first_not_of("0123456789") != std::string::npos) {
+    return Error(std::string("bad ") + what + " value '" + value + "'");
+  }
+  try {
+    *out = std::stoull(value);
+  } catch (...) {
+    return Error(std::string("bad ") + what + " value '" + value + "'");
+  }
+  return Error::Success();
+}
+
+Error ParseSize(const std::string& value, const char* what, size_t* out) {
+  uint64_t v = 0;
+  CTPU_RETURN_IF_ERROR(ParseU64(value, what, &v));
+  *out = static_cast<size_t>(v);
+  return Error::Success();
+}
+
+Error ParseI64(const std::string& value, const char* what, long long* out) {
+  try {
+    size_t idx = 0;
+    *out = std::stoll(value, &idx);
+    if (idx != value.size()) throw std::invalid_argument(value);
+  } catch (...) {
+    return Error(std::string("bad ") + what + " value '" + value + "'");
+  }
+  return Error::Success();
+}
+
+Error ParseF64(const std::string& value, const char* what, double* out) {
+  try {
+    size_t idx = 0;
+    *out = std::stod(value, &idx);
+    if (idx != value.size()) throw std::invalid_argument(value);
+  } catch (...) {
+    return Error(std::string("bad ") + what + " value '" + value + "'");
+  }
+  return Error::Success();
+}
+
 }  // namespace
 
 std::string Usage() {
@@ -208,7 +250,7 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
       params->protocol = next();
     } else if (arg == "-b" || arg == "--batch-size") {
       CTPU_RETURN_IF_ERROR(need(i));
-      params->batch_size = std::stoll(next());
+      { long long v; CTPU_RETURN_IF_ERROR(ParseI64(next(), "--batch-size", &v)); params->batch_size = v; }
     } else if (arg == "--concurrency-range") {
       CTPU_RETURN_IF_ERROR(need(i));
       double s, e, t;
@@ -236,7 +278,7 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
       params->periodic_step = (size_t)t;
     } else if (arg == "--request-period") {
       CTPU_RETURN_IF_ERROR(need(i));
-      params->request_period = (size_t)std::stoull(next());
+      CTPU_RETURN_IF_ERROR(ParseSize(next(), "--request-period", &params->request_period));
     } else if (arg == "--request-distribution") {
       CTPU_RETURN_IF_ERROR(need(i));
       params->request_distribution = next();
@@ -247,22 +289,22 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
       }
     } else if (arg == "--measurement-interval" || arg == "-p") {
       CTPU_RETURN_IF_ERROR(need(i));
-      params->measurement_interval_ms = std::stod(next());
+      CTPU_RETURN_IF_ERROR(ParseF64(next(), "--measurement-interval", &params->measurement_interval_ms));
     } else if (arg == "--stability-percentage" || arg == "-s") {
       CTPU_RETURN_IF_ERROR(need(i));
-      params->stability_percentage = std::stod(next());
+      CTPU_RETURN_IF_ERROR(ParseF64(next(), "--stability-percentage", &params->stability_percentage));
     } else if (arg == "--max-trials" || arg == "-r") {
       CTPU_RETURN_IF_ERROR(need(i));
-      params->max_trials = (size_t)std::stoull(next());
+      CTPU_RETURN_IF_ERROR(ParseSize(next(), "--max-trials", &params->max_trials));
     } else if (arg == "--latency-threshold" || arg == "-l") {
       CTPU_RETURN_IF_ERROR(need(i));
-      params->latency_threshold_ms = std::stod(next());
+      CTPU_RETURN_IF_ERROR(ParseF64(next(), "--latency-threshold", &params->latency_threshold_ms));
     } else if (arg == "--percentile") {
       CTPU_RETURN_IF_ERROR(need(i));
-      params->percentile = std::atoi(next().c_str());
+      { long long v; CTPU_RETURN_IF_ERROR(ParseI64(next(), "--percentile", &v)); params->percentile = (int)v; }
     } else if (arg == "--warmup-request-period") {
       CTPU_RETURN_IF_ERROR(need(i));
-      params->warmup_s = std::stod(next());
+      CTPU_RETURN_IF_ERROR(ParseF64(next(), "--warmup-request-period", &params->warmup_s));
     } else if (arg == "--input-tensor-format") {
       CTPU_RETURN_IF_ERROR(need(i));
       params->input_tensor_format = next();
@@ -299,7 +341,9 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
       }
     } else if (arg == "--output-shared-memory-size") {
       CTPU_RETURN_IF_ERROR(need(i));
-      long long size = std::stoll(next());
+      long long size;
+      CTPU_RETURN_IF_ERROR(
+          ParseI64(next(), "--output-shared-memory-size", &size));
       if (size < 0) {
         return Error("--output-shared-memory-size must be >= 0");
       }
@@ -308,13 +352,13 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
       params->streaming = true;
     } else if (arg == "--sequence-length") {
       CTPU_RETURN_IF_ERROR(need(i));
-      params->sequence_length = std::atoi(next().c_str());
+      { long long v; CTPU_RETURN_IF_ERROR(ParseI64(next(), "--sequence-length", &v)); params->sequence_length = (int)v; }
     } else if (arg == "--sequence-length-variation") {
       CTPU_RETURN_IF_ERROR(need(i));
-      params->sequence_length_variation = std::stod(next());
+      CTPU_RETURN_IF_ERROR(ParseF64(next(), "--sequence-length-variation", &params->sequence_length_variation));
     } else if (arg == "--num-of-sequences") {
       CTPU_RETURN_IF_ERROR(need(i));
-      params->num_of_sequences = (size_t)std::stoull(next());
+      CTPU_RETURN_IF_ERROR(ParseSize(next(), "--num-of-sequences", &params->num_of_sequences));
     } else if (arg == "--sequence-model") {
       params->force_sequences = true;
     } else if (arg == "--request-parameter") {
@@ -323,10 +367,10 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
           ParseRequestParameter(next(), &params->request_parameters));
     } else if (arg == "--max-threads") {
       CTPU_RETURN_IF_ERROR(need(i));
-      params->max_threads = (size_t)std::stoull(next());
+      CTPU_RETURN_IF_ERROR(ParseSize(next(), "--max-threads", &params->max_threads));
     } else if (arg == "--random-seed") {
       CTPU_RETURN_IF_ERROR(need(i));
-      params->random_seed = std::stoull(next());
+      CTPU_RETURN_IF_ERROR(ParseU64(next(), "--random-seed", &params->random_seed));
     } else if (arg == "-f") {
       CTPU_RETURN_IF_ERROR(need(i));
       params->csv_file = next();
@@ -345,10 +389,10 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
       params->local_zoo = true;
     } else if (arg == "--world-size") {
       CTPU_RETURN_IF_ERROR(need(i));
-      params->world_size = std::stoi(next());
+      { long long v; CTPU_RETURN_IF_ERROR(ParseI64(next(), "--world-size", &v)); params->world_size = (int)v; }
     } else if (arg == "--rank") {
       CTPU_RETURN_IF_ERROR(need(i));
-      params->rank = std::stoi(next());
+      { long long v; CTPU_RETURN_IF_ERROR(ParseI64(next(), "--rank", &v)); params->rank = (int)v; }
     } else if (arg == "--coordinator") {
       CTPU_RETURN_IF_ERROR(need(i));
       params->coordinator = next();
@@ -359,7 +403,7 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
       params->metrics_url = next();
     } else if (arg == "--metrics-interval") {
       CTPU_RETURN_IF_ERROR(need(i));
-      params->metrics_interval_ms = std::stod(next());
+      CTPU_RETURN_IF_ERROR(ParseF64(next(), "--metrics-interval", &params->metrics_interval_ms));
     } else if (arg == "-v" || arg == "--verbose") {
       params->verbose = true;
     } else if (arg == "--verbose-csv") {
@@ -374,8 +418,8 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
       params->measurement_mode = next();
     } else if (arg == "--measurement-request-count") {
       CTPU_RETURN_IF_ERROR(need(i));
-      params->measurement_request_count =
-          static_cast<size_t>(std::stoull(next()));
+      CTPU_RETURN_IF_ERROR(ParseSize(next(), "--measurement-request-count",
+                                     &params->measurement_request_count));
     } else if (arg == "--binary-search") {
       params->binary_search = true;
     } else if (arg == "--string-data") {
@@ -383,15 +427,21 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
       params->string_data = next();
     } else if (arg == "--string-length") {
       CTPU_RETURN_IF_ERROR(need(i));
-      params->string_length = static_cast<size_t>(std::stoull(next()));
+      CTPU_RETURN_IF_ERROR(ParseSize(next(), "--string-length", &params->string_length));
     } else if (arg == "--sequence-id-range") {
       CTPU_RETURN_IF_ERROR(need(i));
       const std::string value = next();
       const size_t colon = value.find(':');
-      params->sequence_id_start = std::stoull(value.substr(0, colon));
-      params->sequence_id_end =
-          colon == std::string::npos ? 0
-                                     : std::stoull(value.substr(colon + 1));
+      CTPU_RETURN_IF_ERROR(ParseU64(value.substr(0, colon),
+                                    "--sequence-id-range",
+                                    &params->sequence_id_start));
+      if (colon == std::string::npos) {
+        params->sequence_id_end = 0;
+      } else {
+        CTPU_RETURN_IF_ERROR(ParseU64(value.substr(colon + 1),
+                                      "--sequence-id-range",
+                                      &params->sequence_id_end));
+      }
     } else if (arg == "--model-signature-name") {
       CTPU_RETURN_IF_ERROR(need(i));
       params->model_signature_name = next();
@@ -482,6 +532,10 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
       return Error("--binary-search requires --concurrency-range or "
                    "--request-rate-range");
     }
+  }
+  if (params->sequence_id_start == 0) {
+    return Error("--sequence-id-range must start at >= 1 (sequence id 0 "
+                 "means 'not a sequence' on the wire)");
   }
   if (params->sequence_id_end != 0 &&
       params->sequence_id_end <= params->sequence_id_start) {

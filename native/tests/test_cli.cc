@@ -374,6 +374,39 @@ TEST_CASE("cli: sequence id range parses and validates") {
              .IsOk());
 }
 
+TEST_CASE("cli: sequence id range rejects malformed and zero-start input") {
+  PAParams p;
+  // non-numeric / empty components must fail cleanly, not throw
+  CHECK(!ParseSimple({"--sequence-id-range", "abc"}, &p).IsOk());
+  CHECK(!ParseSimple({"--sequence-id-range", "5:"}, &p).IsOk());
+  CHECK(!ParseSimple({"--sequence-id-range", ":5"}, &p).IsOk());
+  CHECK(!ParseSimple({"--sequence-id-range", "1:2x"}, &p).IsOk());
+  CHECK(!ParseSimple({"--sequence-id-range", "-1:5"}, &p).IsOk());
+  // sequence id 0 means "not a sequence" on the wire; a window that can
+  // hand out id 0 silently breaks sequence semantics for that slot.
+  CHECK(!ParseSimple({"--sequence-id-range", "0:8"}, &p).IsOk());
+  CHECK(!ParseSimple({"--sequence-id-range", "0"}, &p).IsOk());
+}
+
+TEST_CASE("cli: malformed numeric flag values fail cleanly across the table") {
+  PAParams p;
+  CHECK(!ParseSimple({"--batch-size", "abc"}, &p).IsOk());
+  CHECK(!ParseSimple({"--max-trials", "foo"}, &p).IsOk());
+  CHECK(!ParseSimple({"--measurement-request-count", "12x"}, &p).IsOk());
+  CHECK(!ParseSimple({"--string-length", "-3"}, &p).IsOk());
+  CHECK(!ParseSimple({"--measurement-interval", "5q"}, &p).IsOk());
+  CHECK(!ParseSimple({"--latency-threshold", ""}, &p).IsOk());
+  CHECK(!ParseSimple({"--percentile", "ninety"}, &p).IsOk());
+  CHECK(!ParseSimple({"--world-size", "2.5"}, &p).IsOk());
+  CHECK(!ParseSimple({"--random-seed", "0x10"}, &p).IsOk());
+  PAParams ok;
+  CHECK_OK(ParseSimple({"--measurement-interval", "2500.5",
+                        "--max-trials", "7", "--percentile", "99"},
+                       &ok));
+  CHECK_EQ(ok.max_trials, 7u);
+  CHECK_EQ(ok.percentile, 99);
+}
+
 TEST_CASE("cli: string data knobs") {
   PAParams p;
   CHECK_OK(ParseSimple({"--string-data", "abc", "--string-length", "7"}, &p));
