@@ -48,6 +48,11 @@ def _perf_analyzer_row(url: str, extra=None, timeout=300):
     """One perf_analyzer run; returns (summary dict | None, cpu_seconds)."""
     import resource
 
+    # One shared connection for all concurrency slots: on this single-core
+    # host extra connections only multiply wakeups/syscalls (measured +18%
+    # at 32-way share vs the 6-way default). Same knob the reference
+    # exposes as TRITON_CLIENT_GRPC_CHANNEL_MAX_SHARE_COUNT.
+    os.environ.setdefault("CTPU_GRPC_CHANNEL_MAX_SHARE_COUNT", str(CONCURRENCY))
     cmd = [
         PA,
         "-m",

@@ -26,6 +26,7 @@ import asyncio
 import os
 import sys
 import threading
+import traceback
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -134,8 +135,15 @@ class NativeGrpcFrontend:
     # -- request path --------------------------------------------------------
 
     def _pump_loop(self) -> None:
-        """Drain parsed requests from C++ in batches; one loop wakeup per
-        batch. wait_requests blocks with the GIL released."""
+        """Drain parsed requests from C++ in batches. Unary requests run
+        RIGHT HERE on the pump thread through ServerCore.infer_direct —
+        no event-loop crossing, no per-request future/task/executor hop
+        (PERF.md: that asyncio machinery was the dominant per-request
+        server cost). Streaming requests hop to the event loop; while a
+        direct batch executes, new arrivals queue in C++ and become the
+        next batch — the dynamic-batching window.
+
+        wait_requests blocks with the GIL released."""
         try:
             import ctypes
 
@@ -155,138 +163,189 @@ class NativeGrpcFrontend:
                 return  # frontend stopped
             if not batch:
                 continue
-            try:
-                self._loop.call_soon_threadsafe(self._submit_batch, batch)
-            except RuntimeError:  # loop closed under us
-                for item in batch:
-                    self._complete_error(
-                        item[0], "server shutting down", codec.GRPC_UNAVAILABLE
+            streaming_items = [item for item in batch if item[7]]
+            if streaming_items:
+                try:
+                    self._loop.call_soon_threadsafe(
+                        self._submit_batch, streaming_items
                     )
+                except RuntimeError:  # loop closed under us
+                    for item in streaming_items:
+                        self._complete_error(
+                            item[0],
+                            "server shutting down",
+                            codec.GRPC_UNAVAILABLE,
+                        )
+            if len(streaming_items) != len(batch):
+                direct_items = [item for item in batch if not item[7]]
+                try:
+                    self._run_direct(direct_items)
+                except Exception:  # noqa: BLE001 - pump must survive
+                    # A failure here is a bridge bug, not a request
+                    # error; contain it so the front-end keeps serving,
+                    # and fail the affected handles (no-op for any that
+                    # already completed).
+                    traceback.print_exc()
+                    for item in direct_items:
+                        try:
+                            self._complete_error(
+                                item[0],
+                                "internal error completing request batch",
+                                codec.GRPC_INTERNAL,
+                            )
+                        except Exception:  # noqa: BLE001
+                            pass
 
-    def _submit_batch(self, batch) -> None:
-        """Event loop: build CoreRequests; unary requests ride the core's
-        batcher future directly (no per-request asyncio task)."""
-        decode_input = self._core.decode_input
-        for (
-            handle,
+    def _build_request(self, item) -> CoreRequest:
+        """One wire-request tuple -> CoreRequest (raises on bad input)."""
+        (
+            _handle,
             model_name,
             model_version,
             request_id,
             inputs,
             outputs,
             params,
-            streaming,
-        ) in batch:
-            try:
-                request = CoreRequest(
-                    model_name=model_name,
-                    model_version=model_version,
-                    id=request_id,
-                    parameters=params,
+            _streaming,
+        ) = item
+        decode_input = self._core.decode_input
+        request = CoreRequest(
+            model_name=model_name,
+            model_version=model_version,
+            id=request_id,
+            parameters=params,
+        )
+        for name, datatype, shape, data, shm in inputs:
+            if type(data) is np.ndarray:
+                # Fastest path: the C++ side already built the
+                # zero-copy view (shape/dtype validated there).
+                request.inputs.append(
+                    CoreTensor(name, datatype, list(shape), data)
                 )
-                for name, datatype, shape, data, shm in inputs:
-                    if type(data) is np.ndarray:
-                        # Fastest path: the C++ side already built the
-                        # zero-copy view (shape/dtype validated there).
-                        request.inputs.append(
-                            CoreTensor(name, datatype, list(shape), data)
-                        )
-                        continue
-                    if shm is None and data is not None:
-                        # Hot path: raw bytes -> numpy view. frombuffer /
-                        # reshape validate the byte count against the shape.
-                        if datatype == "BYTES":
-                            arr = deserialize_bytes_tensor(data).reshape(
-                                shape
-                            )
-                        else:
-                            np_dtype = triton_to_np_dtype(datatype)
-                            if np_dtype is None:
-                                raise InferenceServerException(
-                                    f"unsupported datatype '{datatype}' "
-                                    f"for input '{name}'"
-                                )
-                            arr = np.frombuffer(data, dtype=np_dtype).reshape(
-                                shape
-                            )
-                        tensor = CoreTensor(name, datatype, list(shape), arr)
-                    elif shm is not None:
-                        region, byte_size, offset = shm
-                        tensor = decode_input(
-                            name,
-                            datatype,
-                            list(shape),
-                            shm_region=region,
-                            shm_byte_size=int(byte_size),
-                            shm_offset=int(offset),
-                        )
-                    else:
+                continue
+            if shm is None and data is not None:
+                # Hot path: raw bytes -> numpy view. frombuffer /
+                # reshape validate the byte count against the shape.
+                if datatype == "BYTES":
+                    arr = deserialize_bytes_tensor(data).reshape(shape)
+                else:
+                    np_dtype = triton_to_np_dtype(datatype)
+                    if np_dtype is None:
                         raise InferenceServerException(
-                            f"input '{name}' has no data (inline, typed "
-                            "contents, or shared memory)"
+                            f"unsupported datatype '{datatype}' "
+                            f"for input '{name}'"
                         )
-                    request.inputs.append(tensor)
-                for name, classification, shm in outputs:
-                    if shm is not None:
-                        region, byte_size, offset = shm
-                        request.outputs.append(
-                            CoreRequestedOutput(
-                                name=name,
-                                classification=int(classification),
-                                shm_region=region,
-                                shm_byte_size=int(byte_size),
-                                shm_offset=int(offset),
-                            )
-                        )
-                    else:
-                        request.outputs.append(
-                            CoreRequestedOutput(
-                                name=name, classification=int(classification)
-                            )
-                        )
-                if streaming:
-                    task = self._loop.create_task(
-                        self._run_stream(handle, request)
+                    arr = np.frombuffer(data, dtype=np_dtype).reshape(shape)
+                tensor = CoreTensor(name, datatype, list(shape), arr)
+            elif shm is not None:
+                region, byte_size, offset = shm
+                tensor = decode_input(
+                    name,
+                    datatype,
+                    list(shape),
+                    shm_region=region,
+                    shm_byte_size=int(byte_size),
+                    shm_offset=int(offset),
+                )
+            else:
+                raise InferenceServerException(
+                    f"input '{name}' has no data (inline, typed "
+                    "contents, or shared memory)"
+                )
+            request.inputs.append(tensor)
+        for name, classification, shm in outputs:
+            if shm is not None:
+                region, byte_size, offset = shm
+                request.outputs.append(
+                    CoreRequestedOutput(
+                        name=name,
+                        classification=int(classification),
+                        shm_region=region,
+                        shm_byte_size=int(byte_size),
+                        shm_offset=int(offset),
                     )
-                    self._tasks[handle] = task
-                    task.add_done_callback(
-                        lambda _t, h=handle: self._tasks.pop(h, None)
+                )
+            else:
+                request.outputs.append(
+                    CoreRequestedOutput(
+                        name=name, classification=int(classification)
+                    )
+                )
+        return request
+
+
+    def _run_direct(self, items) -> None:
+        """Pump thread: decode + execute + complete a batch of unary
+        requests synchronously (ServerCore.infer_direct). All completions
+        for the batch ride ONE complete_many call — the C++ side then
+        serializes and writes the whole batch in a single GIL release."""
+        handles = []
+        requests = []
+        completions = []
+        for item in items:
+            try:
+                request = self._build_request(item)
+            except Exception as e:  # noqa: BLE001 - wire-level badness
+                # Decode errors (including numpy size/shape ValueErrors)
+                # are the client's fault: INVALID_ARGUMENT.
+                completions.append(
+                    self._error_completion(
+                        item[0], e, default=codec.GRPC_INVALID_ARGUMENT
+                    )
+                )
+                continue
+            handles.append(item[0])
+            requests.append(request)
+        if requests:
+            for handle, result in zip(
+                handles, self._core.infer_direct(requests)
+            ):
+                if isinstance(result, Exception):
+                    # Execution errors are the server/model's fault:
+                    # INTERNAL (matching the event-loop unary path).
+                    completions.append(
+                        self._error_completion(handle, result)
                     )
                 else:
-                    future = self._core.infer_nowait(request)
-                    self._tasks[handle] = future
-                    future.add_done_callback(
-                        lambda f, h=handle: self._on_unary_done(h, f)
+                    completions.append(
+                        self._response_completion(handle, result, 1)
                     )
-            except InferenceServerException as e:
-                self._complete_error(
-                    handle, e.message(), codec.status_code_for(e.message())
+        if completions:
+            self._lib.complete_many(completions)
+
+    def _error_completion(
+        self, handle: int, e: Exception, default: Optional[int] = None
+    ):
+        """complete() argument tuple for a failed request. ``default`` is
+        the status for non-InferenceServerException errors (INTERNAL when
+        unset — execution context)."""
+        if isinstance(e, InferenceServerException):
+            message = e.message()
+            status = codec.status_code_for(message)
+        else:
+            message = str(e)
+            status = codec.GRPC_INTERNAL if default is None else default
+        return (handle, "", "", "", None, None, 1, message, status)
+
+    def _submit_batch(self, batch) -> None:
+        """Event loop: build CoreRequests and start streaming tasks."""
+        for item in batch:
+            handle = item[0]
+            try:
+                request = self._build_request(item)
+                task = self._loop.create_task(
+                    self._run_stream(handle, request)
                 )
-            except ValueError as e:
-                # numpy size/shape mismatch on the fast decode path
-                self._complete_error(
-                    handle, str(e), codec.GRPC_INVALID_ARGUMENT
+                self._tasks[handle] = task
+                task.add_done_callback(
+                    lambda _t, h=handle: self._tasks.pop(h, None)
                 )
             except Exception as e:  # noqa: BLE001 - wire-level badness
-                self._complete_error(
-                    handle, str(e), codec.GRPC_INVALID_ARGUMENT
+                self._lib.complete(
+                    *self._error_completion(
+                        handle, e, default=codec.GRPC_INVALID_ARGUMENT
+                    )
                 )
-
-    def _on_unary_done(self, handle: int, future) -> None:
-        """Event loop: deliver a finished unary inference to the wire."""
-        self._tasks.pop(handle, None)
-        if future.cancelled():
-            self._complete_error(handle, "request cancelled", 1)
-            return
-        exc = future.exception()
-        if exc is None:
-            self._complete_response(handle, future.result(), final=True)
-        elif isinstance(exc, InferenceServerException):
-            self._complete_error(
-                handle, exc.message(), codec.status_code_for(exc.message())
-            )
-        else:
-            self._complete_error(handle, str(exc), codec.GRPC_INTERNAL)
 
     def _cancel(self, handle: int) -> None:
         """C++ thread: peer reset the stream / dropped the connection."""
@@ -313,11 +372,15 @@ class NativeGrpcFrontend:
     def _payload(tensor) -> np.ndarray:
         if tensor.datatype == "BYTES":
             return serialize_byte_tensor(tensor.data)
-        return np.ascontiguousarray(tensor.data)
+        data = tensor.data
+        if data.flags.c_contiguous:
+            return data  # row slices of a C-contiguous batch land here
+        return np.ascontiguousarray(data)
 
-    def _complete_response(
-        self, handle: int, response: CoreResponse, final: bool
-    ) -> None:
+    def _response_completion(
+        self, handle: int, response: CoreResponse, final: int
+    ):
+        """complete() argument tuple for a successful response."""
         outs = []
         for t in response.outputs:
             shm = response.shm_outputs.get(t.name)
@@ -333,16 +396,23 @@ class NativeGrpcFrontend:
                         None,
                     )
                 )
-        self._lib.complete(
+        return (
             handle,
             response.model_name,
             response.model_version,
             response.id,
             outs,
             response.parameters or None,
-            1 if final else 0,
+            final,
             None,
             0,
+        )
+
+    def _complete_response(
+        self, handle: int, response: CoreResponse, final: bool
+    ) -> None:
+        self._lib.complete(
+            *self._response_completion(handle, response, 1 if final else 0)
         )
 
     # -- per-request coroutines ----------------------------------------------

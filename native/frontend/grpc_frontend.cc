@@ -1148,76 +1148,77 @@ PyObject* Stop(PyObject* self, PyObject* args) {
   Py_RETURN_NONE;
 }
 
-// complete(handle, model, version, request_id, outputs, params, final,
-//          error, status)
-// outputs: [(name, datatype, shape, data_or_None, shm_or_None), ...]
-PyObject* Complete(PyObject* self, PyObject* args) {
-  (void)self;
-  unsigned long long handle;
-  const char* model_name;
-  const char* model_version;
-  const char* request_id;
-  PyObject* outputs;
-  PyObject* params;
-  int final_flag;
-  PyObject* error_obj;
-  int status;
-  if (!PyArg_ParseTuple(args, "KsssOOiOi", &handle, &model_name,
-                        &model_version, &request_id, &outputs, &params,
-                        &final_flag, &error_obj, &status)) {
-    return nullptr;
-  }
+// One response on its way to the wire: built under the GIL
+// (PrepareCompletion), serialized + delivered without it
+// (DeliverCompletion).
+struct CompletionTask {
+  Frontend* fe = nullptr;
+  std::shared_ptr<h2srv::ServerConnection> conn;
+  std::unique_ptr<Pending> owned;  // on final: keeps request buffers alive
+                                   // until the response bytes are queued
+  uint32_t stream_id = 0;
+  bool streaming = false;
+  bool final_flag = false;
+  bool drop = false;  // cancelled / stopped / dead peer: nothing to write
+  bool has_error = false;
+  int status = 0;
+  std::string error_msg;
+  bool have_body = false;
+  inference::ModelInferResponse resp;
+  inference::ModelStreamInferResponse stream_wrapper;
+};
 
+// Builds `t` from one completion's fields. Called with the GIL; returns
+// false with a Python exception set on bad arguments.
+bool PrepareCompletion(unsigned long long handle, const char* model_name,
+                       const char* model_version, const char* request_id,
+                       PyObject* outputs, PyObject* params, int final_flag,
+                       PyObject* error_obj, int status, CompletionTask* t) {
+  t->final_flag = final_flag != 0;
   // Look up (and on final, remove) the pending entry. Field values are
   // copied out under the lock — a non-final lookup must not retain the raw
   // pointer, since stop() can free the entry concurrently.
-  std::unique_ptr<Pending> owned;  // on final: keeps request buffers alive
-                                   // until the response bytes are queued
-  Frontend* fe;
-  std::shared_ptr<h2srv::ServerConnection> conn_ref;
-  uint32_t stream_id;
-  bool streaming;
   bool cancelled;
   {
     std::lock_guard<std::mutex> g(g_mu);
     auto it = g_pending.find(handle);
-    if (it == g_pending.end()) Py_RETURN_NONE;  // stopped/raced: drop
+    if (it == g_pending.end()) {  // stopped/raced: drop
+      t->drop = true;
+      return true;
+    }
     Pending* pending = it->second.get();
-    fe = pending->fe;
-    conn_ref = pending->conn;
-    stream_id = pending->stream_id;
-    streaming = pending->streaming;
+    t->fe = pending->fe;
+    t->conn = pending->conn;
+    t->stream_id = pending->stream_id;
+    t->streaming = pending->streaming;
     cancelled = pending->cancelled;
     if (final_flag) {
-      owned = std::move(it->second);
+      t->owned = std::move(it->second);
       g_pending.erase(it);
     }
   }
-  h2srv::ServerConnection* conn = conn_ref.get();
-
-  if (cancelled || !conn->alive()) {
-    // Peer is gone; nothing to write. (On final the entry frees here.)
-    Py_RETURN_NONE;
+  if (cancelled || !t->conn->alive()) {
+    // Peer is gone; nothing to write. (On final the entry frees with t.)
+    t->drop = true;
+    return true;
   }
 
-  std::string error_msg;
-  bool has_error = false;
   if (error_obj != Py_None) {
     if (PyUnicode_Check(error_obj)) {
       const char* s = PyUnicode_AsUTF8(error_obj);
-      if (s != nullptr) error_msg = s;
+      if (s != nullptr) t->error_msg = s;
     }
-    has_error = true;
-    if (status == 0) status = kGrpcInternal;
+    t->has_error = true;
+    t->status = status == 0 ? kGrpcInternal : status;
+  } else {
+    t->status = status;
   }
 
   // Build the response proto (unless this is a unary error, which is
   // trailers-only). Building touches Python objects and needs the GIL;
-  // serialization + framing below run with it released.
-  inference::ModelInferResponse resp;
-  inference::ModelStreamInferResponse stream_wrapper;
-  bool have_body = false;
-  if (!has_error || streaming) {
+  // serialization + framing happen later with it released.
+  if (!t->has_error || t->streaming) {
+    inference::ModelInferResponse& resp = t->resp;
     resp.set_model_name(model_name);
     resp.set_model_version(model_version);
     resp.set_id(request_id);
@@ -1231,7 +1232,7 @@ PyObject* Complete(PyObject* self, PyObject* args) {
         SetParam(&(*resp.mutable_parameters())[k], value);
       }
     }
-    if (!has_error && outputs != Py_None) {
+    if (!t->has_error && outputs != Py_None) {
       Py_ssize_t n = PySequence_Size(outputs);
       for (Py_ssize_t i = 0; i < n; ++i) {
         PyObject* item = PySequence_GetItem(outputs, i);
@@ -1241,7 +1242,7 @@ PyObject* Complete(PyObject* self, PyObject* args) {
           PyErr_SetString(PyExc_TypeError,
                           "output item must be a 5-tuple "
                           "(name, datatype, shape, data, shm)");
-          return nullptr;
+          return false;
         }
         PyObject* name = PyTuple_GET_ITEM(item, 0);
         PyObject* datatype = PyTuple_GET_ITEM(item, 1);
@@ -1255,7 +1256,7 @@ PyObject* Complete(PyObject* self, PyObject* args) {
             PySequence_Fast(shape, "shape must be a sequence");
         if (shape_fast == nullptr) {
           Py_DECREF(item);
-          return nullptr;
+          return false;
         }
         Py_ssize_t ndim = PySequence_Fast_GET_SIZE(shape_fast);
         for (Py_ssize_t d = 0; d < ndim; ++d) {
@@ -1280,7 +1281,7 @@ PyObject* Complete(PyObject* self, PyObject* args) {
           Py_buffer view;
           if (PyObject_GetBuffer(data, &view, PyBUF_C_CONTIGUOUS) != 0) {
             Py_DECREF(item);
-            return nullptr;
+            return false;
           }
           resp.add_raw_output_contents()->assign(
               static_cast<const char*>(view.buf),
@@ -1290,42 +1291,59 @@ PyObject* Complete(PyObject* self, PyObject* args) {
         Py_DECREF(item);
       }
     }
-    if (streaming) {
-      if (has_error) {
-        stream_wrapper.set_error_message(error_msg);
-        stream_wrapper.mutable_infer_response()->set_id(request_id);
+    if (t->streaming) {
+      if (t->has_error) {
+        t->stream_wrapper.set_error_message(t->error_msg);
+        t->stream_wrapper.mutable_infer_response()->set_id(request_id);
       } else {
-        *stream_wrapper.mutable_infer_response() = std::move(resp);
+        *t->stream_wrapper.mutable_infer_response() = std::move(resp);
       }
     }
-    have_body = true;
+    t->have_body = true;
   }
+  return true;
+}
 
-  // Serialize + frame + wire writes without the GIL: the payload copies
-  // and HPACK/framing are pure C++ work.
-  Py_BEGIN_ALLOW_THREADS;
+// Serialize + frame + wire writes; runs WITHOUT the GIL (pure C++).
+void DeliverCompletion(CompletionTask* t) {
+  if (t->drop) return;
+  // Hot-path constants: one construction for the process, not per request.
+  static const std::vector<hpack::Header>& kOkHeaders =
+      *new std::vector<hpack::Header>(ResponseHeaders());
+  static const std::vector<hpack::Header>& kOkTrailers =
+      *new std::vector<hpack::Header>(Trailers(kGrpcOk, ""));
+  Frontend* fe = t->fe;
+  h2srv::ServerConnection* conn = t->conn.get();
+  const uint32_t stream_id = t->stream_id;
   std::string body;
-  if (have_body) {
-    body = streaming ? FrameSerialized(stream_wrapper)
-                     : FrameSerialized(resp);
+  if (t->have_body) {
+    body = t->streaming ? FrameSerialized(t->stream_wrapper)
+                        : FrameSerialized(t->resp);
   }
-  if (!streaming) {
-    std::lock_guard<std::mutex> lk(fe->mu);
-    auto it = fe->streams.find({conn, stream_id});
-    if (it != fe->streams.end() && !it->second.finished) {
-      it->second.finished = true;
-      it->second.pending--;
-      if (has_error) {
-        SendErrorTrailers(conn, stream_id, it->second.headers_sent, status,
-                          error_msg);
-      } else {
-        if (!it->second.headers_sent) {
+  if (!t->streaming) {
+    bool need_headers = false;
+    bool send_ok = false;
+    {
+      std::lock_guard<std::mutex> lk(fe->mu);
+      auto it = fe->streams.find({conn, stream_id});
+      if (it != fe->streams.end() && !it->second.finished) {
+        it->second.finished = true;
+        it->second.pending--;
+        if (t->has_error) {
+          SendErrorTrailers(conn, stream_id, it->second.headers_sent,
+                            t->status, t->error_msg);
+        } else {
+          need_headers = !it->second.headers_sent;
           it->second.headers_sent = true;
-          conn->SendHeaders(stream_id, ResponseHeaders(), false);
+          send_ok = true;
         }
-        conn->SendData(stream_id, std::move(body), false);
-        conn->SendTrailers(stream_id, Trailers(kGrpcOk, ""));
       }
+    }
+    if (send_ok) {
+      // HEADERS + DATA + TRAILERS queued with one lock + one writer
+      // wakeup (and usually one send() syscall).
+      conn->SendResponse(stream_id, need_headers ? &kOkHeaders : nullptr,
+                         &body, &kOkTrailers);
     }
   } else {
     bool close_stream = false;
@@ -1341,7 +1359,7 @@ PyObject* Complete(PyObject* self, PyObject* args) {
           it->second.headers_sent = true;
           send_headers = true;
         }
-        if (final_flag) {
+        if (t->final_flag) {
           it->second.pending--;
           if (it->second.end_stream_seen && it->second.pending == 0) {
             it->second.finished = true;
@@ -1351,16 +1369,97 @@ PyObject* Complete(PyObject* self, PyObject* args) {
       }
     }
     if (!drop) {
-      if (send_headers) {
-        conn->SendHeaders(stream_id, ResponseHeaders(), false);
-      }
-      conn->SendData(stream_id, std::move(body), false);
-      if (close_stream) {
-        conn->SendTrailers(stream_id, Trailers(kGrpcOk, ""));
-      }
+      conn->SendResponse(stream_id, send_headers ? &kOkHeaders : nullptr,
+                         &body, close_stream ? &kOkTrailers : nullptr);
     }
   }
+}
+
+// complete(handle, model, version, request_id, outputs, params, final,
+//          error, status)
+// outputs: [(name, datatype, shape, data_or_None, shm_or_None), ...]
+PyObject* Complete(PyObject* self, PyObject* args) {
+  (void)self;
+  unsigned long long handle;
+  const char* model_name;
+  const char* model_version;
+  const char* request_id;
+  PyObject* outputs;
+  PyObject* params;
+  int final_flag;
+  PyObject* error_obj;
+  int status;
+  if (!PyArg_ParseTuple(args, "KsssOOiOi", &handle, &model_name,
+                        &model_version, &request_id, &outputs, &params,
+                        &final_flag, &error_obj, &status)) {
+    return nullptr;
+  }
+  CompletionTask task;
+  if (!PrepareCompletion(handle, model_name, model_version, request_id,
+                         outputs, params, final_flag, error_obj, status,
+                         &task)) {
+    return nullptr;
+  }
+  Py_BEGIN_ALLOW_THREADS;
+  DeliverCompletion(&task);
   Py_END_ALLOW_THREADS;
+  Py_RETURN_NONE;
+}
+
+// complete_many([(handle, model, version, request_id, outputs, params,
+//                 final, error, status), ...])
+// The batched twin of complete(): every proto is built under ONE GIL
+// hold, then the whole batch serializes + hits the wire in ONE GIL
+// release — two GIL transitions per pump batch instead of two per
+// request.
+PyObject* CompleteMany(PyObject* self, PyObject* args) {
+  (void)self;
+  PyObject* items;
+  if (!PyArg_ParseTuple(args, "O", &items)) return nullptr;
+  PyObject* fast = PySequence_Fast(items, "complete_many expects a sequence");
+  if (fast == nullptr) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  std::vector<std::unique_ptr<CompletionTask>> tasks;
+  tasks.reserve(n);
+  bool failed = false;
+  for (Py_ssize_t i = 0; i < n && !failed; ++i) {
+    PyObject* item = PySequence_Fast_GET_ITEM(fast, i);
+    unsigned long long handle;
+    const char* model_name;
+    const char* model_version;
+    const char* request_id;
+    PyObject* outputs;
+    PyObject* params;
+    int final_flag;
+    PyObject* error_obj;
+    int status;
+    if (!PyTuple_Check(item) ||
+        !PyArg_ParseTuple(item, "KsssOOiOi", &handle, &model_name,
+                          &model_version, &request_id, &outputs, &params,
+                          &final_flag, &error_obj, &status)) {
+      failed = true;
+      break;
+    }
+    auto task = std::make_unique<CompletionTask>();
+    if (!PrepareCompletion(handle, model_name, model_version, request_id,
+                           outputs, params, final_flag, error_obj, status,
+                           task.get())) {
+      failed = true;
+      break;
+    }
+    tasks.push_back(std::move(task));
+  }
+  Py_DECREF(fast);
+  // Deliver every successfully-prepared response even when a later item
+  // failed — their Pending entries are already removed from g_pending, so
+  // dropping them here would strand those clients with no reply.
+  Py_BEGIN_ALLOW_THREADS;
+  for (auto& task : tasks) {
+    DeliverCompletion(task.get());
+  }
+  tasks.clear();  // free request buffers without the GIL
+  Py_END_ALLOW_THREADS;
+  if (failed) return nullptr;  // exception from the failing item is set
   Py_RETURN_NONE;
 }
 
@@ -1374,6 +1473,8 @@ PyMethodDef kMethods[] = {
     {"complete", Complete, METH_VARARGS,
      "complete(handle, model, version, request_id, outputs, params, final, "
      "error, status)"},
+    {"complete_many", CompleteMany, METH_VARARGS,
+     "complete_many([complete-argument tuples]) — batched complete()"},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -460,7 +460,7 @@ void ServerConnection::SendHeaders(uint32_t stream_id,
     WriteItem item{ItemKind::kHeaders, stream_id, {}, headers, end_stream, 0};
     wq_.push_back(std::move(item));
   }
-  wq_cv_.notify_all();
+  wq_cv_.notify_one();
 }
 
 void ServerConnection::SendData(uint32_t stream_id, std::string data,
@@ -474,7 +474,7 @@ void ServerConnection::SendData(uint32_t stream_id, std::string data,
                    end_stream, 0};
     wq_.push_back(std::move(item));
   }
-  wq_cv_.notify_all();
+  wq_cv_.notify_one();
 }
 
 void ServerConnection::SendTrailers(
@@ -487,7 +487,31 @@ void ServerConnection::SendTrailers(
     WriteItem item{ItemKind::kTrailers, stream_id, {}, trailers, true, 0};
     wq_.push_back(std::move(item));
   }
-  wq_cv_.notify_all();
+  wq_cv_.notify_one();
+}
+
+void ServerConnection::SendResponse(
+    uint32_t stream_id, const std::vector<hpack::Header>* headers,
+    std::string* data, const std::vector<hpack::Header>* trailers) {
+  if (dead_.load()) return;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    StreamState* st = GetStream(stream_id);
+    if (st == nullptr || st->reset) return;
+    if (headers != nullptr) {
+      wq_.push_back(
+          WriteItem{ItemKind::kHeaders, stream_id, {}, *headers, false, 0});
+    }
+    if (data != nullptr) {
+      wq_.push_back(WriteItem{ItemKind::kData, stream_id, std::move(*data),
+                              {}, false, 0});
+    }
+    if (trailers != nullptr) {
+      wq_.push_back(
+          WriteItem{ItemKind::kTrailers, stream_id, {}, *trailers, true, 0});
+    }
+  }
+  wq_cv_.notify_one();
 }
 
 void ServerConnection::SendReset(uint32_t stream_id, uint32_t error_code) {
@@ -514,7 +538,25 @@ void ServerConnection::SendReset(uint32_t stream_id, uint32_t error_code) {
 // frames or blocks other streams. Returns wq_.size() when nothing is
 // writable. Caller holds mu_.
 size_t ServerConnection::FindWritableLocked() {
-  std::set<uint32_t> blocked;
+  // Blocked-stream scratch: a small stack array covers the common case
+  // (few flow-control-blocked streams) without the per-call allocation a
+  // std::set would cost on this hot path; overflow spills to a set.
+  uint32_t blocked_small[32];
+  size_t n_blocked = 0;
+  std::set<uint32_t> blocked_big;
+  auto is_blocked = [&](uint32_t id) {
+    for (size_t j = 0; j < n_blocked; ++j) {
+      if (blocked_small[j] == id) return true;
+    }
+    return !blocked_big.empty() && blocked_big.count(id) > 0;
+  };
+  auto add_blocked = [&](uint32_t id) {
+    if (n_blocked < 32) {
+      blocked_small[n_blocked++] = id;
+    } else {
+      blocked_big.insert(id);
+    }
+  };
   for (size_t i = 0; i < wq_.size(); ++i) {
     WriteItem& it = wq_[i];
     if (it.kind != ItemKind::kRaw) {
@@ -524,10 +566,10 @@ size_t ServerConnection::FindWritableLocked() {
         --i;
         continue;
       }
-      if (blocked.count(it.stream_id)) continue;
+      if (is_blocked(it.stream_id)) continue;
       if (it.kind == ItemKind::kData &&
           (st->send_window <= 0 || conn_send_window_ <= 0)) {
-        blocked.insert(it.stream_id);
+        add_blocked(it.stream_id);
         continue;
       }
     }

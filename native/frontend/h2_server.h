@@ -78,6 +78,16 @@ class ServerConnection {
   void SendData(uint32_t stream_id, std::string data, bool end_stream);
   void SendTrailers(uint32_t stream_id,
                     const std::vector<hpack::Header>& trailers);
+  // One-lock, one-wakeup enqueue of a response bundle: optional HEADERS
+  // (null = already sent), optional DATA (null = trailers-only), optional
+  // TRAILERS (null = stream stays open, streaming). Equivalent to calling
+  // SendHeaders + SendData + SendTrailers back-to-back, but the writer
+  // wakes once with every frame queued, so a unary gRPC response costs one
+  // condvar signal and usually one send() instead of three of each.
+  void SendResponse(uint32_t stream_id,
+                    const std::vector<hpack::Header>* headers,
+                    std::string* data,
+                    const std::vector<hpack::Header>* trailers);
   void SendReset(uint32_t stream_id, uint32_t error_code);
 
   bool alive() const { return !dead_.load(); }
