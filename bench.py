@@ -61,6 +61,7 @@ def _perf_analyzer_row(url: str, extra=None, timeout=300):
         url,
         "-i",
         "grpc",
+        "--async",
         "--concurrency-range",
         str(CONCURRENCY),
         "--measurement-interval",

@@ -940,14 +940,26 @@ Error InferenceServerGrpcClient::AsyncInfer(
   CTPU_RETURN_IF_ERROR(EnsureConnection());
   inference::ModelInferRequest request;
   CTPU_RETURN_IF_ERROR(FillInferRequest(options, inputs, outputs, &request));
+  // A fresh body always carries compressed-flag byte 0, so the framed
+  // path's compress-on-send applies exactly as it would here.
+  return AsyncInferFramed(std::move(callback), FrameMessage(request),
+                          options.client_timeout_us, headers);
+}
 
+Error InferenceServerGrpcClient::AsyncInferFramed(OnCompleteFn callback,
+                                                  const std::string& framed,
+                                                  uint64_t client_timeout_us,
+                                                  const Headers& headers) {
+  if (!callback) return Error("callback is required for AsyncInferFramed");
+  CTPU_RETURN_IF_ERROR(EnsureConnection());
   auto st = std::make_shared<UnaryCallState>();
   auto cb = std::make_shared<OnCompleteFn>(std::move(callback));
   h2::StreamEvents ev;
   FillUnaryEvents(st, &ev);
   ev.on_close = [st, cb](bool ok, uint32_t, const std::string& err) {
     // Runs on the reader thread (reference delivers from the CQ thread,
-    // grpc_client.cc:1583-1626 — same contract).
+    // grpc_client.cc:1583-1626 — same contract). AsyncInfer delegates
+    // here, so this is the single async unary delivery path.
     auto response = std::make_shared<inference::ModelInferResponse>();
     Error status;
     {
@@ -963,19 +975,20 @@ Error InferenceServerGrpcClient::AsyncInfer(
 
   std::shared_ptr<h2::Connection> conn = Conn();
   const int32_t sid = conn->StartStream(
-      BuildHeaders("ModelInfer", headers, options.client_timeout_us), false,
-      ev);
+      BuildHeaders("ModelInfer", headers, client_timeout_us), false, ev);
   if (sid < 0) return Error("gRPC stream open failed (connection lost)");
-  std::string body = FrameMessage(request);
+  // Compress unless disabled or the body is already a compressed frame
+  // (same contract as CallFramed).
   std::string deflated;
-  if (!compression_.empty() &&
-      CompressFramed(body, compression_ == "gzip", &deflated)) {
-    body = std::move(deflated);
+  const std::string* wire = &framed;
+  if (!compression_.empty() && !framed.empty() && framed[0] == '\0' &&
+      CompressFramed(framed, compression_ == "gzip", &deflated)) {
+    wire = &deflated;
   }
   // If the send fails the stream is already registered and on_close WILL
   // fire with the transport error — report success here so the callback is
   // the single delivery path (no double signaling).
-  conn->SendData(sid, body.data(), body.size(), true);
+  conn->SendData(sid, wire->data(), wire->size(), true);
   return Error::Success();
 }
 

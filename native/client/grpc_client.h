@@ -189,6 +189,12 @@ class InferenceServerGrpcClient : public InferenceServerClient {
                    const std::vector<InferInput*>& inputs,
                    const std::vector<const InferRequestedOutput*>& outputs = {},
                    const Headers& headers = {});
+  // Async unary inference with a body from PrepareInferBody — the async
+  // twin of InferFramed. The callback runs on the connection's reader
+  // thread; `framed` is copied into the send queue before returning.
+  Error AsyncInferFramed(OnCompleteFn callback, const std::string& framed,
+                         uint64_t client_timeout_us = 0,
+                         const Headers& headers = {});
   Error InferMulti(std::vector<InferResult*>* results,
                    const std::vector<InferOptions>& options,
                    const std::vector<std::vector<InferInput*>>& inputs,

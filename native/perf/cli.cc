@@ -173,6 +173,10 @@ std::string Usage() {
       "  --output-shared-memory-size BYTES  redirect outputs to per-worker\n"
       "                              shm regions of this size (shm modes)\n"
       "  --streaming                 streaming mode flag\n"
+      "  -a/--async                  event-driven issue for concurrency\n"
+      "                              mode (callback chains, no per-slot\n"
+      "                              blocking threads); --sync restores\n"
+      "                              the default blocking workers\n"
       "  --sequence-length N         sequence length (default 20)\n"
       "  --sequence-length-variation P  +-pct length variation\n"
       "  --num-of-sequences N        concurrent sequences (default 4)\n"
@@ -451,10 +455,10 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
     } else if (arg == "--grpc-compression-algorithm") {
       CTPU_RETURN_IF_ERROR(need(i));
       params->grpc_compression = next();
-    } else if (arg == "--async" || arg == "--sync") {
-      // Accepted for reference drop-in compatibility: this harness issues
-      // unary requests from dedicated slots either way (the async/sync
-      // distinction is a grpc++/CQ artifact the h2 client doesn't have).
+    } else if (arg == "--async" || arg == "-a") {
+      params->async_mode = true;
+    } else if (arg == "--sync") {
+      params->async_mode = false;
     } else if (arg == "-h" || arg == "--help") {
       return Error("help");
     } else {

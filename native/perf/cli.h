@@ -72,6 +72,10 @@ struct PAParams {
   std::string shared_memory = "none";  // none | system | tpu
   size_t output_shared_memory_size = 0;  // 0 = outputs returned inline
   bool streaming = false;
+  // Event-driven issue for concurrency mode (reference --async): callback
+  // chains instead of per-slot blocking threads. Requires backend support
+  // (gRPC unary); backends without it fall back to blocking workers.
+  bool async_mode = false;
 
   // Sequence id allocation window (reference kSequenceIdRange
   // "start:end"); end 0 = unbounded.

@@ -48,6 +48,28 @@ class BackendContext {
                       const std::vector<const InferRequestedOutput*>& outputs,
                       RequestRecord* record) = 0;
 
+  // Event-driven inference (reference --async, perf_analyzer's AsyncInfer
+  // worker path): issue without blocking; `done(record)` fires exactly
+  // once on the backend's delivery thread with the record's result +
+  // timestamps filled. Inputs/outputs need not outlive the call (the
+  // request serializes before return). A context is still single-issuer:
+  // the manager must not issue concurrently on one context, but MAY issue
+  // the next request from inside `done`. Backends that return false from
+  // SupportsAsync() keep this unimplemented and are driven by blocking
+  // worker threads instead.
+  virtual bool SupportsAsync() const { return false; }
+  virtual Error AsyncInfer(
+      const InferOptions& options, const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs,
+      RequestRecord record, std::function<void(RequestRecord)> done) {
+    (void)options;
+    (void)inputs;
+    (void)outputs;
+    (void)record;
+    (void)done;
+    return Error("backend does not support async inference");
+  }
+
   // Prepared-request cache contract: the load manager tags deterministic
   // (non-sequence) requests with a nonzero token identifying the corpus
   // (stream, step) before calling Infer; a backend that can reuse a

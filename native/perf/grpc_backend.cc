@@ -220,6 +220,74 @@ Error GrpcBackendContext::InferStreaming(
   return Error::Success();
 }
 
+Error GrpcBackendContext::AsyncInfer(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    RequestRecord record, std::function<void(RequestRecord)> done) {
+  Error err = EnsureClient();
+  if (!err.IsOk()) {
+    record.success = false;
+    record.error = err.Message();
+    record.start_ns = record.end_ns = RequestTimers::Now();
+    done(std::move(record));
+    return Error::Success();  // delivered through the record
+  }
+  if (streaming_) {
+    return Error("async issue is unary-only (streaming already "
+                 "multiplexes on one stream)");
+  }
+  // The completion callback runs on the connection's reader thread; it
+  // owns the record from here.
+  auto shared_record = std::make_shared<RequestRecord>(std::move(record));
+  auto on_done = [shared_record,
+                  done = std::move(done)](InferResult* raw) mutable {
+    RequestRecord rec = std::move(*shared_record);
+    rec.end_ns = RequestTimers::Now();
+    rec.response_ns.push_back(rec.end_ns);
+    std::unique_ptr<InferResult> result(raw);
+    Error status = result->RequestStatus();
+    if (!status.IsOk()) {
+      rec.success = false;
+      rec.error = status.Message();
+    } else {
+      rec.success = true;
+    }
+    done(std::move(rec));
+  };
+  shared_record->start_ns = RequestTimers::Now();
+  // Same prepared-body resolution as the blocking path.
+  std::shared_ptr<const std::string> cached =
+      cache_token_ != 0 ? body_cache_->Find(cache_token_) : nullptr;
+  if (cached == nullptr && cache_token_ != 0) {
+    InferOptions idless = options;
+    idless.request_id.clear();
+    std::string framed;
+    err = client_->PrepareInferBody(idless, inputs, outputs, &framed);
+    if (err.IsOk()) {
+      const size_t weight = framed.size();
+      cached = body_cache_->Insert(cache_token_, std::move(framed), weight);
+    }
+  }
+  if (cached != nullptr) {
+    err = client_->AsyncInferFramed(on_done, *cached,
+                                    options.client_timeout_us);
+  } else {
+    err = client_->AsyncInfer(on_done, options, inputs, outputs);
+  }
+  if (!err.IsOk()) {
+    // Issue failed synchronously: the callback will never fire. Deliver
+    // the failure through the record and drop the client so the next
+    // issue re-establishes the connection.
+    RequestRecord rec = std::move(*shared_record);
+    rec.success = false;
+    rec.error = err.Message();
+    rec.end_ns = RequestTimers::Now();
+    client_.reset();
+    done(std::move(rec));
+  }
+  return Error::Success();
+}
+
 Error GrpcBackendContext::Infer(
     const InferOptions& options, const std::vector<InferInput*>& inputs,
     const std::vector<const InferRequestedOutput*>& outputs,

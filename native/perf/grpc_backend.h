@@ -37,6 +37,15 @@ class GrpcBackendContext : public BackendContext {
               const std::vector<const InferRequestedOutput*>& outputs,
               RequestRecord* record) override;
 
+  // Event-driven issue (reference --async): unary only — the streaming
+  // path already multiplexes on one bidi stream and correlates by id.
+  bool SupportsAsync() const override { return !streaming_; }
+  Error AsyncInfer(const InferOptions& options,
+                   const std::vector<InferInput*>& inputs,
+                   const std::vector<const InferRequestedOutput*>& outputs,
+                   RequestRecord record,
+                   std::function<void(RequestRecord)> done) override;
+
   bool HasPrepared(uint64_t token) const override {
     // Streaming correlates responses by per-send request id, which a
     // reused body cannot carry.

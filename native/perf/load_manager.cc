@@ -6,8 +6,9 @@
 namespace ctpu {
 namespace perf {
 
-void LoadManager::IssueOne(BackendContext* ctx, size_t slot, size_t stream,
-                           size_t step) {
+bool LoadManager::PrepareIssueSpec(BackendContext* ctx, size_t slot,
+                                   size_t stream, size_t step,
+                                   IssueSpec* spec) {
   // Non-sequence requests are deterministic per corpus coordinate, so the
   // backend may resend a previously built wire request (sequence options
   // change per send and defeat caching). On a hit, input preparation is
@@ -21,65 +22,163 @@ void LoadManager::IssueOne(BackendContext* ctx, size_t slot, size_t stream,
                              ? data_->CacheToken(slot, stream, step)
                              : 0;
   ctx->SetNextCacheToken(token);
+  spec->options.model_name = config_.model_name;
+  spec->options.model_version = config_.model_version;
+  spec->options.client_timeout_us = config_.client_timeout_us;
   if (token != 0 && ctx->HasPrepared(token)) {
-    InferOptions options(config_.model_name);
-    options.model_version = config_.model_version;
-    options.client_timeout_us = config_.client_timeout_us;
-    RequestRecord record;
-    record.request_id = request_seq_.fetch_add(1);
-    static const std::vector<InferInput*> kNoInputs;
-    static const std::vector<const InferRequestedOutput*> kNoOutputs;
-    ctx->Infer(options, kNoInputs, kNoOutputs, &record);
-    std::lock_guard<std::mutex> lk(records_mu_);
-    records_.push_back(std::move(record));
-    return;
+    spec->record.request_id = request_seq_.fetch_add(1);
+    spec->use_cache = true;
+    return true;
   }
 
-  PreparedRequest request;
-  Error err = data_->Prepare(slot, stream, step, &request);
+  Error err = data_->Prepare(slot, stream, step, &spec->request);
   if (!err.IsOk()) {
     ReportWorkerError(err);
-    return;
+    return false;
   }
 
-  InferOptions options(config_.model_name);
-  options.model_version = config_.model_version;
   uint64_t request_id = request_seq_.fetch_add(1);
-  options.request_id = std::to_string(request_id);
-  options.client_timeout_us = config_.client_timeout_us;
-  options.parameters = config_.request_parameters;
-  if (request.step_parameters != nullptr &&
-      request.step_parameters->IsObject()) {
+  spec->options.request_id = std::to_string(request_id);
+  spec->options.parameters = config_.request_parameters;
+  if (spec->request.step_parameters != nullptr &&
+      spec->request.step_parameters->IsObject()) {
     // per-step parameters override the globals (same merge as the Python
     // harness, client_tpu/perf/load_manager.py issue_one)
-    for (const auto& kv : request.step_parameters->AsObject()) {
-      options.parameters[kv.first] = kv.second.Dump();
+    for (const auto& kv : spec->request.step_parameters->AsObject()) {
+      spec->options.parameters[kv.first] = kv.second.Dump();
     }
   }
   if (sequences_ != nullptr) {
     SequenceManager::StepFlags flags = sequences_->NextStep(slot);
-    options.sequence_id = flags.sequence_id;
-    options.sequence_start = flags.start;
-    options.sequence_end = flags.end;
+    spec->options.sequence_id = flags.sequence_id;
+    spec->options.sequence_start = flags.start;
+    spec->options.sequence_end = flags.end;
   }
+  spec->record.request_id = request_id;
+  return true;
+}
 
-  RequestRecord record;
-  record.request_id = request_id;
-  // errors are data (recorded, not raised)
-  ctx->Infer(options, request.input_ptrs, request.output_ptrs, &record);
-  record.sequence_id = options.sequence_id;
-  {
-    std::lock_guard<std::mutex> lk(records_mu_);
-    records_.push_back(std::move(record));
+void LoadManager::IssueOne(BackendContext* ctx, size_t slot, size_t stream,
+                           size_t step) {
+  IssueSpec spec;
+  if (!PrepareIssueSpec(ctx, slot, stream, step, &spec)) return;
+  if (spec.use_cache) {
+    static const std::vector<InferInput*> kNoInputs;
+    static const std::vector<const InferRequestedOutput*> kNoOutputs;
+    ctx->Infer(spec.options, kNoInputs, kNoOutputs, &spec.record);
+    RecordOne(std::move(spec.record));
+    return;
   }
+  // errors are data (recorded, not raised)
+  ctx->Infer(spec.options, spec.request.input_ptrs,
+             spec.request.output_ptrs, &spec.record);
+  spec.record.sequence_id = spec.options.sequence_id;
+  RecordOne(std::move(spec.record));
+}
+
+Error LoadManager::IssueOneAsync(BackendContext* ctx, size_t slot,
+                                 size_t stream, size_t step,
+                                 std::function<void()> done) {
+  IssueSpec spec;
+  if (!PrepareIssueSpec(ctx, slot, stream, step, &spec)) {
+    return Error("request preparation failed");
+  }
+  const uint64_t sequence_id = spec.options.sequence_id;
+  auto on_done = [this, sequence_id,
+                  done = std::move(done)](RequestRecord record) {
+    record.sequence_id = sequence_id;
+    RecordOne(std::move(record));
+    done();
+  };
+  if (spec.use_cache) {
+    static const std::vector<InferInput*> kNoInputs;
+    static const std::vector<const InferRequestedOutput*> kNoOutputs;
+    return ctx->AsyncInfer(spec.options, kNoInputs, kNoOutputs,
+                           std::move(spec.record), std::move(on_done));
+  }
+  // AsyncInfer serializes before returning, so the PreparedRequest may
+  // die with this frame.
+  return ctx->AsyncInfer(spec.options, spec.request.input_ptrs,
+                         spec.request.output_ptrs, std::move(spec.record),
+                         std::move(on_done));
 }
 
 // ---------------------------------------------------------------------------
 // ConcurrencyManager
 // ---------------------------------------------------------------------------
 
+void ConcurrencyManager::AsyncIssueNext(std::shared_ptr<AsyncSlot> slot) {
+  for (;;) {
+    if (stopping_.load() || !slot->active->load()) {
+      std::lock_guard<std::mutex> lk(async_mu_);
+      async_inflight_--;
+      async_cv_.notify_all();
+      return;
+    }
+    const size_t step = slot->step++;
+    slot->gate.store(2);
+    Error err = IssueOneAsync(
+        slot->ctx.get(), slot->slot_id, slot->slot_id, step,
+        [this, slot] {
+          // Completion's gate release: if the issuer already released
+          // (normal async delivery), advance the chain from here — one
+          // stack frame per delivery, no growth.
+          if (slot->gate.fetch_sub(1) == 1) AsyncIssueNext(slot);
+        });
+    if (!err.IsOk()) {
+      // done will never fire for this issue: the chain ends here.
+      ReportWorkerError(err);
+      std::lock_guard<std::mutex> lk(async_mu_);
+      async_inflight_--;
+      async_cv_.notify_all();
+      return;
+    }
+    // Issuer's gate release: a synchronous completion (fast-fail) already
+    // released its unit, so the chain continues in THIS loop — flat stack
+    // even when every issue fails instantly against a dead server.
+    if (slot->gate.fetch_sub(1) != 1) return;  // completion pending
+  }
+}
+
 void ConcurrencyManager::ChangeConcurrency(size_t concurrency) {
   target_.store(concurrency);
+  if (async_mode_) {
+    // shrink: deactivate surplus chains, then WAIT for their in-flight
+    // requests to drain (the sync path joins surplus workers the same
+    // way) — otherwise stragglers from the higher level would be
+    // recorded inside the next level's measurement window.
+    while (async_slots_.size() > concurrency) {
+      async_slots_.back()->active->store(false);
+      async_slots_.pop_back();
+    }
+    {
+      std::unique_lock<std::mutex> lk(async_mu_);
+      async_cv_.wait(lk,
+                     [&] { return async_inflight_ <= concurrency; });
+    }
+    // grow: start new chains, each kicked from its own (short-lived)
+    // starter thread. Normally the starter exits after the first issue
+    // and the chain continues on completion-delivery threads; against a
+    // fast-failing server the whole chain spins on the starter thread —
+    // the same behavior as a sync worker thread, and crucially NOT on
+    // this caller's thread (which must return to the profiler).
+    while (async_slots_.size() < concurrency) {
+      auto slot = std::make_shared<AsyncSlot>();
+      slot->ctx = backend_->CreateContext();
+      slot->active = std::make_shared<std::atomic<bool>>(true);
+      slot->slot_id = async_slots_.size();
+      async_slots_.push_back(slot);
+      {
+        std::lock_guard<std::mutex> lk(async_mu_);
+        async_inflight_++;
+      }
+      // Stop() joins the chain via the inflight count, not the thread.
+      std::thread([this, slot = std::move(slot)]() mutable {
+        AsyncIssueNext(std::move(slot));
+      }).detach();
+    }
+    return;
+  }
   // shrink: deactivate surplus workers and join them
   while (workers_.size() > concurrency) {
     workers_.back().active->store(false);
@@ -109,6 +208,18 @@ void ConcurrencyManager::WorkerLoop(
 
 void ConcurrencyManager::Stop() {
   stopping_.store(true);
+  if (async_mode_) {
+    for (auto& s : async_slots_) s->active->store(false);
+    // Wait for every chain's in-flight request to drain (each decrements
+    // async_inflight_ exactly once on its way out). Unbounded, matching
+    // the sync path's thread join: a request that never completes hangs
+    // Stop() in both modes, and a bounded wait here would instead free
+    // the manager under a live completion callback (use-after-free).
+    // Callers bound hang risk with --client-timeout.
+    std::unique_lock<std::mutex> lk(async_mu_);
+    async_cv_.wait(lk, [this] { return async_inflight_ == 0; });
+    async_slots_.clear();
+  }
   for (auto& w : workers_) {
     w.active->store(false);
     if (w.thread.joinable()) w.thread.join();

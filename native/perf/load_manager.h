@@ -82,6 +82,34 @@ class LoadManager {
   // Issue one blocking request on the given context and record it.
   void IssueOne(BackendContext* ctx, size_t slot, size_t stream, size_t step);
 
+  // Event-driven twin of IssueOne (reference --async): issues without
+  // blocking; `done()` fires after the completion is recorded (the async
+  // manager chains the next issue from it). The context must support
+  // async and must not have another async issue in flight. On an error
+  // RETURN, `done` will never fire (the chain must account for the slot);
+  // request-level failures are data — recorded and delivered via `done`
+  // like successes, matching the sync worker loop.
+  Error IssueOneAsync(BackendContext* ctx, size_t slot, size_t stream,
+                      size_t step, std::function<void()> done);
+
+  // Shared corpus/options/record preparation for both issue paths.
+  // Returns false when preparation failed (error already reported) or the
+  // prepared-cache fast path applies (*use_cache set true, options/record
+  // filled, inputs/outputs left empty).
+  struct IssueSpec {
+    InferOptions options{""};
+    PreparedRequest request;
+    RequestRecord record;
+    bool use_cache = false;
+  };
+  bool PrepareIssueSpec(BackendContext* ctx, size_t slot, size_t stream,
+                        size_t step, IssueSpec* spec);
+
+  void RecordOne(RequestRecord record) {
+    std::lock_guard<std::mutex> lk(records_mu_);
+    records_.push_back(std::move(record));
+  }
+
   void ReportWorkerError(const Error& err) {
     std::lock_guard<std::mutex> lk(health_mu_);
     if (worker_error_.IsOk()) worker_error_ = err;
@@ -102,9 +130,25 @@ class LoadManager {
 
 // Closed loop: N workers, each re-issuing as soon as its response returns
 // (reference concurrency_worker.h:99-127 send-until-full semantics).
+//
+// Two issue models, selected at construction (reference --async):
+//  - sync (default): every slot gets a blocking thread + context.
+//  - async: every slot is a callback CHAIN on a shared event-driven
+//    backend context pool — a completion records its request and issues
+//    the slot's next request from the delivery thread. No per-request
+//    thread wake/sleep, so client-side context switches drop to ~0 and
+//    the harness keeps N requests outstanding with a handful of threads
+//    (the reference multiplexes async clients over a few workers the
+//    same way, concurrency_manager.h:93-133).
 class ConcurrencyManager : public LoadManager {
  public:
-  using LoadManager::LoadManager;
+  ConcurrencyManager(std::shared_ptr<ClientBackend> backend,
+                     IInferDataManager* data_manager, LoadConfig config,
+                     SequenceManager* sequences = nullptr,
+                     bool async_mode = false)
+      : LoadManager(std::move(backend), data_manager, std::move(config),
+                    sequences),
+        async_mode_(async_mode) {}
   ~ConcurrencyManager() override { Stop(); }
 
   // Grow/shrink the worker pool (reference ChangeConcurrencyLevel).
@@ -117,9 +161,30 @@ class ConcurrencyManager : public LoadManager {
     std::thread thread;
     std::shared_ptr<std::atomic<bool>> active;
   };
+  // One async slot: a self-re-issuing chain. `active` gates re-issue
+  // (slot shrink / stop); `ctx` is used by at most one in-flight request.
+  // `gate` is the issue/completion rendezvous: issuer and completion each
+  // release one unit per request, and whoever releases LAST advances the
+  // chain — so a completion that fires synchronously inside the issue
+  // call (fast-fail paths) continues via the issuer's loop instead of
+  // recursing toward stack overflow.
+  struct AsyncSlot {
+    std::unique_ptr<BackendContext> ctx;
+    std::shared_ptr<std::atomic<bool>> active;
+    std::atomic<int> gate{0};
+    size_t slot_id = 0;
+    size_t step = 0;
+  };
   void WorkerLoop(size_t worker_id, std::shared_ptr<std::atomic<bool>> active);
+  void AsyncIssueNext(std::shared_ptr<AsyncSlot> slot);
   std::vector<Worker> workers_;
   std::atomic<size_t> target_{0};
+
+  const bool async_mode_;
+  std::mutex async_mu_;
+  std::condition_variable async_cv_;
+  std::vector<std::shared_ptr<AsyncSlot>> async_slots_;
+  size_t async_inflight_ = 0;  // guarded by async_mu_
 };
 
 // Open loop: a scheduler thread fires requests at schedule instants into a

@@ -296,8 +296,16 @@ int main(int argc, char** argv) {
     if (!err.IsOk()) return fail(err, "profile");
     experiments = profiler.Experiments();
   } else {
+    // --async needs backend support; probe one context. Backends without
+    // it (HTTP, OpenAI, ...) fall back to blocking workers, like the
+    // reference forces sync for backends lacking an async API.
+    bool async_mode = params.async_mode;
+    if (async_mode) {
+      auto probe = backend->CreateContext();
+      if (probe == nullptr || !probe->SupportsAsync()) async_mode = false;
+    }
     ConcurrencyManager manager(backend, data_manager.get(), load_config,
-                               sequences.get());
+                               sequences.get(), async_mode);
     InferenceProfiler profiler(&manager, profiler_config);
     err = params.binary_search
               ? profiler.ProfileConcurrencyBinary(&manager,

@@ -27,6 +27,16 @@ class MockBackendContext : public BackendContext {
               const std::vector<const InferRequestedOutput*>& outputs,
               RequestRecord* record) override;
 
+  // Async simulation (Options::async_support): the blocking mock runs on
+  // a detached delivery thread and fires `done` from it — the same
+  // "completion arrives on another thread" contract as the gRPC backend.
+  bool SupportsAsync() const override;
+  Error AsyncInfer(const InferOptions& options,
+                   const std::vector<InferInput*>& inputs,
+                   const std::vector<const InferRequestedOutput*>& outputs,
+                   RequestRecord record,
+                   std::function<void(RequestRecord)> done) override;
+
   bool HasPrepared(uint64_t token) const override;
 
  private:
@@ -47,6 +57,11 @@ class MockClientBackend : public ClientBackend {
     int error_every = 0;
     // responses per request (decoupled simulation)
     int responses_per_request = 1;
+    // report SupportsAsync so managers exercise the callback-chain path
+    bool async_support = false;
+    // deliver async completions SYNCHRONOUSLY inside AsyncInfer (models
+    // a fast-fail against a dead server; must not recurse the chain)
+    bool async_complete_inline = false;
     std::string metadata_json =
         R"({"name":"mock","versions":["1"],"platform":"mock",)"
         R"("inputs":[{"name":"IN","datatype":"FP32","shape":[8]}],)"
@@ -113,6 +128,8 @@ class MockClientBackend : public ClientBackend {
   // Infer call carries empty inputs by contract)
   std::atomic<uint64_t> prepared_hits{0};
   std::atomic<uint64_t> empty_input_sends{0};
+  // event-driven issues (AsyncInfer calls)
+  std::atomic<uint64_t> async_issues{0};
   // sequence accounting: per-sequence observed (starts, steps, ended)
   struct SeqStat {
     int starts = 0;
@@ -171,6 +188,39 @@ inline Error MockBackendContext::Infer(
     record->error = "mock injected failure";
     return Error("mock injected failure");
   }
+  return Error::Success();
+}
+
+inline bool MockBackendContext::SupportsAsync() const {
+  return backend_->options_.async_support;
+}
+
+inline Error MockBackendContext::AsyncInfer(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    RequestRecord record, std::function<void(RequestRecord)> done) {
+  (void)inputs;   // may not outlive the call (AsyncInfer contract) —
+  (void)outputs;  // the mock never dereferences request data anyway
+  backend_->async_issues++;
+  if (backend_->options_.async_complete_inline) {
+    // Fast-fail simulation: completion fires on the ISSUING stack, the
+    // way a connect-refused error delivers. The manager's gate must turn
+    // this into a loop, not recursion.
+    record.success = false;
+    record.error = "mock inline failure";
+    record.start_ns = record.end_ns = RequestTimers::Now();
+    done(std::move(record));
+    return Error::Success();
+  }
+  // One in-flight per context is the manager's contract, so touching the
+  // context's seen_tokens_ from the delivery thread stays serialized.
+  std::thread([this, options, record = std::move(record),
+               done = std::move(done)]() mutable {
+    static const std::vector<InferInput*> kNoInputs;
+    static const std::vector<const InferRequestedOutput*> kNoOutputs;
+    Infer(options, kNoInputs, kNoOutputs, &record);
+    done(std::move(record));
+  }).detach();
   return Error::Success();
 }
 

@@ -388,6 +388,16 @@ TEST_CASE("cli: sequence id range rejects malformed and zero-start input") {
   CHECK(!ParseSimple({"--sequence-id-range", "0"}, &p).IsOk());
 }
 
+TEST_CASE("cli: --async/--sync select the issue model") {
+  PAParams p;
+  CHECK(!p.async_mode);
+  CHECK_OK(ParseSimple({"--async"}, &p));
+  CHECK(p.async_mode);
+  PAParams q;
+  CHECK_OK(ParseSimple({"-a", "--sync"}, &q));
+  CHECK(!q.async_mode);
+}
+
 TEST_CASE("cli: malformed numeric flag values fail cleanly across the table") {
   PAParams p;
   CHECK(!ParseSimple({"--batch-size", "abc"}, &p).IsOk());

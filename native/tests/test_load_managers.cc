@@ -62,6 +62,70 @@ TEST_CASE("concurrency: maintains the requested in-flight level") {
   CHECK_EQ(h.mock->context_count.load(), 4);
 }
 
+TEST_CASE("concurrency(async): chains maintain the in-flight level") {
+  MockClientBackend::Options options;
+  options.latency_us = 5000;
+  options.async_support = true;
+  Harness h(options);
+  ConcurrencyManager manager(h.backend, h.data.get(), h.config, nullptr,
+                             /*async_mode=*/true);
+  manager.ChangeConcurrency(4);
+  SleepMs(150);
+  manager.Stop();
+  CHECK_EQ(h.mock->max_inflight.load(), 4);
+  CHECK(h.mock->request_count.load() > 20);
+  // every request went through the event-driven path, one context/chain
+  CHECK_EQ(h.mock->async_issues.load(), h.mock->request_count.load());
+  CHECK_EQ(h.mock->context_count.load(), 4);
+  // Stop() drained: every issued request was recorded, all successes
+  auto records = manager.SwapRecords();
+  CHECK_EQ(records.size(), h.mock->request_count.load());
+  for (const auto& r : records) {
+    CHECK(r.success);
+    CHECK(r.end_ns >= r.start_ns);
+  }
+}
+
+TEST_CASE("concurrency(async): inline fast-fail completions do not recurse") {
+  MockClientBackend::Options options;
+  options.async_support = true;
+  options.async_complete_inline = true;  // dead-server simulation
+  Harness h(options);
+  ConcurrencyManager manager(h.backend, h.data.get(), h.config, nullptr,
+                             /*async_mode=*/true);
+  manager.ChangeConcurrency(2);
+  // Each chain spins thousands of inline failures; with recursion this
+  // overflows the stack long before the sleep ends.
+  SleepMs(50);
+  manager.Stop();
+  CHECK(h.mock->async_issues.load() > 1000);
+  auto records = manager.SwapRecords();
+  CHECK_EQ(records.size(), h.mock->async_issues.load());
+  for (size_t i = 0; i < std::min<size_t>(records.size(), 5); ++i) {
+    CHECK(!records[i].success);
+  }
+}
+
+TEST_CASE("concurrency(async): reconfigure up and down") {
+  MockClientBackend::Options options;
+  options.latency_us = 2000;
+  options.async_support = true;
+  Harness h(options);
+  ConcurrencyManager manager(h.backend, h.data.get(), h.config, nullptr,
+                             /*async_mode=*/true);
+  manager.ChangeConcurrency(2);
+  SleepMs(60);
+  manager.ChangeConcurrency(6);
+  SleepMs(100);
+  CHECK_EQ(h.mock->max_inflight.load(), 6);
+  manager.ChangeConcurrency(1);
+  SleepMs(40);  // surplus chains drain their in-flight request
+  h.mock->max_inflight.store(0);
+  SleepMs(80);
+  CHECK_EQ(h.mock->max_inflight.load(), 1);
+  manager.Stop();
+}
+
 TEST_CASE("concurrency: reconfigure up and down") {
   MockClientBackend::Options options;
   options.latency_us = 2000;
