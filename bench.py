@@ -190,13 +190,33 @@ def _bench_inprocess(server) -> float:
 def main() -> int:
     from tools.bench_common import REEXEC_SENTINEL, device_platform, reexec_on_cpu
 
-    if not device_platform() and REEXEC_SENTINEL not in os.environ:
+    platform = device_platform()
+    if not platform and REEXEC_SENTINEL not in os.environ:
         print(
             "bench: default jax platform unusable (TPU relay stuck?); "
             "re-executing on CPU",
             file=sys.stderr,
         )
         reexec_on_cpu([__file__])
+    relay_unavailable = not platform or REEXEC_SENTINEL in os.environ
+
+    if platform == "tpu" and not os.environ.get("BENCH_NO_ZOO"):
+        # A healthy relay window is rare — capture the on-device zoo rows
+        # (BASELINE.json published['tpu']) the moment one exists, before
+        # the headline run. Failures here must not cost the headline.
+        print("bench: TPU relay healthy; capturing device zoo rows",
+              file=sys.stderr)
+        try:
+            subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "tools", "bench_zoo.py"),
+                 "--update-baseline", "--perf-md"],
+                timeout=2400,
+                check=True,
+            )
+        except Exception as e:  # noqa: BLE001 - zoo capture is best-effort
+            print(f"bench: zoo capture failed: {e}", file=sys.stderr)
 
     from client_tpu.testing import InProcessServer
 
@@ -275,6 +295,11 @@ def main() -> int:
     if inproc > 0:
         line["inproc_infer_per_sec"] = round(inproc, 2)
         line["ratio_vs_inproc"] = round(value / inproc, 3)
+        line["ratio_caveat"] = (
+            f"client, server wire threads, and model share {os.cpu_count()} "
+            "cpu core(s): ratio_vs_inproc is a relative tracker on a "
+            "contended host, not an isolated-server measurement"
+        )
     if shm_throughput > 0:
         line["tpu_shm_infer_per_sec"] = round(shm_throughput, 2)
     # CPU attribution of the client/server split for the headline run
@@ -286,7 +311,15 @@ def main() -> int:
         line["server_cpu_us_per_req"] = round(server_cpu / count * 1e6, 1)
     if inproc > 0:
         line["inproc_us_per_req"] = round(1e6 / inproc, 1)
+    # Contention caveat: with few cores the client, server wire threads,
+    # and model share the core budget, so ratio_vs_inproc is a relative
+    # tracker, not an isolated-server measurement (PERF.md round 5).
     line["ncpus"] = os.cpu_count()
+    # Machine-readable device provenance: the judge/driver can tell a CPU
+    # fallback row from a real on-device row without parsing stderr.
+    line["device"] = platform or "cpu"
+    if relay_unavailable:
+        line["relay_unavailable"] = True
     print(json.dumps(line))
     return 0
 

@@ -357,25 +357,39 @@ struct ChannelCache {
   std::shared_ptr<h2::Connection> Acquire(const std::string& key,
                                           const std::string& host, int port,
                                           std::string* err) {
-    std::lock_guard<std::mutex> lk(mu);
-    auto& entries = by_url[key];
-    // Drop dead connections no longer used by anyone.
-    entries.erase(std::remove_if(entries.begin(), entries.end(),
-                                 [](const Entry& e) {
-                                   return !e.conn->alive() && e.users == 0;
-                                 }),
-                  entries.end());
-    for (auto& e : entries) {
-      if (e.conn->alive() && e.users < ChannelMaxShare()) {
-        e.users++;
-        return e.conn;
+    // Dead unused connections collected under the lock, released outside
+    // it via the callback-safe path: Acquire can run on a reader thread
+    // (async reconnect), where dropping a last reference would self-join.
+    std::vector<std::shared_ptr<h2::Connection>> doomed;
+    std::shared_ptr<h2::Connection> result;
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      auto& entries = by_url[key];
+      for (auto it = entries.begin(); it != entries.end();) {
+        if (!it->conn->alive() && it->users == 0) {
+          doomed.push_back(std::move(it->conn));
+          it = entries.erase(it);
+        } else {
+          ++it;
+        }
+      }
+      for (auto& e : entries) {
+        if (e.conn->alive() && e.users < ChannelMaxShare()) {
+          e.users++;
+          result = e.conn;
+          break;
+        }
+      }
+      if (result == nullptr) {
+        result = std::shared_ptr<h2::Connection>(
+            h2::Connection::Connect(host, port, err).release());
+        if (result != nullptr) entries.push_back(Entry{result, 1});
       }
     }
-    auto conn = std::shared_ptr<h2::Connection>(
-        h2::Connection::Connect(host, port, err).release());
-    if (conn == nullptr) return nullptr;
-    entries.push_back(Entry{conn, 1});
-    return conn;
+    for (auto& c : doomed) {
+      h2::Connection::ReleaseFromCallback(std::move(c));
+    }
+    return result;
   }
 
   void Release(const std::string& key,
@@ -430,6 +444,11 @@ InferenceServerGrpcClient::~InferenceServerGrpcClient() {
   if (conn_ != nullptr && shared_channel_) {
     Cache().Release(host_ + ":" + std::to_string(port_), conn_);
   }
+  // The client may be destroyed from inside a stream callback (async
+  // backends drop a dead client on the delivery thread); if conn_ is the
+  // last reference, a plain member-destruction would self-join the
+  // reader thread.
+  h2::Connection::ReleaseFromCallback(std::move(conn_));
 }
 
 std::shared_ptr<h2::Connection> InferenceServerGrpcClient::Conn() {
@@ -463,8 +482,10 @@ Error InferenceServerGrpcClient::EnsureConnection() {
   const std::string key = host_ + ":" + std::to_string(port_);
   if (conn_ != nullptr && shared_channel_) {
     Cache().Release(key, conn_);  // dead shared connection: drop our claim
-    conn_ = nullptr;
   }
+  // Reconnects can run inside a stream callback (async re-issue on the
+  // reader thread); releasing the last reference there would self-join.
+  h2::Connection::ReleaseFromCallback(std::move(conn_));
   if (ChannelMaxShare() > 0) {
     conn_ = Cache().Acquire(key, host_, port_, &err);
     shared_channel_ = conn_ != nullptr;
@@ -937,11 +958,11 @@ Error InferenceServerGrpcClient::AsyncInfer(
     const std::vector<const InferRequestedOutput*>& outputs,
     const Headers& headers) {
   if (!callback) return Error("callback is required for AsyncInfer");
-  CTPU_RETURN_IF_ERROR(EnsureConnection());
   inference::ModelInferRequest request;
   CTPU_RETURN_IF_ERROR(FillInferRequest(options, inputs, outputs, &request));
   // A fresh body always carries compressed-flag byte 0, so the framed
-  // path's compress-on-send applies exactly as it would here.
+  // path's compress-on-send applies exactly as it would here (and it
+  // performs the EnsureConnection).
   return AsyncInferFramed(std::move(callback), FrameMessage(request),
                           options.client_timeout_us, headers);
 }

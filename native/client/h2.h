@@ -50,6 +50,22 @@ class Connection {
                                              std::string* err);
   ~Connection();
 
+  // Drops a reference safely from ANY thread, including the connection's
+  // own reader thread (i.e. from inside a stream callback). ~Connection
+  // joins the reader thread, so releasing the LAST reference on that
+  // thread would self-join and std::terminate; this helper hands the
+  // final release to a detached disposer thread in that case. Callers on
+  // teardown/reconnect paths that may run inside callbacks must use this
+  // instead of plain reset()/reassignment.
+  static void ReleaseFromCallback(std::shared_ptr<Connection> conn) {
+    if (conn == nullptr) return;
+    if (std::this_thread::get_id() == conn->reader_.get_id()) {
+      std::thread([c = std::move(conn)]() mutable { c.reset(); }).detach();
+    } else {
+      conn.reset();
+    }
+  }
+
   // Opens a stream by sending a HEADERS frame. Returns the stream id, or -1
   // if the connection is dead. Events fire on the reader thread.
   int32_t StartStream(const std::vector<hpack::Header>& headers,

@@ -237,10 +237,13 @@ Error GrpcBackendContext::AsyncInfer(
                  "multiplexes on one stream)");
   }
   // The completion callback runs on the connection's reader thread; it
-  // owns the record from here.
+  // owns the record from here. `done` lives behind a shared_ptr because
+  // BOTH the delivery lambda and the synchronous issue-failure path below
+  // need it (exactly one of them ever runs).
   auto shared_record = std::make_shared<RequestRecord>(std::move(record));
-  auto on_done = [shared_record,
-                  done = std::move(done)](InferResult* raw) mutable {
+  auto done_fn = std::make_shared<std::function<void(RequestRecord)>>(
+      std::move(done));
+  auto on_done = [shared_record, done_fn](InferResult* raw) {
     RequestRecord rec = std::move(*shared_record);
     rec.end_ns = RequestTimers::Now();
     rec.response_ns.push_back(rec.end_ns);
@@ -252,7 +255,7 @@ Error GrpcBackendContext::AsyncInfer(
     } else {
       rec.success = true;
     }
-    done(std::move(rec));
+    (*done_fn)(std::move(rec));
   };
   shared_record->start_ns = RequestTimers::Now();
   // Same prepared-body resolution as the blocking path.
@@ -283,7 +286,7 @@ Error GrpcBackendContext::AsyncInfer(
     rec.error = err.Message();
     rec.end_ns = RequestTimers::Now();
     client_.reset();
-    done(std::move(rec));
+    (*done_fn)(std::move(rec));
   }
   return Error::Success();
 }

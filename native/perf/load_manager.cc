@@ -109,7 +109,7 @@ Error LoadManager::IssueOneAsync(BackendContext* ctx, size_t slot,
 
 void ConcurrencyManager::AsyncIssueNext(std::shared_ptr<AsyncSlot> slot) {
   for (;;) {
-    if (stopping_.load() || !slot->active->load()) {
+    if (stopping_.load() || !slot->active.load()) {
       std::lock_guard<std::mutex> lk(async_mu_);
       async_inflight_--;
       async_cv_.notify_all();
@@ -148,7 +148,7 @@ void ConcurrencyManager::ChangeConcurrency(size_t concurrency) {
     // way) — otherwise stragglers from the higher level would be
     // recorded inside the next level's measurement window.
     while (async_slots_.size() > concurrency) {
-      async_slots_.back()->active->store(false);
+      async_slots_.back()->active.store(false);
       async_slots_.pop_back();
     }
     {
@@ -165,7 +165,6 @@ void ConcurrencyManager::ChangeConcurrency(size_t concurrency) {
     while (async_slots_.size() < concurrency) {
       auto slot = std::make_shared<AsyncSlot>();
       slot->ctx = backend_->CreateContext();
-      slot->active = std::make_shared<std::atomic<bool>>(true);
       slot->slot_id = async_slots_.size();
       async_slots_.push_back(slot);
       {
@@ -209,7 +208,7 @@ void ConcurrencyManager::WorkerLoop(
 void ConcurrencyManager::Stop() {
   stopping_.store(true);
   if (async_mode_) {
-    for (auto& s : async_slots_) s->active->store(false);
+    for (auto& s : async_slots_) s->active.store(false);
     // Wait for every chain's in-flight request to drain (each decrements
     // async_inflight_ exactly once on its way out). Unbounded, matching
     // the sync path's thread join: a request that never completes hangs

@@ -170,7 +170,9 @@ class ConcurrencyManager : public LoadManager {
   // recursing toward stack overflow.
   struct AsyncSlot {
     std::unique_ptr<BackendContext> ctx;
-    std::shared_ptr<std::atomic<bool>> active;
+    // Plain member (unlike Worker's shared flag): the chain lambda holds
+    // the AsyncSlot shared_ptr, which is lifetime enough.
+    std::atomic<bool> active{true};
     std::atomic<int> gate{0};
     size_t slot_id = 0;
     size_t step = 0;
