@@ -48,6 +48,17 @@ def main(argv=None) -> int:
         help="gRPC front-end implementation: 'native' (C++ h2 server, the "
         "fast path), 'aio' (grpc.aio), 'auto' = native when built",
     )
+    parser.add_argument(
+        "--grpc-tls-cert",
+        default=None,
+        help="PEM certificate chain: the native gRPC front-end terminates "
+        "TLS itself (grpcs, ALPN h2); requires --grpc-tls-key",
+    )
+    parser.add_argument(
+        "--grpc-tls-key",
+        default=None,
+        help="PEM private key for --grpc-tls-cert",
+    )
     args = parser.parse_args(argv)
 
     if args.platform:
@@ -81,9 +92,17 @@ def main(argv=None) -> int:
             from client_tpu.server.native_frontend import serve_grpc_native
 
             native_frontend, grpc_port = await serve_grpc_native(
-                core, args.host, args.grpc_port
+                core,
+                args.host,
+                args.grpc_port,
+                tls_cert=args.grpc_tls_cert,
+                tls_key=args.grpc_tls_key,
             )
         else:
+            if args.grpc_tls_cert:
+                raise SystemExit(
+                    "--grpc-tls-cert requires the native gRPC front-end"
+                )
             from client_tpu.server.grpc_server import serve_grpc
 
             grpc_server, grpc_port = await serve_grpc(

@@ -116,8 +116,19 @@ class NativeGrpcFrontend:
 
     # -- lifecycle -----------------------------------------------------------
 
-    def start(self, host: str = "0.0.0.0", port: int = 0) -> None:
-        self._id = self._lib.start(host, port, self._rpc, self._cancel)
+    def start(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        tls_cert: Optional[str] = None,
+        tls_key: Optional[str] = None,
+    ) -> None:
+        """Bind + serve. With ``tls_cert``/``tls_key`` (PEM paths) the
+        C++ listener terminates TLS itself (ALPN h2) — grpcs clients
+        connect directly, no fronting proxy needed."""
+        self._id = self._lib.start(
+            host, port, self._rpc, self._cancel, tls_cert, tls_key
+        )
         self.port = self._lib.port(self._id)
         self._pump = threading.Thread(
             target=self._pump_loop, name="ctpu-grpc-pump", daemon=True
@@ -483,13 +494,18 @@ class NativeGrpcFrontend:
 
 
 async def serve_grpc_native(
-    core: ServerCore, host: str = "0.0.0.0", port: int = 8001
+    core: ServerCore,
+    host: str = "0.0.0.0",
+    port: int = 8001,
+    tls_cert: Optional[str] = None,
+    tls_key: Optional[str] = None,
 ):
     """Start the native gRPC front-end; returns (frontend, bound_port).
 
     Signature mirrors grpc_server.serve_grpc so callers can switch
-    implementations; `frontend.stop()` is synchronous.
+    implementations; `frontend.stop()` is synchronous. TLS termination
+    (grpcs) is enabled by passing PEM cert/key paths.
     """
     frontend = NativeGrpcFrontend(core, asyncio.get_running_loop())
-    frontend.start(host, port)
+    frontend.start(host, port, tls_cert=tls_cert, tls_key=tls_key)
     return frontend, frontend.port

@@ -356,6 +356,7 @@ struct ChannelCache {
   // Returns a cached (or new) connection and counts `who` as a user.
   std::shared_ptr<h2::Connection> Acquire(const std::string& key,
                                           const std::string& host, int port,
+                                          const tls::ClientOptions* ssl,
                                           std::string* err) {
     // Dead unused connections collected under the lock, released outside
     // it via the callback-safe path: Acquire can run on a reader thread
@@ -382,7 +383,7 @@ struct ChannelCache {
       }
       if (result == nullptr) {
         result = std::shared_ptr<h2::Connection>(
-            h2::Connection::Connect(host, port, err).release());
+            h2::Connection::Connect(host, port, err, ssl).release());
         if (result != nullptr) entries.push_back(Entry{result, 1});
       }
     }
@@ -411,22 +412,50 @@ ChannelCache& Cache() {
   return *cache;
 }
 
+// TLS configs must not share a cleartext (or differently-configured)
+// connection, so the cache key carries the TLS identity.
+std::string ChannelKey(const std::string& host, int port, bool use_ssl,
+                       const SslOptions& ssl) {
+  std::string key = host + ":" + std::to_string(port);
+  if (use_ssl) {
+    key += "|tls|" + ssl.root_certificates + "|" + ssl.certificate_chain;
+  }
+  return key;
+}
+
 }  // namespace
 
 Error InferenceServerGrpcClient::Create(
     std::unique_ptr<InferenceServerGrpcClient>* client, const std::string& url,
     bool verbose, const KeepAliveOptions& keepalive) {
+  const bool scheme_ssl = url.rfind("grpcs://", 0) == 0;
+  return Create(client, url, verbose, scheme_ssl, SslOptions{}, keepalive);
+}
+
+Error InferenceServerGrpcClient::Create(
+    std::unique_ptr<InferenceServerGrpcClient>* client, const std::string& url,
+    bool verbose, bool use_ssl, const SslOptions& ssl_options,
+    const KeepAliveOptions& keepalive) {
   std::string rest = url;
   const size_t scheme = rest.find("://");
   if (scheme != std::string::npos) rest = rest.substr(scheme + 3);
+  if (url.rfind("grpcs://", 0) == 0) use_ssl = true;
   const size_t colon = rest.rfind(':');
   if (colon == std::string::npos) {
     return Error("expected <host>:<port> gRPC url, got " + url);
   }
   const std::string host = rest.substr(0, colon);
   const int port = atoi(rest.c_str() + colon + 1);
+  if (use_ssl) {
+    std::string tls_err;
+    if (!tls::TlsAvailable(&tls_err)) {
+      return Error("TLS requested but unavailable: " + tls_err);
+    }
+  }
   client->reset(
       new InferenceServerGrpcClient(host, port, verbose, keepalive));
+  (*client)->use_ssl_ = use_ssl;
+  (*client)->ssl_options_ = ssl_options;
   return Error::Success();
 }
 
@@ -442,7 +471,7 @@ InferenceServerGrpcClient::~InferenceServerGrpcClient() {
   StopStream();
   std::lock_guard<std::mutex> lk(conn_mu_);
   if (conn_ != nullptr && shared_channel_) {
-    Cache().Release(host_ + ":" + std::to_string(port_), conn_);
+    Cache().Release(ChannelKey(host_, port_, use_ssl_, ssl_options_), conn_);
   }
   // The client may be destroyed from inside a stream callback (async
   // backends drop a dead client on the delivery thread); if conn_ is the
@@ -479,7 +508,15 @@ Error InferenceServerGrpcClient::EnsureConnection() {
   std::lock_guard<std::mutex> lk(conn_mu_);
   if (conn_ && conn_->alive()) return Error::Success();
   std::string err;
-  const std::string key = host_ + ":" + std::to_string(port_);
+  tls::ClientOptions tls_options;
+  const tls::ClientOptions* ssl = nullptr;
+  if (use_ssl_) {
+    tls_options.root_certificates = ssl_options_.root_certificates;
+    tls_options.private_key = ssl_options_.private_key;
+    tls_options.certificate_chain = ssl_options_.certificate_chain;
+    ssl = &tls_options;
+  }
+  const std::string key = ChannelKey(host_, port_, use_ssl_, ssl_options_);
   if (conn_ != nullptr && shared_channel_) {
     Cache().Release(key, conn_);  // dead shared connection: drop our claim
   }
@@ -487,11 +524,11 @@ Error InferenceServerGrpcClient::EnsureConnection() {
   // reader thread); releasing the last reference there would self-join.
   h2::Connection::ReleaseFromCallback(std::move(conn_));
   if (ChannelMaxShare() > 0) {
-    conn_ = Cache().Acquire(key, host_, port_, &err);
+    conn_ = Cache().Acquire(key, host_, port_, ssl, &err);
     shared_channel_ = conn_ != nullptr;
   } else {
     conn_ = std::shared_ptr<h2::Connection>(
-        h2::Connection::Connect(host_, port_, &err).release());
+        h2::Connection::Connect(host_, port_, &err, ssl).release());
     shared_channel_ = false;
   }
   if (!conn_) return Error("gRPC connect failed: " + err);
@@ -510,7 +547,7 @@ std::vector<hpack::Header> InferenceServerGrpcClient::BuildHeaders(
     uint64_t timeout_us) {
   std::vector<hpack::Header> headers = {
       {":method", "POST"},
-      {":scheme", "http"},
+      {":scheme", use_ssl_ ? "https" : "http"},
       {":path", kServicePrefix + method},
       {":authority", host_ + ":" + std::to_string(port_)},
       {"content-type", "application/grpc"},

@@ -76,17 +76,32 @@ struct KeepAliveOptions {
   bool keepalive_permit_without_calls = false;
 };
 
+// TLS configuration (reference grpc_client.h:43-60 SslOptions): PEM file
+// paths; empty root_certificates = system default roots; private_key +
+// certificate_chain enable mutual TLS.
+struct SslOptions {
+  std::string root_certificates;
+  std::string private_key;
+  std::string certificate_chain;
+};
+
 class InferenceServerGrpcClient : public InferenceServerClient {
  public:
   using OnCompleteFn = std::function<void(InferResult*)>;
   using OnMultiCompleteFn = std::function<void(std::vector<InferResult*>*)>;
 
-  // url is "host:port" (no scheme) or "grpc://host:port". Cleartext h2c.
+  // url is "host:port" (no scheme) or "grpc://host:port" — cleartext h2c;
+  // "grpcs://host:port" or use_ssl = true selects TLS (ALPN h2).
   // Keepalive (when enabled) applies to the connection this client ends
   // up using — note shared channels (CTPU_GRPC_CHANNEL_MAX_SHARE_COUNT)
   // adopt the FIRST enabling client's settings.
   static Error Create(std::unique_ptr<InferenceServerGrpcClient>* client,
                       const std::string& url, bool verbose = false,
+                      const KeepAliveOptions& keepalive = {});
+  // TLS variant (reference grpc_client.h Create-with-SslOptions).
+  static Error Create(std::unique_ptr<InferenceServerGrpcClient>* client,
+                      const std::string& url, bool verbose, bool use_ssl,
+                      const SslOptions& ssl_options,
                       const KeepAliveOptions& keepalive = {});
   ~InferenceServerGrpcClient() override;
 
@@ -244,6 +259,8 @@ class InferenceServerGrpcClient : public InferenceServerClient {
   std::string host_;
   int port_ = 0;
   KeepAliveOptions keepalive_;
+  bool use_ssl_ = false;
+  SslOptions ssl_options_;
   std::string compression_;  // "" = none; "deflate" | "gzip"
 
   std::mutex conn_mu_;

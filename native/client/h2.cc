@@ -66,9 +66,20 @@ uint32_t GetU32(const uint8_t* p) {
 }  // namespace
 
 std::unique_ptr<Connection> Connection::Connect(const std::string& host,
-                                                int port, std::string* err) {
+                                                int port, std::string* err,
+                                                const tls::ClientOptions* ssl) {
   int fd = DialTcp(host, port, 0, err);
   if (fd < 0) return nullptr;
+  if (ssl != nullptr) {
+    // The TLS pump owns the TCP fd; the connection runs over the pump's
+    // plaintext end, so the h2 threading below never touches the SSL
+    // session (see tls.h).
+    tls::ClientOptions options = *ssl;
+    if (options.host.empty()) options.host = host;
+    options.alpn = "h2";
+    fd = tls::WrapClient(fd, options, err);
+    if (fd < 0) return nullptr;
+  }
   std::unique_ptr<Connection> conn(new Connection());
   conn->fd_ = fd;
   // Client preface + initial SETTINGS + connection window top-up, one write.

@@ -24,6 +24,8 @@
 #include <thread>
 #include <vector>
 
+#include "tls.h"
+
 #include "hpack.h"
 
 namespace ctpu {
@@ -44,10 +46,11 @@ struct StreamEvents {
 
 class Connection {
  public:
-  // Establishes TCP + HTTP/2 preface. Returns nullptr and sets *err on
-  // failure.
-  static std::unique_ptr<Connection> Connect(const std::string& host, int port,
-                                             std::string* err);
+  // Establishes TCP (+ optional TLS with ALPN "h2") + HTTP/2 preface.
+  // Returns nullptr and sets *err on failure.
+  static std::unique_ptr<Connection> Connect(
+      const std::string& host, int port, std::string* err,
+      const tls::ClientOptions* ssl = nullptr);
   ~Connection();
 
   // Drops a reference safely from ANY thread, including the connection's

@@ -64,6 +64,14 @@ Error HttpConnection::Connect(int64_t timeout_us) {
   fd_ = DialTcp(host_, port_, timeout_us, &err);
   buf_.clear();
   if (fd_ < 0) return Error(err);
+  if (use_tls_) {
+    tls::ClientOptions options = tls_;
+    if (options.host.empty()) options.host = host_;
+    // The caller's connect budget covers the handshake too.
+    if (timeout_us > 0) options.handshake_timeout_ms = timeout_us / 1000;
+    fd_ = tls::WrapClient(fd_, options, &err);
+    if (fd_ < 0) return Error("https connect failed: " + err);
+  }
   return Error::Success();
 }
 
@@ -347,26 +355,55 @@ Error InferResultHttp::RawData(const std::string& output_name,
 
 Error InferenceServerHttpClient::Create(
     std::unique_ptr<InferenceServerHttpClient>* client, const std::string& url,
-    bool verbose, size_t async_workers) {
-  size_t colon = url.rfind(':');
+    bool verbose, size_t async_workers, const HttpSslOptions& ssl_options) {
+  const bool use_tls = url.rfind("https://", 0) == 0;
+  std::string rest = url;
+  const size_t scheme = rest.find("://");
+  if (scheme != std::string::npos) rest = rest.substr(scheme + 3);
+  size_t colon = rest.rfind(':');
   if (colon == std::string::npos) {
     return Error("url must be host:port, got '" + url + "'");
   }
-  std::string host = url.substr(0, colon);
-  int port = std::atoi(url.c_str() + colon + 1);
-  client->reset(
-      new InferenceServerHttpClient(host, port, verbose, async_workers));
+  std::string host = rest.substr(0, colon);
+  int port = std::atoi(rest.c_str() + colon + 1);
+  if (use_tls) {
+    if (ssl_options.cert_type != HttpSslOptions::CERT_PEM ||
+        ssl_options.key_type != HttpSslOptions::KEY_PEM) {
+      return Error("only PEM certificates/keys are supported");
+    }
+    std::string tls_err;
+    if (!tls::TlsAvailable(&tls_err)) {
+      return Error("https requested but TLS unavailable: " + tls_err);
+    }
+  }
+  tls::ClientOptions tls;
+  if (use_tls) {
+    tls.root_certificates = ssl_options.ca_info;
+    tls.certificate_chain = ssl_options.cert;
+    tls.private_key = ssl_options.key;
+    tls.verify_peer = ssl_options.verify_peer != 0;
+    tls.verify_host = ssl_options.verify_host != 0;
+    tls.host = host;
+  }
+  client->reset(new InferenceServerHttpClient(
+      host, port, verbose, async_workers, use_tls ? &tls : nullptr));
   return Error::Success();
 }
 
-InferenceServerHttpClient::InferenceServerHttpClient(std::string host,
-                                                     int port, bool verbose,
-                                                     size_t async_workers)
+InferenceServerHttpClient::InferenceServerHttpClient(
+    std::string host, int port, bool verbose, size_t async_workers,
+    const tls::ClientOptions* tls)
     : InferenceServerClient(verbose),
       host_(std::move(host)),
       port_(port),
       control_conn_(host_, port),
       infer_conn_(host_, port) {
+  if (tls != nullptr) {
+    use_tls_ = true;
+    tls_ = *tls;
+    control_conn_.SetTls(tls_);
+    infer_conn_.SetTls(tls_);
+  }
   for (size_t i = 0; i < async_workers; ++i) {
     workers_.emplace_back(&InferenceServerHttpClient::AsyncWorker, this);
   }
@@ -811,6 +848,7 @@ Error InferenceServerHttpClient::AsyncInfer(
 
 void InferenceServerHttpClient::AsyncWorker() {
   HttpConnection conn(host_, port_);
+  if (use_tls_) conn.SetTls(tls_);
   while (true) {
     AsyncJob job;
     {

@@ -27,6 +27,7 @@
 
 #include "common.h"
 #include "json.h"
+#include "tls.h"
 
 namespace ctpu {
 
@@ -35,6 +36,14 @@ class HttpConnection {
  public:
   HttpConnection(std::string host, int port) : host_(std::move(host)), port_(port) {}
   ~HttpConnection() { Close(); }
+
+  // Enable TLS for subsequent Connect()s (see native/client/tls.h: the
+  // socket is wrapped by a pump thread; this class keeps talking
+  // plaintext to the pump's socketpair end).
+  void SetTls(const tls::ClientOptions& options) {
+    tls_ = options;
+    use_tls_ = true;
+  }
 
   // (Re)establish the TCP connection (TCP_NODELAY set).
   Error Connect(int64_t timeout_us = 0);
@@ -70,6 +79,8 @@ class HttpConnection {
   int fd_ = -1;
   int64_t deadline_ns_ = 0;  // absolute steady-clock deadline, 0 = none
   std::string buf_;          // unconsumed read-ahead
+  tls::ClientOptions tls_;
+  bool use_tls_ = false;
 };
 
 // Parsed HTTP headers of interest.
@@ -114,13 +125,30 @@ class InferResultHttp : public InferResult {
 
 using OnCompleteFn = std::function<void(InferResult*)>;
 
+// TLS configuration (reference http_client.h:45-100 HttpSslOptions,
+// libcurl semantics): verify_peer/verify_host toggles, CA bundle path,
+// client certificate + key for mutual TLS. Only PEM files are supported
+// (CERT_DER/KEY_DER return an error, like a curl built without DER).
+struct HttpSslOptions {
+  enum CERTTYPE { CERT_PEM = 0, CERT_DER = 1 };
+  enum KEYTYPE { KEY_PEM = 0, KEY_DER = 1 };
+  long verify_peer = 1;
+  long verify_host = 2;
+  std::string ca_info;
+  CERTTYPE cert_type = CERT_PEM;
+  std::string cert;
+  KEYTYPE key_type = KEY_PEM;
+  std::string key;
+};
+
 class InferenceServerHttpClient : public InferenceServerClient {
  public:
-  // url is "host:port" (no scheme; TLS is not supported by this build —
-  // the reference gates HTTPS behind libcurl options, http_client.h:45).
+  // url is "host:port" or "http://host:port" (cleartext); an
+  // "https://host:port" url selects TLS configured by `ssl_options`.
   static Error Create(std::unique_ptr<InferenceServerHttpClient>* client,
                       const std::string& url, bool verbose = false,
-                      size_t async_workers = 4);
+                      size_t async_workers = 4,
+                      const HttpSslOptions& ssl_options = {});
   ~InferenceServerHttpClient() override;
 
   Error IsServerLive(bool* live);
@@ -181,8 +209,11 @@ class InferenceServerHttpClient : public InferenceServerClient {
                                  std::string&& body, size_t header_length);
 
  private:
+  // `tls` non-null enables HTTPS on every connection; copied before the
+  // async workers spawn (they each build a connection at thread start).
   InferenceServerHttpClient(std::string host, int port, bool verbose,
-                            size_t async_workers);
+                            size_t async_workers,
+                            const tls::ClientOptions* tls = nullptr);
 
   Error Get(const std::string& uri, int* status, std::string* body);
   Error Post(const std::string& uri, const std::string& body, int* status,
@@ -200,6 +231,8 @@ class InferenceServerHttpClient : public InferenceServerClient {
 
   std::string host_;
   int port_;
+  bool use_tls_ = false;
+  tls::ClientOptions tls_;  // applied to every connection when use_tls_
 
   std::mutex mu_;                 // guards control connection + stats
   HttpConnection control_conn_;   // health/metadata/control requests

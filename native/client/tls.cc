@@ -1,0 +1,588 @@
+// OpenSSL-backed TLS pump (see tls.h for the design rationale).
+//
+// The libssl subset used here is declared locally because the image has
+// no OpenSSL development headers. Every prototype and constant below is
+// part of OpenSSL 3's stable public ABI (libssl.so.3 / libcrypto.so.3);
+// symbols are resolved at runtime with dlopen/dlsym, so a host without
+// the runtime degrades to TlsAvailable() == false instead of a link
+// failure.
+#include "tls.h"
+
+#include <dlfcn.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <ctime>
+#include <map>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace ctpu {
+namespace tls {
+
+namespace {
+
+// -- OpenSSL 3 ABI subset ----------------------------------------------------
+
+typedef struct ssl_ctx_st SSL_CTX;
+typedef struct ssl_st SSL;
+typedef struct ssl_method_st SSL_METHOD;
+
+constexpr int kSslErrorWantRead = 2;
+constexpr int kSslErrorWantWrite = 3;
+constexpr int kSslErrorZeroReturn = 6;
+constexpr int kSslFiletypePem = 1;
+constexpr int kSslVerifyNone = 0;
+constexpr int kSslVerifyPeer = 1;
+constexpr long kSslCtrlSetTlsextHostname = 55;
+constexpr long kTlsextNametypeHostName = 0;
+constexpr long kSslCtrlMode = 33;
+constexpr long kSslModeEnablePartialWrite = 1;
+constexpr long kSslModeAcceptMovingWriteBuffer = 2;
+constexpr long kSslModeAutoRetry = 4;
+constexpr int kSslTlsextErrOk = 0;
+constexpr int kSslTlsextErrAlertFatal = 2;
+constexpr long kX509VOk = 0;
+
+struct Api {
+  void* libssl = nullptr;
+  void* libcrypto = nullptr;
+
+  int (*OPENSSL_init_ssl)(uint64_t, const void*) = nullptr;
+  const SSL_METHOD* (*TLS_client_method)() = nullptr;
+  const SSL_METHOD* (*TLS_server_method)() = nullptr;
+  SSL_CTX* (*SSL_CTX_new)(const SSL_METHOD*) = nullptr;
+  void (*SSL_CTX_free)(SSL_CTX*) = nullptr;
+  long (*SSL_CTX_ctrl)(SSL_CTX*, int, long, void*) = nullptr;
+  void (*SSL_CTX_set_verify)(SSL_CTX*, int, void*) = nullptr;
+  int (*SSL_CTX_set_default_verify_paths)(SSL_CTX*) = nullptr;
+  int (*SSL_CTX_load_verify_locations)(SSL_CTX*, const char*, const char*) =
+      nullptr;
+  int (*SSL_CTX_use_certificate_chain_file)(SSL_CTX*, const char*) = nullptr;
+  int (*SSL_CTX_use_PrivateKey_file)(SSL_CTX*, const char*, int) = nullptr;
+  int (*SSL_CTX_check_private_key)(const SSL_CTX*) = nullptr;
+  int (*SSL_CTX_set_alpn_protos)(SSL_CTX*, const unsigned char*,
+                                 unsigned int) = nullptr;
+  void (*SSL_CTX_set_alpn_select_cb)(
+      SSL_CTX*,
+      int (*)(SSL*, const unsigned char**, unsigned char*,
+              const unsigned char*, unsigned int, void*),
+      void*) = nullptr;
+  SSL* (*SSL_new)(SSL_CTX*) = nullptr;
+  void (*SSL_free)(SSL*) = nullptr;
+  int (*SSL_set_fd)(SSL*, int) = nullptr;
+  int (*SSL_connect)(SSL*) = nullptr;
+  int (*SSL_accept)(SSL*) = nullptr;
+  int (*SSL_read)(SSL*, void*, int) = nullptr;
+  int (*SSL_write)(SSL*, const void*, int) = nullptr;
+  int (*SSL_shutdown)(SSL*) = nullptr;
+  int (*SSL_get_error)(const SSL*, int) = nullptr;
+  long (*SSL_ctrl)(SSL*, int, long, void*) = nullptr;
+  int (*SSL_set1_host)(SSL*, const char*) = nullptr;
+  void (*SSL_get0_alpn_selected)(const SSL*, const unsigned char**,
+                                 unsigned int*) = nullptr;
+  long (*SSL_get_verify_result)(const SSL*) = nullptr;
+  unsigned long (*ERR_get_error)() = nullptr;
+  void (*ERR_error_string_n)(unsigned long, char*, size_t) = nullptr;
+
+  std::string load_error;
+
+  template <typename T>
+  bool Sym(void* lib, const char* name, T* out) {
+    *out = reinterpret_cast<T>(dlsym(lib, name));
+    if (*out == nullptr) {
+      load_error = std::string("missing OpenSSL symbol ") + name;
+      return false;
+    }
+    return true;
+  }
+
+  bool Load() {
+    libssl = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (libssl == nullptr) libssl = dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
+    if (libssl == nullptr) {
+      load_error = "libssl not found (dlopen failed)";
+      return false;
+    }
+    libcrypto = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (libcrypto == nullptr) {
+      libcrypto = dlopen("libcrypto.so", RTLD_NOW | RTLD_GLOBAL);
+    }
+    if (libcrypto == nullptr) {
+      load_error = "libcrypto not found (dlopen failed)";
+      return false;
+    }
+#define CTPU_TLS_SYM(lib, name) \
+  if (!Sym(lib, #name, &name)) return false
+    CTPU_TLS_SYM(libssl, OPENSSL_init_ssl);
+    CTPU_TLS_SYM(libssl, TLS_client_method);
+    CTPU_TLS_SYM(libssl, TLS_server_method);
+    CTPU_TLS_SYM(libssl, SSL_CTX_new);
+    CTPU_TLS_SYM(libssl, SSL_CTX_free);
+    CTPU_TLS_SYM(libssl, SSL_CTX_ctrl);
+    CTPU_TLS_SYM(libssl, SSL_CTX_set_verify);
+    CTPU_TLS_SYM(libssl, SSL_CTX_set_default_verify_paths);
+    CTPU_TLS_SYM(libssl, SSL_CTX_load_verify_locations);
+    CTPU_TLS_SYM(libssl, SSL_CTX_use_certificate_chain_file);
+    CTPU_TLS_SYM(libssl, SSL_CTX_use_PrivateKey_file);
+    CTPU_TLS_SYM(libssl, SSL_CTX_check_private_key);
+    CTPU_TLS_SYM(libssl, SSL_CTX_set_alpn_protos);
+    CTPU_TLS_SYM(libssl, SSL_CTX_set_alpn_select_cb);
+    CTPU_TLS_SYM(libssl, SSL_new);
+    CTPU_TLS_SYM(libssl, SSL_free);
+    CTPU_TLS_SYM(libssl, SSL_set_fd);
+    CTPU_TLS_SYM(libssl, SSL_connect);
+    CTPU_TLS_SYM(libssl, SSL_accept);
+    CTPU_TLS_SYM(libssl, SSL_read);
+    CTPU_TLS_SYM(libssl, SSL_write);
+    CTPU_TLS_SYM(libssl, SSL_shutdown);
+    CTPU_TLS_SYM(libssl, SSL_get_error);
+    CTPU_TLS_SYM(libssl, SSL_ctrl);
+    CTPU_TLS_SYM(libssl, SSL_set1_host);
+    CTPU_TLS_SYM(libssl, SSL_get0_alpn_selected);
+    CTPU_TLS_SYM(libssl, SSL_get_verify_result);
+    CTPU_TLS_SYM(libcrypto, ERR_get_error);
+    CTPU_TLS_SYM(libcrypto, ERR_error_string_n);
+#undef CTPU_TLS_SYM
+    OPENSSL_init_ssl(0, nullptr);
+    return true;
+  }
+};
+
+Api* GetApi() {
+  static Api* api = [] {
+    auto* a = new Api();
+    if (!a->Load()) {
+      // keep load_error; callers check via TlsAvailable
+    }
+    return a;
+  }();
+  return api;
+}
+
+bool ApiReady(std::string* err) {
+  Api* api = GetApi();
+  if (api->SSL_new == nullptr) {
+    if (err != nullptr) *err = api->load_error;
+    return false;
+  }
+  return true;
+}
+
+std::string LastSslError(const char* what) {
+  Api* api = GetApi();
+  char buf[256];
+  unsigned long code = api->ERR_get_error();
+  if (code == 0) return std::string(what);
+  api->ERR_error_string_n(code, buf, sizeof(buf));
+  // drain the rest of the error queue so it can't bleed into later calls
+  while (api->ERR_get_error() != 0) {
+  }
+  return std::string(what) + ": " + buf;
+}
+
+// OpenSSL writes with plain write(), which raises SIGPIPE on a closed
+// peer (the rest of this codebase always sends with MSG_NOSIGNAL).
+// Blocks SIGPIPE for the current thread so SSL_write/SSL_shutdown get
+// EPIPE instead; on scoped use, any SIGPIPE that became pending while
+// blocked is consumed before the mask is restored.
+class SigpipeGuard {
+ public:
+  SigpipeGuard() {
+    sigset_t set;
+    sigemptyset(&set);
+    sigaddset(&set, SIGPIPE);
+    blocked_ = pthread_sigmask(SIG_BLOCK, &set, &old_) == 0 &&
+               !sigismember(&old_, SIGPIPE);
+  }
+  ~SigpipeGuard() {
+    if (!blocked_) return;
+    sigset_t set;
+    sigemptyset(&set);
+    sigaddset(&set, SIGPIPE);
+    struct timespec zero = {0, 0};
+    while (sigtimedwait(&set, nullptr, &zero) > 0) {
+    }
+    pthread_sigmask(SIG_SETMASK, &old_, nullptr);
+  }
+
+ private:
+  sigset_t old_;
+  bool blocked_ = false;
+};
+
+// ALPN wire format: length-prefixed protocol list.
+std::vector<unsigned char> AlpnWire(const std::string& proto) {
+  std::vector<unsigned char> wire;
+  wire.push_back(static_cast<unsigned char>(proto.size()));
+  wire.insert(wire.end(), proto.begin(), proto.end());
+  return wire;
+}
+
+// -- the pump ----------------------------------------------------------------
+
+// Owns the SSL session and the encrypted fd; shuttles bytes between them
+// and the plaintext socketpair end until either side closes. ALL SSL
+// calls happen on this one thread (SSL objects are not thread-safe).
+void PumpLoop(Api* api, SSL* ssl, int tls_fd, int plain_fd) {
+  SigpipeGuard sigpipe;  // whole-thread scope: the pump owns this thread
+  // Nonblocking TLS side; the plaintext side stays blocking (its peer is
+  // the in-process h2 reader/writer, which drains promptly).
+  fcntl(tls_fd, F_SETFL, fcntl(tls_fd, F_GETFL, 0) | O_NONBLOCK);
+  std::vector<char> outbuf;  // plaintext bytes pending SSL_write
+  size_t out_off = 0;
+  bool want_tls_write = false;
+  char buf[32 * 1024];
+  for (;;) {
+    struct pollfd fds[2];
+    fds[0].fd = tls_fd;
+    fds[0].events = static_cast<short>(POLLIN | (want_tls_write ? POLLOUT : 0));
+    fds[0].revents = 0;
+    fds[1].fd = plain_fd;
+    fds[1].events = static_cast<short>(outbuf.empty() ? POLLIN : 0);
+    fds[1].revents = 0;
+    if (poll(fds, 2, -1) < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    want_tls_write = false;
+    // TLS -> plaintext
+    if (fds[0].revents & (POLLIN | POLLHUP | POLLERR | POLLOUT)) {
+      for (;;) {
+        int n = api->SSL_read(ssl, buf, sizeof(buf));
+        if (n > 0) {
+          const char* p = buf;
+          size_t left = static_cast<size_t>(n);
+          while (left > 0) {
+            ssize_t w = ::send(plain_fd, p, left, MSG_NOSIGNAL);
+            if (w < 0 && errno == EINTR) continue;
+            if (w <= 0) goto done;
+            p += w;
+            left -= static_cast<size_t>(w);
+          }
+          continue;
+        }
+        int e = api->SSL_get_error(ssl, n);
+        if (e == kSslErrorWantRead) break;
+        if (e == kSslErrorWantWrite) {
+          want_tls_write = true;
+          break;
+        }
+        goto done;  // zero-return (close_notify), syscall error, fatal
+      }
+    }
+    // plaintext -> TLS
+    if (outbuf.empty() && (fds[1].revents & (POLLIN | POLLHUP | POLLERR))) {
+      ssize_t n;
+      do {
+        n = ::recv(plain_fd, buf, sizeof(buf), 0);
+      } while (n < 0 && errno == EINTR);
+      if (n <= 0) goto done;  // h2 side closed: wind down
+      outbuf.assign(buf, buf + n);
+      out_off = 0;
+    }
+    while (out_off < outbuf.size()) {
+      int n = api->SSL_write(ssl, outbuf.data() + out_off,
+                             static_cast<int>(outbuf.size() - out_off));
+      if (n > 0) {
+        out_off += static_cast<size_t>(n);
+        continue;
+      }
+      int e = api->SSL_get_error(ssl, n);
+      if (e == kSslErrorWantRead) break;  // handshake data pending; poll
+      if (e == kSslErrorWantWrite) {
+        want_tls_write = true;
+        break;
+      }
+      goto done;
+    }
+    if (out_off >= outbuf.size()) {
+      outbuf.clear();
+      out_off = 0;
+    }
+  }
+done:
+  api->SSL_shutdown(ssl);  // best-effort close_notify
+  api->SSL_free(ssl);
+  ::close(tls_fd);
+  ::close(plain_fd);
+}
+
+// Nonblocking handshake with an ABSOLUTE deadline — SO_RCVTIMEO would
+// only bound each read, so a trickling peer could keep a blocking
+// SSL_connect/SSL_accept alive indefinitely (and wedge listener
+// shutdown, which drains in-flight handshakes). Leaves the fd
+// nonblocking (the pump wants it that way). Returns true on success.
+bool HandshakeWithDeadline(Api* api, SSL* ssl, int fd, bool is_server,
+                           int64_t timeout_ms, std::string* err) {
+  fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  const int64_t deadline_ms =
+      ts.tv_sec * 1000 + ts.tv_nsec / 1000000 + timeout_ms;
+  for (;;) {
+    int rc = is_server ? api->SSL_accept(ssl) : api->SSL_connect(ssl);
+    if (rc == 1) return true;
+    int e = api->SSL_get_error(ssl, rc);
+    if (e != kSslErrorWantRead && e != kSslErrorWantWrite) {
+      if (!is_server && api->SSL_get_verify_result(ssl) != kX509VOk) {
+        *err = LastSslError("TLS certificate verification failed");
+      } else {
+        *err = LastSslError(is_server ? "TLS accept handshake failed"
+                                      : "TLS handshake failed");
+      }
+      return false;
+    }
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    const int64_t now_ms = ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+    if (now_ms >= deadline_ms) {
+      *err = "TLS handshake timed out";
+      return false;
+    }
+    struct pollfd pfd;
+    pfd.fd = fd;
+    pfd.events = e == kSslErrorWantRead ? POLLIN : POLLOUT;
+    pfd.revents = 0;
+    int prc = poll(&pfd, 1, static_cast<int>(deadline_ms - now_ms));
+    if (prc < 0 && errno != EINTR) {
+      *err = "TLS handshake poll failed";
+      return false;
+    }
+    if (prc == 0) {
+      *err = "TLS handshake timed out";
+      return false;
+    }
+  }
+}
+
+// Common post-handshake tail: verify ALPN, make the socketpair, start the
+// pump. Returns the caller's plaintext fd or -1 (cleaning up ssl+fd).
+int StartPump(Api* api, SSL* ssl, int tcp_fd, const std::string& alpn,
+              std::string* err) {
+  if (!alpn.empty()) {
+    const unsigned char* proto = nullptr;
+    unsigned int proto_len = 0;
+    api->SSL_get0_alpn_selected(ssl, &proto, &proto_len);
+    if (proto_len != alpn.size() ||
+        memcmp(proto, alpn.data(), proto_len) != 0) {
+      *err = "TLS peer did not negotiate ALPN '" + alpn + "'";
+      api->SSL_free(ssl);
+      ::close(tcp_fd);
+      return -1;
+    }
+  }
+  int pair[2];
+  if (socketpair(AF_UNIX, SOCK_STREAM, 0, pair) != 0) {
+    *err = "socketpair failed";
+    api->SSL_free(ssl);
+    ::close(tcp_fd);
+    return -1;
+  }
+  std::thread([api, ssl, tcp_fd, pump_fd = pair[1]] {
+    pthread_setname_np(pthread_self(), "ctpu-tls-pump");
+    PumpLoop(api, ssl, tcp_fd, pump_fd);
+  }).detach();
+  return pair[0];
+}
+
+}  // namespace
+
+bool TlsAvailable(std::string* err) { return ApiReady(err); }
+
+namespace {
+
+// One SSL_CTX per distinct client configuration, built once and cached
+// for the process (the server side's ServerContext plays the same role
+// per listener): root-CA and client-cert PEMs are parsed on first use,
+// not on every connection/reconnect. SSL_new takes its own ctx
+// reference, so cached contexts stay valid for the cache's lifetime.
+SSL_CTX* ClientCtxFor(const ClientOptions& options, std::string* err) {
+  Api* api = GetApi();
+  static std::mutex* mu = new std::mutex();
+  static std::map<std::string, SSL_CTX*>* cache =
+      new std::map<std::string, SSL_CTX*>();
+  const std::string key =
+      options.root_certificates + "|" + options.certificate_chain + "|" +
+      options.private_key + "|" + (options.verify_peer ? "v" : "") + "|" +
+      options.alpn;
+  std::lock_guard<std::mutex> lk(*mu);
+  auto it = cache->find(key);
+  if (it != cache->end()) return it->second;
+
+  SSL_CTX* ctx = api->SSL_CTX_new(api->TLS_client_method());
+  if (ctx == nullptr) {
+    *err = LastSslError("SSL_CTX_new failed");
+    return nullptr;
+  }
+  // Partial writes + auto-retry keep the pump's state machine simple.
+  api->SSL_CTX_ctrl(ctx, kSslCtrlMode,
+                    kSslModeEnablePartialWrite |
+                        kSslModeAcceptMovingWriteBuffer | kSslModeAutoRetry,
+                    nullptr);
+  bool ok = true;
+  if (options.verify_peer) {
+    api->SSL_CTX_set_verify(ctx, kSslVerifyPeer, nullptr);
+    if (!options.root_certificates.empty()) {
+      ok = api->SSL_CTX_load_verify_locations(
+               ctx, options.root_certificates.c_str(), nullptr) == 1;
+      if (!ok) *err = LastSslError("loading root certificates failed");
+    } else {
+      api->SSL_CTX_set_default_verify_paths(ctx);
+    }
+  } else {
+    api->SSL_CTX_set_verify(ctx, kSslVerifyNone, nullptr);
+  }
+  if (ok && !options.certificate_chain.empty()) {
+    ok = api->SSL_CTX_use_certificate_chain_file(
+             ctx, options.certificate_chain.c_str()) == 1 &&
+         api->SSL_CTX_use_PrivateKey_file(ctx, options.private_key.c_str(),
+                                          kSslFiletypePem) == 1 &&
+         api->SSL_CTX_check_private_key(ctx) == 1;
+    if (!ok) *err = LastSslError("loading client certificate/key failed");
+  }
+  if (ok && !options.alpn.empty()) {
+    auto wire = AlpnWire(options.alpn);
+    // NB: returns 0 on success (unlike most SSL_* APIs).
+    ok = api->SSL_CTX_set_alpn_protos(ctx, wire.data(),
+                                      static_cast<unsigned int>(wire.size())) ==
+         0;
+    if (!ok) *err = LastSslError("setting ALPN failed");
+  }
+  if (!ok) {
+    api->SSL_CTX_free(ctx);
+    return nullptr;
+  }
+  (*cache)[key] = ctx;
+  return ctx;
+}
+
+}  // namespace
+
+int WrapClient(int tcp_fd, const ClientOptions& options, std::string* err) {
+  if (!ApiReady(err)) {
+    ::close(tcp_fd);
+    return -1;
+  }
+  Api* api = GetApi();
+  SSL_CTX* ctx = ClientCtxFor(options, err);
+  if (ctx == nullptr) {
+    ::close(tcp_fd);
+    return -1;
+  }
+  SSL* ssl = api->SSL_new(ctx);
+  if (ssl == nullptr) {
+    *err = LastSslError("SSL_new failed");
+    ::close(tcp_fd);
+    return -1;
+  }
+  if (!options.host.empty()) {
+    // SNI (macro SSL_set_tlsext_host_name expands to this SSL_ctrl call)
+    api->SSL_ctrl(ssl, kSslCtrlSetTlsextHostname, kTlsextNametypeHostName,
+                  const_cast<char*>(options.host.c_str()));
+    if (options.verify_peer && options.verify_host) {
+      api->SSL_set1_host(ssl, options.host.c_str());
+    }
+  }
+  api->SSL_set_fd(ssl, tcp_fd);
+  SigpipeGuard sigpipe;  // handshake writes on the caller's thread
+  const int64_t timeout_ms = options.handshake_timeout_ms > 0
+                                 ? options.handshake_timeout_ms
+                                 : 30000;
+  if (!HandshakeWithDeadline(GetApi(), ssl, tcp_fd, /*is_server=*/false,
+                             timeout_ms, err)) {
+    api->SSL_free(ssl);
+    ::close(tcp_fd);
+    return -1;
+  }
+  return StartPump(api, ssl, tcp_fd, options.alpn, err);
+}
+
+// -- server ------------------------------------------------------------------
+
+namespace {
+
+// ALPN select callback: accept exactly the configured protocol.
+int AlpnSelect(SSL*, const unsigned char** out, unsigned char* outlen,
+               const unsigned char* in, unsigned int inlen, void* arg) {
+  const std::string* want = static_cast<const std::string*>(arg);
+  unsigned int i = 0;
+  while (i < inlen) {
+    unsigned int len = in[i];
+    if (i + 1 + len > inlen) break;
+    if (len == want->size() && memcmp(in + i + 1, want->data(), len) == 0) {
+      *out = in + i + 1;
+      *outlen = static_cast<unsigned char>(len);
+      return kSslTlsextErrOk;
+    }
+    i += 1 + len;
+  }
+  return kSslTlsextErrAlertFatal;
+}
+
+}  // namespace
+
+ServerContext* ServerContext::Create(const ServerOptions& options,
+                                     std::string* err) {
+  if (!ApiReady(err)) return nullptr;
+  Api* api = GetApi();
+  SSL_CTX* ctx = api->SSL_CTX_new(api->TLS_server_method());
+  if (ctx == nullptr) {
+    *err = LastSslError("SSL_CTX_new failed");
+    return nullptr;
+  }
+  api->SSL_CTX_ctrl(ctx, kSslCtrlMode,
+                    kSslModeEnablePartialWrite |
+                        kSslModeAcceptMovingWriteBuffer | kSslModeAutoRetry,
+                    nullptr);
+  if (api->SSL_CTX_use_certificate_chain_file(
+          ctx, options.certificate_file.c_str()) != 1 ||
+      api->SSL_CTX_use_PrivateKey_file(ctx, options.key_file.c_str(),
+                                       kSslFiletypePem) != 1 ||
+      api->SSL_CTX_check_private_key(ctx) != 1) {
+    *err = LastSslError("loading server certificate/key failed");
+    api->SSL_CTX_free(ctx);
+    return nullptr;
+  }
+  auto* sc = new ServerContext();
+  sc->ctx_ = ctx;
+  sc->alpn_ = options.alpn;
+  if (!sc->alpn_.empty()) {
+    api->SSL_CTX_set_alpn_select_cb(ctx, AlpnSelect, &sc->alpn_);
+  }
+  return sc;
+}
+
+ServerContext::~ServerContext() {
+  if (ctx_ != nullptr) {
+    GetApi()->SSL_CTX_free(static_cast<SSL_CTX*>(ctx_));
+  }
+}
+
+int ServerContext::WrapAccepted(int tcp_fd, std::string* err) {
+  Api* api = GetApi();
+  SSL* ssl = api->SSL_new(static_cast<SSL_CTX*>(ctx_));
+  if (ssl == nullptr) {
+    *err = LastSslError("SSL_new failed");
+    ::close(tcp_fd);
+    return -1;
+  }
+  api->SSL_set_fd(ssl, tcp_fd);
+  SigpipeGuard sigpipe;  // handshake writes on the caller's thread
+  // Absolute 15s deadline: a trickling client can't pin the handshake
+  // thread (or wedge the listener's shutdown drain) indefinitely.
+  if (!HandshakeWithDeadline(api, ssl, tcp_fd, /*is_server=*/true, 15000,
+                             err)) {
+    api->SSL_free(ssl);
+    ::close(tcp_fd);
+    return -1;
+  }
+  return StartPump(api, ssl, tcp_fd, alpn_, err);
+}
+
+}  // namespace tls
+}  // namespace ctpu

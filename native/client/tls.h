@@ -1,0 +1,83 @@
+// TLS layer for the native h2 stack (client and server).
+//
+// Role parity: the reference's C++ clients speak TLS through grpc++'s
+// SslCredentials (reference src/c++/library/grpc_client.h:43-98) and
+// libcurl's CURLOPT_SSL_* options (http_client.h:45-100). This framework
+// hand-rolls its HTTP/2 and HTTP/1.1 transports, so TLS bolts on at the
+// byte layer instead: a connected TCP socket is wrapped by an OpenSSL
+// session owned by ONE pump thread, and the caller gets back a plaintext
+// socketpair fd it can use exactly like the raw TCP fd. The existing h2
+// reader/writer threading never touches the SSL object (OpenSSL SSL
+// handles are not thread-safe), and the transports need zero changes.
+//
+// OpenSSL is loaded at runtime via dlopen(libssl.so.3): this image ships
+// the runtime libraries and the openssl CLI but no development headers,
+// so the needed subset of the (stable) libssl ABI is declared locally in
+// tls.cc. TlsAvailable() reports whether the runtime is usable.
+#pragma once
+
+#include <string>
+
+namespace ctpu {
+namespace tls {
+
+// Client-side TLS configuration. Field semantics follow the reference's
+// SslOptions (PEM file paths; empty = use defaults) plus the libcurl-style
+// verify toggles of its HttpSslOptions.
+struct ClientOptions {
+  // PEM file with the server root certificates; empty = system defaults.
+  std::string root_certificates;
+  // PEM files for mutual TLS; empty = no client certificate.
+  std::string private_key;
+  std::string certificate_chain;
+  // Verify the server certificate chain / that the cert matches the host.
+  bool verify_peer = true;
+  bool verify_host = true;
+  // Hostname for SNI + host verification.
+  std::string host;
+  // ALPN protocol to offer (e.g. "h2"); empty = no ALPN. When set, the
+  // handshake fails unless the server negotiates exactly this protocol.
+  std::string alpn;
+  // Absolute handshake deadline in ms; <= 0 uses the 30 s default.
+  int64_t handshake_timeout_ms = 0;
+};
+
+// Server-side TLS configuration (PEM file paths).
+struct ServerOptions {
+  std::string certificate_file;  // server certificate chain
+  std::string key_file;          // server private key
+  // ALPN protocol to accept (e.g. "h2"); empty = accept none/any.
+  std::string alpn;
+};
+
+// True when the OpenSSL runtime could be loaded; *err explains otherwise.
+bool TlsAvailable(std::string* err);
+
+// Wraps a connected TCP socket in client-side TLS. Performs the blocking
+// handshake, then spawns a pump thread that owns `tcp_fd` + the SSL
+// session and shuttles bytes to/from a plaintext socketpair. Returns the
+// plaintext fd (caller owns and closes it; closing it winds down the pump
+// and the TCP socket), or -1 with *err set. Takes ownership of tcp_fd on
+// both success and failure.
+int WrapClient(int tcp_fd, const ClientOptions& options, std::string* err);
+
+// Server-side TLS context (one per listener; wraps accepted sockets).
+class ServerContext {
+ public:
+  // Builds the SSL_CTX (loads cert + key). Returns nullptr with *err set.
+  static ServerContext* Create(const ServerOptions& options, std::string* err);
+  ~ServerContext();
+
+  // Server-side twin of WrapClient: blocking accept-handshake, then a pump
+  // thread. Returns the plaintext fd or -1 with *err set. Takes ownership
+  // of tcp_fd either way.
+  int WrapAccepted(int tcp_fd, std::string* err);
+
+ private:
+  ServerContext() = default;
+  void* ctx_ = nullptr;       // SSL_CTX*
+  std::string alpn_;
+};
+
+}  // namespace tls
+}  // namespace ctpu

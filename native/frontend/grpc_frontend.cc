@@ -1035,7 +1035,10 @@ PyObject* Start(PyObject* self, PyObject* args) {
   int port;
   PyObject* rpc;
   PyObject* cancel;
-  if (!PyArg_ParseTuple(args, "siOO", &host, &port, &rpc, &cancel)) {
+  const char* tls_cert = nullptr;
+  const char* tls_key = nullptr;
+  if (!PyArg_ParseTuple(args, "siOO|zz", &host, &port, &rpc, &cancel,
+                        &tls_cert, &tls_key)) {
     return nullptr;
   }
   if (!PyCallable_Check(rpc) ||
@@ -1068,10 +1071,17 @@ PyObject* Start(PyObject* self, PyObject* args) {
   };
   cbs.on_close = [fe](h2srv::ServerConnection* c) { OnClose(fe, c); };
 
+  tls::ServerOptions tls_options;
+  const tls::ServerOptions* tls = nullptr;
+  if (tls_cert != nullptr && tls_cert[0] != '\0') {
+    tls_options.certificate_file = tls_cert;
+    tls_options.key_file = tls_key != nullptr ? tls_key : "";
+    tls = &tls_options;
+  }
   std::string err;
   std::unique_ptr<h2srv::Listener> listener;
   Py_BEGIN_ALLOW_THREADS;
-  listener = h2srv::Listener::Start(host, port, cbs, &err);
+  listener = h2srv::Listener::Start(host, port, cbs, &err, tls);
   Py_END_ALLOW_THREADS;
   if (listener == nullptr) {
     delete fe;
@@ -1465,7 +1475,7 @@ PyObject* CompleteMany(PyObject* self, PyObject* args) {
 
 PyMethodDef kMethods[] = {
     {"start", Start, METH_VARARGS,
-     "start(host, port, rpc, cancel) -> frontend id"},
+     "start(host, port, rpc, cancel[, tls_cert, tls_key]) -> frontend id"},
     {"port", Port, METH_VARARGS, "port(id) -> bound TCP port"},
     {"stop", Stop, METH_VARARGS, "stop(id)"},
     {"wait_requests", WaitRequests, METH_VARARGS,

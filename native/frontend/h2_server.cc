@@ -205,6 +205,10 @@ void ServerConnection::ReaderLoop() {
     }
   }
   dead_.store(true);
+  // Half-close so the peer learns immediately — without this, a client
+  // that spoke the wrong protocol (e.g. a TLS ClientHello against this
+  // cleartext port) blocks forever waiting for bytes that never come.
+  if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
   {
     std::lock_guard<std::mutex> lk(mu_);
     writer_stop_ = true;
@@ -669,7 +673,15 @@ void ServerConnection::WriterLoop() {
 
 std::unique_ptr<Listener> Listener::Start(const std::string& host, int port,
                                           ConnectionCallbacks cbs,
-                                          std::string* err) {
+                                          std::string* err,
+                                          const tls::ServerOptions* tls) {
+  std::unique_ptr<tls::ServerContext> tls_ctx;
+  if (tls != nullptr) {
+    tls::ServerOptions options = *tls;
+    if (options.alpn.empty()) options.alpn = "h2";
+    tls_ctx.reset(tls::ServerContext::Create(options, err));
+    if (tls_ctx == nullptr) return nullptr;
+  }
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) {
     *err = "socket() failed";
@@ -705,6 +717,7 @@ std::unique_ptr<Listener> Listener::Start(const std::string& host, int port,
   l->listen_fd_ = fd;
   l->port_ = ntohs(addr.sin_port);
   l->cbs_ = std::move(cbs);
+  l->tls_ctx_ = std::move(tls_ctx);
   l->acceptor_ = std::thread([p = l.get()] {
     pthread_setname_np(pthread_self(), "ctpu-h2s-accept");
     p->AcceptLoop();
@@ -724,16 +737,46 @@ void Listener::AcceptLoop() {
       continue;
     }
     Reap(false);
-    auto conn = ServerConnection::Adopt(fd, cbs_);
-    {
-      std::lock_guard<std::mutex> lk(mu_);
-      conns_.push_back(conn);
+    if (tls_ctx_ != nullptr) {
+      // TLS handshake off the accept loop: a slow (or malicious) client
+      // must not stall other accepts. WrapAccepted runs the handshake
+      // against an absolute deadline, so a silent OR trickling client
+      // cannot pin the thread (nor hang Stop(), which drains in-flight
+      // handshakes).
+      {
+        std::lock_guard<std::mutex> lk(hs_mu_);
+        hs_inflight_++;
+      }
+      std::thread([this, fd] {
+        pthread_setname_np(pthread_self(), "ctpu-h2s-tls");
+        std::string tls_err;
+        int plain = tls_ctx_->WrapAccepted(fd, &tls_err);
+        if (plain >= 0 && !stopping_.load()) {
+          AdoptAccepted(plain);
+        } else if (plain >= 0) {
+          ::close(plain);
+        }
+        // else: failed handshakes are dropped quietly (like h2c RSTs)
+        std::lock_guard<std::mutex> lk(hs_mu_);
+        hs_inflight_--;
+        hs_cv_.notify_all();
+      }).detach();
+      continue;
     }
-    // Register with the receiver BEFORE frames can arrive, so the first
-    // request on the connection cannot race the registration.
-    if (cbs_.on_accept) cbs_.on_accept(conn);
-    conn->StartThreads();
+    AdoptAccepted(fd);
   }
+}
+
+void Listener::AdoptAccepted(int fd) {
+  auto conn = ServerConnection::Adopt(fd, cbs_);
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    conns_.push_back(conn);
+  }
+  // Register with the receiver BEFORE frames can arrive, so the first
+  // request on the connection cannot race the registration.
+  if (cbs_.on_accept) cbs_.on_accept(conn);
+  conn->StartThreads();
 }
 
 void Listener::Reap(bool all) {
@@ -765,6 +808,13 @@ void Listener::Stop() {
   if (acceptor_.joinable()) acceptor_.join();
   fd = listen_fd_.exchange(-1);
   if (fd >= 0) ::close(fd);
+  {
+    // Drain in-flight TLS handshakes (bounded by WrapAccepted's absolute
+    // deadline) so a handshake thread can never touch a destroyed
+    // listener.
+    std::unique_lock<std::mutex> lk(hs_mu_);
+    hs_cv_.wait(lk, [this] { return hs_inflight_ == 0; });
+  }
   Reap(true);
 }
 

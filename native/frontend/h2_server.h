@@ -27,6 +27,7 @@
 #include <vector>
 
 #include "hpack.h"
+#include "tls.h"
 
 namespace ctpu {
 namespace h2srv {
@@ -168,10 +169,15 @@ class ServerConnection {
 class Listener {
  public:
   // Binds host:port (port 0 picks a free port). Returns nullptr + *err on
-  // failure. `cbs` is shared by every accepted connection.
+  // failure. `cbs` is shared by every accepted connection. With `tls`,
+  // accepted sockets handshake TLS (ALPN h2) before h2 adoption; the
+  // handshake runs on a per-connection thread so a slow client can never
+  // stall the accept loop.
   static std::unique_ptr<Listener> Start(const std::string& host, int port,
                                          ConnectionCallbacks cbs,
-                                         std::string* err);
+                                         std::string* err,
+                                         const tls::ServerOptions* tls =
+                                             nullptr);
   ~Listener();
 
   int port() const { return port_; }
@@ -180,7 +186,15 @@ class Listener {
  private:
   Listener() = default;
   void AcceptLoop();
+  void AdoptAccepted(int fd);
   void Reap(bool all);
+
+  std::unique_ptr<tls::ServerContext> tls_ctx_;
+  // In-flight TLS handshake threads; Stop() drains them (each is bounded
+  // by the accept-socket IO timeout, so the wait is finite).
+  std::mutex hs_mu_;
+  std::condition_variable hs_cv_;
+  size_t hs_inflight_ = 0;
 
   // Atomic: Stop() shuts the socket down from another thread while
   // AcceptLoop blocks in accept() on it (close happens only after join).

@@ -455,6 +455,20 @@ Error ParseArgs(int argc, char** argv, PAParams* params) {
     } else if (arg == "--grpc-compression-algorithm") {
       CTPU_RETURN_IF_ERROR(need(i));
       params->grpc_compression = next();
+    } else if (arg == "--ssl-grpc-use-ssl") {
+      params->ssl_grpc_use_ssl = true;
+    } else if (arg == "--ssl-grpc-root-certifications-file") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->ssl_grpc_root_certifications_file = next();
+      params->ssl_grpc_use_ssl = true;
+    } else if (arg == "--ssl-grpc-private-key-file") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->ssl_grpc_private_key_file = next();
+      params->ssl_grpc_use_ssl = true;
+    } else if (arg == "--ssl-grpc-certificate-chain-file") {
+      CTPU_RETURN_IF_ERROR(need(i));
+      params->ssl_grpc_certificate_chain_file = next();
+      params->ssl_grpc_use_ssl = true;
     } else if (arg == "--async" || arg == "-a") {
       params->async_mode = true;
     } else if (arg == "--sync") {

@@ -99,6 +99,11 @@ struct PAParams {
   // none | deflate | gzip: per-message gRPC request compression
   // (reference kGrpcCompressionAlgorithm).
   std::string grpc_compression = "none";
+  // gRPC TLS (reference --ssl-grpc-* options)
+  bool ssl_grpc_use_ssl = false;
+  std::string ssl_grpc_root_certifications_file;
+  std::string ssl_grpc_private_key_file;
+  std::string ssl_grpc_certificate_chain_file;
   std::string csv_file;
   std::string profile_export_file;
   bool json_summary = false;

@@ -18,10 +18,16 @@ Error CreateClientBackend(const BackendFactoryConfig& config,
       return HttpClientBackend::Create(config.url, config.verbose, backend,
                                        config.json_tensor_format,
                                        config.json_output_format);
-    case BackendKind::KSERVE_GRPC:
+    case BackendKind::KSERVE_GRPC: {
+      SslOptions ssl;
+      ssl.root_certificates = config.grpc_ssl_root_certs;
+      ssl.private_key = config.grpc_ssl_private_key;
+      ssl.certificate_chain = config.grpc_ssl_certificate_chain;
       return GrpcClientBackend::Create(config.url, config.verbose,
                                        config.streaming, backend,
-                                       config.grpc_compression);
+                                       config.grpc_compression,
+                                       config.grpc_use_ssl, ssl);
+    }
     case BackendKind::OPENAI:
       return OpenAiClientBackend::Create(config.url, config.endpoint,
                                          config.streaming, backend);

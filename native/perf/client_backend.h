@@ -216,6 +216,12 @@ struct BackendFactoryConfig {
   // KSERVE_GRPC only: per-message request compression
   // (--grpc-compression-algorithm): "" | "deflate" | "gzip".
   std::string grpc_compression;
+  // KSERVE_GRPC only: TLS (reference --ssl-grpc-* options). PEM paths;
+  // empty root certs = system defaults.
+  bool grpc_use_ssl = false;
+  std::string grpc_ssl_root_certs;
+  std::string grpc_ssl_private_key;
+  std::string grpc_ssl_certificate_chain;
   // TFS only: signature block naming the tensor contract
   // (--model-signature-name).
   std::string tfs_signature_name = "serving_default";

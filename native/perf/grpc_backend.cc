@@ -26,9 +26,13 @@ json::Value TensorsToJson(
 Error GrpcClientBackend::Create(const std::string& url, bool verbose,
                                 bool streaming,
                                 std::shared_ptr<ClientBackend>* backend,
-                                const std::string& compression) {
+                                const std::string& compression,
+                                bool use_ssl, const SslOptions& ssl) {
   auto* b = new GrpcClientBackend(url, streaming, compression);
-  Error err = InferenceServerGrpcClient::Create(&b->client_, url, verbose);
+  b->use_ssl_ = use_ssl;
+  b->ssl_ = ssl;
+  Error err = InferenceServerGrpcClient::Create(&b->client_, url, verbose,
+                                                use_ssl, ssl);
   if (!err.IsOk()) {
     delete b;
     return err;
@@ -126,7 +130,8 @@ GrpcBackendContext::~GrpcBackendContext() {
 Error GrpcBackendContext::EnsureClient() {
   if (client_) return Error::Success();
   CTPU_RETURN_IF_ERROR(
-      InferenceServerGrpcClient::Create(&client_, url_, false));
+      InferenceServerGrpcClient::Create(&client_, url_, false, use_ssl_,
+                                        ssl_));
   if (!compression_.empty()) {
     CTPU_RETURN_IF_ERROR(client_->SetCompression(compression_));
   }

@@ -24,12 +24,15 @@ class GrpcBackendContext : public BackendContext {
   // (otherwise responses map 1:1 to requests).
   GrpcBackendContext(std::string url, bool streaming, bool decoupled,
                      std::string compression,
-                     std::shared_ptr<PreparedBodyCache> body_cache)
+                     std::shared_ptr<PreparedBodyCache> body_cache,
+                     bool use_ssl = false, const SslOptions& ssl = {})
       : url_(std::move(url)),
         streaming_(streaming),
         decoupled_(decoupled),
         compression_(std::move(compression)),
-        body_cache_(std::move(body_cache)) {}
+        body_cache_(std::move(body_cache)),
+        use_ssl_(use_ssl),
+        ssl_(ssl) {}
   ~GrpcBackendContext() override;
 
   Error Infer(const InferOptions& options,
@@ -66,6 +69,8 @@ class GrpcBackendContext : public BackendContext {
   std::unique_ptr<InferenceServerGrpcClient> client_;
   bool stream_started_ = false;
   std::shared_ptr<PreparedBodyCache> body_cache_;
+  bool use_ssl_ = false;
+  SslOptions ssl_;
 
   // In-flight stream request state (one outstanding request per context;
   // contexts are single-threaded by contract). Responses are correlated by
@@ -84,7 +89,8 @@ class GrpcClientBackend : public ClientBackend {
  public:
   static Error Create(const std::string& url, bool verbose, bool streaming,
                       std::shared_ptr<ClientBackend>* backend,
-                      const std::string& compression = "");
+                      const std::string& compression = "",
+                      bool use_ssl = false, const SslOptions& ssl = {});
 
   BackendKind Kind() const override { return BackendKind::KSERVE_GRPC; }
   Error ModelMetadata(json::Value* metadata, const std::string& model_name,
@@ -96,7 +102,8 @@ class GrpcClientBackend : public ClientBackend {
       const std::string& model_name) override;
   std::unique_ptr<BackendContext> CreateContext() override {
     return std::unique_ptr<BackendContext>(new GrpcBackendContext(
-        url_, streaming_, decoupled_, compression_, body_cache_));
+        url_, streaming_, decoupled_, compression_, body_cache_, use_ssl_,
+        ssl_));
   }
   Error RegisterSystemSharedMemory(const std::string& name,
                                    const std::string& key,
@@ -133,6 +140,8 @@ class GrpcClientBackend : public ClientBackend {
   std::string url_;
   bool streaming_;
   std::string compression_;
+  bool use_ssl_ = false;
+  SslOptions ssl_;
   bool decoupled_ = false;  // learned from ModelConfig
   std::unique_ptr<InferenceServerGrpcClient> client_;
   std::shared_ptr<PreparedBodyCache> body_cache_ =

@@ -72,6 +72,12 @@ int main(int argc, char** argv) {
     if (params.grpc_compression != "none") {
       backend_config.grpc_compression = params.grpc_compression;
     }
+    backend_config.grpc_use_ssl = params.ssl_grpc_use_ssl;
+    backend_config.grpc_ssl_root_certs =
+        params.ssl_grpc_root_certifications_file;
+    backend_config.grpc_ssl_private_key = params.ssl_grpc_private_key_file;
+    backend_config.grpc_ssl_certificate_chain =
+        params.ssl_grpc_certificate_chain_file;
   }
   if (params.service_kind == "openai") {
     backend_config.kind = BackendKind::OPENAI;

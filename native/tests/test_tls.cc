@@ -1,0 +1,256 @@
+// TLS loopback tests: the OpenSSL pump (native/client/tls.{h,cc}), the
+// TLS h2 listener, and a full grpcs:// inference round trip through the
+// real gRPC client — the role of the reference's SSL client options
+// (reference src/c++/library/grpc_client.h:43-98, http_client.h:45-100),
+// exercised against this framework's own TLS-terminating front-end.
+//
+// Certificates are generated at test run time with the openssl CLI
+// (self-signed, CN=localhost + SAN for 127.0.0.1), so nothing sensitive
+// lives in the repo.
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <thread>
+#include <cstdlib>
+#include <string>
+
+#include "../frontend/h2_server.h"
+#include "client_tpu/grpc/_generated/grpc_service.pb.h"
+#include "common.h"
+#include "grpc_client.h"
+#include "h2.h"
+#include "http_client.h"
+#include "test_framework.h"
+#include "tls.h"
+
+using namespace ctpu;
+using ctpu::h2srv::ConnectionCallbacks;
+using ctpu::h2srv::Listener;
+using ctpu::h2srv::ServerConnection;
+
+namespace {
+
+// One self-signed cert per test-binary run.
+struct CertFixture {
+  std::string dir;
+  std::string cert;
+  std::string key;
+  bool ok = false;
+
+  CertFixture() {
+    char tmpl[] = "/tmp/ctpu_tls_test_XXXXXX";
+    if (mkdtemp(tmpl) == nullptr) return;
+    dir = tmpl;
+    cert = dir + "/cert.pem";
+    key = dir + "/key.pem";
+    std::string cmd =
+        "openssl req -x509 -newkey rsa:2048 -keyout " + key + " -out " +
+        cert +
+        " -days 2 -nodes -subj /CN=localhost"
+        " -addext 'subjectAltName=DNS:localhost,IP:127.0.0.1'"
+        " >/dev/null 2>&1";
+    ok = system(cmd.c_str()) == 0;
+  }
+};
+
+CertFixture& Certs() {
+  static CertFixture* fixture = new CertFixture();
+  return *fixture;
+}
+
+// A TLS h2 server that answers every unary gRPC request with a canned
+// ModelInferResponse (OUTPUT0 = INT32 [1,2] {7, 9}).
+struct TlsGrpcServer {
+  std::unique_ptr<Listener> listener;
+  std::string start_error;
+
+  TlsGrpcServer() {
+    inference::ModelInferResponse resp;
+    resp.set_model_name("tls_echo");
+    resp.set_model_version("1");
+    auto* out = resp.add_outputs();
+    out->set_name("OUTPUT0");
+    out->set_datatype("INT32");
+    out->add_shape(1);
+    out->add_shape(2);
+    int32_t values[2] = {7, 9};
+    resp.add_raw_output_contents()->assign(
+        reinterpret_cast<const char*>(values), sizeof(values));
+    std::string body = resp.SerializeAsString();
+    std::string framed;
+    framed.push_back('\0');
+    for (int shift = 24; shift >= 0; shift -= 8) {
+      framed.push_back(
+          static_cast<char>((body.size() >> shift) & 0xff));
+    }
+    framed += body;
+
+    ConnectionCallbacks cbs;
+    cbs.on_data = [framed](ServerConnection* conn, uint32_t sid,
+                           const uint8_t*, size_t, bool end_stream) {
+      if (!end_stream) return;
+      std::vector<hpack::Header> headers{
+          {":status", "200"}, {"content-type", "application/grpc"}};
+      std::vector<hpack::Header> trailers{{"grpc-status", "0"}};
+      std::string data = framed;
+      conn->SendResponse(sid, &headers, &data, &trailers);
+    };
+    tls::ServerOptions tls_options;
+    tls_options.certificate_file = Certs().cert;
+    tls_options.key_file = Certs().key;
+    listener =
+        Listener::Start("127.0.0.1", 0, cbs, &start_error, &tls_options);
+  }
+};
+
+}  // namespace
+
+TEST_CASE("tls: runtime is available and certs generate") {
+  std::string err;
+  CHECK(tls::TlsAvailable(&err));
+  CHECK(Certs().ok);
+}
+
+TEST_CASE("tls: h2 connection handshakes with ALPN and runs a request") {
+  TlsGrpcServer server;
+  REQUIRE(server.listener != nullptr);
+  tls::ClientOptions options;
+  options.root_certificates = Certs().cert;  // self-signed: cert is the CA
+  std::string err;
+  auto conn = h2::Connection::Connect("127.0.0.1", server.listener->port(),
+                                      &err, &options);
+  REQUIRE(conn != nullptr);
+  CHECK(conn->alive());
+}
+
+TEST_CASE("tls: grpcs loopback inference through the real client") {
+  TlsGrpcServer server;
+  REQUIRE(server.listener != nullptr);
+  std::unique_ptr<InferenceServerGrpcClient> client;
+  SslOptions ssl;
+  ssl.root_certificates = Certs().cert;
+  CHECK_OK(InferenceServerGrpcClient::Create(
+      &client,
+      "grpcs://localhost:" + std::to_string(server.listener->port()),
+      /*verbose=*/false, /*use_ssl=*/true, ssl));
+  std::vector<int32_t> input{1, 2};
+  InferInput in0("INPUT0", {1, 2}, "INT32");
+  CHECK_OK(in0.AppendRaw(reinterpret_cast<uint8_t*>(input.data()),
+                         input.size() * sizeof(int32_t)));
+  InferOptions options("tls_echo");
+  InferResult* raw_result = nullptr;
+  CHECK_OK(client->Infer(&raw_result, options, {&in0}));
+  std::unique_ptr<InferResult> result(raw_result);
+  const uint8_t* buf = nullptr;
+  size_t byte_size = 0;
+  CHECK_OK(result->RawData("OUTPUT0", &buf, &byte_size));
+  CHECK_EQ(byte_size, 2 * sizeof(int32_t));
+  const int32_t* values = reinterpret_cast<const int32_t*>(buf);
+  CHECK_EQ(values[0], 7);
+  CHECK_EQ(values[1], 9);
+}
+
+TEST_CASE("tls: verification fails without the right roots") {
+  TlsGrpcServer server;
+  REQUIRE(server.listener != nullptr);
+  tls::ClientOptions options;  // verify_peer=true, no roots -> untrusted
+  std::string err;
+  auto conn = h2::Connection::Connect("127.0.0.1", server.listener->port(),
+                                      &err, &options);
+  CHECK(conn == nullptr);
+  CHECK(!err.empty());
+  // verify_peer=false connects fine against the same server
+  tls::ClientOptions no_verify;
+  no_verify.verify_peer = false;
+  auto conn2 = h2::Connection::Connect("127.0.0.1", server.listener->port(),
+                                       &err, &no_verify);
+  CHECK(conn2 != nullptr);
+}
+
+TEST_CASE("tls: plaintext client against a TLS port fails cleanly") {
+  TlsGrpcServer server;
+  REQUIRE(server.listener != nullptr);
+  std::string err;
+  auto conn = h2::Connection::Connect("127.0.0.1", server.listener->port(),
+                                      &err, nullptr);
+  // The preface write may land before the server rejects, but no h2
+  // SETTINGS ever arrives; either Connect fails or the connection dies.
+  if (conn != nullptr) {
+    h2::StreamEvents events;
+    std::atomic<bool> closed{false};
+    events.on_close = [&closed](bool, uint32_t, const std::string&) {
+      closed.store(true);
+    };
+    (void)conn->StartStream({{":method", "POST"},
+                             {":scheme", "http"},
+                             {":path", "/x"},
+                             {":authority", "t"}},
+                            true, events);
+    for (int i = 0; i < 100 && !closed.load() && conn->alive(); ++i) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(20));
+    }
+    CHECK((closed.load() || !conn->alive()));
+  }
+}
+
+TEST_CASE("tls: https HTTP/1.1 roundtrip (openssl s_server)") {
+  // `openssl s_server -www` answers any GET with an HTTP/1.1 status page
+  // — a real TLS HTTP server to drive the http client's transport.
+  int port = 0;
+  {
+    // pick a free port
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    REQUIRE(::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ==
+            0);
+    socklen_t alen = sizeof(addr);
+    getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port = ntohs(addr.sin_port);
+    ::close(fd);
+  }
+  std::string cmd = "openssl s_server -accept " + std::to_string(port) +
+                    " -cert " + Certs().cert + " -key " + Certs().key +
+                    " -www -naccept 1 >/dev/null 2>&1 &";
+  REQUIRE(system(cmd.c_str()) == 0);
+  // wait for the listener to come up
+  HttpConnection conn("127.0.0.1", port);
+  tls::ClientOptions tls_options;
+  tls_options.root_certificates = Certs().cert;
+  tls_options.host = "localhost";
+  conn.SetTls(tls_options);
+  Error err = Error::Success();
+  for (int i = 0; i < 50; ++i) {
+    err = conn.Connect();
+    if (err.IsOk()) break;
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  }
+  CHECK_OK(err);
+  int status = 0;
+  std::string headers;
+  std::string body;
+  CHECK_OK(conn.Roundtrip("GET", "/", {}, nullptr, 0, &status, &headers,
+                          &body));
+  CHECK_EQ(status, 200);
+  CHECK(!body.empty());
+}
+
+TEST_CASE("tls: TLS client against a plaintext port fails cleanly") {
+  // Plaintext listener
+  ConnectionCallbacks cbs;
+  std::string err;
+  auto listener = Listener::Start("127.0.0.1", 0, cbs, &err);
+  REQUIRE(listener != nullptr);
+  tls::ClientOptions options;
+  options.verify_peer = false;
+  auto conn = h2::Connection::Connect("127.0.0.1", listener->port(), &err,
+                                      &options);
+  CHECK(conn == nullptr);
+  CHECK(!err.empty());
+}
