@@ -143,26 +143,13 @@ def _bench_python_grpc(grpc_url: str) -> dict:
     return asyncio.run(run())
 
 
-def _bench_inprocess(server) -> float:
+def _inprocess_throughput(server, make_request, concurrency: int) -> float:
     """Client-overhead-free throughput: ServerCore.infer driven directly on
-    the server's event loop at bench concurrency (the reference's
-    triton_c_api / --service-kind local measurement)."""
-    import numpy as np
+    the server's event loop (the reference's triton_c_api /
+    --service-kind local measurement). Shared by the `simple` tracker row
+    and the north-star twin."""
 
-    from client_tpu.server.core import CoreRequest, CoreTensor
-
-    in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
-    in1 = np.ones([1, 16], dtype=np.int32)
     core = server.core
-
-    def make_request():
-        return CoreRequest(
-            model_name="simple",
-            inputs=[
-                CoreTensor("INPUT0", "INT32", [1, 16], in0),
-                CoreTensor("INPUT1", "INT32", [1, 16], in1),
-            ],
-        )
 
     async def run():
         count = 0
@@ -176,15 +163,108 @@ def _bench_inprocess(server) -> float:
                     count += 1
 
         stop_at = time.monotonic() + min(WARMUP_S, 2.0)
-        await asyncio.gather(*[worker() for _ in range(CONCURRENCY)])
+        await asyncio.gather(*[worker() for _ in range(concurrency)])
         count = 0
         start = time.monotonic()
         stop_at = start + INPROC_MEASURE_S
-        await asyncio.gather(*[worker() for _ in range(CONCURRENCY)])
+        await asyncio.gather(*[worker() for _ in range(concurrency)])
         return count / (time.monotonic() - start)
 
     future = asyncio.run_coroutine_threadsafe(run(), server._loop)
     return future.result(timeout=300)
+
+
+def _bench_northstar(server) -> dict:
+    """The BASELINE.json north-star configuration: image_classifier
+    (ResNet family) at batch 4 over gRPC + tpu-shm vs the same model
+    driven in-process — reported alongside the `simple` tracker row.
+
+    Never raises: failures degrade to a partial (or empty) row so the
+    already-measured headline is never lost. Registers ONLY the image
+    model (the other zoo models' warmup compiles would widen the hang
+    surface for nothing)."""
+    import numpy as np
+
+    from client_tpu.models.serving import ImageClassifierModel
+    from client_tpu.server.core import CoreRequest, CoreTensor
+
+    batch = 4
+    result: dict = {}
+    try:
+        repository = server.core.repository
+        try:
+            model = repository.get("image_classifier")
+        except Exception:  # noqa: BLE001 - not registered yet
+            model = ImageClassifierModel(
+                "image_classifier", image_size=64, small=True
+            )
+            repository.add_model(model)
+        image_size = model.inputs[0]["shape"][1]
+        result["config"] = (
+            f"image_classifier b{batch} ({image_size}px), gRPC + tpu-shm, "
+            f"concurrency 8"
+        )
+        for shm, key in (
+            ("tpu", "infer_per_sec"),
+            ("none", "inline_infer_per_sec"),
+        ):
+            extra = ["-m", "image_classifier", "-b", str(batch)]
+            # _perf_analyzer_row hardcodes -m simple first; later -m wins.
+            extra += ["--concurrency-range", "8"]
+            if shm != "none":
+                extra += ["--shared-memory", shm]
+            # Best of two, like the headline: single passes on this shared
+            # single-core host regularly lose 10-30% to unrelated load.
+            best = 0.0
+            for _ in range(2):
+                summary, _ = _perf_analyzer_row(server.grpc_url, extra=extra)
+                if summary is not None:
+                    best = max(best, summary["throughput"])
+            if best > 0:
+                result[key] = round(best, 2)
+        # In-process twin at the same concurrency and batch.
+        image = np.zeros(
+            (batch, image_size, image_size, 3), dtype=np.float32
+        )
+        inproc = _inprocess_throughput(
+            server,
+            lambda: CoreRequest(
+                model_name="image_classifier",
+                inputs=[
+                    CoreTensor("INPUT", "FP32", list(image.shape), image)
+                ],
+            ),
+            concurrency=8,
+        )
+        result["inproc_infer_per_sec"] = round(inproc, 2)
+        if inproc > 0 and result.get("infer_per_sec"):
+            result["ratio_vs_inproc"] = round(
+                result["infer_per_sec"] / inproc, 3
+            )
+    except Exception as e:  # noqa: BLE001 - row is best-effort
+        print(f"bench: north-star row failed: {e}", file=sys.stderr)
+    return result
+
+
+def _bench_inprocess(server) -> float:
+    """The `simple` tracker row's in-process twin."""
+    import numpy as np
+
+    from client_tpu.server.core import CoreRequest, CoreTensor
+
+    in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    in1 = np.ones([1, 16], dtype=np.int32)
+
+    def make_request():
+        return CoreRequest(
+            model_name="simple",
+            inputs=[
+                CoreTensor("INPUT0", "INT32", [1, 16], in0),
+                CoreTensor("INPUT1", "INT32", [1, 16], in1),
+            ],
+        )
+
+    return _inprocess_throughput(server, make_request, CONCURRENCY)
 
 
 def main() -> int:
@@ -274,6 +354,10 @@ def main() -> int:
             if shm_summary is not None:
                 shm_throughput = shm_summary["throughput"]
 
+        # North-star headline (BASELINE.json: perf_analyzer vs in-process
+        # on ResNet over gRPC + TPU-shm): image_classifier at batch 4.
+        northstar = _bench_northstar(server) if have_pa else None
+
         try:
             inproc = _bench_inprocess(server)
         except Exception as e:  # noqa: BLE001 - ratio is best-effort
@@ -302,6 +386,8 @@ def main() -> int:
         )
     if shm_throughput > 0:
         line["tpu_shm_infer_per_sec"] = round(shm_throughput, 2)
+    if northstar:
+        line["northstar"] = northstar
     # CPU attribution of the client/server split for the headline run
     # (PERF.md explains how this bounds ratio_vs_inproc on few-core hosts).
     count = result.get("count", 0)

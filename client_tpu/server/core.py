@@ -1083,7 +1083,15 @@ class ServerCore:
         (inline binary, JSON, shared memory)."""
         count = num_elements(shape)
         if shm_region is not None:
-            raw = bytes(self.shm.read(shm_region, shm_offset, shm_byte_size))
+            # Zero-copy view into the registered region (np.frombuffer
+            # below wraps it without copying). Read-only so a model that
+            # mutates its input in place raises instead of silently
+            # corrupting the client's region. The region must stay
+            # registered while requests that reference it are in flight —
+            # same contract as the reference server's direct shm reads.
+            raw = self.shm.read(
+                shm_region, shm_offset, shm_byte_size
+            ).toreadonly()
         if raw is not None:
             if datatype == "BYTES":
                 arr = deserialize_bytes_tensor(raw).reshape(shape)

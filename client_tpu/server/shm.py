@@ -79,6 +79,12 @@ class _Region:
     def close(self) -> None:
         try:
             self._map.close()
+        except BufferError:
+            # Zero-copy views into the mapping are still alive (decode_input
+            # hands np.frombuffer views of the region to in-flight
+            # requests). Drop our reference instead: the mapping unmaps
+            # when the last view dies, and the fd/name release now.
+            pass
         finally:
             os.close(self._fd)
 
