@@ -125,6 +125,14 @@ class IdentityModel(Model):
             raise InferenceServerException(
                 f"model '{self.name}' expects input INPUT0"
             )
+        # Execution-delay knob for timeout/deadline tests (the role of the
+        # reference identity backend's execute_delay parameter): requests
+        # carrying delay_ms sleep that long before responding.
+        delay_ms = parameters.get("delay_ms") if parameters else None
+        if delay_ms:
+            import time as _time
+
+            _time.sleep(min(float(delay_ms), 10_000) / 1000.0)
         return {"OUTPUT0": inputs["INPUT0"]}
 
 

@@ -8,6 +8,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <chrono>
 #include <cstdio>
 #include <cstring>
 
@@ -83,9 +84,34 @@ void HttpConnection::Close() {
   buf_.clear();
 }
 
+// Blocks until the fd is ready for `events` or deadline_ns_ passes.
+Error HttpConnection::WaitReadable(short events) {
+  if (deadline_ns_ == 0) return Error::Success();
+  const int64_t now = std::chrono::duration_cast<std::chrono::nanoseconds>(
+                          std::chrono::steady_clock::now().time_since_epoch())
+                          .count();
+  if (now >= deadline_ns_) return Error("HTTP request timed out");
+  struct pollfd pfd;
+  pfd.fd = fd_;
+  pfd.events = events;
+  pfd.revents = 0;
+  const int timeout_ms =
+      static_cast<int>((deadline_ns_ - now) / 1000000) + 1;
+  int rc = poll(&pfd, 1, timeout_ms);
+  if (rc == 0) return Error("HTTP request timed out");
+  if (rc < 0) {
+    // EINTR must re-check the deadline and re-wait, not skip the wait —
+    // otherwise the following blocking send/recv has no timeout at all.
+    if (errno == EINTR) return WaitReadable(events);
+    return MakeSocketError("poll");
+  }
+  return Error::Success();
+}
+
 Error HttpConnection::SendAll(const char* data, size_t size) {
   size_t sent = 0;
   while (sent < size) {
+    CTPU_RETURN_IF_ERROR(WaitReadable(POLLOUT));
     ssize_t n = send(fd_, data + sent, size - sent, MSG_NOSIGNAL);
     if (n < 0) {
       if (errno == EINTR) continue;
@@ -97,6 +123,7 @@ Error HttpConnection::SendAll(const char* data, size_t size) {
 }
 
 Error HttpConnection::FillBuffer() {
+  CTPU_RETURN_IF_ERROR(WaitReadable(POLLIN));
   char tmp[65536];
   ssize_t n = recv(fd_, tmp, sizeof(tmp), 0);
   if (n < 0) {
@@ -135,6 +162,16 @@ Error HttpConnection::RoundtripStream(
     size_t body_size, int* status_out, std::string* resp_headers,
     const std::function<void(const char*, size_t)>& on_data,
     int64_t timeout_us) {
+  // One absolute deadline covers connect + send + the whole response
+  // (the reference's curl CURLOPT_TIMEOUT_MS role). A timeout mid-stream
+  // leaves the connection desynced, so timeout errors Close() it.
+  deadline_ns_ =
+      timeout_us > 0
+          ? std::chrono::duration_cast<std::chrono::nanoseconds>(
+                std::chrono::steady_clock::now().time_since_epoch())
+                    .count() +
+                timeout_us * 1000
+          : 0;
   std::string head;
   head.reserve(256 + uri.size());
   head += method + " /" + uri + " HTTP/1.1\r\n";
@@ -148,6 +185,8 @@ Error HttpConnection::RoundtripStream(
 
   // Send + read response headers, retrying once on a stale keep-alive
   // connection (the failure then surfaces at first read, not just send).
+  // A TIMEOUT never retries — the retry would double the caller's
+  // deadline.
   std::string hdr;
   for (int attempt = 0; attempt < 2; ++attempt) {
     if (!Connected()) {
@@ -168,7 +207,9 @@ Error HttpConnection::RoundtripStream(
       }
     }
     Close();
-    if (attempt == 1) return err;
+    if (attempt == 1 || err.Message() == "HTTP request timed out") {
+      return err;
+    }
   }
   if (hdr.compare(0, 5, "HTTP/") != 0) {
     return Error("malformed HTTP status line");
@@ -181,13 +222,21 @@ Error HttpConnection::RoundtripStream(
     while (true) {
       size_t eol;
       while ((eol = buf_.find("\r\n")) == std::string::npos) {
-        CTPU_RETURN_IF_ERROR(FillBuffer());
+        Error fill = FillBuffer();
+        if (!fill.IsOk()) {
+          Close();  // mid-body: the connection is desynced
+          return fill;
+        }
       }
       const size_t chunk_size = std::strtoul(buf_.c_str(), nullptr, 16);
       buf_.erase(0, eol + 2);
       if (chunk_size == 0) {
         while (buf_.find("\r\n") == std::string::npos) {
-          CTPU_RETURN_IF_ERROR(FillBuffer());
+          Error fill = FillBuffer();
+          if (!fill.IsOk()) {
+            Close();
+            return fill;
+          }
         }
         buf_.erase(0, buf_.find("\r\n") + 2);
         return Error::Success();
@@ -195,7 +244,11 @@ Error HttpConnection::RoundtripStream(
       // Whole chunks are delivered at once; servers emit one SSE event (or
       // a small batch) per chunk, so this is the event arrival granularity.
       while (buf_.size() < chunk_size + 2) {
-        CTPU_RETURN_IF_ERROR(FillBuffer());
+        Error fill = FillBuffer();
+        if (!fill.IsOk()) {
+          Close();
+          return fill;
+        }
       }
       on_data(buf_.data(), chunk_size);
       buf_.erase(0, chunk_size + 2);
@@ -222,11 +275,15 @@ Error HttpConnection::RoundtripStream(
     }
     Error fill = FillBuffer();
     if (!fill.IsOk()) {
-      // EOF-delimited body (no framing headers): close ends the stream.
-      if (content_length == std::string::npos) {
+      // EOF-delimited body (no framing headers): a CLOSE ends the stream
+      // — but a timeout is a failure, not an end-of-body marker, or the
+      // caller would get a silently truncated body reported as success.
+      if (content_length == std::string::npos &&
+          fill.Message() != "HTTP request timed out") {
         Close();
         return Error::Success();
       }
+      Close();  // mid-body: the connection is desynced
       return fill;
     }
   }
@@ -556,6 +613,37 @@ Error InferenceServerHttpClient::ModelInferenceStatistics(
   }
   uri += "/stats";
   return JsonGet(uri, stats);
+}
+
+Error InferenceServerHttpClient::UpdateTraceSettings(
+    json::Value* response, const std::string& model_name,
+    const std::map<std::string, std::vector<std::string>>& settings) {
+  std::string uri = model_name.empty()
+                        ? "v2/trace/setting"
+                        : "v2/models/" + model_name + "/trace/setting";
+  json::Object payload;
+  for (const auto& kv : settings) {
+    if (kv.second.empty()) {
+      // Clear-to-default semantic: null (the server skips null values;
+      // an empty ARRAY would overwrite the setting with []).
+      payload[kv.first] = json::Value();
+    } else if (kv.second.size() == 1) {
+      payload[kv.first] = kv.second[0];
+    } else {
+      json::Array values;
+      for (const auto& v : kv.second) values.push_back(json::Value(v));
+      payload[kv.first] = json::Value(std::move(values));
+    }
+  }
+  return JsonPost(uri, json::Value(std::move(payload)), response);
+}
+
+Error InferenceServerHttpClient::GetTraceSettings(
+    json::Value* settings, const std::string& model_name) {
+  std::string uri = model_name.empty()
+                        ? "v2/trace/setting"
+                        : "v2/models/" + model_name + "/trace/setting";
+  return JsonGet(uri, settings);
 }
 
 Error InferenceServerHttpClient::RegisterSystemSharedMemory(
@@ -918,7 +1006,10 @@ void InferenceServerHttpClient::AsyncWorker() {
       std::lock_guard<std::mutex> lk(mu_);
       UpdateInferStat(timers);
     }
-    job.callback(result.get());
+    // Ownership transfers to the callback — the reference's contract for
+    // BOTH protocols (reference http_client.h:476-483), and what this
+    // client's gRPC twin already does.
+    job.callback(result.release());
   }
 }
 

@@ -73,6 +73,8 @@ class HttpConnection {
  private:
   Error SendAll(const char* data, size_t size);
   Error FillBuffer();  // read() into buf_
+  // Blocks until fd is ready for `events` or deadline_ns_ expires.
+  Error WaitReadable(short events);
 
   std::string host_;
   int port_;
@@ -168,6 +170,14 @@ class InferenceServerHttpClient : public InferenceServerClient {
                                  const std::string& model_name = "",
                                  const std::string& model_version = "");
 
+  // Trace API (reference http_client.h:320-346 UpdateTraceSettings /
+  // GetTraceSettings): values are lists of strings per setting key.
+  Error UpdateTraceSettings(
+      json::Value* response, const std::string& model_name = "",
+      const std::map<std::string, std::vector<std::string>>& settings = {});
+  Error GetTraceSettings(json::Value* settings,
+                         const std::string& model_name = "");
+
   // Shared-memory registration (system + tpu regions;
   // reference http_client.h RegisterSystemSharedMemory /
   // RegisterCudaSharedMemory pair).
@@ -191,7 +201,9 @@ class InferenceServerHttpClient : public InferenceServerClient {
               const std::vector<InferInput*>& inputs,
               const std::vector<const InferRequestedOutput*>& outputs = {});
 
-  // Asynchronous inference: callback fires on a worker thread.
+  // Asynchronous inference: callback fires on a worker thread and OWNS
+  // the passed InferResult (reference http_client.h:476-483 ownership
+  // contract, matching the gRPC client's AsyncInfer).
   Error AsyncInfer(OnCompleteFn callback, const InferOptions& options,
                    const std::vector<InferInput*>& inputs,
                    const std::vector<const InferRequestedOutput*>& outputs = {});

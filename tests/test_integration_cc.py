@@ -1,0 +1,24 @@
+"""Runs the C++ dual-protocol integration suite (build/integration_tests).
+
+The binary spawns its own hermetic server and drives both C++ clients
+through every case (reference cc_client_test.cc + memory_leak_test.cc
+role); this wrapper just surfaces it in the Python test tier/CI.
+"""
+
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BINARY = os.path.join(REPO, "build", "integration_tests")
+
+
+@pytest.mark.skipif(not os.path.exists(BINARY), reason="native build absent")
+def test_integration_suite():
+    out = subprocess.run(
+        [BINARY], capture_output=True, text=True, timeout=600, cwd=REPO
+    )
+    tail = "\n".join(out.stdout.splitlines()[-20:])
+    assert out.returncode == 0, f"integration_tests failed:\n{tail}"
+    assert " 0 failures" in out.stdout, tail
