@@ -85,6 +85,69 @@ def load_dataset_prompts(
     return prompts
 
 
+# Hosted-dataset endpoints (the reference's dataset_url_map, reference
+# genai-perf llm_inputs/llm_inputs.py:48-49,70 — same HF datasets-server
+# rows API).
+HUB_DATASET_URLS = {
+    "openorca": (
+        "https://datasets-server.huggingface.co/rows?"
+        "dataset=Open-Orca%2FOpenOrca&config=default&split=train"
+    ),
+    "cnn_dailymail": (
+        "https://datasets-server.huggingface.co/rows?"
+        "dataset=cnn_dailymail&config=1.0.0&split=train"
+    ),
+}
+
+
+def fetch_hub_prompts(
+    dataset_name: str, starting_index: int = 0, length: int = 100
+) -> List[str]:
+    """Fetch prompts from a hosted dataset (reference
+    _get_input_dataset_from_url, llm_inputs.py:209-360).
+
+    Honors offline mode: HF_HUB_OFFLINE / HF_DATASETS_OFFLINE raise a
+    clear error instead of attempting network IO, so air-gapped runs use
+    --input-dataset files instead.
+    """
+    import os
+    import urllib.request
+
+    if dataset_name not in HUB_DATASET_URLS:
+        raise ValueError(
+            f"unknown hosted dataset '{dataset_name}' (supported: "
+            f"{', '.join(sorted(HUB_DATASET_URLS))})"
+        )
+    for flag in ("HF_HUB_OFFLINE", "HF_DATASETS_OFFLINE"):
+        if os.environ.get(flag, "") not in ("", "0"):
+            raise RuntimeError(
+                f"offline mode ({flag}={os.environ[flag]}): hosted-dataset "
+                f"fetch disabled; pass --input-dataset <file> instead"
+            )
+    url = (
+        f"{HUB_DATASET_URLS[dataset_name]}"
+        f"&offset={starting_index}&length={length}"
+    )
+    with urllib.request.urlopen(url, timeout=60) as response:
+        payload = json.loads(response.read().decode("utf-8"))
+    prompts: List[str] = []
+    for entry in payload.get("rows", []):
+        row = entry.get("row", {})
+        if dataset_name == "openorca":
+            system = row.get("system_prompt", "")
+            question = row.get("question", "")
+            prompt = (system + " " + question).strip()
+        else:  # cnn_dailymail
+            prompt = row.get("article", "")
+        if prompt:
+            prompts.append(prompt)
+    if not prompts:
+        raise ValueError(
+            f"hosted dataset '{dataset_name}' returned no usable rows"
+        )
+    return prompts
+
+
 def create_llm_inputs(
     path: str,
     num_prompts: int = 100,
@@ -100,6 +163,7 @@ def create_llm_inputs(
     streaming: bool = False,
     dataset_path: Optional[str] = None,
     dataset_format: str = "auto",
+    prompts: Optional[List[str]] = None,
 ) -> Dict:
     """Write a perf-harness input-data JSON of LLM requests.
 
@@ -110,11 +174,9 @@ def create_llm_inputs(
     """
     rng = random.Random(seed)
     tokenizer = tokenizer or SyntheticTokenizer()
-    dataset = (
-        load_dataset_prompts(dataset_path, dataset_format)
-        if dataset_path
-        else None
-    )
+    dataset = prompts
+    if dataset is None and dataset_path:
+        dataset = load_dataset_prompts(dataset_path, dataset_format)
     entries: List[Dict] = []
     for i in range(num_prompts):
         if dataset is not None:

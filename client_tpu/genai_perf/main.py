@@ -202,6 +202,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--profile-export-file", default="profile_export.json"
     )
     parser.add_argument(
+        "--dataset",
+        choices=["openorca", "cnn_dailymail"],
+        default=None,
+        help="fetch prompts from this hosted dataset (HF datasets-server; "
+        "honors HF_HUB_OFFLINE/HF_DATASETS_OFFLINE; the offline twin is "
+        "--input-dataset <file>)",
+    )
+    parser.add_argument(
         "--generate-plots", action="store_true",
         help="write latency/throughput plots (matplotlib if available)",
     )
@@ -262,10 +270,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
 
     tokenizer = get_tokenizer(args.tokenizer)
+    hub_prompts = None
+    if args.dataset:
+        from client_tpu.genai_perf.inputs import fetch_hub_prompts
+
+        try:
+            # the rows API caps length at 100; create_llm_inputs cycles
+            # a shorter prompt list up to num_prompts
+            hub_prompts = fetch_hub_prompts(
+                args.dataset, length=min(100, args.num_prompts)
+            )
+        except Exception as e:  # noqa: BLE001 - offline/unreachable hub
+            print(f"genai-perf: dataset fetch failed: {e}", file=sys.stderr)
+            return 1
     log.info(
         "generating %d prompts (%s) with tokenizer %s",
         args.num_prompts,
-        args.input_dataset or "synthetic",
+        args.dataset or args.input_dataset or "synthetic",
         type(tokenizer).__name__,
     )
     create_llm_inputs(
@@ -282,6 +303,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         streaming=openai and args.streaming,
         dataset_path=args.input_dataset,
         dataset_format=args.dataset_format,
+        prompts=hub_prompts,
     )
     log.info("profiling model %s at %s", args.model, args.url)
 
@@ -317,8 +339,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     metrics = LLMProfileDataParser(export_path).parse()
     print()
     print(console_table(metrics))
+    from client_tpu.genai_perf.tokenizer import tokenizer_provenance
+
     export_csv(metrics, os.path.join(artifact_dir, "llm_metrics.csv"))
-    export_json(metrics, os.path.join(artifact_dir, "llm_metrics.json"))
+    export_json(
+        metrics,
+        os.path.join(artifact_dir, "llm_metrics.json"),
+        tokenizer=tokenizer_provenance(tokenizer),
+    )
     print(f"\nartifacts: {artifact_dir}")
     if args.generate_plots:
         try:

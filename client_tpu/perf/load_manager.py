@@ -125,6 +125,7 @@ class LoadManager:
             record.error = str(e)
         record.end_ns = time.monotonic_ns()
         record.sequence_id = seq_kwargs.get("sequence_id", 0)
+        record.ctx_id = slot if slot is not None else 0
         self.records.append(record)
         return record
 
@@ -216,6 +217,10 @@ class RequestRateManager(LoadManager):
         super().__init__(*args, **kwargs)
         self.distribution = distribution
         self._rng = np.random.default_rng(seed)
+        # Context selection gets its OWN stream: sharing the schedule rng
+        # would correlate Poisson intervals with ctx draws (the exact
+        # coupling random selection exists to remove).
+        self._ctx_rng = np.random.default_rng(seed ^ 0x9E3779B97F4A7C15)
         self._dispatcher: Optional[asyncio.Task] = None
         self._inflight: set = set()
         self.schedule_slip_ns = 0
@@ -269,6 +274,15 @@ class RequestRateManager(LoadManager):
                 await asyncio.sleep(delay)
             else:
                 self.schedule_slip_ns += int(-delay * 1e9)
+            if self.sequences is None:
+                # Non-sequence rate mode: the context id attributed to
+                # each dispatch is drawn uniformly at random (reference
+                # rand_ctx_id_tracker.h:28-48 via CtxIdTrackerFactory) —
+                # round-robin would correlate context reuse with the
+                # schedule. This harness's open-loop contexts are virtual
+                # (asyncio tasks), so the id's observable effect is the
+                # per-request ctx_id attribution in the records.
+                slot = int(self._ctx_rng.integers(self.num_sequence_slots))
             task = asyncio.ensure_future(self.issue_one(stream, step, slot=slot))
             self._inflight.add(task)
             task.add_done_callback(self._inflight.discard)

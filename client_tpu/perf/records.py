@@ -23,6 +23,10 @@ class RequestRecord:
     error: Optional[str] = None
     sequence_id: int = 0
     request_id: str = ""
+    # context/slot the dispatcher attributed this request to (rate mode
+    # draws it randomly for non-sequence models, reference
+    # rand_ctx_id_tracker.h; sequences own their slot)
+    ctx_id: int = 0
 
     @property
     def latency_ns(self) -> int:

@@ -1,0 +1,67 @@
+// Context-id selection for dispatching requests onto worker contexts
+// (reference ictx_id_tracker.h + rand_ctx_id_tracker.h:28-48 +
+// ctx_id_tracker_factory.h): concurrency mode owns one context per slot
+// (round-robin / fifo semantics), while RATE mode picks a RANDOM context
+// per dispatch for non-sequence models — round-robin there correlates
+// context reuse with the schedule and skews rate-mode latency
+// distributions whenever contexts own resources (connections, per-slot
+// output shm regions).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <mutex>
+#include <random>
+
+namespace ctpu {
+namespace perf {
+
+class ICtxIdTracker {
+ public:
+  virtual ~ICtxIdTracker() = default;
+  virtual void Reset(size_t count) = 0;
+  virtual size_t Get() = 0;
+};
+
+// Deterministic cycling (the concurrency/serial-sequence semantic).
+class RoundRobinCtxIdTracker : public ICtxIdTracker {
+ public:
+  void Reset(size_t count) override {
+    std::lock_guard<std::mutex> lk(mu_);
+    count_ = count == 0 ? 1 : count;
+    next_ = 0;
+  }
+  size_t Get() override {
+    std::lock_guard<std::mutex> lk(mu_);
+    return next_++ % count_;
+  }
+
+ private:
+  std::mutex mu_;
+  size_t count_ = 1;
+  size_t next_ = 0;
+};
+
+// Uniform-random selection (reference RandCtxIdTracker); seedable so
+// benchmark runs stay reproducible under --random-seed.
+class RandCtxIdTracker : public ICtxIdTracker {
+ public:
+  explicit RandCtxIdTracker(uint64_t seed = 0) : rng_(seed) {}
+  void Reset(size_t count) override {
+    std::lock_guard<std::mutex> lk(mu_);
+    dist_ = std::uniform_int_distribution<size_t>(
+        0, (count == 0 ? 1 : count) - 1);
+  }
+  size_t Get() override {
+    std::lock_guard<std::mutex> lk(mu_);
+    return dist_(rng_);
+  }
+
+ private:
+  std::mutex mu_;
+  std::mt19937_64 rng_;
+  std::uniform_int_distribution<size_t> dist_{0, 0};
+};
+
+}  // namespace perf
+}  // namespace ctpu

@@ -58,6 +58,10 @@ class IInferDataManager {
   virtual uint64_t CacheToken(size_t slot, size_t stream,
                               size_t step) const = 0;
   virtual Error Cleanup() { return Error::Success(); }
+  // True when concurrent in-flight requests must never share a slot
+  // (per-slot output shm regions, see Prepare): dispatchers then keep
+  // deterministic slot assignment instead of random context selection.
+  virtual bool SlotExclusive() const { return false; }
 };
 
 // Plain mode: inputs reference the loader's tensor bytes directly
@@ -130,6 +134,9 @@ class InferDataManagerShm : public IInferDataManager {
   Error Init() override;
   Error Prepare(size_t slot, size_t stream, size_t step,
                 PreparedRequest* request) override;
+  bool SlotExclusive() const override {
+    return output_shm_size_ > 0 && !output_descs_.empty();
+  }
   uint64_t CacheToken(size_t slot, size_t stream,
                       size_t step) const override {
     // Output regions are per-slot, so the token carries the slot whenever

@@ -236,6 +236,18 @@ void RequestRateManager::StartPool() {
   std::lock_guard<std::mutex> lk(pool_mu_);
   if (pool_running_) return;
   pool_running_ = true;
+  if (sequences_ == nullptr && !data_->SlotExclusive()) {
+    // Decorrelate from the schedule rng (same seed would make Poisson
+    // intervals and ctx ids monotone functions of the same raw draws).
+    ctx_tracker_.reset(
+        new RandCtxIdTracker(seed_ ^ 0x9e3779b97f4a7c15ULL));
+  } else {
+    // Sequences own their slots; per-slot output shm regions must never
+    // be shared by concurrent in-flight requests (infer_data.h:50-51) —
+    // both need deterministic slot assignment.
+    ctx_tracker_.reset(new RoundRobinCtxIdTracker());
+  }
+  ctx_tracker_->Reset(config_.max_threads);
   for (size_t i = 0; i < config_.max_threads; ++i) {
     pool_.emplace_back(&RequestRateManager::PoolWorker, this);
   }
@@ -320,10 +332,14 @@ void RequestRateManager::PoolWorker() {
       size_t slot = dispatch % config_.max_threads;
       IssueOne(ctx.get(), slot, slot, dispatch);
     } else {
-      // cover every stream of a multi-stream corpus round-robin
+      // cover every stream of a multi-stream corpus round-robin; the
+      // SLOT (context identity) is drawn uniformly at random per
+      // dispatch (reference rand_ctx_id_tracker.h) — round-robin would
+      // correlate context reuse with the schedule — EXCEPT when per-slot
+      // output shm regions make slots exclusive (see StartPool).
       size_t streams = std::max<size_t>(1, config_.stream_count);
-      IssueOne(ctx.get(), dispatch % config_.max_threads,
-               dispatch % streams, dispatch / streams);
+      IssueOne(ctx.get(), ctx_tracker_->Get(), dispatch % streams,
+               dispatch / streams);
     }
   }
 }

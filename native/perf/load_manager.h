@@ -21,6 +21,7 @@
 #include <vector>
 
 #include "client_backend.h"
+#include "ctx_id_tracker.h"
 #include "infer_data.h"
 #include "model_parser.h"
 #include "sequence_manager.h"
@@ -204,7 +205,8 @@ class RequestRateManager : public LoadManager {
       : LoadManager(std::move(backend), data_manager, std::move(config),
                     sequences),
         distribution_(distribution),
-        rng_(seed) {}
+        rng_(seed),
+        seed_(seed) {}
   ~RequestRateManager() override { Stop(); }
 
   // Replace the dispatch schedule (reference ChangeRequestRate).
@@ -232,6 +234,11 @@ class RequestRateManager : public LoadManager {
 
   Distribution distribution_;
   std::mt19937_64 rng_;
+  uint64_t seed_ = 0;
+  // Rate-mode non-sequence dispatch picks a RANDOM context per request
+  // (reference CtxIdTrackerFactory: !is_concurrency && !is_sequence ->
+  // RandCtxIdTracker); sequences keep deterministic slot ownership.
+  std::unique_ptr<ICtxIdTracker> ctx_tracker_;
   std::function<uint64_t()> now_fn_;
   std::function<void(uint64_t)> sleep_until_fn_;
   std::thread scheduler_;

@@ -1,0 +1,69 @@
+// Context-id tracker tests (reference test_ctx_id_tracker.cc role):
+// uniform selection for the rate-mode random tracker, determinism under a
+// seed, and round-robin cycling for the concurrency semantic.
+#include <map>
+
+#include "ctx_id_tracker.h"
+#include "test_framework.h"
+
+using namespace ctpu::perf;
+
+TEST_CASE("ctx tracker: random selection is uniform over the pool") {
+  RandCtxIdTracker tracker(/*seed=*/42);
+  tracker.Reset(8);
+  std::map<size_t, int> counts;
+  constexpr int kDraws = 16000;
+  for (int i = 0; i < kDraws; ++i) counts[tracker.Get()]++;
+  CHECK_EQ(counts.size(), 8u);
+  for (const auto& kv : counts) {
+    CHECK(kv.first < 8u);
+    // each id expected kDraws/8 = 2000; allow a generous +-15% band
+    CHECK(kv.second > 1700);
+    CHECK(kv.second < 2300);
+  }
+}
+
+TEST_CASE("ctx tracker: random selection is deterministic per seed") {
+  RandCtxIdTracker a(7);
+  RandCtxIdTracker b(7);
+  RandCtxIdTracker c(8);
+  a.Reset(16);
+  b.Reset(16);
+  c.Reset(16);
+  bool same_seed_equal = true;
+  bool other_seed_diverges = false;
+  for (int i = 0; i < 256; ++i) {
+    size_t va = a.Get();
+    if (va != b.Get()) same_seed_equal = false;
+    if (va != c.Get()) other_seed_diverges = true;
+  }
+  CHECK(same_seed_equal);
+  CHECK(other_seed_diverges);
+}
+
+TEST_CASE("ctx tracker: random draws are not round-robin") {
+  RandCtxIdTracker tracker(1);
+  tracker.Reset(4);
+  int repeats = 0;
+  size_t prev = tracker.Get();
+  for (int i = 0; i < 1000; ++i) {
+    size_t id = tracker.Get();
+    if (id == prev) repeats++;
+    prev = id;
+  }
+  CHECK(repeats > 100);  // ~1/4 of draws repeat for a uniform 4-way pick
+}
+
+TEST_CASE("ctx tracker: round-robin cycles the pool in order") {
+  RoundRobinCtxIdTracker tracker;
+  tracker.Reset(3);
+  for (int lap = 0; lap < 4; ++lap) {
+    CHECK_EQ(tracker.Get(), 0u);
+    CHECK_EQ(tracker.Get(), 1u);
+    CHECK_EQ(tracker.Get(), 2u);
+  }
+  tracker.Reset(2);
+  CHECK_EQ(tracker.Get(), 0u);
+  CHECK_EQ(tracker.Get(), 1u);
+  CHECK_EQ(tracker.Get(), 0u);
+}

@@ -395,3 +395,73 @@ def test_generate_plots_full_set(tmp_path):
         "throughput_over_time.png",
     ):
         assert (tmp_path / name).exists(), name
+
+
+def test_hub_fetch_offline_mode_and_parsing(monkeypatch):
+    """fetch_hub_prompts: offline flags gate network IO; the rows-API
+    payloads parse per dataset schema (reference llm_inputs.py:209-360)."""
+    import io
+    import urllib.request
+
+    from client_tpu.genai_perf.inputs import fetch_hub_prompts
+
+    monkeypatch.setenv("HF_HUB_OFFLINE", "1")
+    with pytest.raises(RuntimeError, match="offline"):
+        fetch_hub_prompts("openorca")
+    monkeypatch.delenv("HF_HUB_OFFLINE")
+
+    with pytest.raises(ValueError, match="unknown hosted dataset"):
+        fetch_hub_prompts("not_a_dataset")
+
+    captured = {}
+
+    def fake_urlopen(url, timeout=0):
+        captured["url"] = url
+        payload = {
+            "rows": [
+                {"row": {"system_prompt": "sys", "question": "q1"}},
+                {"row": {"question": "q2"}},
+                {"row": {"irrelevant": True}},
+            ]
+        }
+        body = io.BytesIO(json.dumps(payload).encode())
+        body.__enter__ = lambda *a: body
+        body.__exit__ = lambda *a: False
+        return body
+
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    prompts = fetch_hub_prompts("openorca", starting_index=5, length=2)
+    assert prompts == ["sys q1", "q2"]
+    assert "offset=5" in captured["url"] and "length=2" in captured["url"]
+
+
+def test_metrics_json_carries_tokenizer_provenance(tmp_path):
+    from client_tpu.genai_perf.metrics import LLMProfileDataParser, export_json
+    from client_tpu.genai_perf.tokenizer import (
+        get_tokenizer,
+        tokenizer_provenance,
+    )
+
+    ms = 1_000_000
+    doc = {
+        "experiments": [
+            {
+                "experiment": {"mode": "concurrency", "value": 1},
+                "requests": [
+                    {
+                        "timestamp": 0,
+                        "response_timestamps": [3 * ms, 4 * ms],
+                        "success": True,
+                    }
+                ],
+            }
+        ]
+    }
+    export = tmp_path / "profile.json"
+    export.write_text(json.dumps(doc))
+    metrics = LLMProfileDataParser(str(export)).parse()
+    out = tmp_path / "llm_metrics.json"
+    tok = get_tokenizer("bpe")
+    export_json(metrics, str(out), tokenizer=tokenizer_provenance(tok))
+    data = json.loads(out.read_text())
+    assert data["tokenizer"] == "bundled-bpe8k"
