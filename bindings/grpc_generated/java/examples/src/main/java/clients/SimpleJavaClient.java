@@ -1,0 +1,84 @@
+// Minimal gRPC stub-library example against the client_tpu server (role
+// of reference src/grpc_generated/java/examples SimpleJavaClient.java):
+// liveness probe, then one ModelInfer on the 'simple' add_sub model using
+// raw little-endian tensor contents, printing OUTPUT0/OUTPUT1.
+//
+// Run (after `mvn install` in ../library):
+//   mvn compile exec:java -Dexec.args="localhost 8001"
+package clients;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+
+import com.google.protobuf.ByteString;
+
+import inference.GRPCInferenceServiceGrpc;
+import inference.GRPCInferenceServiceGrpc.GRPCInferenceServiceBlockingStub;
+import inference.GrpcService.ModelInferRequest;
+import inference.GrpcService.ModelInferResponse;
+import inference.GrpcService.ServerLiveRequest;
+import inference.GrpcService.ServerLiveResponse;
+import io.grpc.ManagedChannel;
+import io.grpc.ManagedChannelBuilder;
+
+public class SimpleJavaClient {
+
+  public static void main(String[] args) {
+    String host = args.length > 0 ? args[0] : "localhost";
+    int port = args.length > 1 ? Integer.parseInt(args[1]) : 8001;
+
+    ManagedChannel channel =
+        ManagedChannelBuilder.forAddress(host, port).usePlaintext().build();
+    GRPCInferenceServiceBlockingStub stub =
+        GRPCInferenceServiceGrpc.newBlockingStub(channel);
+
+    ServerLiveResponse live =
+        stub.serverLive(ServerLiveRequest.getDefaultInstance());
+    System.out.println("server live: " + live.getLive());
+
+    int n = 16;
+    ByteBuffer input0 = ByteBuffer.allocate(4 * n).order(ByteOrder.LITTLE_ENDIAN);
+    ByteBuffer input1 = ByteBuffer.allocate(4 * n).order(ByteOrder.LITTLE_ENDIAN);
+    for (int i = 0; i < n; i++) {
+      input0.putInt(i);
+      input1.putInt(1);
+    }
+    input0.flip();
+    input1.flip();
+
+    ModelInferRequest request =
+        ModelInferRequest.newBuilder()
+            .setModelName("simple")
+            .addInputs(
+                ModelInferRequest.InferInputTensor.newBuilder()
+                    .setName("INPUT0")
+                    .setDatatype("INT32")
+                    .addShape(1)
+                    .addShape(n))
+            .addInputs(
+                ModelInferRequest.InferInputTensor.newBuilder()
+                    .setName("INPUT1")
+                    .setDatatype("INT32")
+                    .addShape(1)
+                    .addShape(n))
+            .addRawInputContents(ByteString.copyFrom(input0))
+            .addRawInputContents(ByteString.copyFrom(input1))
+            .build();
+
+    ModelInferResponse response = stub.modelInfer(request);
+
+    for (int out = 0; out < response.getOutputsCount(); out++) {
+      String name = response.getOutputs(out).getName();
+      ByteBuffer raw =
+          response.getRawOutputContents(out).asReadOnlyByteBuffer()
+              .order(ByteOrder.LITTLE_ENDIAN);
+      StringBuilder values = new StringBuilder();
+      while (raw.hasRemaining()) {
+        values.append(raw.getInt()).append(' ');
+      }
+      System.out.println(name + ": " + values.toString().trim());
+    }
+
+    channel.shutdownNow();
+  }
+}

@@ -1,0 +1,46 @@
+#!/usr/bin/env python3
+"""Drives the add_sub_chain ensemble (simple -> simple pipeline executed
+server-side; intermediate tensors never touch the wire — reference
+ensemble_image_client.py role over this repo's demo ensemble)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    in1 = np.full([1, 16], 3, dtype=np.int32)
+    with grpcclient.InferenceServerClient(args.url) as client:
+        config = client.get_model_config("add_sub_chain", as_json=True)
+        steps = (
+            config.get("config", {})
+            .get("ensemble_scheduling", {})
+            .get("step", [])
+        )
+        if len(steps) != 2:
+            sys.exit(f"error: expected a 2-step ensemble, got {steps!r}")
+        inputs = [
+            grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+            grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(in0)
+        inputs[1].set_data_from_numpy(in1)
+        result = client.infer("add_sub_chain", inputs)
+        # (a+b)+(a-b) = 2a ; (a+b)-(a-b) = 2b
+        if not (result.as_numpy("OUTPUT0") == 2 * in0).all():
+            sys.exit("error: OUTPUT0 != 2*INPUT0")
+        if not (result.as_numpy("OUTPUT1") == 2 * in1).all():
+            sys.exit("error: OUTPUT1 != 2*INPUT1")
+    print("PASS: grpc_ensemble_chain_client")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+#!/usr/bin/env python3
+"""Image classification over gRPC with the classification extension:
+sends an image tensor (a file via --image, or a synthetic gradient) and
+prints the top-k "score:index:label" strings
+(reference grpc_image_client.py)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+
+def load_image(path, size):
+    if path is None:
+        # synthetic gradient image: deterministic, no files needed
+        ramp = np.linspace(0.0, 1.0, size, dtype=np.float32)
+        return np.stack(
+            [np.tile(ramp, (size, 1))] * 3, axis=-1
+        )  # [H, W, 3]
+    try:
+        from PIL import Image
+    except ImportError:
+        sys.exit("error: --image requires Pillow (or omit for synthetic)")
+    img = Image.open(path).convert("RGB").resize((size, size))
+    return np.asarray(img, dtype=np.float32) / 255.0
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-m", "--model", default="image_classifier")
+    parser.add_argument("--image", default=None, help="image file (optional)")
+    parser.add_argument("-c", "--classes", type=int, default=3)
+    args = parser.parse_args()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        metadata = client.get_model_metadata(args.model, as_json=True)
+        shape = metadata["inputs"][0]["shape"]
+        size = int(shape[-2])  # [-1, H, W, 3] or [H, W, 3]
+        image = load_image(args.image, size)[None, ...]  # batch of 1
+
+        inp = grpcclient.InferInput("INPUT", list(image.shape), "FP32")
+        inp.set_data_from_numpy(np.ascontiguousarray(image))
+        outputs = [
+            grpcclient.InferRequestedOutput(
+                "OUTPUT", class_count=args.classes
+            )
+        ]
+        result = client.infer(args.model, [inp], outputs=outputs)
+        entries = result.as_numpy("OUTPUT").reshape(-1)
+        if len(entries) != args.classes:
+            sys.exit(f"error: expected top-{args.classes}, got {entries!r}")
+        for entry in entries:
+            text = entry.decode() if isinstance(entry, bytes) else str(entry)
+            print("   ", text)
+            if text.count(":") < 1:
+                sys.exit(f"error: malformed classification entry {text!r}")
+    print("PASS: grpc_image_client")
+
+
+if __name__ == "__main__":
+    main()

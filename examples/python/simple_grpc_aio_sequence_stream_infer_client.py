@@ -1,0 +1,53 @@
+#!/usr/bin/env python3
+"""Sequence steps over the asyncio bidirectional stream: all steps of two
+interleaved sequences ride ONE ModelStreamInfer stream
+(reference simple_grpc_aio_sequence_stream_infer_client.py role)."""
+
+import argparse
+import asyncio
+import sys
+
+import numpy as np
+
+import client_tpu.grpc.aio as grpcclient
+
+
+async def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    values = [2, 3, 4]
+    sequences = (3001, 3002)
+
+    async def requests():
+        for step, value in enumerate(values):
+            for sequence_id in sequences:
+                inp = grpcclient.InferInput("INPUT", [1], "INT32")
+                inp.set_data_from_numpy(np.array([value], dtype=np.int32))
+                yield {
+                    "model_name": "sequence_accumulate",
+                    "inputs": [inp],
+                    "sequence_id": sequence_id,
+                    "sequence_start": step == 0,
+                    "sequence_end": step == len(values) - 1,
+                }
+
+    expected_final = sum(values)
+    finals = []
+    async with grpcclient.InferenceServerClient(args.url) as client:
+        async for result, error in client.stream_infer(requests()):
+            if error is not None:
+                sys.exit(f"error: stream error: {error}")
+            finals.append(int(result.as_numpy("OUTPUT")[0]))
+            if len(finals) == len(values) * len(sequences):
+                break
+    # the two sequences accumulate independently; the final responses
+    # (one per sequence) must both equal the full sum
+    if sorted(finals)[-2:] != [expected_final, expected_final]:
+        sys.exit(f"error: unexpected accumulator values {finals}")
+    print("PASS: simple_grpc_aio_sequence_stream_infer_client")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

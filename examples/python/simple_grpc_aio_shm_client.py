@@ -1,0 +1,51 @@
+#!/usr/bin/env python3
+"""System shared memory over the asyncio gRPC client
+(reference aio shm example role)."""
+
+import argparse
+import asyncio
+import sys
+
+import numpy as np
+
+import client_tpu.grpc.aio as grpcclient
+import client_tpu.utils.shared_memory as shm
+
+
+async def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    in1 = np.ones([1, 16], dtype=np.int32)
+    byte_size = in0.nbytes
+
+    handle = shm.create_shared_memory_region(
+        "aio_example_in", "aio_example_in_key", 2 * byte_size
+    )
+    async with grpcclient.InferenceServerClient(args.url) as client:
+        try:
+            shm.set_shared_memory_region(handle, [in0, in1])
+            await client.register_system_shared_memory(
+                "aio_example_in", "aio_example_in_key", 2 * byte_size
+            )
+            inputs = [
+                grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+                grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+            ]
+            inputs[0].set_shared_memory("aio_example_in", byte_size)
+            inputs[1].set_shared_memory(
+                "aio_example_in", byte_size, offset=byte_size
+            )
+            result = await client.infer("simple", inputs)
+            if not (result.as_numpy("OUTPUT0") == in0 + in1).all():
+                sys.exit("error: incorrect result")
+        finally:
+            await client.unregister_system_shared_memory("aio_example_in")
+            shm.destroy_shared_memory_region(handle)
+    print("PASS: simple_grpc_aio_shm_client")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

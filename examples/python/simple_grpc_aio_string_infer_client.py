@@ -1,0 +1,33 @@
+#!/usr/bin/env python3
+"""BYTES (string) tensors over the asyncio gRPC client
+(reference simple_grpc_aio_string_infer_client role)."""
+
+import argparse
+import asyncio
+import sys
+
+import numpy as np
+
+import client_tpu.grpc.aio as grpcclient
+
+
+async def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    values = np.array(
+        [b"alpha", b"beta", b"tpu"], dtype=np.object_
+    ).reshape(1, 3)
+    async with grpcclient.InferenceServerClient(args.url) as client:
+        inp = grpcclient.InferInput("INPUT0", [1, 3], "BYTES")
+        inp.set_data_from_numpy(values)
+        result = await client.infer("identity_bytes", [inp])
+        out = result.as_numpy("OUTPUT0")
+        if not (out == values).all():
+            sys.exit(f"error: roundtrip mismatch: {out!r}")
+    print("PASS: simple_grpc_aio_string_infer_client")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

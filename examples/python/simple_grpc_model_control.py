@@ -1,0 +1,34 @@
+#!/usr/bin/env python3
+"""Explicit model control over gRPC: index, unload, verify, reload
+(reference simple_grpc_model_control.py)."""
+
+import argparse
+import sys
+
+import client_tpu.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("--model", default="identity_fp32")
+    args = parser.parse_args()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        index = client.get_model_repository_index(as_json=True)
+        names = {m["name"] for m in index.get("models", [])}
+        if args.model not in names:
+            sys.exit(f"error: '{args.model}' not in repository index")
+
+        client.unload_model(args.model)
+        if client.is_model_ready(args.model):
+            sys.exit("error: model still ready after unload")
+
+        client.load_model(args.model)
+        if not client.is_model_ready(args.model):
+            sys.exit("error: model not ready after load")
+    print("PASS: simple_grpc_model_control")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+#!/usr/bin/env python3
+"""System shared-memory data plane over gRPC: inputs AND outputs ride a
+POSIX shm region, the wire carries only region references
+(reference simple_grpc_shm_client.py)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+import client_tpu.utils.shared_memory as shm
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    in1 = np.ones([1, 16], dtype=np.int32)
+    byte_size = in0.nbytes
+
+    client = grpcclient.InferenceServerClient(args.url)
+    input_handle = shm.create_shared_memory_region(
+        "grpc_example_in", "grpc_example_in_key", 2 * byte_size
+    )
+    output_handle = shm.create_shared_memory_region(
+        "grpc_example_out", "grpc_example_out_key", 2 * byte_size
+    )
+    try:
+        shm.set_shared_memory_region(input_handle, [in0, in1])
+        client.register_system_shared_memory(
+            "grpc_example_in", "grpc_example_in_key", 2 * byte_size
+        )
+        client.register_system_shared_memory(
+            "grpc_example_out", "grpc_example_out_key", 2 * byte_size
+        )
+
+        inputs = [
+            grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+            grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_shared_memory("grpc_example_in", byte_size)
+        inputs[1].set_shared_memory(
+            "grpc_example_in", byte_size, offset=byte_size
+        )
+        outputs = [
+            grpcclient.InferRequestedOutput("OUTPUT0"),
+            grpcclient.InferRequestedOutput("OUTPUT1"),
+        ]
+        outputs[0].set_shared_memory("grpc_example_out", byte_size)
+        outputs[1].set_shared_memory(
+            "grpc_example_out", byte_size, offset=byte_size
+        )
+
+        result = client.infer("simple", inputs, outputs=outputs)
+        if result.as_numpy("OUTPUT0") is not None:
+            sys.exit("error: output unexpectedly inline")
+        out0 = shm.get_contents_as_numpy(
+            output_handle, np.int32, [1, 16]
+        )
+        out1 = shm.get_contents_as_numpy(
+            output_handle, np.int32, [1, 16], offset=byte_size
+        )
+        if not ((out0 == in0 + in1).all() and (out1 == in0 - in1).all()):
+            sys.exit("error: incorrect shm results")
+    finally:
+        client.unregister_system_shared_memory("grpc_example_in")
+        client.unregister_system_shared_memory("grpc_example_out")
+        shm.destroy_shared_memory_region(input_handle)
+        shm.destroy_shared_memory_region(output_handle)
+        client.close()
+    print("PASS: simple_grpc_shm_client")
+
+
+if __name__ == "__main__":
+    main()

@@ -57,6 +57,14 @@ def run_example(name, server, *args):
         ("image_client.py", []),
         ("reuse_infer_objects_client.py", []),
         ("memory_growth_test.py", ["--iterations", "50"]),
+        ("simple_grpc_string_infer_client.py", []),
+        ("simple_grpc_shm_client.py", []),
+        ("simple_grpc_model_control.py", []),
+        ("grpc_ensemble_chain_client.py", []),
+        ("grpc_image_client.py", []),
+        ("simple_grpc_aio_string_infer_client.py", []),
+        ("simple_grpc_aio_shm_client.py", []),
+        ("simple_grpc_aio_sequence_stream_infer_client.py", []),
     ],
 )
 def test_example(server, name, args):
