@@ -264,6 +264,12 @@ class InferenceServerGrpcClient : public InferenceServerClient {
   std::string compression_;  // "" = none; "deflate" | "gzip"
 
   std::mutex conn_mu_;
+  // Encoded-HPACK header-block cache for the default hot path (no user
+  // headers, no timeout): our encoder is static-table-only, so the block
+  // is a per-client constant per method. Invalidated by SetCompression
+  // (which, like the reference, must not race in-flight calls).
+  std::mutex hdr_mu_;
+  std::map<std::string, std::string> hdr_cache_;
   // shared_ptr: in-flight calls hold a reference so a reconnect (which
   // replaces conn_) can never free a connection out from under them.
   std::shared_ptr<h2::Connection> conn_;

@@ -220,6 +220,13 @@ int32_t Connection::StartStreamWithData(
     bool end_stream, StreamEvents events, size_t* sent) {
   std::string block;
   hpack::Encode(headers, &block);
+  return StartStreamWithEncodedHeaders(block, data, len, end_stream,
+                                       std::move(events), sent);
+}
+
+int32_t Connection::StartStreamWithEncodedHeaders(
+    const std::string& block, const void* data, size_t len, bool end_stream,
+    StreamEvents events, size_t* sent) {
   uint32_t id;
   bool ok;
   size_t data_sent = 0;
@@ -443,20 +450,44 @@ void Connection::CloseStreamLocked(uint32_t stream_id, bool ok,
 }
 
 void Connection::ReaderLoop() {
+  // Buffered reads: a unary gRPC response is typically three SMALL frames
+  // (HEADERS + DATA + trailing HEADERS) and unbuffered reads cost two
+  // recv syscalls per frame (header, payload). One large recv drains many
+  // frames per syscall under load.
+  std::vector<uint8_t> rbuf(64 * 1024);
+  size_t rlen = 0;
+  size_t roff = 0;
+  // Fills `need` bytes into dst from the buffer (refilling via recv).
+  // Returns 1 on success, 0 on clean EOF before any byte, -1 on error or
+  // mid-item truncation.
+  auto fill = [&](uint8_t* dst, size_t need) -> int {
+    const size_t wanted = need;
+    while (need > 0) {
+      if (roff == rlen) {
+        ssize_t n = ::recv(fd_, rbuf.data(), rbuf.size(), 0);
+        if (n < 0 && errno == EINTR) continue;
+        if (n <= 0) return (n == 0 && need == wanted) ? 0 : -1;
+        rlen = static_cast<size_t>(n);
+        roff = 0;
+      }
+      const size_t take = std::min(need, rlen - roff);
+      memcpy(dst, rbuf.data() + roff, take);
+      roff += take;
+      dst += take;
+      need -= take;
+    }
+    return 1;
+  };
+
   std::vector<uint8_t> buf;
   uint8_t fh[9];
   while (!dead_.load()) {
     // Read one frame header.
-    size_t got = 0;
-    while (got < 9) {
-      ssize_t n = ::recv(fd_, fh + got, 9 - got, 0);
-      if (n <= 0) {
-        if (n < 0 && errno == EINTR) continue;
-        Shutdown(got == 0 ? "connection closed by peer"
-                          : "truncated frame header");
-        return;
-      }
-      got += static_cast<size_t>(n);
+    int rc = fill(fh, 9);
+    if (rc != 1) {
+      Shutdown(rc == 0 ? "connection closed by peer"
+                       : "truncated frame header");
+      return;
     }
     const uint32_t len = (uint32_t(fh[0]) << 16) | (uint32_t(fh[1]) << 8) |
                          uint32_t(fh[2]);
@@ -468,15 +499,9 @@ void Connection::ReaderLoop() {
       return;
     }
     buf.resize(len);
-    got = 0;
-    while (got < len) {
-      ssize_t n = ::recv(fd_, buf.data() + got, len - got, 0);
-      if (n <= 0) {
-        if (n < 0 && errno == EINTR) continue;
-        Shutdown("truncated frame payload");
-        return;
-      }
-      got += static_cast<size_t>(n);
+    if (len > 0 && fill(buf.data(), len) != 1) {
+      Shutdown("truncated frame payload");
+      return;
     }
     HandleFrame(type, flags, stream_id, buf.data(), len);
   }

@@ -82,6 +82,13 @@ class Connection {
   int32_t StartStreamWithData(const std::vector<hpack::Header>& headers,
                               const void* data, size_t len, bool end_stream,
                               StreamEvents events, size_t* sent);
+  // Same, with a PRE-ENCODED HPACK header block (hpack::Encode output).
+  // This encoder never uses the dynamic table, so a client whose headers
+  // are per-connection constants can encode once and resend the bytes.
+  int32_t StartStreamWithEncodedHeaders(const std::string& header_block,
+                                        const void* data, size_t len,
+                                        bool end_stream, StreamEvents events,
+                                        size_t* sent);
 
   // Sends DATA on an open stream, chunked to the peer's max frame size and
   // blocking on send flow control. Returns false if the stream/connection

@@ -8,6 +8,8 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
+#include <cerrno>
 #include <cstring>
 
 namespace ctpu {
@@ -127,11 +129,23 @@ void ServerConnection::Join() {
 }
 
 bool ServerConnection::ReadN(uint8_t* buf, size_t len) {
+  // Buffered: a unary gRPC request is several SMALL frames and the frame
+  // loop calls ReadN twice per frame (header, payload); one large recv
+  // drains many frames per syscall under load. Reader-thread only.
+  if (rbuf_.empty()) rbuf_.resize(64 * 1024);
   while (len > 0) {
-    ssize_t n = ::recv(fd_, buf, len, 0);
-    if (n <= 0) return false;
-    buf += n;
-    len -= n;
+    if (roff_ == rlen_) {
+      ssize_t n = ::recv(fd_, rbuf_.data(), rbuf_.size(), 0);
+      if (n < 0 && errno == EINTR) continue;
+      if (n <= 0) return false;
+      rlen_ = static_cast<size_t>(n);
+      roff_ = 0;
+    }
+    const size_t take = std::min(len, rlen_ - roff_);
+    memcpy(buf, rbuf_.data() + roff_, take);
+    roff_ += take;
+    buf += take;
+    len -= take;
   }
   return true;
 }

@@ -134,6 +134,10 @@ class ServerConnection {
   StreamState* GetStream(uint32_t stream_id);  // mu_ held
 
   int fd_ = -1;
+  // ReadN's recv buffer (reader-thread only).
+  std::vector<uint8_t> rbuf_;
+  size_t roff_ = 0;
+  size_t rlen_ = 0;
   std::atomic<bool> dead_{false};
   std::atomic<bool> close_fired_{false};
   ConnectionCallbacks cbs_;
