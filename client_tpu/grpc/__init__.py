@@ -24,7 +24,16 @@ from client_tpu.grpc._requested_output import InferRequestedOutput
 from client_tpu.grpc._service_stubs import GRPCInferenceServiceStub
 from client_tpu.grpc._utils import (
     get_inference_request,
+    is_sequence_request as _is_sequence_request,
     rpc_error_to_exception,
+)
+from client_tpu.resilience import (
+    CircuitBreaker,
+    CircuitBreakerOpenError,
+    RetryPolicy,
+    record_breaker_outcome,
+    run_with_resilience,
+    sequence_is_idempotent,
 )
 from client_tpu.utils import InferenceServerException
 
@@ -97,9 +106,13 @@ class InferenceServerClient(InferenceServerClientBase):
         creds: Optional[grpc.ChannelCredentials] = None,
         keepalive_options: Optional[KeepAliveOptions] = None,
         channel_args: Optional[List] = None,
+        retry_policy: Optional[RetryPolicy] = None,
+        circuit_breaker: Optional[CircuitBreaker] = None,
     ):
         super().__init__()
         self._verbose = verbose
+        self._retry_policy = retry_policy
+        self._circuit_breaker = circuit_breaker
         if channel_args is not None:
             options = list(channel_args)
         else:
@@ -159,18 +172,44 @@ class InferenceServerClient(InferenceServerClientBase):
         headers=None,
         client_timeout=None,
         compression_algorithm=None,
+        idempotent=True,
+        probe=False,
     ):
+        """One RPC under the retry/deadline/breaker rules.
+
+        ``client_timeout`` is the total budget across attempts; each
+        attempt's gRPC timeout is derived from what remains of it.
+        ``probe`` marks liveness/readiness checks: single attempt, no
+        breaker accounting (a probe reports current state; its failures
+        during a restart must not poison a shared breaker).
+        """
         if self._verbose:
             print(f"gRPC {name}: {{{str(request)[:200]}}}")
-        try:
-            return getattr(self._client_stub, name)(
-                request,
-                metadata=self._metadata(headers),
-                timeout=client_timeout,
-                compression=_grpc_compression(compression_algorithm),
-            )
-        except grpc.RpcError as e:
-            raise rpc_error_to_exception(e) from None
+        metadata = self._metadata(headers)
+        compression = _grpc_compression(compression_algorithm)
+        method = getattr(self._client_stub, name)
+
+        def _send(attempt_timeout):
+            try:
+                return method(
+                    request,
+                    metadata=metadata,
+                    timeout=attempt_timeout,
+                    compression=compression,
+                )
+            except grpc.RpcError as e:
+                raise rpc_error_to_exception(e) from None
+
+        if probe:
+            return _send(client_timeout)
+        return run_with_resilience(
+            _send,
+            retry_policy=self._retry_policy,
+            circuit_breaker=self._circuit_breaker,
+            budget_s=client_timeout,
+            idempotent=idempotent,
+            description=f"gRPC {name}",
+        )
 
     def close(self) -> None:
         """Close the channel (stops any active stream first)."""
@@ -187,13 +226,21 @@ class InferenceServerClient(InferenceServerClientBase):
 
     def is_server_live(self, headers=None, client_timeout=None) -> bool:
         response = self._call(
-            "ServerLive", service_pb2.ServerLiveRequest(), headers, client_timeout
+            "ServerLive",
+            service_pb2.ServerLiveRequest(),
+            headers,
+            client_timeout,
+            probe=True,
         )
         return response.live
 
     def is_server_ready(self, headers=None, client_timeout=None) -> bool:
         response = self._call(
-            "ServerReady", service_pb2.ServerReadyRequest(), headers, client_timeout
+            "ServerReady",
+            service_pb2.ServerReadyRequest(),
+            headers,
+            client_timeout,
+            probe=True,
         )
         return response.ready
 
@@ -205,6 +252,7 @@ class InferenceServerClient(InferenceServerClientBase):
             service_pb2.ModelReadyRequest(name=model_name, version=model_version),
             headers,
             client_timeout,
+            probe=True,
         )
         return response.ready
 
@@ -282,7 +330,13 @@ class InferenceServerClient(InferenceServerClientBase):
         if files:
             for name, content in files.items():
                 request.parameters[name].bytes_param = content
-        self._call("RepositoryModelLoad", request, headers, client_timeout)
+        self._call(
+            "RepositoryModelLoad",
+            request,
+            headers,
+            client_timeout,
+            idempotent=False,
+        )
 
     def unload_model(
         self,
@@ -293,7 +347,13 @@ class InferenceServerClient(InferenceServerClientBase):
     ) -> None:
         request = service_pb2.RepositoryModelUnloadRequest(model_name=model_name)
         request.parameters["unload_dependents"].bool_param = unload_dependents
-        self._call("RepositoryModelUnload", request, headers, client_timeout)
+        self._call(
+            "RepositoryModelUnload",
+            request,
+            headers,
+            client_timeout,
+            idempotent=False,
+        )
 
     # -- statistics / settings -----------------------------------------------
 
@@ -384,6 +444,7 @@ class InferenceServerClient(InferenceServerClientBase):
             ),
             headers,
             client_timeout,
+            idempotent=False,
         )
 
     def unregister_system_shared_memory(
@@ -394,6 +455,7 @@ class InferenceServerClient(InferenceServerClientBase):
             service_pb2.SystemSharedMemoryUnregisterRequest(name=name),
             headers,
             client_timeout,
+            idempotent=False,
         )
 
     def get_cuda_shared_memory_status(
@@ -420,6 +482,7 @@ class InferenceServerClient(InferenceServerClientBase):
             ),
             headers,
             client_timeout,
+            idempotent=False,
         )
 
     def unregister_cuda_shared_memory(
@@ -430,6 +493,7 @@ class InferenceServerClient(InferenceServerClientBase):
             service_pb2.CudaSharedMemoryUnregisterRequest(name=name),
             headers,
             client_timeout,
+            idempotent=False,
         )
 
     def get_tpu_shared_memory_status(
@@ -457,6 +521,7 @@ class InferenceServerClient(InferenceServerClientBase):
             ),
             headers,
             client_timeout,
+            idempotent=False,
         )
 
     def unregister_tpu_shared_memory(
@@ -467,6 +532,7 @@ class InferenceServerClient(InferenceServerClientBase):
             service_pb2.TpuSharedMemoryUnregisterRequest(name=name),
             headers,
             client_timeout,
+            idempotent=False,
         )
 
     # -- inference -----------------------------------------------------------
@@ -508,6 +574,7 @@ class InferenceServerClient(InferenceServerClientBase):
             headers,
             client_timeout,
             compression_algorithm=compression_algorithm,
+            idempotent=sequence_is_idempotent(sequence_id),
         )
         return InferResult(response)
 
@@ -555,6 +622,7 @@ class InferenceServerClient(InferenceServerClientBase):
             headers,
             client_timeout,
             compression_algorithm=compression_algorithm,
+            idempotent=not _is_sequence_request(request),
         )
         return InferResult(response)
 
@@ -580,28 +648,47 @@ class InferenceServerClient(InferenceServerClientBase):
 
         ``callback(result, error)`` fires from a gRPC thread on completion.
         Returns a :class:`CallContext` whose ``cancel()`` aborts the call.
+
+        The callback contract rules out transparent retries (the caller
+        would see duplicate callbacks), but a configured circuit breaker
+        is honored: an open breaker fails fast here, and outcomes feed
+        its failure/success accounting.
         """
-        request = get_inference_request(
-            model_name,
-            inputs,
-            model_version=model_version,
-            request_id=request_id,
-            outputs=outputs,
-            sequence_id=sequence_id,
-            sequence_start=sequence_start,
-            sequence_end=sequence_end,
-            priority=priority,
-            timeout=timeout,
-            parameters=parameters,
-        )
-        if self._verbose:
-            print(f"gRPC async ModelInfer: {{{str(request)[:200]}}}")
-        future = self._client_stub.ModelInfer.future(
-            request,
-            metadata=self._metadata(headers),
-            timeout=client_timeout,
-            compression=_grpc_compression(compression_algorithm),
-        )
+        if (
+            self._circuit_breaker is not None
+            and not self._circuit_breaker.allow()
+        ):
+            raise CircuitBreakerOpenError(
+                "circuit breaker is open; gRPC async ModelInfer failed fast"
+            )
+        try:
+            request = get_inference_request(
+                model_name,
+                inputs,
+                model_version=model_version,
+                request_id=request_id,
+                outputs=outputs,
+                sequence_id=sequence_id,
+                sequence_start=sequence_start,
+                sequence_end=sequence_end,
+                priority=priority,
+                timeout=timeout,
+                parameters=parameters,
+            )
+            if self._verbose:
+                print(f"gRPC async ModelInfer: {{{str(request)[:200]}}}")
+            future = self._client_stub.ModelInfer.future(
+                request,
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+                compression=_grpc_compression(compression_algorithm),
+            )
+        except BaseException as e:
+            # a local failure between allow() and the RPC existing says
+            # nothing about the server — release the (possible) half-open
+            # probe slot instead of wedging the breaker
+            record_breaker_outcome(self._circuit_breaker, e)
+            raise
 
         def _done(f):
             # Build (result, error) first, then invoke the callback exactly
@@ -616,6 +703,11 @@ class InferenceServerClient(InferenceServerClientBase):
                 error = InferenceServerException("request was cancelled")
             except Exception as e:  # noqa: BLE001
                 error = InferenceServerException(str(e))
+            if self._circuit_breaker is not None:
+                if error is None:
+                    self._circuit_breaker.record_success()
+                else:
+                    record_breaker_outcome(self._circuit_breaker, error)
             callback(result, error)
 
         future.add_done_callback(_done)
@@ -636,19 +728,39 @@ class InferenceServerClient(InferenceServerClientBase):
         reference grpc_client.cc:1327-1332). ``callback(result, error)``
         fires once per *response* — decoupled models may produce many
         responses per request.
+
+        When the client has a ``retry_policy``, a stream torn down with
+        ``UNAVAILABLE`` reconnects automatically (with the policy's
+        backoff). Requests that were in flight on the dead connection
+        are surfaced to the callback as errors — never silently
+        replayed; requests still queued client-side carry over unsent.
         """
         if self._stream is not None and self._stream.is_active():
             raise InferenceServerException(
                 "stream is already active; call stop_stream() first"
             )
-        self._stream = InferStream(callback, verbose=self._verbose)
-        call = self._client_stub.ModelStreamInfer(
-            self._stream.request_iterator,
-            metadata=self._metadata(headers),
-            timeout=stream_timeout,
-            compression=_grpc_compression(compression_algorithm),
+        metadata = self._metadata(headers)
+        compression = _grpc_compression(compression_algorithm)
+
+        def _open(request_iterator, timeout=stream_timeout):
+            return self._client_stub.ModelStreamInfer(
+                request_iterator,
+                metadata=metadata,
+                timeout=timeout,
+                compression=compression,
+            )
+
+        self._stream = InferStream(
+            callback,
+            verbose=self._verbose,
+            retry_policy=self._retry_policy,
+            # stream_timeout is a total budget: reconnected calls get
+            # only what remains of it
+            stream_budget_s=stream_timeout,
         )
-        self._stream.init_handler(call)
+        self._stream.init_handler(
+            _open(self._stream.request_iterator), reconnect=_open
+        )
 
     def async_stream_infer(
         self,

@@ -29,6 +29,15 @@ def rpc_error_to_exception(rpc_error: grpc.RpcError) -> InferenceServerException
     )
 
 
+def is_sequence_request(request) -> bool:
+    """True when a prepared ModelInferRequest carries sequence state
+    (such requests are non-idempotent and must never be auto-retried)."""
+    if "sequence_id" not in request.parameters:
+        return False
+    param = request.parameters["sequence_id"]
+    return bool(param.int64_param or param.string_param)
+
+
 def set_parameter(proto_params, key: str, value: Any) -> None:
     if isinstance(value, bool):
         proto_params[key].bool_param = value

@@ -25,7 +25,17 @@ from client_tpu.grpc._infer_input import InferInput
 from client_tpu.grpc._infer_result import InferResult
 from client_tpu.grpc._requested_output import InferRequestedOutput
 from client_tpu.grpc._service_stubs import GRPCInferenceServiceStub
-from client_tpu.grpc._utils import get_inference_request, rpc_error_to_exception
+from client_tpu.grpc._utils import (
+    get_inference_request,
+    is_sequence_request as _is_sequence_request,
+    rpc_error_to_exception,
+)
+from client_tpu.resilience import (
+    CircuitBreaker,
+    RetryPolicy,
+    run_with_resilience_async,
+    sequence_is_idempotent,
+)
 from client_tpu.utils import InferenceServerException
 
 __all__ = [
@@ -51,9 +61,13 @@ class InferenceServerClient(InferenceServerClientBase):
         creds: Optional[grpc.ChannelCredentials] = None,
         keepalive_options: Optional[KeepAliveOptions] = None,
         channel_args: Optional[List] = None,
+        retry_policy: Optional[RetryPolicy] = None,
+        circuit_breaker: Optional[CircuitBreaker] = None,
     ):
         super().__init__()
         self._verbose = verbose
+        self._retry_policy = retry_policy
+        self._circuit_breaker = circuit_breaker
         if channel_args is not None:
             options = list(channel_args)
         else:
@@ -105,15 +119,48 @@ class InferenceServerClient(InferenceServerClientBase):
         self._call_plugin(request)
         return tuple((k.lower(), v) for k, v in request.headers.items()) or None
 
-    async def _call(self, name, request, headers=None, client_timeout=None):
-        try:
-            return await getattr(self._client_stub, name)(
-                request,
-                metadata=self._metadata(headers),
-                timeout=client_timeout,
-            )
-        except grpc.RpcError as e:
-            raise rpc_error_to_exception(e) from None
+    async def _call(
+        self,
+        name,
+        request,
+        headers=None,
+        client_timeout=None,
+        compression=None,
+        idempotent=True,
+        probe=False,
+    ):
+        """One RPC under the retry/deadline/breaker rules.
+
+        ``client_timeout`` is the total budget across attempts; each
+        attempt's gRPC timeout is derived from what remains of it.
+        ``probe`` marks liveness/readiness checks: single attempt, no
+        breaker accounting (a probe reports current state; its failures
+        during a restart must not poison a shared breaker).
+        """
+        metadata = self._metadata(headers)
+        method = getattr(self._client_stub, name)
+
+        async def _send(attempt_timeout):
+            try:
+                return await method(
+                    request,
+                    metadata=metadata,
+                    timeout=attempt_timeout,
+                    compression=compression,
+                )
+            except grpc.RpcError as e:
+                raise rpc_error_to_exception(e) from None
+
+        if probe:
+            return await _send(client_timeout)
+        return await run_with_resilience_async(
+            _send,
+            retry_policy=self._retry_policy,
+            circuit_breaker=self._circuit_breaker,
+            budget_s=client_timeout,
+            idempotent=idempotent,
+            description=f"gRPC {name}",
+        )
 
     async def close(self) -> None:
         await self._channel.close()
@@ -128,13 +175,21 @@ class InferenceServerClient(InferenceServerClientBase):
 
     async def is_server_live(self, headers=None, client_timeout=None) -> bool:
         r = await self._call(
-            "ServerLive", service_pb2.ServerLiveRequest(), headers, client_timeout
+            "ServerLive",
+            service_pb2.ServerLiveRequest(),
+            headers,
+            client_timeout,
+            probe=True,
         )
         return r.live
 
     async def is_server_ready(self, headers=None, client_timeout=None) -> bool:
         r = await self._call(
-            "ServerReady", service_pb2.ServerReadyRequest(), headers, client_timeout
+            "ServerReady",
+            service_pb2.ServerReadyRequest(),
+            headers,
+            client_timeout,
+            probe=True,
         )
         return r.ready
 
@@ -146,6 +201,7 @@ class InferenceServerClient(InferenceServerClientBase):
             service_pb2.ModelReadyRequest(name=model_name, version=model_version),
             headers,
             client_timeout,
+            probe=True,
         )
         return r.ready
 
@@ -216,7 +272,13 @@ class InferenceServerClient(InferenceServerClientBase):
         if files:
             for name, content in files.items():
                 request.parameters[name].bytes_param = content
-        await self._call("RepositoryModelLoad", request, headers, client_timeout)
+        await self._call(
+            "RepositoryModelLoad",
+            request,
+            headers,
+            client_timeout,
+            idempotent=False,
+        )
 
     async def unload_model(
         self,
@@ -227,7 +289,13 @@ class InferenceServerClient(InferenceServerClientBase):
     ) -> None:
         request = service_pb2.RepositoryModelUnloadRequest(model_name=model_name)
         request.parameters["unload_dependents"].bool_param = unload_dependents
-        await self._call("RepositoryModelUnload", request, headers, client_timeout)
+        await self._call(
+            "RepositoryModelUnload",
+            request,
+            headers,
+            client_timeout,
+            idempotent=False,
+        )
 
     async def get_inference_statistics(
         self,
@@ -270,6 +338,7 @@ class InferenceServerClient(InferenceServerClientBase):
             ),
             headers,
             client_timeout,
+            idempotent=False,
         )
 
     async def unregister_system_shared_memory(
@@ -280,6 +349,7 @@ class InferenceServerClient(InferenceServerClientBase):
             service_pb2.SystemSharedMemoryUnregisterRequest(name=name),
             headers,
             client_timeout,
+            idempotent=False,
         )
 
     async def get_tpu_shared_memory_status(
@@ -306,6 +376,7 @@ class InferenceServerClient(InferenceServerClientBase):
             ),
             headers,
             client_timeout,
+            idempotent=False,
         )
 
     async def unregister_tpu_shared_memory(
@@ -316,6 +387,7 @@ class InferenceServerClient(InferenceServerClientBase):
             service_pb2.TpuSharedMemoryUnregisterRequest(name=name),
             headers,
             client_timeout,
+            idempotent=False,
         )
 
     # -- inference -----------------------------------------------------------
@@ -362,15 +434,14 @@ class InferenceServerClient(InferenceServerClientBase):
         compression_algorithm: Optional[str] = None,
     ) -> InferResult:
         """Send a request built by :meth:`prepare_request` (reusable)."""
-        try:
-            response = await self._client_stub.ModelInfer(
-                request,
-                metadata=self._metadata(headers),
-                timeout=client_timeout,
-                compression=_grpc_compression(compression_algorithm),
-            )
-        except grpc.RpcError as e:
-            raise rpc_error_to_exception(e) from None
+        response = await self._call(
+            "ModelInfer",
+            request,
+            headers,
+            client_timeout,
+            compression=_grpc_compression(compression_algorithm),
+            idempotent=not _is_sequence_request(request),
+        )
         return InferResult(response)
 
     async def infer(
@@ -403,15 +474,14 @@ class InferenceServerClient(InferenceServerClientBase):
             timeout=timeout,
             parameters=parameters,
         )
-        try:
-            response = await self._client_stub.ModelInfer(
-                request,
-                metadata=self._metadata(headers),
-                timeout=client_timeout,
-                compression=_grpc_compression(compression_algorithm),
-            )
-        except grpc.RpcError as e:
-            raise rpc_error_to_exception(e) from None
+        response = await self._call(
+            "ModelInfer",
+            request,
+            headers,
+            client_timeout,
+            compression=_grpc_compression(compression_algorithm),
+            idempotent=sequence_is_idempotent(sequence_id),
+        )
         return InferResult(response)
 
     def stream_infer(

@@ -11,8 +11,9 @@ reference http/_client.py:102-108), this client may be used from multiple
 threads: calls serialize onto the private loop's connection pool.
 """
 
+import asyncio
 import concurrent.futures
-from typing import Optional
+from typing import List, Optional
 
 from client_tpu._sync_runner import EventLoopRunner
 from client_tpu.http import aio as _aio
@@ -33,8 +34,17 @@ __all__ = [
 class InferAsyncRequest:
     """Handle to an in-flight async_infer request."""
 
-    def __init__(self, future: concurrent.futures.Future):
+    def __init__(
+        self,
+        future: concurrent.futures.Future,
+        loop: Optional[asyncio.AbstractEventLoop] = None,
+        task_box: Optional[List] = None,
+    ):
         self._future = future
+        self._loop = loop
+        # the coroutine records its own asyncio task here once it starts
+        # running on the client loop, so cancel() can reach it
+        self._task_box = task_box if task_box is not None else []
 
     def get_result(self, block: bool = True, timeout: Optional[float] = None):
         """Wait for and return the :class:`InferResult`.
@@ -42,8 +52,8 @@ class InferAsyncRequest:
         Raises
         ------
         InferenceServerException
-            If the request failed, or ``block=False`` and it is still
-            in flight.
+            If the request failed, was cancelled, or ``block=False`` and
+            it is still in flight.
         """
         if not block and not self._future.done():
             raise InferenceServerException("request is not yet completed")
@@ -53,10 +63,45 @@ class InferAsyncRequest:
             raise InferenceServerException(
                 "timeout waiting for async infer result"
             ) from None
+        except (concurrent.futures.CancelledError, asyncio.CancelledError):
+            raise InferenceServerException(
+                "request was cancelled"
+            ) from None
 
-    def cancel(self) -> bool:
-        """Best-effort cancellation of the in-flight request."""
-        return self._future.cancel()
+    def cancel(self, timeout: Optional[float] = 5.0) -> bool:
+        """Cancel the in-flight request; returns whether it was cancelled.
+
+        Cancellation is propagated to the underlying asyncio task on the
+        client's loop, then this waits up to ``timeout`` for the request
+        to settle and reports whether it actually ended cancelled rather
+        than completing first — completion can win the race, and then
+        this returns False and ``get_result()`` still yields the result.
+        """
+        if self._future.done():
+            return False
+        if (
+            self._task_box
+            and self._loop is not None
+            and not self._loop.is_closed()
+        ):
+            # running on the loop: cancel the task and let the outcome
+            # (cancelled vs completed-first) propagate to the future
+
+            def _cancel_task():
+                for task in self._task_box:
+                    if not task.done():
+                        task.cancel()
+
+            self._loop.call_soon_threadsafe(_cancel_task)
+        elif self._future.cancel():
+            # never started: the pending future cancels directly
+            return True
+        concurrent.futures.wait([self._future], timeout=timeout)
+        if self._future.cancelled():
+            return True
+        if not self._future.done():
+            return False
+        return isinstance(self._future.exception(), asyncio.CancelledError)
 
 
 def _delegated(name, doc_source=None):
@@ -84,6 +129,8 @@ class InferenceServerClient:
         network_timeout: float = 60.0,
         ssl: bool = False,
         ssl_context=None,
+        retry_policy=None,
+        circuit_breaker=None,
     ):
         self._runner = EventLoopRunner(name=f"client-tpu-http[{url}]")
         self._aio_client = _aio.InferenceServerClient(
@@ -94,6 +141,8 @@ class InferenceServerClient:
             network_timeout=network_timeout,
             ssl=ssl,
             ssl_context=ssl_context,
+            retry_policy=retry_policy,
+            circuit_breaker=circuit_breaker,
         )
 
     # plugin registry delegates to the aio client so headers flow through it
@@ -152,21 +201,32 @@ class InferenceServerClient:
         from the client's loop thread when the request completes.
         """
         callback = kwargs.pop("callback", None)
-        future = self._runner.submit(
-            self._aio_client.infer(model_name, inputs, **kwargs)
-        )
+        task_box: list = []
+
+        async def _tracked():
+            # record the task so InferAsyncRequest.cancel() can reach the
+            # coroutine after it has started running on the loop
+            task_box.append(asyncio.current_task())
+            return await self._aio_client.infer(model_name, inputs, **kwargs)
+
+        future = self._runner.submit(_tracked())
         if callback is not None:
 
             def _done(f: concurrent.futures.Future):
                 result, error = None, None
                 try:
                     result = f.result()
+                except (
+                    concurrent.futures.CancelledError,
+                    asyncio.CancelledError,
+                ):
+                    error = InferenceServerException("request was cancelled")
                 except Exception as e:  # noqa: BLE001 - surface to callback
                     error = e
                 callback(result, error)
 
             future.add_done_callback(_done)
-        return InferAsyncRequest(future)
+        return InferAsyncRequest(future, loop=self._runner.loop, task_box=task_box)
 
     def close(self, timeout: Optional[float] = 60.0) -> None:
         """Close the connection pool and stop the loop thread."""

@@ -11,6 +11,7 @@ Method surface parity with the reference HTTP client
 the TPU shared-memory registration trio that replaces the CUDA one.
 """
 
+import asyncio
 import json
 from typing import Any, Dict, Optional, Sequence
 
@@ -29,6 +30,13 @@ from client_tpu.http._utils import (
     model_infer_uri,
     parse_json_response,
     raise_if_error,
+)
+from client_tpu.resilience import (
+    CONNECTION_ERROR_STATUS,
+    CircuitBreaker,
+    RetryPolicy,
+    run_with_resilience_async,
+    sequence_is_idempotent,
 )
 from client_tpu.utils import InferenceServerException
 
@@ -51,6 +59,16 @@ class InferenceServerClient(InferenceServerClientBase):
     ssl:
         Use https. ``ssl_context`` may carry a preconfigured
         ``ssl.SSLContext``.
+    retry_policy:
+        Optional :class:`client_tpu.resilience.RetryPolicy`. When set,
+        idempotent requests that fail with connect errors or retryable
+        HTTP statuses (429/502/503/504) are retried with capped
+        exponential backoff; sequence inference is never auto-retried.
+        Off by default (single attempt, as before).
+    circuit_breaker:
+        Optional :class:`client_tpu.resilience.CircuitBreaker` shared
+        per client (or across clients): when open, requests fail fast
+        with ``CircuitBreakerOpenError`` instead of piling up backoff.
     """
 
     def __init__(
@@ -62,6 +80,8 @@ class InferenceServerClient(InferenceServerClientBase):
         network_timeout: float = 60.0,
         ssl: bool = False,
         ssl_context=None,
+        retry_policy: Optional[RetryPolicy] = None,
+        circuit_breaker: Optional[CircuitBreaker] = None,
     ):
         super().__init__()
         scheme = "https" if ssl else "http"
@@ -77,6 +97,8 @@ class InferenceServerClient(InferenceServerClientBase):
         )
         self._connector_limit = concurrency
         self._session: Optional[aiohttp.ClientSession] = None
+        self._retry_policy = retry_policy
+        self._circuit_breaker = circuit_breaker
 
     # -- session lifecycle -------------------------------------------------
 
@@ -116,59 +138,120 @@ class InferenceServerClient(InferenceServerClientBase):
         self._call_plugin(request)
         return request.headers
 
-    async def _get(self, path, headers, query_params) -> tuple:
-        url = f"{self._base_url}/{path}{build_query_string(query_params)}"
-        if self._verbose:
-            print(f"GET {url}")
+    async def _request_once(
+        self, method, url, data, headers, timeout
+    ) -> tuple:
+        """One attempt; transport failures surface as
+        InferenceServerException (URL and cause in the message) rather
+        than raw aiohttp/asyncio errors."""
         session = self._ensure_session()
-        async with session.get(
-            url, headers=self._prepare_headers(headers)
-        ) as resp:
-            body = await resp.read()
-            if self._verbose:
-                print(f"-> {resp.status} ({len(body)} bytes)")
-            return resp.status, body, dict(resp.headers)
+        # only override the session's default ClientTimeout when this
+        # attempt carries an explicit budget: an explicit timeout=None
+        # would DISABLE the configured connection/network timeouts
+        kwargs = (
+            {"timeout": aiohttp.ClientTimeout(total=timeout)}
+            if timeout
+            else {}
+        )
+        try:
+            async with session.request(
+                method, url, data=data, headers=headers, **kwargs
+            ) as resp:
+                rbody = await resp.read()
+                return resp.status, rbody, dict(resp.headers)
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            raise InferenceServerException(
+                f"{method} {url} failed: {type(e).__name__}: {e}",
+                status=CONNECTION_ERROR_STATUS,
+            ) from e
 
-    async def _post(
-        self, path, body: bytes, headers, query_params, timeout=None
+    async def _execute(
+        self,
+        method,
+        path,
+        data,
+        headers,
+        query_params,
+        timeout=None,
+        idempotent=True,
+        probe=False,
     ) -> tuple:
         url = f"{self._base_url}/{path}{build_query_string(query_params)}"
         if self._verbose:
-            print(f"POST {url} ({len(body)} bytes)")
-        session = self._ensure_session()
-        req_timeout = (
-            aiohttp.ClientTimeout(total=timeout) if timeout else None
+            size = f" ({len(data)} bytes)" if data else ""
+            print(f"{method} {url}{size}")
+        prepared_headers = self._prepare_headers(headers)
+        if probe:
+            # liveness/readiness probes report CURRENT state: retrying
+            # one would invert its purpose, and its failures while a
+            # server restarts must not poison a shared circuit breaker
+            return await self._request_once(
+                method, url, data, prepared_headers, timeout
+            )
+        status, rbody, rheaders = await run_with_resilience_async(
+            lambda attempt_timeout: self._request_once(
+                method, url, data, prepared_headers, attempt_timeout
+            ),
+            retry_policy=self._retry_policy,
+            circuit_breaker=self._circuit_breaker,
+            budget_s=timeout or None,
+            idempotent=idempotent,
+            result_status=lambda value: str(value[0]),
+            description=f"{method} {url}",
         )
-        async with session.post(
-            url,
-            data=body,
-            headers=self._prepare_headers(headers),
-            timeout=req_timeout,
-        ) as resp:
-            rbody = await resp.read()
-            if self._verbose:
-                print(f"-> {resp.status} ({len(rbody)} bytes)")
-            return resp.status, rbody, dict(resp.headers)
+        if self._verbose:
+            print(f"-> {status} ({len(rbody)} bytes)")
+        return status, rbody, rheaders
+
+    async def _get(self, path, headers, query_params, probe=False) -> tuple:
+        return await self._execute(
+            "GET", path, None, headers, query_params, probe=probe
+        )
+
+    async def _post(
+        self, path, body: bytes, headers, query_params, timeout=None,
+        idempotent=True,
+    ) -> tuple:
+        return await self._execute(
+            "POST",
+            path,
+            body,
+            headers,
+            query_params,
+            timeout=timeout,
+            idempotent=idempotent,
+        )
 
     async def _get_json(self, path, headers, query_params) -> Dict[str, Any]:
         status, body, _ = await self._get(path, headers, query_params)
         return parse_json_response(status, body)
 
     async def _post_json(
-        self, path, request: Optional[Dict[str, Any]], headers, query_params
+        self,
+        path,
+        request: Optional[Dict[str, Any]],
+        headers,
+        query_params,
+        idempotent: bool = True,
     ) -> Dict[str, Any]:
         body = json.dumps(request).encode("utf-8") if request is not None else b""
-        status, rbody, _ = await self._post(path, body, headers, query_params)
+        status, rbody, _ = await self._post(
+            path, body, headers, query_params, idempotent=idempotent
+        )
         return parse_json_response(status, rbody)
 
     # -- health ------------------------------------------------------------
 
     async def is_server_live(self, headers=None, query_params=None) -> bool:
-        status, _, _ = await self._get("v2/health/live", headers, query_params)
+        status, _, _ = await self._get(
+            "v2/health/live", headers, query_params, probe=True
+        )
         return status == 200
 
     async def is_server_ready(self, headers=None, query_params=None) -> bool:
-        status, _, _ = await self._get("v2/health/ready", headers, query_params)
+        status, _, _ = await self._get(
+            "v2/health/ready", headers, query_params, probe=True
+        )
         return status == 200
 
     async def is_model_ready(
@@ -177,7 +260,9 @@ class InferenceServerClient(InferenceServerClientBase):
         path = f"v2/models/{model_name}"
         if model_version:
             path += f"/versions/{model_version}"
-        status, _, _ = await self._get(f"{path}/ready", headers, query_params)
+        status, _, _ = await self._get(
+            f"{path}/ready", headers, query_params, probe=True
+        )
         return status == 200
 
     # -- metadata / config -------------------------------------------------
@@ -239,6 +324,7 @@ class InferenceServerClient(InferenceServerClientBase):
             load_request,
             headers,
             query_params,
+            idempotent=False,
         )
 
     async def unload_model(
@@ -256,6 +342,7 @@ class InferenceServerClient(InferenceServerClientBase):
             request,
             headers,
             query_params,
+            idempotent=False,
         )
 
     # -- statistics / settings ----------------------------------------------
@@ -321,6 +408,7 @@ class InferenceServerClient(InferenceServerClientBase):
             request,
             headers,
             query_params,
+            idempotent=False,
         )
 
     async def unregister_system_shared_memory(
@@ -329,7 +417,10 @@ class InferenceServerClient(InferenceServerClientBase):
         path = "v2/systemsharedmemory"
         if name:
             path += f"/region/{name}"
-        await self._post_json(f"{path}/unregister", None, headers, query_params)
+        await self._post_json(
+            f"{path}/unregister", None, headers, query_params,
+            idempotent=False,
+        )
 
     async def get_cuda_shared_memory_status(
         self, region_name="", headers=None, query_params=None
@@ -357,6 +448,7 @@ class InferenceServerClient(InferenceServerClientBase):
             request,
             headers,
             query_params,
+            idempotent=False,
         )
 
     async def unregister_cuda_shared_memory(
@@ -365,7 +457,10 @@ class InferenceServerClient(InferenceServerClientBase):
         path = "v2/cudasharedmemory"
         if name:
             path += f"/region/{name}"
-        await self._post_json(f"{path}/unregister", None, headers, query_params)
+        await self._post_json(
+            f"{path}/unregister", None, headers, query_params,
+            idempotent=False,
+        )
 
     async def get_tpu_shared_memory_status(
         self, region_name="", headers=None, query_params=None
@@ -397,6 +492,7 @@ class InferenceServerClient(InferenceServerClientBase):
             request,
             headers,
             query_params,
+            idempotent=False,
         )
 
     async def unregister_tpu_shared_memory(
@@ -405,7 +501,10 @@ class InferenceServerClient(InferenceServerClientBase):
         path = "v2/tpusharedmemory"
         if name:
             path += f"/region/{name}"
-        await self._post_json(f"{path}/unregister", None, headers, query_params)
+        await self._post_json(
+            f"{path}/unregister", None, headers, query_params,
+            idempotent=False,
+        )
 
     # -- inference ----------------------------------------------------------
 
@@ -453,11 +552,21 @@ class InferenceServerClient(InferenceServerClientBase):
         headers: Optional[Dict[str, str]] = None,
         query_params: Optional[Dict[str, Any]] = None,
         timeout: Optional[float] = None,
+        idempotent: bool = True,
     ) -> InferResult:
         """Send a body built by :meth:`generate_request_body` (reusable —
         deterministic request bodies can be built once and resent; the
         reference's static GenerateRequestBody serves the same offline
-        role, reference http_client.cc:1286-1351)."""
+        role, reference http_client.cc:1286-1351).
+
+        Pass ``idempotent=False`` when the prepared body carries sequence
+        state so a configured retry policy never auto-retries it; as a
+        safety net, bodies whose JSON header names a ``sequence_id`` are
+        detected and demoted to non-idempotent automatically."""
+        if idempotent and self._retry_policy is not None:
+            header = body[:json_size] if json_size is not None else body
+            if b'"sequence_id"' in header:
+                idempotent = False
         extra_headers = dict(headers) if headers else {}
         if json_size is not None:
             extra_headers[HEADER_CONTENT_LENGTH] = str(json_size)
@@ -467,6 +576,7 @@ class InferenceServerClient(InferenceServerClientBase):
             extra_headers,
             query_params,
             timeout=timeout,
+            idempotent=idempotent,
         )
         raise_if_error(status, rbody)
         return InferResult.from_response(rbody, rheaders)
@@ -516,6 +626,7 @@ class InferenceServerClient(InferenceServerClientBase):
             extra_headers,
             query_params,
             timeout=timeout,
+            idempotent=sequence_is_idempotent(sequence_id),
         )
         raise_if_error(status, rbody)
         return InferResult.from_response(rbody, rheaders)

@@ -15,6 +15,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from client_tpu.resilience import sequence_is_idempotent
 from client_tpu.utils import (
     TF_TO_KSERVE_DTYPE,
     InferenceServerException,
@@ -184,12 +185,21 @@ class _PreparedRequestCacheMixin:
 class HttpPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
     kind = "http"
 
-    def __init__(self, url: str, concurrency: int = 128):
+    def __init__(
+        self,
+        url: str,
+        concurrency: int = 128,
+        retry_policy=None,
+        circuit_breaker=None,
+    ):
         from client_tpu.http import aio as httpclient
 
         self._mod = httpclient
         self._client = httpclient.InferenceServerClient(
-            url, concurrency=concurrency
+            url,
+            concurrency=concurrency,
+            retry_policy=retry_policy,
+            circuit_breaker=circuit_breaker,
         )
         self._init_prepared()
 
@@ -249,7 +259,13 @@ class HttpPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
                 lambda prepared: len(prepared[0]),
             )
             await self._client.infer_with_body(
-                model_name, body, json_size, model_version=model_version
+                model_name,
+                body,
+                json_size,
+                model_version=model_version,
+                # the prepared body may carry sequence state: keep the
+                # never-auto-retry-sequences guarantee on this path too
+                idempotent=sequence_is_idempotent(sequence_id),
             )
             return
         await self._client.infer(
@@ -268,11 +284,13 @@ class GrpcPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
     kind = "grpc"
     supports_streaming = True
 
-    def __init__(self, url: str):
+    def __init__(self, url: str, retry_policy=None, circuit_breaker=None):
         from client_tpu.grpc import aio as grpcclient
 
         self._mod = grpcclient
-        self._client = grpcclient.InferenceServerClient(url)
+        self._client = grpcclient.InferenceServerClient(
+            url, retry_policy=retry_policy, circuit_breaker=circuit_breaker
+        )
         self._init_prepared()
 
     async def close(self) -> None:
@@ -904,7 +922,7 @@ def create_backend(
     if kind == "http":
         return HttpPerfBackend(url, **kwargs)
     if kind == "grpc":
-        return GrpcPerfBackend(url)
+        return GrpcPerfBackend(url, **kwargs)
     if kind == "openai":
         return OpenAiPerfBackend(url, **kwargs)
     if kind == "tfserving":

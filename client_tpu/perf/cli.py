@@ -171,6 +171,22 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="print a one-line JSON summary (bench integration)",
     )
+    def _error_rate(value: str) -> float:
+        rate = float(value)
+        if not 0.0 <= rate <= 1.0:
+            raise argparse.ArgumentTypeError(
+                f"--max-error-rate must be a fraction in [0, 1], got {rate}"
+            )
+        return rate
+
+    parser.add_argument(
+        "--max-error-rate",
+        type=_error_rate,
+        default=None,
+        help="abort the run when the cumulative request error rate "
+        "exceeds this fraction in [0, 1] (default: tolerate errors; "
+        "they are recorded and reported)",
+    )
     from client_tpu.perf.distributed import topology_from_env
 
     env_world_size, env_rank, env_coordinator = topology_from_env()
@@ -367,6 +383,7 @@ async def run(args) -> int:
             streaming=args.streaming,
             sequence_manager=sequence_manager,
             parameters=request_parameters or None,
+            max_error_rate=args.max_error_rate,
         )
 
         # Multi-process rendezvous: barrier after setup so all ranks start

@@ -14,14 +14,24 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from client_tpu import resilience
 from client_tpu.perf.backend import PerfBackend
 from client_tpu.perf.data import DataLoader
 from client_tpu.perf.records import RequestRecord
 from client_tpu.perf.sequence import SequenceManager
+from client_tpu.utils import InferenceServerException
 
 
 class LoadManager:
-    """Base: owns the backend, data loader, and the shared record list."""
+    """Base: owns the backend, data loader, and the shared record list.
+
+    Failures are data, not fatal: each error lands in its
+    ``RequestRecord`` and the run continues. ``max_error_rate`` (a
+    fraction; None disables the check) turns sustained failure into a
+    ``check_health()`` abort once at least ``min_error_sample`` requests
+    have been issued — the error-tolerant replacement for first-error
+    aborts, sized so a couple of transient faults can't kill a run.
+    """
 
     def __init__(
         self,
@@ -32,6 +42,8 @@ class LoadManager:
         streaming: bool = False,
         sequence_manager: Optional[SequenceManager] = None,
         parameters: Optional[Dict] = None,
+        max_error_rate: Optional[float] = None,
+        min_error_sample: int = 20,
     ):
         self.backend = backend
         self.model_name = model_name
@@ -40,6 +52,12 @@ class LoadManager:
         self.streaming = streaming
         self.sequences = sequence_manager
         self.parameters = parameters
+        self.max_error_rate = max_error_rate
+        self.min_error_sample = min_error_sample
+        # cumulative across swap_records() windows
+        self.issued_total = 0
+        self.errors_total = 0
+        self.retries_total = 0
         self.records: List[RequestRecord] = []
         self._request_counter = itertools.count()
         self._tasks: List[asyncio.Task] = []
@@ -88,6 +106,7 @@ class LoadManager:
             if step_params:
                 parameters = {**(parameters or {}), **step_params}
         record = RequestRecord(start_ns=time.monotonic_ns(), request_id=request_id)
+        resilience.reset_retry_count()
         try:
             if self.streaming and self.backend.supports_streaming:
                 def on_response():
@@ -124,8 +143,15 @@ class LoadManager:
             record.success = False
             record.error = str(e)
         record.end_ns = time.monotonic_ns()
+        # transparent retries the resilience layer performed for this call
+        # (contextvar updates within one task persist across awaits)
+        record.retries = resilience.last_retry_count()
         record.sequence_id = seq_kwargs.get("sequence_id", 0)
         record.ctx_id = slot if slot is not None else 0
+        self.issued_total += 1
+        self.retries_total += record.retries
+        if not record.success:
+            self.errors_total += 1
         self.records.append(record)
         return record
 
@@ -142,12 +168,27 @@ class LoadManager:
 
     def check_health(self) -> None:
         """Raise if any worker task died unexpectedly (reference
-        CheckHealth)."""
+        CheckHealth), or if the cumulative error rate crossed
+        ``max_error_rate`` — individual failures are tolerated and
+        recorded, only sustained failure aborts the run."""
         for task in self._tasks:
             if task.done() and not task.cancelled():
                 exc = task.exception()
                 if exc is not None:
                     raise exc
+        if (
+            self.max_error_rate is not None
+            and self.issued_total > 0
+            and self.issued_total >= self.min_error_sample
+        ):
+            rate = self.errors_total / self.issued_total
+            if rate > self.max_error_rate:
+                raise InferenceServerException(
+                    f"error rate {rate:.1%} exceeds the configured "
+                    f"threshold {self.max_error_rate:.1%} "
+                    f"({self.errors_total}/{self.issued_total} requests "
+                    "failed)"
+                )
 
     async def stop(self) -> None:
         self._stopping = True

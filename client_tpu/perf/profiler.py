@@ -226,6 +226,7 @@ class InferenceProfiler:
         total = sum(w.request_count for w in windows) or 1
         merged.request_count = sum(w.request_count for w in windows)
         merged.error_count = sum(w.error_count for w in windows)
+        merged.retry_count = sum(w.retry_count for w in windows)
         merged.throughput = sum(w.throughput for w in windows) / len(windows)
         merged.response_throughput = sum(
             w.response_throughput for w in windows

@@ -21,6 +21,9 @@ class RequestRecord:
     response_ns: List[int] = dataclasses.field(default_factory=list)
     success: bool = True
     error: Optional[str] = None
+    # transparent client-side retries this request needed (resilience
+    # layer); 0 when no retry policy is configured
+    retries: int = 0
     sequence_id: int = 0
     request_id: str = ""
     # context/slot the dispatcher attributed this request to (rate mode
@@ -55,6 +58,8 @@ class PerfStatus:
     window_end_ns: int = 0
     request_count: int = 0
     error_count: int = 0
+    # transparent client-side retries summed over the window's requests
+    retry_count: int = 0
     throughput: float = 0.0  # infer/sec
     response_throughput: float = 0.0  # responses/sec (decoupled)
     avg_latency_us: float = 0.0
@@ -94,6 +99,7 @@ def compute_window_status(
     successes = [r for r in window if r.success]
     status.request_count = len(successes)
     status.error_count = sum(1 for r in window if not r.success)
+    status.retry_count = sum(r.retries for r in window)
     status.throughput = len(successes) / duration_s
     status.response_throughput = (
         sum(len(r.response_ns) for r in successes) / duration_s

@@ -73,6 +73,8 @@ def detailed_report(experiment: ProfileExperiment) -> str:
         )
     if s.error_count:
         lines.append(f"  Errors: {s.error_count}")
+    if s.retry_count:
+        lines.append(f"  Retries: {s.retry_count}")
     return "\n".join(lines)
 
 

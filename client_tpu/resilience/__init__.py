@@ -1,0 +1,66 @@
+"""Transport-agnostic resilience: retries, deadlines, circuit breaking.
+
+Production TPU serving lives with preemptible hosts, pod restarts, and
+bursty tail latency; this package lets every client surface (HTTP/gRPC,
+sync/aio) ride through transient faults instead of failing on the first
+one. Everything is off by default — a client with no ``retry_policy`` and
+no ``circuit_breaker`` behaves exactly as before.
+
+Components
+----------
+RetryPolicy
+    Capped exponential backoff with full jitter and retryable-error
+    classification (connect errors, HTTP 429/502/503/504, gRPC
+    UNAVAILABLE/DEADLINE_EXCEEDED/RESOURCE_EXHAUSTED). Clock, sleep, and
+    rng are injectable so fault tests run in milliseconds.
+Deadline
+    A total time budget propagated across attempts; each attempt's
+    per-request timeout is derived from the remaining budget, so retries
+    never exceed the caller's ``timeout``.
+CircuitBreaker
+    closed/open/half-open breaker with a failure threshold and cooldown.
+    Shared per client (or across clients), so a dead server fails fast
+    instead of piling up backoff sleeps.
+ChaosPolicy
+    Fault injection for the in-process server front-ends: error rate,
+    injected latency, connection resets, truncated bodies. Accepted by
+    ``InProcessServer(chaos=...)``.
+"""
+
+from client_tpu.resilience.chaos import ChaosPolicy
+from client_tpu.resilience.policy import (
+    CONNECTION_ERROR_STATUS,
+    DEFAULT_RETRYABLE_GRPC_CODES,
+    DEFAULT_RETRYABLE_HTTP_STATUSES,
+    CircuitBreaker,
+    CircuitBreakerOpenError,
+    Deadline,
+    RetryPolicy,
+    exception_is_retryable,
+    http_status_is_retryable,
+    last_retry_count,
+    record_breaker_outcome,
+    reset_retry_count,
+    run_with_resilience,
+    run_with_resilience_async,
+    sequence_is_idempotent,
+)
+
+__all__ = [
+    "CONNECTION_ERROR_STATUS",
+    "DEFAULT_RETRYABLE_GRPC_CODES",
+    "DEFAULT_RETRYABLE_HTTP_STATUSES",
+    "ChaosPolicy",
+    "CircuitBreaker",
+    "CircuitBreakerOpenError",
+    "Deadline",
+    "RetryPolicy",
+    "exception_is_retryable",
+    "http_status_is_retryable",
+    "last_retry_count",
+    "record_breaker_outcome",
+    "reset_retry_count",
+    "run_with_resilience",
+    "run_with_resilience_async",
+    "sequence_is_idempotent",
+]

@@ -11,6 +11,8 @@ inference request/response tensor conversion local.
 """
 
 
+import asyncio
+
 import grpc
 import numpy as np
 
@@ -154,6 +156,7 @@ def build_proto_response(core_response: CoreResponse) -> pb.ModelInferResponse:
 
 def _delegated(method_name: str):
     async def handler(self, request, context):
+        await self._chaos_gate(context, method_name)
         try:
             return codec.handle_method(self.core, method_name, request)
         except codec.RpcError as e:
@@ -167,12 +170,29 @@ def _delegated(method_name: str):
 
 
 class _Servicer(GRPCInferenceServiceServicer):
-    def __init__(self, core: ServerCore):
+    def __init__(self, core: ServerCore, chaos=None):
         self.core = core
+        self.chaos = chaos
+
+    async def _chaos_gate(self, context, method: str) -> None:
+        """Fault injection (ChaosPolicy): added latency plus injected
+        UNAVAILABLE aborts — every drawn fate (error/reset/truncate)
+        maps to an UNAVAILABLE abort, the HTTP/2 face of a dying host."""
+        if self.chaos is None or not self.chaos.applies_to(method):
+            return
+        if self.chaos.latency_s:
+            await asyncio.sleep(self.chaos.latency_s)
+        fate = self.chaos.draw()
+        if fate is not None:
+            self.chaos.record(fate)
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE, "chaos: injected unavailability"
+            )
 
     # -- inference -----------------------------------------------------------
 
     async def ModelInfer(self, request, context):
+        await self._chaos_gate(context, "ModelInfer")
         try:
             core_request = build_core_request(self.core, request)
             core_response = await self.core.infer(core_request)
@@ -182,6 +202,9 @@ class _Servicer(GRPCInferenceServiceServicer):
 
     async def ModelStreamInfer(self, request_iterator, context):
         async for request in request_iterator:
+            # an injected fault aborts the whole stream with UNAVAILABLE
+            # (connection-loss semantics), not a per-request error reply
+            await self._chaos_gate(context, "ModelStreamInfer")
             try:
                 core_request = build_core_request(self.core, request)
                 async for core_response in self.core.infer_decoupled(
@@ -203,15 +226,22 @@ for _method in codec.METHODS:
     setattr(_Servicer, _method, _delegated(_method))
 
 
-async def serve_grpc(core: ServerCore, host: str = "0.0.0.0", port: int = 8001):
-    """Start the gRPC server; returns (server, bound_port)."""
+async def serve_grpc(
+    core: ServerCore, host: str = "0.0.0.0", port: int = 8001, chaos=None
+):
+    """Start the gRPC server; returns (server, bound_port).
+
+    ``chaos`` (a :class:`client_tpu.resilience.ChaosPolicy`) enables
+    fault injection for resilience testing."""
     server = grpc.aio.server(
         options=[
             ("grpc.max_send_message_length", MAX_GRPC_MESSAGE_SIZE),
             ("grpc.max_receive_message_length", MAX_GRPC_MESSAGE_SIZE),
         ]
     )
-    add_GRPCInferenceServiceServicer_to_server(_Servicer(core), server)
+    add_GRPCInferenceServiceServicer_to_server(
+        _Servicer(core, chaos=chaos), server
+    )
     bound = server.add_insecure_port(f"{host}:{port}")
     await server.start()
     return server, bound

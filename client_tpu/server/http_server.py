@@ -6,11 +6,12 @@ system/CUDA/TPU shared-memory registration, and binary-tensor inference
 (JSON header + concatenated raw buffers, ``Inference-Header-Content-Length``).
 """
 
+import asyncio
 import base64
 import gzip
 import json
 import zlib
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 from aiohttp import web
@@ -35,6 +36,52 @@ def _error_response(msg: str, status: int = 400) -> web.Response:
     return web.json_response({"error": msg}, status=status)
 
 
+def _chaos_middleware(chaos):
+    """Fault-injection middleware over a ChaosPolicy: injected latency,
+    in-band errors (503), connection resets, and truncated bodies — the
+    failure modes a client sees from preempted/restarting TPU hosts."""
+
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        if not chaos.applies_to(request.path):
+            return await handler(request)
+        if chaos.latency_s:
+            await asyncio.sleep(chaos.latency_s)
+        fate = chaos.draw()
+        if fate == "error":
+            chaos.record("error")
+            return _error_response(
+                "chaos: injected unavailability", status=chaos.http_status
+            )
+        if fate == "reset":
+            if request.transport is not None:
+                chaos.record("reset")
+                request.transport.abort()
+                # the connection is gone; this response is never written
+                return web.Response(status=500)
+            # peer already gone: the fault did not fire, don't count it
+            return await handler(request)
+        if fate == "truncate":
+            response = await handler(request)
+            body = bytes(response.body or b"")
+            if len(body) >= 2 and request.transport is not None:
+                # declare the full length, write half, kill the socket
+                chaos.record("truncate")
+                truncated = web.StreamResponse(
+                    status=response.status, headers=response.headers
+                )
+                truncated.content_length = len(body)
+                await truncated.prepare(request)
+                await truncated.write(body[: len(body) // 2])
+                request.transport.abort()
+                return truncated
+            # nothing to truncate: the fault did not fire, don't count it
+            return response
+        return await handler(request)
+
+    return middleware
+
+
 def _guarded(handler):
     async def wrapper(request: web.Request) -> web.Response:
         try:
@@ -52,9 +99,12 @@ def _guarded(handler):
 class HttpServer:
     """aiohttp application exposing a ServerCore."""
 
-    def __init__(self, core: ServerCore):
+    def __init__(self, core: ServerCore, chaos=None):
         self.core = core
-        self.app = web.Application(client_max_size=1 << 30)
+        middlewares = [_chaos_middleware(chaos)] if chaos is not None else []
+        self.app = web.Application(
+            client_max_size=1 << 30, middlewares=middlewares
+        )
         self._add_routes()
 
     def _add_routes(self) -> None:
@@ -548,10 +598,16 @@ class HttpServer:
 
 
 async def serve_http(
-    core: ServerCore, host: str = "0.0.0.0", port: int = 8000
+    core: ServerCore,
+    host: str = "0.0.0.0",
+    port: int = 8000,
+    chaos: Optional[object] = None,
 ) -> web.AppRunner:
-    """Start the HTTP server; returns the runner (caller owns shutdown)."""
-    server = HttpServer(core)
+    """Start the HTTP server; returns the runner (caller owns shutdown).
+
+    ``chaos`` (a :class:`client_tpu.resilience.ChaosPolicy`) enables
+    fault injection for resilience testing."""
+    server = HttpServer(core, chaos=chaos)
     runner = web.AppRunner(server.app, access_log=None)
     await runner.setup()
     site = web.TCPSite(runner, host, port)

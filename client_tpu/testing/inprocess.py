@@ -24,21 +24,37 @@ class InProcessServer:
         grpc=True,
         host: str = "127.0.0.1",
         builtin_models: bool = True,
+        chaos=None,
     ):
         """`grpc` may be True (native front-end when built, else grpc.aio),
-        "native", "aio", or False."""
+        "native", "aio", or False.
+
+        ``chaos`` (a :class:`client_tpu.resilience.ChaosPolicy`) injects
+        faults — error rate, latency, resets, truncated bodies — into
+        both front-ends; with chaos active the gRPC front-end is forced
+        to the grpc.aio implementation (the native C++ front-end has no
+        injection hooks)."""
         if core is None:
             core = ServerCore(ModelRepository())
         self.core = core
+        self.chaos = chaos
         if builtin_models:
             from client_tpu.server.models import register_builtin_models
 
             register_builtin_models(self.core.repository)
         self._want_http = http
         if grpc is True:
-            from client_tpu.server.native_frontend import native_available
+            if chaos is not None:
+                grpc = "aio"
+            else:
+                from client_tpu.server.native_frontend import native_available
 
-            grpc = "native" if native_available() else "aio"
+                grpc = "native" if native_available() else "aio"
+        elif grpc == "native" and chaos is not None:
+            raise ValueError(
+                "chaos injection is not supported by the native gRPC "
+                "front-end; use grpc='aio'"
+            )
         self._want_grpc = grpc
         self.grpc_impl: Optional[str] = grpc if grpc else None
         self._host = host
@@ -83,7 +99,9 @@ class InProcessServer:
         if self._want_http:
             from client_tpu.server.http_server import serve_http
 
-            http_runner = await serve_http(self.core, self._host, 0)
+            http_runner = await serve_http(
+                self.core, self._host, 0, chaos=self.chaos
+            )
             self.http_port = http_runner.addresses[0][1]
         if self._want_grpc == "native":
             from client_tpu.server.native_frontend import serve_grpc_native
@@ -95,7 +113,7 @@ class InProcessServer:
             from client_tpu.server.grpc_server import serve_grpc
 
             grpc_server, self.grpc_port = await serve_grpc(
-                self.core, self._host, 0
+                self.core, self._host, 0, chaos=self.chaos
             )
         self._ready.set()
         await self._stop.wait()

@@ -1,0 +1,745 @@
+"""Resilience layer tests: retry/deadline/breaker math under a fake
+clock, plus chaos fault-injection integration over InProcessServer.
+
+Every sleep in these tests is injected (fake clock / zero sleeps), so the
+whole module adds almost no wall time; an autouse guard asserts that no
+real ``time.sleep`` of >= 0.1 s sneaks in.
+"""
+
+import asyncio
+import logging
+import queue
+import time
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.http as httpclient
+from client_tpu import resilience
+from client_tpu.resilience import (
+    ChaosPolicy,
+    CircuitBreaker,
+    CircuitBreakerOpenError,
+    Deadline,
+    RetryPolicy,
+    run_with_resilience,
+    run_with_resilience_async,
+)
+from client_tpu.testing import InProcessServer
+from client_tpu.utils import InferenceServerException
+
+# chaos resets/truncates make aiohttp's server log scary-but-expected
+# connection errors; keep the test output clean
+logging.getLogger("aiohttp.server").setLevel(logging.CRITICAL)
+
+
+@pytest.fixture(autouse=True)
+def no_real_long_sleeps(monkeypatch):
+    """Fail any test that performs a real time.sleep >= 0.1 s — the fake
+    clock/injected sleeps must keep tier-1 wall time flat."""
+    real_sleep = time.sleep
+    calls = []
+
+    def guarded(seconds):
+        calls.append(seconds)
+        real_sleep(seconds)
+
+    monkeypatch.setattr(time, "sleep", guarded)
+    yield
+    long = [s for s in calls if s >= 0.1]
+    assert not long, f"real time.sleep >= 0.1s in a resilience test: {long}"
+
+
+class FakeClock:
+    """Deterministic clock with matching sync/async sleeps."""
+
+    def __init__(self):
+        self.now = 0.0
+        self.sleeps = []
+
+    def time(self) -> float:
+        return self.now
+
+    def sleep(self, seconds: float) -> None:
+        self.sleeps.append(seconds)
+        self.now += seconds
+
+    async def async_sleep(self, seconds: float) -> None:
+        self.sleeps.append(seconds)
+        self.now += seconds
+
+
+def make_policy(clock=None, **kwargs):
+    clock = clock or FakeClock()
+    kwargs.setdefault("jitter", False)
+    return RetryPolicy(
+        clock=clock.time,
+        sleep=clock.sleep,
+        async_sleep=clock.async_sleep,
+        **kwargs,
+    )
+
+
+# ---------------------------------------------------------------------------
+# backoff / jitter / deadline math
+
+
+def test_backoff_exponential_and_capped():
+    policy = make_policy(
+        initial_backoff_s=0.05, backoff_multiplier=2.0, max_backoff_s=0.3
+    )
+    bounds = [policy.backoff_s(n) for n in range(6)]
+    assert bounds[:3] == [0.05, 0.1, 0.2]
+    assert bounds[3:] == [0.3, 0.3, 0.3]  # capped
+
+
+def test_full_jitter_within_bounds():
+    import random
+
+    policy = RetryPolicy(
+        initial_backoff_s=0.2, max_backoff_s=1.0, rng=random.Random(42)
+    )
+    for attempt in range(4):
+        bound = policy.backoff_bound_s(attempt)
+        samples = [policy.backoff_s(attempt) for _ in range(200)]
+        assert all(0.0 <= s <= bound for s in samples)
+        # full jitter actually spreads over the range
+        assert max(samples) > 0.7 * bound
+        assert min(samples) < 0.3 * bound
+
+
+def test_deadline_budget_math():
+    clock = FakeClock()
+    deadline = Deadline(1.0, clock=clock.time)
+    assert deadline.remaining_s() == pytest.approx(1.0)
+    assert not deadline.expired
+    clock.now = 0.4
+    assert deadline.remaining_s() == pytest.approx(0.6)
+    assert deadline.attempt_timeout_s() == pytest.approx(0.6)
+    clock.now = 1.5
+    assert deadline.expired
+    # an exhausted budget floors, never becomes "no timeout"
+    assert deadline.attempt_timeout_s() == pytest.approx(0.001)
+
+
+# ---------------------------------------------------------------------------
+# retry loop semantics
+
+
+def _failing_send(failures, status="503"):
+    state = {"calls": 0}
+
+    def send(timeout):
+        state["calls"] += 1
+        if state["calls"] <= failures:
+            raise InferenceServerException("injected", status=status)
+        return "ok"
+
+    return send, state
+
+
+def test_retry_loop_retries_retryable():
+    clock = FakeClock()
+    policy = make_policy(clock, max_attempts=5, initial_backoff_s=0.05)
+    send, state = _failing_send(2)
+    resilience.reset_retry_count()
+    assert run_with_resilience(send, retry_policy=policy) == "ok"
+    assert state["calls"] == 3
+    assert clock.sleeps == [0.05, 0.1]
+    assert resilience.last_retry_count() == 2
+
+
+def test_retry_loop_async_retries_retryable():
+    clock = FakeClock()
+    policy = make_policy(clock, max_attempts=5, initial_backoff_s=0.05)
+    calls = {"n": 0}
+
+    async def send(timeout):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise InferenceServerException(
+                "injected", status="StatusCode.UNAVAILABLE"
+            )
+        return "ok"
+
+    result = asyncio.run(
+        run_with_resilience_async(send, retry_policy=policy)
+    )
+    assert result == "ok"
+    assert calls["n"] == 3
+
+
+def test_non_retryable_status_fails_immediately():
+    policy = make_policy(max_attempts=5)
+    send, state = _failing_send(99, status="400")
+    with pytest.raises(InferenceServerException):
+        run_with_resilience(send, retry_policy=policy)
+    assert state["calls"] == 1
+
+
+def test_sequence_requests_never_auto_retried():
+    policy = make_policy(max_attempts=5)
+    send, state = _failing_send(99, status="503")
+    with pytest.raises(InferenceServerException):
+        run_with_resilience(send, retry_policy=policy, idempotent=False)
+    assert state["calls"] == 1
+
+
+def test_no_policy_means_single_attempt():
+    send, state = _failing_send(99, status="503")
+    with pytest.raises(InferenceServerException):
+        run_with_resilience(send)
+    assert state["calls"] == 1
+
+
+def test_deadline_limits_attempts_and_derives_timeouts():
+    clock = FakeClock()
+    policy = make_policy(
+        clock, max_attempts=10, initial_backoff_s=0.4, max_backoff_s=10.0
+    )
+    seen_timeouts = []
+
+    def send(timeout):
+        seen_timeouts.append(timeout)
+        raise InferenceServerException("injected", status="503")
+
+    with pytest.raises(InferenceServerException):
+        run_with_resilience(send, retry_policy=policy, budget_s=1.0)
+    # attempt 0 at t=0 (budget 1.0), sleep 0.4, attempt 1 (budget 0.6);
+    # the next backoff (0.8) exceeds the remaining budget: stop.
+    assert seen_timeouts == [pytest.approx(1.0), pytest.approx(0.6)]
+    assert clock.sleeps == [pytest.approx(0.4)]
+
+
+def test_retryable_http_result_returned_after_exhaustion():
+    clock = FakeClock()
+    policy = make_policy(clock, max_attempts=3, initial_backoff_s=0.01)
+
+    def send(timeout):
+        return (503, b"", {})
+
+    status, _, _ = run_with_resilience(
+        send,
+        retry_policy=policy,
+        result_status=lambda value: str(value[0]),
+    )
+    assert status == 503  # in-band error semantics preserved
+    assert len(clock.sleeps) == 2  # but it did retry max_attempts times
+
+
+def test_breaker_treats_5xx_as_inconclusive_not_success():
+    # a crash-looping server alternating resets with 500s must still
+    # trip the breaker: 500s may not RESET the failure count
+    breaker = CircuitBreaker(failure_threshold=2, cooldown_s=100.0)
+    responses = iter(
+        [
+            InferenceServerException("reset", status="CONNECTION_ERROR"),
+            (500, b"", {}),
+            InferenceServerException("reset", status="CONNECTION_ERROR"),
+        ]
+    )
+
+    def send(timeout):
+        item = next(responses)
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    with pytest.raises(InferenceServerException):
+        run_with_resilience(send, circuit_breaker=breaker)
+    run_with_resilience(
+        send, circuit_breaker=breaker,
+        result_status=lambda value: str(value[0]),
+    )
+    with pytest.raises(InferenceServerException):
+        run_with_resilience(send, circuit_breaker=breaker)
+    assert breaker.state == CircuitBreaker.OPEN
+    # ...while a 4xx still counts as the server being alive
+    breaker2 = CircuitBreaker(failure_threshold=2)
+    breaker2.record_failure()
+    run_with_resilience(
+        lambda timeout: (404, b"", {}),
+        circuit_breaker=breaker2,
+        result_status=lambda value: str(value[0]),
+    )
+    breaker2.record_failure()
+    assert breaker2.state == CircuitBreaker.CLOSED  # 404 reset the count
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+
+
+def test_breaker_opens_half_opens_and_recloses():
+    clock = FakeClock()
+    breaker = CircuitBreaker(
+        failure_threshold=3, cooldown_s=5.0, clock=clock.time
+    )
+    assert breaker.state == CircuitBreaker.CLOSED
+    for _ in range(3):
+        assert breaker.allow()
+        breaker.record_failure()
+    assert breaker.state == CircuitBreaker.OPEN
+    assert not breaker.allow()
+    clock.now = 5.1
+    assert breaker.state == CircuitBreaker.HALF_OPEN
+    assert breaker.allow()  # one probe
+    assert not breaker.allow()  # probes are limited
+    breaker.record_success()
+    assert breaker.state == CircuitBreaker.CLOSED
+    assert breaker.times_opened == 1
+
+
+def test_breaker_half_open_failure_reopens():
+    clock = FakeClock()
+    breaker = CircuitBreaker(
+        failure_threshold=1, cooldown_s=2.0, clock=clock.time
+    )
+    breaker.record_failure()
+    assert breaker.state == CircuitBreaker.OPEN
+    clock.now = 2.5
+    assert breaker.allow()
+    breaker.record_failure()  # probe failed
+    assert breaker.state == CircuitBreaker.OPEN
+    assert not breaker.allow()
+    assert breaker.times_opened == 2
+
+
+def test_breaker_fails_fast_through_executor():
+    clock = FakeClock()
+    breaker = CircuitBreaker(
+        failure_threshold=2, cooldown_s=100.0, clock=clock.time
+    )
+    send, state = _failing_send(99, status="503")
+    for _ in range(2):
+        with pytest.raises(InferenceServerException):
+            run_with_resilience(send, circuit_breaker=breaker)
+    assert state["calls"] == 2
+    with pytest.raises(CircuitBreakerOpenError):
+        run_with_resilience(send, circuit_breaker=breaker)
+    assert state["calls"] == 2  # no attempt reached the server
+
+
+def test_breaker_not_tripped_by_client_errors():
+    breaker = CircuitBreaker(failure_threshold=1)
+
+    def send(timeout):
+        raise InferenceServerException("bad request", status="400")
+
+    with pytest.raises(InferenceServerException):
+        run_with_resilience(send, circuit_breaker=breaker)
+    # a 4xx means the server answered: the breaker stays closed
+    assert breaker.state == CircuitBreaker.CLOSED
+
+
+def test_breaker_counts_infra_failures_even_without_retry_opt_in():
+    # a policy that opts out of retrying connection errors must not stop
+    # the breaker from counting them (else a dead host never fails fast)
+    breaker = CircuitBreaker(failure_threshold=2, cooldown_s=100.0)
+    policy = make_policy(max_attempts=5, retry_connection_errors=False)
+
+    def send(timeout):
+        raise InferenceServerException(
+            "connect refused", status=resilience.CONNECTION_ERROR_STATUS
+        )
+
+    for _ in range(2):
+        with pytest.raises(InferenceServerException):
+            run_with_resilience(
+                send, retry_policy=policy, circuit_breaker=breaker
+            )
+    assert breaker.state == CircuitBreaker.OPEN
+
+
+def test_breaker_open_ignores_stale_inflight_success():
+    # a request already in flight when the breaker tripped may drain
+    # successfully; that stale evidence must not close an OPEN breaker
+    # (recovery goes through the half-open probe, never a flap)
+    clock = FakeClock()
+    breaker = CircuitBreaker(
+        failure_threshold=1, cooldown_s=5.0, clock=clock.time
+    )
+    breaker.record_failure()
+    assert breaker.state == CircuitBreaker.OPEN
+    breaker.record_success()
+    assert breaker.state == CircuitBreaker.OPEN
+    clock.now = 5.5
+    assert breaker.allow()  # half-open probe
+    breaker.record_success()
+    assert breaker.state == CircuitBreaker.CLOSED
+
+
+def test_chaos_scope_matches_only_infer_endpoints():
+    chaos = ChaosPolicy(error_rate=1.0)
+    assert chaos.applies_to("/v2/models/simple/infer")
+    assert chaos.applies_to("/v2/models/simple/versions/2/infer")
+    assert chaos.applies_to("ModelInfer")
+    assert chaos.applies_to("/inference.GRPCInferenceService/ModelStreamInfer")
+    # a model NAMED like inference must not drag setup calls into scope
+    assert not chaos.applies_to("/v2/models/inference_v2")
+    assert not chaos.applies_to("/v2/health/live")
+    assert ChaosPolicy(scope="all").applies_to("/v2/health/live")
+
+
+def test_breaker_cancelled_rpc_is_inconclusive():
+    # a locally-cancelled RPC says nothing about server health: it must
+    # neither trip the breaker nor reset the failure count
+    breaker = CircuitBreaker(failure_threshold=2)
+    breaker.record_failure()
+
+    def send(timeout):
+        raise InferenceServerException(
+            "cancelled", status="StatusCode.CANCELLED"
+        )
+
+    with pytest.raises(InferenceServerException):
+        run_with_resilience(send, circuit_breaker=breaker)
+    breaker.record_failure()
+    assert breaker.state == CircuitBreaker.OPEN  # the count survived
+
+
+def test_breaker_half_open_probe_released_on_inconclusive_outcome():
+    clock = FakeClock()
+    breaker = CircuitBreaker(
+        failure_threshold=1, cooldown_s=1.0, clock=clock.time
+    )
+    breaker.record_failure()
+    clock.now = 1.5
+    assert breaker.state == CircuitBreaker.HALF_OPEN
+
+    def send(timeout):
+        raise TypeError("probe died locally, server never consulted")
+
+    with pytest.raises(TypeError):
+        run_with_resilience(send, circuit_breaker=breaker)
+    # the probe slot must be released, not leaked — otherwise the
+    # breaker wedges half-open forever
+    assert breaker.allow()
+
+
+def test_breaker_ignores_local_errors():
+    breaker = CircuitBreaker(failure_threshold=2)
+    breaker.record_failure()
+
+    def send(timeout):
+        raise TypeError("local bug, says nothing about the server")
+
+    with pytest.raises(TypeError):
+        run_with_resilience(send, circuit_breaker=breaker)
+    # a local error is neither success nor failure: the accumulated
+    # failure count survives and the next real failure trips the breaker
+    breaker.record_failure()
+    assert breaker.state == CircuitBreaker.OPEN
+
+
+# ---------------------------------------------------------------------------
+# chaos integration over InProcessServer
+
+
+def _http_inputs():
+    data = np.arange(16, dtype=np.int32).reshape(1, 16)
+    a = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+    a.set_data_from_numpy(data)
+    b = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+    b.set_data_from_numpy(data)
+    return a, b
+
+
+def _grpc_inputs():
+    data = np.arange(16, dtype=np.int32).reshape(1, 16)
+    a = grpcclient.InferInput("INPUT0", [1, 16], "INT32")
+    a.set_data_from_numpy(data)
+    b = grpcclient.InferInput("INPUT1", [1, 16], "INT32")
+    b.set_data_from_numpy(data)
+    return a, b
+
+
+def _chaos_retry_policy_http():
+    # generous attempts so 30% injected failure converges for all 100
+    # requests; injected zero-sleep keeps wall time flat
+    return RetryPolicy(
+        max_attempts=10,
+        initial_backoff_s=0.001,
+        max_backoff_s=0.002,
+        async_sleep=lambda s: asyncio.sleep(0),
+    )
+
+
+def _chaos_retry_policy_grpc():
+    return RetryPolicy(
+        max_attempts=10,
+        initial_backoff_s=0.001,
+        max_backoff_s=0.002,
+        sleep=lambda s: None,
+    )
+
+
+@pytest.mark.chaos
+class TestHttpChaos:
+    @pytest.fixture(scope="class")
+    def chaos(self):
+        return ChaosPolicy(error_rate=0.3, seed=7)
+
+    @pytest.fixture(scope="class")
+    def server(self, chaos):
+        with InProcessServer(grpc=False, chaos=chaos) as s:
+            yield s
+
+    def test_retries_converge_100_of_100(self, server, chaos):
+        a, b = _http_inputs()
+        before = chaos.injected["error"]
+        with httpclient.InferenceServerClient(
+            server.http_url, retry_policy=_chaos_retry_policy_http()
+        ) as client:
+            for _ in range(100):
+                client.infer("simple", [a, b])
+        assert chaos.injected["error"] > before  # faults actually fired
+
+    def test_without_retries_same_run_fails(self, server):
+        a, b = _http_inputs()
+        with httpclient.InferenceServerClient(server.http_url) as client:
+            with pytest.raises(InferenceServerException):
+                for _ in range(100):
+                    client.infer("simple", [a, b])
+
+    def test_resets_and_truncation_wrapped_and_retried(self):
+        chaos = ChaosPolicy(reset_rate=0.15, truncate_rate=0.15, seed=5)
+        a, b = _http_inputs()
+        with InProcessServer(grpc=False, chaos=chaos) as server:
+            with httpclient.InferenceServerClient(
+                server.http_url, retry_policy=_chaos_retry_policy_http()
+            ) as client:
+                for _ in range(40):
+                    client.infer("simple", [a, b])
+            assert chaos.injected["reset"] + chaos.injected["truncate"] > 0
+
+    def test_transport_error_wrapped_with_url_and_cause(self):
+        # connection refused: must surface as InferenceServerException
+        # naming the URL and cause, not a raw aiohttp error
+        with httpclient.InferenceServerClient("127.0.0.1:9") as client:
+            with pytest.raises(InferenceServerException) as excinfo:
+                client.is_server_live()
+        message = excinfo.value.message()
+        assert "127.0.0.1:9" in message
+        assert excinfo.value.status() == resilience.CONNECTION_ERROR_STATUS
+
+    def test_breaker_fails_fast_against_dead_server(self):
+        breaker = CircuitBreaker(failure_threshold=2, cooldown_s=1000.0)
+        with httpclient.InferenceServerClient(
+            "127.0.0.1:9", circuit_breaker=breaker
+        ) as client:
+            for _ in range(2):
+                with pytest.raises(InferenceServerException):
+                    client.get_server_metadata()
+            with pytest.raises(CircuitBreakerOpenError):
+                client.get_server_metadata()
+            # probes bypass the breaker: they must report current state
+            # even while it is open, and must not feed its accounting
+            with pytest.raises(InferenceServerException) as excinfo:
+                client.is_server_live()
+            assert not isinstance(excinfo.value, CircuitBreakerOpenError)
+
+    def test_cancel_reaches_running_request(self):
+        chaos = ChaosPolicy(latency_s=0.5)
+        a, b = _http_inputs()
+        with InProcessServer(grpc=False, chaos=chaos) as server:
+            with httpclient.InferenceServerClient(server.http_url) as client:
+                request = client.async_infer("simple", [a, b])
+                # let the coroutine actually start on the client loop
+                deadline = time.monotonic() + 2.0
+                while not request._task_box and time.monotonic() < deadline:
+                    time.sleep(0.001)
+                assert request.cancel() is True
+                with pytest.raises(InferenceServerException) as excinfo:
+                    request.get_result()
+                assert "cancelled" in excinfo.value.message()
+
+    def test_cancel_after_completion_returns_false(self):
+        a, b = _http_inputs()
+        with InProcessServer(grpc=False) as server:
+            with httpclient.InferenceServerClient(server.http_url) as client:
+                request = client.async_infer("simple", [a, b])
+                request.get_result(timeout=30)
+                assert request.cancel() is False
+
+
+@pytest.mark.chaos
+class TestGrpcChaos:
+    @pytest.fixture(scope="class")
+    def chaos(self):
+        return ChaosPolicy(error_rate=0.3, seed=11)
+
+    @pytest.fixture(scope="class")
+    def server(self, chaos):
+        with InProcessServer(http=False, grpc="aio", chaos=chaos) as s:
+            yield s
+
+    def test_retries_converge_100_of_100(self, server, chaos):
+        a, b = _grpc_inputs()
+        before = chaos.injected["error"]
+        with grpcclient.InferenceServerClient(
+            server.grpc_url, retry_policy=_chaos_retry_policy_grpc()
+        ) as client:
+            for _ in range(100):
+                client.infer("simple", [a, b])
+        assert chaos.injected["error"] > before
+
+    def test_without_retries_same_run_fails(self, server):
+        a, b = _grpc_inputs()
+        with grpcclient.InferenceServerClient(server.grpc_url) as client:
+            with pytest.raises(InferenceServerException) as excinfo:
+                for _ in range(100):
+                    client.infer("simple", [a, b])
+            assert "UNAVAILABLE" in (excinfo.value.status() or "")
+
+    def test_stream_without_policy_keeps_single_error_callback(self):
+        # legacy semantics: no retry policy -> a stream teardown invokes
+        # the callback exactly once (the stream error), with no
+        # synthesized per-request in-flight errors
+        chaos = ChaosPolicy(error_rate=1.0)
+        a, b = _grpc_inputs()
+        with InProcessServer(http=False, grpc="aio", chaos=chaos) as server:
+            results: "queue.Queue" = queue.Queue()
+            with grpcclient.InferenceServerClient(server.grpc_url) as client:
+                client.start_stream(
+                    lambda result, error: results.put((result, error))
+                )
+                client.async_stream_infer("simple", [a, b], request_id="1")
+                result, error = results.get(timeout=30)
+                assert error is not None
+                assert "in flight" not in error.message()
+                time.sleep(0.05)  # no second callback arrives
+                assert results.empty()
+                client.stop_stream()
+
+    def test_stream_reconnects_and_surfaces_inflight_errors(
+        self, server, chaos
+    ):
+        a, b = _grpc_inputs()
+        results: "queue.Queue" = queue.Queue()
+        with grpcclient.InferenceServerClient(
+            server.grpc_url, retry_policy=_chaos_retry_policy_grpc()
+        ) as client:
+            client.start_stream(
+                lambda result, error: results.put((result, error))
+            )
+            oks = errors = 0
+            for i in range(20):
+                client.async_stream_infer("simple", [a, b], request_id=str(i))
+                result, error = results.get(timeout=30)
+                if error is None:
+                    oks += 1
+                else:
+                    # the in-flight request is surfaced, never replayed
+                    errors += 1
+                    assert "in flight" in error.message()
+            assert oks + errors == 20
+            # the stream survived every injected teardown and still works
+            for _ in range(50):
+                client.async_stream_infer("simple", [a, b], request_id="z")
+                result, error = results.get(timeout=30)
+                if error is None:
+                    break
+            else:
+                pytest.fail("stream did not recover after reconnects")
+            client.stop_stream()
+
+
+# ---------------------------------------------------------------------------
+# perf harness error tolerance
+
+
+@pytest.mark.chaos
+def test_load_manager_tolerates_errors_and_counts_retries():
+    from client_tpu.perf.backend import MockPerfBackend
+    from client_tpu.perf.data import DataLoader
+    from client_tpu.perf.load_manager import ConcurrencyManager
+
+    async def run(max_error_rate):
+        backend = MockPerfBackend(latency_s=0.0005, error_every=3)
+        loader = DataLoader(await backend.get_model_metadata("mock"))
+        loader.generate_synthetic()
+        manager = ConcurrencyManager(
+            backend,
+            "mock",
+            loader,
+            max_error_rate=max_error_rate,
+            min_error_sample=10,
+        )
+        await manager.change_concurrency(2)
+        while manager.issued_total < 40:
+            await asyncio.sleep(0.002)
+        manager.check_health()
+        await manager.stop()
+        return manager
+
+    # every third request fails (~33%): a 90% threshold tolerates it...
+    manager = asyncio.run(run(max_error_rate=0.9))
+    assert manager.errors_total > 0
+    assert any(not r.success for r in manager.records)
+    # ...and errors land in the window statistics, not as aborts
+    from client_tpu.perf.records import compute_window_status
+
+    status = compute_window_status(
+        manager.records, 0, max(r.end_ns for r in manager.records)
+    )
+    assert status.error_count == manager.errors_total
+
+    # a 10% threshold aborts via check_health (not first-error)
+    with pytest.raises(InferenceServerException) as excinfo:
+        asyncio.run(run(max_error_rate=0.1))
+    assert "error rate" in excinfo.value.message()
+
+
+@pytest.mark.chaos
+def test_request_records_capture_retry_counts():
+    from client_tpu.perf.backend import MockPerfBackend
+    from client_tpu.perf.data import DataLoader
+    from client_tpu.perf.load_manager import LoadManager
+
+    class RetryingBackend(MockPerfBackend):
+        """Backend whose infer path goes through the resilience loop."""
+
+        def __init__(self):
+            super().__init__(latency_s=0.0)
+            clock = FakeClock()
+            self.policy = RetryPolicy(
+                max_attempts=4,
+                initial_backoff_s=0.001,
+                clock=clock.time,
+                sleep=clock.sleep,
+                async_sleep=clock.async_sleep,
+            )
+            self._fail_next = 0
+
+        async def infer(self, model_name, inputs, **kwargs):
+            async def send(timeout):
+                if self._fail_next > 0:
+                    self._fail_next -= 1
+                    raise InferenceServerException("boom", status="503")
+                return None
+
+            await run_with_resilience_async(send, retry_policy=self.policy)
+
+    async def run():
+        backend = RetryingBackend()
+        loader = DataLoader(await backend.get_model_metadata("mock"))
+        loader.generate_synthetic()
+        manager = LoadManager(backend, "mock", loader)
+        backend._fail_next = 2
+        first = await manager.issue_one()
+        second = await manager.issue_one()
+        return first, second, manager
+
+    first, second, manager = asyncio.run(run())
+    assert first.success and first.retries == 2
+    assert second.success and second.retries == 0
+    assert manager.retries_total == 2
+
+    from client_tpu.perf.records import compute_window_status
+
+    status = compute_window_status(
+        manager.records, 0, max(r.end_ns for r in manager.records)
+    )
+    assert status.retry_count == 2
