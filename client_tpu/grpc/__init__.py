@@ -27,6 +27,12 @@ from client_tpu.grpc._utils import (
     is_sequence_request as _is_sequence_request,
     rpc_error_to_exception,
 )
+from client_tpu.observability.trace import (
+    NOOP_TRACE,
+    TRACEPARENT_HEADER,
+    Tracer,
+    start_trace,
+)
 from client_tpu.resilience import (
     CircuitBreaker,
     CircuitBreakerOpenError,
@@ -108,11 +114,13 @@ class InferenceServerClient(InferenceServerClientBase):
         channel_args: Optional[List] = None,
         retry_policy: Optional[RetryPolicy] = None,
         circuit_breaker: Optional[CircuitBreaker] = None,
+        tracer: Optional[Tracer] = None,
     ):
         super().__init__()
         self._verbose = verbose
         self._retry_policy = retry_policy
         self._circuit_breaker = circuit_breaker
+        self._tracer = tracer
         if channel_args is not None:
             options = list(channel_args)
         else:
@@ -174,6 +182,7 @@ class InferenceServerClient(InferenceServerClientBase):
         compression_algorithm=None,
         idempotent=True,
         probe=False,
+        trace=NOOP_TRACE,
     ):
         """One RPC under the retry/deadline/breaker rules.
 
@@ -181,7 +190,9 @@ class InferenceServerClient(InferenceServerClientBase):
         attempt's gRPC timeout is derived from what remains of it.
         ``probe`` marks liveness/readiness checks: single attempt, no
         breaker accounting (a probe reports current state; its failures
-        during a restart must not poison a shared breaker).
+        during a restart must not poison a shared breaker). An active
+        ``trace`` records one "request" span per attempt (the blocking
+        stub cannot split send from wait).
         """
         if self._verbose:
             print(f"gRPC {name}: {{{str(request)[:200]}}}")
@@ -203,7 +214,7 @@ class InferenceServerClient(InferenceServerClientBase):
         if probe:
             return _send(client_timeout)
         return run_with_resilience(
-            _send,
+            trace.wrap_attempt(_send),
             retry_policy=self._retry_policy,
             circuit_breaker=self._circuit_breaker,
             budget_s=client_timeout,
@@ -555,28 +566,45 @@ class InferenceServerClient(InferenceServerClientBase):
         parameters: Optional[Dict[str, Any]] = None,
     ) -> InferResult:
         """Run an inference and block for the result."""
-        request = get_inference_request(
-            model_name,
-            inputs,
-            model_version=model_version,
-            request_id=request_id,
-            outputs=outputs,
-            sequence_id=sequence_id,
-            sequence_start=sequence_start,
-            sequence_end=sequence_end,
-            priority=priority,
-            timeout=timeout,
-            parameters=parameters,
+        trace = start_trace(
+            self._tracer, "infer", surface="grpc", model=model_name
         )
-        response = self._call(
-            "ModelInfer",
-            request,
-            headers,
-            client_timeout,
-            compression_algorithm=compression_algorithm,
-            idempotent=sequence_is_idempotent(sequence_id),
-        )
-        return InferResult(response)
+        try:
+            with trace.stage("serialize"):
+                request = get_inference_request(
+                    model_name,
+                    inputs,
+                    model_version=model_version,
+                    request_id=request_id,
+                    outputs=outputs,
+                    sequence_id=sequence_id,
+                    sequence_start=sequence_start,
+                    sequence_end=sequence_end,
+                    priority=priority,
+                    timeout=timeout,
+                    parameters=parameters,
+                )
+            if trace.traceparent:
+                headers = {
+                    **(headers or {}),
+                    TRACEPARENT_HEADER: trace.traceparent,
+                }
+            response = self._call(
+                "ModelInfer",
+                request,
+                headers,
+                client_timeout,
+                compression_algorithm=compression_algorithm,
+                idempotent=sequence_is_idempotent(sequence_id),
+                trace=trace,
+            )
+            with trace.stage("deserialize"):
+                result = InferResult(response)
+        except BaseException as e:
+            trace.finish(error=e)
+            raise
+        trace.finish()
+        return result
 
     @staticmethod
     def prepare_request(
@@ -616,15 +644,31 @@ class InferenceServerClient(InferenceServerClientBase):
         compression_algorithm: Optional[str] = None,
     ) -> InferResult:
         """Send a request built by :meth:`prepare_request` (reusable)."""
-        response = self._call(
-            "ModelInfer",
-            request,
-            headers,
-            client_timeout,
-            compression_algorithm=compression_algorithm,
-            idempotent=not _is_sequence_request(request),
+        trace = start_trace(
+            self._tracer, "infer", surface="grpc", model=request.model_name
         )
-        return InferResult(response)
+        if trace.traceparent:
+            headers = {
+                **(headers or {}),
+                TRACEPARENT_HEADER: trace.traceparent,
+            }
+        try:
+            response = self._call(
+                "ModelInfer",
+                request,
+                headers,
+                client_timeout,
+                compression_algorithm=compression_algorithm,
+                idempotent=not _is_sequence_request(request),
+                trace=trace,
+            )
+            with trace.stage("deserialize"):
+                result = InferResult(response)
+        except BaseException as e:
+            trace.finish(error=e)
+            raise
+        trace.finish()
+        return result
 
     def async_infer(
         self,

@@ -30,6 +30,12 @@ from client_tpu.grpc._utils import (
     is_sequence_request as _is_sequence_request,
     rpc_error_to_exception,
 )
+from client_tpu.observability.trace import (
+    NOOP_TRACE,
+    TRACEPARENT_HEADER,
+    Tracer,
+    start_trace,
+)
 from client_tpu.resilience import (
     CircuitBreaker,
     RetryPolicy,
@@ -63,11 +69,13 @@ class InferenceServerClient(InferenceServerClientBase):
         channel_args: Optional[List] = None,
         retry_policy: Optional[RetryPolicy] = None,
         circuit_breaker: Optional[CircuitBreaker] = None,
+        tracer: Optional[Tracer] = None,
     ):
         super().__init__()
         self._verbose = verbose
         self._retry_policy = retry_policy
         self._circuit_breaker = circuit_breaker
+        self._tracer = tracer
         if channel_args is not None:
             options = list(channel_args)
         else:
@@ -128,6 +136,7 @@ class InferenceServerClient(InferenceServerClientBase):
         compression=None,
         idempotent=True,
         probe=False,
+        trace=NOOP_TRACE,
     ):
         """One RPC under the retry/deadline/breaker rules.
 
@@ -135,7 +144,8 @@ class InferenceServerClient(InferenceServerClientBase):
         attempt's gRPC timeout is derived from what remains of it.
         ``probe`` marks liveness/readiness checks: single attempt, no
         breaker accounting (a probe reports current state; its failures
-        during a restart must not poison a shared breaker).
+        during a restart must not poison a shared breaker). An active
+        ``trace`` records one "request" span per attempt.
         """
         metadata = self._metadata(headers)
         method = getattr(self._client_stub, name)
@@ -154,7 +164,7 @@ class InferenceServerClient(InferenceServerClientBase):
         if probe:
             return await _send(client_timeout)
         return await run_with_resilience_async(
-            _send,
+            trace.wrap_attempt_async(_send),
             retry_policy=self._retry_policy,
             circuit_breaker=self._circuit_breaker,
             budget_s=client_timeout,
@@ -434,15 +444,31 @@ class InferenceServerClient(InferenceServerClientBase):
         compression_algorithm: Optional[str] = None,
     ) -> InferResult:
         """Send a request built by :meth:`prepare_request` (reusable)."""
-        response = await self._call(
-            "ModelInfer",
-            request,
-            headers,
-            client_timeout,
-            compression=_grpc_compression(compression_algorithm),
-            idempotent=not _is_sequence_request(request),
+        trace = start_trace(
+            self._tracer, "infer", surface="grpc", model=request.model_name
         )
-        return InferResult(response)
+        if trace.traceparent:
+            headers = {
+                **(headers or {}),
+                TRACEPARENT_HEADER: trace.traceparent,
+            }
+        try:
+            response = await self._call(
+                "ModelInfer",
+                request,
+                headers,
+                client_timeout,
+                compression=_grpc_compression(compression_algorithm),
+                idempotent=not _is_sequence_request(request),
+                trace=trace,
+            )
+            with trace.stage("deserialize"):
+                result = InferResult(response)
+        except BaseException as e:
+            trace.finish(error=e)
+            raise
+        trace.finish()
+        return result
 
     async def infer(
         self,
@@ -461,28 +487,45 @@ class InferenceServerClient(InferenceServerClientBase):
         compression_algorithm: Optional[str] = None,
         parameters: Optional[Dict[str, Any]] = None,
     ) -> InferResult:
-        request = get_inference_request(
-            model_name,
-            inputs,
-            model_version=model_version,
-            request_id=request_id,
-            outputs=outputs,
-            sequence_id=sequence_id,
-            sequence_start=sequence_start,
-            sequence_end=sequence_end,
-            priority=priority,
-            timeout=timeout,
-            parameters=parameters,
+        trace = start_trace(
+            self._tracer, "infer", surface="grpc", model=model_name
         )
-        response = await self._call(
-            "ModelInfer",
-            request,
-            headers,
-            client_timeout,
-            compression=_grpc_compression(compression_algorithm),
-            idempotent=sequence_is_idempotent(sequence_id),
-        )
-        return InferResult(response)
+        try:
+            with trace.stage("serialize"):
+                request = get_inference_request(
+                    model_name,
+                    inputs,
+                    model_version=model_version,
+                    request_id=request_id,
+                    outputs=outputs,
+                    sequence_id=sequence_id,
+                    sequence_start=sequence_start,
+                    sequence_end=sequence_end,
+                    priority=priority,
+                    timeout=timeout,
+                    parameters=parameters,
+                )
+            if trace.traceparent:
+                headers = {
+                    **(headers or {}),
+                    TRACEPARENT_HEADER: trace.traceparent,
+                }
+            response = await self._call(
+                "ModelInfer",
+                request,
+                headers,
+                client_timeout,
+                compression=_grpc_compression(compression_algorithm),
+                idempotent=sequence_is_idempotent(sequence_id),
+                trace=trace,
+            )
+            with trace.stage("deserialize"):
+                result = InferResult(response)
+        except BaseException as e:
+            trace.finish(error=e)
+            raise
+        trace.finish()
+        return result
 
     def stream_infer(
         self,

@@ -131,6 +131,7 @@ class InferenceServerClient:
         ssl_context=None,
         retry_policy=None,
         circuit_breaker=None,
+        tracer=None,
     ):
         self._runner = EventLoopRunner(name=f"client-tpu-http[{url}]")
         self._aio_client = _aio.InferenceServerClient(
@@ -143,6 +144,7 @@ class InferenceServerClient:
             ssl_context=ssl_context,
             retry_policy=retry_policy,
             circuit_breaker=circuit_breaker,
+            tracer=tracer,
         )
 
     # plugin registry delegates to the aio client so headers flow through it

@@ -31,6 +31,12 @@ from client_tpu.http._utils import (
     parse_json_response,
     raise_if_error,
 )
+from client_tpu.observability.trace import (
+    NOOP_TRACE,
+    TRACEPARENT_HEADER,
+    Tracer,
+    start_trace,
+)
 from client_tpu.resilience import (
     CONNECTION_ERROR_STATUS,
     CircuitBreaker,
@@ -69,6 +75,12 @@ class InferenceServerClient(InferenceServerClientBase):
         Optional :class:`client_tpu.resilience.CircuitBreaker` shared
         per client (or across clients): when open, requests fail fast
         with ``CircuitBreakerOpenError`` instead of piling up backoff.
+    tracer:
+        Optional :class:`client_tpu.observability.Tracer`. When set,
+        each ``infer``/``infer_with_body`` call records client spans
+        (serialize, per-attempt send/wait, deserialize) and propagates a
+        W3C ``traceparent`` header the server front-ends extract. Off by
+        default (no spans, no header).
     """
 
     def __init__(
@@ -82,6 +94,7 @@ class InferenceServerClient(InferenceServerClientBase):
         ssl_context=None,
         retry_policy: Optional[RetryPolicy] = None,
         circuit_breaker: Optional[CircuitBreaker] = None,
+        tracer: Optional[Tracer] = None,
     ):
         super().__init__()
         scheme = "https" if ssl else "http"
@@ -99,6 +112,7 @@ class InferenceServerClient(InferenceServerClientBase):
         self._session: Optional[aiohttp.ClientSession] = None
         self._retry_policy = retry_policy
         self._circuit_breaker = circuit_breaker
+        self._tracer = tracer
 
     # -- session lifecycle -------------------------------------------------
 
@@ -139,11 +153,13 @@ class InferenceServerClient(InferenceServerClientBase):
         return request.headers
 
     async def _request_once(
-        self, method, url, data, headers, timeout
+        self, method, url, data, headers, timeout, trace=NOOP_TRACE
     ) -> tuple:
         """One attempt; transport failures surface as
         InferenceServerException (URL and cause in the message) rather
-        than raw aiohttp/asyncio errors."""
+        than raw aiohttp/asyncio errors. With an active ``trace`` the
+        attempt records a "send" span (until response headers arrive)
+        and a "wait" span (body read)."""
         session = self._ensure_session()
         # only override the session's default ClientTimeout when this
         # attempt carries an explicit budget: an explicit timeout=None
@@ -153,13 +169,19 @@ class InferenceServerClient(InferenceServerClientBase):
             if timeout
             else {}
         )
+        span = trace.begin_span("send", attempt=trace.attempt_index())
         try:
             async with session.request(
                 method, url, data=data, headers=headers, **kwargs
             ) as resp:
+                trace.end_span(span)
+                span = trace.begin_span("wait")
                 rbody = await resp.read()
+                trace.end_span(span)
+                span = None
                 return resp.status, rbody, dict(resp.headers)
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            trace.end_span(span, error=f"{type(e).__name__}: {e}")
             raise InferenceServerException(
                 f"{method} {url} failed: {type(e).__name__}: {e}",
                 status=CONNECTION_ERROR_STATUS,
@@ -175,6 +197,7 @@ class InferenceServerClient(InferenceServerClientBase):
         timeout=None,
         idempotent=True,
         probe=False,
+        trace=NOOP_TRACE,
     ) -> tuple:
         url = f"{self._base_url}/{path}{build_query_string(query_params)}"
         if self._verbose:
@@ -190,7 +213,8 @@ class InferenceServerClient(InferenceServerClientBase):
             )
         status, rbody, rheaders = await run_with_resilience_async(
             lambda attempt_timeout: self._request_once(
-                method, url, data, prepared_headers, attempt_timeout
+                method, url, data, prepared_headers, attempt_timeout,
+                trace=trace,
             ),
             retry_policy=self._retry_policy,
             circuit_breaker=self._circuit_breaker,
@@ -210,7 +234,7 @@ class InferenceServerClient(InferenceServerClientBase):
 
     async def _post(
         self, path, body: bytes, headers, query_params, timeout=None,
-        idempotent=True,
+        idempotent=True, trace=NOOP_TRACE,
     ) -> tuple:
         return await self._execute(
             "POST",
@@ -220,6 +244,7 @@ class InferenceServerClient(InferenceServerClientBase):
             query_params,
             timeout=timeout,
             idempotent=idempotent,
+            trace=trace,
         )
 
     async def _get_json(self, path, headers, query_params) -> Dict[str, Any]:
@@ -570,16 +595,29 @@ class InferenceServerClient(InferenceServerClientBase):
         extra_headers = dict(headers) if headers else {}
         if json_size is not None:
             extra_headers[HEADER_CONTENT_LENGTH] = str(json_size)
-        status, rbody, rheaders = await self._post(
-            model_infer_uri(model_name, model_version),
-            body,
-            extra_headers,
-            query_params,
-            timeout=timeout,
-            idempotent=idempotent,
+        trace = start_trace(
+            self._tracer, "infer", surface="http", model=model_name
         )
-        raise_if_error(status, rbody)
-        return InferResult.from_response(rbody, rheaders)
+        if trace.traceparent:
+            extra_headers[TRACEPARENT_HEADER] = trace.traceparent
+        try:
+            status, rbody, rheaders = await self._post(
+                model_infer_uri(model_name, model_version),
+                body,
+                extra_headers,
+                query_params,
+                timeout=timeout,
+                idempotent=idempotent,
+                trace=trace,
+            )
+            with trace.stage("deserialize"):
+                raise_if_error(status, rbody)
+                result = InferResult.from_response(rbody, rheaders)
+        except BaseException as e:
+            trace.finish(error=e)
+            raise
+        trace.finish()
+        return result
 
     async def infer(
         self,
@@ -600,33 +638,51 @@ class InferenceServerClient(InferenceServerClientBase):
         parameters: Optional[Dict[str, Any]] = None,
     ) -> InferResult:
         """Run a synchronous (from the caller's view: awaited) inference."""
-        body, json_size = get_inference_request_body(
-            inputs,
-            request_id=request_id,
-            outputs=outputs,
-            sequence_id=sequence_id,
-            sequence_start=sequence_start,
-            sequence_end=sequence_end,
-            priority=priority,
-            timeout=int(timeout * 1_000_000) if timeout else None,
-            parameters=parameters,
+        trace = start_trace(
+            self._tracer, "infer", surface="http", model=model_name
         )
-        extra_headers = dict(headers) if headers else {}
-        body, encoding = compress_body(body, request_compression_algorithm)
-        if encoding:
-            extra_headers["Content-Encoding"] = encoding
-        if response_compression_algorithm:
-            extra_headers["Accept-Encoding"] = response_compression_algorithm
-        if json_size is not None:
-            extra_headers[HEADER_CONTENT_LENGTH] = str(json_size)
+        try:
+            with trace.stage("serialize"):
+                body, json_size = get_inference_request_body(
+                    inputs,
+                    request_id=request_id,
+                    outputs=outputs,
+                    sequence_id=sequence_id,
+                    sequence_start=sequence_start,
+                    sequence_end=sequence_end,
+                    priority=priority,
+                    timeout=int(timeout * 1_000_000) if timeout else None,
+                    parameters=parameters,
+                )
+                extra_headers = dict(headers) if headers else {}
+                body, encoding = compress_body(
+                    body, request_compression_algorithm
+                )
+                if encoding:
+                    extra_headers["Content-Encoding"] = encoding
+                if response_compression_algorithm:
+                    extra_headers["Accept-Encoding"] = (
+                        response_compression_algorithm
+                    )
+                if json_size is not None:
+                    extra_headers[HEADER_CONTENT_LENGTH] = str(json_size)
+            if trace.traceparent:
+                extra_headers[TRACEPARENT_HEADER] = trace.traceparent
 
-        status, rbody, rheaders = await self._post(
-            model_infer_uri(model_name, model_version),
-            body,
-            extra_headers,
-            query_params,
-            timeout=timeout,
-            idempotent=sequence_is_idempotent(sequence_id),
-        )
-        raise_if_error(status, rbody)
-        return InferResult.from_response(rbody, rheaders)
+            status, rbody, rheaders = await self._post(
+                model_infer_uri(model_name, model_version),
+                body,
+                extra_headers,
+                query_params,
+                timeout=timeout,
+                idempotent=sequence_is_idempotent(sequence_id),
+                trace=trace,
+            )
+            with trace.stage("deserialize"):
+                raise_if_error(status, rbody)
+                result = InferResult.from_response(rbody, rheaders)
+        except BaseException as e:
+            trace.finish(error=e)
+            raise
+        trace.finish()
+        return result

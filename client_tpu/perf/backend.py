@@ -191,6 +191,7 @@ class HttpPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
         concurrency: int = 128,
         retry_policy=None,
         circuit_breaker=None,
+        tracer=None,
     ):
         from client_tpu.http import aio as httpclient
 
@@ -200,6 +201,7 @@ class HttpPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
             concurrency=concurrency,
             retry_policy=retry_policy,
             circuit_breaker=circuit_breaker,
+            tracer=tracer,
         )
         self._init_prepared()
 
@@ -284,12 +286,17 @@ class GrpcPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
     kind = "grpc"
     supports_streaming = True
 
-    def __init__(self, url: str, retry_policy=None, circuit_breaker=None):
+    def __init__(
+        self, url: str, retry_policy=None, circuit_breaker=None, tracer=None
+    ):
         from client_tpu.grpc import aio as grpcclient
 
         self._mod = grpcclient
         self._client = grpcclient.InferenceServerClient(
-            url, retry_policy=retry_policy, circuit_breaker=circuit_breaker
+            url,
+            retry_policy=retry_policy,
+            circuit_breaker=circuit_breaker,
+            tracer=tracer,
         )
         self._init_prepared()
 

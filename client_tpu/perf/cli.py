@@ -187,6 +187,19 @@ def build_parser() -> argparse.ArgumentParser:
         "exceeds this fraction in [0, 1] (default: tolerate errors; "
         "they are recorded and reported)",
     )
+    parser.add_argument(
+        "--stage-breakdown",
+        action="store_true",
+        help="trace every request client-side (observability spans) and "
+        "report a serialize/transport/deserialize stage breakdown next "
+        "to the server queue/compute stats (kserve http/grpc only)",
+    )
+    parser.add_argument(
+        "--trace-export-file",
+        default=None,
+        help="write the client-side spans as JSONL to this file "
+        "(implies --stage-breakdown)",
+    )
     from client_tpu.perf.distributed import topology_from_env
 
     env_world_size, env_rank, env_coordinator = topology_from_env()
@@ -261,6 +274,15 @@ async def run(args) -> int:
     )
     from client_tpu.perf.sequence import SequenceManager
 
+    want_tracing = args.stage_breakdown or args.trace_export_file
+    if want_tracing and args.service_kind != "kserve":
+        print(
+            "error: --stage-breakdown/--trace-export-file need the kserve "
+            "http/grpc clients (client-side spans)",
+            file=sys.stderr,
+        )
+        return 2
+    trace_exporter = None
     if args.service_kind == "openai":
         backend = create_backend("openai", args.url, endpoint=args.endpoint)
     elif args.service_kind in ("tfserving", "torchserve"):
@@ -280,7 +302,14 @@ async def run(args) -> int:
             return 2
         backend = create_backend(args.service_kind, args.url)
     else:
-        backend = create_backend(args.protocol, args.url)
+        backend_kwargs = {}
+        if want_tracing:
+            from client_tpu.observability import JsonlExporter, Tracer
+
+            if args.trace_export_file:
+                trace_exporter = JsonlExporter(args.trace_export_file)
+            backend_kwargs["tracer"] = Tracer(exporter=trace_exporter)
+        backend = create_backend(args.protocol, args.url, **backend_kwargs)
     if args.streaming and not backend.supports_streaming:
         if args.service_kind in ("tfserving", "torchserve"):
             hint = (f"the {args.service_kind} service kind never supports "
@@ -546,6 +575,8 @@ async def run(args) -> int:
         if shm_plane is not None:
             await shm_plane.cleanup()
         await backend.close()
+        if trace_exporter is not None:
+            trace_exporter.close()
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -15,6 +15,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from client_tpu import resilience
+from client_tpu.observability import trace as observability
 from client_tpu.perf.backend import PerfBackend
 from client_tpu.perf.data import DataLoader
 from client_tpu.perf.records import RequestRecord
@@ -107,6 +108,7 @@ class LoadManager:
                 parameters = {**(parameters or {}), **step_params}
         record = RequestRecord(start_ns=time.monotonic_ns(), request_id=request_id)
         resilience.reset_retry_count()
+        observability.reset_last_stages()
         try:
             if self.streaming and self.backend.supports_streaming:
                 def on_response():
@@ -146,6 +148,9 @@ class LoadManager:
         # transparent retries the resilience layer performed for this call
         # (contextvar updates within one task persist across awaits)
         record.retries = resilience.last_retry_count()
+        # client-side stage durations from the tracer, when the backend
+        # has one configured (same contextvar idiom as the retry count)
+        record.stages = observability.last_stages()
         record.sequence_id = seq_kwargs.get("sequence_id", 0)
         record.ctx_id = slot if slot is not None else 0
         self.issued_total += 1

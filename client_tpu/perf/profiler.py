@@ -251,6 +251,21 @@ class InferenceProfiler:
                 field,
                 sum(getattr(w, field) for w in windows) / len(windows),
             )
+        # client stage averages weight by each window's traced requests
+        merged.traced_count = sum(w.traced_count for w in windows)
+        if merged.traced_count:
+            for field in (
+                "client_serialize_us",
+                "client_transport_us",
+                "client_deserialize_us",
+            ):
+                setattr(
+                    merged,
+                    field,
+                    sum(
+                        getattr(w, field) * w.traced_count for w in windows
+                    ) / merged.traced_count,
+                )
         return merged
 
     # -- sweeps --------------------------------------------------------------

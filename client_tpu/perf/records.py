@@ -8,7 +8,7 @@ inference_profiler.h's PerfStatus.
 
 import dataclasses
 import math
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 
 @dataclasses.dataclass
@@ -30,6 +30,10 @@ class RequestRecord:
     # draws it randomly for non-sequence models, reference
     # rand_ctx_id_tracker.h; sequences own their slot)
     ctx_id: int = 0
+    # client-side span stage durations for this request (observability
+    # tracer rollup: serialize/transport/deserialize ns); None when the
+    # backend has no tracer configured
+    stages: Optional[Dict[str, Any]] = None
 
     @property
     def latency_ns(self) -> int:
@@ -72,6 +76,13 @@ class PerfStatus:
     server_compute_infer_us: float = 0.0
     server_compute_input_us: float = 0.0
     server_compute_output_us: float = 0.0
+    # client-side stage averages from the observability tracer's spans
+    # (microseconds over the window's traced successes); traced_count 0
+    # means no tracer was configured
+    traced_count: int = 0
+    client_serialize_us: float = 0.0
+    client_transport_us: float = 0.0
+    client_deserialize_us: float = 0.0
 
     @property
     def stabilizing_latency_us(self) -> float:
@@ -117,4 +128,17 @@ def compute_window_status(
         status.latency_percentiles_us = {
             q: percentile(lat_us, q) for q in percentiles
         }
+    traced = [r for r in successes if r.stages]
+    if traced:
+        n = len(traced)
+        status.traced_count = n
+        status.client_serialize_us = (
+            sum(r.stages.get("serialize", 0) for r in traced) / n / 1e3
+        )
+        status.client_transport_us = (
+            sum(r.stages.get("transport", 0) for r in traced) / n / 1e3
+        )
+        status.client_deserialize_us = (
+            sum(r.stages.get("deserialize", 0) for r in traced) / n / 1e3
+        )
     return status

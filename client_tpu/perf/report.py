@@ -71,6 +71,30 @@ def detailed_report(experiment: ProfileExperiment) -> str:
             f"{s.server_compute_infer_us:.0f} usec, compute output "
             f"{s.server_compute_output_us:.0f} usec"
         )
+    if s.traced_count:
+        # Client spans (observability tracer) split the end-to-end latency
+        # into attributable stages; combined with the server-side stats
+        # delta, the transport time decomposes into server work vs
+        # network + wire overhead.
+        lines.append(
+            f"  Stage breakdown ({s.traced_count} traced): client "
+            f"serialize {s.client_serialize_us:.0f} usec, transport "
+            f"{s.client_transport_us:.0f} usec, deserialize "
+            f"{s.client_deserialize_us:.0f} usec"
+        )
+        server_us = (
+            s.server_queue_us
+            + s.server_compute_input_us
+            + s.server_compute_infer_us
+            + s.server_compute_output_us
+        )
+        if server_us:
+            network_us = max(0.0, s.client_transport_us - server_us)
+            lines.append(
+                f"    server queue {s.server_queue_us:.0f} usec + compute "
+                f"{server_us - s.server_queue_us:.0f} usec -> network+wire "
+                f"~{network_us:.0f} usec"
+            )
     if s.error_count:
         lines.append(f"  Errors: {s.error_count}")
     if s.retry_count:

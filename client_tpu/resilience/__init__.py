@@ -36,6 +36,7 @@ from client_tpu.resilience.policy import (
     CircuitBreakerOpenError,
     Deadline,
     RetryPolicy,
+    begin_attempt_events,
     exception_is_retryable,
     http_status_is_retryable,
     last_retry_count,
@@ -44,6 +45,7 @@ from client_tpu.resilience.policy import (
     run_with_resilience,
     run_with_resilience_async,
     sequence_is_idempotent,
+    take_attempt_events,
 )
 
 __all__ = [
@@ -55,6 +57,7 @@ __all__ = [
     "CircuitBreakerOpenError",
     "Deadline",
     "RetryPolicy",
+    "begin_attempt_events",
     "exception_is_retryable",
     "http_status_is_retryable",
     "last_retry_count",
@@ -63,4 +66,5 @@ __all__ = [
     "run_with_resilience",
     "run_with_resilience_async",
     "sequence_is_idempotent",
+    "take_attempt_events",
 ]

@@ -39,6 +39,34 @@ _last_retry_count: contextvars.ContextVar = contextvars.ContextVar(
     "client_tpu_last_retry_count", default=0
 )
 
+# Per-context event log of what the attempt loop did (retries taken,
+# circuit-breaker trips/fast-fails). The observability tracer arms it
+# before a traced call and drains it into span annotations afterwards;
+# when unarmed (the default) logging is a None-check — zero cost.
+_attempt_events: contextvars.ContextVar = contextvars.ContextVar(
+    "client_tpu_attempt_events", default=None
+)
+
+
+def begin_attempt_events() -> list:
+    """Arm the per-context attempt-event log; returns the live list."""
+    events: list = []
+    _attempt_events.set(events)
+    return events
+
+
+def take_attempt_events() -> list:
+    """Drain and disarm the per-context attempt-event log."""
+    events = _attempt_events.get()
+    _attempt_events.set(None)
+    return events if events is not None else []
+
+
+def _note(event: str, **fields) -> None:
+    log = _attempt_events.get()
+    if log is not None:
+        log.append({"event": event, **fields})
+
 
 def sequence_is_idempotent(sequence_id) -> bool:
     """False when a request carries sequence state (``sequence_id`` set):
@@ -263,6 +291,7 @@ class CircuitBreaker:
         self._consecutive_failures = 0
         self._probes_in_flight = 0
         self.times_opened += 1
+        _note("circuit_tripped", times_opened=self.times_opened)
 
 
 # ---------------------------------------------------------------------------
@@ -476,6 +505,7 @@ class _AttemptLoop:
         """Breaker gate + per-attempt timeout for the next attempt."""
         if self.breaker is not None and not self.breaker.allow():
             self._finish()
+            _note("circuit_open", description=self.description)
             raise CircuitBreakerOpenError(
                 f"circuit breaker is open; {self.description} failed fast"
             )
@@ -499,6 +529,17 @@ class _AttemptLoop:
                 )
                 if backoff is not None:
                     self.retries += 1
+                    status = (
+                        exc.status()
+                        if isinstance(exc, InferenceServerException)
+                        else None
+                    )
+                    _note(
+                        "retry",
+                        attempt=self.retries,
+                        backoff_s=backoff,
+                        error=status or type(exc).__name__,
+                    )
                     return backoff
         self._finish()
         raise exc
@@ -525,6 +566,12 @@ class _AttemptLoop:
                 )
                 if backoff is not None:
                     self.retries += 1
+                    _note(
+                        "retry",
+                        attempt=self.retries,
+                        backoff_s=backoff,
+                        error=token,
+                    )
                     return backoff
             self._finish()
             return None

@@ -271,22 +271,33 @@ def _tpu_shm_unregister(core: ServerCore, request):
 
 
 def _trace_setting(core: ServerCore, request):
-    if request.settings:
-        for key, value in request.settings.items():
-            if value.value:
-                core.trace_settings[key] = list(value.value)
+    """The trace-settings RPC, backed by the real TraceManager: validated
+    updates (unknown keys / wrong types -> INVALID_ARGUMENT), per-model
+    overrides via ``model_name``, and an empty value clearing a setting
+    (Triton semantics)."""
+    updates = {}
+    for key, value in request.settings.items():
+        updates[key] = list(value.value) if value.value else None
+    if updates:
+        settings = core.trace_manager.update(updates, request.model_name)
+    else:
+        settings = core.trace_manager.settings(request.model_name)
     response = pb.TraceSettingResponse()
-    for key, value in core.trace_settings.items():
+    for key, value in settings.items():
         values = value if isinstance(value, list) else [str(value)]
         response.settings[key].value.extend([str(v) for v in values])
     return response
 
 
 def _log_settings(core: ServerCore, request):
+    from client_tpu.observability import validate_log_settings
+
+    updates = {}
     for key, value in request.settings.items():
         which = value.WhichOneof("parameter_choice")
         if which is not None:
-            core.log_settings[key] = getattr(value, which)
+            updates[key] = getattr(value, which)
+    core.log_settings.update(validate_log_settings(updates))
     response = pb.LogSettingsResponse()
     for key, value in core.log_settings.items():
         if isinstance(value, bool):

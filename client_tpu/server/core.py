@@ -75,6 +75,24 @@ class CoreRequest:
     inputs: List[CoreTensor] = field(default_factory=list)
     outputs: List[CoreRequestedOutput] = field(default_factory=list)
     parameters: Dict[str, Any] = field(default_factory=dict)
+    # server trace attached by the front-end (observability.ServerTrace);
+    # the execution paths add queue/compute stage events to it
+    trace: Optional[Any] = None
+
+
+def _trace_stages(
+    trace, queue_start_ns: int, compute_start_ns: int,
+    compute_end_ns: int, request_end_ns: int,
+) -> None:
+    """Stamp the Triton-style stage timestamps onto a server trace
+    (no-op for untraced requests). REQUEST_START was recorded by the
+    front-end when it accepted the request."""
+    if trace is None:
+        return
+    trace.event("QUEUE_START", queue_start_ns)
+    trace.event("COMPUTE_START", compute_start_ns)
+    trace.event("COMPUTE_END", compute_end_ns)
+    trace.event("REQUEST_END", request_end_ns)
 
 
 @dataclass(slots=True)
@@ -516,6 +534,9 @@ class _ModelBatcher:
                     out_ns=out_end - infer_end,
                     executions=execution_pending,
                 )
+                _trace_stages(
+                    request.trace, arrival, exec_start, infer_end, out_end
+                )
                 execution_pending = 0
                 if not future.done():
                     future.set_result(response)
@@ -545,13 +566,12 @@ class ServerCore:
             max_workers=max_workers, thread_name_prefix="client-tpu-exec"
         )
         self.live = True
-        self.trace_settings: Dict[str, Any] = {
-            "trace_level": ["OFF"],
-            "trace_rate": "1000",
-            "trace_count": "-1",
-            "log_frequency": "0",
-            "trace_file": "",
-        }
+        # The trace extension, made real: sampling, per-model settings,
+        # timestamped records (observability.TraceManager). The old inert
+        # trace_settings dict survives as a read-only property below.
+        from client_tpu.observability.server import TraceManager
+
+        self.trace_manager = TraceManager()
         self.log_settings: Dict[str, Any] = {
             "log_file": "",
             "log_info": True,
@@ -561,8 +581,15 @@ class ServerCore:
             "log_format": "default",
         }
 
+    @property
+    def trace_settings(self) -> Dict[str, Any]:
+        """The effective global trace settings (compat view over the
+        trace manager; update through ``trace_manager.update``)."""
+        return self.trace_manager.settings()
+
     def close(self) -> None:
         self._executor.shutdown(wait=False, cancel_futures=True)
+        self.trace_manager.close()
 
     def _stats_for(self, model_name: str) -> _Stats:
         with self._stats_lock:
@@ -890,6 +917,13 @@ class ServerCore:
                         k: v[offset : offset + rows] for k, v in raw.items()
                     }
                 results[idx] = self._package_outputs(model, request, sliced)
+                _trace_stages(
+                    request.trace,
+                    arrival_ns,
+                    exec_start,
+                    infer_end,
+                    time.monotonic_ns(),
+                )
                 ok_requests += 1
                 ok_rows += rows
             except Exception as e:  # noqa: BLE001 - per-request packaging
@@ -931,6 +965,7 @@ class ServerCore:
             infer_ns=t1 - t0,
             out_ns=t2 - t1,
         )
+        _trace_stages(request.trace, t0, t0, t1, t2)
         return response
 
     async def infer(self, request: CoreRequest) -> CoreResponse:
@@ -970,6 +1005,7 @@ class ServerCore:
             infer_ns=t2 - t1,
             out_ns=t3 - t2,
         )
+        _trace_stages(request.trace, t0, t1, t2, t3)
         return response
 
     async def infer_decoupled(
@@ -1001,6 +1037,7 @@ class ServerCore:
                 infer_ns=(t1 - t0) - packaging_ns,
                 out_ns=packaging_ns,
             )
+            _trace_stages(request.trace, t0, t0, t1, t1)
 
         try:
             if not model.decoupled:
@@ -1030,6 +1067,8 @@ class ServerCore:
                     latency_ns=p1 - t0,
                     empty=not raw,
                 )
+                if request.trace is not None:
+                    request.trace.event(f"RESPONSE_{index}", p1)
                 prev_ns = p1
                 index += 1
                 # A close/cancel that arrives while suspended at this yield

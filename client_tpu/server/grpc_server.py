@@ -191,13 +191,34 @@ class _Servicer(GRPCInferenceServiceServicer):
 
     # -- inference -----------------------------------------------------------
 
+    def _begin_trace(self, context, request):
+        """Trace sampling + W3C traceparent extraction from the call
+        metadata (the gRPC face of the HTTP header)."""
+        metadata = dict(context.invocation_metadata() or ())
+        return self.core.trace_manager.begin(
+            request.model_name,
+            model_version=request.model_version,
+            traceparent=metadata.get("traceparent"),
+            request_id=request.id,
+        )
+
     async def ModelInfer(self, request, context):
         await self._chaos_gate(context, "ModelInfer")
+        trace = self._begin_trace(context, request)
         try:
             core_request = build_core_request(self.core, request)
+            core_request.trace = trace
             core_response = await self.core.infer(core_request)
         except InferenceServerException as e:
+            if trace is not None:
+                trace.end(error=e.message())
             await context.abort(_status_for(e.message()), e.message())
+        except BaseException as e:
+            if trace is not None:
+                trace.end(error=str(e))
+            raise
+        if trace is not None:
+            trace.end()
         return build_proto_response(core_response)
 
     async def ModelStreamInfer(self, request_iterator, context):
@@ -205,8 +226,10 @@ class _Servicer(GRPCInferenceServiceServicer):
             # an injected fault aborts the whole stream with UNAVAILABLE
             # (connection-loss semantics), not a per-request error reply
             await self._chaos_gate(context, "ModelStreamInfer")
+            trace = self._begin_trace(context, request)
             try:
                 core_request = build_core_request(self.core, request)
+                core_request.trace = trace
                 async for core_response in self.core.infer_decoupled(
                     core_request
                 ):
@@ -214,11 +237,22 @@ class _Servicer(GRPCInferenceServiceServicer):
                         infer_response=build_proto_response(core_response)
                     )
             except InferenceServerException as e:
+                if trace is not None:
+                    trace.end(error=e.message())
+                    trace = None
                 error = pb.ModelStreamInferResponse(
                     error_message=e.message(),
                     infer_response=pb.ModelInferResponse(id=request.id),
                 )
                 yield error
+            except BaseException as e:
+                # stream teardown (client cancel) or a non-ISE failure:
+                # the trace record must still be exported
+                if trace is not None:
+                    trace.end(error=str(e) or type(e).__name__)
+                raise
+            if trace is not None:
+                trace.end()
 
 
 # Bind every non-inference method to the shared codec implementation.

@@ -10,12 +10,14 @@ import asyncio
 import base64
 import gzip
 import json
+import time
 import zlib
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 from aiohttp import web
 
+from client_tpu.observability import TRACEPARENT_HEADER, validate_log_settings
 from client_tpu.server.core import (
     SERVER_EXTENSIONS,
     SERVER_NAME,
@@ -29,11 +31,25 @@ from client_tpu.utils import (
     serialize_byte_tensor,
 )
 
+try:  # jax powers the optional device-memory gauges in /metrics
+    import jax
+except Exception:  # pragma: no cover - jax is an optional extra
+    jax = None
+
 HEADER_CONTENT_LENGTH = "Inference-Header-Content-Length"
 
 
 def _error_response(msg: str, status: int = 400) -> web.Response:
     return web.json_response({"error": msg}, status=status)
+
+
+def prometheus_escape(label: str) -> str:
+    """Prometheus exposition-format label-value escaping."""
+    return (
+        label.replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
 
 
 def _chaos_middleware(chaos):
@@ -249,14 +265,7 @@ class HttpServer:
         device memory gauges (the TPU replacement for the reference's
         nv_gpu_* metrics scraped by perf_analyzer's MetricsManager,
         reference metrics_manager.h:45-92, metrics.h:37-42)."""
-        def esc(label: str) -> str:
-            # Prometheus exposition format label-value escaping.
-            return (
-                label.replace("\\", "\\\\")
-                .replace('"', '\\"')
-                .replace("\n", "\\n")
-            )
-
+        esc = prometheus_escape
         lines = [
             "# HELP tpu_inference_count Successful inference requests.",
             "# TYPE tpu_inference_count counter",
@@ -281,8 +290,6 @@ class HttpServer:
         # the reference's nv_gpu_utilization (SURVEY §5; reference
         # metrics.h:37-42). Computed from the statistics extension's
         # compute_infer counters, so it needs no device-side profiler.
-        import time as _time
-
         # Only device-placed models count toward TPU duty: host-placed
         # models (device == "cpu", e.g. the tiny 'simple' fixture) execute
         # on the host and must not report the TPU as busy.
@@ -299,25 +306,30 @@ class HttpServer:
             for ms in self.core.statistics()["model_stats"]
             if ms["name"] in device_models
         )
-        now_ns = _time.monotonic_ns()
+        now_ns = time.monotonic_ns()
         prev = getattr(self, "_metrics_prev", None)
         duty = 0.0
         if prev is not None and now_ns > prev[0]:
-            duty = (total_compute_ns - prev[1]) / (now_ns - prev[0])
-            duty = max(0.0, min(1.0, duty))
+            # A statistics reset (model reload, stats cleared) makes the
+            # cumulative counter go backwards; clamp the delta to 0 so the
+            # gauge never goes negative.
+            compute_delta_ns = max(0, total_compute_ns - prev[1])
+            duty = min(1.0, compute_delta_ns / (now_ns - prev[0]))
         self._metrics_prev = (now_ns, total_compute_ns)
         lines.append("# TYPE tpu_duty_cycle gauge")
         lines.append(f"tpu_duty_cycle {duty:.6f}")
         lines.append("# TYPE tpu_device_compute_ns_total counter")
         lines.append(f"tpu_device_compute_ns_total {total_compute_ns}")
         lines.append("# TYPE tpu_memory_used_bytes gauge")
-        try:
-            import jax
-
-            for i, device in enumerate(jax.local_devices()):
+        if jax is not None:
+            try:
+                devices = jax.local_devices()
+            except Exception:  # noqa: BLE001 - no backend available
+                devices = []
+            for i, device in enumerate(devices):
                 try:
                     mstats = device.memory_stats() or {}
-                except Exception:
+                except Exception:  # noqa: BLE001 - backend-dependent
                     mstats = {}
                 used = mstats.get("bytes_in_use")
                 limit = mstats.get("bytes_limit") or mstats.get(
@@ -336,8 +348,6 @@ class HttpServer:
                             f'tpu_memory_utilization{{device="{i}"}} '
                             f"{used / limit:.6f}"
                         )
-        except Exception:
-            pass
         return web.Response(
             text="\n".join(lines) + "\n", content_type="text/plain"
         )
@@ -398,29 +408,44 @@ class HttpServer:
 
     # -- trace / logging -----------------------------------------------------
 
+    @staticmethod
+    def _parse_settings_body(body: bytes) -> Dict[str, Any]:
+        if not body:
+            return {}
+        try:
+            updates = json.loads(body)
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise InferenceServerException(
+                f"malformed settings request: {e}"
+            ) from None
+        if not isinstance(updates, dict):
+            raise InferenceServerException(
+                "settings request must be a JSON object"
+            )
+        return updates
+
     async def handle_get_trace(self, request):
-        return web.json_response(self.core.trace_settings)
+        model = request.match_info.get("model", "")
+        return web.json_response(self.core.trace_manager.settings(model))
 
     async def handle_update_trace(self, request):
-        body = await request.read()
-        if body:
-            updates = json.loads(body)
-            for key, value in updates.items():
-                if value is None:
-                    continue
-                self.core.trace_settings[key] = value
-        return web.json_response(self.core.trace_settings)
+        # Unknown keys and wrong-typed values are rejected with a 400 +
+        # JSON error body (Triton behavior) — the manager validates the
+        # whole update before applying any of it. A null value clears a
+        # per-model override / resets a global setting.
+        updates = self._parse_settings_body(await request.read())
+        model = request.match_info.get("model", "")
+        return web.json_response(
+            self.core.trace_manager.update(updates, model)
+        )
 
     async def handle_get_logging(self, request):
         return web.json_response(self.core.log_settings)
 
     async def handle_update_logging(self, request):
-        body = await request.read()
-        if body:
-            updates = json.loads(body)
-            for key, value in updates.items():
-                if value is not None:
-                    self.core.log_settings[key] = value
+        updates = self._parse_settings_body(await request.read())
+        updates = {k: v for k, v in updates.items() if v is not None}
+        self.core.log_settings.update(validate_log_settings(updates))
         return web.json_response(self.core.log_settings)
 
     # -- inference -----------------------------------------------------------
@@ -449,15 +474,34 @@ class HttpServer:
                 ) from None
             binary = b""
 
-        core_request = self._build_core_request(
-            request.match_info["model"],
-            request.match_info.get("version", ""),
-            payload,
-            binary,
+        model_name = request.match_info["model"]
+        # Trace sampling + W3C context extraction: a propagated sampled
+        # traceparent correlates this server record with the client span.
+        trace = self.core.trace_manager.begin(
+            model_name,
+            model_version=request.match_info.get("version", ""),
+            traceparent=request.headers.get(TRACEPARENT_HEADER),
         )
-        core_response = await self.core.infer(core_request)
-        accept = request.headers.get("Accept-Encoding", "")
-        return self._build_response(payload, core_response, accept)
+        try:
+            core_request = self._build_core_request(
+                model_name,
+                request.match_info.get("version", ""),
+                payload,
+                binary,
+            )
+            core_request.trace = trace
+            if trace is not None:
+                trace.request_id = core_request.id
+            core_response = await self.core.infer(core_request)
+            accept = request.headers.get("Accept-Encoding", "")
+            response = self._build_response(payload, core_response, accept)
+        except BaseException as e:
+            if trace is not None:
+                trace.end(error=str(e))
+            raise
+        if trace is not None:
+            trace.end()
+        return response
 
     def _build_core_request(
         self, model_name, model_version, payload, binary

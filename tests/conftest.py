@@ -54,6 +54,20 @@ def pytest_configure(config):
         "tpu: runs on the real TPU device (select with -m tpu and "
         "CLIENT_TPU_TEST_PLATFORM=tpu); skipped otherwise",
     )
+    # Clock-injection lint: observability/resilience must never call
+    # time.*() clocks directly (their tests run on fake clocks). Failing
+    # at session start beats a flaky sleep-based test later.
+    import pytest
+
+    from tools.clock_lint import run_clock_lint
+
+    problems = run_clock_lint()
+    if problems:
+        raise pytest.UsageError(
+            "clock lint failed (injectable clocks only in "
+            "client_tpu/observability and client_tpu/resilience):\n"
+            + "\n".join(problems)
+        )
 
 
 def pytest_collection_modifyitems(config, items):

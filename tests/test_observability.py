@@ -1,0 +1,584 @@
+"""Observability layer tests: tracer/span core on fake clocks, trace
+settings validation on both front-ends, traceparent round-trips through
+InProcessServer (all four client surfaces), retry-annotated spans under
+chaos, the Prometheus /metrics endpoint, and the perf stage breakdown.
+
+No real sleeps: clocks are injected everywhere (tools/clock_lint.py
+keeps it that way), chaos backoffs are zero.
+"""
+
+import asyncio
+import json
+import logging
+import random
+import urllib.request
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.grpc.aio as aio_grpcclient
+import client_tpu.http as httpclient
+import client_tpu.http.aio as aio_httpclient
+from client_tpu.observability import (
+    ClientMetrics,
+    InMemoryExporter,
+    JsonlExporter,
+    TraceContext,
+    TraceManager,
+    Tracer,
+    last_stages,
+    validate_log_settings,
+)
+from client_tpu.resilience import ChaosPolicy, RetryPolicy
+from client_tpu.server.http_server import prometheus_escape
+from client_tpu.testing import InProcessServer
+from client_tpu.utils import InferenceServerException
+
+pytestmark = pytest.mark.observability
+
+logging.getLogger("aiohttp.server").setLevel(logging.CRITICAL)
+
+
+class FakeClockNs:
+    """Monotonic fake clock: every read advances 1000 ns."""
+
+    def __init__(self, step_ns: int = 1000):
+        self.now = 0
+        self.step = step_ns
+
+    def __call__(self) -> int:
+        self.now += self.step
+        return self.now
+
+
+def _tracer(exporter=None, **kwargs):
+    kwargs.setdefault("clock_ns", FakeClockNs())
+    kwargs.setdefault("rng", random.Random(0))
+    return Tracer(exporter=exporter, **kwargs)
+
+
+def _simple_inputs(mod):
+    in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    in1 = np.ones([1, 16], dtype=np.int32)
+    a = mod.InferInput("INPUT0", [1, 16], "INT32")
+    a.set_data_from_numpy(in0)
+    b = mod.InferInput("INPUT1", [1, 16], "INT32")
+    b.set_data_from_numpy(in1)
+    return [a, b]
+
+
+# ---------------------------------------------------------------------------
+# W3C trace context
+
+
+def test_traceparent_roundtrip_format():
+    ctx = TraceContext(trace_id="ab" * 16, span_id="cd" * 8, sampled=True)
+    header = ctx.to_header()
+    assert header == f"00-{'ab' * 16}-{'cd' * 8}-01"
+    parsed = TraceContext.parse(header)
+    assert parsed == ctx
+    unsampled = TraceContext.parse(f"00-{'ab' * 16}-{'cd' * 8}-00")
+    assert unsampled is not None and not unsampled.sampled
+
+
+@pytest.mark.parametrize(
+    "header",
+    [
+        None,
+        "",
+        "garbage",
+        "00-short-cdcdcdcdcdcdcdcd-01",
+        f"00-{'0' * 32}-{'cd' * 8}-01",  # all-zero trace id
+        f"00-{'ab' * 16}-{'0' * 16}-01",  # all-zero span id
+        f"ff-{'ab' * 16}-{'cd' * 8}-01",  # forbidden version
+        f"00-{'XY' * 16}-{'cd' * 8}-01",  # non-hex
+    ],
+)
+def test_traceparent_malformed(header):
+    assert TraceContext.parse(header) is None
+
+
+# ---------------------------------------------------------------------------
+# tracer core (fake clock)
+
+
+def test_tracer_spans_and_stage_rollup():
+    exporter = InMemoryExporter()
+    tracer = _tracer(exporter)
+    trace = tracer.start("infer", model="simple")
+    with trace.stage("serialize"):
+        pass
+    span = trace.begin_span("send", attempt=trace.attempt_index())
+    trace.end_span(span)
+    with trace.stage("deserialize"):
+        pass
+    trace.finish()
+    names = [s.name for s in exporter.items]
+    assert names == ["infer", "serialize", "send", "deserialize"]
+    root = exporter.items[0]
+    for child in exporter.items[1:]:
+        assert child.trace_id == root.trace_id
+        assert child.parent_id == root.span_id
+        assert child.duration_ns > 0
+    stages = last_stages()
+    assert stages["trace_id"] == root.trace_id
+    assert stages["attempts"] == 1
+    assert stages["serialize"] > 0 and stages["transport"] > 0
+    assert tracer.metrics.snapshot()["request_count"] == 1
+
+
+def test_tracer_finish_idempotent_and_error():
+    exporter = InMemoryExporter()
+    tracer = _tracer(exporter)
+    trace = tracer.start("infer")
+    trace.finish(error=InferenceServerException("boom"))
+    trace.finish()  # second finish must not double-export
+    assert len(exporter.items) == 1
+    assert exporter.items[0].error == "boom"
+    assert tracer.metrics.snapshot()["error_count"] == 1
+
+
+def test_tracer_sampling():
+    tracer = _tracer(sample_rate=0.0)
+    assert tracer.start("infer") is None
+    always = _tracer(sample_rate=1.0)
+    assert always.start("infer") is not None
+
+
+def test_client_metrics_histogram():
+    metrics = ClientMetrics()
+    metrics.record(50_000, error=False)  # 50 us -> first bucket (<=100us)
+    metrics.record(700_000_000, error=True, retries=2)  # 0.7 s
+    snap = metrics.snapshot()
+    assert snap["request_count"] == 2
+    assert snap["error_count"] == 1
+    assert snap["retry_count"] == 2
+    histogram = snap["latency_histogram_us"]
+    assert histogram[0] == {"le_us": 100, "count": 1}
+    assert histogram[-1]["le_us"] == "inf" and histogram[-1]["count"] == 2
+
+
+def test_jsonl_exporter(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    exporter = JsonlExporter(path)
+    tracer = _tracer(exporter)
+    trace = tracer.start("infer")
+    trace.finish()
+    exporter.export([{"id": "plain-dict"}])
+    exporter.close()
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    assert lines[0]["name"] == "infer"
+    assert lines[1]["id"] == "plain-dict"
+
+
+# ---------------------------------------------------------------------------
+# server TraceManager: sampling, budgets, validation
+
+
+def test_trace_manager_rate_sampling():
+    manager = TraceManager(clock_ns=FakeClockNs(), exporter=InMemoryExporter())
+    manager.update({"trace_level": ["TIMESTAMPS"], "trace_rate": "3"})
+    traced = [manager.begin("m") is not None for _ in range(9)]
+    assert traced == [True, False, False] * 3
+    # per-model counters: a second model starts its own cycle
+    assert manager.begin("other") is not None
+
+
+def test_trace_manager_level_off_and_count():
+    manager = TraceManager(clock_ns=FakeClockNs())
+    assert manager.begin("m") is None  # default level OFF
+    manager.update(
+        {"trace_level": ["TIMESTAMPS"], "trace_rate": "1", "trace_count": "2"}
+    )
+    assert manager.begin("m") is not None
+    assert manager.begin("m") is not None
+    assert manager.begin("m") is None  # budget exhausted
+    manager.update({"trace_count": "-1"})  # re-arm unlimited
+    assert manager.begin("m") is not None
+
+
+def test_trace_manager_per_model_trace_count():
+    manager = TraceManager(clock_ns=FakeClockNs())
+    manager.update({"trace_level": ["TIMESTAMPS"], "trace_rate": "1"})
+    manager.update({"trace_count": "2"}, model_name="m")
+    assert manager.begin("m") is not None
+    assert manager.begin("m") is not None
+    assert manager.begin("m") is None  # per-model budget exhausted
+    # other models ride the global (unlimited) budget
+    assert manager.begin("other") is not None
+    # clearing the override removes the model's budget
+    manager.update({"trace_count": None}, model_name="m")
+    assert manager.begin("m") is not None
+
+
+def test_tracer_does_not_inherit_previous_retry_count():
+    from client_tpu.resilience.policy import _last_retry_count
+
+    tracer = _tracer(InMemoryExporter())
+    _last_retry_count.set(3)  # a previous resilient call's count
+    trace = tracer.start("infer")
+    trace.finish(error=InferenceServerException("failed pre-transport"))
+    root = tracer.exporter.items[0]
+    assert "retries" not in root.attributes
+    assert tracer.metrics.snapshot()["retry_count"] == 0
+
+
+def test_trace_manager_traceparent_forces_and_correlates():
+    manager = TraceManager(clock_ns=FakeClockNs(), exporter=InMemoryExporter())
+    manager.update({"trace_level": ["TIMESTAMPS"], "trace_rate": "1000"})
+    header = f"00-{'ab' * 16}-{'cd' * 8}-01"
+    # burn the rate sampler's first slot so the next untraced request
+    # would NOT be sampled by rate
+    assert manager.begin("m") is not None
+    assert manager.begin("m") is None
+    trace = manager.begin("m", traceparent=header)
+    assert trace is not None and trace.trace_id == "ab" * 16
+    assert trace.parent_span_id == "cd" * 8
+    # an unsampled context does not force
+    unsampled = f"00-{'ab' * 16}-{'cd' * 8}-00"
+    assert manager.begin("m", traceparent=unsampled) is None
+
+
+def test_trace_manager_record_shape_and_log_frequency(tmp_path):
+    exporter = InMemoryExporter()
+    manager = TraceManager(clock_ns=FakeClockNs(), exporter=exporter)
+    manager.update(
+        {"trace_level": ["TIMESTAMPS"], "trace_rate": "1", "log_frequency": "2"}
+    )
+    for _ in range(3):
+        trace = manager.begin("m", request_id="r1")
+        trace.event("QUEUE_START")
+        trace.event("REQUEST_END")
+        trace.end()
+    # frequency 2: two records flushed, the third still buffered
+    assert len(exporter.items) == 2
+    manager.flush()
+    assert len(exporter.items) == 3
+    record = exporter.items[0]
+    names = [t["name"] for t in record["timestamps"]]
+    assert names == ["REQUEST_START", "QUEUE_START", "REQUEST_END"]
+    assert record["model_name"] == "m" and record["request_id"] == "r1"
+
+
+def test_trace_manager_trace_file(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    manager = TraceManager(clock_ns=FakeClockNs())
+    manager.update({"trace_level": ["TIMESTAMPS"], "trace_rate": "1",
+                    "trace_file": path})
+    trace = manager.begin("m")
+    trace.end()
+    manager.close()
+    records = [json.loads(l) for l in open(path) if l.strip()]
+    assert len(records) == 1 and records[0]["model_name"] == "m"
+
+
+def test_trace_settings_validation_and_overrides():
+    manager = TraceManager(clock_ns=FakeClockNs())
+    with pytest.raises(InferenceServerException, match="unknown trace"):
+        manager.update({"bogus_key": "1"})
+    with pytest.raises(InferenceServerException, match="integer"):
+        manager.update({"trace_rate": "not-a-number"})
+    with pytest.raises(InferenceServerException, match="trace_level"):
+        manager.update({"trace_level": ["LOUD"]})
+    with pytest.raises(InferenceServerException, match=">= 1"):
+        manager.update({"trace_rate": "0"})
+    # per-model overlay + clearing
+    manager.update({"trace_rate": "10"})
+    manager.update({"trace_rate": "2"}, model_name="m")
+    assert manager.settings("m")["trace_rate"] == "2"
+    assert manager.settings()["trace_rate"] == "10"
+    manager.update({"trace_rate": None}, model_name="m")
+    assert manager.settings("m")["trace_rate"] == "10"
+    manager.update({"trace_rate": None})  # global reset to default
+    assert manager.settings()["trace_rate"] == "1000"
+    # gRPC-wire single-element lists normalize to scalars
+    assert manager.update({"trace_rate": ["500"]})["trace_rate"] == "500"
+
+
+def test_log_settings_validation():
+    assert validate_log_settings({"log_verbose_level": 2}) == {
+        "log_verbose_level": 2
+    }
+    with pytest.raises(InferenceServerException, match="unknown log"):
+        validate_log_settings({"verbosity": 1})
+    with pytest.raises(InferenceServerException, match="boolean"):
+        validate_log_settings({"log_info": "yes"})
+    with pytest.raises(InferenceServerException, match="integer"):
+        validate_log_settings({"log_verbose_level": "high"})
+    with pytest.raises(InferenceServerException, match="log_format"):
+        validate_log_settings({"log_format": "csv"})
+
+
+# ---------------------------------------------------------------------------
+# wire-level settings validation + correlated traces over InProcessServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    with InProcessServer(grpc="aio") as s:
+        s.core.trace_manager.exporter = InMemoryExporter()
+        yield s
+
+
+@pytest.fixture()
+def server_trace_exporter(server):
+    exporter = server.core.trace_manager.exporter
+    exporter.clear()
+    # enabled level, rate high enough that only propagated contexts trace
+    server.core.trace_manager.update(
+        {"trace_level": ["TIMESTAMPS"], "trace_rate": "1000000",
+         "trace_count": "-1"}
+    )
+    yield exporter
+    server.core.trace_manager.update({"trace_level": ["OFF"]})
+
+
+def test_http_settings_validation_rejected(server):
+    with httpclient.InferenceServerClient(server.http_url) as client:
+        with pytest.raises(InferenceServerException, match="unknown trace"):
+            client.update_trace_settings(settings={"bogus": "1"})
+        with pytest.raises(InferenceServerException, match="integer"):
+            client.update_trace_settings(settings={"trace_rate": "abc"})
+        with pytest.raises(InferenceServerException, match="unknown log"):
+            client.update_log_settings({"bogus": True})
+        with pytest.raises(InferenceServerException, match="integer"):
+            client.update_log_settings({"log_verbose_level": "high"})
+        # valid updates still apply and echo back
+        settings = client.update_trace_settings(
+            settings={"trace_rate": "250"}
+        )
+        assert settings["trace_rate"] == "250"
+
+
+def test_grpc_settings_validation_rejected(server):
+    with grpcclient.InferenceServerClient(server.grpc_url) as client:
+        with pytest.raises(InferenceServerException, match="unknown trace"):
+            client.update_trace_settings(settings={"bogus": "1"})
+        with pytest.raises(InferenceServerException, match="unknown log"):
+            client.update_log_settings({"bogus": "x"})
+        # per-model settings flow through the RPC's model_name field
+        out = client.update_trace_settings(
+            model_name="simple", settings={"trace_rate": "7"}, as_json=True
+        )
+        assert out["settings"]["trace_rate"]["value"] == ["7"]
+        cleared = client.update_trace_settings(
+            model_name="simple", settings={"trace_rate": None}, as_json=True
+        )
+        assert cleared["settings"]["trace_rate"]["value"] != ["7"]
+
+
+def _assert_correlated(client_exporter, server_exporter, surface):
+    roots = [
+        s for s in client_exporter.items
+        if getattr(s, "parent_id", None) is None
+    ]
+    assert roots, f"{surface}: no client root span"
+    root = roots[-1]
+    child_names = {
+        s.name for s in client_exporter.items if s.trace_id == root.trace_id
+    }
+    if surface.startswith("http"):
+        assert {"serialize", "send", "wait", "deserialize"} <= child_names
+    else:
+        assert {"serialize", "request", "deserialize"} <= child_names
+    records = server_exporter.find(root.trace_id)
+    assert records, f"{surface}: no server record for {root.trace_id}"
+    names = [t["name"] for t in records[-1]["timestamps"]]
+    for expected in (
+        "REQUEST_START",
+        "QUEUE_START",
+        "COMPUTE_START",
+        "COMPUTE_END",
+        "REQUEST_END",
+    ):
+        assert expected in names, f"{surface}: missing {expected} in {names}"
+    stamps = {t["name"]: t["ns"] for t in records[-1]["timestamps"]}
+    assert (
+        stamps["QUEUE_START"]
+        <= stamps["COMPUTE_START"]
+        <= stamps["COMPUTE_END"]
+        <= stamps["REQUEST_END"]
+    )
+
+
+def test_correlated_trace_http_sync(server, server_trace_exporter):
+    exporter = InMemoryExporter()
+    with httpclient.InferenceServerClient(
+        server.http_url, tracer=Tracer(exporter=exporter)
+    ) as client:
+        client.infer("simple", _simple_inputs(httpclient))
+    _assert_correlated(exporter, server_trace_exporter, "http")
+
+
+def test_correlated_trace_http_aio(server, server_trace_exporter):
+    exporter = InMemoryExporter()
+
+    async def run():
+        async with aio_httpclient.InferenceServerClient(
+            server.http_url, tracer=Tracer(exporter=exporter)
+        ) as client:
+            await client.infer("simple", _simple_inputs(aio_httpclient))
+
+    asyncio.run(run())
+    _assert_correlated(exporter, server_trace_exporter, "http.aio")
+
+
+def test_correlated_trace_grpc_sync(server, server_trace_exporter):
+    exporter = InMemoryExporter()
+    with grpcclient.InferenceServerClient(
+        server.grpc_url, tracer=Tracer(exporter=exporter)
+    ) as client:
+        client.infer("simple", _simple_inputs(grpcclient))
+    _assert_correlated(exporter, server_trace_exporter, "grpc")
+
+
+def test_correlated_trace_grpc_aio(server, server_trace_exporter):
+    exporter = InMemoryExporter()
+
+    async def run():
+        async with aio_grpcclient.InferenceServerClient(
+            server.grpc_url, tracer=Tracer(exporter=exporter)
+        ) as client:
+            await client.infer("simple", _simple_inputs(aio_grpcclient))
+
+    asyncio.run(run())
+    _assert_correlated(exporter, server_trace_exporter, "grpc.aio")
+
+
+def test_server_rate_sampling_over_the_wire(server):
+    exporter = server.core.trace_manager.exporter
+    exporter.clear()
+    server.core.trace_manager.update(
+        {"trace_level": ["TIMESTAMPS"], "trace_rate": "2"}
+    )
+    # fresh per-model counter: use a model name the other tests don't
+    try:
+        with httpclient.InferenceServerClient(server.http_url) as client:
+            for _ in range(4):
+                client.infer("identity_fp32", [_identity_input()])
+    finally:
+        server.core.trace_manager.update({"trace_level": ["OFF"]})
+    records = [
+        r for r in exporter.items if r.get("model_name") == "identity_fp32"
+    ]
+    assert len(records) == 2  # every 2nd of 4 untagged requests
+
+
+def _identity_input():
+    x = httpclient.InferInput("INPUT0", [1, 4], "FP32")
+    x.set_data_from_numpy(np.zeros([1, 4], dtype=np.float32))
+    return x
+
+
+# ---------------------------------------------------------------------------
+# retry-annotated spans under chaos
+
+
+@pytest.mark.chaos
+def test_retry_annotated_spans_under_chaos():
+    chaos = ChaosPolicy(error_rate=0.5, seed=1)
+    policy = RetryPolicy(
+        max_attempts=6, initial_backoff_s=0.0, max_backoff_s=0.0
+    )
+    exporter = InMemoryExporter()
+    with InProcessServer(grpc=False, chaos=chaos) as server:
+        with httpclient.InferenceServerClient(
+            server.http_url,
+            retry_policy=policy,
+            tracer=Tracer(exporter=exporter),
+        ) as client:
+            for _ in range(4):
+                client.infer("simple", _simple_inputs(httpclient))
+    assert chaos.injected["error"] >= 1
+    roots = [s for s in exporter.items if s.parent_id is None]
+    retried = [r for r in roots if r.attributes.get("retries")]
+    assert retried, "seeded chaos should force at least one retried call"
+    root = retried[0]
+    events = root.attributes["resilience"]
+    assert any(
+        e["event"] == "retry" and e["error"] == "503" for e in events
+    )
+    # one send span per attempt, attempt indices annotated
+    sends = [
+        s for s in exporter.items
+        if s.trace_id == root.trace_id and s.name == "send"
+    ]
+    assert len(sends) >= 2
+    assert sends[0].attributes["attempt"] == 0
+    assert sends[1].attributes["attempt"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Prometheus /metrics
+
+
+def test_prometheus_escape():
+    assert prometheus_escape('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+
+
+def test_metrics_endpoint_duty_cycle_and_reset(server):
+    def scrape():
+        with urllib.request.urlopen(
+            f"http://{server.http_url}/metrics", timeout=10
+        ) as resp:
+            return resp.read().decode()
+
+    with httpclient.InferenceServerClient(server.http_url) as client:
+        client.infer("simple", _simple_inputs(httpclient))
+    text = scrape()
+    assert 'tpu_inference_count{model="simple"}' in text
+    duty = [
+        l for l in text.splitlines() if l.startswith("tpu_duty_cycle ")
+    ][0]
+    assert 0.0 <= float(duty.split()[1]) <= 1.0
+    # statistics reset: the cumulative compute counter goes backwards;
+    # the duty gauge must clamp to 0, never go negative
+    server.core.stats.clear()
+    text = scrape()
+    duty = [
+        l for l in text.splitlines() if l.startswith("tpu_duty_cycle ")
+    ][0]
+    assert float(duty.split()[1]) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# perf harness stage breakdown
+
+
+def test_perf_stage_breakdown(server):
+    from client_tpu.perf.backend import HttpPerfBackend
+    from client_tpu.perf.data import DataLoader
+    from client_tpu.perf.load_manager import ConcurrencyManager
+    from client_tpu.perf.records import compute_window_status
+    from client_tpu.perf.report import detailed_report
+    from client_tpu.perf.profiler import ProfileExperiment
+
+    async def run():
+        backend = HttpPerfBackend(server.http_url, tracer=Tracer())
+        try:
+            metadata = await backend.get_model_metadata("simple")
+            loader = DataLoader(metadata, batched=True)
+            loader.generate_synthetic()
+            manager = ConcurrencyManager(backend, "simple", loader)
+            for _ in range(5):
+                await manager.issue_one()
+            return manager.swap_records()
+        finally:
+            await backend.close()
+
+    records = asyncio.run(run())
+    assert all(r.success for r in records), [r.error for r in records]
+    assert all(r.stages for r in records)
+    assert all(r.stages["transport"] > 0 for r in records)
+    start = min(r.start_ns for r in records)
+    end = max(r.end_ns for r in records)
+    status = compute_window_status(records, start, end)
+    assert status.traced_count == len(records)
+    assert status.client_transport_us > 0
+    report = detailed_report(
+        ProfileExperiment(
+            mode="concurrency", value=1, status=status, records=records
+        )
+    )
+    assert "Stage breakdown" in report

@@ -1,0 +1,119 @@
+"""Clock-injection lint for the time-sensitive packages.
+
+The observability and resilience layers are tested with fake clocks (no
+sleeps, milliseconds of wall time); that only works while every clock
+read goes through an injectable ``clock``/``clock_ns`` callable. This
+lint bans *direct calls* to the ``time`` module's clock functions inside
+``client_tpu/observability/`` and ``client_tpu/resilience/``.
+
+References are fine — ``clock: Callable = time.monotonic`` as a default
+parameter is exactly the injectable pattern — only Call nodes are
+flagged. Runs standalone (``python tools/clock_lint.py``) and at test
+session start via ``tests/conftest.py``, so a regression fails the suite
+immediately instead of surfacing as a flaky sleep-based test later.
+"""
+
+import ast
+import os
+from typing import List, Tuple
+
+TARGET_DIRS = (
+    os.path.join("client_tpu", "observability"),
+    os.path.join("client_tpu", "resilience"),
+)
+
+# time-module clock functions whose direct call defeats injection
+BANNED_CLOCKS = frozenset(
+    {
+        "time",
+        "monotonic",
+        "monotonic_ns",
+        "perf_counter",
+        "perf_counter_ns",
+        "process_time",
+        "process_time_ns",
+    }
+)
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def check_source(source: str, filename: str) -> List[Tuple[int, str]]:
+    """Findings for one module: (lineno, message) per banned clock call."""
+    tree = ast.parse(source, filename=filename)
+    # names the module binds to the time module / its clock functions
+    time_aliases = set()
+    clock_names = {}  # local name -> original time.<fn> name
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "time":
+                    time_aliases.add(alias.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name in BANNED_CLOCKS:
+                    clock_names[alias.asname or alias.name] = alias.name
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id in time_aliases
+            and func.attr in BANNED_CLOCKS
+        ):
+            findings.append(
+                (
+                    node.lineno,
+                    f"direct {func.value.id}.{func.attr}() call — inject a "
+                    "clock instead",
+                )
+            )
+        elif isinstance(func, ast.Name) and func.id in clock_names:
+            findings.append(
+                (
+                    node.lineno,
+                    f"direct {clock_names[func.id]}() call (imported from "
+                    "time) — inject a clock instead",
+                )
+            )
+    return findings
+
+
+def run_clock_lint(repo_root: str = None) -> List[str]:
+    """Lint the target packages; returns 'path:line: message' strings."""
+    root = repo_root or _repo_root()
+    problems = []
+    for target in TARGET_DIRS:
+        base = os.path.join(root, target)
+        for dirpath, _dirs, files in os.walk(base):
+            if "__pycache__" in dirpath:
+                continue
+            for name in sorted(files):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+                for lineno, message in check_source(source, path):
+                    rel = os.path.relpath(path, root)
+                    problems.append(f"{rel}:{lineno}: {message}")
+    return problems
+
+
+def main() -> int:
+    problems = run_clock_lint()
+    for problem in problems:
+        print(problem)
+    if problems:
+        print(f"clock lint: {len(problems)} finding(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
