@@ -11,5 +11,10 @@ drives thousands of in-flight requests per host (the client-side
 available where nanosecond scheduling fidelity matters.
 """
 
-from client_tpu.perf.records import PerfStatus, RequestRecord  # noqa: F401
+from client_tpu.perf.metrics_collector import MetricsCollector  # noqa: F401
+from client_tpu.perf.records import (  # noqa: F401
+    PerfStatus,
+    RequestRecord,
+    ServerMetricsSummary,
+)
 from client_tpu.perf.profiler import InferenceProfiler  # noqa: F401

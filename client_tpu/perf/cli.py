@@ -200,6 +200,33 @@ def build_parser() -> argparse.ArgumentParser:
         help="write the client-side spans as JSONL to this file "
         "(implies --stage-breakdown)",
     )
+    parser.add_argument(
+        "--collect-metrics",
+        action="store_true",
+        help="scrape the server's Prometheus /metrics during the run and "
+        "report a 'Server metrics' section (TPU duty cycle, memory, "
+        "queue/compute, batch sizes)",
+    )
+    def _positive_interval(value: str) -> float:
+        interval = float(value)
+        if interval <= 0:
+            raise argparse.ArgumentTypeError(
+                f"--metrics-interval must be > 0 seconds, got {interval}"
+            )
+        return interval
+
+    parser.add_argument(
+        "--metrics-interval",
+        type=_positive_interval,
+        default=1.0,
+        help="seconds between /metrics scrapes (with --collect-metrics)",
+    )
+    parser.add_argument(
+        "--metrics-url",
+        default=None,
+        help="metrics endpoint (host:port[/metrics]); default: the -u "
+        "host/port for HTTP runs, port 8000 on the -u host otherwise",
+    )
     from client_tpu.perf.distributed import topology_from_env
 
     env_world_size, env_rank, env_coordinator = topology_from_env()
@@ -270,6 +297,8 @@ async def run(args) -> int:
         console_report,
         detailed_report,
         export_profile,
+        format_client_metrics,
+        format_server_metrics,
         write_csv,
     )
     from client_tpu.perf.sequence import SequenceManager
@@ -283,6 +312,8 @@ async def run(args) -> int:
         )
         return 2
     trace_exporter = None
+    tracer = None
+    collector = None
     if args.service_kind == "openai":
         backend = create_backend("openai", args.url, endpoint=args.endpoint)
     elif args.service_kind in ("tfserving", "torchserve"):
@@ -308,7 +339,8 @@ async def run(args) -> int:
 
             if args.trace_export_file:
                 trace_exporter = JsonlExporter(args.trace_export_file)
-            backend_kwargs["tracer"] = Tracer(exporter=trace_exporter)
+            tracer = Tracer(exporter=trace_exporter)
+            backend_kwargs["tracer"] = tracer
         backend = create_backend(args.protocol, args.url, **backend_kwargs)
     if args.streaming and not backend.supports_streaming:
         if args.service_kind in ("tfserving", "torchserve"):
@@ -328,6 +360,28 @@ async def run(args) -> int:
         return 1
     shm_plane = None
     try:
+        if args.collect_metrics:
+            # Scrape the server's Prometheus endpoint alongside the run
+            # (reference --collect-metrics / MetricsManager). The metrics
+            # live on the HTTP front-end; for gRPC runs default to the
+            # conventional HTTP port on the same host.
+            from client_tpu.perf.metrics_collector import MetricsCollector
+
+            metrics_url = args.metrics_url
+            if not metrics_url:
+                if args.protocol == "http" and args.service_kind == "kserve":
+                    metrics_url = args.url
+                else:
+                    host = args.url.rsplit(":", 1)[0] or "localhost"
+                    metrics_url = f"{host}:8000"
+            collector = MetricsCollector(
+                metrics_url,
+                interval_s=args.metrics_interval,
+                model_name=args.model_name,
+            )
+            await collector.start()
+            if args.verbose:
+                print(f"collecting server metrics from {collector.url}")
         metadata = await backend.get_model_metadata(
             args.model_name, args.model_version
         )
@@ -448,6 +502,7 @@ async def run(args) -> int:
                 percentiles=percentiles,
                 stability_percentile=args.percentile,
                 warmup_requests=args.warmup_request_count,
+                metrics_collector=collector,
                 verbose=args.verbose,
             )
 
@@ -534,6 +589,20 @@ async def run(args) -> int:
         print()
         print(console_report(experiments))
 
+        server_summary = None
+        if collector is not None:
+            await collector.stop()
+            server_summary = collector.summary()
+            print()
+            print(format_server_metrics(server_summary))
+            if collector.scrape_errors and collector.last_error:
+                print(f"  last scrape error: {collector.last_error}")
+        if tracer is not None:
+            # the ClientMetrics snapshot every traced call feeds: error/
+            # retry counts + the client-side latency histogram
+            print()
+            print(format_client_metrics(tracer.metrics.snapshot()))
+
         if args.filename:
             write_csv(experiments, args.filename)
         if args.profile_export_file:
@@ -550,19 +619,20 @@ async def run(args) -> int:
                 and profiler.binary_search_answer()
             ):
                 best = profiler.binary_search_answer()
-            print(
-                json.dumps(
-                    {
-                        "throughput": best.status.throughput,
-                        "p50_us": best.status.latency_percentiles_us.get(50, 0),
-                        "p99_us": best.status.latency_percentiles_us.get(99, 0),
-                        "count": best.status.request_count,
-                        "errors": best.status.error_count,
-                        "mode": best.mode,
-                        "value": best.value,
-                    }
-                )
-            )
+            summary_doc = {
+                "throughput": best.status.throughput,
+                "p50_us": best.status.latency_percentiles_us.get(50, 0),
+                "p99_us": best.status.latency_percentiles_us.get(99, 0),
+                "count": best.status.request_count,
+                "errors": best.status.error_count,
+                "mode": best.mode,
+                "value": best.value,
+            }
+            if server_summary is not None:
+                summary_doc["server_duty_avg"] = server_summary.duty_avg
+                summary_doc["server_duty_max"] = server_summary.duty_max
+                summary_doc["server_batch_avg"] = server_summary.batch_avg
+            print(json.dumps(summary_doc))
         return 0
     except InferenceServerException as e:
         # Setup/transport failures (unreachable endpoint, bad metadata,
@@ -572,6 +642,8 @@ async def run(args) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 1
     finally:
+        if collector is not None:
+            await collector.stop()  # no-op when already stopped above
         if shm_plane is not None:
             await shm_plane.cleanup()
         await backend.close()

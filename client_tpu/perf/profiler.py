@@ -49,6 +49,7 @@ class InferenceProfiler:
         stability_percentile: Optional[int] = None,
         warmup_s: float = 0.0,
         warmup_requests: int = 0,
+        metrics_collector=None,
         verbose: bool = False,
     ):
         self.manager = manager
@@ -68,6 +69,10 @@ class InferenceProfiler:
         self.stability_percentile = stability_percentile
         self.warmup_s = warmup_s
         self.warmup_requests = warmup_requests
+        # a running MetricsCollector (--collect-metrics): windows bracket
+        # themselves with an extra scrape so window-boundary deltas exist
+        # even when the scrape interval is longer than the window
+        self.metrics_collector = metrics_collector
         self.verbose = verbose
         self.experiments: List[ProfileExperiment] = []
         self._binary_answer: Optional[ProfileExperiment] = None
@@ -114,6 +119,8 @@ class InferenceProfiler:
     async def measure_window(self) -> PerfStatus:
         """One measurement window over the live manager."""
         before = await self._server_stats(self.manager.model_name)
+        if self.metrics_collector is not None:
+            await self.metrics_collector.scrape_now()
         self.manager.swap_records()  # discard partial records
         start_ns = time.monotonic_ns()
         if self.count_windows:
@@ -129,6 +136,8 @@ class InferenceProfiler:
         end_ns = time.monotonic_ns()
         records = self.manager.swap_records()
         after = await self._server_stats(self.manager.model_name)
+        if self.metrics_collector is not None:
+            await self.metrics_collector.scrape_now()
         status = compute_window_status(
             records, start_ns, end_ns, self.percentiles
         )

@@ -3,12 +3,14 @@
 RequestRecord mirrors the reference's request_record.h (6-point timestamps
 reduced to the ones a network client can observe: send start, response(s),
 completion); PerfStatus mirrors the client-side slice of
-inference_profiler.h's PerfStatus.
+inference_profiler.h's PerfStatus; ServerMetricsSummary mirrors the
+scraped-metrics slice its Metrics member carries (reference metrics.h:37-42
+gpu_utilization / memory maps, TPU names here).
 """
 
 import dataclasses
 import math
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 
 @dataclasses.dataclass
@@ -89,6 +91,40 @@ class PerfStatus:
         """The latency metric used for stability checks (p99 if computed,
         else avg) — reference DetermineStability semantics."""
         return self.latency_percentiles_us.get(99, self.avg_latency_us)
+
+
+@dataclasses.dataclass
+class ServerMetricsSummary:
+    """Reduction of a run's scraped server metrics (--collect-metrics).
+
+    Counter/histogram fields are first-scrape -> last-scrape deltas, so
+    they cover exactly this run; gauges (duty, memory) are series
+    statistics over the scrape interval.
+    """
+
+    scrape_count: int = 0
+    scrape_errors: int = 0
+    window_s: float = 0.0
+    # TPU duty cycle over the scrape intervals (fractions in [0, 1])
+    duty_avg: float = 0.0
+    duty_max: float = 0.0
+    # peak sum of tpu_memory_used_bytes across devices (0 = not exported)
+    memory_peak_bytes: float = 0.0
+    # per-request averages from the server-side histograms (microseconds)
+    request_count: int = 0
+    avg_request_us: float = 0.0
+    avg_queue_us: float = 0.0
+    avg_compute_us: float = 0.0
+    # total queued seconds / total compute seconds over the run
+    queue_compute_ratio: float = 0.0
+    # device-execution batch sizes (dynamic batcher merge quality)
+    batch_avg: float = 0.0
+    # non-cumulative per-bucket observation counts [(le, count)]
+    batch_buckets: List[Tuple[float, float]] = dataclasses.field(
+        default_factory=list
+    )
+    success_count: int = 0
+    failure_count: int = 0
 
 
 def compute_window_status(

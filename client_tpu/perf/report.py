@@ -8,9 +8,10 @@ timestamps) that genai-perf consumes
 """
 
 import json
-from typing import Optional, Sequence
+from typing import Any, Dict, Optional, Sequence
 
 from client_tpu.perf.profiler import ProfileExperiment
+from client_tpu.perf.records import ServerMetricsSummary
 
 
 def console_report(
@@ -99,6 +100,93 @@ def detailed_report(experiment: ProfileExperiment) -> str:
         lines.append(f"  Errors: {s.error_count}")
     if s.retry_count:
         lines.append(f"  Retries: {s.retry_count}")
+    return "\n".join(lines)
+
+
+def _format_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} GiB"  # pragma: no cover - loop always returns
+
+
+def format_server_metrics(summary: ServerMetricsSummary) -> str:
+    """The "Server metrics" block printed when --collect-metrics scraped
+    the server during the run (reference MetricsManager report role)."""
+    lines = [
+        f"Server metrics ({summary.scrape_count} scrapes over "
+        f"{summary.window_s:.1f} s"
+        + (
+            f", {summary.scrape_errors} failed"
+            if summary.scrape_errors
+            else ""
+        )
+        + "):"
+    ]
+    lines.append(
+        f"  TPU duty cycle: avg {summary.duty_avg * 100:.1f}%, "
+        f"max {summary.duty_max * 100:.1f}%"
+    )
+    if summary.memory_peak_bytes:
+        lines.append(
+            f"  TPU memory: peak {_format_bytes(summary.memory_peak_bytes)} "
+            "used"
+        )
+    if summary.request_count:
+        lines.append(
+            f"  Requests: {summary.success_count} ok, "
+            f"{summary.failure_count} failed, avg "
+            f"{summary.avg_request_us:.0f} usec in server"
+        )
+        lines.append(
+            f"  Queue/compute: avg queue {summary.avg_queue_us:.0f} usec, "
+            f"avg compute {summary.avg_compute_us:.0f} usec "
+            f"(ratio {summary.queue_compute_ratio:.2f})"
+        )
+    if summary.batch_avg:
+        dist = ", ".join(
+            f"<={int(le) if float(le).is_integer() else le}: {int(count)}"
+            for le, count in summary.batch_buckets
+            if count > 0
+        )
+        lines.append(
+            f"  Batch size: avg {summary.batch_avg:.1f} rows/execution"
+            + (f" [{dist}]" if dist else "")
+        )
+    if summary.scrape_count == 0 or (
+        not summary.request_count and not summary.duty_max
+    ):
+        lines.append(
+            "  (no server activity captured; is the metrics endpoint the "
+            "right server?)"
+        )
+    return "\n".join(lines)
+
+
+def format_client_metrics(snapshot: Dict[str, Any]) -> str:
+    """The "Client metrics" block: the tracer's ClientMetrics snapshot —
+    error/retry counts and the client-side latency histogram the
+    observability layer records on every traced call."""
+    lines = [
+        "Client metrics:",
+        f"  Requests: {snapshot['request_count']} "
+        f"(errors {snapshot['error_count']}, retries "
+        f"{snapshot['retry_count']}), avg latency "
+        f"{snapshot['avg_latency_us']:.0f} usec",
+    ]
+    # de-cumulate the histogram and print the populated buckets
+    parts = []
+    prev = 0
+    for entry in snapshot.get("latency_histogram_us", []):
+        count = entry["count"] - prev
+        prev = entry["count"]
+        if count > 0:
+            bound = entry["le_us"]
+            label = f"<={bound}us" if bound != "inf" else ">last"
+            parts.append(f"{label}: {count}")
+    if parts:
+        lines.append(f"  Latency histogram: {', '.join(parts)}")
     return "\n".join(lines)
 
 

@@ -107,11 +107,19 @@ class CoreResponse:
 
 
 class _Stats:
-    """Cumulative per-model statistics (counts + ns)."""
+    """Cumulative per-model statistics (counts + ns).
+
+    ``metrics`` (a :class:`client_tpu.server.metrics.ServerMetrics`) gets
+    the same events as the counters — every booking path feeds both, so
+    the statistics extension and the Prometheus families can never
+    disagree. Metrics calls happen outside ``self.lock``.
+    """
 
     FIELDS = ("success", "fail", "queue", "compute_input", "compute_infer", "compute_output")
 
-    def __init__(self):
+    def __init__(self, metrics=None, model_name: str = ""):
+        self._metrics = metrics
+        self._model = model_name
         self.lock = threading.Lock()
         self.counts = {f: 0 for f in self.FIELDS}
         self.ns = {f: 0 for f in self.FIELDS}
@@ -127,6 +135,8 @@ class _Stats:
         with self.lock:
             self.counts[field_name] += 1
             self.ns[field_name] += duration_ns
+        if field_name == "fail" and self._metrics is not None:
+            self._metrics.observe_failure(self._model)
 
     def record_success(
         self, batch: int, queue_ns, in_ns, infer_ns, out_ns, executions: int = 1
@@ -150,6 +160,10 @@ class _Stats:
             ):
                 self.counts[f] += 1
                 self.ns[f] += ns
+        if self._metrics is not None:
+            self._metrics.observe_success(
+                self._model, queue_ns, in_ns + infer_ns + out_ns, total
+            )
 
     def record_success_batch(
         self,
@@ -178,6 +192,15 @@ class _Stats:
             ):
                 self.counts[f] += n_requests
                 self.ns[f] += ns
+        if self._metrics is not None and n_requests:
+            # per-request averages of the chunk totals, booked n at once
+            self._metrics.observe_success(
+                self._model,
+                queue_ns_total // n_requests,
+                (infer_ns_total + out_ns_total) // n_requests,
+                total // n_requests,
+                count=n_requests,
+            )
 
     def record_execution(self) -> None:
         """Count a device execution whose every request failed packaging."""
@@ -506,6 +529,10 @@ class _ModelBatcher:
 
             raw = await loop.run_in_executor(core._executor, _run)
             infer_end = time.monotonic_ns()
+            core.add_busy_ns(model, infer_end - exec_start)
+            core.metrics.observe_execution(
+                model.name, sum(e[3] for e in entries)
+            )
         except Exception as e:  # noqa: BLE001 - fail every request in batch
             now = time.monotonic_ns()
             for _req, future, _sig, _rows, arrival in entries:
@@ -572,6 +599,15 @@ class ServerCore:
         from client_tpu.observability.server import TraceManager
 
         self.trace_manager = TraceManager()
+        # Cumulative device-busy nanoseconds (device-placed executions
+        # only) — the monotone counter scrapers derive duty cycle from.
+        # Owned here, not by an HTTP handler, so every front-end and any
+        # number of concurrent scrapers see one consistent time base.
+        self._busy_lock = threading.Lock()
+        self._device_busy_ns = 0
+        from client_tpu.server.metrics import ServerMetrics
+
+        self.metrics = ServerMetrics(self)
         self.log_settings: Dict[str, Any] = {
             "log_file": "",
             "log_info": True,
@@ -594,8 +630,26 @@ class ServerCore:
     def _stats_for(self, model_name: str) -> _Stats:
         with self._stats_lock:
             if model_name not in self.stats:
-                self.stats[model_name] = _Stats()
+                self.stats[model_name] = _Stats(
+                    metrics=self.metrics, model_name=model_name
+                )
             return self.stats[model_name]
+
+    # -- device busy accounting (duty cycle) --------------------------------
+
+    def add_busy_ns(self, model: Model, duration_ns: int) -> None:
+        """Credit one device execution's nanoseconds to the busy counter.
+        Host-placed models (device == "cpu") never count — they execute on
+        the host and must not report the TPU as busy."""
+        if getattr(model, "device", "") == "cpu":
+            return
+        with self._busy_lock:
+            self._device_busy_ns += duration_ns
+
+    @property
+    def device_busy_ns_total(self) -> int:
+        with self._busy_lock:
+            return self._device_busy_ns
 
     def _batch_meta(self, model: Model) -> _BatchMeta:
         """Per-model batching caches, shared by both batching paths.
@@ -788,18 +842,30 @@ class ServerCore:
                 f"model '{model.name}' is decoupled; use streaming inference"
             )
         if model.max_batch_size > 1 and self._has_batch_dim(model, request):
-            batcher = self._batchers.get(model.name)
-            if batcher is None or batcher.model is not model:
-                batcher = _ModelBatcher(self, model)
-                self._batchers[model.name] = batcher
-            try:
-                return batcher.submit(request)
-            except InferenceServerException:
-                # Validation failures surface synchronously; execution
-                # failures are accounted inside the batcher already.
-                self._stats_for(model.name).record("fail", 0)
-                raise
-        return asyncio.ensure_future(self._infer_single(model, request))
+            future = self._submit_batched(model, request)
+        else:
+            future = asyncio.ensure_future(self._infer_single(model, request))
+        self.metrics.pending_inc(model.name)
+        future.add_done_callback(
+            lambda _f, name=model.name: self.metrics.pending_dec(name)
+        )
+        return future
+
+    def _submit_batched(
+        self, model: Model, request: CoreRequest
+    ) -> "asyncio.Future[CoreResponse]":
+        """Route a batchable request to its model's dynamic batcher."""
+        batcher = self._batchers.get(model.name)
+        if batcher is None or batcher.model is not model:
+            batcher = _ModelBatcher(self, model)
+            self._batchers[model.name] = batcher
+        try:
+            return batcher.submit(request)
+        except InferenceServerException:
+            # Validation failures surface synchronously; execution
+            # failures are accounted inside the batcher already.
+            self._stats_for(model.name).record("fail", 0)
+            raise
 
     def infer_direct(self, requests: List[CoreRequest]) -> List[Any]:
         """Synchronously execute a batch of unary requests on the CALLING
@@ -827,6 +893,7 @@ class ServerCore:
         model_cache: Dict[Any, Model] = {}
         for idx, request in enumerate(requests):
             model = None
+            grouped = False
             try:
                 model_key = (request.model_name, request.model_version)
                 model = model_cache.get(model_key)
@@ -835,6 +902,7 @@ class ServerCore:
                         request.model_name, request.model_version
                     )
                     model_cache[model_key] = model
+                self.metrics.pending_inc(model.name)
                 if model.decoupled:
                     raise InferenceServerException(
                         f"model '{model.name}' is decoupled; use streaming "
@@ -851,6 +919,9 @@ class ServerCore:
                         groups[key] = (model, meta, [(idx, rows)])
                     else:
                         group[2].append((idx, rows))
+                    # grouped requests stay pending until their chunk
+                    # executes (_execute_direct_chunk decrements)
+                    grouped = True
                 else:
                     results[idx] = self._infer_single_sync(model, request)
             except Exception as e:  # noqa: BLE001 - aligned error result
@@ -862,6 +933,9 @@ class ServerCore:
                         "fail", time.monotonic_ns() - arrival_ns
                     )
                 results[idx] = e
+            finally:
+                if model is not None and not grouped:
+                    self.metrics.pending_dec(model.name)
         for model, meta, entries in groups.values():
             budget = model.max_batch_size
             chunk: List[Any] = []
@@ -899,11 +973,16 @@ class ServerCore:
             with model.placement():
                 raw = _to_host(model.execute(merged, reqs[0].parameters))
             infer_end = time.monotonic_ns()
+            self.add_busy_ns(model, infer_end - exec_start)
+            self.metrics.observe_execution(
+                model.name, sum(rows for _idx, rows in chunk)
+            )
         except Exception as e:  # noqa: BLE001 - fail every request in chunk
             now = time.monotonic_ns()
             for idx, _rows in chunk:
                 stats.record("fail", now - arrival_ns)
                 results[idx] = e
+            self.metrics.pending_dec(model.name, len(chunk))
             return
         offset = 0
         ok_requests = 0
@@ -931,6 +1010,7 @@ class ServerCore:
                 results[idx] = e
             offset += rows
         out_end = time.monotonic_ns()
+        self.metrics.pending_dec(model.name, len(chunk))
         if ok_requests:
             # One lock + one booking for the whole chunk; packaging time
             # is split evenly across its requests. The ONE device
@@ -956,10 +1036,13 @@ class ServerCore:
         t0 = time.monotonic_ns()
         raw = self._run_model(model, request)
         t1 = time.monotonic_ns()
+        self.add_busy_ns(model, t1 - t0)
         response = self._package_outputs(model, request, raw)
         t2 = time.monotonic_ns()
+        rows = self._resolve_batch(model, request)
+        self.metrics.observe_execution(model.name, rows)
         stats.record_success(
-            self._resolve_batch(model, request),
+            rows,
             queue_ns=0,
             in_ns=0,
             infer_ns=t1 - t0,
@@ -975,10 +1058,14 @@ class ServerCore:
             raise InferenceServerException(
                 f"model '{model.name}' is decoupled; use streaming inference"
             )
-        if model.max_batch_size > 1 and self._has_batch_dim(model, request):
-            return await self.infer_nowait(request)
-        # Awaited single path: run the coroutine inline — no Task.
-        return await self._infer_single(model, request)
+        self.metrics.pending_inc(model.name)
+        try:
+            if model.max_batch_size > 1 and self._has_batch_dim(model, request):
+                return await self._submit_batched(model, request)
+            # Awaited single path: run the coroutine inline — no Task.
+            return await self._infer_single(model, request)
+        finally:
+            self.metrics.pending_dec(model.name)
 
     async def _infer_single(
         self, model: Model, request: CoreRequest
@@ -998,8 +1085,11 @@ class ServerCore:
         except Exception:
             stats.record("fail", time.monotonic_ns() - t0)
             raise
+        self.add_busy_ns(model, t2 - t1)
+        rows = self._resolve_batch(model, request)
+        self.metrics.observe_execution(model.name, rows)
         stats.record_success(
-            self._resolve_batch(model, request),
+            rows,
             queue_ns=t1 - t0,
             in_ns=0,
             infer_ns=t2 - t1,
@@ -1024,12 +1114,19 @@ class ServerCore:
         # treat a stream as one opaque request (its own known blind spot,
         # grpc_client.cc:1650-1653); don't inherit that.
         packaging_ns = 0
+        # Device-busy attribution for the stream: only time spent awaiting
+        # the model's next item counts (model_wait_ns). The stream's wall
+        # time also contains suspension at `yield` while the front-end
+        # writes to the consumer — booking that would read a slow client
+        # as a busy TPU (duty cycle ~1.0 on an idle device).
+        model_wait_ns = 0
         prev_ns = t0
         index = 0
         final_delivered = False
 
         def _book_success() -> None:
             t1 = time.monotonic_ns()
+            self.add_busy_ns(model, model_wait_ns)
             stats.record_success(
                 self._resolve_batch(model, request),
                 queue_ns=0,
@@ -1039,14 +1136,20 @@ class ServerCore:
             )
             _trace_stages(request.trace, t0, t0, t1, t1)
 
+        if model.decoupled:
+            # non-decoupled requests delegate to infer(), which tracks its
+            # own pending gauge — tracking both would double-count
+            self.metrics.pending_inc(model.name)
         try:
             if not model.decoupled:
                 yield await self.infer(request)
                 return
             inputs = {t.name: t.data for t in request.inputs}
+            resume_ns = time.monotonic_ns()
             async for raw in model.execute_decoupled(inputs, request.parameters):
                 final = raw.pop("__final__", False) if isinstance(raw, dict) else False
                 p0 = time.monotonic_ns()
+                model_wait_ns += p0 - resume_ns
                 if raw:
                     response = self._package_outputs(model, request, raw)
                 else:
@@ -1077,6 +1180,8 @@ class ServerCore:
                 # routinely stop iterating at triton_final_response).
                 final_delivered = final
                 yield response
+                # back from the consumer; the next await is model time
+                resume_ns = time.monotonic_ns()
         except (asyncio.CancelledError, GeneratorExit):
             # Task cancellation (gRPC stream teardown) and generator close
             # (HTTP/OpenAI front-end client disconnect): if the final
@@ -1104,6 +1209,9 @@ class ServerCore:
             raise
         else:
             _book_success()
+        finally:
+            if model.decoupled:
+                self.metrics.pending_dec(model.name)
 
     # -- wire-side input decoding -------------------------------------------
 

@@ -206,7 +206,15 @@ class _Servicer(GRPCInferenceServiceServicer):
         await self._chaos_gate(context, "ModelInfer")
         trace = self._begin_trace(context, request)
         try:
-            core_request = build_core_request(self.core, request)
+            try:
+                core_request = build_core_request(self.core, request)
+            except InferenceServerException:
+                # rejected before reaching the engine: the statistics
+                # extension never sees it, the front-end counter does
+                # (same family the HTTP front-end books, protocol label
+                # apart — the shared registry keeps both faces consistent)
+                self.core.metrics.observe_frontend_error("grpc")
+                raise
             core_request.trace = trace
             core_response = await self.core.infer(core_request)
         except InferenceServerException as e:
@@ -228,7 +236,11 @@ class _Servicer(GRPCInferenceServiceServicer):
             await self._chaos_gate(context, "ModelStreamInfer")
             trace = self._begin_trace(context, request)
             try:
-                core_request = build_core_request(self.core, request)
+                try:
+                    core_request = build_core_request(self.core, request)
+                except InferenceServerException:
+                    self.core.metrics.observe_frontend_error("grpc")
+                    raise
                 core_request.trace = trace
                 async for core_response in self.core.infer_decoupled(
                     core_request

@@ -10,7 +10,6 @@ import asyncio
 import base64
 import gzip
 import json
-import time
 import zlib
 from typing import Any, Dict, List, Optional
 
@@ -18,6 +17,12 @@ import numpy as np
 from aiohttp import web
 
 from client_tpu.observability import TRACEPARENT_HEADER, validate_log_settings
+
+# Back-compat alias: /metrics label escaping lived here before the
+# registry (client_tpu.observability.metrics) owned the exposition format.
+from client_tpu.observability.metrics import (
+    escape_label_value as prometheus_escape,  # noqa: F401
+)
 from client_tpu.server.core import (
     SERVER_EXTENSIONS,
     SERVER_NAME,
@@ -31,25 +36,11 @@ from client_tpu.utils import (
     serialize_byte_tensor,
 )
 
-try:  # jax powers the optional device-memory gauges in /metrics
-    import jax
-except Exception:  # pragma: no cover - jax is an optional extra
-    jax = None
-
 HEADER_CONTENT_LENGTH = "Inference-Header-Content-Length"
 
 
 def _error_response(msg: str, status: int = 400) -> web.Response:
     return web.json_response({"error": msg}, status=status)
-
-
-def prometheus_escape(label: str) -> str:
-    """Prometheus exposition-format label-value escaping."""
-    return (
-        label.replace("\\", "\\\\")
-        .replace('"', '\\"')
-        .replace("\n", "\\n")
-    )
 
 
 def _chaos_middleware(chaos):
@@ -261,95 +252,15 @@ class HttpServer:
         )
 
     async def handle_metrics(self, request):
-        """Prometheus text metrics: per-model inference counters plus TPU
-        device memory gauges (the TPU replacement for the reference's
-        nv_gpu_* metrics scraped by perf_analyzer's MetricsManager,
-        reference metrics_manager.h:45-92, metrics.h:37-42)."""
-        esc = prometheus_escape
-        lines = [
-            "# HELP tpu_inference_count Successful inference requests.",
-            "# TYPE tpu_inference_count counter",
-        ]
-        for ms in self.core.statistics()["model_stats"]:
-            model = esc(ms["name"])
-            stats = ms["inference_stats"]
-            lines.append(
-                f'tpu_inference_count{{model="{model}"}} '
-                f'{stats["success"]["count"]}'
-            )
-            lines.append(
-                f'tpu_inference_duration_ns{{model="{model}"}} '
-                f'{stats["success"]["ns"]}'
-            )
-            lines.append(
-                f'tpu_inference_fail_count{{model="{model}"}} '
-                f'{stats["fail"]["count"]}'
-            )
-        # Device duty cycle: fraction of wall time the server spent inside
-        # model executions since the previous scrape — the TPU swap-in for
-        # the reference's nv_gpu_utilization (SURVEY §5; reference
-        # metrics.h:37-42). Computed from the statistics extension's
-        # compute_infer counters, so it needs no device-side profiler.
-        # Only device-placed models count toward TPU duty: host-placed
-        # models (device == "cpu", e.g. the tiny 'simple' fixture) execute
-        # on the host and must not report the TPU as busy.
-        device_models = set()
-        for entry in self.core.repository.index():
-            try:
-                model = self.core.repository.get(entry["name"])
-            except Exception:  # noqa: BLE001 - racing an unload
-                continue
-            if getattr(model, "device", "") != "cpu":
-                device_models.add(entry["name"])
-        total_compute_ns = sum(
-            ms["inference_stats"]["compute_infer"]["ns"]
-            for ms in self.core.statistics()["model_stats"]
-            if ms["name"] in device_models
-        )
-        now_ns = time.monotonic_ns()
-        prev = getattr(self, "_metrics_prev", None)
-        duty = 0.0
-        if prev is not None and now_ns > prev[0]:
-            # A statistics reset (model reload, stats cleared) makes the
-            # cumulative counter go backwards; clamp the delta to 0 so the
-            # gauge never goes negative.
-            compute_delta_ns = max(0, total_compute_ns - prev[1])
-            duty = min(1.0, compute_delta_ns / (now_ns - prev[0]))
-        self._metrics_prev = (now_ns, total_compute_ns)
-        lines.append("# TYPE tpu_duty_cycle gauge")
-        lines.append(f"tpu_duty_cycle {duty:.6f}")
-        lines.append("# TYPE tpu_device_compute_ns_total counter")
-        lines.append(f"tpu_device_compute_ns_total {total_compute_ns}")
-        lines.append("# TYPE tpu_memory_used_bytes gauge")
-        if jax is not None:
-            try:
-                devices = jax.local_devices()
-            except Exception:  # noqa: BLE001 - no backend available
-                devices = []
-            for i, device in enumerate(devices):
-                try:
-                    mstats = device.memory_stats() or {}
-                except Exception:  # noqa: BLE001 - backend-dependent
-                    mstats = {}
-                used = mstats.get("bytes_in_use")
-                limit = mstats.get("bytes_limit") or mstats.get(
-                    "bytes_reservable_limit"
-                )
-                if used is not None:
-                    lines.append(
-                        f'tpu_memory_used_bytes{{device="{i}"}} {used}'
-                    )
-                if limit:
-                    lines.append(
-                        f'tpu_memory_limit_bytes{{device="{i}"}} {limit}'
-                    )
-                    if used is not None:
-                        lines.append(
-                            f'tpu_memory_utilization{{device="{i}"}} '
-                            f"{used / limit:.6f}"
-                        )
+        """Prometheus text metrics, rendered from the core's registry
+        (:mod:`client_tpu.server.metrics` — the TPU replacement for the
+        reference's nv_* families scraped by perf_analyzer's
+        MetricsManager, reference metrics_manager.h:45-92). The registry's
+        collect hook takes exactly one statistics snapshot per scrape and
+        derives duty cycle from the core's monotone busy-ns counter, so
+        concurrent scrapers never corrupt each other's deltas."""
         return web.Response(
-            text="\n".join(lines) + "\n", content_type="text/plain"
+            text=self.core.metrics.render(), content_type="text/plain"
         )
 
     # -- shared memory -------------------------------------------------------
@@ -461,6 +372,7 @@ class HttpServer:
             try:
                 payload = json.loads(body[:header_len].decode("utf-8"))
             except (UnicodeDecodeError, json.JSONDecodeError) as e:
+                self.core.metrics.observe_frontend_error("http")
                 raise InferenceServerException(
                     f"malformed inference request header: {e}"
                 ) from None
@@ -469,6 +381,7 @@ class HttpServer:
             try:
                 payload = json.loads(body.decode("utf-8"))
             except (UnicodeDecodeError, json.JSONDecodeError) as e:
+                self.core.metrics.observe_frontend_error("http")
                 raise InferenceServerException(
                     f"malformed inference request: {e}"
                 ) from None
@@ -483,12 +396,18 @@ class HttpServer:
             traceparent=request.headers.get(TRACEPARENT_HEADER),
         )
         try:
-            core_request = self._build_core_request(
-                model_name,
-                request.match_info.get("version", ""),
-                payload,
-                binary,
-            )
+            try:
+                core_request = self._build_core_request(
+                    model_name,
+                    request.match_info.get("version", ""),
+                    payload,
+                    binary,
+                )
+            except InferenceServerException:
+                # rejected before reaching the engine: the statistics
+                # extension never sees it, the front-end counter does
+                self.core.metrics.observe_frontend_error("http")
+                raise
             core_request.trace = trace
             if trace is not None:
                 trace.request_id = core_request.id

@@ -1,0 +1,279 @@
+"""Server metrics: the registry behind ``/metrics``.
+
+Triton-parity metric families per model (the TPU face of the reference's
+``nv_inference_*``/``nv_gpu_*`` families that perf_analyzer's
+MetricsManager scrapes, reference metrics_manager.h:45-92,
+metrics.h:37-42), built on the dependency-free registry in
+:mod:`client_tpu.observability.metrics`:
+
+===================================  =========  ==============================
+family                               type       source
+===================================  =========  ==============================
+tpu_inference_request_success        counter    ServerCore stage events
+tpu_inference_request_failure        counter    ServerCore stage events
+tpu_inference_request_duration       histogram  per request, seconds
+tpu_inference_queue_duration         histogram  per request, seconds
+tpu_inference_compute_duration       histogram  per request, seconds
+tpu_inference_batch_size             histogram  per device execution, rows
+tpu_pending_request_count            gauge      in-flight requests per model
+tpu_frontend_request_errors          counter    requests rejected pre-core
+tpu_duty_cycle                       gauge      busy-ns counter, scrape delta
+tpu_device_compute_ns_total          counter    ServerCore busy-ns counter
+tpu_memory_used_bytes (+limit/util)  gauge      jax device memory_stats()
+tpu_inference_count (+duration_ns,   counter    statistics extension mirror
+  fail_count)                                   (pre-registry wire names)
+===================================  =========  ==============================
+
+The histograms are fed from the same ServerCore stage events the
+TraceManager receives, so ``/metrics``, the statistics extension, and the
+gRPC ModelStatistics RPC all agree: a histogram's ``_count`` equals the
+statistics ``success.count`` and its ``_sum`` equals ``success.ns / 1e9``.
+
+Duty cycle is derived from ServerCore's monotone cumulative busy-ns
+counter (device executions only — host-placed models never report the
+TPU busy): each scrape books busy-delta / wall-delta since the previous
+scrape under a lock, so concurrent scrapers each see a consistent (if
+shorter) interval and the first scrape reports utilization since server
+start instead of a hard-coded 0. Scrapers that want full control (the
+perf collector) derive their own rate from ``tpu_device_compute_ns_total``.
+"""
+
+import threading
+import time
+from typing import Callable, Optional
+
+from client_tpu.observability.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+
+try:  # jax powers the optional device-memory gauges
+    import jax
+except Exception:  # pragma: no cover - jax is an optional extra
+    jax = None
+
+# Seconds buckets tuned for TPU relays: sub-ms host models through
+# multi-second LLM decodes.
+DURATION_BUCKETS_S = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+BATCH_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+class ServerMetrics:
+    """Owns the server registry and the hot-path observation methods.
+
+    One instance per :class:`~client_tpu.server.core.ServerCore`; the
+    core's execution paths call ``observe_*``/``pending_*`` as requests
+    move through, and both front-ends render scrapes via :meth:`render`.
+    ``clock_ns`` is injectable (fake-clock tests).
+    """
+
+    def __init__(
+        self,
+        core,
+        clock_ns: Callable[[], int] = time.monotonic_ns,
+        jax_module=jax,
+    ):
+        self.core = core
+        self._clock_ns = clock_ns
+        self._jax = jax_module
+        registry = self.registry = MetricsRegistry()
+        model = ("model",)
+        self.request_success = Counter(
+            "tpu_inference_request_success",
+            "Successful inference requests.",
+            model,
+            registry=registry,
+        )
+        self.request_failure = Counter(
+            "tpu_inference_request_failure",
+            "Failed inference requests.",
+            model,
+            registry=registry,
+        )
+        self.request_duration = Histogram(
+            "tpu_inference_request_duration",
+            "End-to-end request duration inside the server, in seconds "
+            "(queue + compute).",
+            model,
+            buckets=DURATION_BUCKETS_S,
+            registry=registry,
+        )
+        self.queue_duration = Histogram(
+            "tpu_inference_queue_duration",
+            "Time a request waited for a device execution slot, in seconds.",
+            model,
+            buckets=DURATION_BUCKETS_S,
+            registry=registry,
+        )
+        self.compute_duration = Histogram(
+            "tpu_inference_compute_duration",
+            "Model compute time per request (input + infer + output), in "
+            "seconds.",
+            model,
+            buckets=DURATION_BUCKETS_S,
+            registry=registry,
+        )
+        self.batch_size = Histogram(
+            "tpu_inference_batch_size",
+            "Rows per device execution (dynamic batcher merge size).",
+            model,
+            buckets=BATCH_SIZE_BUCKETS,
+            registry=registry,
+        )
+        self.pending_requests = Gauge(
+            "tpu_pending_request_count",
+            "Inference requests currently inside the server (queued or "
+            "executing).",
+            model,
+            registry=registry,
+        )
+        self.frontend_errors = Counter(
+            "tpu_frontend_request_errors",
+            "Requests rejected by a front-end before reaching the engine "
+            "(malformed payloads; not counted by the statistics extension).",
+            ("protocol",),
+            registry=registry,
+        )
+        self.duty_cycle = Gauge(
+            "tpu_duty_cycle",
+            "Fraction of wall time the device spent executing models since "
+            "the previous scrape.",
+            registry=registry,
+        )
+        self.device_compute_ns = Counter(
+            "tpu_device_compute_ns_total",
+            "Cumulative nanoseconds of device model execution (monotone; "
+            "derive duty cycle from deltas of this counter).",
+            registry=registry,
+        )
+        self.memory_used = Gauge(
+            "tpu_memory_used_bytes",
+            "Device memory in use, per local device.",
+            ("device",),
+            registry=registry,
+        )
+        self.memory_limit = Gauge(
+            "tpu_memory_limit_bytes",
+            "Device memory capacity, per local device.",
+            ("device",),
+            registry=registry,
+        )
+        self.memory_utilization = Gauge(
+            "tpu_memory_utilization",
+            "Used / limit device memory fraction, per local device.",
+            ("device",),
+            registry=registry,
+        )
+        # Pre-registry wire names, kept so existing scrape configs and the
+        # round-1 dashboards survive the rewrite (statistics mirrors).
+        self.legacy_count = Counter(
+            "tpu_inference_count",
+            "Successful inference requests.",
+            model,
+            registry=registry,
+        )
+        self.legacy_duration_ns = Counter(
+            "tpu_inference_duration_ns",
+            "Cumulative successful-request nanoseconds.",
+            model,
+            registry=registry,
+        )
+        self.legacy_fail_count = Counter(
+            "tpu_inference_fail_count",
+            "Failed inference requests.",
+            model,
+            registry=registry,
+        )
+        self._duty_lock = threading.Lock()
+        # First scrape reports utilization since server start — not 0.0
+        # (the pre-registry handler's first-scrape blind spot).
+        self._duty_prev = (self._clock_ns(), 0)
+        registry.add_collect_hook(self._collect)
+
+    # -- hot-path hooks (called by ServerCore's execution paths) ------------
+
+    def observe_success(
+        self, model: str, queue_ns: int, compute_ns: int, total_ns: int,
+        count: int = 1,
+    ) -> None:
+        """Book ``count`` successful requests (per-request durations; the
+        merged direct path passes its chunk average with count=n)."""
+        self.request_success.labels(model).inc(count)
+        self.request_duration.labels(model).observe(total_ns / 1e9, count)
+        self.queue_duration.labels(model).observe(queue_ns / 1e9, count)
+        self.compute_duration.labels(model).observe(compute_ns / 1e9, count)
+
+    def observe_failure(self, model: str, count: int = 1) -> None:
+        self.request_failure.labels(model).inc(count)
+
+    def observe_execution(self, model: str, rows: int) -> None:
+        """Book one device execution of ``rows`` merged rows."""
+        self.batch_size.labels(model).observe(float(rows))
+
+    def observe_frontend_error(self, protocol: str) -> None:
+        self.frontend_errors.labels(protocol).inc()
+
+    def pending_inc(self, model: str, count: int = 1) -> None:
+        self.pending_requests.labels(model).inc(count)
+
+    def pending_dec(self, model: str, count: int = 1) -> None:
+        self.pending_requests.labels(model).dec(count)
+
+    # -- scrape -------------------------------------------------------------
+
+    def render(self) -> str:
+        """The exposition document (runs the collect hook below)."""
+        return self.registry.render()
+
+    def _collect(self) -> None:
+        """Scrape-time refresh: exactly ONE statistics snapshot feeds the
+        mirror counters (counters and derived values stay consistent
+        within a scrape), plus duty cycle and device memory."""
+        stats = self.core.statistics()
+        for ms in stats["model_stats"]:
+            name = ms["name"]
+            inference = ms["inference_stats"]
+            self.legacy_count.labels(name).set(inference["success"]["count"])
+            self.legacy_duration_ns.labels(name).set(
+                inference["success"]["ns"]
+            )
+            self.legacy_fail_count.labels(name).set(inference["fail"]["count"])
+        busy_ns = self.core.device_busy_ns_total
+        now_ns = self._clock_ns()
+        with self._duty_lock:
+            prev_ns, prev_busy = self._duty_prev
+            self._duty_prev = (now_ns, busy_ns)
+        duty = 0.0
+        if now_ns > prev_ns:
+            duty = min(1.0, max(0, busy_ns - prev_busy) / (now_ns - prev_ns))
+        self.duty_cycle.set(duty)
+        self.device_compute_ns.labels().set(busy_ns)
+        self._collect_memory()
+
+    def _collect_memory(self) -> None:
+        if self._jax is None:
+            return
+        try:
+            devices = self._jax.local_devices()
+        except Exception:  # noqa: BLE001 - no backend available
+            return
+        for i, device in enumerate(devices):
+            try:
+                mstats = device.memory_stats() or {}
+            except Exception:  # noqa: BLE001 - backend-dependent
+                mstats = {}
+            used = mstats.get("bytes_in_use")
+            limit = mstats.get("bytes_limit") or mstats.get(
+                "bytes_reservable_limit"
+            )
+            if used is not None:
+                self.memory_used.labels(str(i)).set(used)
+            if limit:
+                self.memory_limit.labels(str(i)).set(limit)
+                if used is not None:
+                    self.memory_utilization.labels(str(i)).set(used / limit)

@@ -1,0 +1,535 @@
+"""Metrics subsystem tests: the Prometheus registry's exposition-format
+guarantees (HELP/TYPE ordering, label-escaping round-trip, histogram
+bucket invariants, concurrent-scrape consistency), the server registry's
+duty-cycle derivation on a fake clock, /metrics served by the in-process
+server (self-scrape round-trip through our own parser), agreement between
+the gRPC statistics surface and the scraped histograms, and the perf
+harness's --collect-metrics collection loop.
+"""
+
+import asyncio
+import threading
+import types
+import urllib.request
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.http as httpclient
+from client_tpu.observability.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    counter_total,
+    escape_help,
+    escape_label_value,
+    gauge_values,
+    histogram_totals,
+    parse_exposition,
+    unescape_help,
+    unescape_label_value,
+)
+from client_tpu.perf.metrics_collector import MetricsCollector
+from client_tpu.server.metrics import ServerMetrics
+from client_tpu.testing import InProcessServer
+
+pytestmark = pytest.mark.observability
+
+
+def _simple_inputs(mod):
+    in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    in1 = np.ones([1, 16], dtype=np.int32)
+    a = mod.InferInput("INPUT0", [1, 16], "INT32")
+    a.set_data_from_numpy(in0)
+    b = mod.InferInput("INPUT1", [1, 16], "INT32")
+    b.set_data_from_numpy(in1)
+    return [a, b]
+
+
+# ---------------------------------------------------------------------------
+# registry: rendering
+
+
+def test_help_type_sample_ordering():
+    registry = MetricsRegistry()
+    counter = Counter("t_requests_total", "Requests.", ("model",),
+                      registry=registry)
+    counter.labels("m").inc(3)
+    Gauge("t_gauge", "A gauge.", registry=registry).set(1.5)
+    lines = registry.render().splitlines()
+    # per family: HELP line, then TYPE, then samples — in that order
+    assert lines[0] == "# HELP t_requests_total Requests."
+    assert lines[1] == "# TYPE t_requests_total counter"
+    assert lines[2] == 't_requests_total{model="m"} 3'
+    assert lines[3] == "# HELP t_gauge A gauge."
+    assert lines[4] == "# TYPE t_gauge gauge"
+    assert lines[5] == "t_gauge 1.5"
+
+
+def test_label_escaping_roundtrip():
+    nasty = 'quo"te\\slash\nnewline'
+    assert unescape_label_value(escape_label_value(nasty)) == nasty
+    registry = MetricsRegistry()
+    counter = Counter("t_esc", "Help with \\ backslash\nand newline",
+                      ("name",), registry=registry)
+    counter.labels(nasty).inc()
+    families = parse_exposition(registry.render())
+    sample = families["t_esc"].samples[0]
+    assert sample.labels["name"] == nasty
+    assert sample.value == 1
+    assert families["t_esc"].help == "Help with \\ backslash\nand newline"
+
+
+def test_help_escaping_roundtrip():
+    # literal backslash-then-n must survive: its escaped form contains the
+    # two-char sequence '\\n' that a naive ordered-replace would misread
+    for text in ("a\\nb", "line1\nline2", "mixed \\ and\nnewline \\n"):
+        assert unescape_help(escape_help(text)) == text
+        registry = MetricsRegistry()
+        Counter("t_help", text, registry=registry)
+        assert parse_exposition(registry.render())["t_help"].help == text
+
+
+def test_counter_and_gauge_semantics():
+    registry = MetricsRegistry()
+    counter = Counter("t_c", "c", registry=registry)
+    gauge = Gauge("t_g", "g", ("k",), registry=registry)
+    counter.inc()
+    counter.inc(2)
+    with pytest.raises(ValueError):
+        counter.labels().inc(-1)
+    with pytest.raises(ValueError):
+        counter.labels().dec()
+    gauge.labels("a").inc(5)
+    gauge.labels("a").dec(2)
+    gauge.labels(k="b").set(7)
+    assert registry.sample_value("t_c") == 3
+    assert registry.sample_value("t_g", {"k": "a"}) == 3
+    assert registry.sample_value("t_g", {"k": "b"}) == 7
+    with pytest.raises(ValueError):
+        MetricsRegistry().register(Counter("bad name", "x"))
+    with pytest.raises(ValueError):
+        Counter("t_dup", "x", registry=registry)
+        Counter("t_dup", "x", registry=registry)
+
+
+def test_histogram_invariants():
+    registry = MetricsRegistry()
+    hist = Histogram("t_h", "h", ("model",), buckets=(0.1, 1.0, 10.0),
+                     registry=registry)
+    for value in (0.05, 0.5, 0.5, 5.0, 50.0):
+        hist.labels("m").observe(value)
+    hist.labels("m").observe(2.0, count=3)  # batched booking
+    families = parse_exposition(registry.render())
+    totals = histogram_totals(families["t_h"], {"model": "m"})
+    buckets = totals["buckets"]
+    # cumulative counts never decrease across ascending le
+    assert [b[1] for b in buckets] == sorted(b[1] for b in buckets)
+    # +Inf bucket equals _count; _sum matches the observations
+    assert buckets[-1][0] == float("inf")
+    assert buckets[-1][1] == totals["count"] == 8
+    assert totals["sum"] == pytest.approx(0.05 + 0.5 + 0.5 + 5.0 + 50.0 + 6.0)
+    # bucket boundaries are inclusive (le semantics): 0.1 lands in le=0.1
+    hist.labels("m2").observe(0.1)
+    value = registry.sample_value(
+        "t_h_bucket", {"model": "m2", "le": "0.1"}
+    )
+    assert value == 1
+
+
+def test_histogram_rejects_bad_buckets():
+    with pytest.raises(ValueError):
+        Histogram("t_bad", "x", buckets=())
+    with pytest.raises(ValueError):
+        Histogram("t_bad", "x", buckets=(2.0, 1.0))
+    # a trailing +Inf is tolerated (implicit bucket)
+    hist = Histogram("t_ok", "x", buckets=(1.0, float("inf")))
+    assert hist.buckets == (1.0,)
+
+
+def test_parser_tolerates_foreign_documents():
+    text = "\n".join([
+        "# some freeform comment",
+        "# HELP up Scrape health.",
+        "# TYPE up gauge",
+        "up 1 1700000000",  # timestamp ignored
+        'foreign_total{a="1",b="2"} +Inf',
+        "bare_metric 42",
+    ])
+    families = parse_exposition(text)
+    assert families["up"].samples[0].value == 1
+    assert families["foreign_total"].samples[0].value == float("inf")
+    assert families["foreign_total"].samples[0].labels == {"a": "1", "b": "2"}
+    assert families["bare_metric"].samples[0].value == 42
+    with pytest.raises(ValueError):
+        parse_exposition("<html>not prometheus</html>")
+
+
+def test_concurrent_scrape_consistency():
+    """Scrapes racing live observations must each render an internally
+    consistent histogram: cumulative buckets monotone, +Inf == _count,
+    and counts never go backwards between successive scrapes."""
+    registry = MetricsRegistry()
+    hist = Histogram("t_cc", "h", buckets=(1.0, 2.0, 4.0), registry=registry)
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            hist.observe(0.5)
+            hist.observe(3.0)
+
+    workers = [threading.Thread(target=hammer) for _ in range(4)]
+    for w in workers:
+        w.start()
+    try:
+        last_count = 0.0
+        for _ in range(50):
+            totals = histogram_totals(
+                parse_exposition(registry.render())["t_cc"]
+            )
+            buckets = [b[1] for b in totals["buckets"]]
+            assert buckets == sorted(buckets)
+            assert buckets[-1] == totals["count"]
+            assert totals["count"] >= last_count
+            last_count = totals["count"]
+    finally:
+        stop.set()
+        for w in workers:
+            w.join()
+
+
+# ---------------------------------------------------------------------------
+# server registry: duty cycle on a fake clock
+
+
+class _CoreStub:
+    """Just enough ServerCore surface for a standalone ServerMetrics."""
+
+    def __init__(self):
+        self.busy_ns = 0
+
+    def statistics(self):
+        return {"model_stats": []}
+
+    @property
+    def device_busy_ns_total(self):
+        return self.busy_ns
+
+
+def test_duty_cycle_from_monotone_counter():
+    clock = types.SimpleNamespace(now=1_000)
+    core = _CoreStub()
+    metrics = ServerMetrics(core, clock_ns=lambda: clock.now, jax_module=None)
+
+    # First scrape reports utilization since construction — not 0.0.
+    core.busy_ns = 500_000_000
+    clock.now += 1_000_000_000
+    families = parse_exposition(metrics.render())
+    assert gauge_values(families["tpu_duty_cycle"])[0] == pytest.approx(0.5)
+    assert gauge_values(families["tpu_device_compute_ns_total"])[0] == (
+        500_000_000
+    )
+
+    # Idle interval: duty falls to 0; the counter stays monotone.
+    clock.now += 1_000_000_000
+    families = parse_exposition(metrics.render())
+    assert gauge_values(families["tpu_duty_cycle"])[0] == 0.0
+
+    # Busy > wall (concurrent executions) clamps to 1.0.
+    core.busy_ns += 5_000_000_000
+    clock.now += 1_000_000_000
+    families = parse_exposition(metrics.render())
+    assert gauge_values(families["tpu_duty_cycle"])[0] == 1.0
+
+
+def test_server_metrics_hot_path_families():
+    core = _CoreStub()
+    metrics = ServerMetrics(core, jax_module=None)
+    metrics.observe_success("m", queue_ns=1_000_000, compute_ns=2_000_000,
+                            total_ns=3_000_000)
+    metrics.observe_success("m", queue_ns=0, compute_ns=500_000,
+                            total_ns=500_000, count=3)
+    metrics.observe_failure("m")
+    metrics.observe_execution("m", 4)
+    metrics.pending_inc("m", 2)
+    metrics.pending_dec("m")
+    metrics.observe_frontend_error("http")
+    families = parse_exposition(metrics.render())
+    match = {"model": "m"}
+    assert counter_total(families["tpu_inference_request_success"], match) == 4
+    assert counter_total(families["tpu_inference_request_failure"], match) == 1
+    request = histogram_totals(
+        families["tpu_inference_request_duration"], match
+    )
+    assert request["count"] == 4
+    assert request["sum"] == pytest.approx(3e-3 + 3 * 5e-4)
+    assert gauge_values(families["tpu_pending_request_count"], match) == [1]
+    batch = histogram_totals(families["tpu_inference_batch_size"], match)
+    assert batch["count"] == 1 and batch["sum"] == 4
+    assert counter_total(
+        families["tpu_frontend_request_errors"], {"protocol": "http"}
+    ) == 1
+
+
+# ---------------------------------------------------------------------------
+# in-process server: self-scrape round-trip + cross-front-end agreement
+
+
+@pytest.fixture(scope="module")
+def server():
+    with InProcessServer(grpc="aio") as srv:
+        yield srv
+
+
+def _scrape(server) -> str:
+    with urllib.request.urlopen(
+        f"http://{server.http_url}/metrics", timeout=10
+    ) as resp:
+        return resp.read().decode()
+
+
+def test_metrics_endpoint_serves_true_histograms(server):
+    with httpclient.InferenceServerClient(server.http_url) as client:
+        for _ in range(4):
+            client.infer("simple", _simple_inputs(httpclient))
+    families = parse_exposition(_scrape(server))
+    match = {"model": "simple"}
+    request = histogram_totals(
+        families["tpu_inference_request_duration"], match
+    )
+    assert families["tpu_inference_request_duration"].kind == "histogram"
+    assert request["count"] >= 4 and request["sum"] > 0
+    buckets = [b[1] for b in request["buckets"]]
+    assert buckets == sorted(buckets)
+    assert buckets[-1] == request["count"]
+    assert histogram_totals(
+        families["tpu_inference_queue_duration"], match
+    )["count"] == request["count"]
+    assert histogram_totals(
+        families["tpu_inference_compute_duration"], match
+    )["count"] == request["count"]
+    # executions happened, nothing is in flight now
+    assert histogram_totals(
+        families["tpu_inference_batch_size"], match
+    )["count"] >= 1
+    assert gauge_values(
+        families["tpu_pending_request_count"], match
+    ) == [0]
+    # pre-registry wire names survive the rewrite
+    assert counter_total(families["tpu_inference_count"], match) == (
+        counter_total(families["tpu_inference_request_success"], match)
+    )
+
+
+def test_grpc_statistics_agree_with_scraped_metrics(server):
+    with grpcclient.InferenceServerClient(server.grpc_url) as client:
+        for _ in range(3):
+            client.infer("simple", _simple_inputs(grpcclient))
+        stats = client.get_inference_statistics("simple", as_json=True)
+    success = stats["model_stats"][0]["inference_stats"]["success"]
+    families = parse_exposition(_scrape(server))
+    match = {"model": "simple"}
+    request = histogram_totals(
+        families["tpu_inference_request_duration"], match
+    )
+    # the registry histograms and the statistics extension are fed from
+    # the same ServerCore stage events: _count == success.count and
+    # _sum == success.ns (allowing for requests landing between the two
+    # snapshots — scrape AFTER stats, counts can only grow)
+    assert request["count"] >= int(success["count"])
+    assert counter_total(
+        families["tpu_inference_request_success"], match
+    ) == request["count"]
+    assert request["sum"] >= int(success["ns"]) / 1e9 * 0.999
+
+
+def test_frontend_error_counter(server):
+    import urllib.error
+
+    req = urllib.request.Request(
+        f"http://{server.http_url}/v2/models/simple/infer",
+        data=b"this is not json",
+        method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(req, timeout=10)
+    families = parse_exposition(_scrape(server))
+    assert counter_total(
+        families["tpu_frontend_request_errors"], {"protocol": "http"}
+    ) >= 1
+    # a pre-core rejection never pollutes the statistics extension
+    assert counter_total(
+        families["tpu_inference_request_failure"], {"model": "simple"}
+    ) == 0
+
+
+def test_decoupled_slow_consumer_does_not_inflate_busy():
+    """A decoupled stream suspended at yield while the consumer dawdles
+    must book only model-await time into the busy counter — booking wall
+    time would read a slow client as a busy TPU (duty ~1.0)."""
+    from client_tpu.server.core import CoreRequest, CoreTensor, ServerCore
+    from client_tpu.server.model_repository import ModelRepository
+    from client_tpu.server.models import register_builtin_models
+
+    core = ServerCore(ModelRepository())
+    register_builtin_models(core.repository)
+
+    async def run():
+        request = CoreRequest(model_name="repeat_int32")
+        request.inputs.append(
+            CoreTensor("IN", "INT32", [4], np.arange(4, dtype=np.int32))
+        )
+        async for _response in core.infer_decoupled(request):
+            await asyncio.sleep(0.05)  # slow consumer
+
+    asyncio.run(run())
+    # consumer spent >=200 ms suspended; model produces near-instantly
+    assert core.device_busy_ns_total < 100_000_000
+    core.close()
+
+
+# ---------------------------------------------------------------------------
+# perf collector
+
+
+def test_collector_summary_round_trip():
+    """Collector scraping a live ServerMetrics (injected fetch, fake
+    clocks): duty from the monotone counter, queue/compute ratio, batch
+    distribution — all first->last deltas."""
+    clock = types.SimpleNamespace(now=0)
+    core = _CoreStub()
+    metrics = ServerMetrics(core, clock_ns=lambda: clock.now, jax_module=None)
+    metrics.memory_used.labels("0").set(1024)
+
+    async def fetch():
+        return metrics.render()
+
+    collector = MetricsCollector(
+        "ignored:0",
+        interval_s=10.0,
+        model_name="m",
+        fetch=fetch,
+        clock_ns=lambda: clock.now,
+    )
+
+    async def run():
+        assert await collector.scrape_now()  # baseline
+        # one second of load: 40% duty, 10 requests, batches of 2
+        core.busy_ns += 400_000_000
+        for _ in range(10):
+            metrics.observe_success(
+                "m", queue_ns=100_000, compute_ns=900_000, total_ns=1_000_000
+            )
+        for _ in range(5):
+            metrics.observe_execution("m", 2)
+        metrics.memory_used.labels("0").set(4096)
+        clock.now += 1_000_000_000
+        assert await collector.scrape_now()
+        await collector.stop()
+
+    asyncio.run(run())
+    summary = collector.summary()
+    assert summary.scrape_count == 3  # baseline + load + stop()'s final
+    assert summary.duty_avg == pytest.approx(0.4, rel=0.01)
+    assert summary.duty_max == pytest.approx(0.4, rel=0.01)
+    assert summary.memory_peak_bytes == 4096
+    assert summary.request_count == 10
+    assert summary.avg_request_us == pytest.approx(1000, rel=0.01)
+    assert summary.avg_queue_us == pytest.approx(100, rel=0.01)
+    assert summary.avg_compute_us == pytest.approx(900, rel=0.01)
+    assert summary.queue_compute_ratio == pytest.approx(1 / 9, rel=0.01)
+    assert summary.batch_avg == pytest.approx(2.0)
+    assert sum(c for _le, c in summary.batch_buckets) == 5
+    assert summary.success_count == 10 and summary.failure_count == 0
+
+
+def test_collector_duty_avg_is_time_weighted():
+    """Unequal scrape intervals (the profiler's window-bracketing scrapes
+    next to the 1 s loop) must not bias duty_avg: the average is the
+    overall busy/wall ratio, not a per-interval mean."""
+    clock = types.SimpleNamespace(now=0)
+    core = _CoreStub()
+    metrics = ServerMetrics(core, clock_ns=lambda: clock.now, jax_module=None)
+
+    async def fetch():
+        return metrics.render()
+
+    collector = MetricsCollector(
+        "ignored:0", fetch=fetch, clock_ns=lambda: clock.now
+    )
+
+    async def run():
+        await collector.scrape_now()  # t=0, busy=0
+        core.busy_ns += 900_000_000  # 1 s at 90%
+        clock.now += 1_000_000_000
+        await collector.scrape_now()
+        clock.now += 20_000_000  # 20 ms idle bracket scrape
+        await collector.scrape_now()
+
+    asyncio.run(run())
+    summary = collector.summary()
+    # unweighted mean would report (0.9 + 0.0) / 2 = 0.45
+    assert summary.duty_avg == pytest.approx(0.9 / 1.02, rel=0.01)
+    assert summary.duty_max == pytest.approx(0.9, rel=0.01)
+
+
+def test_collector_tolerates_scrape_failures():
+    async def fetch():
+        raise RuntimeError("connection refused")
+
+    collector = MetricsCollector("ignored:0", fetch=fetch)
+
+    async def run():
+        assert not await collector.scrape_now()
+        await collector.stop()
+
+    asyncio.run(run())
+    assert collector.scrape_errors == 2  # explicit + stop()'s final
+    assert "connection refused" in collector.last_error
+    summary = collector.summary()
+    assert summary.scrape_count == 0 and summary.scrape_errors == 2
+
+
+def test_collector_url_normalization():
+    assert MetricsCollector("localhost:8000").url == (
+        "http://localhost:8000/metrics"
+    )
+    assert MetricsCollector("localhost:8000/metrics").url == (
+        "http://localhost:8000/metrics"
+    )
+    assert MetricsCollector("http://h:1/metrics").url == "http://h:1/metrics"
+    with pytest.raises(ValueError):
+        MetricsCollector("h:1", interval_s=0)
+
+
+# ---------------------------------------------------------------------------
+# CLI end-to-end (--collect-metrics against the in-process server)
+
+
+def test_cli_collect_metrics_end_to_end(capsys):
+    from client_tpu.perf.cli import main
+
+    with InProcessServer(grpc=False) as server:
+        code = main([
+            "-m", "simple",
+            "-u", server.http_url,
+            "-i", "http",
+            "--concurrency-range", "2",
+            "--measurement-interval", "250",
+            "--stability-percentage", "60",
+            "--max-trials", "3",
+            "--collect-metrics",
+            "--metrics-interval", "0.1",
+            "--stage-breakdown",
+        ])
+    assert code == 0
+    out = capsys.readouterr().out
+    assert "Server metrics" in out
+    assert "TPU duty cycle" in out
+    assert "Queue/compute" in out
+    assert "Batch size" in out
+    # the previously-unprinted ClientMetrics snapshot surfaces too
+    assert "Client metrics:" in out
+    assert "Latency histogram:" in out

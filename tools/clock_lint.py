@@ -4,7 +4,10 @@ The observability and resilience layers are tested with fake clocks (no
 sleeps, milliseconds of wall time); that only works while every clock
 read goes through an injectable ``clock``/``clock_ns`` callable. This
 lint bans *direct calls* to the ``time`` module's clock functions inside
-``client_tpu/observability/`` and ``client_tpu/resilience/``.
+``client_tpu/observability/`` (the tracer AND the Prometheus registry in
+``metrics.py``), ``client_tpu/resilience/``, and the clock-injected
+perf-harness modules listed in ``TARGET_FILES`` (the server-metrics
+collector).
 
 References are fine — ``clock: Callable = time.monotonic`` as a default
 parameter is exactly the injectable pattern — only Call nodes are
@@ -20,6 +23,11 @@ from typing import List, Tuple
 TARGET_DIRS = (
     os.path.join("client_tpu", "observability"),
     os.path.join("client_tpu", "resilience"),
+)
+
+# clock-injected modules outside the blanket-linted packages
+TARGET_FILES = (
+    os.path.join("client_tpu", "perf", "metrics_collector.py"),
 )
 
 # time-module clock functions whose direct call defeats injection
@@ -88,6 +96,14 @@ def run_clock_lint(repo_root: str = None) -> List[str]:
     """Lint the target packages; returns 'path:line: message' strings."""
     root = repo_root or _repo_root()
     problems = []
+    for target in TARGET_FILES:
+        path = os.path.join(root, target)
+        if not os.path.exists(path):
+            continue
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        for lineno, message in check_source(source, path):
+            problems.append(f"{target}:{lineno}: {message}")
     for target in TARGET_DIRS:
         base = os.path.join(root, target)
         for dirpath, _dirs, files in os.walk(base):
