@@ -128,6 +128,22 @@ def get_inference_request_body(
     return header, None
 
 
+def retry_after_seconds(headers) -> Optional[float]:
+    """Parse a ``Retry-After`` header (delta-seconds form) from a header
+    mapping; returns None when absent or unparsable (HTTP-date form is
+    ignored — the servers this client talks to emit seconds)."""
+    if not headers:
+        return None
+    for key, value in headers.items():
+        if key.lower() == "retry-after":
+            try:
+                parsed = float(value)
+            except (TypeError, ValueError):
+                return None
+            return parsed if parsed > 0 else None
+    return None
+
+
 def parse_error_response(body: bytes, status: int) -> InferenceServerException:
     """Map an HTTP error response to an InferenceServerException."""
     try:

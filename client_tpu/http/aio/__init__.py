@@ -30,6 +30,7 @@ from client_tpu.http._utils import (
     model_infer_uri,
     parse_json_response,
     raise_if_error,
+    retry_after_seconds,
 )
 from client_tpu.observability.trace import (
     NOOP_TRACE,
@@ -222,6 +223,9 @@ class InferenceServerClient(InferenceServerClientBase):
             idempotent=idempotent,
             result_status=lambda value: str(value[0]),
             description=f"{method} {url}",
+            # a 429 shed response's Retry-After is the server's own
+            # backoff estimate — honored as the retry floor
+            result_backoff_hint=lambda value: retry_after_seconds(value[2]),
         )
         if self._verbose:
             print(f"-> {status} ({len(rbody)} bytes)")
@@ -576,7 +580,7 @@ class InferenceServerClient(InferenceServerClientBase):
         model_version: str = "",
         headers: Optional[Dict[str, str]] = None,
         query_params: Optional[Dict[str, Any]] = None,
-        timeout: Optional[float] = None,
+        client_timeout: Optional[float] = None,
         idempotent: bool = True,
     ) -> InferResult:
         """Send a body built by :meth:`generate_request_body` (reusable —
@@ -606,7 +610,7 @@ class InferenceServerClient(InferenceServerClientBase):
                 body,
                 extra_headers,
                 query_params,
-                timeout=timeout,
+                timeout=client_timeout,
                 idempotent=idempotent,
                 trace=trace,
             )
@@ -630,14 +634,32 @@ class InferenceServerClient(InferenceServerClientBase):
         sequence_start: bool = False,
         sequence_end: bool = False,
         priority: int = 0,
-        timeout: Optional[float] = None,
+        timeout: Optional[int] = None,
+        client_timeout: Optional[float] = None,
         headers: Optional[Dict[str, str]] = None,
         query_params: Optional[Dict[str, Any]] = None,
         request_compression_algorithm: Optional[str] = None,
         response_compression_algorithm: Optional[str] = None,
         parameters: Optional[Dict[str, Any]] = None,
     ) -> InferResult:
-        """Run a synchronous (from the caller's view: awaited) inference."""
+        """Run a synchronous (from the caller's view: awaited) inference.
+
+        ``priority`` and ``timeout`` match the gRPC client surface
+        (``client_tpu.grpc.InferenceServerClient.infer``): both travel as
+        KServe request *parameters* — ``priority`` picks the server-side
+        scheduler queue level (1 = highest) and ``timeout`` is the queue
+        timeout in MICROSECONDS the server may enforce before execution.
+        ``client_timeout`` (seconds) is this client's own transport
+        budget across attempts — the two deadlines are independent."""
+        if timeout is not None and not isinstance(timeout, int):
+            # fail LOUDLY: this kwarg used to be a seconds-float transport
+            # budget; a silently truncated float would reach the server as
+            # a microsecond queue deadline and shed every request
+            raise InferenceServerException(
+                "infer(timeout=...) is the server queue timeout in "
+                "MICROSECONDS (int), matching the gRPC client; use "
+                "client_timeout= (seconds) for the transport budget"
+            )
         trace = start_trace(
             self._tracer, "infer", surface="http", model=model_name
         )
@@ -651,7 +673,7 @@ class InferenceServerClient(InferenceServerClientBase):
                     sequence_start=sequence_start,
                     sequence_end=sequence_end,
                     priority=priority,
-                    timeout=int(timeout * 1_000_000) if timeout else None,
+                    timeout=int(timeout) if timeout else None,
                     parameters=parameters,
                 )
                 extra_headers = dict(headers) if headers else {}
@@ -674,7 +696,7 @@ class InferenceServerClient(InferenceServerClientBase):
                 body,
                 extra_headers,
                 query_params,
-                timeout=timeout,
+                timeout=client_timeout,
                 idempotent=sequence_is_idempotent(sequence_id),
                 trace=trace,
             )

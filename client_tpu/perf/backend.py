@@ -88,9 +88,13 @@ class PerfBackend:
         sequence_id: int = 0,
         sequence_start: bool = False,
         sequence_end: bool = False,
+        priority: int = 0,
+        timeout_us: Optional[int] = None,
     ) -> None:
         """One request -> one response (payload discarded; timing is the
-        caller's job)."""
+        caller's job). ``priority``/``timeout_us`` are the server-side
+        scheduling parameters (overload mode); backends without a way to
+        express them ignore them."""
         raise NotImplementedError
 
     async def stream_infer(
@@ -246,6 +250,8 @@ class HttpPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
         sequence_id=0,
         sequence_start=False,
         sequence_end=False,
+        priority=0,
+        timeout_us=None,
         cache_token=None,
     ):
         if cache_token is not None:
@@ -257,6 +263,8 @@ class HttpPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
                     sequence_id=sequence_id,
                     sequence_start=sequence_start,
                     sequence_end=sequence_end,
+                    priority=priority,
+                    timeout=timeout_us,
                 ),
                 lambda prepared: len(prepared[0]),
             )
@@ -279,6 +287,8 @@ class HttpPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
             sequence_id=sequence_id,
             sequence_start=sequence_start,
             sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout_us,
         )
 
 
@@ -348,6 +358,8 @@ class GrpcPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
         sequence_id=0,
         sequence_start=False,
         sequence_end=False,
+        priority=0,
+        timeout_us=None,
         cache_token=None,
     ):
         if cache_token is not None:
@@ -361,6 +373,8 @@ class GrpcPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
                     sequence_id=sequence_id,
                     sequence_start=sequence_start,
                     sequence_end=sequence_end,
+                    priority=priority,
+                    timeout=timeout_us,
                 ),
                 lambda request: request.ByteSize(),
             )
@@ -375,6 +389,8 @@ class GrpcPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
             sequence_id=sequence_id,
             sequence_start=sequence_start,
             sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout_us,
         )
 
     async def stream_infer(
@@ -430,14 +446,28 @@ class LocalPerfBackend(PerfBackend):
         self._CoreTensor = CoreTensor
 
     def _build_request(
-        self, model_name, inputs, model_version, request_id, parameters
+        self,
+        model_name,
+        inputs,
+        model_version,
+        request_id,
+        parameters,
+        priority=0,
+        timeout_us=None,
     ):
 
+        params = dict(parameters or {})
+        # scheduling parameters ride the same wire slot the remote
+        # front-ends decode them from
+        if priority:
+            params["priority"] = priority
+        if timeout_us:
+            params["timeout"] = timeout_us
         request = self._CoreRequest(
             model_name=model_name,
             model_version=model_version,
             id=request_id,
-            parameters=dict(parameters or {}),
+            parameters=params,
         )
         for t in inputs:
             request.inputs.append(
@@ -469,10 +499,18 @@ class LocalPerfBackend(PerfBackend):
         sequence_id=0,
         sequence_start=False,
         sequence_end=False,
+        priority=0,
+        timeout_us=None,
     ):
         await self._core.infer(
             self._build_request(
-                model_name, inputs, model_version, request_id, parameters
+                model_name,
+                inputs,
+                model_version,
+                request_id,
+                parameters,
+                priority=priority,
+                timeout_us=timeout_us,
             )
         )
 
@@ -814,7 +852,8 @@ class TfsPerfBackend(_RestSessionMixin, PerfBackend):
 
     async def infer(self, model_name, inputs, model_version="",
                     request_id="", parameters=None, sequence_id=0,
-                    sequence_start=False, sequence_end=False):
+                    sequence_start=False, sequence_end=False,
+                    priority=0, timeout_us=None):
         def rows_for(t):
             values = np.asarray(t.data)
             if t.datatype == "BYTES":
@@ -894,7 +933,8 @@ class TorchServePerfBackend(_RestSessionMixin, PerfBackend):
 
     async def infer(self, model_name, inputs, model_version="",
                     request_id="", parameters=None, sequence_id=0,
-                    sequence_start=False, sequence_end=False):
+                    sequence_start=False, sequence_end=False,
+                    priority=0, timeout_us=None):
         if not inputs:
             raise InferenceServerException("torchserve backend needs input")
         t = inputs[0]

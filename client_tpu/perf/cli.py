@@ -188,6 +188,22 @@ def build_parser() -> argparse.ArgumentParser:
         "they are recorded and reported)",
     )
     parser.add_argument(
+        "--request-priority",
+        default=None,
+        help="scheduling priority parameter for every request (1 = "
+        "highest), or a comma list cycled across requests (e.g. '1,2') "
+        "for a mixed-priority overload run — the report then carries a "
+        "per-priority latency split",
+    )
+    parser.add_argument(
+        "--queue-timeout-us",
+        type=int,
+        default=None,
+        help="per-request server queue timeout in microseconds (the "
+        "KServe 'timeout' parameter); timed-out requests fail with a "
+        "deadline error before execution",
+    )
+    parser.add_argument(
         "--stage-breakdown",
         action="store_true",
         help="trace every request client-side (observability spans) and "
@@ -459,6 +475,21 @@ async def run(args) -> int:
             print(f"error: {e}", file=sys.stderr)
             return 2
 
+        priorities = None
+        if args.request_priority:
+            try:
+                priorities = [
+                    int(p) for p in str(args.request_priority).split(",")
+                ]
+            except ValueError:
+                print(
+                    f"error: bad --request-priority "
+                    f"'{args.request_priority}' (want an int or a comma "
+                    "list of ints)",
+                    file=sys.stderr,
+                )
+                return 2
+
         common = dict(
             model_name=args.model_name,
             model_version=args.model_version,
@@ -467,6 +498,8 @@ async def run(args) -> int:
             sequence_manager=sequence_manager,
             parameters=request_parameters or None,
             max_error_rate=args.max_error_rate,
+            priorities=priorities,
+            queue_timeout_us=args.queue_timeout_us,
         )
 
         # Multi-process rendezvous: barrier after setup so all ranks start
@@ -627,7 +660,19 @@ async def run(args) -> int:
                 "errors": best.status.error_count,
                 "mode": best.mode,
                 "value": best.value,
+                # overload/scheduling: admission sheds, deadline errors,
+                # shed fraction, and successes/sec excluding rejects
+                "rejected": best.status.rejected_count,
+                "timeouts": best.status.timeout_count,
+                "shed_rate": best.status.shed_rate,
+                "goodput": best.status.goodput,
             }
+            if best.status.per_priority_latency_us:
+                summary_doc["per_priority_p99_us"] = {
+                    str(p): entry.get(99, 0)
+                    for p, entry in
+                    best.status.per_priority_latency_us.items()
+                }
             if server_summary is not None:
                 summary_doc["server_duty_avg"] = server_summary.duty_avg
                 summary_doc["server_duty_max"] = server_summary.duty_max

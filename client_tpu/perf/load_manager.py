@@ -45,6 +45,8 @@ class LoadManager:
         parameters: Optional[Dict] = None,
         max_error_rate: Optional[float] = None,
         min_error_sample: int = 20,
+        priorities: Optional[Sequence[int]] = None,
+        queue_timeout_us: Optional[int] = None,
     ):
         self.backend = backend
         self.model_name = model_name
@@ -55,6 +57,11 @@ class LoadManager:
         self.parameters = parameters
         self.max_error_rate = max_error_rate
         self.min_error_sample = min_error_sample
+        # Overload mode: scheduling parameters stamped on every request.
+        # A list of priorities is cycled across requests (a mixed
+        # "1,2" run produces the report's per-priority latency split).
+        self.priorities = list(priorities) if priorities else []
+        self.queue_timeout_us = queue_timeout_us
         # cumulative across swap_records() windows
         self.issued_total = 0
         self.errors_total = 0
@@ -85,15 +92,35 @@ class LoadManager:
         each slot owns at most one active sequence at a time (two workers
         must never interleave steps of one sequence id).
         """
-        request_id = str(next(self._request_counter))
+        request_index = next(self._request_counter)
+        request_id = str(request_index)
         seq_kwargs = {}
         if self.sequences is not None:
             seq_kwargs = self.sequences.next_step(
                 slot if slot is not None else stream
             )
+        priority = (
+            self.priorities[request_index % len(self.priorities)]
+            if self.priorities
+            else 0
+        )
+        sched_kwargs = {}
+        if priority:
+            sched_kwargs["priority"] = priority
+        if self.queue_timeout_us:
+            sched_kwargs["timeout_us"] = self.queue_timeout_us
         cache_token = None
         if self._prepared_enabled and not self.streaming:
             cache_token = self.data_loader.cache_token(stream, step)
+            if cache_token is not None and sched_kwargs:
+                # scheduling params are baked into a prepared wire
+                # request — a mixed-priority run must not reuse one
+                # priority's body for another's
+                cache_token = (
+                    cache_token,
+                    priority,
+                    self.queue_timeout_us,
+                )
         if cache_token is not None and self.backend.has_prepared(cache_token):
             # Prepared hit: the backend resends its stored wire request —
             # skip input/parameter preparation entirely (C++ twin:
@@ -136,6 +163,7 @@ class LoadManager:
                     request_id=request_id,
                     parameters=parameters,
                     **seq_kwargs,
+                    **sched_kwargs,
                     **extra,
                 )
                 record.response_ns.append(time.monotonic_ns())
@@ -144,6 +172,10 @@ class LoadManager:
         except Exception as e:  # noqa: BLE001 - failures are data
             record.success = False
             record.error = str(e)
+            if isinstance(e, InferenceServerException):
+                # status token (e.g. "429", "StatusCode.RESOURCE_EXHAUSTED")
+                # lets the reducer classify sheds vs deadline errors
+                record.error_status = e.status()
         record.end_ns = time.monotonic_ns()
         # transparent retries the resilience layer performed for this call
         # (contextvar updates within one task persist across awaits)
@@ -151,6 +183,7 @@ class LoadManager:
         # client-side stage durations from the tracer, when the backend
         # has one configured (same contextvar idiom as the retry count)
         record.stages = observability.last_stages()
+        record.priority = priority
         record.sequence_id = seq_kwargs.get("sequence_id", 0)
         record.ctx_id = slot if slot is not None else 0
         self.issued_total += 1

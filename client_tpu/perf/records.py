@@ -23,6 +23,12 @@ class RequestRecord:
     response_ns: List[int] = dataclasses.field(default_factory=list)
     success: bool = True
     error: Optional[str] = None
+    # status token of the failure ("429", "StatusCode.RESOURCE_EXHAUSTED",
+    # "DEADLINE_EXCEEDED", ...) when the error carried one — classifies
+    # admission sheds vs deadline errors vs other failures
+    error_status: Optional[str] = None
+    # scheduling priority this request was sent with (0 = unset)
+    priority: int = 0
     # transparent client-side retries this request needed (resilience
     # layer); 0 when no retry policy is configured
     retries: int = 0
@@ -85,6 +91,25 @@ class PerfStatus:
     client_serialize_us: float = 0.0
     client_transport_us: float = 0.0
     client_deserialize_us: float = 0.0
+    # scheduling / overload: admission sheds (429 / RESOURCE_EXHAUSTED),
+    # queue-deadline errors (504 / DEADLINE_EXCEEDED), the shed fraction
+    # of all window completions, and the per-priority latency split for
+    # mixed-priority runs: priority -> {"count", "avg", 50, 99, ...}
+    rejected_count: int = 0
+    timeout_count: int = 0
+    shed_rate: float = 0.0
+    per_priority_latency_us: Dict[int, Dict[Any, float]] = dataclasses.field(
+        default_factory=dict
+    )
+
+    @property
+    def goodput(self) -> float:
+        """Successes/sec excluding rejected and failed requests. The
+        client-side ``throughput`` already counts successes only, so
+        this is an alias — it exists because under overload that number
+        must be READ as goodput (rejects are not served work), and the
+        overload report/JSON name it accordingly."""
+        return self.throughput
 
     @property
     def stabilizing_latency_us(self) -> float:
@@ -127,6 +152,19 @@ class ServerMetricsSummary:
     failure_count: int = 0
 
 
+# Status tokens that classify a failed request as shed by admission
+# control vs failed on its queue deadline (all client surfaces: HTTP
+# numeric statuses, gRPC code reprs, in-process scheduling errors).
+_REJECT_STATUS_TOKENS = frozenset({"429", "RESOURCE_EXHAUSTED"})
+_TIMEOUT_STATUS_TOKENS = frozenset({"504", "DEADLINE_EXCEEDED"})
+
+
+def _error_token(record: RequestRecord) -> str:
+    if record.success or not record.error_status:
+        return ""
+    return record.error_status.rsplit(".", 1)[-1]
+
+
 def compute_window_status(
     records: List[RequestRecord],
     window_start_ns: int,
@@ -164,6 +202,34 @@ def compute_window_status(
         status.latency_percentiles_us = {
             q: percentile(lat_us, q) for q in percentiles
         }
+    # scheduling / overload classification
+    rejected = sum(
+        1 for r in window if _error_token(r) in _REJECT_STATUS_TOKENS
+    )
+    timeouts = sum(
+        1 for r in window if _error_token(r) in _TIMEOUT_STATUS_TOKENS
+    )
+    status.rejected_count = rejected
+    status.timeout_count = timeouts
+    if window:
+        status.shed_rate = rejected / len(window)
+    priorities = {r.priority for r in window}
+    if priorities and priorities != {0}:
+        split: Dict[int, Dict[Any, float]] = {}
+        for p in sorted(priorities):
+            lat_p = sorted(
+                r.latency_ns / 1e3 for r in successes if r.priority == p
+            )
+            if not lat_p:
+                continue
+            entry: Dict[Any, float] = {
+                "count": len(lat_p),
+                "avg": sum(lat_p) / len(lat_p),
+            }
+            for q in percentiles:
+                entry[q] = percentile(lat_p, q)
+            split[p] = entry
+        status.per_priority_latency_us = split
     traced = [r for r in successes if r.stages]
     if traced:
         n = len(traced)

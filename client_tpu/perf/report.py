@@ -100,6 +100,32 @@ def detailed_report(experiment: ProfileExperiment) -> str:
         lines.append(f"  Errors: {s.error_count}")
     if s.retry_count:
         lines.append(f"  Retries: {s.retry_count}")
+    scheduling = format_scheduling(s)
+    if scheduling:
+        lines.append(scheduling)
+    return "\n".join(lines)
+
+
+def format_scheduling(s) -> str:
+    """The "Scheduling" block: overload behavior (shed rate, goodput)
+    and the per-priority latency split of a mixed-priority run. Empty
+    when the window saw no admission activity and no priorities."""
+    if not (
+        s.rejected_count or s.timeout_count or s.per_priority_latency_us
+    ):
+        return ""
+    lines = [
+        "  Scheduling: shed rate "
+        f"{s.shed_rate * 100:.1f}% ({s.rejected_count} queue-full, "
+        f"{s.timeout_count} timeout), goodput {s.goodput:.2f} infer/sec"
+    ]
+    for p in sorted(s.per_priority_latency_us):
+        entry = s.per_priority_latency_us[p]
+        lines.append(
+            f"    priority {p}: {int(entry['count'])} ok, avg "
+            f"{entry['avg']:.0f} usec, p50 {entry.get(50, 0):.0f} usec, "
+            f"p99 {entry.get(99, 0):.0f} usec"
+        )
     return "\n".join(lines)
 
 

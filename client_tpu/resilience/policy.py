@@ -457,6 +457,18 @@ def _backoff_within_budget(policy, deadline, retries):
     return backoff
 
 
+def _apply_backoff_hint(backoff, hint_s, deadline):
+    """Raise a drawn backoff to a server-provided floor (Retry-After on a
+    429 shed response): the server knows its queue better than the
+    client's jitter schedule. Returns None — no retry — when honoring the
+    hint would blow the remaining deadline budget."""
+    if backoff is None or not hint_s or hint_s <= backoff:
+        return backoff
+    if deadline is not None and deadline.remaining_s() <= hint_s:
+        return None
+    return hint_s
+
+
 class _AttemptLoop:
     """Shared per-attempt decision core for the sync and async drivers.
 
@@ -473,12 +485,14 @@ class _AttemptLoop:
         idempotent,
         result_status,
         description,
+        result_backoff_hint=None,
     ):
         self.policy = retry_policy
         self.breaker = circuit_breaker
         self.budget_s = budget_s
         self.idempotent = idempotent
         self.result_status = result_status
+        self.result_backoff_hint = result_backoff_hint
         self.description = description
         clock = (
             retry_policy.clock if retry_policy is not None else time.monotonic
@@ -524,8 +538,12 @@ class _AttemptLoop:
             if _should_retry_now(
                 self.policy, self.idempotent, self.retries, retryable
             ):
-                backoff = _backoff_within_budget(
-                    self.policy, self.deadline, self.retries
+                backoff = _apply_backoff_hint(
+                    _backoff_within_budget(
+                        self.policy, self.deadline, self.retries
+                    ),
+                    getattr(exc, "retry_after_s", None),
+                    self.deadline,
                 )
                 if backoff is not None:
                     self.retries += 1
@@ -561,8 +579,14 @@ class _AttemptLoop:
             if _should_retry_now(
                 self.policy, self.idempotent, self.retries, True
             ):
-                backoff = _backoff_within_budget(
-                    self.policy, self.deadline, self.retries
+                backoff = _apply_backoff_hint(
+                    _backoff_within_budget(
+                        self.policy, self.deadline, self.retries
+                    ),
+                    self.result_backoff_hint(value)
+                    if self.result_backoff_hint is not None
+                    else None,
+                    self.deadline,
                 )
                 if backoff is not None:
                     self.retries += 1
@@ -595,6 +619,7 @@ async def run_with_resilience_async(
     idempotent: bool = True,
     result_status: Optional[Callable[[object], str]] = None,
     description: str = "request",
+    result_backoff_hint: Optional[Callable[[object], Optional[float]]] = None,
 ):
     """Run ``send(per_attempt_timeout)`` under retry/deadline/breaker rules.
 
@@ -604,6 +629,10 @@ async def run_with_resilience_async(
     — returned values whose ``result_status(value)`` token classifies as
     retryable; a failing value is returned as-is once attempts are
     exhausted, so non-retry semantics are unchanged.
+    ``result_backoff_hint(value)`` may supply a server-provided backoff
+    floor in seconds for a retryable value (HTTP ``Retry-After`` on a 429
+    shed response); exceptions carry the same hint as a
+    ``retry_after_s`` attribute.
     """
     if retry_policy is None and circuit_breaker is None:
         # default configuration: no loop state, no classification — the
@@ -617,6 +646,7 @@ async def run_with_resilience_async(
         idempotent,
         result_status,
         description,
+        result_backoff_hint,
     )
     while True:
         attempt_timeout = loop.pre_attempt()
@@ -640,6 +670,7 @@ def run_with_resilience(
     idempotent: bool = True,
     result_status: Optional[Callable[[object], str]] = None,
     description: str = "request",
+    result_backoff_hint: Optional[Callable[[object], Optional[float]]] = None,
 ):
     """Sync twin of :func:`run_with_resilience_async` (blocking sleeps)."""
     if retry_policy is None and circuit_breaker is None:
@@ -652,6 +683,7 @@ def run_with_resilience(
         idempotent,
         result_status,
         description,
+        result_backoff_hint,
     )
     while True:
         attempt_timeout = loop.pre_attempt()

@@ -1,0 +1,52 @@
+"""Scheduling & admission control: queue policies, priority queues, and
+overload shedding.
+
+The server-side QoS layer between the front-ends and the execution engine
+(the role Triton's dynamic-batch scheduler queue policies and rate limiter
+play; reference model_config.proto ModelQueuePolicy / ModelDynamicBatching
+priority_levels / ModelRateLimiter):
+
+- :class:`QueuePolicy` — per-model admission configuration
+  (``max_queue_size``, ``default_timeout_us``, ``timeout_action``,
+  ``allow_timeout_override``, ``priority_levels``,
+  ``default_priority_level``) resolved from the model's declarations.
+- :class:`PriorityQueue` — bounded multi-level FIFO (lower level number =
+  higher priority; stable arrival order within a level) with deadline
+  expiry. Backs ``_ModelBatcher.pending``.
+- :class:`RateLimiter` — grants device executions against named resource
+  pools, waking waiters in priority order (ModelRateLimiter semantics).
+- :class:`AdmissionGate` — waiting-room counter for the execution paths
+  that have no explicit queue (single / direct / decoupled).
+
+Everything here is clock-injectable: no function in this package reads a
+wall clock itself — "now" values are passed in by the caller (the server
+core) or produced by an injected ``clock_ns`` — so the whole subsystem is
+tested with fake clocks in milliseconds of wall time (enforced by
+``tools/clock_lint.py``).
+"""
+
+from client_tpu.scheduling.policy import (
+    SCHEDULING_PARAM_KEYS,
+    TIMEOUT_ACTION_CONTINUE,
+    TIMEOUT_ACTION_REJECT,
+    AdmissionGate,
+    QueueFullError,
+    QueuePolicy,
+    QueueTimeoutError,
+    SchedulingError,
+)
+from client_tpu.scheduling.queue import PriorityQueue
+from client_tpu.scheduling.rate_limiter import RateLimiter
+
+__all__ = [
+    "SCHEDULING_PARAM_KEYS",
+    "TIMEOUT_ACTION_CONTINUE",
+    "TIMEOUT_ACTION_REJECT",
+    "AdmissionGate",
+    "PriorityQueue",
+    "QueueFullError",
+    "QueuePolicy",
+    "QueueTimeoutError",
+    "RateLimiter",
+    "SchedulingError",
+]

@@ -24,16 +24,41 @@ from client_tpu.utils import InferenceServerException
 # gRPC status codes (subset used here; numeric so the native front-end can
 # put them straight into the grpc-status trailer).
 GRPC_OK = 0
+GRPC_DEADLINE_EXCEEDED = 4
 GRPC_INVALID_ARGUMENT = 3
 GRPC_NOT_FOUND = 5
+GRPC_RESOURCE_EXHAUSTED = 8
 GRPC_UNIMPLEMENTED = 12
 GRPC_INTERNAL = 13
 GRPC_UNAVAILABLE = 14
 
+# grpc.StatusCode names (as carried by SchedulingError.grpc_code) ->
+# numeric codes, for exception-aware callers.
+_CODE_BY_NAME = {
+    "DEADLINE_EXCEEDED": GRPC_DEADLINE_EXCEEDED,
+    "INVALID_ARGUMENT": GRPC_INVALID_ARGUMENT,
+    "NOT_FOUND": GRPC_NOT_FOUND,
+    "RESOURCE_EXHAUSTED": GRPC_RESOURCE_EXHAUSTED,
+    "UNIMPLEMENTED": GRPC_UNIMPLEMENTED,
+    "INTERNAL": GRPC_INTERNAL,
+    "UNAVAILABLE": GRPC_UNAVAILABLE,
+}
 
-def status_code_for(message: str) -> int:
-    """Map an InferenceServerException message to a gRPC status code."""
+
+def status_code_for(message: str, exc=None) -> int:
+    """Map an InferenceServerException (or its message) to a gRPC status
+    code. Exceptions that declare ``grpc_code`` (the scheduling layer's
+    admission rejections) win; message patterns cover callers that only
+    have the text (the native front-end's completion path)."""
+    if exc is not None:
+        code = _CODE_BY_NAME.get(getattr(exc, "grpc_code", None))
+        if code is not None:
+            return code
     lowered = message.lower()
+    if "queue" in lowered and "full" in lowered:
+        return GRPC_RESOURCE_EXHAUSTED
+    if "timed out in queue" in lowered:
+        return GRPC_DEADLINE_EXCEEDED
     if "not found" in lowered or "unknown model" in lowered:
         return GRPC_NOT_FOUND
     if "not ready" in lowered or "unavailable" in lowered:

@@ -18,6 +18,17 @@ from typing import Any, AsyncIterator, Dict, List, Optional
 
 import numpy as np
 
+from client_tpu.scheduling import (
+    SCHEDULING_PARAM_KEYS,
+    TIMEOUT_ACTION_REJECT,
+    AdmissionGate,
+    PriorityQueue,
+    QueueFullError,
+    QueuePolicy,
+    QueueTimeoutError,
+    RateLimiter,
+    SchedulingError,
+)
 from client_tpu.server.model_repository import Model, ModelRepository
 from client_tpu.server.shm import SharedMemoryManager
 from client_tpu.utils import (
@@ -78,6 +89,11 @@ class CoreRequest:
     # server trace attached by the front-end (observability.ServerTrace);
     # the execution paths add queue/compute stage events to it
     trace: Optional[Any] = None
+    # scheduling fields stamped at admission (QueuePolicy.stamp): the
+    # effective queue level (1 = highest) and the absolute queue deadline
+    # in monotonic ns (None = no deadline)
+    priority_level: int = 0
+    deadline_ns: Optional[int] = None
 
 
 def _trace_stages(
@@ -348,6 +364,21 @@ class _BatchMeta:
                 )
         return rows
 
+    @staticmethod
+    def _signature_params(parameters: Dict[str, Any]) -> str:
+        """Parameter part of the batch-compat signature. Scheduling
+        params (priority/timeout) are admission inputs, not execution
+        inputs — two same-shape requests that differ only in them must
+        still share a batch, so they are excluded here."""
+        if not parameters:
+            return ""
+        filtered = [
+            (k, v)
+            for k, v in sorted(parameters.items())
+            if k not in SCHEDULING_PARAM_KEYS
+        ]
+        return repr(filtered) if filtered else ""
+
     def signature(self, request: CoreRequest):
         if not self.ragged:
             return (
@@ -355,9 +386,7 @@ class _BatchMeta:
                     (t.name, t.datatype, tuple(t.shape[1:]))
                     for t in request.inputs
                 ),
-                repr(sorted(request.parameters.items()))
-                if request.parameters
-                else "",
+                self._signature_params(request.parameters),
             )
         sig = []
         for t in request.inputs:
@@ -373,9 +402,7 @@ class _BatchMeta:
             sig.append((t.name, t.datatype, len(t.shape), dims))
         return (
             tuple(sig),
-            repr(sorted(request.parameters.items()))
-            if request.parameters
-            else "",
+            self._signature_params(request.parameters),
         )
 
     def pad_ragged(self, name: str, arrays: List[np.ndarray]) -> List[np.ndarray]:
@@ -454,25 +481,52 @@ class _ModelBatcher:
     are zero-padded to a shared power-of-two bucket (Triton's ragged
     batching, server-side) — so concurrent BERT/LLM requests of different
     sequence lengths share one device execution.
+
+    Admission control (client_tpu.scheduling): the pending list is a
+    bounded multi-level :class:`PriorityQueue` — ``submit()`` rejects
+    with 429/RESOURCE_EXHAUSTED once ``max_queue_size`` requests wait,
+    ``_take_batch`` consumes in (priority, arrival) order, and entries
+    whose queue deadline passes fail with a deadline error before
+    execution (or are demoted behind in-deadline work when the model's
+    ``timeout_action`` is "continue").
     """
 
     def __init__(self, core: "ServerCore", model: Model):
         self.core = core
         self.model = model
         self.meta = core._batch_meta(model)
-        # entries: (request, future, signature, rows, arrival_ns)
-        self.pending: List[Any] = []
+        self.policy = core._queue_policy(model)
+        # queued entries: (request, future, signature, rows, arrival_ns)
+        self.pending = PriorityQueue(levels=self.policy.levels)
         self.running = False
 
     def submit(self, request: CoreRequest) -> "asyncio.Future[CoreResponse]":
-        """Validate + enqueue a request; returns a future for its response."""
+        """Validate + enqueue a request; returns a future for its response.
+
+        Raises :class:`QueueFullError` (already booked on metrics/stats)
+        when the queue is at ``max_queue_size``."""
         rows = self.meta.validate(request)
+        policy = self.policy
+        if (
+            policy.max_queue_size
+            and len(self.pending) >= policy.max_queue_size
+        ):
+            error = QueueFullError(self.model.name, policy.max_queue_size)
+            self.core._book_rejection(
+                self.model.name, request, error, record_fail=True
+            )
+            raise error
+        arrival_ns = time.monotonic_ns()
+        policy.stamp(request, arrival_ns)
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
-        self.pending.append(
-            (request, future, self.meta.signature(request), rows,
-             time.monotonic_ns())
+        self.pending.push(
+            (request, future, self.meta.signature(request), rows, arrival_ns),
+            level=request.priority_level,
+            deadline_ns=request.deadline_ns,
+            timeout_action=policy.timeout_action,
         )
+        self._publish_depths()
         if not self.running:
             self.running = True
             loop.create_task(self._drain())
@@ -480,39 +534,107 @@ class _ModelBatcher:
 
     async def _drain(self) -> None:
         try:
-            while self.pending:
-                await self._execute_batch(self._take_batch())
+            while len(self.pending):
+                self._expire_pending()
+                if not len(self.pending):
+                    break
+                batch = self._take_batch()
+                resources = self.policy.rate_resources
+                if resources:
+                    await self.core.rate_limiter.acquire(
+                        resources, self.policy.rate_priority
+                    )
+                    try:
+                        # the grant wait may have outlived queue
+                        # deadlines: reject-action entries still fail
+                        # BEFORE execution, as the policy promises
+                        batch = self._expire_taken(batch)
+                        if batch:
+                            await self._execute_batch(batch)
+                    finally:
+                        self.core.rate_limiter.release(resources)
+                else:
+                    await self._execute_batch(batch)
         finally:
             self.running = False
-            if self.pending:  # raced with a submit after the while check
+            if len(self.pending):  # raced with a submit after the check
                 self.running = True
                 asyncio.get_running_loop().create_task(self._drain())
 
+    def _reject_expired(self, entry, now_ns: int) -> None:
+        """Fail one (request, future, ...) entry with a deadline error."""
+        request, future, _sig, _rows, arrival_ns = entry
+        error = QueueTimeoutError(
+            self.model.name, self.policy.timeout_us_of(request.parameters)
+        )
+        self.core._book_rejection(
+            self.model.name,
+            request,
+            error,
+            record_fail=True,
+            latency_ns=now_ns - arrival_ns,
+        )
+        if not future.done():
+            future.set_exception(error)
+
+    def _expire_pending(self) -> None:
+        """Fail queued entries whose deadline passed (reject action);
+        "continue" entries were demoted inside the queue instead."""
+        now_ns = time.monotonic_ns()
+        expired = self.pending.expire(now_ns)
+        for item in expired:
+            self._reject_expired(item.value, now_ns)
+        if expired:
+            self._publish_depths()
+
+    def _expire_taken(self, entries: List[Any]) -> List[Any]:
+        """Deadline re-check for a batch already popped from the queue
+        (the rate-limiter grant wait sits between take and execute);
+        returns the still-live entries."""
+        if self.policy.timeout_action != TIMEOUT_ACTION_REJECT:
+            return entries
+        now_ns = time.monotonic_ns()
+        live = []
+        for entry in entries:
+            deadline_ns = entry[0].deadline_ns
+            if deadline_ns is not None and now_ns > deadline_ns:
+                self._reject_expired(entry, now_ns)
+            else:
+                live.append(entry)
+        return live
+
     def _take_batch(self) -> List[Any]:
-        """Pop the oldest request plus every compatible pending request,
-        bounded by max_batch_size rows (submit() already rejected any
-        single request exceeding the max). Scanning stops at the first
-        same-signature entry that does not fit the row budget, so arrival
-        order within a signature is preserved."""
-        lead = self.pending[0]
-        signature = lead[2]
+        """Pop the highest-priority oldest request plus every compatible
+        queued request, bounded by max_batch_size rows (submit() already
+        rejected any single request exceeding the max). The scan walks
+        the queue in (priority, arrival) order and stops taking a
+        signature at its first entry that does not fit the row budget, so
+        arrival order within a (priority, signature) lane is preserved."""
+        items = self.pending.scan()
+        signature = items[0].value[2]
         budget = self.model.max_batch_size
-        taken, kept, rows = [], [], 0
+        taken_items, taken, rows = [], [], 0
         signature_full = False
-        for entry in self.pending:
+        for item in items:
+            entry = item.value
             if (
                 entry[2] == signature
                 and not signature_full
                 and rows + entry[3] <= budget
             ):
+                taken_items.append(item)
                 taken.append(entry)
                 rows += entry[3]
-            else:
-                if entry[2] == signature:
-                    signature_full = True
-                kept.append(entry)
-        self.pending = kept
+            elif entry[2] == signature:
+                signature_full = True
+        self.pending.remove(taken_items)
+        self._publish_depths()
         return taken
+
+    def _publish_depths(self) -> None:
+        self.core.metrics.set_queue_depth(
+            self.model.name, self.pending.depths()
+        )
 
     async def _execute_batch(self, entries: List[Any]) -> None:
         loop = asyncio.get_running_loop()
@@ -599,6 +721,10 @@ class ServerCore:
         from client_tpu.observability.server import TraceManager
 
         self.trace_manager = TraceManager()
+        # Execution grants against named resource pools (ModelRateLimiter
+        # semantics); models that declare rate_limiter resources acquire
+        # them around every device execution.
+        self.rate_limiter = RateLimiter()
         # Cumulative device-busy nanoseconds (device-placed executions
         # only) — the monotone counter scrapers derive duty cycle from.
         # Owned here, not by an HTTP handler, so every front-end and any
@@ -659,6 +785,95 @@ class ServerCore:
             meta = _BatchMeta(model)
             model._ctpu_batch_meta = meta
         return meta
+
+    # -- scheduling / admission control --------------------------------------
+
+    def _queue_policy(self, model: Model) -> QueuePolicy:
+        """The model's resolved admission policy (cached on the model so
+        a repository reload rebuilds it). First resolution registers the
+        model's rate-limiter demands with the shared pool."""
+        policy = getattr(model, "_ctpu_queue_policy", None)
+        if policy is None or policy.model is not model:
+            policy = QueuePolicy.from_model(model)
+            model._ctpu_queue_policy = policy
+            if policy.rate_resources:
+                self.rate_limiter.register(policy.rate_resources)
+        return policy
+
+    def _admission_for(self, model: Model) -> AdmissionGate:
+        """Waiting-room gate for the non-batcher execution paths."""
+        gate = getattr(model, "_ctpu_admission_gate", None)
+        if gate is None or gate.policy.model is not model:
+            gate = AdmissionGate(self._queue_policy(model))
+            model._ctpu_admission_gate = gate
+        return gate
+
+    def _book_rejection(
+        self,
+        model_name: str,
+        request: CoreRequest,
+        error: SchedulingError,
+        record_fail: bool = False,
+        latency_ns: int = 0,
+    ) -> None:
+        """Account one admission rejection everywhere it is observable:
+        the dedicated reject counter (by reason), the trace record, and —
+        when no other error path will — the statistics 'fail' field."""
+        self.metrics.observe_rejection(model_name, error.reason)
+        if request.trace is not None:
+            request.trace.event("QUEUE_REJECTED")
+        if record_fail:
+            self._stats_for(model_name).record("fail", latency_ns)
+
+    def _admit_single(self, model: Model, request: CoreRequest):
+        """Admission for the non-batcher paths: stamps the scheduling
+        fields and claims a waiting-room slot. Returns the gate ticket
+        (``started()`` releases the slot when execution begins), or None
+        on the fast path — an unconfigured model and a request with no
+        parameters have nothing to schedule, so the stamp and the gate
+        lock are skipped entirely. Raises :class:`QueueFullError` —
+        already booked — when the room is full."""
+        policy = self._queue_policy(model)
+        if not policy.enabled and not request.parameters:
+            return None
+        policy.stamp(request, time.monotonic_ns())
+        gate = self._admission_for(model)
+        try:
+            return gate.enter(model.name)
+        except SchedulingError as e:
+            self._book_rejection(model.name, request, e, record_fail=True)
+            raise
+
+    def _check_deadline(self, model: Model, request: CoreRequest) -> None:
+        """Fail a request whose queue deadline passed before execution
+        (reject action only; "continue" executes late)."""
+        if (
+            request.deadline_ns is not None
+            and time.monotonic_ns() > request.deadline_ns
+        ):
+            policy = self._queue_policy(model)
+            if policy.timeout_action == TIMEOUT_ACTION_REJECT:
+                error = QueueTimeoutError(
+                    model.name, policy.timeout_us_of(request.parameters)
+                )
+                # Fully booked here; generic error paths skip stats
+                # accounting for SchedulingError to avoid double counts.
+                self._book_rejection(
+                    model.name, request, error, record_fail=True
+                )
+                raise error
+
+    def _run_single(self, model: Model, request: CoreRequest, ticket=None):
+        """Executor-side entry for the single path: leave the waiting
+        room, enforce the queue deadline, then run the model. NEVER
+        blocks on the rate limiter — a parked executor thread could
+        starve the very execution whose release it waits for; limiter
+        waits happen on the event loop (async path) or the caller's own
+        pump thread (direct path) instead."""
+        if ticket is not None:
+            ticket.started()
+        self._check_deadline(model, request)
+        return self._run_model(model, request)
 
     # -- statistics API ------------------------------------------------------
 
@@ -844,7 +1059,10 @@ class ServerCore:
         if model.max_batch_size > 1 and self._has_batch_dim(model, request):
             future = self._submit_batched(model, request)
         else:
-            future = asyncio.ensure_future(self._infer_single(model, request))
+            ticket = self._admit_single(model, request)
+            future = asyncio.ensure_future(
+                self._infer_single(model, request, ticket)
+            )
         self.metrics.pending_inc(model.name)
         future.add_done_callback(
             lambda _f, name=model.name: self.metrics.pending_dec(name)
@@ -861,6 +1079,10 @@ class ServerCore:
             self._batchers[model.name] = batcher
         try:
             return batcher.submit(request)
+        except SchedulingError:
+            # Admission rejections are fully booked inside submit()
+            # (reject counter + stats fail + trace event).
+            raise
         except InferenceServerException:
             # Validation failures surface synchronously; execution
             # failures are accounted inside the batcher already.
@@ -913,22 +1135,27 @@ class ServerCore:
                 ):
                     meta = self._batch_meta(model)
                     rows = meta.validate(request)
+                    ticket = self._admit_single(model, request)
                     key = (model.name, meta.signature(request))
                     group = groups.get(key)
                     if group is None:
-                        groups[key] = (model, meta, [(idx, rows)])
+                        groups[key] = (model, meta, [(idx, rows, ticket)])
                     else:
-                        group[2].append((idx, rows))
+                        group[2].append((idx, rows, ticket))
                     # grouped requests stay pending until their chunk
                     # executes (_execute_direct_chunk decrements)
                     grouped = True
                 else:
-                    results[idx] = self._infer_single_sync(model, request)
+                    ticket = self._admit_single(model, request)
+                    results[idx] = self._infer_single_sync(
+                        model, request, ticket
+                    )
             except Exception as e:  # noqa: BLE001 - aligned error result
                 # Only account stats for models that exist: booking by a
                 # client-supplied unknown name would grow self.stats
-                # without bound under hostile clients.
-                if model is not None:
+                # without bound under hostile clients. Admission
+                # rejections were fully booked at the rejection site.
+                if model is not None and not isinstance(e, SchedulingError):
                     self._stats_for(model.name).record(
                         "fail", time.monotonic_ns() - arrival_ns
                     )
@@ -964,14 +1191,55 @@ class ServerCore:
         arrival_ns: int,
     ) -> None:
         """One merged device execution for the direct path (the synchronous
-        twin of _ModelBatcher._execute_batch)."""
+        twin of _ModelBatcher._execute_batch). Chunk entries are
+        ``(index, rows, admission_ticket)``; entries whose queue deadline
+        passed while the chunk formed fail with a deadline error before
+        the merge."""
         stats = self._stats_for(model.name)
+        policy = self._queue_policy(model)
+        check_ns = time.monotonic_ns()
+        live = []
+        for idx, rows, ticket in chunk:
+            if ticket is not None:
+                ticket.started()
+            request = requests[idx]
+            if (
+                request.deadline_ns is not None
+                and check_ns > request.deadline_ns
+                and policy.timeout_action == TIMEOUT_ACTION_REJECT
+            ):
+                error = QueueTimeoutError(
+                    model.name, policy.timeout_us_of(request.parameters)
+                )
+                self._book_rejection(
+                    model.name,
+                    request,
+                    error,
+                    record_fail=True,
+                    latency_ns=check_ns - arrival_ns,
+                )
+                results[idx] = error
+                self.metrics.pending_dec(model.name)
+            else:
+                live.append((idx, rows))
+        chunk = live
+        if not chunk:
+            return
+        resources = policy.rate_resources
+        if resources:
+            self.rate_limiter.acquire_blocking(
+                resources, policy.rate_priority
+            )
         exec_start = time.monotonic_ns()
         reqs = [requests[idx] for idx, _rows in chunk]
         try:
-            merged = meta.merge_inputs(reqs)
-            with model.placement():
-                raw = _to_host(model.execute(merged, reqs[0].parameters))
+            try:
+                merged = meta.merge_inputs(reqs)
+                with model.placement():
+                    raw = _to_host(model.execute(merged, reqs[0].parameters))
+            finally:
+                if resources:
+                    self.rate_limiter.release(resources)
             infer_end = time.monotonic_ns()
             self.add_busy_ns(model, infer_end - exec_start)
             self.metrics.observe_execution(
@@ -1028,13 +1296,29 @@ class ServerCore:
             stats.record_execution()
 
     def _infer_single_sync(
-        self, model: Model, request: CoreRequest
+        self, model: Model, request: CoreRequest, ticket=None
     ) -> CoreResponse:
         """Unbatched synchronous execution (the direct-path twin of
-        _infer_single); raises on failure, caller accounts the 'fail'."""
+        _infer_single); raises on failure, caller accounts the 'fail'
+        (admission rejections book themselves). Runs on the native
+        front-end's pump thread — its own thread, not the shared
+        executor — so a blocking limiter wait here cannot starve the
+        execution that would release the grant."""
         stats = self._stats_for(model.name)
-        t0 = time.monotonic_ns()
-        raw = self._run_model(model, request)
+        policy = self._queue_policy(model)
+        if policy.rate_resources:
+            # before t0: the grant wait must not book as device-busy time
+            self.rate_limiter.acquire_blocking(
+                policy.rate_resources, policy.rate_priority
+            )
+            try:
+                t0 = time.monotonic_ns()
+                raw = self._run_single(model, request, ticket)
+            finally:
+                self.rate_limiter.release(policy.rate_resources)
+        else:
+            t0 = time.monotonic_ns()
+            raw = self._run_single(model, request, ticket)
         t1 = time.monotonic_ns()
         self.add_busy_ns(model, t1 - t0)
         response = self._package_outputs(model, request, raw)
@@ -1063,28 +1347,50 @@ class ServerCore:
             if model.max_batch_size > 1 and self._has_batch_dim(model, request):
                 return await self._submit_batched(model, request)
             # Awaited single path: run the coroutine inline — no Task.
-            return await self._infer_single(model, request)
+            ticket = self._admit_single(model, request)
+            return await self._infer_single(model, request, ticket)
         finally:
             self.metrics.pending_dec(model.name)
 
     async def _infer_single(
-        self, model: Model, request: CoreRequest
+        self, model: Model, request: CoreRequest, ticket=None
     ) -> CoreResponse:
-        """Unbatched execution path (max_batch_size <= 1 or no batch dim)."""
+        """Unbatched execution path (max_batch_size <= 1 or no batch dim).
+
+        ``ticket`` is the admission-gate slot claimed by the caller; the
+        executor closure releases it when execution begins (and the
+        finally below is the safety net for requests cancelled before
+        their executor slot ran)."""
         stats = self._stats_for(model.name)
+        policy = self._queue_policy(model)
         t0 = time.monotonic_ns()
         loop = asyncio.get_running_loop()
+        rate_resources = None
         try:
+            if policy.rate_resources:
+                # waited on the LOOP, never on an executor thread (a
+                # parked worker could starve the releasing execution)
+                await self.rate_limiter.acquire(
+                    policy.rate_resources, policy.rate_priority
+                )
+                rate_resources = policy.rate_resources
             t1 = time.monotonic_ns()
             raw = await loop.run_in_executor(
-                self._executor, self._run_model, model, request
+                self._executor, self._run_single, model, request, ticket
             )
             t2 = time.monotonic_ns()
             response = self._package_outputs(model, request, raw)
             t3 = time.monotonic_ns()
-        except Exception:
-            stats.record("fail", time.monotonic_ns() - t0)
+        except Exception as e:
+            # admission rejections (queue timeout) were booked already
+            if not isinstance(e, SchedulingError):
+                stats.record("fail", time.monotonic_ns() - t0)
             raise
+        finally:
+            if rate_resources is not None:
+                self.rate_limiter.release(rate_resources)
+            if ticket is not None:
+                ticket.close()
         self.add_busy_ns(model, t2 - t1)
         rows = self._resolve_batch(model, request)
         self.metrics.observe_execution(model.name, rows)
@@ -1108,6 +1414,13 @@ class ServerCore:
         """
         model = self.repository.get(request.model_name, request.model_version)
         stats = self._stats_for(model.name)
+        ticket = None
+        rate_resources = None
+        if model.decoupled:
+            # Admission before the stream opens: for decoupled models the
+            # waiting-room bound sheds streams that would only pile up
+            # behind a saturated device (raises a booked QueueFullError).
+            ticket = self._admit_single(model, request)
         t0 = time.monotonic_ns()
         # Split the stream's lifetime into model-compute vs output-packaging
         # time, and record time-to-first-response — the reference's stats
@@ -1144,6 +1457,21 @@ class ServerCore:
             if not model.decoupled:
                 yield await self.infer(request)
                 return
+            policy = self._queue_policy(model)
+            if policy.rate_resources:
+                # the stream holds its resource grant for its lifetime
+                await self.rate_limiter.acquire(
+                    policy.rate_resources, policy.rate_priority
+                )
+                rate_resources = policy.rate_resources
+            # Leave the waiting room and re-check the queue deadline only
+            # AFTER the grant wait (mirroring _run_single's ordering):
+            # streams parked on the pool must keep counting against
+            # max_queue_size, and a deadline that passes during the wait
+            # must still fail the stream before it touches the model.
+            if ticket is not None:
+                ticket.started()
+            self._check_deadline(model, request)
             inputs = {t.name: t.data for t in request.inputs}
             resume_ns = time.monotonic_ns()
             async for raw in model.execute_decoupled(inputs, request.parameters):
@@ -1195,7 +1523,7 @@ class ServerCore:
                         index, time.monotonic_ns() - t0, cancelled=True
                     )
             raise
-        except Exception:
+        except Exception as e:
             # Only the decoupled path accounts here: non-decoupled requests
             # were delegated to infer(), which already recorded the failure
             # (recording again would double-count it).
@@ -1205,11 +1533,17 @@ class ServerCore:
                 # aggregate: response_stats mirrors Triton's
                 # InferResponseStatistics, which carries fail entries.
                 stats.record_response_failure(index, now - t0)
-                stats.record("fail", now - t0)
+                # admission rejections booked their aggregate fail already
+                if not isinstance(e, SchedulingError):
+                    stats.record("fail", now - t0)
             raise
         else:
             _book_success()
         finally:
+            if rate_resources is not None:
+                self.rate_limiter.release(rate_resources)
+            if ticket is not None:
+                ticket.close()
             if model.decoupled:
                 self.metrics.pending_dec(model.name)
 

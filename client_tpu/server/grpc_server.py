@@ -40,9 +40,13 @@ _INT_TO_STATUS_CODE = {
 }
 
 
-def _status_for(message: str) -> grpc.StatusCode:
+def _status_for(message: str, exc=None) -> grpc.StatusCode:
+    """Status for an inference failure. Admission rejections carry their
+    code directly (``grpc_code``): queue-full -> RESOURCE_EXHAUSTED,
+    queue timeout -> DEADLINE_EXCEEDED."""
     return _INT_TO_STATUS_CODE.get(
-        codec.status_code_for(message), grpc.StatusCode.INVALID_ARGUMENT
+        codec.status_code_for(message, exc=exc),
+        grpc.StatusCode.INVALID_ARGUMENT,
     )
 
 
@@ -220,7 +224,7 @@ class _Servicer(GRPCInferenceServiceServicer):
         except InferenceServerException as e:
             if trace is not None:
                 trace.end(error=e.message())
-            await context.abort(_status_for(e.message()), e.message())
+            await context.abort(_status_for(e.message(), e), e.message())
         except BaseException as e:
             if trace is not None:
                 trace.end(error=str(e))

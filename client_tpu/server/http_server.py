@@ -39,8 +39,24 @@ from client_tpu.utils import (
 HEADER_CONTENT_LENGTH = "Inference-Header-Content-Length"
 
 
-def _error_response(msg: str, status: int = 400) -> web.Response:
-    return web.json_response({"error": msg}, status=status)
+def _error_response(
+    msg: str, status: int = 400, headers: Optional[Dict[str, str]] = None
+) -> web.Response:
+    return web.json_response({"error": msg}, status=status, headers=headers)
+
+
+def _map_exception(e: InferenceServerException) -> web.Response:
+    """InferenceServerException -> HTTP error response. Admission-control
+    rejections (client_tpu.scheduling) carry their own wire face:
+    queue-full -> 429 with a Retry-After hint (the resilience layer
+    classifies 429 as retryable-with-backoff and honors the hint),
+    queue timeout -> 504; everything else keeps the historical 400."""
+    status = getattr(e, "http_status", None) or 400
+    headers = None
+    retry_after_s = getattr(e, "retry_after_s", None)
+    if retry_after_s:
+        headers = {"Retry-After": str(max(1, int(round(retry_after_s))))}
+    return _error_response(e.message(), status=status, headers=headers)
 
 
 def _chaos_middleware(chaos):
@@ -94,7 +110,7 @@ def _guarded(handler):
         try:
             return await handler(request)
         except InferenceServerException as e:
-            return _error_response(e.message())
+            return _map_exception(e)
         except web.HTTPException:
             raise
         except Exception as e:  # noqa: BLE001 - surface as server error

@@ -16,6 +16,8 @@ tpu_inference_queue_duration         histogram  per request, seconds
 tpu_inference_compute_duration       histogram  per request, seconds
 tpu_inference_batch_size             histogram  per device execution, rows
 tpu_pending_request_count            gauge      in-flight requests per model
+tpu_queue_rejected_total             counter    admission rejections {model,reason}
+tpu_queue_depth                      gauge      queued requests {model,level}
 tpu_frontend_request_errors          counter    requests rejected pre-core
 tpu_duty_cycle                       gauge      busy-ns counter, scrape delta
 tpu_device_compute_ns_total          counter    ServerCore busy-ns counter
@@ -132,6 +134,21 @@ class ServerMetrics:
             model,
             registry=registry,
         )
+        self.queue_rejected = Counter(
+            "tpu_queue_rejected_total",
+            "Requests rejected by admission control, by reason "
+            "(queue_full = max_queue_size hit, timeout = queue deadline "
+            "passed before execution).",
+            ("model", "reason"),
+            registry=registry,
+        )
+        self.queue_depth = Gauge(
+            "tpu_queue_depth",
+            "Requests waiting in the scheduler queue, per priority level "
+            "(level 1 = highest priority).",
+            ("model", "level"),
+            registry=registry,
+        )
         self.frontend_errors = Counter(
             "tpu_frontend_request_errors",
             "Requests rejected by a front-end before reaching the engine "
@@ -217,6 +234,17 @@ class ServerMetrics:
 
     def observe_frontend_error(self, protocol: str) -> None:
         self.frontend_errors.labels(protocol).inc()
+
+    def observe_rejection(self, model: str, reason: str) -> None:
+        """Book one admission-control rejection (queue_full / timeout)."""
+        self.queue_rejected.labels(model, reason).inc()
+
+    def set_queue_depth(self, model: str, depths) -> None:
+        """Publish the scheduler queue depth per priority level (fed from
+        the same submit/take/expire events that stamp the statistics
+        extension's queue timings)."""
+        for level, depth in depths.items():
+            self.queue_depth.labels(model, str(level)).set(depth)
 
     def pending_inc(self, model: str, count: int = 1) -> None:
         self.pending_requests.labels(model).inc(count)

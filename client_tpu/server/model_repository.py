@@ -59,6 +59,18 @@ class Model:
     # batchable models (the core batcher is always on for them).
     sequence_batching: Optional[Dict[str, Any]] = None
     ensemble_scheduling: Optional[Dict[str, Any]] = None
+    # Admission control (client_tpu.scheduling; the ModelDynamicBatching
+    # priority / ModelQueuePolicy / ModelRateLimiter surface):
+    # priority_levels N declares queue levels 1..N (1 = highest);
+    # requests without a priority parameter land on default_priority_level
+    # (or the lowest level when 0). queue_policy keys: max_queue_size,
+    # default_timeout_us, timeout_action ("reject"|"continue"),
+    # allow_timeout_override. rate_limiter: {"resources": [{"name",
+    # "count"}], "priority"} — executions acquire those pool resources.
+    priority_levels: int = 0
+    default_priority_level: int = 0
+    queue_policy: Optional[Dict[str, Any]] = None
+    rate_limiter: Optional[Dict[str, Any]] = None
 
     def metadata(self) -> Dict[str, Any]:
         return {
@@ -116,7 +128,37 @@ class Model:
             # the way Triton configs do so clients can see the scheduler.
             # Ensembles never declare it (the proto's scheduling_choice is
             # a oneof — both protocols must report the same scheduler).
-            config["dynamic_batching"] = {}
+            dynamic_batching: Dict[str, Any] = {}
+            if self.priority_levels:
+                dynamic_batching["priority_levels"] = self.priority_levels
+                dynamic_batching["default_priority_level"] = (
+                    self.default_priority_level
+                )
+            if self.queue_policy:
+                qp = self.queue_policy
+                # Triton wire names (ModelQueuePolicy)
+                dynamic_batching["default_queue_policy"] = {
+                    "timeout_action": (
+                        "DELAY"
+                        if qp.get("timeout_action") == "continue"
+                        else "REJECT"
+                    ),
+                    "default_timeout_microseconds": int(
+                        qp.get("default_timeout_us", 0)
+                    ),
+                    "allow_timeout_override": bool(
+                        qp.get("allow_timeout_override", True)
+                    ),
+                    "max_queue_size": int(qp.get("max_queue_size", 0)),
+                }
+            config["dynamic_batching"] = dynamic_batching
+        if self.rate_limiter:
+            config["rate_limiter"] = {
+                "resources": [
+                    dict(r) for r in self.rate_limiter.get("resources", [])
+                ],
+                "priority": int(self.rate_limiter.get("priority", 0)),
+            }
         if self.ensemble_scheduling is not None:
             config["ensemble_scheduling"] = {
                 "step": [dict(s) for s in

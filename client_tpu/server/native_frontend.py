@@ -332,7 +332,7 @@ class NativeGrpcFrontend:
         unset — execution context)."""
         if isinstance(e, InferenceServerException):
             message = e.message()
-            status = codec.status_code_for(message)
+            status = codec.status_code_for(message, exc=e)
         else:
             message = str(e)
             status = codec.GRPC_INTERNAL if default is None else default
@@ -446,7 +446,7 @@ class NativeGrpcFrontend:
             raise
         except InferenceServerException as e:
             self._complete_error(
-                handle, e.message(), codec.status_code_for(e.message())
+                handle, e.message(), codec.status_code_for(e.message(), exc=e)
             )
             return
         except Exception as e:  # noqa: BLE001

@@ -163,11 +163,17 @@ def test_plugin_registry():
 
 def test_wheel_builds(tmp_path):
     """Wheel assembly (pure-Python flavor for speed) must succeed and
-    carry the package + entry points."""
+    carry the package + entry points. Skips when the `build` frontend is
+    not installed in the environment (tools/build_wheel.py shells out to
+    `python -m build`); the assertion path is unchanged where it is."""
     import os
     import subprocess
     import sys
     import zipfile
+
+    pytest.importorskip(
+        "build", reason="`python -m build` unavailable in this environment"
+    )
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     out = subprocess.run(

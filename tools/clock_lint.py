@@ -5,9 +5,10 @@ sleeps, milliseconds of wall time); that only works while every clock
 read goes through an injectable ``clock``/``clock_ns`` callable. This
 lint bans *direct calls* to the ``time`` module's clock functions inside
 ``client_tpu/observability/`` (the tracer AND the Prometheus registry in
-``metrics.py``), ``client_tpu/resilience/``, and the clock-injected
-perf-harness modules listed in ``TARGET_FILES`` (the server-metrics
-collector).
+``metrics.py``), ``client_tpu/resilience/``, ``client_tpu/scheduling/``
+(queue deadlines and rate-limiter waits take "now" from the caller), and
+the clock-injected perf-harness modules listed in ``TARGET_FILES`` (the
+server-metrics collector).
 
 References are fine — ``clock: Callable = time.monotonic`` as a default
 parameter is exactly the injectable pattern — only Call nodes are
@@ -23,6 +24,7 @@ from typing import List, Tuple
 TARGET_DIRS = (
     os.path.join("client_tpu", "observability"),
     os.path.join("client_tpu", "resilience"),
+    os.path.join("client_tpu", "scheduling"),
 )
 
 # clock-injected modules outside the blanket-linted packages
