@@ -27,6 +27,7 @@ from client_tpu.grpc._utils import (
     is_sequence_request as _is_sequence_request,
     rpc_error_to_exception,
 )
+from client_tpu.lifecycle import EndpointPool, status_is_unavailable
 from client_tpu.observability.trace import (
     NOOP_TRACE,
     TRACEPARENT_HEADER,
@@ -103,7 +104,7 @@ class InferenceServerClient(InferenceServerClientBase):
 
     def __init__(
         self,
-        url: str,
+        url=None,
         verbose: bool = False,
         ssl: bool = False,
         root_certificates: Optional[str] = None,
@@ -115,9 +116,27 @@ class InferenceServerClient(InferenceServerClientBase):
         retry_policy: Optional[RetryPolicy] = None,
         circuit_breaker: Optional[CircuitBreaker] = None,
         tracer: Optional[Tracer] = None,
+        urls=None,
+        endpoint_cooldown_s: float = 1.0,
     ):
+        """``url`` may be a single ``host:port``, a comma list, or an
+        :class:`~client_tpu.lifecycle.EndpointPool`; ``urls=[...]`` names
+        replica endpoints. One channel per endpoint (created lazily);
+        unary RPCs target a sticky primary and fail over — immediately,
+        no backoff sleep — when an endpoint answers UNAVAILABLE or the
+        connection dies; recovering endpoints must pass a ``ServerReady``
+        probe first. Streams bind to the endpoint current at open."""
         super().__init__()
         self._verbose = verbose
+        self._pool = EndpointPool.resolve(
+            url, urls, cooldown_s=endpoint_cooldown_s
+        )
+        if self._pool.size > 1 and retry_policy is None:
+            retry_policy = RetryPolicy(
+                max_attempts=2 * self._pool.size,
+                initial_backoff_s=0.02,
+                max_backoff_s=0.5,
+            )
         self._retry_policy = retry_policy
         self._circuit_breaker = circuit_breaker
         self._tracer = tracer
@@ -145,8 +164,9 @@ class InferenceServerClient(InferenceServerClientBase):
                         keepalive_options.http2_max_pings_without_data,
                     ),
                 ]
+        self._channel_options = options
         if creds is not None:
-            self._channel = grpc.secure_channel(url, creds, options=options)
+            self._credentials: Optional[grpc.ChannelCredentials] = creds
         elif ssl:
 
             def _read(path):
@@ -155,16 +175,68 @@ class InferenceServerClient(InferenceServerClientBase):
                 with open(path, "rb") as f:
                     return f.read()
 
-            credentials = grpc.ssl_channel_credentials(
+            self._credentials = grpc.ssl_channel_credentials(
                 root_certificates=_read(root_certificates),
                 private_key=_read(private_key),
                 certificate_chain=_read(certificate_chain),
             )
-            self._channel = grpc.secure_channel(url, credentials, options=options)
         else:
-            self._channel = grpc.insecure_channel(url, options=options)
-        self._client_stub = GRPCInferenceServiceStub(self._channel)
+            self._credentials = None
+        self._channels: Dict[str, grpc.Channel] = {}
+        self._stubs: Dict[str, GRPCInferenceServiceStub] = {}
+        # primary-bound aliases (streams and subclasses use them)
+        self._channel = self._channel_for(self._pool.urls[0])
+        self._client_stub = self._stub_for(self._pool.urls[0])
         self._stream: Optional[InferStream] = None
+
+    def _channel_for(self, url: str) -> grpc.Channel:
+        channel = self._channels.get(url)
+        if channel is None:
+            if self._credentials is not None:
+                channel = grpc.secure_channel(
+                    url, self._credentials, options=self._channel_options
+                )
+            else:
+                channel = grpc.insecure_channel(
+                    url, options=self._channel_options
+                )
+            self._channels[url] = channel
+        return channel
+
+    def _stub_for(self, url: str) -> GRPCInferenceServiceStub:
+        stub = self._stubs.get(url)
+        if stub is None:
+            stub = GRPCInferenceServiceStub(self._channel_for(url))
+            self._stubs[url] = stub
+        return stub
+
+    def _probe_endpoint(self, endpoint, timeout: float = 1.0) -> bool:
+        """ServerReady against a specific endpoint (the gRPC face of the
+        /v2/health/ready check the pool demands of recovering members)."""
+        try:
+            response = self._stub_for(endpoint.url).ServerReady(
+                service_pb2.ServerReadyRequest(), timeout=timeout
+            )
+            return bool(response.ready)
+        except grpc.RpcError:
+            return False
+
+    def _pick_endpoint(self, budget_s: Optional[float] = None):
+        """Pool choice for the next attempt; recovering endpoints pass a
+        ServerReady probe first, budgeted against the attempt timeout."""
+        pool = self._pool
+        probe_timeout = 1.0
+        if budget_s:
+            probe_timeout = min(1.0, max(0.05, budget_s / pool.size))
+        for _ in range(pool.size):
+            endpoint = pool.pick()
+            if not pool.needs_probe(endpoint):
+                return endpoint
+            if self._probe_endpoint(endpoint, timeout=probe_timeout):
+                pool.mark_up(endpoint)
+                return endpoint
+            pool.mark_down(endpoint)
+        return pool.pick()
 
     # -- plumbing -----------------------------------------------------------
 
@@ -198,21 +270,39 @@ class InferenceServerClient(InferenceServerClientBase):
             print(f"gRPC {name}: {{{str(request)[:200]}}}")
         metadata = self._metadata(headers)
         compression = _grpc_compression(compression_algorithm)
-        method = getattr(self._client_stub, name)
+        if probe:
+            try:
+                return getattr(self._stub_for(self._pool.pick().url), name)(
+                    request,
+                    metadata=metadata,
+                    timeout=client_timeout,
+                    compression=compression,
+                )
+            except grpc.RpcError as e:
+                raise rpc_error_to_exception(e) from None
+        pool = self._pool
 
         def _send(attempt_timeout):
+            endpoint = self._pick_endpoint(attempt_timeout)
             try:
-                return method(
+                value = getattr(self._stub_for(endpoint.url), name)(
                     request,
                     metadata=metadata,
                     timeout=attempt_timeout,
                     compression=compression,
                 )
             except grpc.RpcError as e:
-                raise rpc_error_to_exception(e) from None
+                exc = rpc_error_to_exception(e)
+                if status_is_unavailable(exc.status()):
+                    # draining/dead endpoint: bench it; with an
+                    # alternative, skip the backoff and fail over NOW
+                    pool.observe(endpoint, token=exc.status())
+                    if pool.has_alternative(endpoint):
+                        exc.retry_backoff_cap_s = 0.0
+                raise exc from None
+            pool.observe(endpoint, ok=True)
+            return value
 
-        if probe:
-            return _send(client_timeout)
         return run_with_resilience(
             trace.wrap_attempt(_send),
             retry_policy=self._retry_policy,
@@ -223,9 +313,10 @@ class InferenceServerClient(InferenceServerClientBase):
         )
 
     def close(self) -> None:
-        """Close the channel (stops any active stream first)."""
+        """Close every endpoint channel (stops any active stream first)."""
         self.stop_stream()
-        self._channel.close()
+        for channel in self._channels.values():
+            channel.close()
 
     def __enter__(self) -> "InferenceServerClient":
         return self
@@ -721,7 +812,9 @@ class InferenceServerClient(InferenceServerClientBase):
             )
             if self._verbose:
                 print(f"gRPC async ModelInfer: {{{str(request)[:200]}}}")
-            future = self._client_stub.ModelInfer.future(
+            future = self._stub_for(
+                self._pick_endpoint().url
+            ).ModelInfer.future(
                 request,
                 metadata=self._metadata(headers),
                 timeout=client_timeout,
@@ -787,7 +880,10 @@ class InferenceServerClient(InferenceServerClientBase):
         compression = _grpc_compression(compression_algorithm)
 
         def _open(request_iterator, timeout=stream_timeout):
-            return self._client_stub.ModelStreamInfer(
+            # bound to the pool's CURRENT endpoint at each (re)open, so a
+            # reconnect after UNAVAILABLE also fails over to a healthy
+            # replica instead of re-dialing the dead one
+            return self._stub_for(self._pool.pick().url).ModelStreamInfer(
                 request_iterator,
                 metadata=metadata,
                 timeout=timeout,

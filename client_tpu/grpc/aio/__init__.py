@@ -30,6 +30,7 @@ from client_tpu.grpc._utils import (
     is_sequence_request as _is_sequence_request,
     rpc_error_to_exception,
 )
+from client_tpu.lifecycle import EndpointPool, status_is_unavailable
 from client_tpu.observability.trace import (
     NOOP_TRACE,
     TRACEPARENT_HEADER,
@@ -58,7 +59,7 @@ class InferenceServerClient(InferenceServerClientBase):
 
     def __init__(
         self,
-        url: str,
+        url=None,
         verbose: bool = False,
         ssl: bool = False,
         root_certificates: Optional[str] = None,
@@ -70,9 +71,28 @@ class InferenceServerClient(InferenceServerClientBase):
         retry_policy: Optional[RetryPolicy] = None,
         circuit_breaker: Optional[CircuitBreaker] = None,
         tracer: Optional[Tracer] = None,
+        urls=None,
+        endpoint_cooldown_s: float = 1.0,
     ):
+        """``url`` may be a single ``host:port``, a comma list, or an
+        :class:`~client_tpu.lifecycle.EndpointPool`; ``urls=[...]`` names
+        replica endpoints. One channel per endpoint (created lazily);
+        unary RPCs target a sticky primary and fail over — immediately,
+        no backoff sleep — when an endpoint answers UNAVAILABLE or the
+        connection dies; recovering endpoints must pass a ``ServerReady``
+        probe first. ``stream_infer`` binds to the endpoint current at
+        stream open."""
         super().__init__()
         self._verbose = verbose
+        self._pool = EndpointPool.resolve(
+            url, urls, cooldown_s=endpoint_cooldown_s
+        )
+        if self._pool.size > 1 and retry_policy is None:
+            retry_policy = RetryPolicy(
+                max_attempts=2 * self._pool.size,
+                initial_backoff_s=0.02,
+                max_backoff_s=0.5,
+            )
         self._retry_policy = retry_policy
         self._circuit_breaker = circuit_breaker
         self._tracer = tracer
@@ -100,8 +120,9 @@ class InferenceServerClient(InferenceServerClientBase):
                         keepalive_options.http2_max_pings_without_data,
                     ),
                 ]
+        self._channel_options = options
         if creds is not None:
-            self._channel = grpc.aio.secure_channel(url, creds, options=options)
+            self._credentials: Optional[grpc.ChannelCredentials] = creds
         elif ssl:
 
             def _read(path):
@@ -110,17 +131,67 @@ class InferenceServerClient(InferenceServerClientBase):
                 with open(path, "rb") as f:
                     return f.read()
 
-            credentials = grpc.ssl_channel_credentials(
+            self._credentials = grpc.ssl_channel_credentials(
                 root_certificates=_read(root_certificates),
                 private_key=_read(private_key),
                 certificate_chain=_read(certificate_chain),
             )
-            self._channel = grpc.aio.secure_channel(
-                url, credentials, options=options
-            )
         else:
-            self._channel = grpc.aio.insecure_channel(url, options=options)
-        self._client_stub = GRPCInferenceServiceStub(self._channel)
+            self._credentials = None
+        self._channels: Dict[str, grpc.aio.Channel] = {}
+        self._stubs: Dict[str, GRPCInferenceServiceStub] = {}
+        # primary-bound aliases (stream_infer uses them)
+        self._channel = self._channel_for(self._pool.urls[0])
+        self._client_stub = self._stub_for(self._pool.urls[0])
+
+    def _channel_for(self, url: str) -> "grpc.aio.Channel":
+        channel = self._channels.get(url)
+        if channel is None:
+            if self._credentials is not None:
+                channel = grpc.aio.secure_channel(
+                    url, self._credentials, options=self._channel_options
+                )
+            else:
+                channel = grpc.aio.insecure_channel(
+                    url, options=self._channel_options
+                )
+            self._channels[url] = channel
+        return channel
+
+    def _stub_for(self, url: str) -> GRPCInferenceServiceStub:
+        stub = self._stubs.get(url)
+        if stub is None:
+            stub = GRPCInferenceServiceStub(self._channel_for(url))
+            self._stubs[url] = stub
+        return stub
+
+    async def _probe_endpoint(self, endpoint, timeout: float = 1.0) -> bool:
+        """ServerReady against a specific endpoint (the gRPC face of the
+        /v2/health/ready check the pool demands of recovering members)."""
+        try:
+            response = await self._stub_for(endpoint.url).ServerReady(
+                service_pb2.ServerReadyRequest(), timeout=timeout
+            )
+            return bool(response.ready)
+        except grpc.RpcError:
+            return False
+
+    async def _pick_endpoint(self, budget_s: Optional[float] = None):
+        """Pool choice for the next attempt; recovering endpoints pass a
+        ServerReady probe first, budgeted against the attempt timeout."""
+        pool = self._pool
+        probe_timeout = 1.0
+        if budget_s:
+            probe_timeout = min(1.0, max(0.05, budget_s / pool.size))
+        for _ in range(pool.size):
+            endpoint = pool.pick()
+            if not pool.needs_probe(endpoint):
+                return endpoint
+            if await self._probe_endpoint(endpoint, timeout=probe_timeout):
+                pool.mark_up(endpoint)
+                return endpoint
+            pool.mark_down(endpoint)
+        return pool.pick()
 
     def _metadata(self, headers: Optional[Dict[str, str]]):
         request = Request(headers or {})
@@ -148,21 +219,41 @@ class InferenceServerClient(InferenceServerClientBase):
         ``trace`` records one "request" span per attempt.
         """
         metadata = self._metadata(headers)
-        method = getattr(self._client_stub, name)
+        if probe:
+            try:
+                return await getattr(
+                    self._stub_for(self._pool.pick().url), name
+                )(
+                    request,
+                    metadata=metadata,
+                    timeout=client_timeout,
+                    compression=compression,
+                )
+            except grpc.RpcError as e:
+                raise rpc_error_to_exception(e) from None
+        pool = self._pool
 
         async def _send(attempt_timeout):
+            endpoint = await self._pick_endpoint(attempt_timeout)
             try:
-                return await method(
+                value = await getattr(self._stub_for(endpoint.url), name)(
                     request,
                     metadata=metadata,
                     timeout=attempt_timeout,
                     compression=compression,
                 )
             except grpc.RpcError as e:
-                raise rpc_error_to_exception(e) from None
+                exc = rpc_error_to_exception(e)
+                if status_is_unavailable(exc.status()):
+                    # draining/dead endpoint: bench it; with an
+                    # alternative, skip the backoff and fail over NOW
+                    pool.observe(endpoint, token=exc.status())
+                    if pool.has_alternative(endpoint):
+                        exc.retry_backoff_cap_s = 0.0
+                raise exc from None
+            pool.observe(endpoint, ok=True)
+            return value
 
-        if probe:
-            return await _send(client_timeout)
         return await run_with_resilience_async(
             trace.wrap_attempt_async(_send),
             retry_policy=self._retry_policy,
@@ -173,7 +264,8 @@ class InferenceServerClient(InferenceServerClientBase):
         )
 
     async def close(self) -> None:
-        await self._channel.close()
+        for channel in self._channels.values():
+            await channel.close()
 
     async def __aenter__(self) -> "InferenceServerClient":
         return self
@@ -557,7 +649,9 @@ class InferenceServerClient(InferenceServerClientBase):
                     ].bool_param = True
                 yield request
 
-        call = self._client_stub.ModelStreamInfer(
+        # bound to the pool's current endpoint at open (draining/dead
+        # endpoints are routed around; the stream then stays on it)
+        call = self._stub_for(self._pool.pick().url).ModelStreamInfer(
             _request_iterator(),
             metadata=self._metadata(headers),
             timeout=stream_timeout,

@@ -122,7 +122,7 @@ class InferenceServerClient:
 
     def __init__(
         self,
-        url: str,
+        url=None,
         verbose: bool = False,
         concurrency: int = 16,
         connection_timeout: float = 60.0,
@@ -132,7 +132,13 @@ class InferenceServerClient:
         retry_policy=None,
         circuit_breaker=None,
         tracer=None,
+        urls=None,
+        endpoint_cooldown_s: float = 1.0,
     ):
+        """``url`` may be a single ``host:port``, a comma list, or an
+        :class:`~client_tpu.lifecycle.EndpointPool`; ``urls=[...]`` names
+        replica endpoints for health-checked failover (see the aio
+        client's docs — this veneer passes both straight through)."""
         self._runner = EventLoopRunner(name=f"client-tpu-http[{url}]")
         self._aio_client = _aio.InferenceServerClient(
             url,
@@ -145,6 +151,8 @@ class InferenceServerClient:
             retry_policy=retry_policy,
             circuit_breaker=circuit_breaker,
             tracer=tracer,
+            urls=urls,
+            endpoint_cooldown_s=endpoint_cooldown_s,
         )
 
     # plugin registry delegates to the aio client so headers flow through it

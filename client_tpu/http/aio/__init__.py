@@ -32,6 +32,7 @@ from client_tpu.http._utils import (
     raise_if_error,
     retry_after_seconds,
 )
+from client_tpu.lifecycle import EndpointPool, status_is_unavailable
 from client_tpu.observability.trace import (
     NOOP_TRACE,
     TRACEPARENT_HEADER,
@@ -56,7 +57,20 @@ class InferenceServerClient(InferenceServerClientBase):
     Parameters
     ----------
     url:
-        Host:port of the server, e.g. ``"localhost:8000"``.
+        Host:port of the server, e.g. ``"localhost:8000"``. May also be
+        a comma-separated list of endpoints or an
+        :class:`~client_tpu.lifecycle.EndpointPool` (see ``urls``).
+    urls:
+        Optional list of equivalent endpoints (replicas behind no load
+        balancer). Requests target a sticky primary; endpoints that
+        return 503 / connection errors (draining or dead servers) are
+        benched for ``endpoint_cooldown_s`` (or their ``Retry-After``
+        hint) and traffic fails over to the next healthy endpoint —
+        immediately, skipping the retry backoff. Recovering endpoints
+        must pass a ``/v2/health/ready`` probe before carrying real
+        traffic again. With more than one endpoint and no explicit
+        ``retry_policy``, a small failover retry policy is installed so
+        idempotent requests actually reroute instead of failing.
     verbose:
         Print request/response traffic.
     concurrency:
@@ -86,7 +100,7 @@ class InferenceServerClient(InferenceServerClientBase):
 
     def __init__(
         self,
-        url: str,
+        url=None,
         verbose: bool = False,
         concurrency: int = 16,
         connection_timeout: float = 60.0,
@@ -96,14 +110,29 @@ class InferenceServerClient(InferenceServerClientBase):
         retry_policy: Optional[RetryPolicy] = None,
         circuit_breaker: Optional[CircuitBreaker] = None,
         tracer: Optional[Tracer] = None,
+        urls=None,
+        endpoint_cooldown_s: float = 1.0,
     ):
         super().__init__()
         scheme = "https" if ssl else "http"
-        if "://" in url:
-            raise InferenceServerException(
-                f"url should not include the scheme: '{url}'"
+        self._pool = EndpointPool.resolve(
+            url, urls, cooldown_s=endpoint_cooldown_s
+        )
+        for endpoint_url in self._pool.urls:
+            if "://" in endpoint_url:
+                raise InferenceServerException(
+                    f"url should not include the scheme: '{endpoint_url}'"
+                )
+        self._scheme = scheme
+        if self._pool.size > 1 and retry_policy is None:
+            # Failover needs attempts to spend: give multi-endpoint
+            # clients a small retry budget (the backoff is skipped
+            # entirely when another endpoint is available).
+            retry_policy = RetryPolicy(
+                max_attempts=2 * self._pool.size,
+                initial_backoff_s=0.02,
+                max_backoff_s=0.5,
             )
-        self._base_url = f"{scheme}://{url}"
         self._verbose = verbose
         self._ssl_context = ssl_context
         self._timeout = aiohttp.ClientTimeout(
@@ -188,6 +217,44 @@ class InferenceServerClient(InferenceServerClientBase):
                 status=CONNECTION_ERROR_STATUS,
             ) from e
 
+    def _endpoint_base(self, endpoint) -> str:
+        return f"{self._scheme}://{endpoint.url}"
+
+    async def _probe_endpoint(self, endpoint, timeout: float = 1.0) -> bool:
+        """One /v2/health/ready probe against a specific endpoint (used
+        before trusting a recovering pool member with real traffic)."""
+        try:
+            status, _, _ = await self._request_once(
+                "GET",
+                f"{self._endpoint_base(endpoint)}/v2/health/ready",
+                None,
+                {},
+                timeout,
+            )
+        except InferenceServerException:
+            return False
+        return status == 200
+
+    async def _pick_endpoint(self, budget_s: Optional[float] = None):
+        """The pool's choice for the next attempt; endpoints coming back
+        from a down period must pass a readiness probe first (a draining
+        server answers its health endpoint long before it serves).
+        Probes are budgeted against ``budget_s`` (the remaining attempt
+        timeout) so they can never blow the caller's deadline."""
+        pool = self._pool
+        probe_timeout = 1.0
+        if budget_s:
+            probe_timeout = min(1.0, max(0.05, budget_s / pool.size))
+        for _ in range(pool.size):
+            endpoint = pool.pick()
+            if not pool.needs_probe(endpoint):
+                return endpoint
+            if await self._probe_endpoint(endpoint, timeout=probe_timeout):
+                pool.mark_up(endpoint)
+                return endpoint
+            pool.mark_down(endpoint)
+        return pool.pick()
+
     async def _execute(
         self,
         method,
@@ -200,32 +267,68 @@ class InferenceServerClient(InferenceServerClientBase):
         probe=False,
         trace=NOOP_TRACE,
     ) -> tuple:
-        url = f"{self._base_url}/{path}{build_query_string(query_params)}"
-        if self._verbose:
-            size = f" ({len(data)} bytes)" if data else ""
-            print(f"{method} {url}{size}")
+        suffix = f"/{path}{build_query_string(query_params)}"
         prepared_headers = self._prepare_headers(headers)
         if probe:
             # liveness/readiness probes report CURRENT state: retrying
             # one would invert its purpose, and its failures while a
             # server restarts must not poison a shared circuit breaker
+            url = self._endpoint_base(self._pool.pick()) + suffix
             return await self._request_once(
                 method, url, data, prepared_headers, timeout
             )
+        pool = self._pool
+
+        async def _attempt(attempt_timeout):
+            endpoint = await self._pick_endpoint(attempt_timeout)
+            url = self._endpoint_base(endpoint) + suffix
+            if self._verbose:
+                size = f" ({len(data)} bytes)" if data else ""
+                print(f"{method} {url}{size}")
+            try:
+                result = await self._request_once(
+                    method, url, data, prepared_headers, attempt_timeout,
+                    trace=trace,
+                )
+            except InferenceServerException as e:
+                if e.status() == CONNECTION_ERROR_STATUS:
+                    # dead endpoint: bench it; with an alternative
+                    # available the retry loop skips the backoff sleep
+                    pool.observe(endpoint, token=CONNECTION_ERROR_STATUS)
+                    if pool.has_alternative(endpoint):
+                        e.retry_backoff_cap_s = 0.0
+                raise
+            token = str(result[0])
+            if status_is_unavailable(token):
+                # draining server: bench it for its own Retry-After hint
+                pool.observe(
+                    endpoint,
+                    token=token,
+                    retry_after_s=retry_after_seconds(result[2]),
+                )
+            else:
+                pool.observe(endpoint, ok=True)
+            return result
+
         status, rbody, rheaders = await run_with_resilience_async(
-            lambda attempt_timeout: self._request_once(
-                method, url, data, prepared_headers, attempt_timeout,
-                trace=trace,
-            ),
+            _attempt,
             retry_policy=self._retry_policy,
             circuit_breaker=self._circuit_breaker,
             budget_s=timeout or None,
             idempotent=idempotent,
             result_status=lambda value: str(value[0]),
-            description=f"{method} {url}",
+            description=f"{method} {suffix.lstrip('/')}",
             # a 429 shed response's Retry-After is the server's own
             # backoff estimate — honored as the retry floor
             result_backoff_hint=lambda value: retry_after_seconds(value[2]),
+            # ...unless the failure is endpoint-scoped (503/UNAVAILABLE)
+            # and the pool has somewhere else to go: fail over NOW
+            result_backoff_cap=lambda value: (
+                0.0
+                if status_is_unavailable(str(value[0]))
+                and pool.has_alternative(None)
+                else None
+            ),
         )
         if self._verbose:
             print(f"-> {status} ({len(rbody)} bytes)")

@@ -1,0 +1,59 @@
+"""Graceful lifecycle: drain-aware shutdown and client endpoint failover.
+
+The robustness layer for the boring disasters — deploys, model reloads,
+instance restarts — so a rolling restart under load drops ~zero requests:
+
+Server side
+-----------
+:class:`DrainController`
+    Explicit SERVING -> DRAINING -> STOPPED states with an in-flight
+    census over all four ServerCore execution paths. Draining flips
+    readiness false (liveness stays true, so load balancers drain),
+    rejects new inferences with 503 + ``Retry-After`` / gRPC
+    ``UNAVAILABLE``, and lets in-flight and queued work finish up to a
+    drain deadline before anything is cancelled.
+:class:`ServerDrainingError`
+    The clean rejection both front-ends map without message parsing.
+
+Client side
+-----------
+:class:`EndpointPool`
+    Accepted everywhere a ``url`` is today (``urls=[...]`` or an explicit
+    pool): sticky-primary routing that health-checks recovering endpoints
+    via ``/v2/health/ready`` (gRPC ``ServerReady``), benches draining or
+    dead endpoints, integrates per-endpoint
+    :class:`~client_tpu.resilience.CircuitBreaker` instances, and fails
+    over mid-retry-loop — immediately, skipping the backoff sleep — when
+    another endpoint is available.
+
+Everything here is clock-injectable (enforced by ``tools/clock_lint.py``)
+so the lifecycle test suite runs on fake clocks.
+"""
+
+from client_tpu.lifecycle.drain import (
+    DRAINING,
+    SERVING,
+    STATE_VALUES,
+    STOPPED,
+    DrainController,
+    ServerDrainingError,
+)
+from client_tpu.lifecycle.pool import (
+    UNAVAILABLE_TOKENS,
+    Endpoint,
+    EndpointPool,
+    status_is_unavailable,
+)
+
+__all__ = [
+    "DRAINING",
+    "SERVING",
+    "STATE_VALUES",
+    "STOPPED",
+    "UNAVAILABLE_TOKENS",
+    "DrainController",
+    "Endpoint",
+    "EndpointPool",
+    "ServerDrainingError",
+    "status_is_unavailable",
+]

@@ -1,0 +1,236 @@
+"""Client-side endpoint pool: health-aware routing + failover state.
+
+Every client surface accepts an :class:`EndpointPool` (or ``urls=[...]``)
+wherever a single ``url`` is accepted today. The pool is pure state — it
+owns no sockets and issues no probes itself (the owning client probes
+``/v2/health/ready`` / gRPC ``ServerReady`` when the pool says a
+recovering endpoint :meth:`needs_probe`), so one implementation serves
+all four surfaces and tests drive it with a fake clock
+(``tools/clock_lint.py`` covers this package).
+
+Routing is sticky-primary with failover: :meth:`pick` returns the current
+primary until a request against it fails with an unavailability signal
+(connect error, HTTP 503, gRPC UNAVAILABLE — a draining or dead server),
+at which point the endpoint is marked down for ``cooldown_s`` (or the
+server's own ``Retry-After`` hint) and the primary advances. Per-endpoint
+:class:`~client_tpu.resilience.CircuitBreaker` instances (optional) are
+consulted by :meth:`pick` and fed by :meth:`observe`, so a flapping
+endpoint fails fast instead of eating a timeout per attempt.
+"""
+
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Union
+
+from client_tpu.resilience import CONNECTION_ERROR_STATUS
+
+# Status tokens that mean "this endpoint cannot serve right now" — route
+# around it. 503 / UNAVAILABLE are what a draining server returns; a
+# connection error is what a dead one produces.
+UNAVAILABLE_TOKENS = frozenset({"503", "UNAVAILABLE", CONNECTION_ERROR_STATUS})
+
+
+def status_is_unavailable(token: Optional[str]) -> bool:
+    """True when a status token ("503", "StatusCode.UNAVAILABLE",
+    "CONNECTION_ERROR") signals an endpoint-level outage."""
+    if not token:
+        return False
+    return token.rsplit(".", 1)[-1] in UNAVAILABLE_TOKENS
+
+
+class Endpoint:
+    """One pool member's health state."""
+
+    __slots__ = (
+        "url",
+        "circuit_breaker",
+        "down_until",
+        "was_down",
+        "failures",
+        "successes",
+    )
+
+    def __init__(self, url: str, circuit_breaker=None):
+        self.url = url
+        self.circuit_breaker = circuit_breaker
+        self.down_until = 0.0
+        # once an endpoint has been marked down, its first use after the
+        # cooldown should be a readiness probe, not a real request
+        self.was_down = False
+        self.failures = 0
+        self.successes = 0
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"Endpoint({self.url!r}, down_until={self.down_until})"
+
+
+class EndpointPool:
+    """Health-aware endpoint selection shared by the client surfaces.
+
+    Parameters
+    ----------
+    urls:
+        Endpoint addresses (``host:port``). A single comma-separated
+        string is accepted (the perf CLI's ``-u host1:p1,host2:p2``).
+    cooldown_s:
+        How long a failed endpoint stays out of rotation before it is
+        probed again (a server's ``Retry-After`` hint overrides this per
+        incident).
+    breaker_factory:
+        Optional zero-arg callable returning a per-endpoint
+        :class:`~client_tpu.resilience.CircuitBreaker`; when set,
+        :meth:`pick` skips endpoints whose breaker is open and
+        :meth:`observe` feeds each endpoint's breaker.
+    clock:
+        Injectable monotonic-seconds clock (fake-clock tests).
+    """
+
+    def __init__(
+        self,
+        urls: Union[str, Sequence[str]],
+        cooldown_s: float = 1.0,
+        breaker_factory: Optional[Callable[[], object]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if isinstance(urls, str):
+            urls = [u.strip() for u in urls.split(",") if u.strip()]
+        urls = list(urls)
+        if not urls:
+            raise ValueError("EndpointPool needs at least one url")
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._endpoints: List[Endpoint] = [
+            Endpoint(u, breaker_factory() if breaker_factory else None)
+            for u in urls
+        ]
+        self._primary = 0
+        # times the primary moved off a failed endpoint (observability)
+        self.failovers = 0
+
+    @classmethod
+    def resolve(
+        cls,
+        url: Optional[Union[str, "EndpointPool"]] = None,
+        urls: Optional[Sequence[str]] = None,
+        **kwargs,
+    ) -> "EndpointPool":
+        """The one spot every client constructor funnels through:
+        ``url`` may be a host:port, a comma list, or an EndpointPool
+        instance (returned as-is — shareable across clients); ``urls``
+        wins when given."""
+        if isinstance(url, EndpointPool):
+            return url
+        if urls:
+            return cls(urls, **kwargs)
+        if url is None:
+            raise ValueError("either url or urls is required")
+        return cls(url, **kwargs)
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def size(self) -> int:
+        return len(self._endpoints)
+
+    @property
+    def urls(self) -> List[str]:
+        return [ep.url for ep in self._endpoints]
+
+    @property
+    def endpoints(self) -> List[Endpoint]:
+        return list(self._endpoints)
+
+    @property
+    def primary_url(self) -> str:
+        with self._lock:
+            return self._endpoints[self._primary].url
+
+    def _up(self, ep: Endpoint, now: float) -> bool:
+        if ep.down_until and now < ep.down_until:
+            return False
+        if ep.circuit_breaker is not None and not ep.circuit_breaker.allow():
+            return False
+        return True
+
+    # -- selection -----------------------------------------------------------
+
+    def pick(self) -> Endpoint:
+        """The endpoint the next request should target: the sticky
+        primary when healthy, else the next healthy endpoint in rotation.
+        When every endpoint is down, returns the one whose cooldown ends
+        soonest — callers still try it (the server may be back early)."""
+        with self._lock:
+            now = self._clock()
+            n = len(self._endpoints)
+            for offset in range(n):
+                ep = self._endpoints[(self._primary + offset) % n]
+                if self._up(ep, now):
+                    return ep
+            return min(self._endpoints, key=lambda e: e.down_until)
+
+    def has_alternative(self, ep: Optional[Endpoint]) -> bool:
+        """True when a request that just failed on ``ep`` (None: on
+        whichever endpoint was benched for it) has somewhere else to go
+        RIGHT NOW — the failover fast path (no backoff sleep)."""
+        with self._lock:
+            now = self._clock()
+            return any(
+                other is not ep and self._up(other, now)
+                for other in self._endpoints
+            )
+
+    def needs_probe(self, ep: Endpoint) -> bool:
+        """True when ``ep`` is coming back from a down period and should
+        pass a readiness probe before carrying real traffic. Single-
+        endpoint pools never probe — there is no alternative to protect."""
+        if len(self._endpoints) == 1:
+            return False
+        with self._lock:
+            return ep.was_down and self._clock() >= ep.down_until
+
+    # -- health feedback -----------------------------------------------------
+
+    def mark_down(
+        self, ep: Endpoint, cooldown_s: Optional[float] = None
+    ) -> None:
+        """Take ``ep`` out of rotation for a cooldown and advance the
+        primary off it."""
+        with self._lock:
+            ep.down_until = self._clock() + (
+                cooldown_s if cooldown_s else self.cooldown_s
+            )
+            ep.was_down = True
+            ep.failures += 1
+            n = len(self._endpoints)
+            if n > 1 and self._endpoints[self._primary] is ep:
+                self._primary = (self._primary + 1) % n
+                self.failovers += 1
+
+    def mark_up(self, ep: Endpoint) -> None:
+        with self._lock:
+            ep.down_until = 0.0
+            ep.was_down = False
+
+    def observe(
+        self,
+        ep: Endpoint,
+        ok: bool = False,
+        token: Optional[str] = None,
+        retry_after_s: Optional[float] = None,
+    ) -> None:
+        """Feed one request outcome: success re-arms the endpoint, an
+        unavailability token benches it for ``retry_after_s`` (the
+        server's own estimate — a draining server knows its restart time
+        better than our default) or ``cooldown_s``. Other tokens (4xx,
+        model errors) say nothing about endpoint health."""
+        if ok:
+            self.mark_up(ep)
+            ep.successes += 1
+            if ep.circuit_breaker is not None:
+                ep.circuit_breaker.record_success()
+            return
+        if status_is_unavailable(token):
+            self.mark_down(ep, cooldown_s=retry_after_s)
+            if ep.circuit_breaker is not None:
+                ep.circuit_breaker.record_failure()

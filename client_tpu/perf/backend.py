@@ -116,6 +116,20 @@ class PerfBackend:
     async def get_inference_statistics(self, model_name: str = "") -> Dict:
         return {}
 
+    # -- repository control (rolling-restart chaos uses these) ---------------
+
+    async def unload_model(self, model_name: str) -> None:
+        raise InferenceServerException(
+            f"model repository control not supported by the "
+            f"'{self.kind}' backend"
+        )
+
+    async def load_model(self, model_name: str) -> None:
+        raise InferenceServerException(
+            f"model repository control not supported by the "
+            f"'{self.kind}' backend"
+        )
+
     # -- shared-memory data plane (reference client_backend.h:433-485) ------
 
     async def register_system_shared_memory(
@@ -220,6 +234,12 @@ class HttpPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
 
     async def get_inference_statistics(self, model_name=""):
         return await self._client.get_inference_statistics(model_name)
+
+    async def unload_model(self, model_name):
+        await self._client.unload_model(model_name)
+
+    async def load_model(self, model_name):
+        await self._client.load_model(model_name)
 
     def _build_inputs(self, inputs):
         return [_build_client_input(self._mod, t) for t in inputs]
@@ -328,6 +348,12 @@ class GrpcPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
         return await self._client.get_inference_statistics(
             model_name, as_json=True
         )
+
+    async def unload_model(self, model_name):
+        await self._client.unload_model(model_name)
+
+    async def load_model(self, model_name):
+        await self._client.load_model(model_name)
 
     def _build_inputs(self, inputs):
         return [_build_client_input(self._mod, t) for t in inputs]
@@ -489,6 +515,13 @@ class LocalPerfBackend(PerfBackend):
     async def get_inference_statistics(self, model_name=""):
         return self._core.statistics(model_name)
 
+    async def unload_model(self, model_name):
+        # drain-aware: through the core, not the bare repository
+        self._core.unload_model(model_name)
+
+    async def load_model(self, model_name):
+        self._core.repository.load(model_name)
+
     async def infer(
         self,
         model_name,
@@ -569,6 +602,12 @@ class MockPerfBackend(PerfBackend):
 
     async def get_model_metadata(self, model_name, model_version=""):
         return dict(self._metadata, name=model_name)
+
+    async def unload_model(self, model_name):
+        self.unload_count = getattr(self, "unload_count", 0) + 1
+
+    async def load_model(self, model_name):
+        self.load_count = getattr(self, "load_count", 0) + 1
 
     async def get_model_config(self, model_name, model_version=""):
         return {

@@ -35,7 +35,12 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("-m", "--model-name", required=True)
     parser.add_argument("-x", "--model-version", default="")
     parser.add_argument(
-        "-u", "--url", default="localhost:8000", help="server host:port"
+        "-u",
+        "--url",
+        default="localhost:8000",
+        help="server host:port; a comma list (host1:p1,host2:p2) names "
+        "replica endpoints — the kserve clients then health-check and "
+        "fail over between them (client_tpu.lifecycle.EndpointPool)",
     )
     parser.add_argument(
         "-i",
@@ -203,6 +208,24 @@ def build_parser() -> argparse.ArgumentParser:
         "KServe 'timeout' parameter); timed-out requests fail with a "
         "deadline error before execution",
     )
+    def _positive_period(value: str) -> float:
+        period = float(value)
+        if period <= 0:
+            raise argparse.ArgumentTypeError(
+                f"--rolling-restart must be > 0 seconds, got {period}"
+            )
+        return period
+
+    parser.add_argument(
+        "--rolling-restart",
+        type=_positive_period,
+        default=None,
+        metavar="PERIOD_S",
+        help="chaos scenario: every PERIOD_S seconds cycle the model "
+        "through unload -> load on the server (a drain-aware rolling "
+        "restart) during the measurement; the report then shows dropped "
+        "vs rerouted requests (kserve http/grpc only)",
+    )
     parser.add_argument(
         "--stage-breakdown",
         action="store_true",
@@ -327,9 +350,17 @@ async def run(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.rolling_restart and args.service_kind != "kserve":
+        print(
+            "error: --rolling-restart needs the kserve http/grpc clients "
+            "(model repository control)",
+            file=sys.stderr,
+        )
+        return 2
     trace_exporter = None
     tracer = None
     collector = None
+    restart_driver = None
     if args.service_kind == "openai":
         backend = create_backend("openai", args.url, endpoint=args.endpoint)
     elif args.service_kind in ("tfserving", "torchserve"):
@@ -384,11 +415,14 @@ async def run(args) -> int:
             from client_tpu.perf.metrics_collector import MetricsCollector
 
             metrics_url = args.metrics_url
+            # a comma list (-u EndpointPool form) scrapes the FIRST
+            # endpoint; override with --metrics-url for another
+            primary_url = args.url.split(",")[0].strip()
             if not metrics_url:
                 if args.protocol == "http" and args.service_kind == "kserve":
-                    metrics_url = args.url
+                    metrics_url = primary_url
                 else:
-                    host = args.url.rsplit(":", 1)[0] or "localhost"
+                    host = primary_url.rsplit(":", 1)[0] or "localhost"
                     metrics_url = f"{host}:8000"
             collector = MetricsCollector(
                 metrics_url,
@@ -519,6 +553,19 @@ async def run(args) -> int:
             if args.verbose:
                 print(f"rank {args.rank}/{args.world_size} ready")
 
+        if args.rolling_restart:
+            from client_tpu.perf.load_manager import RollingRestartDriver
+
+            restart_driver = RollingRestartDriver(
+                backend, args.model_name, args.rolling_restart
+            )
+            restart_driver.start()
+            if args.verbose:
+                print(
+                    f"rolling restart: cycling unload/load of "
+                    f"'{args.model_name}' every {args.rolling_restart:g}s"
+                )
+
         latency_threshold_us = (
             args.latency_threshold * 1000 if args.latency_threshold else None
         )
@@ -610,6 +657,9 @@ async def run(args) -> int:
                     start, end, step
                 )
 
+        if restart_driver is not None:
+            await restart_driver.stop()
+
         if world.is_distributed:
             # No rank tears its load down while another is still measuring.
             await asyncio.to_thread(world.barrier)
@@ -619,6 +669,17 @@ async def run(args) -> int:
             label = f"{experiment.mode} = {experiment.value:g}"
             print(f"* {label}")
             print(detailed_report(experiment))
+        if restart_driver is not None:
+            line = (
+                f"Rolling restart: {restart_driver.cycles} unload/load "
+                "cycles during the run"
+            )
+            if restart_driver.errors:
+                line += (
+                    f" ({len(restart_driver.errors)} cycle errors; last: "
+                    f"{restart_driver.errors[-1]})"
+                )
+            print(line)
         print()
         print(console_report(experiments))
 
@@ -666,7 +727,12 @@ async def run(args) -> int:
                 "timeouts": best.status.timeout_count,
                 "shed_rate": best.status.shed_rate,
                 "goodput": best.status.goodput,
+                # lifecycle: dropped vs rerouted across drains/restarts
+                "dropped_unavailable": best.status.unavailable_count,
+                "rerouted": best.status.rerouted_count,
             }
+            if restart_driver is not None:
+                summary_doc["rolling_restart_cycles"] = restart_driver.cycles
             if best.status.per_priority_latency_us:
                 summary_doc["per_priority_p99_us"] = {
                     str(p): entry.get(99, 0)
@@ -687,6 +753,10 @@ async def run(args) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 1
     finally:
+        if restart_driver is not None:
+            # no-op when already stopped above; on an aborted run this
+            # also reloads the model so the server is left serving
+            await restart_driver.stop()
         if collector is not None:
             await collector.stop()  # no-op when already stopped above
         if shm_plane is not None:

@@ -101,6 +101,12 @@ class PerfStatus:
     per_priority_latency_us: Dict[int, Dict[Any, float]] = dataclasses.field(
         default_factory=dict
     )
+    # lifecycle / rolling-restart: requests that DROPPED because an
+    # endpoint was draining/dead (503 / UNAVAILABLE / connection error),
+    # vs. requests that were REROUTED — completed successfully but only
+    # after transparent retries (failover or ride-through)
+    unavailable_count: int = 0
+    rerouted_count: int = 0
 
     @property
     def goodput(self) -> float:
@@ -157,6 +163,11 @@ class ServerMetricsSummary:
 # numeric statuses, gRPC code reprs, in-process scheduling errors).
 _REJECT_STATUS_TOKENS = frozenset({"429", "RESOURCE_EXHAUSTED"})
 _TIMEOUT_STATUS_TOKENS = frozenset({"504", "DEADLINE_EXCEEDED"})
+# ...and as dropped by a draining/dead endpoint (the rolling-restart
+# report's "dropped" column; client_tpu.lifecycle.UNAVAILABLE_TOKENS).
+_UNAVAILABLE_STATUS_TOKENS = frozenset(
+    {"503", "UNAVAILABLE", "CONNECTION_ERROR"}
+)
 
 
 def _error_token(record: RequestRecord) -> str:
@@ -213,6 +224,12 @@ def compute_window_status(
     status.timeout_count = timeouts
     if window:
         status.shed_rate = rejected / len(window)
+    # lifecycle: dropped (unavailable endpoint) vs rerouted (succeeded
+    # after transparent retries — failover or drain ride-through)
+    status.unavailable_count = sum(
+        1 for r in window if _error_token(r) in _UNAVAILABLE_STATUS_TOKENS
+    )
+    status.rerouted_count = sum(1 for r in successes if r.retries > 0)
     priorities = {r.priority for r in window}
     if priorities and priorities != {0}:
         split: Dict[int, Dict[Any, float]] = {}

@@ -103,7 +103,25 @@ def detailed_report(experiment: ProfileExperiment) -> str:
     scheduling = format_scheduling(s)
     if scheduling:
         lines.append(scheduling)
+    lifecycle = format_lifecycle(s)
+    if lifecycle:
+        lines.append(lifecycle)
     return "\n".join(lines)
+
+
+def format_lifecycle(s) -> str:
+    """The "Lifecycle" block: what a rolling restart (or any endpoint
+    outage) cost the window — requests rerouted transparently (succeeded
+    after client-side retries/failover) vs. dropped on an unavailable
+    endpoint. Empty for undisturbed windows, so the acceptance claim
+    ("zero failed requests across a drain") is measured, not asserted."""
+    if not (s.rerouted_count or s.unavailable_count):
+        return ""
+    return (
+        f"  Lifecycle: {s.rerouted_count} rerouted "
+        f"(transparent retry/failover), {s.unavailable_count} dropped "
+        "(endpoint unavailable)"
+    )
 
 
 def format_scheduling(s) -> str:

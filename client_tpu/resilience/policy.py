@@ -469,6 +469,19 @@ def _apply_backoff_hint(backoff, hint_s, deadline):
     return hint_s
 
 
+def _apply_backoff_cap(backoff, cap_s):
+    """Cap a backoff from above — the failover fast path. When an attempt
+    failed against an endpoint but the caller has ANOTHER endpoint to try
+    (an :class:`~client_tpu.lifecycle.EndpointPool` with a healthy
+    alternative), sleeping out a backoff — or a draining server's
+    Retry-After, which applies to THAT server, not its replicas — just
+    adds latency: the cap (typically 0) overrides both so the retry goes
+    elsewhere immediately."""
+    if backoff is None or cap_s is None:
+        return backoff
+    return min(backoff, max(0.0, cap_s))
+
+
 class _AttemptLoop:
     """Shared per-attempt decision core for the sync and async drivers.
 
@@ -486,6 +499,7 @@ class _AttemptLoop:
         result_status,
         description,
         result_backoff_hint=None,
+        result_backoff_cap=None,
     ):
         self.policy = retry_policy
         self.breaker = circuit_breaker
@@ -493,6 +507,7 @@ class _AttemptLoop:
         self.idempotent = idempotent
         self.result_status = result_status
         self.result_backoff_hint = result_backoff_hint
+        self.result_backoff_cap = result_backoff_cap
         self.description = description
         clock = (
             retry_policy.clock if retry_policy is not None else time.monotonic
@@ -538,12 +553,17 @@ class _AttemptLoop:
             if _should_retry_now(
                 self.policy, self.idempotent, self.retries, retryable
             ):
-                backoff = _apply_backoff_hint(
-                    _backoff_within_budget(
-                        self.policy, self.deadline, self.retries
+                backoff = _apply_backoff_cap(
+                    _apply_backoff_hint(
+                        _backoff_within_budget(
+                            self.policy, self.deadline, self.retries
+                        ),
+                        getattr(exc, "retry_after_s", None),
+                        self.deadline,
                     ),
-                    getattr(exc, "retry_after_s", None),
-                    self.deadline,
+                    # a client surface that just failed over to another
+                    # endpoint stamps this on the exception: retry NOW
+                    getattr(exc, "retry_backoff_cap_s", None),
                 )
                 if backoff is not None:
                     self.retries += 1
@@ -579,14 +599,19 @@ class _AttemptLoop:
             if _should_retry_now(
                 self.policy, self.idempotent, self.retries, True
             ):
-                backoff = _apply_backoff_hint(
-                    _backoff_within_budget(
-                        self.policy, self.deadline, self.retries
+                backoff = _apply_backoff_cap(
+                    _apply_backoff_hint(
+                        _backoff_within_budget(
+                            self.policy, self.deadline, self.retries
+                        ),
+                        self.result_backoff_hint(value)
+                        if self.result_backoff_hint is not None
+                        else None,
+                        self.deadline,
                     ),
-                    self.result_backoff_hint(value)
-                    if self.result_backoff_hint is not None
+                    self.result_backoff_cap(value)
+                    if self.result_backoff_cap is not None
                     else None,
-                    self.deadline,
                 )
                 if backoff is not None:
                     self.retries += 1
@@ -620,6 +645,7 @@ async def run_with_resilience_async(
     result_status: Optional[Callable[[object], str]] = None,
     description: str = "request",
     result_backoff_hint: Optional[Callable[[object], Optional[float]]] = None,
+    result_backoff_cap: Optional[Callable[[object], Optional[float]]] = None,
 ):
     """Run ``send(per_attempt_timeout)`` under retry/deadline/breaker rules.
 
@@ -632,7 +658,11 @@ async def run_with_resilience_async(
     ``result_backoff_hint(value)`` may supply a server-provided backoff
     floor in seconds for a retryable value (HTTP ``Retry-After`` on a 429
     shed response); exceptions carry the same hint as a
-    ``retry_after_s`` attribute.
+    ``retry_after_s`` attribute. ``result_backoff_cap(value)`` is the
+    inverse — a ceiling (typically 0) for the endpoint-failover case
+    where the next attempt goes to a DIFFERENT endpoint, so neither the
+    backoff nor the failed endpoint's Retry-After should delay it;
+    exceptions carry it as ``retry_backoff_cap_s``.
     """
     if retry_policy is None and circuit_breaker is None:
         # default configuration: no loop state, no classification — the
@@ -647,6 +677,7 @@ async def run_with_resilience_async(
         result_status,
         description,
         result_backoff_hint,
+        result_backoff_cap,
     )
     while True:
         attempt_timeout = loop.pre_attempt()
@@ -671,6 +702,7 @@ def run_with_resilience(
     result_status: Optional[Callable[[object], str]] = None,
     description: str = "request",
     result_backoff_hint: Optional[Callable[[object], Optional[float]]] = None,
+    result_backoff_cap: Optional[Callable[[object], Optional[float]]] = None,
 ):
     """Sync twin of :func:`run_with_resilience_async` (blocking sleeps)."""
     if retry_policy is None and circuit_breaker is None:
@@ -684,6 +716,7 @@ def run_with_resilience(
         result_status,
         description,
         result_backoff_hint,
+        result_backoff_cap,
     )
     while True:
         attempt_timeout = loop.pre_attempt()

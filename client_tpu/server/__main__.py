@@ -35,6 +35,16 @@ def main(argv=None) -> int:
         "--max-workers", type=int, default=32, help="model execution threads"
     )
     parser.add_argument(
+        "--drain-timeout",
+        type=float,
+        default=30.0,
+        help="graceful-shutdown budget in seconds: on SIGTERM/SIGINT the "
+        "server flips /v2/health/ready to 503 (liveness stays up), "
+        "rejects new inferences with 503/UNAVAILABLE, and waits this "
+        "long for in-flight and queued work before closing — the "
+        "rolling-restart contract load balancers rely on",
+    )
+    parser.add_argument(
         "--platform",
         default=None,
         help="force the JAX platform (e.g. 'cpu', 'tpu'); overrides any "
@@ -114,9 +124,28 @@ def main(argv=None) -> int:
             f"({impl})",
             flush=True,
         )
+        import signal
+
+        stop_event = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, stop_event.set)
+            except NotImplementedError:  # pragma: no cover - non-POSIX
+                pass
         try:
-            await asyncio.Event().wait()
+            await stop_event.wait()
         finally:
+            # Graceful half first: readiness false + reject new work while
+            # in-flight and queued requests finish inside --drain-timeout;
+            # only then do the front-ends close.
+            print(
+                f"draining (up to {args.drain_timeout:g}s) ...", flush=True
+            )
+            drained = await core.drain(args.drain_timeout)
+            if not drained:
+                print("drain deadline expired; queued work failed cleanly",
+                      flush=True)
             if native_frontend is not None:
                 native_frontend.stop()
             if grpc_server is not None:

@@ -61,7 +61,12 @@ def status_code_for(message: str, exc=None) -> int:
         return GRPC_DEADLINE_EXCEEDED
     if "not found" in lowered or "unknown model" in lowered:
         return GRPC_NOT_FOUND
-    if "not ready" in lowered or "unavailable" in lowered:
+    if (
+        "not ready" in lowered
+        or "unavailable" in lowered
+        or "draining" in lowered
+        or "not accepting new inference" in lowered
+    ):
         return GRPC_UNAVAILABLE
     if "not implemented" in lowered or "no cuda" in lowered:
         return GRPC_UNIMPLEMENTED
@@ -106,7 +111,10 @@ def _server_live(core: ServerCore, request):
 
 
 def _server_ready(core: ServerCore, request):
-    return pb.ServerReadyResponse(ready=core.live)
+    # Real readiness (was a copy of _server_live): live AND accepting
+    # (drain-aware) AND repository ready set non-degraded. Shared by the
+    # grpc.aio servicer and the native C++ front-end.
+    return pb.ServerReadyResponse(ready=core.ready)
 
 
 def _model_ready(core: ServerCore, request):
@@ -226,7 +234,10 @@ def _repository_model_load(core: ServerCore, request):
 
 
 def _repository_model_unload(core: ServerCore, request):
-    core.repository.unload(request.model_name)
+    # Drain-aware unload through the core (see ServerCore.unload_model);
+    # the RPC returns once the model stops admitting — the drain itself
+    # runs in the background, Triton-style.
+    core.unload_model(request.model_name)
     return pb.RepositoryModelUnloadResponse()
 
 

@@ -29,6 +29,7 @@ from client_tpu.scheduling import (
     RateLimiter,
     SchedulingError,
 )
+from client_tpu.lifecycle import DrainController, ServerDrainingError
 from client_tpu.server.model_repository import Model, ModelRepository
 from client_tpu.server.shm import SharedMemoryManager
 from client_tpu.utils import (
@@ -734,6 +735,10 @@ class ServerCore:
         from client_tpu.server.metrics import ServerMetrics
 
         self.metrics = ServerMetrics(self)
+        # Graceful lifecycle: SERVING -> DRAINING -> STOPPED state plus
+        # the in-flight census every execution path reports into, so a
+        # drain can WAIT for work instead of cancelling it.
+        self.lifecycle = DrainController()
         self.log_settings: Dict[str, Any] = {
             "log_file": "",
             "log_info": True,
@@ -750,8 +755,141 @@ class ServerCore:
         return self.trace_manager.settings()
 
     def close(self) -> None:
+        self.lifecycle.mark_stopped()
         self._executor.shutdown(wait=False, cancel_futures=True)
         self.trace_manager.close()
+
+    # -- graceful lifecycle --------------------------------------------------
+
+    @property
+    def ready(self) -> bool:
+        """Readiness as load balancers should see it: live, accepting
+        (not draining), and the repository's ready set non-degraded.
+        Liveness (:attr:`live`) deliberately stays true through a drain."""
+        return (
+            self.live
+            and self.lifecycle.accepting
+            and not self.repository.degraded()
+        )
+
+    def _lifecycle_admit(self, model_name: str, trace=None) -> None:
+        """Drain gate + in-flight tracking for one request; books the
+        rejection counter and the trace event when draining."""
+        try:
+            self.lifecycle.admit(model_name)
+        except ServerDrainingError:
+            self.metrics.observe_drain_rejection(model_name)
+            if trace is not None:
+                trace.event("DRAIN_REJECTED")
+            raise
+
+    def reject_if_draining(self, model_name: str = "") -> None:
+        """Front-end fast path: raise the drain rejection before paying
+        request decode cost. Books exactly like an admission rejection
+        (check() never touches the in-flight census)."""
+        try:
+            self.lifecycle.check()
+        except ServerDrainingError:
+            self.metrics.observe_drain_rejection(model_name)
+            raise
+
+    async def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """Graceful shutdown sequence (runs on the serving loop):
+        stop admitting, wait for in-flight + queued work up to
+        ``timeout_s``, then fail anything still queued with a clean
+        503/UNAVAILABLE (never a cancelled future). Returns True when
+        everything drained inside the deadline."""
+        self.lifecycle.begin_drain()
+        drained = await self.lifecycle.wait_idle(timeout_s)
+        if not drained:
+            self.fail_pending()
+            # the failed futures' awaiters need a tick to observe before
+            # the front-ends close under them (deliberately NOT folded
+            # into the return value: the deadline DID expire)
+            await self.lifecycle.wait_idle(min(1.0, timeout_s or 1.0))
+        self.lifecycle.mark_stopped()
+        return drained
+
+    def fail_pending(self, model_name: Optional[str] = None) -> int:
+        """Fail every queued (not yet executing) batcher entry with a
+        drain rejection — the past-deadline counterpart of waiting.
+        Loop-thread only (the futures belong to the serving loop)."""
+        failed = 0
+        for name, batcher in list(self._batchers.items()):
+            if model_name is not None and name != model_name:
+                continue
+            items = batcher.pending.scan()
+            if not items:
+                continue
+            batcher.pending.remove(items)
+            batcher._publish_depths()
+            for item in items:
+                _request, future, _sig, _rows, _arrival = item.value
+                self.metrics.observe_drain_rejection(name)
+                if not future.done():
+                    future.set_exception(
+                        ServerDrainingError(
+                            self.lifecycle.state,
+                            retry_after_s=self.lifecycle.retry_after_s,
+                        )
+                    )
+                failed += 1
+        return failed
+
+    def unload_model(self, name: str, drain_timeout_s: float = 5.0):
+        """Repository unload with real per-model lifecycle: the model
+        stops admitting immediately (503/UNAVAILABLE), queued and
+        in-flight work drains in the background, then the batcher state
+        is evicted and the index entry flips to UNAVAILABLE/"unloaded".
+
+        Returns the finalization task when a loop is running (callers on
+        the serving loop — both front-ends — never block on the drain),
+        else finalizes synchronously.
+        """
+        old_model = self.repository.peek(name)
+        epoch = self.repository.unload(name)
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        if loop is None:
+            self._evict_batcher(name, old_model)
+            self.repository.finish_unload(name, epoch)
+            return None
+        return loop.create_task(
+            self._finalize_unload(name, old_model, epoch, drain_timeout_s)
+        )
+
+    async def _finalize_unload(
+        self, name: str, old_model, epoch: int, drain_timeout_s: float
+    ) -> None:
+        drained = await self.lifecycle.wait_idle(
+            drain_timeout_s, model_name=name
+        )
+        if self.repository.epoch_of(name) != epoch:
+            # a load() superseded this unload mid-drain (the rolling
+            # restart pattern): the census now counts the NEW model's
+            # traffic — failing its queued work here would drop the very
+            # requests the reload exists to keep serving
+            return
+        if not drained:
+            # past the drain deadline: queued entries fail cleanly
+            self.fail_pending(name)
+        self._evict_batcher(name, old_model)
+        self.repository.finish_unload(name, epoch)
+
+    def _evict_batcher(self, name: str, model=None) -> None:
+        """Drop a model's batcher state if it still belongs to the
+        unloaded model object and holds no queued work (a reload may
+        already have installed a new batcher — leave that one alone)."""
+        batcher = self._batchers.get(name)
+        if batcher is None:
+            return
+        if model is not None and batcher.model is not model:
+            return
+        if len(batcher.pending):
+            return
+        self._batchers.pop(name, None)
 
     def _stats_for(self, model_name: str) -> _Stats:
         with self._stats_lock:
@@ -1051,22 +1189,33 @@ class ServerCore:
         fall back to a task wrapping the slow path. Raises synchronously on
         validation errors.
         """
-        model = self.repository.get(request.model_name, request.model_version)
-        if model.decoupled:
-            raise InferenceServerException(
-                f"model '{model.name}' is decoupled; use streaming inference"
+        self._lifecycle_admit(request.model_name, request.trace)
+        try:
+            model = self.repository.get(
+                request.model_name, request.model_version
             )
-        if model.max_batch_size > 1 and self._has_batch_dim(model, request):
-            future = self._submit_batched(model, request)
-        else:
-            ticket = self._admit_single(model, request)
-            future = asyncio.ensure_future(
-                self._infer_single(model, request, ticket)
-            )
+            if model.decoupled:
+                raise InferenceServerException(
+                    f"model '{model.name}' is decoupled; use streaming "
+                    "inference"
+                )
+            if model.max_batch_size > 1 and self._has_batch_dim(model, request):
+                future = self._submit_batched(model, request)
+            else:
+                ticket = self._admit_single(model, request)
+                future = asyncio.ensure_future(
+                    self._infer_single(model, request, ticket)
+                )
+        except BaseException:
+            self.lifecycle.finish(request.model_name)
+            raise
         self.metrics.pending_inc(model.name)
-        future.add_done_callback(
-            lambda _f, name=model.name: self.metrics.pending_dec(name)
-        )
+
+        def _settled(_f, name=model.name, census=request.model_name):
+            self.metrics.pending_dec(name)
+            self.lifecycle.finish(census)
+
+        future.add_done_callback(_settled)
         return future
 
     def _submit_batched(
@@ -1113,10 +1262,15 @@ class ServerCore:
         # repository.get takes the repo lock; under load nearly every
         # request in a batch targets the same model, so resolve once.
         model_cache: Dict[Any, Model] = {}
+        # every request admitted into the lifecycle census; this whole
+        # call is synchronous, so they all finish before it returns
+        admitted: List[str] = []
         for idx, request in enumerate(requests):
             model = None
             grouped = False
             try:
+                self._lifecycle_admit(request.model_name, request.trace)
+                admitted.append(request.model_name)
                 model_key = (request.model_name, request.model_version)
                 model = model_cache.get(model_key)
                 if model is None:
@@ -1163,22 +1317,26 @@ class ServerCore:
             finally:
                 if model is not None and not grouped:
                     self.metrics.pending_dec(model.name)
-        for model, meta, entries in groups.values():
-            budget = model.max_batch_size
-            chunk: List[Any] = []
-            chunk_rows = 0
-            for entry in entries:
-                if chunk and chunk_rows + entry[1] > budget:
+        try:
+            for model, meta, entries in groups.values():
+                budget = model.max_batch_size
+                chunk: List[Any] = []
+                chunk_rows = 0
+                for entry in entries:
+                    if chunk and chunk_rows + entry[1] > budget:
+                        self._execute_direct_chunk(
+                            model, meta, chunk, requests, results, arrival_ns
+                        )
+                        chunk, chunk_rows = [], 0
+                    chunk.append(entry)
+                    chunk_rows += entry[1]
+                if chunk:
                     self._execute_direct_chunk(
                         model, meta, chunk, requests, results, arrival_ns
                     )
-                    chunk, chunk_rows = [], 0
-                chunk.append(entry)
-                chunk_rows += entry[1]
-            if chunk:
-                self._execute_direct_chunk(
-                    model, meta, chunk, requests, results, arrival_ns
-                )
+        finally:
+            for name in admitted:
+                self.lifecycle.finish(name)
         return results
 
     def _execute_direct_chunk(
@@ -1337,20 +1495,31 @@ class ServerCore:
 
     async def infer(self, request: CoreRequest) -> CoreResponse:
         """Execute a request->response inference (decoupled models rejected)."""
-        model = self.repository.get(request.model_name, request.model_version)
-        if model.decoupled:
-            raise InferenceServerException(
-                f"model '{model.name}' is decoupled; use streaming inference"
-            )
-        self.metrics.pending_inc(model.name)
+        self._lifecycle_admit(request.model_name, request.trace)
         try:
-            if model.max_batch_size > 1 and self._has_batch_dim(model, request):
-                return await self._submit_batched(model, request)
-            # Awaited single path: run the coroutine inline — no Task.
-            ticket = self._admit_single(model, request)
-            return await self._infer_single(model, request, ticket)
+            model = self.repository.get(
+                request.model_name, request.model_version
+            )
+            if model.decoupled:
+                raise InferenceServerException(
+                    f"model '{model.name}' is decoupled; use streaming "
+                    "inference"
+                )
+            self.metrics.pending_inc(model.name)
+            try:
+                if model.max_batch_size > 1 and self._has_batch_dim(
+                    model, request
+                ):
+                    return await self._submit_batched(model, request)
+                # Awaited single path: run the coroutine inline — no Task.
+                ticket = self._admit_single(model, request)
+                return await self._infer_single(model, request, ticket)
+            finally:
+                self.metrics.pending_dec(model.name)
         finally:
-            self.metrics.pending_dec(model.name)
+            # the census covers queued batcher time too: the future above
+            # resolves only when the request left the queue and executed
+            self.lifecycle.finish(request.model_name)
 
     async def _infer_single(
         self, model: Model, request: CoreRequest, ticket=None
@@ -1417,10 +1586,16 @@ class ServerCore:
         ticket = None
         rate_resources = None
         if model.decoupled:
-            # Admission before the stream opens: for decoupled models the
+            # Drain gate + census first (non-decoupled delegates to
+            # infer(), which runs its own), then admission: the
             # waiting-room bound sheds streams that would only pile up
             # behind a saturated device (raises a booked QueueFullError).
-            ticket = self._admit_single(model, request)
+            self._lifecycle_admit(request.model_name, request.trace)
+            try:
+                ticket = self._admit_single(model, request)
+            except BaseException:
+                self.lifecycle.finish(request.model_name)
+                raise
         t0 = time.monotonic_ns()
         # Split the stream's lifetime into model-compute vs output-packaging
         # time, and record time-to-first-response — the reference's stats
@@ -1546,6 +1721,7 @@ class ServerCore:
                 ticket.close()
             if model.decoupled:
                 self.metrics.pending_dec(model.name)
+                self.lifecycle.finish(request.model_name)
 
     # -- wire-side input decoding -------------------------------------------
 

@@ -210,6 +210,10 @@ class _Servicer(GRPCInferenceServiceServicer):
         await self._chaos_gate(context, "ModelInfer")
         trace = self._begin_trace(context, request)
         try:
+            # drain fast path: UNAVAILABLE before paying decode cost
+            # (outside the inner try: a drain rejection is booked on its
+            # own counter, not as a malformed-request frontend error)
+            self.core.reject_if_draining(request.model_name)
             try:
                 core_request = build_core_request(self.core, request)
             except InferenceServerException:
@@ -240,6 +244,9 @@ class _Servicer(GRPCInferenceServiceServicer):
             await self._chaos_gate(context, "ModelStreamInfer")
             trace = self._begin_trace(context, request)
             try:
+                # drain-aware: rejected stream requests surface as clean
+                # in-band errors, never cancelled streams
+                self.core.reject_if_draining(request.model_name)
                 try:
                     core_request = build_core_request(self.core, request)
                 except InferenceServerException:

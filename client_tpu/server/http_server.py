@@ -204,10 +204,21 @@ class HttpServer:
     # -- health / metadata ---------------------------------------------------
 
     async def handle_live(self, request):
+        # Liveness is process health only — it deliberately stays true
+        # through a drain so orchestrators don't kill a draining server.
         return web.Response(status=200 if self.core.live else 400)
 
     async def handle_ready(self, request):
-        return web.Response(status=200 if self.core.live else 400)
+        # Readiness requires live AND accepting (not draining) AND the
+        # repository's ready set non-degraded; 503 is what pulls a
+        # draining instance out of a load balancer while /live stays 200.
+        if self.core.ready:
+            return web.Response(status=200)
+        headers = None
+        if self.core.live and not self.core.lifecycle.accepting:
+            retry_after = self.core.lifecycle.retry_after_s
+            headers = {"Retry-After": str(max(1, int(round(retry_after))))}
+        return web.Response(status=503, headers=headers)
 
     async def handle_model_ready(self, request):
         ready = self.core.repository.is_ready(
@@ -254,7 +265,11 @@ class HttpServer:
         return web.Response(status=200)
 
     async def handle_repository_unload(self, request):
-        self.core.repository.unload(request.match_info["model"])
+        # Through the core, not the bare repository: the model stops
+        # admitting immediately while its queued/in-flight work drains in
+        # the background, then batcher state is evicted and the index
+        # entry flips to UNAVAILABLE/"unloaded".
+        self.core.unload_model(request.match_info["model"])
         return web.Response(status=200)
 
     # -- statistics ----------------------------------------------------------
@@ -378,6 +393,9 @@ class HttpServer:
     # -- inference -----------------------------------------------------------
 
     async def handle_infer(self, request):
+        # Drain fast path: reject before paying body read/decode cost
+        # (_map_exception renders the 503 + Retry-After).
+        self.core.reject_if_draining(request.match_info["model"])
         # aiohttp auto-decompresses request bodies per Content-Encoding
         # (gzip/deflate), so `body` is already plain here.
         body = await request.read()

@@ -149,6 +149,20 @@ class ServerMetrics:
             ("model", "level"),
             registry=registry,
         )
+        self.drain_rejected = Counter(
+            "tpu_drain_rejected_total",
+            "Requests rejected because the server was draining or "
+            "stopped (clean 503/UNAVAILABLE, load balancers should have "
+            "routed elsewhere).",
+            model,
+            registry=registry,
+        )
+        self.server_state = Gauge(
+            "tpu_server_state",
+            "Lifecycle state of the server (0 = serving, 1 = draining, "
+            "2 = stopped).",
+            registry=registry,
+        )
         self.frontend_errors = Counter(
             "tpu_frontend_request_errors",
             "Requests rejected by a front-end before reaching the engine "
@@ -239,6 +253,10 @@ class ServerMetrics:
         """Book one admission-control rejection (queue_full / timeout)."""
         self.queue_rejected.labels(model, reason).inc()
 
+    def observe_drain_rejection(self, model: str) -> None:
+        """Book one request rejected by the lifecycle drain gate."""
+        self.drain_rejected.labels(model or "").inc()
+
     def set_queue_depth(self, model: str, depths) -> None:
         """Publish the scheduler queue depth per priority level (fed from
         the same submit/take/expire events that stamp the statistics
@@ -271,6 +289,13 @@ class ServerMetrics:
                 inference["success"]["ns"]
             )
             self.legacy_fail_count.labels(name).set(inference["fail"]["count"])
+        lifecycle = getattr(self.core, "lifecycle", None)
+        if lifecycle is not None:
+            from client_tpu.lifecycle import STATE_VALUES
+
+            self.server_state.set(
+                float(STATE_VALUES.get(lifecycle.state, 0))
+            )
         busy_ns = self.core.device_busy_ns_total
         now_ns = self._clock_ns()
         with self._duty_lock:

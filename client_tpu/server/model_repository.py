@@ -16,6 +16,29 @@ import numpy as np
 
 from client_tpu.utils import InferenceServerException
 
+# index() states (Triton RepositoryIndex wire values)
+STATE_READY = "READY"
+STATE_UNAVAILABLE = "UNAVAILABLE"
+STATE_LOADING = "LOADING"
+STATE_UNLOADING = "UNLOADING"
+
+
+class ModelUnavailableError(InferenceServerException):
+    """A request targeted a model that exists but is not serving
+    (unloaded, unloading, or load-failed).
+
+    Carries both wire faces directly — HTTP 503 (a retryable status, so
+    clients with a retry policy ride through an unload->load window) and
+    gRPC UNAVAILABLE — instead of the generic 400/INVALID_ARGUMENT a
+    missing model gets: "temporarily gone" and "never existed" are
+    different contracts."""
+
+    http_status = 503
+    grpc_code = "UNAVAILABLE"
+
+    def __init__(self, msg: str):
+        super().__init__(msg, status="UNAVAILABLE")
+
 
 class Model:
     """Base class for served models.
@@ -217,26 +240,44 @@ class ModelRepository:
 
     def __init__(self, repository_path: Optional[str] = None):
         self._models: Dict[str, Model] = {}
-        self._ready: Dict[str, bool] = {}
+        self._state: Dict[str, str] = {}
+        self._reason: Dict[str, str] = {}
+        # per-name load/unload generation: async unload finalization and
+        # batcher eviction only apply when no load() happened in between
+        self._epoch: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._repository_path = repository_path
+
+    def _set_state(self, name: str, state: str, reason: str = "") -> None:
+        # lock held by caller
+        self._state[name] = state
+        self._reason[name] = reason
 
     def add_model(self, model: Model, ready: bool = True) -> None:
         model.warmup()
         with self._lock:
             self._models[model.name] = model
-            self._ready[model.name] = ready
+            self._set_state(
+                model.name, STATE_READY if ready else STATE_UNAVAILABLE
+            )
+            self._epoch[model.name] = self._epoch.get(model.name, 0) + 1
+
+    def peek(self, name: str) -> Optional[Model]:
+        """The registered model object regardless of readiness (the server
+        core uses it to pin per-model state across an unload)."""
+        with self._lock:
+            return self._models.get(name)
 
     def get(self, name: str, version: str = "") -> Model:
         with self._lock:
             model = self._models.get(name)
-            ready = self._ready.get(name, False)
+            ready = self._state.get(name) == STATE_READY
         if model is None:
             raise InferenceServerException(
                 f"Request for unknown model: '{name}' is not found"
             )
         if not ready:
-            raise InferenceServerException(
+            raise ModelUnavailableError(
                 f"Request for unavailable model: '{name}' is not ready"
             )
         if version and version != model.version:
@@ -252,7 +293,20 @@ class ModelRepository:
                 return False
             if version and self._models[name].version != version:
                 return False
-            return self._ready.get(name, False)
+            return self._state.get(name) == STATE_READY
+
+    def degraded(self) -> bool:
+        """True when the ready set is degraded: a model is mid-load or
+        stuck in a failed load. Intentional removals (unloading/unloaded)
+        do NOT degrade readiness — draining one model out of a serving
+        process is normal operations, not an unhealthy server."""
+        with self._lock:
+            for name in self._models:
+                if self._state.get(name) == STATE_LOADING:
+                    return True
+                if self._reason.get(name, "").startswith("load failed"):
+                    return True
+        return False
 
     def index(self) -> List[Dict[str, str]]:
         with self._lock:
@@ -260,62 +314,145 @@ class ModelRepository:
                 {
                     "name": m.name,
                     "version": m.version,
-                    "state": "READY" if self._ready.get(m.name) else "UNAVAILABLE",
-                    "reason": "",
+                    "state": self._state.get(m.name, STATE_UNAVAILABLE),
+                    "reason": self._reason.get(m.name, ""),
                 }
                 for m in self._models.values()
             ]
 
     def load(self, name: str, config_override: Optional[str] = None) -> None:
-        """Load (or reload) a model by name.
+        """Load (or reload) a model by name — atomically.
 
-        Programmatically added models are marked ready; directory models are
-        (re-)imported from ``<repo>/<name>/model.py``.
+        Directory models are (re-)imported from ``<repo>/<name>/model.py``;
+        an already-serving model keeps serving the OLD object until the
+        new one passes ``warmup()``, then requests cut over in one swap.
+        A failed load leaves the old model serving (the error still
+        propagates to the caller). Programmatic models are re-warmed on
+        reload — a bare re-mark-ready would resurrect a model that was
+        unloaded precisely because its state went bad.
         """
+        model_py = (
+            os.path.join(self._repository_path, name, "model.py")
+            if self._repository_path
+            else None
+        )
         with self._lock:
             known = name in self._models
-        if known and self._repository_path is None:
-            with self._lock:
-                self._ready[name] = True
-            return
-        if self._repository_path is None:
-            raise InferenceServerException(
-                f"failed to load '{name}': no model repository configured"
-            )
-        model_py = os.path.join(self._repository_path, name, "model.py")
-        if not os.path.exists(model_py):
-            raise InferenceServerException(
-                f"failed to load '{name}': {model_py} not found"
-            )
-        spec = importlib.util.spec_from_file_location(
-            f"client_tpu_model_{name}", model_py
-        )
-        module = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(module)
-        if not hasattr(module, "create_model"):
-            raise InferenceServerException(
-                f"failed to load '{name}': model.py must define create_model()"
-            )
-        model = module.create_model()
-        if config_override:
-            try:
-                overrides = json.loads(config_override)
-            except json.JSONDecodeError as e:
+            was_ready = self._state.get(name) == STATE_READY
+        if model_py is None or not os.path.exists(model_py):
+            if not known:
+                if self._repository_path is None:
+                    raise InferenceServerException(
+                        f"failed to load '{name}': no model repository "
+                        "configured"
+                    )
                 raise InferenceServerException(
-                    f"failed to load '{name}': bad config override: {e}"
-                ) from None
-            if "max_batch_size" in overrides:
-                model.max_batch_size = int(overrides["max_batch_size"])
-        model.name = name
-        self.add_model(model)
+                    f"failed to load '{name}': {model_py} not found"
+                )
+            # Programmatic reload: same object, fresh warmup.
+            model = self._models[name]
+            try:
+                model.warmup()
+            except Exception as e:  # noqa: BLE001 - surfaced to caller
+                with self._lock:
+                    if not was_ready:
+                        self._set_state(
+                            name, STATE_UNAVAILABLE, f"load failed: {e}"
+                        )
+                raise InferenceServerException(
+                    f"failed to load '{name}': {e}"
+                ) from e
+            with self._lock:
+                self._set_state(name, STATE_READY)
+                self._epoch[name] = self._epoch.get(name, 0) + 1
+            return
+        with self._lock:
+            # Old model (if ready) keeps serving through the load; a brand
+            # new name is LOADING (not ready) until warmup passes.
+            if known and was_ready:
+                self._reason[name] = "loading"
+            else:
+                self._set_state(name, STATE_LOADING, "loading")
+        try:
+            spec = importlib.util.spec_from_file_location(
+                f"client_tpu_model_{name}", model_py
+            )
+            module = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(module)
+            if not hasattr(module, "create_model"):
+                raise InferenceServerException(
+                    f"failed to load '{name}': model.py must define "
+                    "create_model()"
+                )
+            model = module.create_model()
+            if config_override:
+                try:
+                    overrides = json.loads(config_override)
+                except json.JSONDecodeError as e:
+                    raise InferenceServerException(
+                        f"failed to load '{name}': bad config override: {e}"
+                    ) from None
+                if "max_batch_size" in overrides:
+                    model.max_batch_size = int(overrides["max_batch_size"])
+            model.name = name
+            model.warmup()
+        except Exception as e:  # noqa: BLE001 - load failure bookkeeping
+            with self._lock:
+                if known and was_ready:
+                    # old model still serving: load failure is an event,
+                    # not a state — readiness is untouched
+                    self._reason[name] = ""
+                elif known:
+                    self._set_state(
+                        name, STATE_UNAVAILABLE, f"load failed: {e}"
+                    )
+                else:
+                    # never-loaded name: no registry entry to degrade
+                    self._state.pop(name, None)
+                    self._reason.pop(name, None)
+            if isinstance(e, InferenceServerException):
+                raise
+            raise InferenceServerException(
+                f"failed to load '{name}': {e}"
+            ) from e
+        # Atomic cutover: one assignment under the lock; requests admitted
+        # before this instant run to completion against the old object.
+        with self._lock:
+            self._models[name] = model
+            self._set_state(name, STATE_READY)
+            self._epoch[name] = self._epoch.get(name, 0) + 1
 
-    def unload(self, name: str) -> None:
+    def unload(self, name: str) -> int:
+        """Begin unloading: the model stops admitting immediately (new
+        requests get a 503/UNAVAILABLE :class:`ModelUnavailableError`)
+        while queued and in-flight work drains. Returns the unload epoch;
+        the caller (ServerCore) drains and then calls
+        :meth:`finish_unload` with it."""
         with self._lock:
             if name not in self._models:
                 raise InferenceServerException(
                     f"failed to unload '{name}': model is not loaded"
                 )
-            self._ready[name] = False
+            self._set_state(name, STATE_UNLOADING, "unloading")
+            self._epoch[name] = self._epoch.get(name, 0) + 1
+            return self._epoch[name]
+
+    def epoch_of(self, name: str) -> Optional[int]:
+        """The model's current load/unload generation (None if unknown).
+        Callers finalizing an async unload compare against the epoch
+        :meth:`unload` returned — a mismatch means a load() superseded
+        the unload and its cleanup must not touch the new model."""
+        with self._lock:
+            return self._epoch.get(name)
+
+    def finish_unload(self, name: str, epoch: Optional[int] = None) -> None:
+        """Mark an unload complete (state UNAVAILABLE, reason "unloaded").
+        With ``epoch``, a no-op when a load() superseded the unload."""
+        with self._lock:
+            if epoch is not None and self._epoch.get(name) != epoch:
+                return
+            if self._state.get(name) == STATE_UNLOADING:
+                self._set_state(name, STATE_UNAVAILABLE, "unloaded")
 
     def scan(self) -> None:
         """Load every model directory found in the repository path."""

@@ -442,7 +442,18 @@ class NativeGrpcFrontend:
                     self._complete_response(handle, held, final=False)
                 held = response
         except asyncio.CancelledError:
-            self._complete_error(handle, "request cancelled", 1)
+            if not self._core.lifecycle.accepting:
+                # torn down by a drain deadline, not by the peer: the
+                # client gets a clean retryable UNAVAILABLE, never a
+                # bare CANCELLED from a cancelled future
+                self._complete_error(
+                    handle,
+                    "server is draining and not accepting new inference "
+                    "requests",
+                    codec.GRPC_UNAVAILABLE,
+                )
+            else:
+                self._complete_error(handle, "request cancelled", 1)
             raise
         except InferenceServerException as e:
             self._complete_error(

@@ -25,6 +25,9 @@ class InProcessServer:
         host: str = "127.0.0.1",
         builtin_models: bool = True,
         chaos=None,
+        http_port: int = 0,
+        grpc_port: int = 0,
+        drain_timeout_s: float = 5.0,
     ):
         """`grpc` may be True (native front-end when built, else grpc.aio),
         "native", "aio", or False.
@@ -33,7 +36,18 @@ class InProcessServer:
         faults — error rate, latency, resets, truncated bodies — into
         both front-ends; with chaos active the gRPC front-end is forced
         to the grpc.aio implementation (the native C++ front-end has no
-        injection hooks)."""
+        injection hooks).
+
+        ``http_port``/``grpc_port`` default to 0 (ephemeral); rolling-
+        restart tests pass the previous instance's ports so a restarted
+        server comes back at the same address an
+        :class:`~client_tpu.lifecycle.EndpointPool` keeps probing.
+
+        ``drain_timeout_s`` bounds the graceful half of :meth:`stop`:
+        readiness flips false immediately, in-flight and queued work gets
+        this long to finish, and only then do the front-ends close and
+        anything left fail — with a clean 503/UNAVAILABLE, never a
+        cancelled-future traceback."""
         if core is None:
             core = ServerCore(ModelRepository())
         self.core = core
@@ -58,6 +72,9 @@ class InProcessServer:
         self._want_grpc = grpc
         self.grpc_impl: Optional[str] = grpc if grpc else None
         self._host = host
+        self._http_bind_port = http_port
+        self._grpc_bind_port = grpc_port
+        self._drain_timeout_s = drain_timeout_s
         self.http_port: Optional[int] = None
         self.grpc_port: Optional[int] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -100,23 +117,33 @@ class InProcessServer:
             from client_tpu.server.http_server import serve_http
 
             http_runner = await serve_http(
-                self.core, self._host, 0, chaos=self.chaos
+                self.core, self._host, self._http_bind_port, chaos=self.chaos
             )
             self.http_port = http_runner.addresses[0][1]
         if self._want_grpc == "native":
             from client_tpu.server.native_frontend import serve_grpc_native
 
             native_frontend, self.grpc_port = await serve_grpc_native(
-                self.core, self._host, 0
+                self.core, self._host, self._grpc_bind_port
             )
         elif self._want_grpc:
             from client_tpu.server.grpc_server import serve_grpc
 
             grpc_server, self.grpc_port = await serve_grpc(
-                self.core, self._host, 0, chaos=self.chaos
+                self.core, self._host, self._grpc_bind_port, chaos=self.chaos
             )
         self._ready.set()
         await self._stop.wait()
+        # Graceful half BEFORE the front-ends close: readiness flips
+        # false (new requests 503/UNAVAILABLE) while in-flight AND queued
+        # batcher work finishes inside the drain deadline; past it,
+        # queued entries fail with the same clean error. Previously the
+        # front-ends stopped first and core.close() cancelled in-flight
+        # futures into cancelled-asyncio tracebacks.
+        try:
+            await self.core.drain(self._drain_timeout_s)
+        except Exception:  # noqa: BLE001 - shutdown must proceed
+            pass
         if native_frontend is not None:
             native_frontend.stop()
         if grpc_server is not None:
@@ -124,11 +151,15 @@ class InProcessServer:
         if http_runner is not None:
             await http_runner.cleanup()
 
-    def stop(self) -> None:
+    def stop(self, drain_timeout_s: Optional[float] = None) -> None:
+        """Drain (bounded by ``drain_timeout_s``, default the value the
+        server was built with) and shut down."""
+        if drain_timeout_s is not None:
+            self._drain_timeout_s = drain_timeout_s
         if self._loop is not None and self._stop is not None:
             self._loop.call_soon_threadsafe(self._stop.set)
         if self._thread is not None:
-            self._thread.join(timeout=10)
+            self._thread.join(timeout=self._drain_timeout_s + 10)
         self.core.close()
 
     def __enter__(self) -> "InProcessServer":

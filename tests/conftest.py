@@ -65,8 +65,8 @@ def pytest_configure(config):
     if problems:
         raise pytest.UsageError(
             "clock lint failed (injectable clocks only in "
-            "client_tpu/observability, client_tpu/resilience and "
-            "client_tpu/scheduling):\n"
+            "client_tpu/lifecycle, client_tpu/observability, "
+            "client_tpu/resilience and client_tpu/scheduling):\n"
             + "\n".join(problems)
         )
 

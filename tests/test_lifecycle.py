@@ -1,0 +1,856 @@
+"""Graceful-lifecycle tests: drain-aware shutdown, hot model reload, and
+client endpoint failover.
+
+Unit halves (DrainController, EndpointPool, repository state machine,
+failover backoff cap) run on fake clocks. Integration halves drive real
+in-process servers but keep every window short; the chaos-marked tests
+are the acceptance scenarios — rolling restart over an EndpointPool with
+zero client-visible failures, and unload->load under concurrent traffic
+with no wrong-model results and no drops.
+"""
+
+import asyncio
+import logging
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.http as httpclient
+from client_tpu.lifecycle import (
+    DRAINING,
+    SERVING,
+    STOPPED,
+    DrainController,
+    EndpointPool,
+    ServerDrainingError,
+    status_is_unavailable,
+)
+from client_tpu.resilience import CircuitBreaker, RetryPolicy
+from client_tpu.server.core import ServerCore
+from client_tpu.server.model_repository import (
+    Model,
+    ModelRepository,
+    ModelUnavailableError,
+)
+from client_tpu.testing import InProcessServer
+from client_tpu.utils import InferenceServerException
+
+pytestmark = pytest.mark.lifecycle
+
+# server restarts make aiohttp log scary-but-expected connection errors
+logging.getLogger("aiohttp.server").setLevel(logging.CRITICAL)
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+        self.sleeps = []
+
+    def time(self) -> float:
+        return self.now
+
+    async def async_sleep(self, seconds: float) -> None:
+        self.sleeps.append(seconds)
+        self.now += seconds
+
+
+# ---------------------------------------------------------------------------
+# DrainController
+
+
+def test_drain_controller_state_machine():
+    ctl = DrainController(retry_after_s=3.0)
+    assert ctl.state == SERVING and ctl.accepting
+    ctl.admit("m")
+    assert ctl.inflight() == 1 and ctl.inflight("m") == 1
+    ctl.begin_drain()
+    assert ctl.state == DRAINING and not ctl.accepting
+    with pytest.raises(ServerDrainingError) as exc_info:
+        ctl.admit("m")
+    error = exc_info.value
+    assert error.http_status == 503
+    assert error.grpc_code == "UNAVAILABLE"
+    assert error.retry_after_s == 3.0
+    assert "draining" in error.message()
+    assert ctl.rejected_total == 1
+    # in-flight work admitted before the drain still counts down
+    ctl.finish("m")
+    assert ctl.inflight() == 0
+    # a drain can be aborted; a stop cannot
+    ctl.resume()
+    assert ctl.accepting
+    ctl.mark_stopped()
+    ctl.resume()
+    assert ctl.state == STOPPED
+    with pytest.raises(ServerDrainingError, match="stopped"):
+        ctl.admit("m")
+
+
+def test_drain_wait_idle_fake_clock_deadline():
+    clock = FakeClock()
+    ctl = DrainController(clock=clock.time, async_sleep=clock.async_sleep)
+    ctl.admit("m")
+
+    async def scenario():
+        # never finishes: the wait must give up at the deadline, not hang
+        assert not await ctl.wait_idle(timeout_s=0.5, poll_s=0.1)
+        ctl.finish("m")
+        assert await ctl.wait_idle(timeout_s=0.5)
+        # per-model wait sees only that model's work
+        ctl.admit("a")
+        assert await ctl.wait_idle(timeout_s=0.2, model_name="b")
+        assert not await ctl.wait_idle(timeout_s=0.2, model_name="a")
+
+    asyncio.run(scenario())
+    assert clock.sleeps  # waiting actually polled via the injected sleep
+
+
+# ---------------------------------------------------------------------------
+# EndpointPool
+
+
+def test_endpoint_pool_parses_comma_list_and_resolves():
+    pool = EndpointPool("a:1, b:2,c:3")
+    assert pool.urls == ["a:1", "b:2", "c:3"]
+    assert EndpointPool.resolve(pool) is pool
+    assert EndpointPool.resolve("x:1", None).urls == ["x:1"]
+    assert EndpointPool.resolve(None, ["y:1", "z:2"]).size == 2
+    with pytest.raises(ValueError):
+        EndpointPool.resolve(None, None)
+
+
+def test_endpoint_pool_sticky_primary_failover_and_recovery():
+    clock = FakeClock()
+    pool = EndpointPool(["a:1", "b:2"], cooldown_s=2.0, clock=clock.time)
+    first = pool.pick()
+    assert first.url == "a:1" and pool.pick() is first  # sticky
+    pool.observe(first, token="503")
+    assert pool.failovers == 1
+    second = pool.pick()
+    assert second.url == "b:2"
+    assert pool.has_alternative(first)
+    # cooldown not expired: no probe yet, still routed to b
+    clock.now = 1.0
+    assert not pool.needs_probe(first)
+    assert pool.pick() is second
+    # cooldown expired: a is back as a candidate but must pass a probe
+    clock.now = 2.5
+    assert pool.needs_probe(first)
+    pool.mark_up(first)
+    assert not pool.needs_probe(first)
+    pool.observe(second, ok=True)
+
+
+def test_endpoint_pool_retry_after_overrides_cooldown():
+    clock = FakeClock()
+    pool = EndpointPool(["a:1", "b:2"], cooldown_s=1.0, clock=clock.time)
+    ep = pool.pick()
+    pool.observe(ep, token="UNAVAILABLE", retry_after_s=7.0)
+    assert ep.down_until == pytest.approx(7.0)
+
+
+def test_endpoint_pool_all_down_returns_least_bad():
+    clock = FakeClock()
+    pool = EndpointPool(["a:1", "b:2"], cooldown_s=1.0, clock=clock.time)
+    a, b = pool.endpoints
+    pool.mark_down(a, cooldown_s=5.0)
+    pool.mark_down(b, cooldown_s=2.0)
+    assert pool.pick() is b  # soonest recovery
+    assert not pool.has_alternative(None)
+
+
+def test_endpoint_pool_breaker_integration():
+    clock = FakeClock()
+    pool = EndpointPool(
+        ["a:1", "b:2"],
+        cooldown_s=0.0,
+        clock=clock.time,
+        breaker_factory=lambda: CircuitBreaker(
+            failure_threshold=2, cooldown_s=100.0, clock=clock.time
+        ),
+    )
+    a, b = pool.endpoints
+    # two unavailability outcomes trip a's breaker; even with the pool
+    # cooldown at zero, pick() then skips a
+    pool.observe(a, token="503")
+    pool.observe(a, token="503")
+    assert a.circuit_breaker.state == CircuitBreaker.OPEN
+    assert pool.pick() is b
+
+
+def test_status_is_unavailable_classification():
+    assert status_is_unavailable("503")
+    assert status_is_unavailable("StatusCode.UNAVAILABLE")
+    assert status_is_unavailable("CONNECTION_ERROR")
+    assert not status_is_unavailable("429")
+    assert not status_is_unavailable("400")
+    assert not status_is_unavailable(None)
+
+
+def test_failover_skips_backoff_via_cap():
+    """An exception carrying retry_backoff_cap_s=0 (set by a surface that
+    has another endpoint) must retry immediately — overriding both the
+    drawn backoff and a server Retry-After floor."""
+    from client_tpu.resilience import run_with_resilience
+
+    clock = FakeClock()
+    sleeps = []
+    policy = RetryPolicy(
+        max_attempts=3,
+        initial_backoff_s=0.5,
+        jitter=False,
+        clock=clock.time,
+        sleep=lambda s: sleeps.append(s),
+    )
+    attempts = []
+
+    def send(timeout):
+        attempts.append(timeout)
+        if len(attempts) == 1:
+            error = InferenceServerException("draining", status="503")
+            error.retry_after_s = 9.0  # the failed endpoint's own hint
+            error.retry_backoff_cap_s = 0.0  # ...but we have an alternative
+            raise error
+        return "ok"
+
+    assert run_with_resilience(send, retry_policy=policy) == "ok"
+    assert sleeps == [0.0]
+
+
+# ---------------------------------------------------------------------------
+# repository state machine
+
+
+class CountingModel(Model):
+    name = "counting"
+    max_batch_size = 0
+    inputs = [{"name": "INPUT0", "datatype": "FP32", "shape": [-1]}]
+    outputs = [{"name": "OUTPUT0", "datatype": "FP32", "shape": [-1]}]
+
+    def __init__(self):
+        self.warmups = 0
+        self.fail_warmup = False
+
+    def warmup(self):
+        if self.fail_warmup:
+            raise RuntimeError("warmup exploded")
+        self.warmups += 1
+
+    def execute(self, inputs, parameters):
+        return {"OUTPUT0": inputs["INPUT0"]}
+
+
+def test_unload_reasons_and_unavailable_error():
+    repo = ModelRepository()
+    model = CountingModel()
+    repo.add_model(model)
+    core = ServerCore(repo)
+    core.unload_model("counting")  # no loop: finalizes synchronously
+    entry = {m["name"]: m for m in repo.index()}["counting"]
+    assert entry["state"] == "UNAVAILABLE"
+    assert entry["reason"] == "unloaded"
+    with pytest.raises(ModelUnavailableError) as exc_info:
+        repo.get("counting")
+    assert exc_info.value.http_status == 503
+    assert exc_info.value.grpc_code == "UNAVAILABLE"
+    assert exc_info.value.status() == "UNAVAILABLE"
+    # unloading one model does NOT degrade server readiness
+    assert not repo.degraded()
+    assert core.ready
+    core.close()
+
+
+def test_programmatic_load_rewarns_instead_of_remarking_ready():
+    repo = ModelRepository()
+    model = CountingModel()
+    repo.add_model(model)
+    assert model.warmups == 1
+    epoch = repo.unload("counting")
+    repo.finish_unload("counting", epoch)
+    repo.load("counting")
+    assert model.warmups == 2  # real reload, not a silent ready flip
+    assert repo.is_ready("counting")
+    # a failing warmup on reload leaves the model unavailable + reasoned
+    epoch = repo.unload("counting")
+    repo.finish_unload("counting", epoch)
+    model.fail_warmup = True
+    with pytest.raises(InferenceServerException):
+        repo.load("counting")
+    entry = {m["name"]: m for m in repo.index()}["counting"]
+    assert entry["state"] == "UNAVAILABLE"
+    assert entry["reason"].startswith("load failed")
+    assert repo.degraded()
+
+
+def _write_model_py(path, marker: float, fail_warmup: bool = False):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(
+        f"""
+import numpy as np
+from client_tpu.server.model_repository import Model
+
+
+class MarkerModel(Model):
+    name = "swap"
+    max_batch_size = 0
+    inputs = [{{"name": "INPUT0", "datatype": "FP32", "shape": [-1]}}]
+    outputs = [{{"name": "OUTPUT0", "datatype": "FP32", "shape": [-1]}}]
+
+    def warmup(self):
+        if {fail_warmup!r}:
+            raise RuntimeError("bad weights")
+
+    def execute(self, inputs, parameters):
+        return {{"OUTPUT0": inputs["INPUT0"] + np.float32({marker!r})}}
+
+
+def create_model():
+    return MarkerModel()
+"""
+    )
+
+
+def test_directory_reload_is_atomic_swap(tmp_path):
+    model_py = tmp_path / "swap" / "model.py"
+    _write_model_py(model_py, marker=1.0)
+    repo = ModelRepository(str(tmp_path))
+    repo.scan()
+    v1 = repo.get("swap")
+    x = np.zeros(4, dtype=np.float32)
+    assert repo.get("swap").execute({"INPUT0": x}, {})["OUTPUT0"][0] == 1.0
+    # a load whose warmup fails leaves v1 serving and readiness intact
+    _write_model_py(model_py, marker=2.0, fail_warmup=True)
+    with pytest.raises(InferenceServerException, match="bad weights"):
+        repo.load("swap")
+    assert repo.get("swap") is v1
+    assert repo.is_ready("swap")
+    assert not repo.degraded()
+    # a good load swaps atomically to the new object
+    _write_model_py(model_py, marker=3.0)
+    repo.load("swap")
+    v3 = repo.get("swap")
+    assert v3 is not v1
+    assert v3.execute({"INPUT0": x}, {})["OUTPUT0"][0] == 3.0
+
+
+def test_new_model_load_failure_leaves_no_registry_entry(tmp_path):
+    _write_model_py(tmp_path / "swap" / "model.py", 1.0, fail_warmup=True)
+    repo = ModelRepository(str(tmp_path))
+    with pytest.raises(InferenceServerException):
+        repo.load("swap")
+    assert not repo.is_ready("swap")
+    assert repo.index() == []
+    assert not repo.degraded()
+
+
+# ---------------------------------------------------------------------------
+# core drain: queued work fails cleanly, never as cancelled futures
+
+
+def test_fail_pending_converts_queue_to_clean_503():
+    async def scenario():
+        core = ServerCore(ModelRepository())
+        from client_tpu.server.models import register_builtin_models
+
+        register_builtin_models(core.repository)
+
+        from client_tpu.server.core import CoreRequest, CoreTensor
+
+        def request():
+            data = np.zeros((1, 16), dtype=np.int32)
+            return CoreRequest(
+                model_name="simple",
+                inputs=[
+                    CoreTensor("INPUT0", "INT32", [1, 16], data),
+                    CoreTensor("INPUT1", "INT32", [1, 16], data),
+                ],
+            )
+
+        # first submit starts executing; the rest queue behind it
+        futures = [core.infer_nowait(request()) for _ in range(4)]
+        core.lifecycle.begin_drain()
+        failed = core.fail_pending()
+        results = await asyncio.gather(*futures, return_exceptions=True)
+        core.close()
+        return failed, results
+
+    failed, results = asyncio.run(scenario())
+    drain_errors = [r for r in results if isinstance(r, ServerDrainingError)]
+    assert failed == len(drain_errors) and failed >= 1
+    # nothing surfaced as a cancelled future
+    assert not any(isinstance(r, asyncio.CancelledError) for r in results)
+    for r in results:
+        assert isinstance(r, ServerDrainingError) or not isinstance(
+            r, BaseException
+        )
+
+
+def test_unload_finalize_skipped_when_load_supersedes():
+    """A load() that lands while an unload is still draining supersedes
+    it: the finalizer must neither fail the new model's work nor flip it
+    back to UNAVAILABLE (the rolling-restart unload->load pattern)."""
+
+    async def scenario():
+        repo = ModelRepository()
+        repo.add_model(CountingModel())
+        core = ServerCore(repo)
+        # a stuck census entry forces the drain deadline to expire
+        core.lifecycle.admit("counting")
+        task = core.unload_model("counting", drain_timeout_s=0.05)
+        repo.load("counting")  # supersedes: epoch advances, READY again
+        failed = []
+        core.fail_pending = lambda name=None: failed.append(name) or 0
+        await task
+        core.lifecycle.finish("counting")
+        entry = {m["name"]: m for m in repo.index()}["counting"]
+        core.close()
+        return repo.is_ready("counting"), failed, entry
+
+    ready, failed, entry = asyncio.run(scenario())
+    assert ready
+    assert failed == []  # the new model's queued work was NOT failed
+    assert entry["state"] == "READY" and entry["reason"] == ""
+
+
+def test_drain_reports_expired_deadline():
+    """drain() must return False when the deadline expired — even though
+    fail_pending cleared the queue afterwards (the server CLI logs the
+    expiry off this value)."""
+
+    async def scenario():
+        core = ServerCore(ModelRepository())
+        core.lifecycle.admit("stuck")  # never finishes
+        drained = await core.drain(timeout_s=0.05)
+        core.close()
+        return drained
+
+    assert asyncio.run(scenario()) is False
+
+
+# ---------------------------------------------------------------------------
+# integration: readiness + drain over real front-ends
+
+
+@pytest.fixture()
+def server():
+    with InProcessServer(grpc="aio") as s:
+        yield s
+
+
+def _identity_infer(client, value=3.0, module=httpclient, **kwargs):
+    x = np.array([value], dtype=np.float32)
+    inp = module.InferInput("INPUT0", [1], "FP32")
+    inp.set_data_from_numpy(x)
+    result = client.infer("identity_fp32", [inp], **kwargs)
+    return result.as_numpy("OUTPUT0")
+
+
+def test_ready_flips_during_drain_both_frontends(server):
+    http = httpclient.InferenceServerClient(server.http_url)
+    grpc = grpcclient.InferenceServerClient(server.grpc_url)
+    try:
+        assert http.is_server_ready() and grpc.is_server_ready()
+        server.core.lifecycle.begin_drain()
+        server.core.lifecycle.retry_after_s = 2.0
+        # readiness drops on BOTH front-ends the moment draining starts...
+        assert not http.is_server_ready()
+        assert not grpc.is_server_ready()
+        # ...while liveness stays up (orchestrators must not kill us)
+        assert http.is_server_live()
+        assert grpc.is_server_live()
+        # new inferences: HTTP 503 (+ Retry-After honored as status) and
+        # gRPC UNAVAILABLE — clean rejections, not hangs or resets
+        with pytest.raises(InferenceServerException) as http_error:
+            _identity_infer(http)
+        assert http_error.value.status() == "503"
+        with pytest.raises(InferenceServerException) as grpc_error:
+            _identity_infer(grpc, module=grpcclient)
+        assert "UNAVAILABLE" in (grpc_error.value.status() or "")
+        # the drain is observable: state gauge + rejection counter
+        import urllib.request
+
+        body = urllib.request.urlopen(
+            f"http://{server.http_url}/metrics"
+        ).read().decode()
+        assert "tpu_server_state 1" in body
+        assert "tpu_drain_rejected_total" in body
+        server.core.lifecycle.resume()
+        assert http.is_server_ready()
+        assert _identity_infer(http)[0] == 3.0
+    finally:
+        http.close()
+        grpc.close()
+
+
+def test_ready_includes_retry_after_header(server):
+    import urllib.request
+    from urllib.error import HTTPError
+
+    server.core.lifecycle.begin_drain()
+    try:
+        urllib.request.urlopen(f"http://{server.http_url}/v2/health/ready")
+        raise AssertionError("expected a 503")
+    except HTTPError as e:
+        assert e.code == 503
+        assert e.headers.get("Retry-After") is not None
+    finally:
+        server.core.lifecycle.resume()
+
+
+def test_degraded_repository_flips_readiness(server):
+    http = httpclient.InferenceServerClient(server.http_url)
+    try:
+        model = server.core.repository.peek("identity_fp32")
+        epoch = server.core.repository.unload("identity_fp32")
+        server.core.repository.finish_unload("identity_fp32", epoch)
+        # an intentional unload does not degrade readiness...
+        assert http.is_server_ready()
+        # ...but a failed reload does
+        original_warmup = type(model).warmup
+
+        def boom(self):
+            raise RuntimeError("bad reload")
+
+        type(model).warmup = boom
+        try:
+            with pytest.raises(InferenceServerException):
+                http.load_model("identity_fp32")
+            assert not http.is_server_ready()
+        finally:
+            type(model).warmup = original_warmup
+        http.load_model("identity_fp32")
+        assert http.is_server_ready()
+    finally:
+        http.close()
+
+
+def test_unload_drains_and_reasons_through_client(server):
+    http = httpclient.InferenceServerClient(server.http_url)
+    try:
+        http.unload_model("identity_fp32")
+        assert not http.is_model_ready("identity_fp32")
+        with pytest.raises(InferenceServerException) as exc_info:
+            _identity_infer(http)
+        assert exc_info.value.status() == "503"
+        # the async finalize settles the index entry to "unloaded"
+        deadline = time.monotonic() + 2.0
+        entry = None
+        while time.monotonic() < deadline:
+            index = http.get_model_repository_index()
+            entry = {m["name"]: m for m in index}["identity_fp32"]
+            if entry["reason"] == "unloaded":
+                break
+            time.sleep(0.01)
+        assert entry["state"] == "UNAVAILABLE"
+        assert entry["reason"] == "unloaded"
+        http.load_model("identity_fp32")
+        assert http.is_model_ready("identity_fp32")
+        assert _identity_infer(http, 5.0)[0] == 5.0
+    finally:
+        http.close()
+
+
+class SlowModel(Model):
+    name = "slow"
+    max_batch_size = 0
+    inputs = [{"name": "INPUT0", "datatype": "FP32", "shape": [-1]}]
+    outputs = [{"name": "OUTPUT0", "datatype": "FP32", "shape": [-1]}]
+
+    def __init__(self, delay_s: float):
+        self.delay_s = delay_s
+
+    def execute(self, inputs, parameters):
+        time.sleep(self.delay_s)
+        return {"OUTPUT0": inputs["INPUT0"]}
+
+
+def test_drain_aware_stop_completes_inflight_work():
+    """The InProcessServer.stop() ordering fix: in-flight requests finish
+    inside the drain deadline instead of dying as cancelled futures."""
+    core = ServerCore(ModelRepository())
+    core.repository.add_model(SlowModel(0.4))
+    server = InProcessServer(
+        core=core, grpc=False, builtin_models=False, drain_timeout_s=5.0
+    ).start()
+    client = httpclient.InferenceServerClient(server.http_url)
+    results = []
+
+    def one_request():
+        x = np.array([1.5], dtype=np.float32)
+        inp = httpclient.InferInput("INPUT0", [1], "FP32")
+        inp.set_data_from_numpy(x)
+        try:
+            out = client.infer("slow", [inp]).as_numpy("OUTPUT0")
+            results.append(("ok", float(out[0])))
+        except Exception as e:  # noqa: BLE001 - recorded for assertion
+            results.append(("error", str(e)))
+
+    thread = threading.Thread(target=one_request)
+    thread.start()
+    time.sleep(0.15)  # request is now in flight on the server
+    server.stop()  # drains: readiness false, in-flight completes
+    thread.join(timeout=10)
+    client.close()
+    assert results == [("ok", 1.5)]
+    assert core.lifecycle.state == STOPPED
+
+
+# ---------------------------------------------------------------------------
+# acceptance scenarios (chaos-marked: concurrent traffic, real servers)
+
+
+def _hammer(client, stop_event, failures, successes, value=2.0):
+    while not stop_event.is_set():
+        try:
+            out = _identity_infer(client, value)
+            if out[0] != value:
+                failures.append(f"wrong result: {out[0]!r}")
+            else:
+                successes.append(1)
+        except Exception as e:  # noqa: BLE001 - recorded for assertion
+            failures.append(repr(e))
+
+
+@pytest.mark.chaos
+def test_endpoint_pool_failover_during_drain():
+    """EndpointPool over two servers: draining one mid-load yields zero
+    client-visible failures — requests reroute to the survivor."""
+    with InProcessServer(grpc=False) as a, InProcessServer(grpc=False) as b:
+        client = httpclient.InferenceServerClient(
+            urls=[a.http_url, b.http_url], endpoint_cooldown_s=0.2
+        )
+        stop_event = threading.Event()
+        failures, successes = [], []
+        threads = [
+            threading.Thread(
+                target=_hammer,
+                args=(client, stop_event, failures, successes),
+            )
+            for _ in range(3)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            time.sleep(0.2)
+            a.core.lifecycle.begin_drain()  # primary goes away
+            time.sleep(0.5)
+            a.core.lifecycle.resume()
+            time.sleep(0.2)
+            b.core.lifecycle.begin_drain()  # the other one too
+            time.sleep(0.4)
+            b.core.lifecycle.resume()
+            time.sleep(0.2)
+        finally:
+            stop_event.set()
+            for t in threads:
+                t.join(timeout=10)
+        pool = client._aio_client._pool
+        assert failures == []
+        assert len(successes) > 20
+        assert pool.failovers >= 1
+        client.close()
+
+
+@pytest.mark.chaos
+def test_grpc_endpoint_pool_failover_during_drain():
+    """Same failover contract on the gRPC surface: draining the primary
+    moves traffic to the survivor with zero client-visible failures."""
+    with InProcessServer(http=False, grpc="aio") as a, InProcessServer(
+        http=False, grpc="aio"
+    ) as b:
+        client = grpcclient.InferenceServerClient(
+            urls=[a.grpc_url, b.grpc_url], endpoint_cooldown_s=0.2
+        )
+        stop_event = threading.Event()
+        failures, successes = [], []
+
+        def hammer():
+            while not stop_event.is_set():
+                try:
+                    out = _identity_infer(client, 4.0, module=grpcclient)
+                    if out[0] != 4.0:
+                        failures.append(f"wrong result: {out[0]!r}")
+                    else:
+                        successes.append(1)
+                except Exception as e:  # noqa: BLE001 - recorded
+                    failures.append(repr(e))
+
+        threads = [threading.Thread(target=hammer) for _ in range(2)]
+        for t in threads:
+            t.start()
+        try:
+            time.sleep(0.2)
+            a.core.lifecycle.begin_drain()
+            time.sleep(0.5)
+            a.core.lifecycle.resume()
+            time.sleep(0.2)
+        finally:
+            stop_event.set()
+            for t in threads:
+                t.join(timeout=10)
+        assert failures == []
+        assert len(successes) > 10
+        assert client._pool.failovers >= 1
+        client.close()
+
+
+@pytest.mark.chaos
+def test_rolling_restart_zero_failed_requests():
+    """The acceptance claim, measured: with an EndpointPool over two
+    in-process servers, draining and RESTARTING one mid-load yields zero
+    client-visible failed inferences."""
+    a = InProcessServer(grpc=False).start()
+    b = InProcessServer(grpc=False).start()
+    a_port = a.http_port
+    client = httpclient.InferenceServerClient(
+        urls=[a.http_url, b.http_url], endpoint_cooldown_s=0.2
+    )
+    stop_event = threading.Event()
+    failures, successes = [], []
+    threads = [
+        threading.Thread(
+            target=_hammer, args=(client, stop_event, failures, successes)
+        )
+        for _ in range(3)
+    ]
+    for t in threads:
+        t.start()
+    restarted = None
+    try:
+        time.sleep(0.3)
+        a.stop()  # full drain-aware shutdown of the primary
+        time.sleep(0.4)  # traffic rides on b
+        restarted = InProcessServer(
+            grpc=False, http_port=a_port
+        ).start()  # same address, as a load balancer would see it
+        time.sleep(0.6)  # cooldown passes; probes re-admit the endpoint
+    finally:
+        stop_event.set()
+        for t in threads:
+            t.join(timeout=10)
+        client.close()
+        if restarted is not None:
+            restarted.stop()
+        b.stop()
+    assert failures == []
+    assert len(successes) > 20
+
+
+@pytest.mark.chaos
+def test_drain_with_no_surviving_endpoint_is_clean_503():
+    """When EVERY endpoint is draining, requests fail with a clean
+    503/UNAVAILABLE classification — never cancelled-future tracebacks."""
+    with InProcessServer(grpc=False) as a, InProcessServer(grpc=False) as b:
+        for s in (a, b):
+            s.core.lifecycle.retry_after_s = 0.05
+            s.core.lifecycle.begin_drain()
+        client = httpclient.InferenceServerClient(
+            urls=[a.http_url, b.http_url],
+            endpoint_cooldown_s=0.05,
+            retry_policy=RetryPolicy(
+                max_attempts=2, initial_backoff_s=0.01, max_backoff_s=0.05
+            ),
+        )
+        with pytest.raises(InferenceServerException) as exc_info:
+            _identity_infer(client)
+        token = (exc_info.value.status() or "").rsplit(".", 1)[-1]
+        assert token in ("503", "UNAVAILABLE")
+        assert "cancel" not in str(exc_info.value).lower()
+        client.close()
+        a.core.lifecycle.resume()
+        b.core.lifecycle.resume()
+
+
+@pytest.mark.chaos
+def test_unload_load_under_traffic_no_drops_no_wrong_results(tmp_path):
+    """unload -> load of a directory model under concurrent traffic never
+    returns a wrong-model result or a dropped request (clients retry the
+    503 window away)."""
+    _write_model_py(tmp_path / "swap" / "model.py", marker=1.0)
+    repo = ModelRepository(str(tmp_path))
+    repo.scan()
+    core = ServerCore(repo)
+    server = InProcessServer(
+        core=core, grpc=False, builtin_models=False
+    ).start()
+    client = httpclient.InferenceServerClient(
+        server.http_url,
+        retry_policy=RetryPolicy(
+            max_attempts=12, initial_backoff_s=0.01, max_backoff_s=0.1
+        ),
+    )
+    stop_event = threading.Event()
+    failures, results = [], []
+
+    def hammer():
+        x = np.zeros(4, dtype=np.float32)
+        inp = httpclient.InferInput("INPUT0", [4], "FP32")
+        inp.set_data_from_numpy(x)
+        while not stop_event.is_set():
+            try:
+                out = client.infer("swap", [inp]).as_numpy("OUTPUT0")
+                results.append(float(out[0]))
+            except Exception as e:  # noqa: BLE001 - recorded
+                failures.append(repr(e))
+
+    threads = [threading.Thread(target=hammer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.2)
+        for _ in range(3):
+            client.unload_model("swap")
+            time.sleep(0.05)
+            client.load_model("swap")
+            time.sleep(0.15)
+    finally:
+        stop_event.set()
+        for t in threads:
+            t.join(timeout=10)
+        client.close()
+        server.stop()
+    assert failures == []
+    assert len(results) > 20
+    # the marker is constant across reloads: a mixed/wrong-model result
+    # (partial swap) would show up as a value other than 1.0
+    assert set(results) == {1.0}
+
+
+@pytest.mark.chaos
+def test_perf_cli_rolling_restart_reports_cycles(capsys):
+    """--rolling-restart e2e: the CLI cycles unload/load against a live
+    server, the run completes, and the summary carries the cycle count
+    plus the dropped/rerouted split."""
+    import json as jsonlib
+
+    from client_tpu.perf import cli
+
+    with InProcessServer(grpc=False) as server:
+        rc = cli.main(
+            [
+                "-m",
+                "identity_fp32",
+                "-u",
+                server.http_url,
+                "--shape",
+                "INPUT0:4",
+                "--concurrency-range",
+                "2",
+                "--measurement-interval",
+                "400",
+                "--max-trials",
+                "2",
+                "--rolling-restart",
+                "0.15",
+                "--json-summary",
+            ]
+        )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Rolling restart:" in out
+    summary = jsonlib.loads(out.strip().splitlines()[-1])
+    assert summary["rolling_restart_cycles"] >= 1
+    assert "dropped_unavailable" in summary and "rerouted" in summary

@@ -6,9 +6,10 @@ read goes through an injectable ``clock``/``clock_ns`` callable. This
 lint bans *direct calls* to the ``time`` module's clock functions inside
 ``client_tpu/observability/`` (the tracer AND the Prometheus registry in
 ``metrics.py``), ``client_tpu/resilience/``, ``client_tpu/scheduling/``
-(queue deadlines and rate-limiter waits take "now" from the caller), and
-the clock-injected perf-harness modules listed in ``TARGET_FILES`` (the
-server-metrics collector).
+(queue deadlines and rate-limiter waits take "now" from the caller),
+``client_tpu/lifecycle/`` (drain deadlines and endpoint cooldowns run on
+fake clocks), and the clock-injected perf-harness modules listed in
+``TARGET_FILES`` (the server-metrics collector).
 
 References are fine — ``clock: Callable = time.monotonic`` as a default
 parameter is exactly the injectable pattern — only Call nodes are
@@ -22,6 +23,7 @@ import os
 from typing import List, Tuple
 
 TARGET_DIRS = (
+    os.path.join("client_tpu", "lifecycle"),
     os.path.join("client_tpu", "observability"),
     os.path.join("client_tpu", "resilience"),
     os.path.join("client_tpu", "scheduling"),
