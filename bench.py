@@ -246,6 +246,69 @@ def _bench_northstar(server) -> dict:
     return result
 
 
+def _bench_stage_attribution(server, seconds: float = 3.0) -> dict:
+    """Per-stage server-CPU decomposition of the wire path (PR-6): a
+    SHORT instrumented pass AFTER the headline run — stage-CPU
+    accounting on, loopback gRPC load, per-stage deltas divided by the
+    stage's own sampled request count. Kept separate so the instrument
+    never perturbs the headline number. Returns {} on any failure.
+
+    Emitted as ``server_stage_cpu_us`` in the bench JSON line (schema in
+    PERF.md) so BENCH_r06+ carry the attribution, not just totals —
+    ROADMAP item 3 can then show WHICH stage shrinks.
+    """
+    import numpy as np
+
+    import client_tpu.grpc.aio as grpcclient
+
+    prof = server.core.profiling
+    before = prof.snapshot()
+    clock_mode = ""
+    try:
+        prof.enable()
+        clock_mode = prof.clock_mode
+        in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+        in1 = np.ones([1, 16], dtype=np.int32)
+
+        async def drive():
+            async with grpcclient.InferenceServerClient(
+                server.grpc_url
+            ) as client:
+                def make_inputs():
+                    a = grpcclient.InferInput("INPUT0", [1, 16], "INT32")
+                    a.set_data_from_numpy(in0)
+                    b = grpcclient.InferInput("INPUT1", [1, 16], "INT32")
+                    b.set_data_from_numpy(in1)
+                    return [a, b]
+
+                stop_at = time.monotonic() + seconds
+
+                async def worker():
+                    inputs = make_inputs()
+                    while time.monotonic() < stop_at:
+                        await client.infer("simple", inputs)
+
+                await asyncio.gather(*[worker() for _ in range(8)])
+
+        asyncio.run(drive())
+    except Exception as e:  # noqa: BLE001 - attribution is best-effort
+        print(f"bench: stage attribution failed: {e}", file=sys.stderr)
+        return {}
+    finally:
+        prof.disable()
+    after = prof.snapshot()
+    stages = {}
+    for stage, entry in after.items():
+        base = before.get(stage, {"count": 0, "cpu_ns": 0})
+        d_count = entry["count"] - base["count"]
+        d_cpu = entry["cpu_ns"] - base["cpu_ns"]
+        if d_count > 0:
+            stages[stage] = round(d_cpu / d_count / 1e3, 2)
+    if not stages:
+        return {}
+    return {"server_stage_cpu_us": stages, "stage_cpu_clock": clock_mode}
+
+
 def _bench_inprocess(server) -> float:
     """The `simple` tracker row's in-process twin."""
     import numpy as np
@@ -364,6 +427,10 @@ def main() -> int:
             print(f"bench: in-process measurement failed: {e}", file=sys.stderr)
             inproc = 0.0
 
+        # Per-stage wire-path decomposition (separate instrumented pass;
+        # the headline above ran with accounting off).
+        stage_attribution = _bench_stage_attribution(server)
+
     value = round(result["throughput"], 2)
     line = {
         "metric": (
@@ -397,6 +464,9 @@ def main() -> int:
         line["server_cpu_us_per_req"] = round(server_cpu / count * 1e6, 1)
     if inproc > 0:
         line["inproc_us_per_req"] = round(1e6 / inproc, 1)
+    # Per-stage decomposition of the wire path's server CPU (us/req per
+    # stage; "rpc" is per non-inference call). Schema: PERF.md PR-6.
+    line.update(stage_attribution)
     # Contention caveat: with few cores the client, server wire threads,
     # and model share the core budget, so ratio_vs_inproc is a relative
     # tracker, not an isolated-server measurement (PERF.md round 5).

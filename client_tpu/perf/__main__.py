@@ -1,0 +1,6 @@
+"""``python -m client_tpu.perf`` — the perf-analyzer-tpu CLI."""
+
+from client_tpu.perf.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -266,6 +266,31 @@ def build_parser() -> argparse.ArgumentParser:
         help="metrics endpoint (host:port[/metrics]); default: the -u "
         "host/port for HTTP runs, port 8000 on the -u host otherwise",
     )
+    parser.add_argument(
+        "--profile-server",
+        action="store_true",
+        help="enable the server's per-stage CPU accounting for this run "
+        "(POST /v2/debug/profiling on the metrics host; restored after) "
+        "and print a 'Wire-gap attribution' table decomposing server "
+        "CPU us/req by stage; implies --collect-metrics and "
+        "--stage-breakdown",
+    )
+    parser.add_argument(
+        "--flamegraph-out",
+        default=None,
+        metavar="PATH",
+        help="capture a wall-stack sample of the server during the "
+        "measurement (GET /v2/debug/profile) and write collapsed stacks "
+        "(flamegraph.pl / speedscope 'import' format) to PATH; implies "
+        "--profile-server",
+    )
+    parser.add_argument(
+        "--profile-hz",
+        type=float,
+        default=99.0,
+        help="sampling rate for --flamegraph-out (the server's overhead "
+        "guard may lower the effective rate)",
+    )
     from client_tpu.perf.distributed import topology_from_env
 
     env_world_size, env_rank, env_coordinator = topology_from_env()
@@ -342,6 +367,24 @@ async def run(args) -> int:
     )
     from client_tpu.perf.sequence import SequenceManager
 
+    if args.flamegraph_out:
+        args.profile_server = True
+    if args.profile_server:
+        if args.service_kind != "kserve":
+            # named error BEFORE the implied flags below trigger the
+            # generic --stage-breakdown message for a flag the user
+            # never passed
+            print(
+                "error: --profile-server/--flamegraph-out need the "
+                "kserve http/grpc clients (server debug endpoints + "
+                "client-side spans)",
+                file=sys.stderr,
+            )
+            return 2
+        # the attribution table reads against the client stage table and
+        # arrives via the /metrics scrape — imply both collection modes
+        args.stage_breakdown = True
+        args.collect_metrics = True
     want_tracing = args.stage_breakdown or args.trace_export_file
     if want_tracing and args.service_kind != "kserve":
         print(
@@ -361,6 +404,9 @@ async def run(args) -> int:
     tracer = None
     collector = None
     restart_driver = None
+    prev_profiling = None
+    profiling_clock_mode = ""
+    flamegraph_task = None
     if args.service_kind == "openai":
         backend = create_backend("openai", args.url, endpoint=args.endpoint)
     elif args.service_kind in ("tfserving", "torchserve"):
@@ -432,6 +478,30 @@ async def run(args) -> int:
             await collector.start()
             if args.verbose:
                 print(f"collecting server metrics from {collector.url}")
+        if args.profile_server:
+            # Flip the server's stage-CPU accounting on for this run
+            # (restored in the finally); the previous config also tells
+            # us which clock the server calibrated to, for the report.
+            from client_tpu.perf.metrics_collector import set_stage_cpu
+
+            toggled = await set_stage_cpu(collector.url, True)
+            if toggled is None:
+                print(
+                    "warning: could not enable server stage-CPU "
+                    f"accounting via {collector.url} (is the HTTP "
+                    "front-end reachable?); the attribution table will "
+                    "be empty",
+                    file=sys.stderr,
+                )
+            else:
+                prev_profiling = toggled["previous"]
+                profiling_clock_mode = toggled["current"].get("clock", "")
+                if args.verbose:
+                    print(
+                        "server stage-CPU accounting enabled "
+                        f"(clock: {profiling_clock_mode}, was "
+                        f"{prev_profiling.get('stage_cpu')})"
+                    )
         metadata = await backend.get_model_metadata(
             args.model_name, args.model_version
         )
@@ -566,6 +636,29 @@ async def run(args) -> int:
                     f"'{args.model_name}' every {args.rolling_restart:g}s"
                 )
 
+        if args.flamegraph_out:
+            # Sample the server mid-measurement: started HERE — after
+            # metadata/config/data setup, right before the load managers
+            # launch — so the capture window overlaps real load, not the
+            # idle server a slow setup would otherwise hand it.
+            from client_tpu.perf.metrics_collector import fetch_profile
+
+            profile_duration_s = min(
+                5.0, max(0.25, args.measurement_interval / 1000.0)
+            )
+
+            async def _capture_flamegraph():
+                await asyncio.sleep(0.5)
+                return await fetch_profile(
+                    collector.url,
+                    duration_s=profile_duration_s,
+                    hz=args.profile_hz,
+                )
+
+            flamegraph_task = asyncio.get_running_loop().create_task(
+                _capture_flamegraph()
+            )
+
         latency_threshold_us = (
             args.latency_threshold * 1000 if args.latency_threshold else None
         )
@@ -691,6 +784,32 @@ async def run(args) -> int:
             print(format_server_metrics(server_summary))
             if collector.scrape_errors and collector.last_error:
                 print(f"  last scrape error: {collector.last_error}")
+        if args.profile_server and server_summary is not None:
+            from client_tpu.perf.report import format_wire_gap
+
+            print()
+            print(
+                format_wire_gap(
+                    server_summary, clock_mode=profiling_clock_mode
+                )
+            )
+        if flamegraph_task is not None:
+            collapsed = await flamegraph_task
+            flamegraph_task = None
+            if collapsed:
+                with open(args.flamegraph_out, "w") as f:
+                    f.write(collapsed)
+                print(
+                    f"wrote server flamegraph collapsed stacks to "
+                    f"{args.flamegraph_out} (flamegraph.pl or "
+                    "speedscope.app can open it)"
+                )
+            else:
+                print(
+                    "warning: server profile capture failed; no "
+                    "flamegraph written",
+                    file=sys.stderr,
+                )
         if tracer is not None:
             # the ClientMetrics snapshot every traced call feeds: error/
             # retry counts + the client-side latency histogram
@@ -743,6 +862,12 @@ async def run(args) -> int:
                 summary_doc["server_duty_avg"] = server_summary.duty_avg
                 summary_doc["server_duty_max"] = server_summary.duty_max
                 summary_doc["server_batch_avg"] = server_summary.batch_avg
+                stage_us = server_summary.stage_cpu_us()
+                if stage_us:
+                    summary_doc["server_stage_cpu_us"] = {
+                        stage: round(us, 2)
+                        for stage, us in sorted(stage_us.items())
+                    }
             print(json.dumps(summary_doc))
         return 0
     except InferenceServerException as e:
@@ -753,6 +878,13 @@ async def run(args) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 1
     finally:
+        if flamegraph_task is not None:
+            flamegraph_task.cancel()
+        if prev_profiling is not None and not prev_profiling.get("stage_cpu"):
+            # restore the server's pre-run profiling setting (default off)
+            from client_tpu.perf.metrics_collector import set_stage_cpu
+
+            await set_stage_cpu(collector.url, False)
         if restart_driver is not None:
             # no-op when already stopped above; on an aborted run this
             # also reloads the model so the server is left serving

@@ -244,7 +244,96 @@ class MetricsCollector:
             - counter_total(first.get("tpu_inference_request_failure"), match)
         )
         out.window_s = max(0.0, (last_ns - first_ns) / 1e9)
+
+        # Per-stage thread-CPU deltas (tpu_request_cpu_seconds{stage},
+        # populated when --profile-server enabled the accounting). The
+        # stage label set is discovered from the last scrape.
+        stage_family = last.get("tpu_request_cpu_seconds")
+        if stage_family is not None:
+            stages = sorted(
+                {
+                    s.labels["stage"]
+                    for s in stage_family.samples
+                    if "stage" in s.labels
+                }
+            )
+            first_family = first.get("tpu_request_cpu_seconds")
+            for stage in stages:
+                a = histogram_totals(first_family, {"stage": stage})
+                b = histogram_totals(stage_family, {"stage": stage})
+                count = b["count"] - a["count"]
+                cpu_s = b["sum"] - a["sum"]
+                if count > 0:
+                    out.stage_cpu[stage] = {"count": count, "cpu_s": cpu_s}
         return out
+
+
+# -- server profiling control (--profile-server / --flamegraph-out) ----------
+
+
+def server_base_url(url: str) -> str:
+    """host:port / http://host:port[/metrics] -> http://host:port."""
+    if not url.startswith("http://") and not url.startswith("https://"):
+        url = f"http://{url}"
+    scheme, rest = url.split("://", 1)
+    return f"{scheme}://{rest.split('/', 1)[0]}"
+
+
+async def set_stage_cpu(url: str, enabled: bool) -> Optional[Dict]:
+    """Toggle the server's stage-CPU accounting via
+    ``POST /v2/debug/profiling``; returns ``{"previous": ..,
+    "current": ..}`` config dicts (the caller restores ``previous``
+    after the run; ``current`` carries the calibrated clock mode), or
+    None when the endpoint is unreachable — profiling degrades, the run
+    proceeds."""
+    import aiohttp
+
+    base = server_base_url(url)
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{base}/v2/debug/profiling") as resp:
+                previous = await resp.json()
+                if resp.status != 200:
+                    return None
+            async with session.post(
+                f"{base}/v2/debug/profiling", json={"stage_cpu": enabled}
+            ) as resp:
+                current = await resp.json()
+                if resp.status != 200:
+                    return None
+        return {"previous": previous, "current": current}
+    except Exception:  # noqa: BLE001 - profiling is best-effort
+        return None
+
+
+async def fetch_profile(
+    url: str,
+    duration_s: float,
+    hz: float = 99.0,
+    fmt: str = "collapsed",
+) -> Optional[str]:
+    """Run the server's on-demand sampler (``GET /v2/debug/profile``)
+    and return the export text; None on any failure."""
+    import aiohttp
+
+    base = server_base_url(url)
+    try:
+        timeout = aiohttp.ClientTimeout(total=duration_s + 30.0)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            async with session.get(
+                f"{base}/v2/debug/profile",
+                params={
+                    "duration_s": f"{duration_s:g}",
+                    "hz": f"{hz:g}",
+                    "format": fmt,
+                },
+            ) as resp:
+                text = await resp.text()
+                if resp.status != 200:
+                    return None
+                return text
+    except Exception:  # noqa: BLE001 - profiling is best-effort
+        return None
 
 
 def _bucket_delta(

@@ -156,6 +156,21 @@ class ServerMetricsSummary:
     )
     success_count: int = 0
     failure_count: int = 0
+    # per-stage thread-CPU accounting deltas (--profile-server): stage ->
+    # {"count": sampled bookings, "cpu_s": seconds}. cpu_s/count is the
+    # per-request mean for that stage (stride sampling keeps it unbiased)
+    stage_cpu: Dict[str, Dict[str, float]] = dataclasses.field(
+        default_factory=dict
+    )
+
+    def stage_cpu_us(self) -> Dict[str, float]:
+        """Per-stage thread-CPU microseconds per request (mean over the
+        stage's sampled bookings); empty when accounting was off."""
+        return {
+            stage: entry["cpu_s"] / entry["count"] * 1e6
+            for stage, entry in self.stage_cpu.items()
+            if entry.get("count")
+        }
 
 
 # Status tokens that classify a failed request as shed by admission

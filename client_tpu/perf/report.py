@@ -208,6 +208,76 @@ def format_server_metrics(summary: ServerMetricsSummary) -> str:
     return "\n".join(lines)
 
 
+def format_wire_gap(
+    summary: ServerMetricsSummary,
+    clock_mode: str = "",
+    inproc_us_per_req: float = 0.0,
+) -> str:
+    """The "Wire-gap attribution" table (``--profile-server``): the
+    server's per-stage thread-CPU µs per request, from the
+    ``tpu_request_cpu_seconds{stage}`` deltas the collector scraped.
+
+    Splits the stages into wire-only work (decode/encode/rpc — CPU the
+    in-process path never pays: the directly-attributable slice of the
+    wire gap) and shared work (assembly/device_put/compute/readback).
+    ``inproc_us_per_req`` (when the caller measured an in-process
+    baseline, e.g. bench.py) adds the explicit gap line.
+    """
+    from client_tpu.observability.profiling import STAGES, WIRE_ONLY_STAGES
+
+    per_request = summary.stage_cpu_us()
+    header = "Wire-gap attribution (server stage CPU per request"
+    if clock_mode and clock_mode != "thread_cpu":
+        header += f"; clock: {clock_mode}"
+    header += "):"
+    if not per_request:
+        return (
+            header
+            + "\n  no stage-CPU samples captured (is the server's"
+            " /v2/debug/profiling endpoint reachable?)"
+        )
+    lines = [header]
+    ordered = [s for s in STAGES if s in per_request] + sorted(
+        set(per_request) - set(STAGES)
+    )
+    inference_stages = [s for s in ordered if s != "rpc"]
+    total_us = sum(per_request[s] for s in inference_stages)
+    for stage in ordered:
+        us = per_request[stage]
+        entry = summary.stage_cpu[stage]
+        if stage == "rpc":
+            # booked per method call, not per request: report the run
+            # total so scrape/statistics overhead stays visible
+            lines.append(
+                f"  {stage:<15s} {entry['cpu_s'] * 1e3:8.2f} ms total "
+                f"({int(entry['count'])} non-inference calls)"
+            )
+            continue
+        share = us / total_us * 100 if total_us else 0.0
+        line = f"  {stage:<15s} {us:8.1f} us/req  ({share:4.1f}%)"
+        if stage == "queue_wait" and summary.avg_queue_us:
+            line += f"  [wall {summary.avg_queue_us:.1f} us/req]"
+        lines.append(line)
+    lines.append(f"  {'total':<15s} {total_us:8.1f} us/req")
+    wire_stages = [s for s in inference_stages if s in WIRE_ONLY_STAGES]
+    shared_stages = [
+        s for s in inference_stages if s not in WIRE_ONLY_STAGES
+    ]
+    wire_us = sum(per_request[s] for s in wire_stages)
+    shared_us = total_us - wire_us
+    lines.append(
+        f"  wire-only stages ({'+'.join(wire_stages)}) {wire_us:.1f} "
+        f"us/req vs shared stages ({'+'.join(shared_stages)}) "
+        f"{shared_us:.1f} us/req"
+    )
+    if inproc_us_per_req > 0:
+        lines.append(
+            f"  in-process baseline {inproc_us_per_req:.1f} us/req -> "
+            f"directly-attributed wire gap {wire_us:.1f} us/req"
+        )
+    return "\n".join(lines)
+
+
 def format_client_metrics(snapshot: Dict[str, Any]) -> str:
     """The "Client metrics" block: the tracer's ClientMetrics snapshot —
     error/retry counts and the client-side latency histogram the

@@ -402,16 +402,26 @@ def handle_method(core: ServerCore, method: str, request_proto):
     """Run one non-inference method on a decoded request proto.
 
     Returns the response proto; raises :class:`RpcError` on failure.
+    Thread-CPU books under the "rpc" profiling stage when stage-CPU
+    accounting is enabled: statistics/metadata scrapes share the serving
+    threads, so their cycles are part of the wire path's CPU bill and
+    must show up in the attribution, not hide in the unaccounted rest.
+    Both gRPC faces route here (grpc.aio directly, the native C++
+    front-end via :func:`handle_method_bytes`), so one bracket covers
+    both.
     """
     entry = METHODS.get(method)
     if entry is None:
         raise RpcError(GRPC_UNIMPLEMENTED, f"unknown method '{method}'")
-    try:
-        return entry[1](core, request_proto)
-    except RpcError:
-        raise
-    except InferenceServerException as e:
-        raise RpcError(status_code_for(e.message()), e.message()) from e
+    from client_tpu.observability.profiling import stage_scope
+
+    with stage_scope(core.profiling, "rpc"):
+        try:
+            return entry[1](core, request_proto)
+        except RpcError:
+            raise
+        except InferenceServerException as e:
+            raise RpcError(status_code_for(e.message()), e.message()) from e
 
 
 def handle_method_bytes(core: ServerCore, method: str, payload: bytes) -> bytes:

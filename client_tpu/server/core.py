@@ -641,14 +641,44 @@ class _ModelBatcher:
         loop = asyncio.get_running_loop()
         model, core = self.model, self.core
         stats = core._stats_for(model.name)
+        prof = core.profiling
         exec_start = time.monotonic_ns()
         requests = [e[0] for e in entries]
+        n = len(entries)
+        # one take() decision covers the whole batch's stage brackets
+        measured = prof.take()
         try:
-            merged = self.meta.merge_inputs(requests)
+            if measured:
+                # queue_wait is a wall phenomenon (no thread attached):
+                # CPU books 0, the wall total is the batch's queued ns
+                prof.account(
+                    "queue_wait",
+                    0,
+                    wall_ns=sum(exec_start - e[4] for e in entries),
+                    count=n,
+                )
+                a0 = prof.cpu_now()
+                merged = self.meta.merge_inputs(requests)
+                prof.account("batch_assembly", prof.cpu_now() - a0, count=n)
+            else:
+                merged = self.meta.merge_inputs(requests)
 
             def _run():
+                # compute vs readback split on the executor thread (its
+                # own thread-CPU clock — exactly the CPU this stage burnt)
                 with model.placement():
-                    return _to_host(model.execute(merged, requests[0].parameters))
+                    if not measured:
+                        return _to_host(
+                            model.execute(merged, requests[0].parameters)
+                        )
+                    c0 = prof.cpu_now()
+                    raw = model.execute(merged, requests[0].parameters)
+                    c1 = prof.cpu_now()
+                    host = _to_host(raw)
+                    c2 = prof.cpu_now()
+                    prof.account("compute", c1 - c0, count=n)
+                    prof.account("readback", c2 - c1, count=n)
+                    return host
 
             raw = await loop.run_in_executor(core._executor, _run)
             infer_end = time.monotonic_ns()
@@ -674,7 +704,7 @@ class _ModelBatcher:
                     sliced = raw
                 else:
                     sliced = {k: v[offset : offset + rows] for k, v in raw.items()}
-                response = core._package_outputs(model, request, sliced)
+                response = core._package_profiled(model, request, sliced)
                 out_end = time.monotonic_ns()
                 stats.record_success(
                     rows,
@@ -735,6 +765,15 @@ class ServerCore:
         from client_tpu.server.metrics import ServerMetrics
 
         self.metrics = ServerMetrics(self)
+        # Per-stage thread-CPU accounting (observability.profiling):
+        # default-off; while disabled every stage event is one attribute
+        # check. Enabled via POST /v2/debug/profiling (the perf
+        # harness's --profile-server does this for the run's duration).
+        from client_tpu.observability.profiling import StageCpuAccounting
+
+        self.profiling = StageCpuAccounting(
+            metrics_hook=self.metrics.observe_stage_cpu
+        )
         # Graceful lifecycle: SERVING -> DRAINING -> STOPPED state plus
         # the in-flight census every execution path reports into, so a
         # drain can WAIT for work instead of cancelling it.
@@ -1096,8 +1135,34 @@ class ServerCore:
                     f"unexpected inference input '{t.name}' for model "
                     f"'{model.name}'"
                 )
+        prof = self.profiling
         with model.placement():
-            return _to_host(model.execute(inputs, request.parameters))
+            if not prof.take():
+                return _to_host(model.execute(inputs, request.parameters))
+            c0 = prof.cpu_now()
+            raw = model.execute(inputs, request.parameters)
+            c1 = prof.cpu_now()
+            host = _to_host(raw)
+            c2 = prof.cpu_now()  # before accounting, like the batch paths
+            prof.account("compute", c1 - c0)
+            prof.account("readback", c2 - c1)
+            return host
+
+    def _package_profiled(
+        self, model: Model, request: CoreRequest, raw: Dict[str, np.ndarray]
+    ) -> CoreResponse:
+        """_package_outputs with its thread-CPU booked under "package" —
+        deliberately distinct from the front-ends' "encode" (wire
+        serialization): packaging is paid by the in-process path too, so
+        folding them together would overstate the wire-only CPU."""
+        prof = self.profiling
+        if not prof.take():
+            return self._package_outputs(model, request, raw)
+        c0 = prof.cpu_now()
+        try:
+            return self._package_outputs(model, request, raw)
+        finally:
+            prof.account("package", prof.cpu_now() - c0)
 
     def _package_outputs(
         self, model: Model, request: CoreRequest, raw: Dict[str, np.ndarray]
@@ -1390,11 +1455,34 @@ class ServerCore:
             )
         exec_start = time.monotonic_ns()
         reqs = [requests[idx] for idx, _rows in chunk]
+        prof = self.profiling
+        n = len(chunk)
         try:
             try:
-                merged = meta.merge_inputs(reqs)
-                with model.placement():
-                    raw = _to_host(model.execute(merged, reqs[0].parameters))
+                if prof.take():
+                    prof.account(
+                        "queue_wait",
+                        0,
+                        wall_ns=(exec_start - arrival_ns) * n,
+                        count=n,
+                    )
+                    a0 = prof.cpu_now()
+                    merged = meta.merge_inputs(reqs)
+                    a1 = prof.cpu_now()
+                    with model.placement():
+                        raw = model.execute(merged, reqs[0].parameters)
+                        a2 = prof.cpu_now()
+                        raw = _to_host(raw)
+                    a3 = prof.cpu_now()
+                    prof.account("batch_assembly", a1 - a0, count=n)
+                    prof.account("compute", a2 - a1, count=n)
+                    prof.account("readback", a3 - a2, count=n)
+                else:
+                    merged = meta.merge_inputs(reqs)
+                    with model.placement():
+                        raw = _to_host(
+                            model.execute(merged, reqs[0].parameters)
+                        )
             finally:
                 if resources:
                     self.rate_limiter.release(resources)
@@ -1421,7 +1509,7 @@ class ServerCore:
                     sliced = {
                         k: v[offset : offset + rows] for k, v in raw.items()
                     }
-                results[idx] = self._package_outputs(model, request, sliced)
+                results[idx] = self._package_profiled(model, request, sliced)
                 _trace_stages(
                     request.trace,
                     arrival_ns,
@@ -1479,7 +1567,7 @@ class ServerCore:
             raw = self._run_single(model, request, ticket)
         t1 = time.monotonic_ns()
         self.add_busy_ns(model, t1 - t0)
-        response = self._package_outputs(model, request, raw)
+        response = self._package_profiled(model, request, raw)
         t2 = time.monotonic_ns()
         rows = self._resolve_batch(model, request)
         self.metrics.observe_execution(model.name, rows)
@@ -1548,7 +1636,7 @@ class ServerCore:
                 self._executor, self._run_single, model, request, ticket
             )
             t2 = time.monotonic_ns()
-            response = self._package_outputs(model, request, raw)
+            response = self._package_profiled(model, request, raw)
             t3 = time.monotonic_ns()
         except Exception as e:
             # admission rejections (queue timeout) were booked already
@@ -1570,6 +1658,8 @@ class ServerCore:
             infer_ns=t2 - t1,
             out_ns=t3 - t2,
         )
+        if self.profiling.take():
+            self.profiling.account("queue_wait", 0, wall_ns=t1 - t0)
         _trace_stages(request.trace, t0, t1, t2, t3)
         return response
 
@@ -1648,13 +1738,22 @@ class ServerCore:
                 ticket.started()
             self._check_deadline(model, request)
             inputs = {t.name: t.data for t in request.inputs}
+            prof = self.profiling
             resume_ns = time.monotonic_ns()
+            # Decoupled models run as async generators on the loop
+            # thread; the loop thread's CPU between resuming the model
+            # and its next item is the step's compute (an approximation:
+            # other tasks interleaved on the loop contaminate it).
+            measure_step = prof.take()
+            cpu_resume = prof.cpu_now() if measure_step else 0
             async for raw in model.execute_decoupled(inputs, request.parameters):
                 final = raw.pop("__final__", False) if isinstance(raw, dict) else False
                 p0 = time.monotonic_ns()
                 model_wait_ns += p0 - resume_ns
+                if measure_step:
+                    prof.account("compute", prof.cpu_now() - cpu_resume)
                 if raw:
-                    response = self._package_outputs(model, request, raw)
+                    response = self._package_profiled(model, request, raw)
                 else:
                     response = CoreResponse(
                         model_name=model.name,
@@ -1685,6 +1784,9 @@ class ServerCore:
                 yield response
                 # back from the consumer; the next await is model time
                 resume_ns = time.monotonic_ns()
+                measure_step = prof.take()
+                if measure_step:
+                    cpu_resume = prof.cpu_now()
         except (asyncio.CancelledError, GeneratorExit):
             # Task cancellation (gRPC stream teardown) and generator close
             # (HTTP/OpenAI front-end client disconnect): if the final

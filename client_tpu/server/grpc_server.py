@@ -209,13 +209,23 @@ class _Servicer(GRPCInferenceServiceServicer):
     async def ModelInfer(self, request, context):
         await self._chaos_gate(context, "ModelInfer")
         trace = self._begin_trace(context, request)
+        prof = self.core.profiling
+        # one take() covers this request's decode AND encode brackets
+        measured = prof.take()
         try:
             # drain fast path: UNAVAILABLE before paying decode cost
             # (outside the inner try: a drain rejection is booked on its
             # own counter, not as a malformed-request frontend error)
             self.core.reject_if_draining(request.model_name)
             try:
-                core_request = build_core_request(self.core, request)
+                if measured:
+                    decode_cpu0 = prof.cpu_now()
+                    core_request = build_core_request(self.core, request)
+                    prof.account(
+                        "frontend_decode", prof.cpu_now() - decode_cpu0
+                    )
+                else:
+                    core_request = build_core_request(self.core, request)
             except InferenceServerException:
                 # rejected before reaching the engine: the statistics
                 # extension never sees it, the front-end counter does
@@ -235,6 +245,11 @@ class _Servicer(GRPCInferenceServiceServicer):
             raise
         if trace is not None:
             trace.end()
+        if measured:
+            encode_cpu0 = prof.cpu_now()
+            response = build_proto_response(core_response)
+            prof.account("encode", prof.cpu_now() - encode_cpu0)
+            return response
         return build_proto_response(core_response)
 
     async def ModelStreamInfer(self, request_iterator, context):
@@ -243,12 +258,20 @@ class _Servicer(GRPCInferenceServiceServicer):
             # (connection-loss semantics), not a per-request error reply
             await self._chaos_gate(context, "ModelStreamInfer")
             trace = self._begin_trace(context, request)
+            prof = self.core.profiling
             try:
                 # drain-aware: rejected stream requests surface as clean
                 # in-band errors, never cancelled streams
                 self.core.reject_if_draining(request.model_name)
                 try:
-                    core_request = build_core_request(self.core, request)
+                    if prof.take():
+                        decode_cpu0 = prof.cpu_now()
+                        core_request = build_core_request(self.core, request)
+                        prof.account(
+                            "frontend_decode", prof.cpu_now() - decode_cpu0
+                        )
+                    else:
+                        core_request = build_core_request(self.core, request)
                 except InferenceServerException:
                     self.core.metrics.observe_frontend_error("grpc")
                     raise
@@ -256,8 +279,14 @@ class _Servicer(GRPCInferenceServiceServicer):
                 async for core_response in self.core.infer_decoupled(
                     core_request
                 ):
+                    if prof.take():
+                        encode_cpu0 = prof.cpu_now()
+                        wire_response = build_proto_response(core_response)
+                        prof.account("encode", prof.cpu_now() - encode_cpu0)
+                    else:
+                        wire_response = build_proto_response(core_response)
                     yield pb.ModelStreamInferResponse(
-                        infer_response=build_proto_response(core_response)
+                        infer_response=wire_response
                     )
             except InferenceServerException as e:
                 if trace is not None:

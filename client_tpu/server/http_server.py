@@ -128,6 +128,9 @@ class HttpServer:
         self.app = web.Application(
             client_max_size=1 << 30, middlewares=middlewares
         )
+        # one sampling run at a time (a second concurrent /v2/debug/profile
+        # gets 409 instead of doubling the sampling overhead)
+        self._profiling_busy = False
         self._add_routes()
 
     def _add_routes(self) -> None:
@@ -192,6 +195,11 @@ class HttpServer:
         g("/v2/logging", _guarded(self.handle_get_logging))
         p("/v2/logging", _guarded(self.handle_update_logging))
         g("/metrics", _guarded(self.handle_metrics))
+        # Hot-path profiling (observability.profiling): stage-CPU
+        # accounting toggle + the on-demand wall-stack sampler.
+        g("/v2/debug/profiling", _guarded(self.handle_get_profiling))
+        p("/v2/debug/profiling", _guarded(self.handle_update_profiling))
+        g("/v2/debug/profile", _guarded(self.handle_profile))
         # OpenAI-compatible front-end (chat/completions + SSE streaming).
         from client_tpu.server.openai_frontend import OpenAiFrontend
 
@@ -275,12 +283,18 @@ class HttpServer:
     # -- statistics ----------------------------------------------------------
 
     async def handle_stats(self, request):
-        return web.json_response(
-            self.core.statistics(
-                request.match_info.get("model", ""),
-                request.match_info.get("version", ""),
+        # "rpc" profiling stage (same booking the gRPC faces make in
+        # _grpc_codec.handle_method): the statistics snapshots the perf
+        # harness takes per window are part of the server's CPU bill
+        from client_tpu.observability.profiling import stage_scope
+
+        with stage_scope(self.core.profiling, "rpc"):
+            return web.json_response(
+                self.core.statistics(
+                    request.match_info.get("model", ""),
+                    request.match_info.get("version", ""),
+                )
             )
-        )
 
     async def handle_metrics(self, request):
         """Prometheus text metrics, rendered from the core's registry
@@ -289,10 +303,16 @@ class HttpServer:
         MetricsManager, reference metrics_manager.h:45-92). The registry's
         collect hook takes exactly one statistics snapshot per scrape and
         derives duty cycle from the core's monotone busy-ns counter, so
-        concurrent scrapers never corrupt each other's deltas."""
-        return web.Response(
-            text=self.core.metrics.render(), content_type="text/plain"
-        )
+        concurrent scrapers never corrupt each other's deltas. Render
+        CPU books under the "rpc" profiling stage (like the gRPC faces'
+        non-inference methods): with --profile-server the harness's own
+        scrape cost shows in the attribution instead of hiding."""
+        from client_tpu.observability.profiling import stage_scope
+
+        with stage_scope(self.core.profiling, "rpc"):
+            return web.Response(
+                text=self.core.metrics.render(), content_type="text/plain"
+            )
 
     # -- shared memory -------------------------------------------------------
 
@@ -390,6 +410,126 @@ class HttpServer:
         self.core.log_settings.update(validate_log_settings(updates))
         return web.json_response(self.core.log_settings)
 
+    # -- profiling -----------------------------------------------------------
+
+    async def handle_get_profiling(self, request):
+        # enabled flag + calibration outcome (clock mode, sample stride)
+        return web.json_response(self.core.profiling.config())
+
+    async def handle_update_profiling(self, request):
+        """Toggle per-stage thread-CPU accounting (default off). The perf
+        harness's ``--profile-server`` flips it on for the run's duration
+        and restores the previous setting afterwards."""
+        updates = self._parse_settings_body(await request.read())
+        unknown = set(updates) - {"stage_cpu"}
+        if unknown:
+            raise InferenceServerException(
+                f"unknown profiling setting '{sorted(unknown)[0]}'"
+            )
+        value = updates.get("stage_cpu")
+        if value is not None:
+            if not isinstance(value, bool):
+                raise InferenceServerException(
+                    f"profiling setting 'stage_cpu' expects a boolean, "
+                    f"got {value!r}"
+                )
+            if value:
+                # enable() calibrates (a bounded ~20 ms clock-quantum
+                # spin on some hosts) — run it off the event loop so
+                # in-flight requests don't stall behind it
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self.core.profiling.enable
+                )
+            else:
+                self.core.profiling.disable()
+        return web.json_response(self.core.profiling.config())
+
+    async def handle_profile(self, request):
+        """On-demand wall-stack sampling: ``GET /v2/debug/profile?
+        duration_s=&hz=&format=collapsed|speedscope[&jax_trace_dir=]``.
+
+        The sampler runs on an executor thread (the event loop keeps
+        serving) and excludes its own thread from the samples; the
+        measured-overhead guard inside WallProfiler caps its CPU cost.
+        Nothing is installed when this endpoint is not called — profiling
+        is strictly on-demand.
+        """
+        from client_tpu.observability.profiling import (
+            WallProfiler,
+            maybe_jax_trace,
+        )
+
+        query = request.query
+        try:
+            duration_s = float(query.get("duration_s", "1.0"))
+            hz = float(query.get("hz", "99"))
+        except ValueError as e:
+            raise InferenceServerException(
+                f"malformed profile request: {e}"
+            ) from None
+        if not 0 < duration_s <= 120:
+            raise InferenceServerException(
+                f"profile duration_s must be in (0, 120], got {duration_s}"
+            )
+        if not 1 <= hz <= 1000:
+            raise InferenceServerException(
+                f"profile hz must be in [1, 1000], got {hz}"
+            )
+        fmt = query.get("format", "collapsed")
+        if fmt not in ("collapsed", "speedscope"):
+            raise InferenceServerException(
+                f"profile format must be 'collapsed' or 'speedscope', "
+                f"got '{fmt}'"
+            )
+        jax_trace_dir = query.get("jax_trace_dir") or None
+        if jax_trace_dir is not None:
+            # a wire-controlled filesystem-write target must stay inside
+            # the system temp dir — this endpoint must not hand any
+            # client that can reach the HTTP port an arbitrary-path
+            # write primitive (traces elsewhere: use jax.profiler
+            # directly on the server side)
+            import os
+            import tempfile
+
+            temp_root = os.path.realpath(tempfile.gettempdir())
+            resolved = os.path.realpath(jax_trace_dir)
+            if not (
+                resolved == temp_root
+                or resolved.startswith(temp_root + os.sep)
+            ):
+                raise InferenceServerException(
+                    "jax_trace_dir must be inside the server's temp "
+                    f"directory ({temp_root})"
+                )
+            jax_trace_dir = resolved
+        if self._profiling_busy:
+            return _error_response(
+                "a profiling run is already in progress", status=409
+            )
+        self._profiling_busy = True
+        try:
+            profiler = WallProfiler(hz=hz)
+            loop = asyncio.get_running_loop()
+
+            def _run():
+                with maybe_jax_trace(jax_trace_dir):
+                    return profiler.run(duration_s)
+
+            result = await loop.run_in_executor(None, _run)
+        finally:
+            self._profiling_busy = False
+        headers = {
+            "X-Profile-Samples": str(result.sample_count),
+            "X-Profile-Hz-Effective": f"{result.hz_effective:.1f}",
+        }
+        if fmt == "speedscope":
+            return web.json_response(result.speedscope(), headers=headers)
+        return web.Response(
+            text=result.collapsed(),
+            content_type="text/plain",
+            headers=headers,
+        )
+
     # -- inference -----------------------------------------------------------
 
     async def handle_infer(self, request):
@@ -400,6 +540,10 @@ class HttpServer:
         # (gzip/deflate), so `body` is already plain here.
         body = await request.read()
 
+        prof = self.core.profiling
+        # one take() covers this request's decode AND encode brackets
+        measured = prof.take()
+        decode_cpu0 = prof.cpu_now() if measured else 0
         header_len = request.headers.get(HEADER_CONTENT_LENGTH)
         if header_len is not None:
             header_len = int(header_len)
@@ -442,12 +586,21 @@ class HttpServer:
                 # extension never sees it, the front-end counter does
                 self.core.metrics.observe_frontend_error("http")
                 raise
+            if measured:
+                prof.account(
+                    "frontend_decode", prof.cpu_now() - decode_cpu0
+                )
             core_request.trace = trace
             if trace is not None:
                 trace.request_id = core_request.id
             core_response = await self.core.infer(core_request)
             accept = request.headers.get("Accept-Encoding", "")
-            response = self._build_response(payload, core_response, accept)
+            if measured:
+                encode_cpu0 = prof.cpu_now()
+                response = self._build_response(payload, core_response, accept)
+                prof.account("encode", prof.cpu_now() - encode_cpu0)
+            else:
+                response = self._build_response(payload, core_response, accept)
         except BaseException as e:
             if trace is not None:
                 trace.end(error=str(e))

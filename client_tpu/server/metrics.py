@@ -16,6 +16,7 @@ tpu_inference_queue_duration         histogram  per request, seconds
 tpu_inference_compute_duration       histogram  per request, seconds
 tpu_inference_batch_size             histogram  per device execution, rows
 tpu_pending_request_count            gauge      in-flight requests per model
+tpu_request_cpu_seconds              histogram  per request thread-CPU {stage}
 tpu_queue_rejected_total             counter    admission rejections {model,reason}
 tpu_queue_depth                      gauge      queued requests {model,level}
 tpu_frontend_request_errors          counter    requests rejected pre-core
@@ -63,6 +64,12 @@ DURATION_BUCKETS_S = (
     0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 BATCH_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+# Thread-CPU per stage per request: sub-microsecond codec touches through
+# multi-millisecond model compute.
+STAGE_CPU_BUCKETS_S = (
+    1e-6, 2.5e-6, 5e-6, 1e-5, 2.5e-5, 5e-5,
+    1e-4, 2.5e-4, 5e-4, 1e-3, 1e-2, 1e-1,
+)
 
 
 class ServerMetrics:
@@ -134,6 +141,24 @@ class ServerMetrics:
             model,
             registry=registry,
         )
+        self.stage_cpu = Histogram(
+            "tpu_request_cpu_seconds",
+            "Thread-CPU seconds a request spent in each named server "
+            "stage (frontend_decode/queue_wait/batch_assembly/device_put/"
+            "compute/readback/package/encode, plus rpc for non-inference "
+            "methods). Populated only while stage-CPU accounting is "
+            "enabled (POST /v2/debug/profiling {\"stage_cpu\": true}).",
+            ("stage",),
+            buckets=STAGE_CPU_BUCKETS_S,
+            registry=registry,
+        )
+        # hot-path cache: stage -> histogram child, so observe_stage_cpu
+        # skips the family-lock labels() lookup per booking
+        from client_tpu.observability.profiling import STAGES
+
+        self._stage_children = {
+            stage: self.stage_cpu.labels(stage) for stage in STAGES
+        }
         self.queue_rejected = Counter(
             "tpu_queue_rejected_total",
             "Requests rejected by admission control, by reason "
@@ -248,6 +273,18 @@ class ServerMetrics:
 
     def observe_frontend_error(self, protocol: str) -> None:
         self.frontend_errors.labels(protocol).inc()
+
+    def observe_stage_cpu(self, stage: str, cpu_ns: int, count: int = 1) -> None:
+        """Book ``count`` requests' thread-CPU for one stage (merged
+        batch paths pass their chunk total with count=n; the histogram
+        records the per-request average n times so _sum stays the true
+        total and _count the true request count)."""
+        if count <= 0:
+            return
+        child = self._stage_children.get(stage)
+        if child is None:
+            child = self._stage_children[stage] = self.stage_cpu.labels(stage)
+        child.observe(cpu_ns / count / 1e9, count)
 
     def observe_rejection(self, model: str, reason: str) -> None:
         """Book one admission-control rejection (queue_full / timeout)."""

@@ -293,6 +293,10 @@ class NativeGrpcFrontend:
         handles = []
         requests = []
         completions = []
+        prof = self._core.profiling
+        # one take() covers this pump batch's decode AND encode brackets
+        measured = prof.take()
+        decode_cpu0 = prof.cpu_now() if measured else 0
         for item in items:
             try:
                 request = self._build_request(item)
@@ -307,10 +311,16 @@ class NativeGrpcFrontend:
                 continue
             handles.append(item[0])
             requests.append(request)
+        if measured and requests:
+            prof.account(
+                "frontend_decode",
+                prof.cpu_now() - decode_cpu0,
+                count=len(requests),
+            )
         if requests:
-            for handle, result in zip(
-                handles, self._core.infer_direct(requests)
-            ):
+            results = self._core.infer_direct(requests)
+            encode_cpu0 = prof.cpu_now() if measured else 0
+            for handle, result in zip(handles, results):
                 if isinstance(result, Exception):
                     # Execution errors are the server/model's fault:
                     # INTERNAL (matching the event-loop unary path).
@@ -321,6 +331,12 @@ class NativeGrpcFrontend:
                     completions.append(
                         self._response_completion(handle, result, 1)
                     )
+            if measured:
+                prof.account(
+                    "encode",
+                    prof.cpu_now() - encode_cpu0,
+                    count=len(requests),
+                )
         if completions:
             self._lib.complete_many(completions)
 
@@ -340,10 +356,18 @@ class NativeGrpcFrontend:
 
     def _submit_batch(self, batch) -> None:
         """Event loop: build CoreRequests and start streaming tasks."""
+        prof = self._core.profiling
         for item in batch:
             handle = item[0]
             try:
-                request = self._build_request(item)
+                if prof.take():
+                    decode_cpu0 = prof.cpu_now()
+                    request = self._build_request(item)
+                    prof.account(
+                        "frontend_decode", prof.cpu_now() - decode_cpu0
+                    )
+                else:
+                    request = self._build_request(item)
                 task = self._loop.create_task(
                     self._run_stream(handle, request)
                 )

@@ -168,6 +168,20 @@ class InProcessServer:
     def __exit__(self, *exc) -> None:
         self.stop()
 
+    # -- profiling ----------------------------------------------------------
+
+    def profile(self, duration_s: float = 1.0, hz: float = 99.0):
+        """Sample this server's threads for ``duration_s`` seconds and
+        return the :class:`~client_tpu.observability.profiling.
+        ProfileResult` (collapsed()/speedscope() exporters). The sampler
+        runs on the CALLING thread — the server's loop, executor, and
+        pump threads keep serving and show up in the samples; the
+        caller's own stack is excluded. The in-process twin of
+        ``GET /v2/debug/profile``."""
+        from client_tpu.observability.profiling import WallProfiler
+
+        return WallProfiler(hz=hz).run(duration_s)
+
     # -- convenience --------------------------------------------------------
 
     @property

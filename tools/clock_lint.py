@@ -29,12 +29,18 @@ TARGET_DIRS = (
     os.path.join("client_tpu", "scheduling"),
 )
 
-# clock-injected modules outside the blanket-linted packages
+# clock-injected modules outside the blanket-linted packages, plus
+# explicitly-pinned files inside them (profiling.py reads thread CPU
+# clocks — the shim below must stay injected even if the directory list
+# ever changes); findings are deduplicated against the directory walk
 TARGET_FILES = (
+    os.path.join("client_tpu", "observability", "profiling.py"),
     os.path.join("client_tpu", "perf", "metrics_collector.py"),
 )
 
 # time-module clock functions whose direct call defeats injection
+# (thread_time/thread_time_ns: the stage-CPU accounting reads them
+# through its injected cpu_clock_ns shim only)
 BANNED_CLOCKS = frozenset(
     {
         "time",
@@ -44,6 +50,8 @@ BANNED_CLOCKS = frozenset(
         "perf_counter_ns",
         "process_time",
         "process_time_ns",
+        "thread_time",
+        "thread_time_ns",
     }
 )
 
@@ -100,6 +108,7 @@ def run_clock_lint(repo_root: str = None) -> List[str]:
     """Lint the target packages; returns 'path:line: message' strings."""
     root = repo_root or _repo_root()
     problems = []
+    seen = set()
     for target in TARGET_FILES:
         path = os.path.join(root, target)
         if not os.path.exists(path):
@@ -107,7 +116,10 @@ def run_clock_lint(repo_root: str = None) -> List[str]:
         with open(path, encoding="utf-8") as f:
             source = f.read()
         for lineno, message in check_source(source, path):
-            problems.append(f"{target}:{lineno}: {message}")
+            finding = f"{target}:{lineno}: {message}"
+            if finding not in seen:
+                seen.add(finding)
+                problems.append(finding)
     for target in TARGET_DIRS:
         base = os.path.join(root, target)
         for dirpath, _dirs, files in os.walk(base):
@@ -121,7 +133,10 @@ def run_clock_lint(repo_root: str = None) -> List[str]:
                     source = f.read()
                 for lineno, message in check_source(source, path):
                     rel = os.path.relpath(path, root)
-                    problems.append(f"{rel}:{lineno}: {message}")
+                    finding = f"{rel}:{lineno}: {message}"
+                    if finding not in seen:
+                        seen.add(finding)
+                        problems.append(finding)
     return problems
 
 
