@@ -73,6 +73,7 @@ class InferenceServerClient(InferenceServerClientBase):
         tracer: Optional[Tracer] = None,
         urls=None,
         endpoint_cooldown_s: float = 1.0,
+        logger=None,
     ):
         """``url`` may be a single ``host:port``, a comma list, or an
         :class:`~client_tpu.lifecycle.EndpointPool`; ``urls=[...]`` names
@@ -85,7 +86,7 @@ class InferenceServerClient(InferenceServerClientBase):
         super().__init__()
         self._verbose = verbose
         self._pool = EndpointPool.resolve(
-            url, urls, cooldown_s=endpoint_cooldown_s
+            url, urls, cooldown_s=endpoint_cooldown_s, logger=logger
         )
         if self._pool.size > 1 and retry_policy is None:
             retry_policy = RetryPolicy(

@@ -134,6 +134,7 @@ class InferenceServerClient:
         tracer=None,
         urls=None,
         endpoint_cooldown_s: float = 1.0,
+        logger=None,
     ):
         """``url`` may be a single ``host:port``, a comma list, or an
         :class:`~client_tpu.lifecycle.EndpointPool`; ``urls=[...]`` names
@@ -153,6 +154,7 @@ class InferenceServerClient:
             tracer=tracer,
             urls=urls,
             endpoint_cooldown_s=endpoint_cooldown_s,
+            logger=logger,
         )
 
     # plugin registry delegates to the aio client so headers flow through it

@@ -112,11 +112,12 @@ class InferenceServerClient(InferenceServerClientBase):
         tracer: Optional[Tracer] = None,
         urls=None,
         endpoint_cooldown_s: float = 1.0,
+        logger=None,
     ):
         super().__init__()
         scheme = "https" if ssl else "http"
         self._pool = EndpointPool.resolve(
-            url, urls, cooldown_s=endpoint_cooldown_s
+            url, urls, cooldown_s=endpoint_cooldown_s, logger=logger
         )
         for endpoint_url in self._pool.urls:
             if "://" in endpoint_url:

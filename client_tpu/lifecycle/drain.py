@@ -159,6 +159,19 @@ class DrainController:
                 else:
                     self._inflight_by_model[model_name] = count - 1
 
+    def snapshot(self) -> Dict[str, object]:
+        """One consistent view of the census (state, totals, per-model
+        in-flight counts) under a single lock acquisition — the
+        ``/v2/debug/state`` building block."""
+        with self._lock:
+            return {
+                "state": self._state,
+                "accepting": self._state == SERVING,
+                "inflight_total": self._inflight_total,
+                "inflight_by_model": dict(self._inflight_by_model),
+                "rejected_total": self.rejected_total,
+            }
+
     def inflight(self, model_name: Optional[str] = None) -> int:
         with self._lock:
             if model_name is None:

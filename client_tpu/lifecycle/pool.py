@@ -83,6 +83,12 @@ class EndpointPool:
         :meth:`observe` feeds each endpoint's breaker.
     clock:
         Injectable monotonic-seconds clock (fake-clock tests).
+    logger:
+        Optional :class:`~client_tpu.observability.StructuredLogger`.
+        When set, failover state changes emit structured events
+        (``endpoint_down`` / ``endpoint_recovered``); when None — the
+        default — each site is a single None-check (the same zero-cost
+        pattern as the resilience layer's attempt-event log).
     """
 
     def __init__(
@@ -91,6 +97,7 @@ class EndpointPool:
         cooldown_s: float = 1.0,
         breaker_factory: Optional[Callable[[], object]] = None,
         clock: Callable[[], float] = time.monotonic,
+        logger=None,
     ):
         if isinstance(urls, str):
             urls = [u.strip() for u in urls.split(",") if u.strip()]
@@ -99,6 +106,7 @@ class EndpointPool:
             raise ValueError("EndpointPool needs at least one url")
         self.cooldown_s = cooldown_s
         self._clock = clock
+        self._logger = logger
         self._lock = threading.Lock()
         self._endpoints: List[Endpoint] = [
             Endpoint(u, breaker_factory() if breaker_factory else None)
@@ -196,21 +204,34 @@ class EndpointPool:
     ) -> None:
         """Take ``ep`` out of rotation for a cooldown and advance the
         primary off it."""
+        effective_cooldown = cooldown_s if cooldown_s else self.cooldown_s
+        failed_over = None
         with self._lock:
-            ep.down_until = self._clock() + (
-                cooldown_s if cooldown_s else self.cooldown_s
-            )
+            ep.down_until = self._clock() + effective_cooldown
             ep.was_down = True
             ep.failures += 1
             n = len(self._endpoints)
             if n > 1 and self._endpoints[self._primary] is ep:
                 self._primary = (self._primary + 1) % n
                 self.failovers += 1
+                failed_over = self._endpoints[self._primary].url
+        if self._logger is not None:
+            self._logger.warning(
+                "endpoint_down",
+                endpoint=ep.url,
+                cooldown_s=round(effective_cooldown, 3),
+                failures=ep.failures,
+                new_primary=failed_over,
+                failovers=self.failovers,
+            )
 
     def mark_up(self, ep: Endpoint) -> None:
         with self._lock:
+            recovered = ep.was_down
             ep.down_until = 0.0
             ep.was_down = False
+        if recovered and self._logger is not None:
+            self._logger.info("endpoint_recovered", endpoint=ep.url)
 
     def observe(
         self,

@@ -25,6 +25,10 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+# validate_log_settings moved to the structured-logging module (its
+# canonical home since /v2/logging became real); re-exported here for
+# back-compat with existing importers.
+from client_tpu.observability.logging import validate_log_settings  # noqa: F401
 from client_tpu.observability.trace import JsonlExporter, TraceContext
 from client_tpu.utils import InferenceServerException
 
@@ -103,55 +107,6 @@ def _normalize_trace_setting(key: str, value) -> Any:
             )
         return value
     raise InferenceServerException(f"unknown trace setting '{key}'")
-
-
-_LOG_SETTING_TYPES: Dict[str, type] = {
-    "log_file": str,
-    "log_info": bool,
-    "log_warning": bool,
-    "log_error": bool,
-    "log_verbose_level": int,
-    "log_format": str,
-}
-_LOG_FORMATS = ("default", "ISO8601")
-
-
-def validate_log_settings(updates: Dict[str, Any]) -> Dict[str, Any]:
-    """Validate a log-settings update; returns the normalized updates.
-
-    Raises :class:`InferenceServerException` on unknown keys or
-    wrong-typed values (both front-ends surface it as a client error).
-    """
-    out: Dict[str, Any] = {}
-    for key, value in updates.items():
-        expected = _LOG_SETTING_TYPES.get(key)
-        if expected is None:
-            raise InferenceServerException(f"unknown log setting '{key}'")
-        if expected is bool:
-            if not isinstance(value, bool):
-                raise InferenceServerException(
-                    f"log setting '{key}' expects a boolean, got {value!r}"
-                )
-        elif expected is int:
-            if isinstance(value, bool) or not isinstance(value, int):
-                raise InferenceServerException(
-                    f"log setting '{key}' expects an integer, got {value!r}"
-                )
-            if value < 0:
-                raise InferenceServerException(
-                    f"log setting '{key}' must be >= 0, got {value}"
-                )
-        elif not isinstance(value, str):
-            raise InferenceServerException(
-                f"log setting '{key}' expects a string, got {value!r}"
-            )
-        if key == "log_format" and value not in _LOG_FORMATS:
-            raise InferenceServerException(
-                f"log setting 'log_format' expects one of {list(_LOG_FORMATS)},"
-                f" got {value!r}"
-            )
-        out[key] = value
-    return out
 
 
 class ServerTrace:

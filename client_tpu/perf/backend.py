@@ -210,6 +210,7 @@ class HttpPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
         retry_policy=None,
         circuit_breaker=None,
         tracer=None,
+        logger=None,
     ):
         from client_tpu.http import aio as httpclient
 
@@ -220,6 +221,7 @@ class HttpPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
             retry_policy=retry_policy,
             circuit_breaker=circuit_breaker,
             tracer=tracer,
+            logger=logger,
         )
         self._init_prepared()
 
@@ -317,7 +319,12 @@ class GrpcPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
     supports_streaming = True
 
     def __init__(
-        self, url: str, retry_policy=None, circuit_breaker=None, tracer=None
+        self,
+        url: str,
+        retry_policy=None,
+        circuit_breaker=None,
+        tracer=None,
+        logger=None,
     ):
         from client_tpu.grpc import aio as grpcclient
 
@@ -327,6 +334,7 @@ class GrpcPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
             retry_policy=retry_policy,
             circuit_breaker=circuit_breaker,
             tracer=tracer,
+            logger=logger,
         )
         self._init_prepared()
 

@@ -291,6 +291,25 @@ def build_parser() -> argparse.ArgumentParser:
         help="sampling rate for --flamegraph-out (the server's overhead "
         "guard may lower the effective rate)",
     )
+    parser.add_argument(
+        "--dump-slow-requests",
+        type=int,
+        default=0,
+        metavar="N",
+        help="after the run, fetch the server's flight recorder "
+        "(GET /v2/debug/requests on the metrics host) and print the N "
+        "slowest requests stage-decomposed (queue/compute/package us, "
+        "trace id, error text); kserve http/grpc only",
+    )
+    parser.add_argument(
+        "--log-file",
+        default=None,
+        metavar="PATH",
+        help="write the harness's structured JSON event log to PATH "
+        "(run lifecycle, client endpoint failover and circuit-breaker "
+        "transitions, slow-request dump) — the client-side face of the "
+        "server's /v2/logging stream",
+    )
     from client_tpu.perf.distributed import topology_from_env
 
     env_world_size, env_rank, env_coordinator = topology_from_env()
@@ -347,6 +366,20 @@ def parse_request_parameters(specs):
     return parameters
 
 
+def _server_http_url(args) -> str:
+    """The server's HTTP base for metrics + debug endpoints:
+    ``--metrics-url`` when given, else the -u primary endpoint for HTTP
+    kserve runs, else the conventional HTTP port on the -u host. A comma
+    list (-u EndpointPool form) resolves to the FIRST endpoint."""
+    if args.metrics_url:
+        return args.metrics_url
+    primary_url = args.url.split(",")[0].strip()
+    if args.protocol == "http" and args.service_kind == "kserve":
+        return primary_url
+    host = primary_url.rsplit(":", 1)[0] or "localhost"
+    return f"{host}:8000"
+
+
 async def run(args) -> int:
     from client_tpu.perf.backend import create_backend
     from client_tpu.utils import InferenceServerException
@@ -400,6 +433,13 @@ async def run(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.dump_slow_requests and args.service_kind != "kserve":
+        print(
+            "error: --dump-slow-requests needs the kserve http/grpc "
+            "clients (server flight-recorder debug endpoint)",
+            file=sys.stderr,
+        )
+        return 2
     trace_exporter = None
     tracer = None
     collector = None
@@ -407,6 +447,24 @@ async def run(args) -> int:
     prev_profiling = None
     profiling_clock_mode = ""
     flamegraph_task = None
+    run_logger = None
+    if args.log_file:
+        # The harness's own structured event log; passed as logger= to
+        # the kserve clients so EndpointPool failover and circuit-breaker
+        # transitions land in the same JSONL stream as the run events.
+        from client_tpu.observability import StructuredLogger
+
+        run_logger = StructuredLogger(name="perf")
+        run_logger.update(
+            {"log_file": args.log_file, "log_verbose_level": 1}
+        )
+        run_logger.info(
+            "run_started",
+            model=args.model_name,
+            url=args.url,
+            protocol=args.protocol,
+            service_kind=args.service_kind,
+        )
     if args.service_kind == "openai":
         backend = create_backend("openai", args.url, endpoint=args.endpoint)
     elif args.service_kind in ("tfserving", "torchserve"):
@@ -434,6 +492,8 @@ async def run(args) -> int:
                 trace_exporter = JsonlExporter(args.trace_export_file)
             tracer = Tracer(exporter=trace_exporter)
             backend_kwargs["tracer"] = tracer
+        if run_logger is not None:
+            backend_kwargs["logger"] = run_logger
         backend = create_backend(args.protocol, args.url, **backend_kwargs)
     if args.streaming and not backend.supports_streaming:
         if args.service_kind in ("tfserving", "torchserve"):
@@ -460,18 +520,8 @@ async def run(args) -> int:
             # conventional HTTP port on the same host.
             from client_tpu.perf.metrics_collector import MetricsCollector
 
-            metrics_url = args.metrics_url
-            # a comma list (-u EndpointPool form) scrapes the FIRST
-            # endpoint; override with --metrics-url for another
-            primary_url = args.url.split(",")[0].strip()
-            if not metrics_url:
-                if args.protocol == "http" and args.service_kind == "kserve":
-                    metrics_url = primary_url
-                else:
-                    host = primary_url.rsplit(":", 1)[0] or "localhost"
-                    metrics_url = f"{host}:8000"
             collector = MetricsCollector(
-                metrics_url,
+                _server_http_url(args),
                 interval_s=args.metrics_interval,
                 model_name=args.model_name,
             )
@@ -810,6 +860,40 @@ async def run(args) -> int:
                     "flamegraph written",
                     file=sys.stderr,
                 )
+        if args.dump_slow_requests:
+            # End the run with evidence, not just aggregates: the
+            # server's worst requests, stage-decomposed.
+            from client_tpu.perf.metrics_collector import (
+                fetch_debug_requests,
+            )
+            from client_tpu.perf.report import format_slow_requests
+
+            debug_url = (
+                collector.url if collector is not None
+                else _server_http_url(args)
+            )
+            recorder_snapshot = await fetch_debug_requests(
+                debug_url,
+                model=args.model_name,
+                limit=args.dump_slow_requests,
+            )
+            print()
+            if recorder_snapshot is None:
+                print(
+                    "warning: could not fetch /v2/debug/requests from "
+                    f"{debug_url}; no slow-request dump",
+                    file=sys.stderr,
+                )
+            else:
+                print(
+                    format_slow_requests(
+                        recorder_snapshot, args.dump_slow_requests
+                    )
+                )
+                if run_logger is not None:
+                    for exemplar in recorder_snapshot.get("slowest", []):
+                        run_logger.info("slow_request", **exemplar)
+
         if tracer is not None:
             # the ClientMetrics snapshot every traced call feeds: error/
             # retry counts + the client-side latency histogram
@@ -896,6 +980,9 @@ async def run(args) -> int:
         await backend.close()
         if trace_exporter is not None:
             trace_exporter.close()
+        if run_logger is not None:
+            run_logger.info("run_finished")
+            run_logger.close()
 
 
 def main(argv: Optional[List[str]] = None) -> int:

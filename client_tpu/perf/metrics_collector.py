@@ -336,6 +336,34 @@ async def fetch_profile(
         return None
 
 
+async def fetch_debug_requests(
+    url: str, model: str = "", limit: Optional[int] = None
+) -> Optional[Dict]:
+    """Fetch the server's flight-recorder snapshot
+    (``GET /v2/debug/requests``): recent / failed / slowest request
+    exemplars with per-stage timings. None on any failure — the dump is
+    best-effort, the run's results stand without it."""
+    import aiohttp
+
+    base = server_base_url(url)
+    params: Dict[str, str] = {}
+    if model:
+        params["model"] = model
+    if limit is not None:
+        params["limit"] = str(limit)
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"{base}/v2/debug/requests", params=params
+            ) as resp:
+                payload = await resp.json()
+                if resp.status != 200:
+                    return None
+                return payload
+    except Exception:  # noqa: BLE001 - debug dump is best-effort
+        return None
+
+
 def _bucket_delta(
     before: List[Tuple[float, float]], after: List[Tuple[float, float]]
 ) -> List[Tuple[float, float]]:

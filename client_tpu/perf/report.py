@@ -304,6 +304,54 @@ def format_client_metrics(snapshot: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def format_slow_requests(
+    snapshot: Dict[str, Any], limit: Optional[int] = None
+) -> str:
+    """Render the flight recorder's slowest-request exemplars
+    (``GET /v2/debug/requests``) stage-decomposed — the end-of-run answer
+    to "which requests were the worst, and where did their time go"."""
+    slowest = snapshot.get("slowest", [])
+    if limit is not None:
+        slowest = slowest[:limit]
+    lines = ["Slowest requests (server flight recorder):"]
+    if not slowest:
+        lines.append("  (no exemplars recorded)")
+        return "\n".join(lines)
+    header = (
+        f"  {'total_us':>10} {'queue_us':>10} {'compute_us':>10} "
+        f"{'package_us':>10}  {'model':<16} {'path':<9} {'status':<8} detail"
+    )
+    lines.append(header)
+    for exemplar in slowest:
+        stages = exemplar.get("stages", {})
+        detail = []
+        if exemplar.get("request_id"):
+            detail.append(f"id={exemplar['request_id']}")
+        if exemplar.get("trace_id"):
+            detail.append(f"trace={exemplar['trace_id']}")
+        if exemplar.get("error"):
+            detail.append(f"error={exemplar['error']}")
+        lines.append(
+            f"  {exemplar.get('total_us', 0):>10.0f}"
+            f" {stages.get('queue_us', 0):>10.0f}"
+            f" {stages.get('compute_us', 0):>10.0f}"
+            f" {stages.get('package_us', 0):>10.0f}"
+            f"  {exemplar.get('model', ''):<16}"
+            f" {exemplar.get('path', ''):<9}"
+            f" {exemplar.get('status', ''):<8}"
+            f" {' '.join(detail)}".rstrip()
+        )
+    errors = snapshot.get("error_total", 0)
+    rejected = snapshot.get("rejected_total", 0)
+    if errors or rejected:
+        lines.append(
+            f"  ({errors} errored / {rejected} rejected requests recorded;"
+            " full exemplars in the 'errors' section of"
+            " GET /v2/debug/requests)"
+        )
+    return "\n".join(lines)
+
+
 def write_csv(experiments: Sequence[ProfileExperiment], path: str) -> None:
     """Reference-compatible CSV columns."""
     percentile_cols = sorted(

@@ -209,6 +209,7 @@ class CircuitBreaker:
         cooldown_s: float = 5.0,
         half_open_max_probes: int = 1,
         clock: Callable[[], float] = time.monotonic,
+        logger=None,
     ):
         if failure_threshold < 1:
             raise ValueError("failure_threshold must be >= 1")
@@ -216,12 +217,24 @@ class CircuitBreaker:
         self.cooldown_s = cooldown_s
         self.half_open_max_probes = max(1, half_open_max_probes)
         self._clock = clock
+        # optional StructuredLogger: state transitions emit
+        # circuit_open / circuit_half_open / circuit_closed events; None
+        # (the default) keeps every transition site a single None-check
+        self._logger = logger
         self._lock = threading.Lock()
         self._state = self.CLOSED
         self._consecutive_failures = 0
         self._opened_at = 0.0
         self._probes_in_flight = 0
         self.times_opened = 0  # observability
+
+    def _log_transition(self, event: str, **fields) -> None:
+        # lock may be held by the caller; the logger has its own lock and
+        # never calls back into the breaker, so this cannot deadlock
+        if self._logger is not None:
+            self._logger.info(
+                event, times_opened=self.times_opened, **fields
+            )
 
     def _tick(self) -> None:
         # lock held by caller
@@ -231,6 +244,7 @@ class CircuitBreaker:
         ):
             self._state = self.HALF_OPEN
             self._probes_in_flight = 0
+            self._log_transition("circuit_half_open")
 
     @property
     def state(self) -> str:
@@ -261,9 +275,12 @@ class CircuitBreaker:
                 # evidence — stay open through the cooldown so recovery
                 # goes through a half-open probe, not a flap
                 return
+            closed_now = self._state != self.CLOSED
             self._state = self.CLOSED
             self._consecutive_failures = 0
             self._probes_in_flight = 0
+            if closed_now:
+                self._log_transition("circuit_closed")
 
     def record_failure(self) -> None:
         with self._lock:
@@ -292,6 +309,7 @@ class CircuitBreaker:
         self._probes_in_flight = 0
         self.times_opened += 1
         _note("circuit_tripped", times_opened=self.times_opened)
+        self._log_transition("circuit_open", cooldown_s=self.cooldown_s)
 
 
 # ---------------------------------------------------------------------------

@@ -77,6 +77,23 @@ class RateLimiter:
         with self._lock:
             return self._capacity.get(name, 0) - self._used.get(name, 0)
 
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        """Per-pool occupancy under one lock: capacity, grants in use,
+        and parked waiters (``/v2/debug/state`` building block)."""
+        with self._lock:
+            waiting: Dict[str, int] = {}
+            for waiter in self._waiters:
+                for name in waiter.resources:
+                    waiting[name] = waiting.get(name, 0) + 1
+            return {
+                name: {
+                    "capacity": capacity,
+                    "used": self._used.get(name, 0),
+                    "waiters": waiting.get(name, 0),
+                }
+                for name, capacity in self._capacity.items()
+            }
+
     # -- acquisition ---------------------------------------------------------
 
     def _fits_locked(self, resources: Dict[str, int]) -> bool:

@@ -118,11 +118,15 @@ def main(argv=None) -> int:
             grpc_server, grpc_port = await serve_grpc(
                 core, args.host, args.grpc_port
             )
-        print(
-            f"client_tpu server listening: http={args.host}:"
-            f"{http_runner.addresses[0][1]} grpc={args.host}:{grpc_port} "
-            f"({impl})",
-            flush=True,
+        # Lifecycle events go through the structured logger (JSON lines
+        # on stderr by default, the log_file setting elsewhere) so
+        # orchestrators can parse them instead of scraping prose.
+        core.logger.info(
+            "server_started",
+            host=args.host,
+            http_port=http_runner.addresses[0][1],
+            grpc_port=grpc_port,
+            grpc_frontend=impl,
         )
         import signal
 
@@ -138,14 +142,11 @@ def main(argv=None) -> int:
         finally:
             # Graceful half first: readiness false + reject new work while
             # in-flight and queued requests finish inside --drain-timeout;
-            # only then do the front-ends close.
-            print(
-                f"draining (up to {args.drain_timeout:g}s) ...", flush=True
-            )
+            # only then do the front-ends close. core.drain() emits the
+            # drain_started / drain_deadline_expired / drain_completed
+            # events through the structured logger itself.
             drained = await core.drain(args.drain_timeout)
-            if not drained:
-                print("drain deadline expired; queued work failed cleanly",
-                      flush=True)
+            core.logger.info("server_stopping", drained=drained)
             if native_frontend is not None:
                 native_frontend.stop()
             if grpc_server is not None:

@@ -230,6 +230,7 @@ def _repository_model_load(core: ServerCore, request):
         request.model_name,
         config_override=config if isinstance(config, str) else None,
     )
+    core.logger.info("model_loaded", model=request.model_name)
     return pb.RepositoryModelLoadResponse()
 
 
@@ -326,16 +327,24 @@ def _trace_setting(core: ServerCore, request):
 
 
 def _log_settings(core: ServerCore, request):
-    from client_tpu.observability import validate_log_settings
-
+    """The logging-settings RPC, backed by the real structured logger:
+    updates change what the server emits immediately. The proto carries
+    no model field, so a per-model override rides in as a reserved
+    "model" settings key (the HTTP face accepts the same key alongside
+    its /v2/models/{model}/logging route)."""
     updates = {}
     for key, value in request.settings.items():
         which = value.WhichOneof("parameter_choice")
         if which is not None:
             updates[key] = getattr(value, which)
-    core.log_settings.update(validate_log_settings(updates))
+    model = updates.pop("model", "")
+    if not isinstance(model, str):
+        raise InferenceServerException(
+            f"log setting 'model' expects a string, got {model!r}"
+        )
+    settings = core.update_log_settings(updates, model)
     response = pb.LogSettingsResponse()
-    for key, value in core.log_settings.items():
+    for key, value in settings.items():
         if isinstance(value, bool):
             response.settings[key].bool_param = value
         elif isinstance(value, int):

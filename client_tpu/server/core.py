@@ -687,9 +687,24 @@ class _ModelBatcher:
                 model.name, sum(e[3] for e in entries)
             )
         except Exception as e:  # noqa: BLE001 - fail every request in batch
+            # the only trace this previously left was N client error
+            # responses — record the server-side evidence too
+            core._log_request_error(
+                "batch_execution_failed", model.name, e, path="batch"
+            )
             now = time.monotonic_ns()
-            for _req, future, _sig, _rows, arrival in entries:
+            for req, future, _sig, _rows, arrival in entries:
                 stats.record("fail", now - arrival)
+                core._record_exemplar(
+                    model.name,
+                    req,
+                    path="batch",
+                    status="error",
+                    error=str(e),
+                    arrival_ns=arrival,
+                    exec_start_ns=exec_start,
+                    end_ns=now,
+                )
                 if not future.done():
                     future.set_exception(e)
             return
@@ -717,11 +732,37 @@ class _ModelBatcher:
                 _trace_stages(
                     request.trace, arrival, exec_start, infer_end, out_end
                 )
+                core._record_exemplar(
+                    model.name,
+                    request,
+                    path="batch",
+                    arrival_ns=arrival,
+                    exec_start_ns=exec_start,
+                    infer_end_ns=infer_end,
+                    end_ns=out_end,
+                    rows=rows,
+                )
                 execution_pending = 0
                 if not future.done():
                     future.set_result(response)
             except Exception as e:  # noqa: BLE001 - per-request packaging error
-                stats.record("fail", time.monotonic_ns() - arrival)
+                core._log_request_error(
+                    "packaging_failed", model.name, e, path="batch"
+                )
+                now = time.monotonic_ns()
+                stats.record("fail", now - arrival)
+                core._record_exemplar(
+                    model.name,
+                    request,
+                    path="batch",
+                    status="error",
+                    error=str(e),
+                    arrival_ns=arrival,
+                    exec_start_ns=exec_start,
+                    infer_end_ns=infer_end,
+                    end_ns=now,
+                    rows=rows,
+                )
                 if not future.done():
                     future.set_exception(e)
             offset += rows
@@ -736,6 +777,8 @@ class ServerCore:
         self,
         repository: Optional[ModelRepository] = None,
         max_workers: int = 32,
+        logger=None,
+        flight_recorder=None,
     ):
         self.repository = repository or ModelRepository()
         self.shm = SharedMemoryManager()
@@ -778,14 +821,21 @@ class ServerCore:
         # the in-flight census every execution path reports into, so a
         # drain can WAIT for work instead of cancelling it.
         self.lifecycle = DrainController()
-        self.log_settings: Dict[str, Any] = {
-            "log_file": "",
-            "log_info": True,
-            "log_warning": True,
-            "log_error": True,
-            "log_verbose_level": 0,
-            "log_format": "default",
-        }
+        # The logging extension, made real (observability.logging): the
+        # /v2/logging settings live inside the logger and gate what it
+        # emits — toggling them changes server output with no restart.
+        from client_tpu.observability.logging import StructuredLogger
+        from client_tpu.observability.recorder import FlightRecorder
+
+        self.logger = logger if logger is not None else StructuredLogger(
+            name="server"
+        )
+        # Per-request exemplars of recent/failed/slowest requests
+        # (GET /v2/debug/requests). On by default — recording is a dict
+        # build + lock + deque append; measured overhead in PERF.md.
+        self.flight_recorder = (
+            flight_recorder if flight_recorder is not None else FlightRecorder()
+        )
 
     @property
     def trace_settings(self) -> Dict[str, Any]:
@@ -793,10 +843,24 @@ class ServerCore:
         trace manager; update through ``trace_manager.update``)."""
         return self.trace_manager.settings()
 
+    @property
+    def log_settings(self) -> Dict[str, Any]:
+        """The effective global log settings (compat view over the
+        structured logger; update through :meth:`update_log_settings`)."""
+        return self.logger.settings()
+
+    def update_log_settings(
+        self, updates: Dict[str, Any], model_name: str = ""
+    ) -> Dict[str, Any]:
+        """Validated /v2/logging update (per-model override when
+        ``model_name`` is set); returns the effective settings."""
+        return self.logger.update(updates, model_name)
+
     def close(self) -> None:
         self.lifecycle.mark_stopped()
         self._executor.shutdown(wait=False, cancel_futures=True)
         self.trace_manager.close()
+        self.logger.close()
 
     # -- graceful lifecycle --------------------------------------------------
 
@@ -839,14 +903,23 @@ class ServerCore:
         503/UNAVAILABLE (never a cancelled future). Returns True when
         everything drained inside the deadline."""
         self.lifecycle.begin_drain()
+        self.logger.info(
+            "drain_started",
+            timeout_s=timeout_s,
+            inflight=self.lifecycle.inflight(),
+        )
         drained = await self.lifecycle.wait_idle(timeout_s)
         if not drained:
-            self.fail_pending()
+            failed = self.fail_pending()
+            self.logger.warning(
+                "drain_deadline_expired", failed_pending=failed
+            )
             # the failed futures' awaiters need a tick to observe before
             # the front-ends close under them (deliberately NOT folded
             # into the return value: the deadline DID expire)
             await self.lifecycle.wait_idle(min(1.0, timeout_s or 1.0))
         self.lifecycle.mark_stopped()
+        self.logger.info("drain_completed", drained=drained)
         return drained
 
     def fail_pending(self, model_name: Optional[str] = None) -> int:
@@ -887,6 +960,11 @@ class ServerCore:
         """
         old_model = self.repository.peek(name)
         epoch = self.repository.unload(name)
+        self.logger.info(
+            "model_unloading",
+            model=name,
+            inflight=self.lifecycle.inflight(name),
+        )
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:
@@ -916,6 +994,7 @@ class ServerCore:
             self.fail_pending(name)
         self._evict_batcher(name, old_model)
         self.repository.finish_unload(name, epoch)
+        self.logger.info("model_unloaded", model=name, drained=drained)
 
     def _evict_batcher(self, name: str, model=None) -> None:
         """Drop a model's batcher state if it still belongs to the
@@ -937,6 +1016,65 @@ class ServerCore:
                     metrics=self.metrics, model_name=model_name
                 )
             return self.stats[model_name]
+
+    # -- flight recorder / structured logging --------------------------------
+
+    def _record_exemplar(
+        self,
+        model_name: str,
+        request: CoreRequest,
+        path: str,
+        status: str = "ok",
+        error: str = "",
+        arrival_ns: int = 0,
+        exec_start_ns: Optional[int] = None,
+        infer_end_ns: Optional[int] = None,
+        end_ns: Optional[int] = None,
+        rows: int = 1,
+        responses: Optional[int] = None,
+    ) -> None:
+        """Book one completed request into the flight recorder. Stage
+        boundaries are the same monotonic reads the statistics extension
+        books (queue = arrival->exec, compute = exec->infer_end, package
+        = infer_end->end), so exemplars and aggregates always agree."""
+        if end_ns is None:
+            end_ns = time.monotonic_ns()
+        exec_start = exec_start_ns if exec_start_ns is not None else end_ns
+        infer_end = infer_end_ns if infer_end_ns is not None else exec_start
+        trace = request.trace
+        self.flight_recorder.record(
+            model_name,
+            request_id=request.id,
+            trace_id=trace.trace_id if trace is not None else "",
+            status=status,
+            error=error,
+            path=path,
+            queue_us=(exec_start - arrival_ns) / 1e3 if arrival_ns else 0.0,
+            compute_us=(infer_end - exec_start) / 1e3,
+            package_us=(end_ns - infer_end) / 1e3,
+            total_us=(
+                (end_ns - arrival_ns) if arrival_ns else (end_ns - exec_start)
+            )
+            / 1e3,
+            rows=rows,
+            priority=request.priority_level,
+            responses=responses,
+        )
+
+    def _log_request_error(
+        self, event: str, model_name: str, exc: BaseException, path: str
+    ) -> None:
+        """Server-side record for an execution/packaging failure that is
+        otherwise only converted into a client response. Rate-limited per
+        (event, model): a model bug failing every request leaves a
+        traceback trail without melting the log sink."""
+        self.logger.error(
+            event,
+            model=model_name,
+            exc=exc,
+            rate_key=(event, model_name),
+            path=path,
+        )
 
     # -- device busy accounting (duty cycle) --------------------------------
 
@@ -1001,6 +1139,23 @@ class ServerCore:
             request.trace.event("QUEUE_REJECTED")
         if record_fail:
             self._stats_for(model_name).record("fail", latency_ns)
+        now_ns = time.monotonic_ns()
+        self._record_exemplar(
+            model_name,
+            request,
+            path="admission",
+            status="rejected",
+            error=error.message(),
+            arrival_ns=now_ns - latency_ns,
+            exec_start_ns=now_ns,
+            end_ns=now_ns,
+        )
+        self.logger.verbose(
+            "request_rejected",
+            model=model_name,
+            reason=error.reason,
+            request_id=request.id,
+        )
 
     def _admit_single(self, model: Model, request: CoreRequest):
         """Admission for the non-batcher paths: stamps the scheduling
@@ -1072,6 +1227,46 @@ class ServerCore:
             snap.update({"name": name, "version": model.version})
             result.append(snap)
         return {"model_stats": result}
+
+    # -- live-state introspection (GET /v2/debug/state) ----------------------
+
+    def debug_state(self) -> Dict[str, Any]:
+        """One snapshot of the server's live internals: what an operator
+        asks a misbehaving replica before anything else. Each subsystem
+        is captured under its own lock (a single consistent view per
+        subsystem; cross-subsystem counts may be one request apart —
+        taking one global lock across the hot path would cost more than
+        the skew is worth)."""
+        queues: Dict[str, Any] = {}
+        for name, batcher in list(self._batchers.items()):
+            queues[name] = {
+                "depths": {
+                    str(level): depth
+                    for level, depth in batcher.pending.depths().items()
+                },
+                "max_queue_size": batcher.policy.max_queue_size,
+            }
+        return {
+            "server": {
+                "name": SERVER_NAME,
+                "version": SERVER_VERSION,
+                "live": self.live,
+                "ready": self.ready,
+            },
+            "lifecycle": self.lifecycle.snapshot(),
+            "queues": queues,
+            "rate_limiter": self.rate_limiter.snapshot(),
+            "models": self.repository.index(),
+            "log_settings": self.logger.settings(),
+            "log_model_overrides": self.logger.model_overrides(),
+            "trace": {
+                "settings": self.trace_manager.settings(),
+                "started": self.trace_manager.started_count,
+                "completed": self.trace_manager.completed_count,
+            },
+            "profiling": self.profiling.config(),
+            "flight_recorder": self.flight_recorder.stats(),
+        }
 
     # -- inference -----------------------------------------------------------
 
@@ -1375,8 +1570,21 @@ class ServerCore:
                 # without bound under hostile clients. Admission
                 # rejections were fully booked at the rejection site.
                 if model is not None and not isinstance(e, SchedulingError):
+                    now = time.monotonic_ns()
                     self._stats_for(model.name).record(
-                        "fail", time.monotonic_ns() - arrival_ns
+                        "fail", now - arrival_ns
+                    )
+                    self._log_request_error(
+                        "request_failed", model.name, e, path="direct"
+                    )
+                    self._record_exemplar(
+                        model.name,
+                        request,
+                        path="direct",
+                        status="error",
+                        error=str(e),
+                        arrival_ns=arrival_ns,
+                        end_ns=now,
                     )
                 results[idx] = e
             finally:
@@ -1492,9 +1700,22 @@ class ServerCore:
                 model.name, sum(rows for _idx, rows in chunk)
             )
         except Exception as e:  # noqa: BLE001 - fail every request in chunk
+            self._log_request_error(
+                "batch_execution_failed", model.name, e, path="direct"
+            )
             now = time.monotonic_ns()
             for idx, _rows in chunk:
                 stats.record("fail", now - arrival_ns)
+                self._record_exemplar(
+                    model.name,
+                    requests[idx],
+                    path="direct",
+                    status="error",
+                    error=str(e),
+                    arrival_ns=arrival_ns,
+                    exec_start_ns=exec_start,
+                    end_ns=now,
+                )
                 results[idx] = e
             self.metrics.pending_dec(model.name, len(chunk))
             return
@@ -1510,17 +1731,44 @@ class ServerCore:
                         k: v[offset : offset + rows] for k, v in raw.items()
                     }
                 results[idx] = self._package_profiled(model, request, sliced)
+                request_end = time.monotonic_ns()
                 _trace_stages(
                     request.trace,
                     arrival_ns,
                     exec_start,
                     infer_end,
-                    time.monotonic_ns(),
+                    request_end,
+                )
+                self._record_exemplar(
+                    model.name,
+                    request,
+                    path="direct",
+                    arrival_ns=arrival_ns,
+                    exec_start_ns=exec_start,
+                    infer_end_ns=infer_end,
+                    end_ns=request_end,
+                    rows=rows,
                 )
                 ok_requests += 1
                 ok_rows += rows
             except Exception as e:  # noqa: BLE001 - per-request packaging
-                stats.record("fail", time.monotonic_ns() - arrival_ns)
+                self._log_request_error(
+                    "packaging_failed", model.name, e, path="direct"
+                )
+                now = time.monotonic_ns()
+                stats.record("fail", now - arrival_ns)
+                self._record_exemplar(
+                    model.name,
+                    request,
+                    path="direct",
+                    status="error",
+                    error=str(e),
+                    arrival_ns=arrival_ns,
+                    exec_start_ns=exec_start,
+                    infer_end_ns=infer_end,
+                    end_ns=now,
+                    rows=rows,
+                )
                 results[idx] = e
             offset += rows
         out_end = time.monotonic_ns()
@@ -1579,6 +1827,16 @@ class ServerCore:
             out_ns=t2 - t1,
         )
         _trace_stages(request.trace, t0, t0, t1, t2)
+        self._record_exemplar(
+            model.name,
+            request,
+            path="single",
+            arrival_ns=t0,
+            exec_start_ns=t0,
+            infer_end_ns=t1,
+            end_ns=t2,
+            rows=rows,
+        )
         return response
 
     async def infer(self, request: CoreRequest) -> CoreResponse:
@@ -1641,7 +1899,20 @@ class ServerCore:
         except Exception as e:
             # admission rejections (queue timeout) were booked already
             if not isinstance(e, SchedulingError):
-                stats.record("fail", time.monotonic_ns() - t0)
+                now = time.monotonic_ns()
+                stats.record("fail", now - t0)
+                self._log_request_error(
+                    "request_failed", model.name, e, path="single"
+                )
+                self._record_exemplar(
+                    model.name,
+                    request,
+                    path="single",
+                    status="error",
+                    error=str(e),
+                    arrival_ns=t0,
+                    end_ns=now,
+                )
             raise
         finally:
             if rate_resources is not None:
@@ -1661,6 +1932,16 @@ class ServerCore:
         if self.profiling.take():
             self.profiling.account("queue_wait", 0, wall_ns=t1 - t0)
         _trace_stages(request.trace, t0, t1, t2, t3)
+        self._record_exemplar(
+            model.name,
+            request,
+            path="single",
+            arrival_ns=t0,
+            exec_start_ns=t1,
+            infer_end_ns=t2,
+            end_ns=t3,
+            rows=rows,
+        )
         return response
 
     async def infer_decoupled(
@@ -1713,6 +1994,16 @@ class ServerCore:
                 out_ns=packaging_ns,
             )
             _trace_stages(request.trace, t0, t0, t1, t1)
+            self._record_exemplar(
+                model.name,
+                request,
+                path="decoupled",
+                arrival_ns=t0,
+                exec_start_ns=t0,
+                infer_end_ns=t1 - packaging_ns,
+                end_ns=t1,
+                responses=index,
+            )
 
         if model.decoupled:
             # non-decoupled requests delegate to infer(), which tracks its
@@ -1813,6 +2104,19 @@ class ServerCore:
                 # admission rejections booked their aggregate fail already
                 if not isinstance(e, SchedulingError):
                     stats.record("fail", now - t0)
+                    self._log_request_error(
+                        "stream_failed", model.name, e, path="decoupled"
+                    )
+                    self._record_exemplar(
+                        model.name,
+                        request,
+                        path="decoupled",
+                        status="error",
+                        error=str(e),
+                        arrival_ns=t0,
+                        end_ns=now,
+                        responses=index,
+                    )
             raise
         else:
             _book_success()

@@ -238,6 +238,15 @@ class _Servicer(GRPCInferenceServiceServicer):
         except InferenceServerException as e:
             if trace is not None:
                 trace.end(error=e.message())
+            log = self.core.logger
+            if log.verbose_hot:
+                log.verbose(
+                    "request",
+                    model=request.model_name,
+                    protocol="grpc",
+                    status="error",
+                    error=e.message(),
+                )
             await context.abort(_status_for(e.message(), e), e.message())
         except BaseException as e:
             if trace is not None:
@@ -245,6 +254,15 @@ class _Servicer(GRPCInferenceServiceServicer):
             raise
         if trace is not None:
             trace.end()
+        log = self.core.logger
+        if log.verbose_hot:
+            log.verbose(
+                "request",
+                model=request.model_name,
+                protocol="grpc",
+                status="ok",
+                request_id=request.id,
+            )
         if measured:
             encode_cpu0 = prof.cpu_now()
             response = build_proto_response(core_response)
@@ -292,6 +310,16 @@ class _Servicer(GRPCInferenceServiceServicer):
                 if trace is not None:
                     trace.end(error=e.message())
                     trace = None
+                log = self.core.logger
+                if log.verbose_hot:
+                    log.verbose(
+                        "request",
+                        model=request.model_name,
+                        protocol="grpc",
+                        status="error",
+                        error=e.message(),
+                        streaming=True,
+                    )
                 error = pb.ModelStreamInferResponse(
                     error_message=e.message(),
                     infer_response=pb.ModelInferResponse(id=request.id),

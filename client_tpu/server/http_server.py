@@ -16,7 +16,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 from aiohttp import web
 
-from client_tpu.observability import TRACEPARENT_HEADER, validate_log_settings
+from client_tpu.observability import TRACEPARENT_HEADER
 
 # Back-compat alias: /metrics label escaping lived here before the
 # registry (client_tpu.observability.metrics) owned the exposition format.
@@ -105,7 +105,7 @@ def _chaos_middleware(chaos):
     return middleware
 
 
-def _guarded(handler):
+def _guarded(handler, logger=None):
     async def wrapper(request: web.Request) -> web.Response:
         try:
             return await handler(request)
@@ -114,6 +114,15 @@ def _guarded(handler):
         except web.HTTPException:
             raise
         except Exception as e:  # noqa: BLE001 - surface as server error
+            if logger is not None:
+                # a 500 previously left no server-side trace at all
+                logger.error(
+                    "internal_error",
+                    exc=e,
+                    rate_key=("internal_error", request.path),
+                    path=request.path,
+                    protocol="http",
+                )
             return _error_response(f"internal error: {e}", status=500)
 
     return wrapper
@@ -135,79 +144,91 @@ class HttpServer:
 
     def _add_routes(self) -> None:
         r = self.app.router
+
+        def guard(handler, _logger=self.core.logger):
+            # every registration below wraps through this: exceptions map
+            # to wire errors and internal 500s get a structured record
+            return _guarded(handler, _logger)
+
         g, p = r.add_get, r.add_post
-        g("/v2/health/live", _guarded(self.handle_live))
-        g("/v2/health/ready", _guarded(self.handle_ready))
-        g("/v2/models/{model}/ready", _guarded(self.handle_model_ready))
+        g("/v2/health/live", guard(self.handle_live))
+        g("/v2/health/ready", guard(self.handle_ready))
+        g("/v2/models/{model}/ready", guard(self.handle_model_ready))
         g(
             "/v2/models/{model}/versions/{version}/ready",
-            _guarded(self.handle_model_ready),
+            guard(self.handle_model_ready),
         )
-        g("/v2", _guarded(self.handle_server_metadata))
-        g("/v2/", _guarded(self.handle_server_metadata))
-        g("/v2/models/stats", _guarded(self.handle_stats))
-        g("/v2/models/{model}/stats", _guarded(self.handle_stats))
-        g("/v2/models/{model}/versions/{version}/stats", _guarded(self.handle_stats))
-        g("/v2/models/{model}", _guarded(self.handle_model_metadata))
+        g("/v2", guard(self.handle_server_metadata))
+        g("/v2/", guard(self.handle_server_metadata))
+        g("/v2/models/stats", guard(self.handle_stats))
+        g("/v2/models/{model}/stats", guard(self.handle_stats))
+        g("/v2/models/{model}/versions/{version}/stats", guard(self.handle_stats))
+        g("/v2/models/{model}", guard(self.handle_model_metadata))
         g(
             "/v2/models/{model}/versions/{version}",
-            _guarded(self.handle_model_metadata),
+            guard(self.handle_model_metadata),
         )
-        g("/v2/models/{model}/config", _guarded(self.handle_model_config))
+        g("/v2/models/{model}/config", guard(self.handle_model_config))
         g(
             "/v2/models/{model}/versions/{version}/config",
-            _guarded(self.handle_model_config),
+            guard(self.handle_model_config),
         )
-        p("/v2/repository/index", _guarded(self.handle_repository_index))
+        p("/v2/repository/index", guard(self.handle_repository_index))
         p(
             "/v2/repository/models/{model}/load",
-            _guarded(self.handle_repository_load),
+            guard(self.handle_repository_load),
         )
         p(
             "/v2/repository/models/{model}/unload",
-            _guarded(self.handle_repository_unload),
+            guard(self.handle_repository_unload),
         )
-        p("/v2/models/{model}/infer", _guarded(self.handle_infer))
+        p("/v2/models/{model}/infer", guard(self.handle_infer))
         p(
             "/v2/models/{model}/versions/{version}/infer",
-            _guarded(self.handle_infer),
+            guard(self.handle_infer),
         )
         for kind in ("system", "cuda", "tpu"):
             base = f"/v2/{kind}sharedmemory"
-            g(f"{base}/status", _guarded(self._shm_status_handler(kind)))
+            g(f"{base}/status", guard(self._shm_status_handler(kind)))
             g(
                 f"{base}/region/{{name}}/status",
-                _guarded(self._shm_status_handler(kind)),
+                guard(self._shm_status_handler(kind)),
             )
             p(
                 f"{base}/region/{{name}}/register",
-                _guarded(self._shm_register_handler(kind)),
+                guard(self._shm_register_handler(kind)),
             )
-            p(f"{base}/unregister", _guarded(self._shm_unregister_handler(kind)))
+            p(f"{base}/unregister", guard(self._shm_unregister_handler(kind)))
             p(
                 f"{base}/region/{{name}}/unregister",
-                _guarded(self._shm_unregister_handler(kind)),
+                guard(self._shm_unregister_handler(kind)),
             )
-        g("/v2/trace/setting", _guarded(self.handle_get_trace))
-        p("/v2/trace/setting", _guarded(self.handle_update_trace))
-        g("/v2/models/{model}/trace/setting", _guarded(self.handle_get_trace))
-        p("/v2/models/{model}/trace/setting", _guarded(self.handle_update_trace))
-        g("/v2/logging", _guarded(self.handle_get_logging))
-        p("/v2/logging", _guarded(self.handle_update_logging))
-        g("/metrics", _guarded(self.handle_metrics))
+        g("/v2/trace/setting", guard(self.handle_get_trace))
+        p("/v2/trace/setting", guard(self.handle_update_trace))
+        g("/v2/models/{model}/trace/setting", guard(self.handle_get_trace))
+        p("/v2/models/{model}/trace/setting", guard(self.handle_update_trace))
+        g("/v2/logging", guard(self.handle_get_logging))
+        p("/v2/logging", guard(self.handle_update_logging))
+        g("/v2/models/{model}/logging", guard(self.handle_get_logging))
+        p("/v2/models/{model}/logging", guard(self.handle_update_logging))
+        # Flight recorder + live-state introspection (the debugging
+        # surface: "what are your slowest/failed requests right now?").
+        g("/v2/debug/requests", guard(self.handle_debug_requests))
+        g("/v2/debug/state", guard(self.handle_debug_state))
+        g("/metrics", guard(self.handle_metrics))
         # Hot-path profiling (observability.profiling): stage-CPU
         # accounting toggle + the on-demand wall-stack sampler.
-        g("/v2/debug/profiling", _guarded(self.handle_get_profiling))
-        p("/v2/debug/profiling", _guarded(self.handle_update_profiling))
-        g("/v2/debug/profile", _guarded(self.handle_profile))
+        g("/v2/debug/profiling", guard(self.handle_get_profiling))
+        p("/v2/debug/profiling", guard(self.handle_update_profiling))
+        g("/v2/debug/profile", guard(self.handle_profile))
         # OpenAI-compatible front-end (chat/completions + SSE streaming).
         from client_tpu.server.openai_frontend import OpenAiFrontend
 
-        OpenAiFrontend(self.core).add_routes(self.app, _guarded)
+        OpenAiFrontend(self.core).add_routes(self.app, guard)
         # TFS + TorchServe REST compatibility (perf-harness backends).
         from client_tpu.server.compat_frontends import CompatFrontends
 
-        CompatFrontends(self.core).add_routes(self.app, _guarded)
+        CompatFrontends(self.core).add_routes(self.app, guard)
 
     # -- health / metadata ---------------------------------------------------
 
@@ -269,6 +290,9 @@ class HttpServer:
             config_override = params.get("config")
         self.core.repository.load(
             request.match_info["model"], config_override=config_override
+        )
+        self.core.logger.info(
+            "model_loaded", model=request.match_info["model"]
         )
         return web.Response(status=200)
 
@@ -402,13 +426,47 @@ class HttpServer:
         )
 
     async def handle_get_logging(self, request):
-        return web.json_response(self.core.log_settings)
+        model = request.match_info.get("model", "")
+        return web.json_response(self.core.logger.settings(model))
 
     async def handle_update_logging(self, request):
+        # Backed by the real structured logger: applying an update
+        # changes what the server emits immediately (no restart). The
+        # model scope comes from the /v2/models/{model}/logging route or
+        # a "model" key in the body (the gRPC wire uses the same key); a
+        # null value clears a per-model override / resets a global
+        # setting, mirroring the trace-settings RPC.
         updates = self._parse_settings_body(await request.read())
-        updates = {k: v for k, v in updates.items() if v is not None}
-        self.core.log_settings.update(validate_log_settings(updates))
-        return web.json_response(self.core.log_settings)
+        model = request.match_info.get("model", "")
+        body_model = updates.pop("model", None)
+        if body_model is not None:
+            if not isinstance(body_model, str):
+                raise InferenceServerException(
+                    f"log setting 'model' expects a string, got {body_model!r}"
+                )
+            model = body_model
+        return web.json_response(self.core.update_log_settings(updates, model))
+
+    # -- flight recorder / live state ----------------------------------------
+
+    async def handle_debug_requests(self, request):
+        """Recent / failed / slowest request exemplars
+        (``?model=`` filter, ``?limit=`` per-section cap)."""
+        model = request.query.get("model") or None
+        limit = request.query.get("limit")
+        if limit is not None:
+            try:
+                limit = int(limit)
+            except ValueError:
+                raise InferenceServerException(
+                    f"debug requests limit must be an integer, got '{limit}'"
+                ) from None
+        return web.json_response(
+            self.core.flight_recorder.snapshot(model=model, limit=limit)
+        )
+
+    async def handle_debug_state(self, request):
+        return web.json_response(self.core.debug_state())
 
     # -- profiling -----------------------------------------------------------
 
@@ -604,9 +662,27 @@ class HttpServer:
         except BaseException as e:
             if trace is not None:
                 trace.end(error=str(e))
+            log = self.core.logger
+            if log.verbose_hot:
+                log.verbose(
+                    "request",
+                    model=model_name,
+                    protocol="http",
+                    status="error",
+                    error=str(e),
+                )
             raise
         if trace is not None:
             trace.end()
+        log = self.core.logger
+        if log.verbose_hot:
+            log.verbose(
+                "request",
+                model=model_name,
+                protocol="http",
+                status="ok",
+                request_id=core_request.id,
+            )
         return response
 
     def _build_core_request(

@@ -320,6 +320,7 @@ class NativeGrpcFrontend:
         if requests:
             results = self._core.infer_direct(requests)
             encode_cpu0 = prof.cpu_now() if measured else 0
+            log = self._core.logger
             for handle, result in zip(handles, results):
                 if isinstance(result, Exception):
                     # Execution errors are the server/model's fault:
@@ -328,6 +329,14 @@ class NativeGrpcFrontend:
                         self._error_completion(handle, result)
                     )
                 else:
+                    if log.verbose_hot:
+                        log.verbose(
+                            "request",
+                            model=result.model_name,
+                            protocol="grpc-native",
+                            status="ok",
+                            request_id=result.id,
+                        )
                     completions.append(
                         self._response_completion(handle, result, 1)
                     )
@@ -352,6 +361,15 @@ class NativeGrpcFrontend:
         else:
             message = str(e)
             status = codec.GRPC_INTERNAL if default is None else default
+        log = self._core.logger
+        if log.verbose_hot:
+            log.verbose(
+                "request",
+                protocol="grpc-native",
+                status="error",
+                error=message,
+                grpc_status=status,
+            )
         return (handle, "", "", "", None, None, 1, message, status)
 
     def _submit_batch(self, batch) -> None:

@@ -69,6 +69,19 @@ def pytest_configure(config):
             "client_tpu/resilience and client_tpu/scheduling):\n"
             + "\n".join(problems)
         )
+    # Structured-logging lint: the server-side packages must emit through
+    # the StructuredLogger (JSON, severity-gated, /v2/logging-controlled)
+    # — bare print() and stdlib logging bypass all of that.
+    from tools.log_lint import run_log_lint
+
+    problems = run_log_lint()
+    if problems:
+        raise pytest.UsageError(
+            "log lint failed (no bare print()/stdlib logging in "
+            "client_tpu/server and client_tpu/observability; use "
+            "client_tpu.observability.logging.StructuredLogger):\n"
+            + "\n".join(problems)
+        )
 
 
 def pytest_collection_modifyitems(config, items):

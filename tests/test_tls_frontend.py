@@ -65,10 +65,12 @@ def tls_server(tls_certs):
         line = proc.stdout.readline()
         if not line:
             break
-        if "listening" in line:
-            for part in line.split():
-                if part.startswith("grpc="):
-                    grpc_port = int(part.split(":")[-1])
+        # the startup banner is a structured server_started JSON event
+        if "server_started" in line:
+            try:
+                grpc_port = int(json.loads(line)["grpc_port"])
+            except (ValueError, KeyError, TypeError):
+                continue
             break
     if grpc_port is None:
         proc.kill()

@@ -31,10 +31,13 @@ TARGET_DIRS = (
 
 # clock-injected modules outside the blanket-linted packages, plus
 # explicitly-pinned files inside them (profiling.py reads thread CPU
-# clocks — the shim below must stay injected even if the directory list
-# ever changes); findings are deduplicated against the directory walk
+# clocks; logging.py/recorder.py stamp wall timestamps and rate windows —
+# these must stay injected even if the directory list ever changes);
+# findings are deduplicated against the directory walk
 TARGET_FILES = (
+    os.path.join("client_tpu", "observability", "logging.py"),
     os.path.join("client_tpu", "observability", "profiling.py"),
+    os.path.join("client_tpu", "observability", "recorder.py"),
     os.path.join("client_tpu", "perf", "metrics_collector.py"),
 )
 

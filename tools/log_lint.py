@@ -1,0 +1,107 @@
+"""Structured-logging lint for the server-side packages.
+
+Server output must be machine-parsable: every record the serving stack
+writes goes through :class:`client_tpu.observability.logging
+.StructuredLogger` (JSON lines, severity-gated by the live ``/v2/logging``
+settings). A bare ``print()`` bypasses the severity gates, the rate
+limiter, and the ``log_file`` exporter; stdlib ``logging`` smuggles in a
+second, unconfigured formatting pipeline whose records the settings RPCs
+cannot reach. This lint bans both inside ``client_tpu/server/`` and
+``client_tpu/observability/``.
+
+AST-based like ``tools/clock_lint.py``: only ``print(...)`` *call* nodes
+and ``import logging`` / ``from logging import ...`` of the *stdlib*
+module are flagged (``client_tpu.observability.logging`` imports are the
+fix, not a finding). Runs standalone (``python tools/log_lint.py``) and at
+test session start via ``tests/conftest.py``.
+"""
+
+import ast
+import os
+from typing import List, Tuple
+
+TARGET_DIRS = (
+    os.path.join("client_tpu", "observability"),
+    os.path.join("client_tpu", "server"),
+)
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def check_source(source: str, filename: str) -> List[Tuple[int, str]]:
+    """Findings for one module: (lineno, message) per banned construct."""
+    tree = ast.parse(source, filename=filename)
+    findings = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "logging" or alias.name.startswith(
+                    "logging."
+                ):
+                    findings.append(
+                        (
+                            node.lineno,
+                            "stdlib logging import — use "
+                            "client_tpu.observability.logging."
+                            "StructuredLogger instead",
+                        )
+                    )
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "logging" and node.level == 0:
+                findings.append(
+                    (
+                        node.lineno,
+                        "stdlib logging import — use "
+                        "client_tpu.observability.logging."
+                        "StructuredLogger instead",
+                    )
+                )
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Name) and func.id == "print":
+                findings.append(
+                    (
+                        node.lineno,
+                        "bare print() call — emit through the structured "
+                        "logger so the record is JSON, severity-gated, "
+                        "and reaches the configured log_file",
+                    )
+                )
+    return findings
+
+
+def run_log_lint(repo_root: str = None) -> List[str]:
+    """Lint the target packages; returns 'path:line: message' strings."""
+    root = repo_root or _repo_root()
+    problems = []
+    for target in TARGET_DIRS:
+        base = os.path.join(root, target)
+        for dirpath, _dirs, files in os.walk(base):
+            if "__pycache__" in dirpath:
+                continue
+            for name in sorted(files):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+                for lineno, message in check_source(source, path):
+                    rel = os.path.relpath(path, root)
+                    problems.append(f"{rel}:{lineno}: {message}")
+    return problems
+
+
+def main() -> int:
+    problems = run_log_lint()
+    for problem in problems:
+        print(problem)
+    if problems:
+        print(f"log lint: {len(problems)} finding(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
