@@ -431,6 +431,13 @@ def main() -> int:
         # the headline above ran with accounting off).
         stage_attribution = _bench_stage_attribution(server)
 
+        # Live-telemetry spot check while the server still serves: the
+        # rolling 30s window the SLO layer computed over the most recent
+        # load — cross-checkable against the harness-side percentiles.
+        rolling_30s = server.core.metrics.telemetry.rolling("simple").get(
+            "30s", {}
+        )
+
     value = round(result["throughput"], 2)
     line = {
         "metric": (
@@ -467,6 +474,11 @@ def main() -> int:
     # Per-stage decomposition of the wire path's server CPU (us/req per
     # stage; "rpc" is per non-inference call). Schema: PERF.md PR-6.
     line.update(stage_attribution)
+    if rolling_30s.get("count"):
+        # server-side rolling-window view of the tail at run end (PR 8);
+        # the stage-attribution pass is the most recent load it covers
+        line["rolling_30s_p99_us"] = rolling_30s.get("p99_us", 0.0)
+        line["rolling_30s_count"] = rolling_30s.get("count", 0)
     # Contention caveat: with few cores the client, server wire threads,
     # and model share the core budget, so ratio_vs_inproc is a relative
     # tracker, not an isolated-server measurement (PERF.md round 5).

@@ -285,6 +285,7 @@ class InferenceServerClient(InferenceServerClientBase):
 
         def _send(attempt_timeout):
             endpoint = self._pick_endpoint(attempt_timeout)
+            started = pool.begin(endpoint)
             try:
                 value = getattr(self._stub_for(endpoint.url), name)(
                     request,
@@ -293,6 +294,7 @@ class InferenceServerClient(InferenceServerClientBase):
                     compression=compression,
                 )
             except grpc.RpcError as e:
+                pool.finish(endpoint, started, ok=False)
                 exc = rpc_error_to_exception(e)
                 if status_is_unavailable(exc.status()):
                     # draining/dead endpoint: bench it; with an
@@ -301,6 +303,12 @@ class InferenceServerClient(InferenceServerClientBase):
                     if pool.has_alternative(endpoint):
                         exc.retry_backoff_cap_s = 0.0
                 raise exc from None
+            except BaseException:
+                # an unwrapped error: close the bracket so the
+                # outstanding gauge never leaks
+                pool.finish(endpoint, started, ok=False)
+                raise
+            pool.finish(endpoint, started, ok=True)
             pool.observe(endpoint, ok=True)
             return value
 
@@ -318,6 +326,14 @@ class InferenceServerClient(InferenceServerClientBase):
         self.stop_stream()
         for channel in self._channels.values():
             channel.close()
+
+    def endpoint_snapshot(self) -> dict:
+        """Live per-endpoint pool telemetry — outstanding requests, EWMA
+        latency, error/reroute counters per endpoint (see
+        :meth:`~client_tpu.lifecycle.EndpointPool.snapshot`). Unary
+        calls are begin/finish-bracketed; the bidirectional stream pins
+        its endpoint at open and is not counted per-request."""
+        return self._pool.snapshot()
 
     def __enter__(self) -> "InferenceServerClient":
         return self

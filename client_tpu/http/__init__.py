@@ -167,6 +167,12 @@ class InferenceServerClient:
     def unregister_plugin(self):
         self._aio_client.unregister_plugin()
 
+    def endpoint_snapshot(self) -> dict:
+        """Live per-endpoint pool telemetry (see
+        :meth:`~client_tpu.lifecycle.EndpointPool.snapshot`); sync read
+        of the aio client's pool — no loop hop needed."""
+        return self._aio_client.endpoint_snapshot()
+
     # health
     is_server_live = _delegated("is_server_live")
     is_server_ready = _delegated("is_server_ready")

@@ -168,6 +168,13 @@ class InferenceServerClient(InferenceServerClientBase):
         if self._session is not None and not self._session.closed:
             await self._session.close()
 
+    def endpoint_snapshot(self) -> dict:
+        """Live per-endpoint telemetry (outstanding requests, EWMA
+        latency, error/reroute counters) — every request this client
+        sends is bracketed through its :class:`~client_tpu.lifecycle.
+        EndpointPool`; see :meth:`EndpointPool.snapshot`."""
+        return self._pool.snapshot()
+
     async def __aenter__(self) -> "InferenceServerClient":
         return self
 
@@ -286,12 +293,14 @@ class InferenceServerClient(InferenceServerClientBase):
             if self._verbose:
                 size = f" ({len(data)} bytes)" if data else ""
                 print(f"{method} {url}{size}")
+            started = pool.begin(endpoint)
             try:
                 result = await self._request_once(
                     method, url, data, prepared_headers, attempt_timeout,
                     trace=trace,
                 )
             except InferenceServerException as e:
+                pool.finish(endpoint, started, ok=False)
                 if e.status() == CONNECTION_ERROR_STATUS:
                     # dead endpoint: bench it; with an alternative
                     # available the retry loop skips the backoff sleep
@@ -299,7 +308,13 @@ class InferenceServerClient(InferenceServerClientBase):
                     if pool.has_alternative(endpoint):
                         e.retry_backoff_cap_s = 0.0
                 raise
+            except BaseException:
+                # cancellation or an unwrapped error: close the bracket
+                # so the outstanding gauge never leaks
+                pool.finish(endpoint, started, ok=False)
+                raise
             token = str(result[0])
+            pool.finish(endpoint, started, ok=token.startswith("2"))
             if status_is_unavailable(token):
                 # draining server: bench it for its own Retry-After hint
                 pool.observe(

@@ -38,8 +38,22 @@ def status_is_unavailable(token: Optional[str]) -> bool:
     return token.rsplit(".", 1)[-1] in UNAVAILABLE_TOKENS
 
 
+# EWMA smoothing for the per-endpoint latency estimate: ~the last 20
+# requests dominate, old incidents decay instead of poisoning the mean
+# forever (the "least-EWMA-latency" routing policy input).
+EWMA_ALPHA = 0.1
+
+
 class Endpoint:
-    """One pool member's health state."""
+    """One pool member's health + telemetry state.
+
+    Beyond the failover fields, each endpoint carries the live stats the
+    routing policies of the scale-out arc consume: ``outstanding`` (the
+    least-outstanding / power-of-two-choices signal), ``ewma_latency_s``
+    (the latency-aware signal), and error/reroute counters. All are
+    updated under the pool lock by :meth:`EndpointPool.begin` /
+    :meth:`EndpointPool.finish` / :meth:`EndpointPool.mark_down`.
+    """
 
     __slots__ = (
         "url",
@@ -48,6 +62,10 @@ class Endpoint:
         "was_down",
         "failures",
         "successes",
+        "outstanding",
+        "ewma_latency_s",
+        "errors",
+        "reroutes",
     )
 
     def __init__(self, url: str, circuit_breaker=None):
@@ -59,6 +77,11 @@ class Endpoint:
         self.was_down = False
         self.failures = 0
         self.successes = 0
+        # live telemetry (begin/finish bracket every attempt)
+        self.outstanding = 0
+        self.ewma_latency_s = 0.0
+        self.errors = 0
+        self.reroutes = 0
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return f"Endpoint({self.url!r}, down_until={self.down_until})"
@@ -197,6 +220,63 @@ class EndpointPool:
         with self._lock:
             return ep.was_down and self._clock() >= ep.down_until
 
+    # -- per-endpoint telemetry ----------------------------------------------
+
+    def begin(self, ep: Endpoint) -> float:
+        """Mark one request outstanding on ``ep``; returns the start
+        timestamp the caller passes back to :meth:`finish`. Every attempt
+        a client surface sends brackets itself with begin/finish, so
+        ``outstanding`` is the live in-flight count per endpoint — the
+        signal a least-outstanding routing policy selects on."""
+        with self._lock:
+            ep.outstanding += 1
+        return self._clock()
+
+    def finish(self, ep: Endpoint, started: float, ok: bool) -> None:
+        """Close the begin/finish bracket: drop the outstanding count,
+        fold a successful attempt's latency into the EWMA, count an
+        error. Endpoint-health signals (503/UNAVAILABLE benching) stay
+        with :meth:`observe` — a 400 is an error here but says nothing
+        about endpoint health there."""
+        latency_s = self._clock() - started
+        with self._lock:
+            if ep.outstanding > 0:
+                ep.outstanding -= 1
+            if ok:
+                if ep.ewma_latency_s:
+                    ep.ewma_latency_s += EWMA_ALPHA * (
+                        latency_s - ep.ewma_latency_s
+                    )
+                else:
+                    ep.ewma_latency_s = latency_s
+            else:
+                ep.errors += 1
+
+    def snapshot(self) -> dict:
+        """The pool's live telemetry in one consistent read: per-endpoint
+        outstanding/EWMA/counters plus the pool-level failover count —
+        what the perf report's "Client metrics" section prints and what
+        the scale-out routing policies will consume."""
+        with self._lock:
+            now = self._clock()
+            return {
+                "primary": self._endpoints[self._primary].url,
+                "failovers": self.failovers,
+                "endpoints": [
+                    {
+                        "url": ep.url,
+                        "outstanding": ep.outstanding,
+                        "ewma_latency_us": round(ep.ewma_latency_s * 1e6, 1),
+                        "successes": ep.successes,
+                        "errors": ep.errors,
+                        "marked_down": ep.failures,
+                        "reroutes": ep.reroutes,
+                        "down": bool(ep.down_until and now < ep.down_until),
+                    }
+                    for ep in self._endpoints
+                ],
+            }
+
     # -- health feedback -----------------------------------------------------
 
     def mark_down(
@@ -214,6 +294,10 @@ class EndpointPool:
             if n > 1 and self._endpoints[self._primary] is ep:
                 self._primary = (self._primary + 1) % n
                 self.failovers += 1
+                # traffic that was sticky on ep is rerouted to the new
+                # primary from here on — charged to the endpoint that
+                # caused the move
+                ep.reroutes += 1
                 failed_over = self._endpoints[self._primary].url
         if self._logger is not None:
             self._logger.warning(

@@ -1,0 +1,264 @@
+"""Fleet metrics aggregation: N replicas' ``/metrics`` into one view.
+
+A single replica's scrape answers "how is this instance"; running a
+fleet needs "how is the service, and which replica is dragging it".
+This module merges parsed exposition documents (the output of
+:func:`client_tpu.observability.metrics.parse_exposition` — our own
+renderer's round-trip partner) across replicas:
+
+- **counters and histograms** sum pointwise per (name, labels) — deltas
+  and quantiles over the merged families describe the whole fleet;
+- **gauges** keep the max across replicas (the operator-relevant bound:
+  peak memory, worst queue depth), with per-replica values preserved in
+  the :class:`ReplicaStats` rows so min/max spreads stay visible;
+- **skew detection** compares replicas' rolling p99
+  (``tpu_rolling_latency_seconds{window=...,quantile="0.99"}``, falling
+  back to the cumulative duration histogram delta when the live gauge is
+  absent) and flags the slowest-vs-fastest ratio past a threshold — the
+  "which of my N replicas is slow" answer.
+
+Pure data reductions — no sockets, no clocks. The perf harness's
+``--metrics-url a,b,c`` builds one scraper per replica and feeds the
+snapshots here (``client_tpu.perf.metrics_collector.FleetCollector``).
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from client_tpu.observability.metrics import (
+    ParsedFamily,
+    ParsedSample,
+    counter_total,
+    gauge_values,
+    histogram_totals,
+)
+
+__all__ = [
+    "FleetSummary",
+    "ReplicaStats",
+    "bucket_delta",
+    "fleet_skew",
+    "merge_families",
+    "replica_stats",
+    "summarize_fleet",
+]
+
+# slowest/fastest rolling-p99 ratio at which the fleet report calls a
+# replica out (2x: one replica serving half the speed of its peers).
+SKEW_RATIO_THRESHOLD = 2.0
+
+
+@dataclass
+class ReplicaStats:
+    """One replica's contribution to the fleet window."""
+
+    url: str
+    requests: int = 0
+    failures: int = 0
+    duty: float = 0.0
+    avg_request_us: float = 0.0
+    p99_s: float = 0.0
+    p99_source: str = ""  # "rolling" | "histogram" | ""
+    # THIS replica's own first->last scrape span: a replica whose
+    # endpoint stopped answering mid-run has a shorter span than the
+    # fleet, and its duty/rate must be computed over its own window
+    window_s: float = 0.0
+
+
+@dataclass
+class FleetSummary:
+    replicas: List[ReplicaStats] = field(default_factory=list)
+    total_requests: int = 0
+    total_failures: int = 0
+    window_s: float = 0.0
+    skew: Optional[Dict[str, Any]] = None
+    merged: Dict[str, ParsedFamily] = field(default_factory=dict)
+
+
+def _sample_key(sample: ParsedSample) -> Tuple[str, Tuple[Tuple[str, str], ...]]:
+    return sample.name, tuple(sorted(sample.labels.items()))
+
+
+def merge_families(
+    docs: Sequence[Dict[str, ParsedFamily]],
+) -> Dict[str, ParsedFamily]:
+    """Merge parsed exposition documents: counter/histogram samples sum
+    per (name, labels); gauge (and untyped) samples keep the max."""
+    merged: Dict[str, ParsedFamily] = {}
+    accumulators: Dict[str, Dict[Tuple, ParsedSample]] = {}
+    for doc in docs:
+        for name, family in doc.items():
+            target = merged.get(name)
+            if target is None:
+                target = merged[name] = ParsedFamily(
+                    name=name, kind=family.kind, help=family.help
+                )
+                accumulators[name] = {}
+            summing = family.kind in ("counter", "histogram", "summary")
+            acc = accumulators[name]
+            for sample in family.samples:
+                key = _sample_key(sample)
+                existing = acc.get(key)
+                if existing is None:
+                    acc[key] = ParsedSample(
+                        name=sample.name,
+                        labels=dict(sample.labels),
+                        value=sample.value,
+                    )
+                elif summing:
+                    existing.value += sample.value
+                else:
+                    existing.value = max(existing.value, sample.value)
+    for name, acc in accumulators.items():
+        merged[name].samples = list(acc.values())
+    return merged
+
+
+def _histogram_p99(delta_buckets: List[Tuple[float, float]], count: float) -> float:
+    """p99 from non-cumulative per-bucket deltas [(le, count)]: the bound
+    of the bucket holding the 99th-percentile rank (upper-bound estimate,
+    matching the rolling sketch's grid resolution)."""
+    if count <= 0:
+        return 0.0
+    rank = 0.99 * count
+    cumulative = 0.0
+    last_finite = 0.0
+    for le, bucket_count in delta_buckets:
+        cumulative += bucket_count
+        if le != float("inf"):
+            last_finite = le
+        if cumulative >= rank:
+            return le if le != float("inf") else last_finite
+    return last_finite
+
+
+def bucket_delta(
+    before: List[Tuple[float, float]], after: List[Tuple[float, float]]
+) -> List[Tuple[float, float]]:
+    """Per-bucket (non-cumulative) observation deltas between two
+    cumulative bucket snapshots. Shared with the perf collector's
+    scrape reduction."""
+    base = dict(before)
+    out: List[Tuple[float, float]] = []
+    previous = 0.0
+    for le, cumulative in after:
+        delta = cumulative - base.get(le, 0.0)
+        out.append((le, delta - previous))
+        previous = delta
+    return out
+
+
+def replica_stats(
+    url: str,
+    first: Dict[str, ParsedFamily],
+    last: Dict[str, ParsedFamily],
+    window_s: float = 0.0,
+    model: str = "",
+    rolling_window: str = "30s",
+) -> ReplicaStats:
+    """Reduce one replica's first->last scrape pair to its fleet row."""
+    match = {"model": model} if model else None
+    stats = ReplicaStats(url=url, window_s=window_s)
+    stats.requests = int(
+        counter_total(last.get("tpu_inference_request_success"), match)
+        - counter_total(first.get("tpu_inference_request_success"), match)
+    )
+    stats.failures = int(
+        counter_total(last.get("tpu_inference_request_failure"), match)
+        - counter_total(first.get("tpu_inference_request_failure"), match)
+    )
+    a = histogram_totals(first.get("tpu_inference_request_duration"), match)
+    b = histogram_totals(last.get("tpu_inference_request_duration"), match)
+    delta_count = b["count"] - a["count"]
+    if delta_count > 0:
+        stats.avg_request_us = (b["sum"] - a["sum"]) / delta_count * 1e6
+    # duty from the monotone busy counter over the window
+    busy_a = gauge_values(first.get("tpu_device_compute_ns_total"))
+    busy_b = gauge_values(last.get("tpu_device_compute_ns_total"))
+    if busy_a and busy_b and window_s > 0:
+        stats.duty = min(
+            1.0, max(0.0, busy_b[0] - busy_a[0]) / (window_s * 1e9)
+        )
+    # live rolling p99 (preferred: it reflects "now", not the lifetime)
+    rolling_match = {"window": rolling_window, "quantile": "0.99"}
+    if model:
+        rolling_match["model"] = model
+    rolling = gauge_values(
+        last.get("tpu_rolling_latency_seconds"), rolling_match
+    )
+    rolling = [v for v in rolling if v > 0]
+    if rolling:
+        stats.p99_s = max(rolling)
+        stats.p99_source = "rolling"
+    elif delta_count > 0:
+        stats.p99_s = _histogram_p99(
+            bucket_delta(a["buckets"], b["buckets"]), delta_count
+        )
+        stats.p99_source = "histogram"
+    return stats
+
+
+def fleet_skew(
+    replicas: Sequence[ReplicaStats],
+    ratio_threshold: float = SKEW_RATIO_THRESHOLD,
+) -> Optional[Dict[str, Any]]:
+    """Slowest-vs-fastest rolling p99 across replicas; ``flagged`` when
+    the ratio crosses the threshold. None with fewer than two replicas
+    reporting a COMPARABLE p99: the rolling gauge interpolates inside
+    its bucket while the histogram fallback reports the bucket's upper
+    bound, so mixing the two sources can manufacture a 2x "skew" out of
+    pure quantization — replicas are only compared within one source
+    (the live rolling one preferred)."""
+    measured = [r for r in replicas if r.p99_s > 0]
+    groups: Dict[str, List[ReplicaStats]] = {}
+    for replica in measured:
+        groups.setdefault(replica.p99_source, []).append(replica)
+    pool = groups.get("rolling", [])
+    if len(pool) < 2:
+        others = [g for src, g in groups.items() if src != "rolling"]
+        pool = max(others, key=len, default=[])
+    if len(pool) < 2:
+        return None
+    slowest = max(pool, key=lambda r: r.p99_s)
+    fastest = min(pool, key=lambda r: r.p99_s)
+    ratio = slowest.p99_s / fastest.p99_s if fastest.p99_s else float("inf")
+    return {
+        "slowest": slowest.url,
+        "fastest": fastest.url,
+        "slowest_p99_us": round(slowest.p99_s * 1e6, 1),
+        "fastest_p99_us": round(fastest.p99_s * 1e6, 1),
+        "ratio": round(ratio, 2),
+        "flagged": ratio >= ratio_threshold,
+        "source": pool[0].p99_source,
+        # replicas whose p99 came from the other source (or none) were
+        # not comparable and sat out the verdict
+        "compared": len(pool),
+    }
+
+
+def summarize_fleet(
+    entries: Sequence[Tuple],
+    window_s: float = 0.0,
+    model: str = "",
+    ratio_threshold: float = SKEW_RATIO_THRESHOLD,
+) -> FleetSummary:
+    """Reduce ``(url, first_scrape, last_scrape[, window_s])`` per
+    replica to the fleet view: per-replica rows, summed totals, merged
+    families, and the skew verdict. A 4-tuple carries the replica's OWN
+    scrape span (its duty/rate denominator — an endpoint that stopped
+    answering mid-run covers less time than the fleet); 3-tuples fall
+    back to the fleet-wide ``window_s``."""
+    summary = FleetSummary(window_s=window_s)
+    for entry in entries:
+        url, first, last = entry[0], entry[1], entry[2]
+        replica_window = entry[3] if len(entry) > 3 else window_s
+        summary.replicas.append(
+            replica_stats(
+                url, first, last, window_s=replica_window, model=model
+            )
+        )
+    summary.total_requests = sum(r.requests for r in summary.replicas)
+    summary.total_failures = sum(r.failures for r in summary.replicas)
+    summary.skew = fleet_skew(summary.replicas, ratio_threshold)
+    summary.merged = merge_families([entry[2] for entry in entries])
+    return summary

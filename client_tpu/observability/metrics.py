@@ -35,6 +35,7 @@ __all__ = [
     "ParsedSample",
     "escape_help",
     "escape_label_value",
+    "format_exemplar",
     "format_value",
     "histogram_totals",
     "parse_exposition",
@@ -170,21 +171,36 @@ class _Child:
 class _HistogramChild:
     """One labeled histogram series: bucket counts + sum."""
 
-    __slots__ = ("_family", "_counts", "_sum")
+    __slots__ = ("_family", "_counts", "_sum", "_exemplars")
 
     def __init__(self, family: "Histogram"):
         self._family = family
         # one slot per finite bound plus the +Inf overflow slot
         self._counts = [0] * (len(family.buckets) + 1)
         self._sum = 0.0
+        # bucket index -> (labels dict, observed value): the most recent
+        # exemplar per bucket (OpenMetrics exemplars; rendered only when
+        # the registry renders with exemplars=True)
+        self._exemplars: Optional[Dict[int, Tuple[Dict[str, str], float]]] = None
 
-    def observe(self, value: float, count: int = 1) -> None:
+    def observe(
+        self,
+        value: float,
+        count: int = 1,
+        exemplar: Optional[Tuple[Dict[str, str], float]] = None,
+    ) -> None:
         """Record ``count`` observations of ``value`` (count > 1 books a
-        merged batch in one call — the direct-path per-chunk booking)."""
+        merged batch in one call — the direct-path per-chunk booking).
+        ``exemplar`` — ``(labels, exemplar_value)``, e.g. a trace id and
+        its latency — attaches to the bucket containing ``value``."""
         index = bisect.bisect_left(self._family.buckets, value)
         with self._family._lock:
             self._counts[index] += count
             self._sum += value * count
+            if exemplar is not None:
+                if self._exemplars is None:
+                    self._exemplars = {}
+                self._exemplars[index] = exemplar
 
     def get(self) -> Tuple[List[int], float]:
         with self._family._lock:
@@ -193,11 +209,23 @@ class _HistogramChild:
 
 @dataclass
 class Sample:
-    """One rendered time series: full sample name, labels, value."""
+    """One rendered time series: full sample name, labels, value.
+    ``exemplar`` — (labels, value) — rides histogram bucket samples when
+    the owning family recorded one (rendered only on request)."""
 
     name: str
     labels: List[Tuple[str, str]]
     value: float
+    exemplar: Optional[Tuple[Dict[str, str], float]] = None
+
+
+def format_exemplar(exemplar: Tuple[Dict[str, str], float]) -> str:
+    """The OpenMetrics exemplar tail: ``# {label="v",...} value``."""
+    labels, value = exemplar
+    body = ",".join(
+        f'{n}="{escape_label_value(v)}"' for n, v in labels.items()
+    )
+    return f"# {{{body}}} {format_value(float(value))}"
 
 
 class _Family:
@@ -254,6 +282,20 @@ class _Family:
                 self._children[key] = child
             return child
 
+    def remove(self, *values) -> None:
+        """Drop the child for one label-value combination (no-op when
+        absent) — a family whose label space churns (per-model gauges
+        across unloads) prunes here so scrapes stop reporting entities
+        that no longer exist."""
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            self._children.pop(key, None)
+
+    def label_sets(self) -> List[Tuple[str, ...]]:
+        """The label-value combinations currently holding a child."""
+        with self._lock:
+            return list(self._children.keys())
+
     # unlabeled conveniences ------------------------------------------------
 
     def inc(self, amount: float = 1.0) -> None:
@@ -272,16 +314,19 @@ class _Family:
             for key, value in items
         ]
 
-    def render(self, out: List[str]) -> None:
+    def render(self, out: List[str], exemplars: bool = False) -> None:
         out.append(f"# HELP {self.name} {escape_help(self.documentation)}")
         out.append(f"# TYPE {self.name} {self.kind}")
         for sample in self.collect():
             names = [n for n, _ in sample.labels]
             values = [v for _, v in sample.labels]
-            out.append(
+            line = (
                 f"{sample.name}{_format_labels(names, values)} "
                 f"{format_value(sample.value)}"
             )
+            if exemplars and sample.exemplar is not None:
+                line += f" {format_exemplar(sample.exemplar)}"
+            out.append(line)
 
 
 class Counter(_Family):
@@ -319,32 +364,48 @@ class Histogram(_Family):
     def _make_child(self):
         return _HistogramChild(self)
 
-    def observe(self, value: float, count: int = 1) -> None:
-        self.labels().observe(value, count)
+    def observe(
+        self,
+        value: float,
+        count: int = 1,
+        exemplar: Optional[Tuple[Dict[str, str], float]] = None,
+    ) -> None:
+        self.labels().observe(value, count, exemplar=exemplar)
 
     def collect(self) -> List[Sample]:
         with self._lock:
             items = [
-                (key, list(child._counts), child._sum)
+                (
+                    key,
+                    list(child._counts),
+                    child._sum,
+                    dict(child._exemplars) if child._exemplars else None,
+                )
                 for key, child in self._children.items()
             ]
         samples: List[Sample] = []
-        for key, counts, total in items:
+        for key, counts, total, exemplars in items:
             base = list(zip(self.labelnames, key))
             cumulative = 0
-            for bound, count in zip(self.buckets, counts):
+            for i, (bound, count) in enumerate(zip(self.buckets, counts)):
                 cumulative += count
                 samples.append(
                     Sample(
                         f"{self.name}_bucket",
                         base + [("le", format_value(float(bound)))],
                         cumulative,
+                        exemplar=exemplars.get(i) if exemplars else None,
                     )
                 )
             cumulative += counts[-1]
             samples.append(
                 Sample(
-                    f"{self.name}_bucket", base + [("le", "+Inf")], cumulative
+                    f"{self.name}_bucket",
+                    base + [("le", "+Inf")],
+                    cumulative,
+                    exemplar=(
+                        exemplars.get(len(self.buckets)) if exemplars else None
+                    ),
                 )
             )
             samples.append(Sample(f"{self.name}_sum", list(base), total))
@@ -383,10 +444,12 @@ class MetricsRegistry:
         with self._lock:
             return list(self._families.values())
 
-    def render(self) -> str:
+    def render(self, exemplars: bool = False) -> str:
         """The full exposition document (HELP, TYPE, samples per family,
         registration order). Hook failures are swallowed: a scrape must
-        degrade, never 500."""
+        degrade, never 500. ``exemplars=True`` appends OpenMetrics
+        exemplars to histogram bucket samples that carry one; the
+        default Prometheus text format is byte-identical to before."""
         with self._lock:
             hooks = list(self._collect_hooks)
             families = list(self._families.values())
@@ -397,7 +460,7 @@ class MetricsRegistry:
                 pass
         lines: List[str] = []
         for family in families:
-            family.render(lines)
+            family.render(lines, exemplars=exemplars)
         return "\n".join(lines) + "\n"
 
     def sample_value(
@@ -422,6 +485,8 @@ class ParsedSample:
     name: str
     labels: Dict[str, str]
     value: float
+    # OpenMetrics exemplar (labels, value) when the sample carried one
+    exemplar: Optional[Tuple[Dict[str, str], float]] = None
 
 
 @dataclass
@@ -465,6 +530,47 @@ def _parse_label_block(block: str, line: str) -> Dict[str, str]:
         i += 1  # closing quote
         labels[name] = unescape_label_value("".join(raw))
     return labels
+
+
+def _find_block_end(text: str, start: int) -> int:
+    """Index of the ``}`` closing the label block opened at
+    ``text[start] == '{'``, honoring quoted values and escapes — an
+    exemplar tail may carry its own brace pair, so a blind rpartition
+    would split the wrong block."""
+    i = start + 1
+    in_quote = False
+    n = len(text)
+    while i < n:
+        c = text[i]
+        if in_quote:
+            if c == "\\":
+                i += 2
+                continue
+            if c == '"':
+                in_quote = False
+        elif c == '"':
+            in_quote = True
+        elif c == "}":
+            return i
+        i += 1
+    raise ValueError(f"unclosed label block: {text}")
+
+
+def _parse_exemplar(part: str, line: str) -> Tuple[Dict[str, str], float]:
+    """``{label="v"} value [timestamp]`` -> (labels, value)."""
+    part = part.strip()
+    if not part.startswith("{"):
+        raise ValueError(f"malformed exemplar in: {line}")
+    end = _find_block_end(part, 0)
+    labels = _parse_label_block(part[1:end], line)
+    tokens = part[end + 1 :].split()
+    if not tokens:
+        raise ValueError(f"exemplar missing value in: {line}")
+    try:
+        value = float(tokens[0])
+    except ValueError:
+        raise ValueError(f"malformed exemplar value: {line}") from None
+    return labels, value
 
 
 _HISTOGRAM_SUFFIXES = ("_bucket", "_sum", "_count")
@@ -513,17 +619,22 @@ def parse_exposition(text: str) -> Dict[str, ParsedFamily]:
                 )
                 family.kind = parts[3]
             continue
-        if "{" in line:
-            name, _, rest = line.partition("{")
-            block, closing, tail = rest.rpartition("}")
-            if not closing:
-                raise ValueError(f"unclosed label block: {line}")
-            labels = _parse_label_block(block, line)
-            value_part = tail.strip()
+        brace = line.find("{")
+        space = line.find(" ")
+        if brace >= 0 and (space < 0 or brace < space):
+            name = line[:brace]
+            end = _find_block_end(line, brace)
+            labels = _parse_label_block(line[brace + 1 : end], line)
+            value_part = line[end + 1 :].strip()
         else:
             name, _, value_part = line.partition(" ")
             labels = {}
         name = name.strip()
+        # OpenMetrics exemplar tail: `value [ts] # {labels} value [ts]`
+        value_part, exemplar_sep, exemplar_part = value_part.partition("#")
+        exemplar = (
+            _parse_exemplar(exemplar_part, line) if exemplar_sep else None
+        )
         tokens = value_part.split()
         if not name or not tokens:
             raise ValueError(f"malformed sample line: {line}")
@@ -532,7 +643,9 @@ def parse_exposition(text: str) -> Dict[str, ParsedFamily]:
         except ValueError:
             raise ValueError(f"malformed sample value: {line}") from None
         _family_for(name, families).samples.append(
-            ParsedSample(name=name, labels=labels, value=value)
+            ParsedSample(
+                name=name, labels=labels, value=value, exemplar=exemplar
+            )
         )
     return families
 

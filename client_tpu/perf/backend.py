@@ -61,6 +61,12 @@ class PerfBackend:
     async def close(self) -> None:
         pass
 
+    def endpoint_snapshot(self) -> Optional[Dict]:
+        """Per-endpoint pool telemetry (outstanding/EWMA/errors per
+        endpoint), for backends whose client routes through an
+        :class:`~client_tpu.lifecycle.EndpointPool`; None otherwise."""
+        return None
+
     async def get_model_metadata(self, model_name: str, model_version: str = "") -> Dict:
         raise NotImplementedError
 
@@ -228,6 +234,9 @@ class HttpPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
     async def close(self) -> None:
         await self._client.close()
 
+    def endpoint_snapshot(self) -> Optional[Dict]:
+        return self._client.endpoint_snapshot()
+
     async def get_model_metadata(self, model_name, model_version=""):
         return await self._client.get_model_metadata(model_name, model_version)
 
@@ -340,6 +349,9 @@ class GrpcPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
 
     async def close(self) -> None:
         await self._client.close()
+
+    def endpoint_snapshot(self) -> Optional[Dict]:
+        return self._client.endpoint_snapshot()
 
     async def get_model_metadata(self, model_name, model_version=""):
         return await self._client.get_model_metadata(
@@ -528,7 +540,7 @@ class LocalPerfBackend(PerfBackend):
         self._core.unload_model(model_name)
 
     async def load_model(self, model_name):
-        self._core.repository.load(model_name)
+        self._core.load_model(model_name)
 
     async def infer(
         self,

@@ -263,8 +263,13 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--metrics-url",
         default=None,
-        help="metrics endpoint (host:port[/metrics]); default: the -u "
-        "host/port for HTTP runs, port 8000 on the -u host otherwise",
+        help="metrics endpoint (host:port[/metrics]); implies "
+        "--collect-metrics. Default: the -u "
+        "host/port for HTTP runs, port 8000 on the -u host otherwise. "
+        "A comma list (host1:p1,host2:p2,...) scrapes every replica and "
+        "adds a 'Fleet' report section (per-replica duty/p99/error "
+        "split + rolling-p99 skew detection); profiling/debug endpoints "
+        "keep targeting the FIRST entry",
     )
     parser.add_argument(
         "--profile-server",
@@ -370,14 +375,24 @@ def _server_http_url(args) -> str:
     """The server's HTTP base for metrics + debug endpoints:
     ``--metrics-url`` when given, else the -u primary endpoint for HTTP
     kserve runs, else the conventional HTTP port on the -u host. A comma
-    list (-u EndpointPool form) resolves to the FIRST endpoint."""
+    list (-u EndpointPool or --metrics-url fleet form) resolves to the
+    FIRST endpoint."""
     if args.metrics_url:
-        return args.metrics_url
+        return args.metrics_url.split(",")[0].strip()
     primary_url = args.url.split(",")[0].strip()
     if args.protocol == "http" and args.service_kind == "kserve":
         return primary_url
     host = primary_url.rsplit(":", 1)[0] or "localhost"
     return f"{host}:8000"
+
+
+def _metrics_urls(args) -> List[str]:
+    """Every metrics endpoint to scrape: the --metrics-url comma list
+    (one collector per replica — the fleet view), else the single
+    default endpoint."""
+    if args.metrics_url:
+        return [u.strip() for u in args.metrics_url.split(",") if u.strip()]
+    return [_server_http_url(args)]
 
 
 async def run(args) -> int:
@@ -418,6 +433,10 @@ async def run(args) -> int:
         # arrives via the /metrics scrape — imply both collection modes
         args.stage_breakdown = True
         args.collect_metrics = True
+    if args.metrics_url and not args.collect_metrics:
+        # naming replicas to scrape IS asking for the scrape — without
+        # this a --metrics-url list silently produced no Fleet section
+        args.collect_metrics = True
     want_tracing = args.stage_breakdown or args.trace_export_file
     if want_tracing and args.service_kind != "kserve":
         print(
@@ -443,6 +462,7 @@ async def run(args) -> int:
     trace_exporter = None
     tracer = None
     collector = None
+    fleet = None
     restart_driver = None
     prev_profiling = None
     profiling_clock_mode = ""
@@ -517,17 +537,34 @@ async def run(args) -> int:
             # Scrape the server's Prometheus endpoint alongside the run
             # (reference --collect-metrics / MetricsManager). The metrics
             # live on the HTTP front-end; for gRPC runs default to the
-            # conventional HTTP port on the same host.
-            from client_tpu.perf.metrics_collector import MetricsCollector
-
-            collector = MetricsCollector(
-                _server_http_url(args),
-                interval_s=args.metrics_interval,
-                model_name=args.model_name,
+            # conventional HTTP port on the same host. A --metrics-url
+            # comma list scrapes every replica (one collector each) and
+            # adds the Fleet section; the first replica stays the
+            # "collector" every single-server consumer reads.
+            from client_tpu.perf.metrics_collector import (
+                FleetCollector,
+                MetricsCollector,
             )
-            await collector.start()
+
+            urls = _metrics_urls(args)
+            if len(urls) > 1:
+                fleet = FleetCollector(
+                    urls,
+                    interval_s=args.metrics_interval,
+                    model_name=args.model_name,
+                )
+                await fleet.start()
+                collector = fleet.primary
+            else:
+                collector = MetricsCollector(
+                    urls[0],
+                    interval_s=args.metrics_interval,
+                    model_name=args.model_name,
+                )
+                await collector.start()
             if args.verbose:
-                print(f"collecting server metrics from {collector.url}")
+                scraping = ", ".join(urls) if len(urls) > 1 else collector.url
+                print(f"collecting server metrics from {scraping}")
         if args.profile_server:
             # Flip the server's stage-CPU accounting on for this run
             # (restored in the finally); the previous config also tells
@@ -827,13 +864,23 @@ async def run(args) -> int:
         print(console_report(experiments))
 
         server_summary = None
+        fleet_summary = None
         if collector is not None:
-            await collector.stop()
+            if fleet is not None:
+                await fleet.stop()
+            else:
+                await collector.stop()
             server_summary = collector.summary()
             print()
             print(format_server_metrics(server_summary))
             if collector.scrape_errors and collector.last_error:
                 print(f"  last scrape error: {collector.last_error}")
+        if fleet is not None:
+            from client_tpu.perf.report import format_fleet
+
+            fleet_summary = fleet.fleet_summary()
+            print()
+            print(format_fleet(fleet_summary))
         if args.profile_server and server_summary is not None:
             from client_tpu.perf.report import format_wire_gap
 
@@ -894,11 +941,24 @@ async def run(args) -> int:
                     for exemplar in recorder_snapshot.get("slowest", []):
                         run_logger.info("slow_request", **exemplar)
 
-        if tracer is not None:
-            # the ClientMetrics snapshot every traced call feeds: error/
-            # retry counts + the client-side latency histogram
+        # "Client metrics" prints whenever client telemetry is live — a
+        # tracer (any tracing flag, not just --stage-breakdown: the PR 3
+        # leftover) or the endpoint pool's per-endpoint stats under
+        # --collect-metrics — and includes the pool snapshot either way.
+        try:
+            pool_snapshot = backend.endpoint_snapshot()
+        except Exception:  # noqa: BLE001 - telemetry must not fail the run
+            pool_snapshot = None
+        if tracer is not None or (
+            args.collect_metrics and pool_snapshot is not None
+        ):
             print()
-            print(format_client_metrics(tracer.metrics.snapshot()))
+            print(
+                format_client_metrics(
+                    tracer.metrics.snapshot() if tracer is not None else None,
+                    endpoints=pool_snapshot,
+                )
+            )
 
         if args.filename:
             write_csv(experiments, args.filename)
@@ -942,6 +1002,21 @@ async def run(args) -> int:
                     for p, entry in
                     best.status.per_priority_latency_us.items()
                 }
+            if fleet_summary is not None:
+                summary_doc["fleet"] = {
+                    "replicas": [
+                        {
+                            "url": r.url,
+                            "requests": r.requests,
+                            "failures": r.failures,
+                            "duty": round(r.duty, 4),
+                            "p99_us": round(r.p99_s * 1e6, 1),
+                            "p99_source": r.p99_source,
+                        }
+                        for r in fleet_summary.replicas
+                    ],
+                    "skew": fleet_summary.skew,
+                }
             if server_summary is not None:
                 summary_doc["server_duty_avg"] = server_summary.duty_avg
                 summary_doc["server_duty_max"] = server_summary.duty_max
@@ -973,7 +1048,9 @@ async def run(args) -> int:
             # no-op when already stopped above; on an aborted run this
             # also reloads the model so the server is left serving
             await restart_driver.stop()
-        if collector is not None:
+        if fleet is not None:
+            await fleet.stop()  # no-op when already stopped above
+        elif collector is not None:
             await collector.stop()  # no-op when already stopped above
         if shm_plane is not None:
             await shm_plane.cleanup()

@@ -24,6 +24,7 @@ import asyncio
 import time
 from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 
+from client_tpu.observability.fleet import bucket_delta
 from client_tpu.observability.metrics import (
     ParsedFamily,
     counter_total,
@@ -216,7 +217,7 @@ class MetricsCollector:
             return {
                 "count": b["count"] - a["count"],
                 "sum": b["sum"] - a["sum"],
-                "buckets": _bucket_delta(a["buckets"], b["buckets"]),
+                "buckets": bucket_delta(a["buckets"], b["buckets"]),
             }
 
         request = _delta("tpu_inference_request_duration")
@@ -266,6 +267,88 @@ class MetricsCollector:
                 if count > 0:
                     out.stage_cpu[stage] = {"count": count, "cpu_s": cpu_s}
         return out
+
+
+class FleetCollector:
+    """One :class:`MetricsCollector` per replica (``--metrics-url
+    a,b,c``): scrapes every replica on the shared interval and reduces
+    the first->last pairs to a :class:`~client_tpu.observability.fleet.
+    FleetSummary` — per-replica request/duty/p99 rows, summed totals,
+    and the slowest-vs-fastest rolling-p99 skew verdict.
+
+    ``collectors[0]`` is the *primary*: the CLI keeps feeding it to every
+    single-server consumer (the "Server metrics" section, the profiling
+    endpoints), so a fleet run degrades to exactly the old behavior for
+    replica #1 plus the fleet view on top.
+    """
+
+    def __init__(
+        self,
+        urls,
+        interval_s: float = 1.0,
+        model_name: str = "",
+        fetches: Optional[List[Callable[[], Awaitable[str]]]] = None,
+        clock_ns: Callable[[], int] = time.monotonic_ns,
+    ):
+        if isinstance(urls, str):
+            urls = [u.strip() for u in urls.split(",") if u.strip()]
+        urls = list(urls)
+        if not urls:
+            raise ValueError("FleetCollector needs at least one url")
+        if fetches is not None and len(fetches) != len(urls):
+            raise ValueError("fetches must match urls one-to-one")
+        self.model_name = model_name
+        self.collectors = [
+            MetricsCollector(
+                url,
+                interval_s=interval_s,
+                model_name=model_name,
+                fetch=fetches[i] if fetches is not None else None,
+                clock_ns=clock_ns,
+            )
+            for i, url in enumerate(urls)
+        ]
+
+    @property
+    def primary(self) -> MetricsCollector:
+        return self.collectors[0]
+
+    @property
+    def size(self) -> int:
+        return len(self.collectors)
+
+    async def start(self) -> None:
+        for collector in self.collectors:
+            await collector.start()
+
+    async def stop(self) -> None:
+        for collector in self.collectors:
+            await collector.stop()
+
+    def fleet_summary(self):
+        """Reduce every replica's scrape series to the fleet view
+        (:func:`client_tpu.observability.fleet.summarize_fleet`).
+        Replicas whose endpoint never answered contribute an empty row —
+        visible as zero requests, not silently dropped. Each replica's
+        duty/rate is computed over its OWN scrape span (an endpoint that
+        stopped answering mid-run covers less time than the fleet)."""
+        from client_tpu.observability.fleet import summarize_fleet
+
+        entries = []
+        window_s = 0.0
+        for collector in self.collectors:
+            replica_window = 0.0
+            if collector.snapshots:
+                first_ns, first = collector.snapshots[0]
+                last_ns, last = collector.snapshots[-1]
+                replica_window = (last_ns - first_ns) / 1e9
+                window_s = max(window_s, replica_window)
+            else:
+                first, last = {}, {}
+            entries.append((collector.url, first, last, replica_window))
+        return summarize_fleet(
+            entries, window_s=window_s, model=self.model_name
+        )
 
 
 # -- server profiling control (--profile-server / --flamegraph-out) ----------
@@ -363,17 +446,3 @@ async def fetch_debug_requests(
     except Exception:  # noqa: BLE001 - debug dump is best-effort
         return None
 
-
-def _bucket_delta(
-    before: List[Tuple[float, float]], after: List[Tuple[float, float]]
-) -> List[Tuple[float, float]]:
-    """Per-bucket (non-cumulative) observation deltas between two
-    cumulative bucket snapshots."""
-    base = dict(before)
-    out: List[Tuple[float, float]] = []
-    prev_cumulative = 0.0
-    for le, cumulative in after:
-        delta_cumulative = cumulative - base.get(le, 0.0)
-        out.append((le, delta_cumulative - prev_cumulative))
-        prev_cumulative = delta_cumulative
-    return out

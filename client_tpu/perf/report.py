@@ -278,29 +278,103 @@ def format_wire_gap(
     return "\n".join(lines)
 
 
-def format_client_metrics(snapshot: Dict[str, Any]) -> str:
+def format_client_metrics(
+    snapshot: Optional[Dict[str, Any]],
+    endpoints: Optional[Dict[str, Any]] = None,
+) -> str:
     """The "Client metrics" block: the tracer's ClientMetrics snapshot —
     error/retry counts and the client-side latency histogram the
-    observability layer records on every traced call."""
+    observability layer records on every traced call — plus, when the
+    backend exposes one, the per-endpoint pool telemetry (outstanding
+    requests, EWMA latency, error/reroute counters per endpoint; the
+    inputs the scale-out routing policies consume). Either argument may
+    be None; the section prints whatever is live."""
+    lines = ["Client metrics:"]
+    if snapshot is not None:
+        lines.append(
+            f"  Requests: {snapshot['request_count']} "
+            f"(errors {snapshot['error_count']}, retries "
+            f"{snapshot['retry_count']}), avg latency "
+            f"{snapshot['avg_latency_us']:.0f} usec"
+        )
+        # de-cumulate the histogram and print the populated buckets
+        parts = []
+        prev = 0
+        for entry in snapshot.get("latency_histogram_us", []):
+            count = entry["count"] - prev
+            prev = entry["count"]
+            if count > 0:
+                bound = entry["le_us"]
+                label = f"<={bound}us" if bound != "inf" else ">last"
+                parts.append(f"{label}: {count}")
+        if parts:
+            lines.append(f"  Latency histogram: {', '.join(parts)}")
+    if endpoints is not None and endpoints.get("endpoints"):
+        rows = endpoints["endpoints"]
+        noun = "endpoint" if len(rows) == 1 else "endpoints"
+        lines.append(
+            f"  Endpoint pool ({len(rows)} {noun}, primary "
+            f"{endpoints.get('primary', '?')}, "
+            f"{endpoints.get('failovers', 0)} failovers):"
+        )
+        lines.append(
+            f"    {'url':<28} {'outst':>5} {'ewma_us':>10} {'ok':>8} "
+            f"{'err':>5} {'down':>5} {'reroutes':>8}"
+        )
+        for row in rows:
+            state = "DOWN" if row.get("down") else "up"
+            lines.append(
+                f"    {row['url']:<28} {row['outstanding']:>5} "
+                f"{row['ewma_latency_us']:>10.1f} {row['successes']:>8} "
+                f"{row['errors']:>5} {state:>5} {row['reroutes']:>8}"
+            )
+    if len(lines) == 1:
+        lines.append("  (no client telemetry recorded)")
+    return "\n".join(lines)
+
+
+def format_fleet(summary) -> str:
+    """The "Fleet" section (``--metrics-url a,b,c``): per-replica
+    duty/p99/error split over the run window plus the skew verdict —
+    the "which of my N replicas is slow" answer, computed from each
+    replica's own ``/metrics`` (rolling p99 preferred, cumulative
+    histogram delta as fallback)."""
     lines = [
-        "Client metrics:",
-        f"  Requests: {snapshot['request_count']} "
-        f"(errors {snapshot['error_count']}, retries "
-        f"{snapshot['retry_count']}), avg latency "
-        f"{snapshot['avg_latency_us']:.0f} usec",
+        f"Fleet ({len(summary.replicas)} replicas): "
+        f"{summary.total_requests} requests "
+        f"({summary.total_failures} failures) over "
+        f"{summary.window_s:.1f} s",
     ]
-    # de-cumulate the histogram and print the populated buckets
-    parts = []
-    prev = 0
-    for entry in snapshot.get("latency_histogram_us", []):
-        count = entry["count"] - prev
-        prev = entry["count"]
-        if count > 0:
-            bound = entry["le_us"]
-            label = f"<={bound}us" if bound != "inf" else ">last"
-            parts.append(f"{label}: {count}")
-    if parts:
-        lines.append(f"  Latency histogram: {', '.join(parts)}")
+    lines.append(
+        f"  {'replica':<28} {'req':>8} {'req/s':>8} {'duty':>6} "
+        f"{'avg_us':>10} {'p99_us':>10} {'fail':>6}  p99 source"
+    )
+    for replica in summary.replicas:
+        # the replica's own scrape span (a mid-run-dead endpoint covers
+        # less time than the fleet), falling back to the fleet window
+        span = replica.window_s or summary.window_s
+        rate = replica.requests / span if span else 0.0
+        lines.append(
+            f"  {replica.url:<28} {replica.requests:>8} {rate:>8.1f} "
+            f"{replica.duty:>6.2f} {replica.avg_request_us:>10.1f} "
+            f"{replica.p99_s * 1e6:>10.1f} {replica.failures:>6}  "
+            f"{replica.p99_source or '-'}"
+        )
+    if summary.skew is not None:
+        skew = summary.skew
+        verdict = "SKEW FLAGGED" if skew["flagged"] else "within tolerance"
+        source = skew.get("source")
+        via = f", {source} p99" if source else ""
+        lines.append(
+            f"  Skew: slowest {skew['slowest']} p99 "
+            f"{skew['slowest_p99_us']:.1f} us vs fastest {skew['fastest']} "
+            f"p99 {skew['fastest_p99_us']:.1f} us — ratio "
+            f"{skew['ratio']:.2f}x ({verdict}{via})"
+        )
+    else:
+        lines.append(
+            "  Skew: not enough replicas reporting a comparable p99"
+        )
     return "\n".join(lines)
 
 

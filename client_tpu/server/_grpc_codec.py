@@ -226,11 +226,10 @@ def _repository_index(core: ServerCore, request):
 def _repository_model_load(core: ServerCore, request):
     params = params_to_dict(request.parameters)
     config = params.get("config")
-    core.repository.load(
+    core.load_model(
         request.model_name,
         config_override=config if isinstance(config, str) else None,
     )
-    core.logger.info("model_loaded", model=request.model_name)
     return pb.RepositoryModelLoadResponse()
 
 

@@ -58,6 +58,10 @@ SERVER_EXTENSIONS = [
     "statistics",
     "trace",
     "logging",
+    # rolling-window quantiles + SLO burn rates (GET /v2/debug/slo, the
+    # tpu_rolling_latency_seconds / tpu_slo_* gauge families); advertised
+    # by both front-ends' server-metadata responses
+    "live_telemetry",
 ]
 
 
@@ -95,6 +99,15 @@ class CoreRequest:
     # in monotonic ns (None = no deadline)
     priority_level: int = 0
     deadline_ns: Optional[int] = None
+
+
+def _trace_id_of(request) -> str:
+    """The request's trace id ("" when untraced) — rides the success
+    booking into the metrics layer as the duration histogram's
+    OpenMetrics exemplar, linking a ``/metrics`` bucket to the same
+    request's ``/v2/debug/requests`` evidence."""
+    trace = request.trace
+    return trace.trace_id if trace is not None else ""
 
 
 def _trace_stages(
@@ -156,12 +169,15 @@ class _Stats:
             self._metrics.observe_failure(self._model)
 
     def record_success(
-        self, batch: int, queue_ns, in_ns, infer_ns, out_ns, executions: int = 1
+        self, batch: int, queue_ns, in_ns, infer_ns, out_ns,
+        executions: int = 1, trace_id: str = "",
     ):
         """Account one successful request. ``executions`` is 0 for requests
         that shared a dynamically-batched model execution with an earlier
         request in the same batch (Triton semantics: inference_count counts
-        requests/rows, execution_count counts device executions)."""
+        requests/rows, execution_count counts device executions).
+        ``trace_id`` (traced requests only) rides to the metrics hook as
+        the duration histogram's OpenMetrics exemplar."""
         now_ms = int(time.time() * 1000)
         total = queue_ns + in_ns + infer_ns + out_ns
         with self.lock:
@@ -179,7 +195,8 @@ class _Stats:
                 self.ns[f] += ns
         if self._metrics is not None:
             self._metrics.observe_success(
-                self._model, queue_ns, in_ns + infer_ns + out_ns, total
+                self._model, queue_ns, in_ns + infer_ns + out_ns, total,
+                trace_id=trace_id,
             )
 
     def record_success_batch(
@@ -728,6 +745,7 @@ class _ModelBatcher:
                     infer_ns=infer_end - exec_start,
                     out_ns=out_end - infer_end,
                     executions=execution_pending,
+                    trace_id=_trace_id_of(request),
                 )
                 _trace_stages(
                     request.trace, arrival, exec_start, infer_end, out_end
@@ -948,6 +966,17 @@ class ServerCore:
                 failed += 1
         return failed
 
+    def load_model(
+        self, name: str, config_override: Optional[str] = None
+    ) -> None:
+        """Repository load plus the telemetry bookkeeping every load
+        path needs: the model's live-telemetry state is reset so the
+        next record re-resolves the freshly-loaded slo declaration.
+        Front-ends and the in-process backend all load through here."""
+        self.repository.load(name, config_override=config_override)
+        self.metrics.telemetry.reset(name)
+        self.logger.info("model_loaded", model=name)
+
     def unload_model(self, name: str, drain_timeout_s: float = 5.0):
         """Repository unload with real per-model lifecycle: the model
         stops admitting immediately (503/UNAVAILABLE), queued and
@@ -960,6 +989,10 @@ class ServerCore:
         """
         old_model = self.repository.peek(name)
         epoch = self.repository.unload(name)
+        # drop the model's live-telemetry state: the rolling windows
+        # describe the outgoing instance, and a later load must
+        # re-resolve the repository's (possibly changed) slo declaration
+        self.metrics.telemetry.reset(name)
         self.logger.info(
             "model_unloading",
             model=name,
@@ -972,6 +1005,10 @@ class ServerCore:
         if loop is None:
             self._evict_batcher(name, old_model)
             self.repository.finish_unload(name, epoch)
+            # in-flight completions between the reset above and here
+            # re-create telemetry state for the dead model; this final
+            # reset is the one collect() prunes gauges against
+            self.metrics.telemetry.reset(name)
             return None
         return loop.create_task(
             self._finalize_unload(name, old_model, epoch, drain_timeout_s)
@@ -994,6 +1031,12 @@ class ServerCore:
             self.fail_pending(name)
         self._evict_batcher(name, old_model)
         self.repository.finish_unload(name, epoch)
+        # requests that completed during the drain re-created telemetry
+        # state for the outgoing model (observe_success -> record); this
+        # final reset — epoch-guarded above, so a superseding load's
+        # traffic is never dropped — leaves nothing for collect() to
+        # keep exporting
+        self.metrics.telemetry.reset(name)
         self.logger.info("model_unloaded", model=name, drained=drained)
 
     def _evict_batcher(self, name: str, model=None) -> None:
@@ -1266,7 +1309,17 @@ class ServerCore:
             },
             "profiling": self.profiling.config(),
             "flight_recorder": self.flight_recorder.stats(),
+            # compact live-telemetry block: shortest-window rolling p99 +
+            # SLO burn per model (the full document is GET /v2/debug/slo)
+            "slo": self.metrics.telemetry.summary(),
         }
+
+    def debug_slo(self) -> Dict[str, Any]:
+        """The ``GET /v2/debug/slo`` document: every tracked model's
+        rolling latency windows (30s/5m p50/p95/p99 over the same bucket
+        grid as ``/metrics``) plus error-budget status for models that
+        declare an ``slo`` config."""
+        return self.metrics.telemetry.snapshot()
 
     # -- inference -----------------------------------------------------------
 
@@ -1825,6 +1878,7 @@ class ServerCore:
             in_ns=0,
             infer_ns=t1 - t0,
             out_ns=t2 - t1,
+            trace_id=_trace_id_of(request),
         )
         _trace_stages(request.trace, t0, t0, t1, t2)
         self._record_exemplar(
@@ -1928,6 +1982,7 @@ class ServerCore:
             in_ns=0,
             infer_ns=t2 - t1,
             out_ns=t3 - t2,
+            trace_id=_trace_id_of(request),
         )
         if self.profiling.take():
             self.profiling.account("queue_wait", 0, wall_ns=t1 - t0)
@@ -1992,6 +2047,7 @@ class ServerCore:
                 in_ns=0,
                 infer_ns=(t1 - t0) - packaging_ns,
                 out_ns=packaging_ns,
+                trace_id=_trace_id_of(request),
             )
             _trace_stages(request.trace, t0, t0, t1, t1)
             self._record_exemplar(

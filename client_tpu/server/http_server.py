@@ -215,6 +215,7 @@ class HttpServer:
         # surface: "what are your slowest/failed requests right now?").
         g("/v2/debug/requests", guard(self.handle_debug_requests))
         g("/v2/debug/state", guard(self.handle_debug_state))
+        g("/v2/debug/slo", guard(self.handle_debug_slo))
         g("/metrics", guard(self.handle_metrics))
         # Hot-path profiling (observability.profiling): stage-CPU
         # accounting toggle + the on-demand wall-stack sampler.
@@ -288,11 +289,8 @@ class HttpServer:
             payload = json.loads(body)
             params = payload.get("parameters", {})
             config_override = params.get("config")
-        self.core.repository.load(
+        self.core.load_model(
             request.match_info["model"], config_override=config_override
-        )
-        self.core.logger.info(
-            "model_loaded", model=request.match_info["model"]
         )
         return web.Response(status=200)
 
@@ -330,12 +328,21 @@ class HttpServer:
         concurrent scrapers never corrupt each other's deltas. Render
         CPU books under the "rpc" profiling stage (like the gRPC faces'
         non-inference methods): with --profile-server the harness's own
-        scrape cost shows in the attribution instead of hiding."""
+        scrape cost shows in the attribution instead of hiding.
+
+        ``?exemplars=true`` appends OpenMetrics exemplars (trace id +
+        latency) to duration-histogram bucket samples, linking a bucket
+        to its ``/v2/debug/requests`` evidence; the default output is
+        byte-identical to before the flag existed."""
         from client_tpu.observability.profiling import stage_scope
 
+        exemplars = request.query.get("exemplars", "").lower() in (
+            "1", "true", "yes",
+        )
         with stage_scope(self.core.profiling, "rpc"):
             return web.Response(
-                text=self.core.metrics.render(), content_type="text/plain"
+                text=self.core.metrics.render(exemplars=exemplars),
+                content_type="text/plain",
             )
 
     # -- shared memory -------------------------------------------------------
@@ -467,6 +474,13 @@ class HttpServer:
 
     async def handle_debug_state(self, request):
         return web.json_response(self.core.debug_state())
+
+    async def handle_debug_slo(self, request):
+        """Live telemetry document: rolling 30s/5m latency quantiles per
+        model plus SLO error-budget burn for models declaring one —
+        the "what is p99 RIGHT NOW" answer the cumulative statistics
+        extension cannot give."""
+        return web.json_response(self.core.debug_slo())
 
     # -- profiling -----------------------------------------------------------
 

@@ -51,6 +51,7 @@ from client_tpu.observability.metrics import (
     Histogram,
     MetricsRegistry,
 )
+from client_tpu.observability.slo import LiveTelemetry, SloObjective
 
 try:  # jax powers the optional device-memory gauges
     import jax
@@ -245,6 +246,42 @@ class ServerMetrics:
             model,
             registry=registry,
         )
+        # Live telemetry (observability.slo): rolling-window latency
+        # sketches + SLO error-budget tracking, fed from the SAME
+        # observe_success/observe_failure events as the histograms above,
+        # so the live signals and the cumulative ones can never disagree
+        # about what happened — only about when.
+        self.telemetry = LiveTelemetry(
+            buckets=DURATION_BUCKETS_S,
+            clock_ns=clock_ns,
+            objective_resolver=self._resolve_objective,
+        )
+        self.rolling_latency = Gauge(
+            "tpu_rolling_latency_seconds",
+            "Rolling-window latency quantile per model (sliding sub-window "
+            "sketch over the duration bucket grid; window=30s/5m, "
+            "quantile=0.5/0.95/0.99). Reflects the window, not the "
+            "server's lifetime.",
+            ("model", "window", "quantile"),
+            registry=registry,
+        )
+        self.slo_burn_rate = Gauge(
+            "tpu_slo_latency_burn_rate",
+            "Error-budget burn rate over the model's SLO window: the "
+            "fraction of requests violating the SLO (failed or over the "
+            "latency target) divided by the allowed fraction "
+            "(1 - availability). 1.0 = burning exactly the budget; only "
+            "models declaring an slo config report.",
+            model,
+            registry=registry,
+        )
+        self.slo_budget_remaining = Gauge(
+            "tpu_slo_error_budget_remaining",
+            "Fraction of the model's rolling-window error budget still "
+            "unspent (1.0 = no violations, 0.0 = budget exhausted).",
+            model,
+            registry=registry,
+        )
         self._duty_lock = threading.Lock()
         # First scrape reports utilization since server start — not 0.0
         # (the pre-registry handler's first-scrape blind spot).
@@ -253,19 +290,54 @@ class ServerMetrics:
 
     # -- hot-path hooks (called by ServerCore's execution paths) ------------
 
+    def _resolve_objective(self, model_name: str):
+        """The model's declared SLO (repository config ``slo`` attr);
+        None when it declares none or is unknown. A malformed declaration
+        resolves to None but emits a rate-limited warning — a typo'd SLO
+        silently tracking nothing would look exactly like a healthy
+        model with no objective."""
+        try:
+            model = self.core.repository.peek(model_name)
+        except Exception:  # noqa: BLE001 - telemetry must not fail requests
+            return None
+        if model is None:
+            return None
+        try:
+            return SloObjective.from_model(model)
+        except ValueError as e:
+            logger = getattr(self.core, "logger", None)
+            if logger is not None:
+                logger.warning(
+                    "slo_declaration_invalid",
+                    model=model_name,
+                    error=str(e),
+                    rate_key=("slo_declaration_invalid", model_name),
+                )
+            return None
+
     def observe_success(
         self, model: str, queue_ns: int, compute_ns: int, total_ns: int,
-        count: int = 1,
+        count: int = 1, trace_id: str = "",
     ) -> None:
         """Book ``count`` successful requests (per-request durations; the
-        merged direct path passes its chunk average with count=n)."""
+        merged direct path passes its chunk average with count=n).
+        ``trace_id`` (when the request was traced) becomes the duration
+        histogram's OpenMetrics exemplar, linking ``/metrics`` buckets to
+        ``/v2/debug/requests`` evidence."""
+        total_s = total_ns / 1e9
         self.request_success.labels(model).inc(count)
-        self.request_duration.labels(model).observe(total_ns / 1e9, count)
+        self.request_duration.labels(model).observe(
+            total_s,
+            count,
+            exemplar=({"trace_id": trace_id}, total_s) if trace_id else None,
+        )
         self.queue_duration.labels(model).observe(queue_ns / 1e9, count)
         self.compute_duration.labels(model).observe(compute_ns / 1e9, count)
+        self.telemetry.record(model, total_s, ok=True, count=count)
 
     def observe_failure(self, model: str, count: int = 1) -> None:
         self.request_failure.labels(model).inc(count)
+        self.telemetry.record(model, 0.0, ok=False, count=count)
 
     def observe_execution(self, model: str, rows: int) -> None:
         """Book one device execution of ``rows`` merged rows."""
@@ -309,9 +381,12 @@ class ServerMetrics:
 
     # -- scrape -------------------------------------------------------------
 
-    def render(self) -> str:
-        """The exposition document (runs the collect hook below)."""
-        return self.registry.render()
+    def render(self, exemplars: bool = False) -> str:
+        """The exposition document (runs the collect hook below).
+        ``exemplars=True`` appends OpenMetrics exemplars (trace id +
+        latency) to duration-histogram bucket samples that carry one;
+        the default text format is unchanged."""
+        return self.registry.render(exemplars=exemplars)
 
     def _collect(self) -> None:
         """Scrape-time refresh: exactly ONE statistics snapshot feeds the
@@ -343,6 +418,13 @@ class ServerMetrics:
             duty = min(1.0, max(0, busy_ns - prev_busy) / (now_ns - prev_ns))
         self.duty_cycle.set(duty)
         self.device_compute_ns.labels().set(busy_ns)
+        # rolling quantiles + SLO burn gauges reflect the window at
+        # scrape time, not the hot path (one O(buckets) merge per model)
+        self.telemetry.collect(
+            self.rolling_latency,
+            self.slo_burn_rate,
+            self.slo_budget_remaining,
+        )
         self._collect_memory()
 
     def _collect_memory(self) -> None:

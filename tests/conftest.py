@@ -82,6 +82,19 @@ def pytest_configure(config):
             "client_tpu.observability.logging.StructuredLogger):\n"
             + "\n".join(problems)
         )
+    # Metric-naming lint: /metrics families follow the Prometheus
+    # conventions (tpu_ prefix, _total counters, _seconds/_bytes/_ratio
+    # units) — a non-compliant name is a wire-compatibility liability
+    # the moment a dashboard keys on it.
+    from tools.metric_lint import run_metric_lint
+
+    problems = run_metric_lint()
+    if problems:
+        raise pytest.UsageError(
+            "metric lint failed (tpu_ prefix + unit-suffix conventions "
+            "on every family in client_tpu/server/metrics.py; see "
+            "tools/metric_lint.py):\n" + "\n".join(problems)
+        )
 
 
 def pytest_collection_modifyitems(config, items):

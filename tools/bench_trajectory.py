@@ -1,0 +1,202 @@
+"""Bench-trajectory reducer: BENCH_r*.json into one table + a guard.
+
+Every merged PR leaves a ``BENCH_rNN.json`` behind (bench.py's JSON line
+under the ``parsed`` key), but nothing rendered the sequence — the
+throughput story lived in scattered PERF.md prose. This tool reduces the
+run files into one trajectory table (headline infer/sec, p50, the
+wire-vs-in-process ratio, server CPU per request, and the dominant
+server stage once the PR-6 attribution fields appear), prints it, and
+refreshes the marked section of ``PERF.md`` in place:
+
+    python tools/bench_trajectory.py            # print + refresh PERF.md
+    python tools/bench_trajectory.py --no-write # print only (CI check)
+
+Exit status doubles as a regression guard: nonzero when the NEWEST
+run's headline throughput is more than ``--threshold`` (default 10%)
+below the best prior run — the "did this PR quietly lose the perf the
+arc already won" tripwire. Runs whose bench recorded an error (rc != 0
+or no parsed payload) are listed but excluded from the guard.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+BEGIN_MARK = "<!-- bench-trajectory:begin (tools/bench_trajectory.py) -->"
+END_MARK = "<!-- bench-trajectory:end -->"
+
+DEFAULT_THRESHOLD = 0.10
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_runs(root: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Every BENCH_r*.json in run order: ``{run, path, parsed}`` rows
+    (``parsed`` is None for a run whose bench failed or predates the
+    JSON line)."""
+    root = root or _repo_root()
+    runs = []
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        match = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+        if not match:
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = {}
+        parsed = doc.get("parsed")
+        if not isinstance(parsed, dict) or doc.get("rc", 0) != 0:
+            parsed = None
+        runs.append(
+            {"run": int(match.group(1)), "path": path, "parsed": parsed}
+        )
+    runs.sort(key=lambda r: r["run"])
+    return runs
+
+
+def _dominant_stage(parsed: Dict[str, Any]) -> str:
+    """The costliest server stage from the PR-6 attribution fields
+    (``server_stage_cpu_us`` dict of stage -> us/req), '-' before r06."""
+    stages = parsed.get("server_stage_cpu_us")
+    if not isinstance(stages, dict) or not stages:
+        return "-"
+    stage, cost = max(stages.items(), key=lambda kv: kv[1])
+    return f"{stage} ({cost:.1f}us)"
+
+
+def format_table(runs: List[Dict[str, Any]]) -> str:
+    """The trajectory as a GitHub-flavored markdown table (also what
+    stdout gets — it is readable as fixed columns)."""
+    lines = [
+        "| run | infer/sec | p50 (us) | ratio_vs_inproc | server CPU "
+        "(us/req) | dominant stage | rolling p99 (us) |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for run in runs:
+        parsed = run["parsed"]
+        if parsed is None:
+            lines.append(f"| r{run['run']:02d} | (bench failed) | | | | | |")
+            continue
+
+        def _num(key: str, fmt: str = "{:.1f}") -> str:
+            value = parsed.get(key)
+            return fmt.format(value) if isinstance(value, (int, float)) else "-"
+
+        lines.append(
+            f"| r{run['run']:02d} "
+            f"| {_num('value', '{:.1f}')} "
+            f"| {_num('p50_us', '{:.1f}')} "
+            f"| {_num('ratio_vs_inproc', '{:.3f}')} "
+            f"| {_num('server_cpu_us_per_req', '{:.1f}')} "
+            f"| {_dominant_stage(parsed)} "
+            f"| {_num('rolling_30s_p99_us', '{:.1f}')} |"
+        )
+    return "\n".join(lines)
+
+
+def check_regression(
+    runs: List[Dict[str, Any]], threshold: float = DEFAULT_THRESHOLD
+) -> Optional[str]:
+    """An error string when the newest successful run's throughput sits
+    more than ``threshold`` below the best prior successful run; None
+    when the trajectory is healthy (or has fewer than two data points)."""
+    measured = [
+        (r["run"], r["parsed"]["value"])
+        for r in runs
+        if r["parsed"] is not None
+        and isinstance(r["parsed"].get("value"), (int, float))
+    ]
+    if len(measured) < 2:
+        return None
+    latest_run, latest = measured[-1]
+    best_run, best = max(measured[:-1], key=lambda kv: kv[1])
+    if latest < best * (1.0 - threshold):
+        return (
+            f"throughput regression: r{latest_run:02d} at {latest:.1f} "
+            f"infer/sec is {(1 - latest / best) * 100:.1f}% below the best "
+            f"prior run (r{best_run:02d} at {best:.1f}); the guard allows "
+            f"{threshold * 100:.0f}%"
+        )
+    return None
+
+
+def refresh_perf_md(table: str, perf_path: Optional[str] = None) -> bool:
+    """Replace the marked bench-trajectory block in PERF.md (appends a
+    new marked section when the markers are missing). Returns True when
+    the file changed."""
+    path = perf_path or os.path.join(_repo_root(), "PERF.md")
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        text = "# PERF\n"
+    block = f"{BEGIN_MARK}\n{table}\n{END_MARK}"
+    if BEGIN_MARK in text and END_MARK in text:
+        head, _, rest = text.partition(BEGIN_MARK)
+        _, _, tail = rest.partition(END_MARK)
+        updated = head + block + tail
+    else:
+        updated = (
+            text.rstrip("\n")
+            + "\n\n## Bench trajectory (generated)\n\n"
+            + block
+            + "\n"
+        )
+    if updated == text:
+        return False
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(updated)
+    return True
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="render the BENCH_r*.json trajectory and guard "
+        "against throughput regressions"
+    )
+    parser.add_argument(
+        "--root",
+        default=None,
+        help="repo root holding BENCH_r*.json (default: this checkout)",
+    )
+    parser.add_argument(
+        "--no-write",
+        action="store_true",
+        help="print only; leave PERF.md untouched",
+    )
+    parser.add_argument(
+        "--threshold",
+        type=float,
+        default=DEFAULT_THRESHOLD,
+        help="allowed fractional drop vs the best prior run "
+        "(default %(default)s)",
+    )
+    args = parser.parse_args(argv)
+
+    runs = load_runs(args.root)
+    if not runs:
+        print("no BENCH_r*.json files found — nothing to render")
+        return 0
+    table = format_table(runs)
+    print(table)
+    if not args.no_write:
+        perf_path = (
+            os.path.join(args.root, "PERF.md") if args.root else None
+        )
+        if refresh_perf_md(table, perf_path):
+            print("\nPERF.md bench-trajectory section refreshed")
+    problem = check_regression(runs, args.threshold)
+    if problem:
+        print(f"\nFAIL: {problem}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,163 @@
+"""Metric naming lint for the server's exposition families.
+
+Prometheus consumers key on naming conventions: counters end in
+``_total``, base units are spelled out (``_seconds``/``_bytes``), and
+dimensionless fractions end in ``_ratio``. A family that breaks the
+conventions ships a wire name dashboards and recording rules then depend
+on forever — renaming after the fact is a breaking change. This lint
+enforces the conventions on every family registered in
+``client_tpu/server/metrics.py`` (the ``/metrics`` surface, including
+the live-telemetry SLO/rolling-window gauges):
+
+- every family name matches ``tpu_[a-z0-9_]+`` (the repo's namespace);
+- ``Counter`` families end in ``_total``;
+- time-valued names must carry the base unit: ending in ``_duration``/
+  ``_latency``/``_time`` without ``_seconds`` is a finding, as is any
+  non-base-unit time suffix (``_ns``/``_us``/``_ms``, bare or before
+  ``_total``);
+- fraction-valued names (``_utilization``/``_cycle``/``_fraction``/
+  ``_percent`` endings) must end in ``_ratio`` instead.
+
+``GRANDFATHERED`` freezes the pre-lint wire names (Triton-parity and
+pre-registry mirrors that existing scrape configs depend on). The set is
+closed: adding a NEW non-compliant family fails the suite; renaming a
+grandfathered family to a compliant name shrinks the set.
+
+AST-based like ``tools/clock_lint.py``: family names are read from the
+first string-literal argument of ``Counter``/``Gauge``/``Histogram``
+constructor calls. Runs standalone (``python tools/metric_lint.py``) and
+at test session start via ``tests/conftest.py``.
+"""
+
+import ast
+import os
+import re
+from typing import List, Tuple
+
+TARGET_FILES = (os.path.join("client_tpu", "server", "metrics.py"),)
+
+FAMILY_CONSTRUCTORS = frozenset({"Counter", "Gauge", "Histogram"})
+
+NAME_PATTERN = re.compile(r"^tpu_[a-z0-9_]+$")
+
+# Pre-lint wire names, frozen for scrape-config compatibility (the
+# statistics-extension mirrors and round-1 dashboard names). Do not add
+# to this set — name new families to the conventions instead.
+GRANDFATHERED = frozenset(
+    {
+        "tpu_device_compute_ns_total",  # _ns: pre-lint busy-ns counter
+        "tpu_duty_cycle",  # fraction: predates the _ratio rule
+        "tpu_frontend_request_errors",  # counter without _total
+        "tpu_inference_compute_duration",  # seconds histogram sans unit
+        "tpu_inference_count",  # pre-registry statistics mirror
+        "tpu_inference_duration_ns",  # pre-registry statistics mirror
+        "tpu_inference_fail_count",  # pre-registry statistics mirror
+        "tpu_inference_queue_duration",  # seconds histogram sans unit
+        "tpu_inference_request_duration",  # seconds histogram sans unit
+        "tpu_inference_request_failure",  # counter without _total
+        "tpu_inference_request_success",  # counter without _total
+        "tpu_memory_utilization",  # fraction: predates the _ratio rule
+    }
+)
+
+# time-valued name endings that demand the base unit
+_UNITLESS_TIME_SUFFIXES = ("_duration", "_latency", "_time")
+# non-base time units (with or without a _total counter suffix)
+_NON_BASE_TIME = ("_ns", "_us", "_ms", "_ns_total", "_us_total", "_ms_total")
+# dimensionless-fraction endings that should be _ratio
+_FRACTION_SUFFIXES = ("_utilization", "_cycle", "_fraction", "_percent")
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def check_family(name: str, kind: str) -> List[str]:
+    """Convention findings for one (family name, constructor kind)."""
+    problems = []
+    if not NAME_PATTERN.match(name):
+        problems.append(
+            f"family '{name}' must match {NAME_PATTERN.pattern} "
+            "(tpu_ namespace, lowercase snake_case)"
+        )
+        return problems  # the suffix rules assume the shape held
+    if name in GRANDFATHERED:
+        return []
+    if kind == "Counter" and not name.endswith("_total"):
+        problems.append(
+            f"counter '{name}' must end in _total (Prometheus counter "
+            "convention)"
+        )
+    for suffix in _UNITLESS_TIME_SUFFIXES:
+        if name.endswith(suffix):
+            problems.append(
+                f"time-valued family '{name}' must carry the base unit "
+                f"(rename to {name}_seconds or {name}_seconds_total)"
+            )
+    for suffix in _NON_BASE_TIME:
+        if name.endswith(suffix):
+            problems.append(
+                f"family '{name}' uses a non-base time unit ('{suffix}') "
+                "— export seconds (_seconds) and let consumers scale"
+            )
+    for suffix in _FRACTION_SUFFIXES:
+        if name.endswith(suffix):
+            problems.append(
+                f"fraction-valued family '{name}' must end in _ratio "
+                f"instead of '{suffix}'"
+            )
+    return problems
+
+
+def check_source(source: str, filename: str) -> List[Tuple[int, str]]:
+    """Findings for one module: (lineno, message) per non-compliant
+    family constructor call."""
+    tree = ast.parse(source, filename=filename)
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            ctor = func.attr
+        elif isinstance(func, ast.Name):
+            ctor = func.id
+        else:
+            continue
+        if ctor not in FAMILY_CONSTRUCTORS or not node.args:
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
+            continue
+        for message in check_family(first.value, ctor):
+            findings.append((node.lineno, message))
+    return findings
+
+
+def run_metric_lint(repo_root: str = None) -> List[str]:
+    """Lint the target modules; returns 'path:line: message' strings."""
+    root = repo_root or _repo_root()
+    problems = []
+    for target in TARGET_FILES:
+        path = os.path.join(root, target)
+        if not os.path.exists(path):
+            continue
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        for lineno, message in check_source(source, path):
+            problems.append(f"{target}:{lineno}: {message}")
+    return problems
+
+
+def main() -> int:
+    problems = run_metric_lint()
+    for problem in problems:
+        print(problem)
+    if problems:
+        print(f"metric lint: {len(problems)} finding(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
