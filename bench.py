@@ -309,6 +309,59 @@ def _bench_stage_attribution(server, seconds: float = 3.0) -> dict:
     return {"server_stage_cpu_us": stages, "stage_cpu_clock": clock_mode}
 
 
+def _bench_llm_generate(server) -> dict:
+    """The LLM-serving north-star row (ROADMAP item 2 / BENCH_r09+):
+    genai-perf drives the continuous-batching ``llm_engine`` model over
+    streaming gRPC and reports aggregate tokens/sec + TTFT/ITL. The
+    engine batches every concurrent generation into one decode step per
+    token, so tokens/sec here tracks the continuous-batching win the same
+    way ``infer_per_sec`` tracks the wire path. Never raises; failures
+    degrade to {} so the headline is never lost."""
+    import tempfile
+
+    result: dict = {}
+    try:
+        from client_tpu.llm.serving import LlmEngineModel
+
+        repository = server.core.repository
+        try:
+            repository.get("llm_engine")
+        except Exception:  # noqa: BLE001 - not registered yet
+            repository.add_model(LlmEngineModel())
+        from client_tpu.genai_perf.main import main as genai_main
+        from client_tpu.genai_perf.metrics import LLMProfileDataParser
+        from client_tpu.genai_perf.main import json_summary_line
+
+        with tempfile.TemporaryDirectory(prefix="bench_llm_") as artifact_dir:
+            code = genai_main(
+                [
+                    "-m", "llm_engine",
+                    "-u", server.grpc_url,
+                    "--num-prompts", "16",
+                    "--synthetic-input-tokens-mean", "32",
+                    "--output-tokens-mean", "24",
+                    "--concurrency", "8",
+                    "--measurement-interval", "4000",
+                    "--stability-percentage", "70",
+                    "--max-trials", "3",
+                    "--artifact-dir", artifact_dir,
+                ]
+            )
+            if code != 0:
+                return {}
+            metrics = LLMProfileDataParser(
+                os.path.join(artifact_dir, "profile_export.json")
+            ).parse()
+        result = json_summary_line(metrics)
+        result["config"] = (
+            "llm_engine (tiny llama, continuous batching + paged KV), "
+            "streaming gRPC, concurrency 8"
+        )
+    except Exception as e:  # noqa: BLE001 - row is best-effort
+        print(f"bench: llm_generate row failed: {e}", file=sys.stderr)
+    return result
+
+
 def _bench_inprocess(server) -> float:
     """The `simple` tracker row's in-process twin."""
     import numpy as np
@@ -431,6 +484,12 @@ def main() -> int:
         # the headline above ran with accounting off).
         stage_attribution = _bench_stage_attribution(server)
 
+        # LLM-serving north-star: continuous-batching tokens/sec +
+        # TTFT/ITL through streaming gRPC (genai-perf end to end).
+        llm_generate = (
+            {} if os.environ.get("BENCH_NO_LLM") else _bench_llm_generate(server)
+        )
+
         # Live-telemetry spot check while the server still serves: the
         # rolling 30s window the SLO layer computed over the most recent
         # load — cross-checkable against the harness-side percentiles.
@@ -462,6 +521,8 @@ def main() -> int:
         line["tpu_shm_infer_per_sec"] = round(shm_throughput, 2)
     if northstar:
         line["northstar"] = northstar
+    if llm_generate:
+        line["llm_generate"] = llm_generate
     # CPU attribution of the client/server split for the headline run
     # (PERF.md explains how this bounds ratio_vs_inproc on few-core hosts).
     count = result.get("count", 0)

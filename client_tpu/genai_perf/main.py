@@ -213,8 +213,34 @@ def build_parser() -> argparse.ArgumentParser:
         "--generate-plots", action="store_true",
         help="write latency/throughput plots (matplotlib if available)",
     )
+    parser.add_argument(
+        "--json-summary", action="store_true",
+        help="print ONE machine-readable JSON line with the headline LLM "
+        "metrics (TTFT/ITL in ms, tokens/sec) — the bench.py/CI "
+        "counterpart of the perf harness's --json-summary",
+    )
     parser.add_argument("-v", "--verbose", action="store_true")
     return parser
+
+
+def json_summary_line(metrics) -> dict:
+    """The --json-summary document: headline LLM metrics in stable units
+    (times in ms; ns internals never leak into the machine output)."""
+    stats = metrics.statistics()
+    ttft = stats["time_to_first_token"]
+    itl = stats["inter_token_latency"]
+    return {
+        "ttft_avg_ms": round(ttft.avg / 1e6, 3),
+        "ttft_p99_ms": round(ttft.p99 / 1e6, 3),
+        "itl_avg_ms": round(itl.avg / 1e6, 3),
+        "itl_p99_ms": round(itl.p99 / 1e6, 3),
+        "tokens_per_sec": round(metrics.output_token_throughput, 2),
+        "requests_per_sec": round(metrics.request_throughput, 3),
+        "request_count": metrics.request_count,
+        "output_tokens_avg": round(
+            stats["num_output_tokens"].avg, 2
+        ),
+    }
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -339,6 +365,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     metrics = LLMProfileDataParser(export_path).parse()
     print()
     print(console_table(metrics))
+    if args.json_summary:
+        import json as _json
+
+        print(_json.dumps(json_summary_line(metrics)))
     from client_tpu.genai_perf.tokenizer import tokenizer_provenance
 
     export_csv(metrics, os.path.join(artifact_dir, "llm_metrics.csv"))

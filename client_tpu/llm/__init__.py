@@ -1,0 +1,28 @@
+"""LLM serving engine: continuous batching + paged KV cache + streaming.
+
+- :mod:`client_tpu.llm.kv_cache` — block-allocated paged KV accounting
+  (fixed-size token blocks, allocate-on-demand, capacity admission).
+- :mod:`client_tpu.llm.engine` — iteration-level scheduler: prefill/decode
+  split, per-step join/exit, preemption under cache pressure, token
+  streaming handles.
+- :mod:`client_tpu.llm.serving` — the ``llm_engine`` repository model
+  serving the engine through the decoupled gRPC and OpenAI SSE paths.
+
+Clock-injected throughout (tools/clock_lint.py covers this package).
+"""
+
+from client_tpu.llm.engine import EngineConfig, LlmEngine, Sequence
+from client_tpu.llm.kv_cache import (
+    TRASH_BLOCK,
+    BlockAllocator,
+    CacheCapacityError,
+)
+
+__all__ = [
+    "BlockAllocator",
+    "CacheCapacityError",
+    "EngineConfig",
+    "LlmEngine",
+    "Sequence",
+    "TRASH_BLOCK",
+]

@@ -1,0 +1,684 @@
+"""Continuous-batching generation engine (iteration-level scheduling).
+
+The orchestration layer between the decoupled execution path and the
+paged-attention model functions (``models/llama.py``):
+
+- **iteration-level scheduler**: one decode step per loop iteration over
+  EVERY running sequence; new requests are prefilled and join the running
+  batch at the next step boundary, finished sequences exit every step —
+  no sequence ever waits for the slowest member of a static batch (the
+  Orca/vLLM continuous-batching shape, PAPER.md survey).
+- **prefill/decode split**: admission pops the waiting queue in
+  (priority, arrival) order and runs each prompt's prefill as its own
+  device call (its first token streams immediately — TTFT is one prefill
+  away, not one batch drain away), then the sequence decodes with the
+  shared step.
+- **paged KV admission**: a sequence is admitted only when the
+  :class:`~client_tpu.llm.kv_cache.BlockAllocator` can cover its prompt;
+  a full cache QUEUES new work (bounded by ``max_queue`` —
+  429/RESOURCE_EXHAUSTED past the bound) instead of failing allocation.
+  Decode allocates blocks on demand; a dry pool preempts the
+  lowest-priority youngest sequence (its blocks free immediately, it
+  re-queues and later resumes by re-prefilling its full context).
+- **token streaming**: every sequence owns an asyncio queue the step loop
+  feeds one ``(token, final)`` pair per step; the serving adapter yields
+  them through ``ServerCore.infer_decoupled`` so each decode step emits
+  one response per active sequence on the decoupled gRPC stream and the
+  OpenAI SSE front-end.
+
+Single-owner concurrency: every public method runs on the serving event
+loop (the decoupled path executes models there); device calls hop to the
+injected executor so the loop never blocks on the accelerator. Clock
+reads go through the injected ``clock_ns`` (tools/clock_lint.py covers
+this package), so deadline behavior is testable on fake clocks.
+"""
+
+import asyncio
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from client_tpu.llm.kv_cache import BlockAllocator, CacheCapacityError, TRASH_BLOCK
+from client_tpu.scheduling import (
+    PriorityQueue,
+    QueueFullError,
+    QueueTimeoutError,
+)
+from client_tpu.utils import InferenceServerException
+
+
+class EngineConfig:
+    """Engine sizing knobs.
+
+    ``num_blocks`` counts physical blocks INCLUDING the reserved trash
+    block; ``max_active`` bounds the decode batch (and the compiled batch
+    buckets); ``max_queue`` bounds the waiting room (0 = unbounded);
+    ``max_seq_len`` is the model's context limit (prompt + max_tokens
+    validated against it at submit); ``priority_levels`` sizes the
+    waiting queue's priority lanes.
+    """
+
+    __slots__ = (
+        "block_size",
+        "num_blocks",
+        "max_active",
+        "max_queue",
+        "max_seq_len",
+        "priority_levels",
+        "default_max_tokens",
+        "prefill_bucket_min",
+    )
+
+    def __init__(
+        self,
+        block_size: int = 16,
+        num_blocks: int = 129,
+        max_active: int = 8,
+        max_queue: int = 64,
+        max_seq_len: int = 512,
+        priority_levels: int = 3,
+        default_max_tokens: int = 16,
+        prefill_bucket_min: int = 8,
+    ):
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.max_active = max(1, int(max_active))
+        self.max_queue = max(0, int(max_queue))
+        self.max_seq_len = int(max_seq_len)
+        self.priority_levels = max(1, int(priority_levels))
+        self.default_max_tokens = int(default_max_tokens)
+        self.prefill_bucket_min = int(prefill_bucket_min)
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return (self.max_seq_len + self.block_size - 1) // self.block_size
+
+
+_WAITING = "waiting"
+_RUNNING = "running"
+_DONE = "done"
+
+
+def _int_param(name: str, value: Any) -> int:
+    """Coerce a wire request parameter; malformed values are a client
+    error (400/INVALID_ARGUMENT), never an internal 500."""
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        raise InferenceServerException(
+            f"request parameter {name!r} must be an integer, got {value!r}"
+        ) from None
+
+
+class Sequence:
+    """One generation request: scheduling state + the token stream handle.
+
+    Async-iterating a sequence yields ``(token_id, final)`` pairs as the
+    step loop produces them. ``context`` (prompt + generated so far) is
+    what a resume-after-preemption re-prefills.
+    """
+
+    __slots__ = (
+        "seq_id",
+        "prompt",
+        "generated",
+        "max_tokens",
+        "priority_level",
+        "deadline_ns",
+        "timeout_us",
+        "state",
+        "blocks",
+        "page_table",
+        "last_token",
+        "position",
+        "cancelled",
+        "preemptions",
+        "_out",
+        "_engine",
+    )
+
+    def __init__(self, seq_id, prompt, max_tokens, priority_level,
+                 deadline_ns, timeout_us, max_blocks: int, engine):
+        self.seq_id = seq_id
+        self.prompt: List[int] = prompt
+        self.generated: List[int] = []
+        self.max_tokens = max_tokens
+        self.priority_level = priority_level
+        self.deadline_ns = deadline_ns
+        self.timeout_us = timeout_us
+        self.state = _WAITING
+        self.blocks: List[int] = []
+        self.page_table = np.zeros([max_blocks], dtype=np.int32)
+        self.last_token = 0
+        self.position = 0
+        self.cancelled = False
+        self.preemptions = 0
+        self._out: asyncio.Queue = asyncio.Queue()
+        self._engine = engine
+
+    @property
+    def context(self) -> List[int]:
+        return self.prompt + self.generated
+
+    def emit(self, token: int, final: bool) -> None:
+        self._out.put_nowait(("tok", int(token), final))
+
+    def fail(self, exc: BaseException) -> None:
+        # _DONE keeps the adapter's unconditional release() from booking
+        # a failed/expired sequence as a client cancellation
+        self.state = _DONE
+        self._out.put_nowait(("err", exc, True))
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        if self.cancelled:
+            raise StopAsyncIteration
+        kind, value, final = await self._out.get()
+        if kind == "end":
+            raise StopAsyncIteration
+        if kind == "err":
+            raise value
+        if final:
+            # mark consumed-to-completion so release() is a no-op
+            self.cancelled = True
+            self.state = _DONE
+            return value, True
+        return value, False
+
+
+class LlmEngine:
+    """The continuous-batching engine; see the module docstring.
+
+    ``prefill_fn(tokens[1, L], page_table[max_blocks], pages, last_index)
+    -> (logits[1, V], pages)`` and ``decode_fn(tokens[B], positions[B],
+    page_tables[B, max_blocks], pages) -> (logits[B, V], pages)`` are the
+    injected (jitted) device callables; ``pages`` is opaque to the
+    engine. ``metrics`` implements the ServerMetrics LLM hooks
+    (set_kv_blocks / set_llm_sequences / observe_llm_step /
+    observe_llm_preemption / observe_rejection); None disables export.
+    """
+
+    def __init__(
+        self,
+        prefill_fn: Callable,
+        decode_fn: Callable,
+        pages: Any,
+        engine_config: EngineConfig,
+        model_name: str = "llm_engine",
+        metrics: Any = None,
+        executor: Any = None,
+        logger: Any = None,
+        clock_ns: Callable[[], int] = time.monotonic_ns,
+    ):
+        self.config = engine_config
+        self.model_name = model_name
+        self.allocator = BlockAllocator(
+            engine_config.num_blocks, engine_config.block_size
+        )
+        self.metrics = metrics
+        self.logger = logger
+        self._clock_ns = clock_ns
+        self._prefill = prefill_fn
+        self._decode = decode_fn
+        self._pages = pages
+        self._executor = executor
+        self._waiting = PriorityQueue(levels=engine_config.priority_levels)
+        self._running: List[Sequence] = []
+        # the one sequence mid-prefill in _admit: it owns blocks but is
+        # in neither _waiting nor _running, so shutdown/failure cleanup
+        # must cover it explicitly
+        self._admitting: Optional[Sequence] = None
+        self._seq_counter = 0
+        self._task: Optional[asyncio.Task] = None
+        self._wake: Optional[asyncio.Event] = None
+        self._closed = False
+        # cumulative counters (also mirrored to the metrics registry)
+        self.steps = 0
+        self.tokens_generated = 0
+        self.preemptions = 0
+        self.completed = 0
+        self.cancelled_count = 0
+        self.expired = 0
+
+    # -- submission / cancellation (serving-loop only) -----------------------
+
+    def submit(
+        self,
+        prompt_ids: List[int],
+        max_tokens: Optional[int] = None,
+        parameters: Optional[Dict[str, Any]] = None,
+    ) -> Sequence:
+        """Admit one generation request into the waiting queue.
+
+        Raises synchronously: :class:`InferenceServerException` for
+        requests that can NEVER run (context exceeds the model's
+        ``max_seq_len`` or the pool's total capacity) and
+        :class:`QueueFullError` (429/RESOURCE_EXHAUSTED) once
+        ``max_queue`` requests wait — the capacity-based admission the
+        paged cache exists for.
+        """
+        if self._closed:
+            raise InferenceServerException(
+                f"llm engine for '{self.model_name}' is closed"
+            )
+        parameters = parameters or {}
+        config = self.config
+        if max_tokens is None:
+            max_tokens = _int_param(
+                "max_tokens",
+                parameters.get("max_tokens", config.default_max_tokens),
+            )
+        prompt = [int(t) for t in prompt_ids]
+        if not prompt:
+            raise InferenceServerException("empty prompt")
+        if max_tokens < 1:
+            raise InferenceServerException(
+                f"max_tokens must be >= 1, got {max_tokens}"
+            )
+        total = len(prompt) + max_tokens
+        if total > config.max_seq_len:
+            raise InferenceServerException(
+                f"prompt ({len(prompt)}) + max_tokens ({max_tokens}) "
+                f"exceeds max sequence length {config.max_seq_len}"
+            )
+        if self.allocator.blocks_for(total) > self.allocator.capacity:
+            raise InferenceServerException(
+                f"request needs {self.allocator.blocks_for(total)} KV "
+                f"blocks but the pool holds {self.allocator.capacity}"
+            )
+        # parse the remaining wire parameters BEFORE the queue-full
+        # check: a malformed request is a 400, not a 429
+        level = _int_param("priority", parameters.get("priority", 0) or 0)
+        if level <= 0:
+            # 0/negative = unset -> the default (lowest) lane, matching
+            # QueuePolicy.priority_of — a negative value must not clamp
+            # to the HIGHEST lane (priority escalation) downstream
+            level = config.priority_levels
+        timeout_us = _int_param(
+            "timeout_us",
+            parameters.get("timeout_us", parameters.get("timeout", 0)) or 0,
+        )
+        if config.max_queue and len(self._waiting) >= config.max_queue:
+            error = QueueFullError(self.model_name, config.max_queue)
+            if self.metrics is not None:
+                self.metrics.observe_rejection(self.model_name, error.reason)
+            raise error
+        now_ns = self._clock_ns()
+        deadline_ns = now_ns + timeout_us * 1000 if timeout_us > 0 else None
+        self._seq_counter += 1
+        seq = Sequence(
+            self._seq_counter,
+            prompt,
+            max_tokens,
+            level,
+            deadline_ns,
+            timeout_us,
+            config.max_blocks_per_seq,
+            self,
+        )
+        self._waiting.push(seq, level=level, deadline_ns=deadline_ns)
+        self._ensure_task()
+        self._publish()
+        return seq
+
+    def release(self, seq: Sequence) -> None:
+        """Drop a sequence (client cancellation / stream teardown).
+
+        Idempotent; safe on finished sequences. The step loop frees the
+        KV blocks and removes the sequence within one iteration."""
+        if seq.state == _DONE:
+            return
+        if not seq.cancelled:
+            seq.cancelled = True
+            self.cancelled_count += 1
+        # unblock a consumer parked on the queue
+        seq._out.put_nowait(("end", None, True))
+        self._wake_loop()
+
+    def close(self) -> None:
+        """Stop the step loop and fail everything still queued/running.
+
+        Idempotent. Thread-safe: while the serving loop is alive, an
+        off-loop caller (ServerCore.close from the main thread) hops
+        onto it — cancelling the task and waking parked stream
+        consumers from a foreign thread would race the loop. Once the
+        loop is stopped/closed, teardown runs directly."""
+        self._closed = True
+        task = self._task
+        if task is not None and not task.done():
+            loop = task.get_loop()
+            try:
+                on_loop = asyncio.get_running_loop() is loop
+            except RuntimeError:
+                on_loop = False
+            if not on_loop and not loop.is_closed():
+                try:
+                    loop.call_soon_threadsafe(self._close_on_loop)
+                    return
+                except RuntimeError:
+                    pass  # loop closed between the check and the call
+        self._close_on_loop()
+
+    def _close_on_loop(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            try:
+                self._task.cancel()
+            except RuntimeError:
+                pass  # owning loop already closed
+            self._task = None
+        self._fail_all(
+            InferenceServerException(
+                f"llm engine for '{self.model_name}' shut down"
+            )
+        )
+
+    def _fail_all(self, error: BaseException) -> None:
+        """Free and fail every live sequence — running, waiting, and the
+        one possibly mid-prefill — so no consumer hangs and no KV block
+        leaks. Idempotent (free is; fail on a done sequence is inert)."""
+        if self._admitting is not None:
+            self.allocator.free(self._admitting.seq_id)
+            self._admitting.fail(error)
+            self._admitting = None
+        for seq in self._running:
+            self.allocator.free(seq.seq_id)
+            seq.fail(error)
+        self._running.clear()
+        items = self._waiting.scan()
+        for item in items:
+            item.value.fail(error)
+        self._waiting.remove(items)
+        self._publish()
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "active_sequences": len(self._running),
+            "waiting_sequences": len(self._waiting),
+            "kv_blocks_in_use": self.allocator.blocks_in_use,
+            "kv_blocks_total": self.allocator.capacity,
+            "block_size": self.allocator.block_size,
+            "steps": self.steps,
+            "tokens_generated": self.tokens_generated,
+            "preemptions": self.preemptions,
+            "completed": self.completed,
+            "cancelled": self.cancelled_count,
+            "expired": self.expired,
+        }
+
+    # -- step loop -----------------------------------------------------------
+
+    def _ensure_task(self) -> None:
+        if self._task is None or self._task.done():
+            loop = asyncio.get_running_loop()
+            # fresh Event per task: an asyncio.Event binds to the loop it
+            # is first awaited on, and a restarted engine may be serving
+            # a different loop than the task that just finished
+            self._wake = asyncio.Event()
+            self._task = loop.create_task(self._run())
+        self._wake_loop()
+
+    def _wake_loop(self) -> None:
+        if self._wake is not None:
+            self._wake.set()
+
+    async def _run_device(self, fn, *args):
+        if self._executor is None:
+            return fn(*args)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._executor, lambda: fn(*args)
+        )
+
+    async def _run(self) -> None:
+        try:
+            while not self._closed:
+                if not self._running and not len(self._waiting):
+                    self._wake.clear()
+                    await self._wake.wait()
+                    continue
+                self._prune()
+                await self._admit()
+                if self._running:
+                    await self._step()
+                self._publish()
+                # one cooperative yield per iteration: stream consumers
+                # on this loop drain their queues between steps
+                await asyncio.sleep(0)
+        except asyncio.CancelledError:
+            # shutdown mid-iteration (possibly mid-prefill): clean up on
+            # the loop before unwinding so nothing leaks or hangs
+            self._fail_all(
+                InferenceServerException(
+                    f"llm engine for '{self.model_name}' shut down"
+                )
+            )
+            raise
+        except Exception as e:  # noqa: BLE001 - engine must not die silently
+            if self.logger is not None:
+                self.logger.error("llm_engine_loop_failed", exc=e,
+                                  model=self.model_name)
+            self._fail_all(
+                InferenceServerException(f"llm engine step failed: {e}")
+            )
+            # A failed device call may have consumed donated buffers (the
+            # page pool is donated to the jitted step off-CPU), so the
+            # engine cannot safely serve against self._pages anymore:
+            # refuse new work until warmup() rebuilds it instead of
+            # failing every future batch against dead device state.
+            self._closed = True
+
+    def _prune(self) -> None:
+        """Drop cancelled sequences and expire waiting deadlines."""
+        now_ns = self._clock_ns()
+        for item in self._waiting.expire(now_ns):
+            seq = item.value
+            self.expired += 1
+            if not seq.cancelled:
+                error = QueueTimeoutError(self.model_name, seq.timeout_us)
+                if self.metrics is not None:
+                    self.metrics.observe_rejection(
+                        self.model_name, error.reason
+                    )
+                seq.fail(error)
+        stale = [i for i in self._waiting.scan() if i.value.cancelled]
+        if stale:
+            self._waiting.remove(stale)
+        if any(seq.cancelled for seq in self._running):
+            for seq in self._running:
+                if seq.cancelled:
+                    self.allocator.free(seq.seq_id)
+                    seq.state = _DONE
+            self._running = [s for s in self._running if not s.cancelled]
+
+    async def _admit(self) -> None:
+        """Prefill waiting sequences into the running batch, in
+        (priority, arrival) order, while the block pool and the
+        ``max_active`` bound allow. The first blocker stops admission —
+        a full cache queues behind it rather than skipping ahead (no
+        starvation of large prompts)."""
+        allocator = self.allocator
+        for item in self._waiting.scan():
+            seq: Sequence = item.value
+            if len(self._running) >= self.config.max_active:
+                break
+            context = seq.context
+            # +1: the first decode step writes the freshly-sampled
+            # token's K/V at position len(context)
+            need = allocator.blocks_for(len(context) + 1)
+            if need > allocator.free_blocks:
+                break
+            self._waiting.remove([item])
+            if seq.cancelled:
+                seq.state = _DONE
+                continue
+            blocks = allocator.allocate(seq.seq_id, need)
+            seq.blocks = blocks
+            seq.page_table[:] = TRASH_BLOCK
+            seq.page_table[: len(blocks)] = blocks
+            # visible to _fail_all while the prefill await is in flight:
+            # the sequence owns blocks but is in neither queue nor batch.
+            # Deliberately NOT cleared in a finally — on cancellation or
+            # device failure it must still be set when the _run handlers
+            # reclaim it; only a successful prefill clears it here.
+            self._admitting = seq
+            token = await self._prefill_one(seq, context)
+            self._admitting = None
+            seq.generated.append(token)
+            seq.last_token = token
+            seq.position = len(context)
+            final = len(seq.generated) >= seq.max_tokens
+            seq.emit(token, final)
+            self.tokens_generated += 1
+            if self.metrics is not None:
+                self.metrics.observe_llm_tokens(self.model_name)
+            if final:
+                self._finish(seq)
+            else:
+                seq.state = _RUNNING
+                self._running.append(seq)
+
+    async def _prefill_one(self, seq: Sequence, context: List[int]):
+        from client_tpu.server.models import pad_batch_bucket
+
+        bucket = min(
+            pad_batch_bucket(
+                len(context), minimum=self.config.prefill_bucket_min
+            ),
+            self.config.max_seq_len,
+        )
+        tokens = np.zeros([1, bucket], dtype=np.int32)
+        tokens[0, : len(context)] = context
+        # A failing device call is ENGINE-fatal, not sequence-fatal: the
+        # inputs were engine-constructed (request validation happened at
+        # submit) and the donated page pool may be gone — let it
+        # propagate to the _run catch-all, which fails everything and
+        # marks the engine for reload.
+        logits, self._pages = await self._run_device(
+            self._prefill,
+            tokens,
+            seq.page_table,
+            self._pages,
+            len(context) - 1,
+        )
+        return int(np.asarray(logits)[0].argmax())
+
+    def _pick_victim(self) -> Optional[Sequence]:
+        """Preemption victim: lowest priority (highest level number)
+        first, youngest (most blocks still to earn) among equals."""
+        if not self._running:
+            return None
+        return max(
+            self._running,
+            key=lambda s: (s.priority_level, -len(s.generated), s.seq_id),
+        )
+
+    def _preempt(self, victim: Sequence) -> None:
+        """Push a running sequence back to the waiting queue and free its
+        blocks NOW; it resumes later by re-prefilling prompt+generated
+        (tokens already streamed stay streamed — deterministic greedy
+        decode regenerates the identical cache)."""
+        self.allocator.free(victim.seq_id)
+        victim.blocks = []
+        victim.page_table[:] = TRASH_BLOCK
+        victim.state = _WAITING
+        victim.preemptions += 1
+        self.preemptions += 1
+        self._running.remove(victim)
+        # NO queue deadline on the requeue: timeout_us bounds time-to-
+        # START, which this sequence already satisfied — expiring a
+        # partially-streamed generation as "timed out in queue" would
+        # turn delivered tokens into a spurious 504
+        self._waiting.push(victim, level=victim.priority_level)
+        if self.metrics is not None:
+            self.metrics.observe_llm_preemption(self.model_name)
+        if self.logger is not None:
+            self.logger.verbose(
+                "llm_sequence_preempted",
+                model=self.model_name,
+                seq=victim.seq_id,
+                generated=len(victim.generated),
+            )
+
+    async def _step(self) -> None:
+        """One iteration-level decode step over every running sequence."""
+        from client_tpu.server.models import pad_batch_bucket
+
+        allocator = self.allocator
+        # allocate-on-demand: sequences whose next write position enters
+        # a new block claim it now; a dry pool preempts until it fits
+        for seq in list(self._running):
+            if seq not in self._running:
+                continue  # already preempted below
+            while seq.position // allocator.block_size >= len(seq.blocks):
+                try:
+                    block = allocator.extend(seq.seq_id)
+                    seq.blocks.append(block)
+                    seq.page_table[len(seq.blocks) - 1] = block
+                except CacheCapacityError:
+                    victim = self._pick_victim()
+                    self._preempt(victim)
+                    if victim is seq:
+                        break
+        batch = self._running
+        if not batch:
+            return
+        n = len(batch)
+        bucket = pad_batch_bucket(n)
+        tokens = np.zeros([bucket], dtype=np.int32)
+        positions = np.zeros([bucket], dtype=np.int32)
+        page_tables = np.zeros(
+            [bucket, self.config.max_blocks_per_seq], dtype=np.int32
+        )
+        for i, seq in enumerate(batch):
+            tokens[i] = seq.last_token
+            positions[i] = seq.position
+            page_tables[i] = seq.page_table
+        logits, self._pages = await self._run_device(
+            self._decode, tokens, positions, page_tables, self._pages
+        )
+        next_tokens = np.asarray(logits)[:n].argmax(axis=-1)
+        self.steps += 1
+        emitted = 0
+        for seq, token in zip(batch, next_tokens):
+            if seq.cancelled:
+                continue  # pruned (and freed) next iteration
+            token = int(token)
+            seq.generated.append(token)
+            seq.last_token = token
+            seq.position += 1
+            self.tokens_generated += 1
+            emitted += 1
+            final = len(seq.generated) >= seq.max_tokens
+            seq.emit(token, final)
+            if final:
+                self._finish(seq)
+        if self.metrics is not None:
+            # emitted (not n): cancelled lanes decoded but streamed
+            # nothing, and the exported counter must agree with stats()
+            self.metrics.observe_llm_step(self.model_name, n)
+            if emitted:
+                self.metrics.observe_llm_tokens(self.model_name, emitted)
+        self._running = [s for s in self._running if s.state == _RUNNING]
+
+    def _finish(self, seq: Sequence) -> None:
+        self.allocator.free(seq.seq_id)
+        seq.state = _DONE
+        self.completed += 1
+
+    def _publish(self) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.set_kv_blocks(
+            self.model_name,
+            self.allocator.blocks_in_use,
+            self.allocator.capacity,
+        )
+        self.metrics.set_llm_sequences(
+            self.model_name, len(self._running), len(self._waiting)
+        )

@@ -1,0 +1,114 @@
+"""Block-allocated paged KV-cache accounting.
+
+The manager half of the paged cache (the physical pool lives in
+``models/llama.py`` ``init_kv_pages``): a fixed population of
+``block_size``-token blocks handed out on demand, one logical page table
+per live sequence. Capacity is the admission signal — a full pool QUEUES
+new work (the engine keeps it waiting) instead of OOMing a growing dense
+cache, and freeing on completion/cancellation returns blocks for the next
+admission. Physical block 0 is reserved as the trash block padding lanes
+write into, so it is never allocated.
+
+Pure bookkeeping: no clocks, no jax, single-owner (the engine's step
+loop) — no locks.
+"""
+
+from typing import Dict, List
+
+from client_tpu.utils import InferenceServerException
+
+# Reserved physical block: bucketed-batch padding lanes and padded
+# prompt tails scatter their K/V here; page-table entries of 0 mean
+# "unallocated" and are masked out of attention.
+TRASH_BLOCK = 0
+
+
+class CacheCapacityError(InferenceServerException):
+    """A block demand exceeded the pool's free (or total) capacity."""
+
+    def __init__(self, msg: str):
+        super().__init__(msg, status="RESOURCE_EXHAUSTED")
+
+
+class BlockAllocator:
+    """Fixed-size-block pool accounting for the paged KV cache.
+
+    ``num_blocks`` counts PHYSICAL blocks including the reserved trash
+    block; :attr:`capacity` (= ``num_blocks - 1``) is what sequences can
+    actually hold. Blocks are identified by pool index and owned by a
+    sequence id until :meth:`free`.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is reserved)")
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # LIFO free stack: recently-freed blocks are re-issued first
+        # (their pages are hot in cache)
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._owned: Dict[object, List[int]] = {}
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable blocks (the trash block excluded)."""
+        return self.num_blocks - 1
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.capacity - len(self._free)
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks needed to hold ``n_tokens`` of context."""
+        return (max(0, n_tokens) + self.block_size - 1) // self.block_size
+
+    def owned(self, seq_id) -> List[int]:
+        """The sequence's block list (allocation order = logical order)."""
+        return self._owned.get(seq_id, [])
+
+    def allocate(self, seq_id, n_blocks: int) -> List[int]:
+        """Claim ``n_blocks`` for a new sequence; all-or-nothing."""
+        if seq_id in self._owned:
+            raise CacheCapacityError(
+                f"sequence {seq_id!r} already owns blocks"
+            )
+        if n_blocks > len(self._free):
+            raise CacheCapacityError(
+                f"KV cache exhausted: need {n_blocks} blocks, "
+                f"{len(self._free)} of {self.capacity} free"
+            )
+        blocks = [self._free.pop() for _ in range(n_blocks)]
+        self._owned[seq_id] = blocks
+        # a copy: callers keep their own page-table mirror, and a caller
+        # appending to the returned list must not alias the ownership
+        # record (a block listed twice would be freed twice)
+        return list(blocks)
+
+    def extend(self, seq_id) -> int:
+        """Claim ONE more block for a growing sequence (decode entering a
+        new block); raises :class:`CacheCapacityError` when the pool is
+        dry — the engine's preemption signal."""
+        if seq_id not in self._owned:
+            raise CacheCapacityError(f"sequence {seq_id!r} owns no blocks")
+        if not self._free:
+            raise CacheCapacityError(
+                f"KV cache exhausted: 0 of {self.capacity} blocks free"
+            )
+        block = self._free.pop()
+        self._owned[seq_id].append(block)
+        return block
+
+    def free(self, seq_id) -> int:
+        """Return a sequence's blocks to the pool (idempotent); returns
+        the number of blocks released."""
+        blocks = self._owned.pop(seq_id, None)
+        if not blocks:
+            return 0
+        self._free.extend(reversed(blocks))
+        return len(blocks)

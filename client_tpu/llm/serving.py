@@ -1,0 +1,171 @@
+"""Repository model type wrapping the continuous-batching engine.
+
+``llm_engine`` is a decoupled KServe v2 model (INPUT_IDS -> one
+OUTPUT_IDS token per streamed response — the same wire contract as
+``llm_decode``) whose generations share ONE :class:`LlmEngine`: every
+concurrent ``execute_decoupled`` call is a sequence in the engine's
+running batch, so N concurrent streams cost one batched decode step per
+token instead of N serial steps. Served through all streaming surfaces
+(decoupled gRPC, OpenAI SSE) untouched — the front-ends just see a
+decoupled model.
+"""
+
+from typing import Any, AsyncIterator, Dict, Optional
+
+import numpy as np
+
+from client_tpu.llm.engine import EngineConfig, LlmEngine
+from client_tpu.server.model_repository import Model
+from client_tpu.utils import InferenceServerException
+
+
+class LlmEngineModel(Model):
+    """Continuous-batching LLM generation over the paged KV cache.
+
+    The serving half of ROADMAP item 2: same request/response shape as
+    :class:`client_tpu.models.serving.LlmDecodeModel` but backed by the
+    shared engine — concurrent generations interleave at every decode
+    step rather than running serial single-sequence loops.
+    """
+
+    decoupled = True
+    max_batch_size = 0
+    platform = "jax"
+    backend = "jax"
+    inputs = [{"name": "INPUT_IDS", "datatype": "INT32", "shape": [-1]}]
+    outputs = [{"name": "OUTPUT_IDS", "datatype": "INT32", "shape": [1]}]
+
+    def __init__(
+        self,
+        name: str = "llm_engine",
+        config=None,
+        params=None,
+        engine_config: Optional[EngineConfig] = None,
+    ):
+        from client_tpu.models import llama
+
+        self.name = name
+        self._config = config or llama.LlamaConfig.tiny(max_seq_len=512)
+        if engine_config is None:
+            # default pool: 8 full-length sequences' worth of blocks —
+            # small enough that sustained overload exercises the
+            # queue/preemption path, large enough that the genai-perf
+            # default workload (64-token prompts, 16 output tokens)
+            # never starves
+            block_size = 16
+            per_seq = (self._config.max_seq_len + block_size - 1) // block_size
+            engine_config = EngineConfig(
+                block_size=block_size,
+                num_blocks=1 + 8 * per_seq,
+                max_active=8,
+                max_queue=64,
+                max_seq_len=self._config.max_seq_len,
+            )
+        self.engine_config = engine_config
+        self._params = params
+        self.engine: Optional[LlmEngine] = None
+        self._core = None
+
+    def warmup(self) -> None:
+        import jax
+
+        from client_tpu.models import llama
+
+        config = self._config
+        if self._params is None:
+            self._params = llama.init_params(jax.random.PRNGKey(0), config)
+        engine_config = self.engine_config
+        params = self._params
+
+        # Buffer donation lets XLA update the block pool in place (the
+        # pool is the whole point — ONE physical cache, not a copy per
+        # step); the CPU backend does not implement donation and warns,
+        # so only donate on real accelerators.
+        donate = jax.default_backend() != "cpu"
+        prefill = jax.jit(
+            lambda tokens, page_table, pages, last_index: (
+                llama.prefill_into_pages(
+                    params, tokens, page_table, pages, last_index, config
+                )
+            ),
+            donate_argnums=(2,) if donate else (),
+        )
+        decode = jax.jit(
+            lambda tokens, positions, page_tables, pages: (
+                llama.decode_step_paged(
+                    params, tokens, positions, page_tables, pages, config
+                )
+            ),
+            donate_argnums=(3,) if donate else (),
+        )
+        pages = llama.init_kv_pages(
+            config, engine_config.num_blocks, engine_config.block_size
+        )
+        # compile the smallest shapes up front (page table all-zeros =
+        # every write lands in the reserved trash block)
+        max_blocks = engine_config.max_blocks_per_seq
+        table = np.zeros([max_blocks], dtype=np.int32)
+        logits, pages = prefill(
+            np.zeros([1, engine_config.prefill_bucket_min], dtype=np.int32),
+            table,
+            pages,
+            engine_config.prefill_bucket_min - 1,
+        )
+        logits, pages = decode(
+            np.zeros([1], dtype=np.int32),
+            np.zeros([1], dtype=np.int32),
+            table[None, :],
+            pages,
+        )
+        jax.block_until_ready(logits)
+        # a reload replaces the engine wholesale: fresh pool, clean
+        # accounting (the old engine's streams were drained by the
+        # lifecycle layer before the swap)
+        if self.engine is not None:
+            self.engine.close()
+        self.engine = LlmEngine(
+            prefill,
+            decode,
+            pages,
+            engine_config,
+            model_name=self.name,
+        )
+        self._core = None  # rebind metrics/executor after a reload
+
+    def shutdown(self) -> None:
+        """Stop the engine's step loop (``ServerCore.close`` hook)."""
+        if self.engine is not None:
+            self.engine.close()
+
+    def bind_core(self, core) -> None:
+        """Wire the engine into the server it serves under (called by
+        ``ServerCore.infer_decoupled`` on first use): metrics export via
+        the shared registry, device calls on the core's executor, errors
+        into the structured logger. Idempotent per core."""
+        if self._core is core or self.engine is None:
+            return
+        self._core = core
+        self.engine.metrics = core.metrics
+        self.engine._executor = core._executor
+        self.engine.logger = core.logger
+        self.engine._publish()
+
+    async def execute_decoupled(
+        self, inputs: Dict[str, np.ndarray], parameters: Dict[str, Any]
+    ) -> AsyncIterator[Dict[str, np.ndarray]]:
+        if "INPUT_IDS" not in inputs:
+            raise InferenceServerException(
+                f"model '{self.name}' expects input INPUT_IDS"
+            )
+        prompt = np.asarray(inputs["INPUT_IDS"], dtype=np.int32).reshape(-1)
+        seq = self.engine.submit(prompt.tolist(), parameters=parameters)
+        try:
+            async for token, final in seq:
+                yield {
+                    "OUTPUT_IDS": np.array([token], dtype=np.int32),
+                    "__final__": final,
+                }
+        finally:
+            # client cancellation / stream teardown: the engine reclaims
+            # the sequence's KV blocks within one step-loop iteration
+            self.engine.release(seq)

@@ -386,6 +386,138 @@ def decode_step(params, token, position, cache, config: LlamaConfig):
     return logits.astype(jnp.float32), new_cache
 
 
+# ---------------------------------------------------------------------------
+# paged KV cache (block-pool layout for the continuous-batching engine)
+# ---------------------------------------------------------------------------
+#
+# "Ragged Paged Attention" (PAPERS.md, arxiv 2604.15464) reproduced at the
+# cache-manager level: instead of one dense [B, max_seq, KV, D] cache per
+# request, every layer owns ONE physical pool of fixed-size token blocks
+# shared by all live sequences:
+#
+#     k_pages, v_pages : [num_blocks, block_size, n_kv_heads, head_dim]
+#
+# A sequence's logical view is its *page table* — a row of physical block
+# ids, one per ``block_size`` tokens of context. Decode scatters the step's
+# K/V into (page_table[pos // bs], pos % bs) and gathers the sequence's
+# pages back into a contiguous [B, S, KV, D] view for attention (the
+# XLA-level stand-in for the fused Pallas kernel; the manager semantics —
+# allocate-on-demand, free-on-completion, shared pool — are identical).
+#
+# Physical block 0 is reserved as the TRASH block: padding lanes of a
+# bucketed decode batch and padded prompt-tail positions point their
+# writes at it, so they can never clobber a live sequence's cache, and
+# unallocated page-table entries are 0 — masked out by the per-sequence
+# validity mask before they influence attention.
+
+
+def init_kv_pages(config: LlamaConfig, num_blocks: int, block_size: int):
+    """Zeroed block pool: one (k_pages, v_pages) pair per layer."""
+    shape = (num_blocks, block_size, config.n_kv_heads, config.head_dim)
+    return [
+        (
+            jnp.zeros(shape, dtype=config.dtype),
+            jnp.zeros(shape, dtype=config.dtype),
+        )
+        for _ in range(config.n_layers)
+    ]
+
+
+def prefill_into_pages(
+    params, tokens, page_table, pages, last_index, config: LlamaConfig
+):
+    """Prefill one prompt and scatter its K/V into the block pool.
+
+    ``tokens`` [1, L] (L = padded bucket length), ``page_table``
+    [max_blocks] physical block ids (0 = unallocated/trash),
+    ``last_index`` the real last-token index (traced scalar). Runs the
+    prompt through :func:`prefill_with_cache` on a dense scratch cache of
+    the bucket length, then writes positions ``0..last_index`` into the
+    pages (padded tail positions write to the trash block). Returns
+    (logits_of_last_token [1, V], new_pages).
+    """
+    b, l = tokens.shape
+    block_size = pages[0][0].shape[1]
+    scratch = init_kv_cache(config, b, l)
+    logits, dense = prefill_with_cache(
+        params, tokens, scratch, config, last_index=last_index
+    )
+    pos = jnp.arange(l)
+    valid = pos <= last_index
+    phys = jnp.where(valid, page_table[pos // block_size], 0)
+    off = jnp.where(valid, pos % block_size, 0)
+    new_pages = []
+    for (k_pages, v_pages), (dense_k, dense_v) in zip(pages, dense):
+        new_pages.append(
+            (
+                k_pages.at[phys, off].set(dense_k[0]),
+                v_pages.at[phys, off].set(dense_v[0]),
+            )
+        )
+    return logits, new_pages
+
+
+def decode_step_paged(
+    params, tokens, positions, page_tables, pages, config: LlamaConfig
+):
+    """One continuous-batching decode step over the block pool.
+
+    ``tokens`` [B] (each sequence's most recent token), ``positions`` [B]
+    (that token's context position — PER SEQUENCE, unlike
+    :func:`decode_step`'s shared scalar), ``page_tables`` [B, max_blocks]
+    physical block ids. Writes each token's K/V into its sequence's
+    current block, gathers each sequence's pages into a contiguous view,
+    and attends under a per-sequence validity mask (slot <= position).
+    Padding lanes (page table all zeros, position 0) write to the trash
+    block and produce garbage logits the caller discards. Returns
+    (logits [B, V], new_pages).
+    """
+    b = tokens.shape[0]
+    block_size = pages[0][0].shape[1]
+    max_blocks = page_tables.shape[1]
+    s = max_blocks * block_size
+    n_rep = config.n_heads // config.n_kv_heads
+    pos2 = positions[:, None]  # [B, 1]
+    phys = page_tables[jnp.arange(b), positions // block_size]  # [B]
+    off = positions % block_size
+    valid = jnp.arange(s)[None, :] <= pos2  # [B, S]
+    x = params["embed"][tokens][:, None, :].astype(config.dtype)
+    new_pages = []
+    for layer, (k_pages, v_pages) in zip(params["layers"], pages):
+        normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q = jnp.einsum("bld,dhk->blhk", normed, layer["wq"])
+        k = jnp.einsum("bld,dhk->blhk", normed, layer["wk"])
+        v = jnp.einsum("bld,dhk->blhk", normed, layer["wv"])
+        q = _rope(q, pos2, config.rope_theta)
+        k = _rope(k, pos2, config.rope_theta)
+        # scatter this step's K/V, THEN gather: the current position's
+        # entry must be visible to its own attention
+        k_pages = k_pages.at[phys, off].set(k[:, 0])
+        v_pages = v_pages.at[phys, off].set(v[:, 0])
+        new_pages.append((k_pages, v_pages))
+        k_ctx = k_pages[page_tables].reshape(
+            b, s, config.n_kv_heads, config.head_dim
+        )
+        v_ctx = v_pages[page_tables].reshape(
+            b, s, config.n_kv_heads, config.head_dim
+        )
+        qh = q.transpose(0, 2, 1, 3)  # [B, H, 1, D]
+        kh = _repeat_kv(k_ctx, n_rep).transpose(0, 2, 1, 3)  # [B, H, S, D]
+        vh = _repeat_kv(v_ctx, n_rep).transpose(0, 2, 1, 3)
+        scores = jnp.einsum(
+            "bhqd,bhkd->bhqk", qh, kh, preferred_element_type=jnp.float32
+        ) / np.sqrt(config.head_dim)
+        scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+        weights = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", weights, vh.astype(weights.dtype))
+        out = out.astype(x.dtype).transpose(0, 2, 1, 3)  # [B, 1, H, D]
+        x = x + jnp.einsum("blhk,hkd->bld", out, layer["wo"])
+        x = x + _mlp_block(layer, rms_norm(x, layer["mlp_norm"], config.norm_eps))
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = jnp.einsum("bd,dv->bv", x[:, 0], params["lm_head"])
+    return logits.astype(jnp.float32), new_pages
+
+
 def generate(
     params,
     prompt_tokens: jnp.ndarray,

@@ -290,6 +290,7 @@ class LlmDecodeModel(Model):
 
 def register_zoo_models(repository, small: bool = True) -> None:
     """Install the model-zoo adapters (small variants by default)."""
+    from client_tpu.llm.serving import LlmEngineModel
     from client_tpu.models import bert
 
     repository.add_model(
@@ -298,6 +299,9 @@ def register_zoo_models(repository, small: bool = True) -> None:
         )
     )
     repository.add_model(LlmDecodeModel())
+    # llm_decode's continuous-batching successor: same wire contract,
+    # one shared engine batching all concurrent generations per step
+    repository.add_model(LlmEngineModel())
     repository.add_model(
         TextEncoderModel(
             config=bert.BertConfig.tiny()

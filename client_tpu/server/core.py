@@ -874,8 +874,22 @@ class ServerCore:
         ``model_name`` is set); returns the effective settings."""
         return self.logger.update(updates, model_name)
 
+    def _shutdown_model_hooks(self) -> None:
+        """Stop model-owned background machinery (the LLM engine's step
+        loop): invoked on the serving loop at the end of a drain, and
+        again (idempotently) from close() for cores that never drain."""
+        for entry in self.repository.index():
+            model = self.repository.peek(entry["name"])
+            shutdown = getattr(model, "shutdown", None)
+            if shutdown is not None:
+                try:
+                    shutdown()
+                except Exception:  # noqa: BLE001 - best-effort teardown
+                    pass
+
     def close(self) -> None:
         self.lifecycle.mark_stopped()
+        self._shutdown_model_hooks()
         self._executor.shutdown(wait=False, cancel_futures=True)
         self.trace_manager.close()
         self.logger.close()
@@ -937,6 +951,9 @@ class ServerCore:
             # into the return value: the deadline DID expire)
             await self.lifecycle.wait_idle(min(1.0, timeout_s or 1.0))
         self.lifecycle.mark_stopped()
+        # runs ON the serving loop: model background tasks (engine step
+        # loops) cancel cleanly here, before the loop itself closes
+        self._shutdown_model_hooks()
         self.logger.info("drain_completed", drained=drained)
         return drained
 
@@ -2008,6 +2025,12 @@ class ServerCore:
         front-end can serve both kinds (Triton semantics).
         """
         model = self.repository.get(request.model_name, request.model_version)
+        # Engine-backed models (client_tpu.llm) hook into the server they
+        # serve under — metrics registry, executor, structured logger —
+        # on first use; one getattr per stream start, idempotent per core.
+        bind = getattr(model, "bind_core", None)
+        if bind is not None:
+            bind(self)
         stats = self._stats_for(model.name)
         ticket = None
         rate_resources = None

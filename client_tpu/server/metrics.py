@@ -282,6 +282,58 @@ class ServerMetrics:
             model,
             registry=registry,
         )
+        # LLM engine families (client_tpu.llm): paged KV-cache occupancy
+        # and continuous-batching behavior. The blocks gauges are the
+        # capacity-admission signal — in_use returning to zero after any
+        # mix of completed/cancelled/expired generations is the engine's
+        # no-leak invariant (asserted in tests/test_llm_engine.py).
+        self.kv_blocks_in_use = Gauge(
+            "tpu_kv_blocks_in_use",
+            "Paged KV-cache blocks currently owned by live sequences.",
+            model,
+            registry=registry,
+        )
+        self.kv_blocks_total = Gauge(
+            "tpu_kv_blocks_total",
+            "Allocatable paged KV-cache blocks in the engine's pool "
+            "(the reserved trash block excluded).",
+            model,
+            registry=registry,
+        )
+        self.llm_active_sequences = Gauge(
+            "tpu_llm_active_sequences",
+            "Sequences in the engine's running decode batch.",
+            model,
+            registry=registry,
+        )
+        self.llm_waiting_sequences = Gauge(
+            "tpu_llm_waiting_sequences",
+            "Sequences queued for admission (cache or batch capacity).",
+            model,
+            registry=registry,
+        )
+        self.llm_step_batch = Histogram(
+            "tpu_llm_step_batch_size",
+            "Sequences decoded per continuous-batching step (each step "
+            "generates one token per member).",
+            model,
+            buckets=BATCH_SIZE_BUCKETS,
+            registry=registry,
+        )
+        self.llm_preemptions = Counter(
+            "tpu_llm_preemptions_total",
+            "Sequences preempted (blocks reclaimed, re-queued) because "
+            "the KV block pool ran dry mid-decode.",
+            model,
+            registry=registry,
+        )
+        self.llm_generated_tokens = Counter(
+            "tpu_llm_generated_tokens_total",
+            "Tokens generated by the LLM engine (prefill first-tokens "
+            "included).",
+            model,
+            registry=registry,
+        )
         self._duty_lock = threading.Lock()
         # First scrape reports utilization since server start — not 0.0
         # (the pre-registry handler's first-scrape blind spot).
@@ -372,6 +424,33 @@ class ServerMetrics:
         extension's queue timings)."""
         for level, depth in depths.items():
             self.queue_depth.labels(model, str(level)).set(depth)
+
+    # -- LLM engine hooks (client_tpu.llm.engine) ---------------------------
+
+    def set_kv_blocks(self, model: str, in_use: int, total: int) -> None:
+        """Publish the paged KV-cache occupancy (the engine calls this on
+        every allocation-state change, not at scrape time, so the gauge
+        is exact the moment a sequence completes or is cancelled)."""
+        self.kv_blocks_in_use.labels(model).set(in_use)
+        self.kv_blocks_total.labels(model).set(total)
+
+    def set_llm_sequences(self, model: str, active: int, waiting: int) -> None:
+        self.llm_active_sequences.labels(model).set(active)
+        self.llm_waiting_sequences.labels(model).set(waiting)
+
+    def observe_llm_step(self, model: str, batch_size: int) -> None:
+        """Book one continuous-batching decode step (per-step batch-size
+        distribution; tokens are booked separately via
+        :meth:`observe_llm_tokens` so cancelled lanes never count)."""
+        self.llm_step_batch.labels(model).observe(batch_size)
+
+    def observe_llm_tokens(self, model: str, count: int = 1) -> None:
+        """Book generated-and-streamed tokens (prefill first tokens and
+        per-step emissions)."""
+        self.llm_generated_tokens.labels(model).inc(count)
+
+    def observe_llm_preemption(self, model: str) -> None:
+        self.llm_preemptions.labels(model).inc()
 
     def pending_inc(self, model: str, count: int = 1) -> None:
         self.pending_requests.labels(model).inc(count)

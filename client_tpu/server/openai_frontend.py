@@ -29,6 +29,29 @@ def _messages_to_prompt(body: Dict[str, Any]) -> str:
     return str(body.get("prompt", ""))
 
 
+# Hard ceiling for the request-body max_tokens field: far above any model
+# this stack serves (max_seq_len <= 4096) but small enough that a client
+# typo (e.g. milliseconds pasted into max_tokens) fails fast with a 400
+# instead of erroring mid-stream after the SSE 200 is committed.
+MAX_TOKENS_CAP = 131072
+
+
+def _invalid_request(message: str, param: str) -> web.Response:
+    """OpenAI-style 400 error body (error.type/param/code, the shape
+    OpenAI SDKs surface to callers)."""
+    return web.json_response(
+        {
+            "error": {
+                "message": message,
+                "type": "invalid_request_error",
+                "param": param,
+                "code": "invalid_value",
+            }
+        },
+        status=400,
+    )
+
+
 class OpenAiFrontend:
     def __init__(self, core, default_model: str = "llm_decode"):
         self.core = core
@@ -43,9 +66,15 @@ class OpenAiFrontend:
         app.router.add_get("/v1/models", wrap(self.handle_models))
 
     async def handle_models(self, request: web.Request) -> web.Response:
+        # Only READY models are listable: an unloaded/UNAVAILABLE entry
+        # in /v1/models would advertise a model whose requests 503 —
+        # OpenAI clients treat the listing as "what I can call now".
+        from client_tpu.server.model_repository import STATE_READY
+
         models = [
             {"id": entry["name"], "object": "model", "owned_by": "client_tpu"}
             for entry in self.core.repository.index()
+            if entry.get("state") == STATE_READY
         ]
         return web.json_response({"object": "list", "data": models})
 
@@ -80,7 +109,30 @@ class OpenAiFrontend:
         model_name = body.get("model") or self.default_model
         prompt = _messages_to_prompt(body)
         prompt_ids = self.tokenizer.encode(prompt) or [2]
-        max_tokens = int(body.get("max_tokens") or 16)
+        # Validate max_tokens BEFORE any work: a non-int, non-positive,
+        # or absurd value must be a clean 400 with an OpenAI-style error
+        # body, never a 500 (or an in-band error after SSE commits).
+        raw_max = body.get("max_tokens", None)
+        if raw_max is None:
+            max_tokens = 16
+        else:
+            if isinstance(raw_max, bool) or not isinstance(raw_max, int):
+                return _invalid_request(
+                    f"max_tokens must be an integer, got "
+                    f"{type(raw_max).__name__}",
+                    "max_tokens",
+                )
+            if raw_max <= 0:
+                return _invalid_request(
+                    f"max_tokens must be a positive integer, got {raw_max}",
+                    "max_tokens",
+                )
+            if raw_max > MAX_TOKENS_CAP:
+                return _invalid_request(
+                    f"max_tokens must be <= {MAX_TOKENS_CAP}, got {raw_max}",
+                    "max_tokens",
+                )
+            max_tokens = raw_max
         stream = bool(body.get("stream", False))
         self._counter += 1
         completion_id = f"chatcmpl-{self._counter}"
@@ -119,6 +171,19 @@ class OpenAiFrontend:
         try:
             iterator = self._decode_stream(model_name, prompt_ids, max_tokens)
             if stream:
+                # Pull the FIRST response before committing the SSE 200:
+                # submit-time rejections (context exceeds the model's
+                # max_seq_len, queue full) surface as real HTTP errors
+                # with their carried status (400/429/...), not in-band
+                # events after a 200. The mid-stream escape hatch below
+                # still covers failures once tokens are flowing.
+                first = None
+                try:
+                    first = await iterator.__anext__()
+                except StopAsyncIteration:
+                    iterator = None
+                except InferenceServerException as e:
+                    return _mapped_error(e)
                 resp = web.StreamResponse(
                     headers={
                         "Content-Type": "text/event-stream",
@@ -128,7 +193,7 @@ class OpenAiFrontend:
                 await resp.prepare(request)
                 count = 0
                 try:
-                    async for core_response in iterator:
+                    async for core_response in _chain(first, iterator):
                         ids = _output_ids(core_response)
                         if ids is None:
                             continue
@@ -184,9 +249,31 @@ class OpenAiFrontend:
             }
             return web.json_response(doc)
         except InferenceServerException as e:
-            return web.json_response(
-                {"error": {"message": e.message()}}, status=400
-            )
+            return _mapped_error(e)
+
+
+def _mapped_error(e: InferenceServerException) -> web.Response:
+    """Error response in the OpenAI body shape but with the exception's
+    carried wire face (429/504/...), including the Retry-After hint the
+    resilience layer honors — mirroring http_server._map_exception."""
+    headers = None
+    retry_after_s = getattr(e, "retry_after_s", None)
+    if retry_after_s:
+        headers = {"Retry-After": str(max(1, int(round(retry_after_s))))}
+    return web.json_response(
+        {"error": {"message": e.message()}},
+        status=getattr(e, "http_status", None) or 400,
+        headers=headers,
+    )
+
+
+async def _chain(first, rest):
+    """Re-attach a prefetched first response to the remaining stream."""
+    if first is not None:
+        yield first
+    if rest is not None:
+        async for response in rest:
+            yield response
 
 
 def _output_ids(core_response):

@@ -75,19 +75,30 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
     stdout gets — it is readable as fixed columns)."""
     lines = [
         "| run | infer/sec | p50 (us) | ratio_vs_inproc | server CPU "
-        "(us/req) | dominant stage | rolling p99 (us) |",
-        "|---|---|---|---|---|---|---|",
+        "(us/req) | dominant stage | rolling p99 (us) | llm tok/s |",
+        "|---|---|---|---|---|---|---|---|",
     ]
     for run in runs:
         parsed = run["parsed"]
         if parsed is None:
-            lines.append(f"| r{run['run']:02d} | (bench failed) | | | | | |")
+            lines.append(
+                f"| r{run['run']:02d} | (bench failed) | | | | | | |"
+            )
             continue
 
         def _num(key: str, fmt: str = "{:.1f}") -> str:
             value = parsed.get(key)
             return fmt.format(value) if isinstance(value, (int, float)) else "-"
 
+        # BENCH_r09+: aggregate streamed tokens/sec of the llm_generate
+        # north-star row (the continuous-batching engine over gRPC)
+        llm = parsed.get("llm_generate")
+        tok_s = (
+            f"{llm['tokens_per_sec']:.1f}"
+            if isinstance(llm, dict)
+            and isinstance(llm.get("tokens_per_sec"), (int, float))
+            else "-"
+        )
         lines.append(
             f"| r{run['run']:02d} "
             f"| {_num('value', '{:.1f}')} "
@@ -95,7 +106,8 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
             f"| {_num('ratio_vs_inproc', '{:.3f}')} "
             f"| {_num('server_cpu_us_per_req', '{:.1f}')} "
             f"| {_dominant_stage(parsed)} "
-            f"| {_num('rolling_30s_p99_us', '{:.1f}')} |"
+            f"| {_num('rolling_30s_p99_us', '{:.1f}')} "
+            f"| {tok_s} |"
         )
     return "\n".join(lines)
 

@@ -24,6 +24,9 @@ from typing import List, Tuple
 
 TARGET_DIRS = (
     os.path.join("client_tpu", "lifecycle"),
+    # the LLM engine's step loop: queue deadlines and preemption timing
+    # run on the injected clock_ns (tests drive them with fake clocks)
+    os.path.join("client_tpu", "llm"),
     os.path.join("client_tpu", "observability"),
     os.path.join("client_tpu", "resilience"),
     os.path.join("client_tpu", "scheduling"),
