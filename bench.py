@@ -362,6 +362,54 @@ def _bench_llm_generate(server) -> dict:
     return result
 
 
+def _bench_sharded() -> dict:
+    """The sharded north-star row (ROADMAP item 1 / BENCH_r10+): the
+    tensor-parallel ``text_encoder_tp`` model over a dp=2 x tp=2 CPU
+    mesh, served through loopback gRPC. JAX's device count is frozen at
+    first backend init — this process already initialized single-device
+    — so the row runs in a subprocess (tools/bench_sharded.py) under
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8``. Never
+    raises; failures degrade to {} so the headline is never lost."""
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools",
+        "bench_sharded.py",
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    try:
+        out = subprocess.run(
+            [sys.executable, script],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=600,
+        )
+        for line in out.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue  # stray non-JSON brace line, keep scanning
+                if "infer_per_sec" not in row and "error" not in row:
+                    continue  # stray structured-log line, not the row
+                if "error" in row:
+                    print(
+                        f"bench: sharded row failed: {row['error']}",
+                        file=sys.stderr,
+                    )
+                    return {}
+                return row
+        print(
+            f"bench: sharded row produced no JSON (rc {out.returncode})",
+            file=sys.stderr,
+        )
+    except Exception as e:  # noqa: BLE001 - row is best-effort
+        print(f"bench: sharded row failed: {e}", file=sys.stderr)
+    return {}
+
+
 def _bench_inprocess(server) -> float:
     """The `simple` tracker row's in-process twin."""
     import numpy as np
@@ -497,6 +545,11 @@ def main() -> int:
             "30s", {}
         )
 
+    # Sharded north-star: runs AFTER the main server closed (its own
+    # subprocess + in-process server; overlapping them would contend for
+    # the host's cores and understate both rows).
+    sharded = {} if os.environ.get("BENCH_NO_SHARDED") else _bench_sharded()
+
     value = round(result["throughput"], 2)
     line = {
         "metric": (
@@ -523,6 +576,8 @@ def main() -> int:
         line["northstar"] = northstar
     if llm_generate:
         line["llm_generate"] = llm_generate
+    if sharded:
+        line["sharded"] = sharded
     # CPU attribution of the client/server split for the headline run
     # (PERF.md explains how this bounds ratio_vs_inproc on few-core hosts).
     count = result.get("count", 0)

@@ -174,6 +174,233 @@ class TextEncoderModel(Model):
         return {"EMBEDDING": pooled[:rows]}
 
 
+class ShardedTextEncoderModel(TextEncoderModel):
+    """Tensor-parallel text encoder over a ``dp x tp`` device mesh.
+
+    The sharded twin of :class:`TextEncoderModel`: same wire contract
+    (INPUT_IDS [-1] INT32 -> EMBEDDING [D]), but ``warmup()`` resolves
+    the declared mesh against ``jax.devices()``, places the parameters
+    per ``bert.param_specs`` (Megatron-style: heads/FFN hidden over
+    ``tp``), and executes through a
+    :class:`~client_tpu.parallel.ShardedExecutor` — batches shard over
+    ``dp``, matmuls shard over ``tp``, and the output gathers back to
+    host for the wire path. Float32 by default so results match the
+    single-device reference to numerical-noise tolerance (bf16 would
+    round differently under the tp reduction split).
+
+    On a host with fewer than ``dp*tp`` devices the model surfaces as
+    repository state UNAVAILABLE with reason
+    ``load failed: mesh requires N devices, host has M``.
+    """
+
+    mesh = {
+        "axes": {"dp": 2, "tp": 2},
+        "inputs": {"INPUT_IDS": ["dp", None]},
+        "outputs": {"EMBEDDING": ["dp", None]},
+    }
+
+    def __init__(self, name: str = "text_encoder_tp", config=None, params=None):
+        import jax.numpy as jnp
+
+        from client_tpu.models import bert
+
+        super().__init__(
+            name=name,
+            config=config or bert.BertConfig.tiny(dtype=jnp.float32),
+            params=params,
+        )
+        self.mesh_plan = None
+        self._executor = None
+
+    def warmup(self) -> None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from client_tpu.models import bert
+        from client_tpu.parallel import ShardedExecutor, plan_for_model
+
+        plan = plan_for_model(self)
+        if self._params is None:
+            self._params = bert.init_params(
+                jax.random.PRNGKey(0), self._config
+            )
+        config = self._config
+        param_shardings = jax.tree.map(
+            lambda spec: NamedSharding(plan.mesh, spec),
+            bert.param_specs(config),
+            is_leaf=lambda x: isinstance(x, PartitionSpec),
+        )
+        params = jax.device_put(self._params, param_shardings)
+        fwd = jax.jit(
+            lambda p, ids: bert.forward(p, ids, config)[1],
+            out_shardings=plan.output_shardings["EMBEDDING"],
+        )
+        executor = ShardedExecutor(
+            plan, lambda arrays: {"EMBEDDING": fwd(params, arrays["INPUT_IDS"])}
+        )
+        # compile the smallest bucket so the first request is fast, and
+        # only publish the plan/executor once it provably executes
+        executor({"INPUT_IDS": np.zeros([1, 8], dtype=np.int32)}, rows=1)
+        self.mesh_plan = plan
+        self._executor = executor
+
+    def execute(self, inputs, parameters):
+        from client_tpu.server.models import pad_batch_bucket
+
+        if "INPUT_IDS" not in inputs:
+            raise InferenceServerException(
+                f"model '{self.name}' expects input INPUT_IDS"
+            )
+        ids = np.asarray(inputs["INPUT_IDS"], dtype=np.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        if ids.shape[1] > self._config.max_seq_len:
+            raise InferenceServerException(
+                f"sequence length {ids.shape[1]} exceeds max "
+                f"{self._config.max_seq_len}"
+            )
+        rows, length = ids.shape
+        row_bucket = pad_batch_bucket(rows)
+        len_bucket = min(
+            pad_batch_bucket(length, minimum=8), self._config.max_seq_len
+        )
+        if (row_bucket, len_bucket) != (rows, length):
+            padded = np.zeros([row_bucket, len_bucket], dtype=np.int32)
+            padded[:rows, :length] = ids
+        else:
+            padded = ids
+        # the executor device_puts onto the dp/tp shardings (padding the
+        # batch dim to the dp extent), runs under the mesh, and gathers +
+        # trims the output back to the true row count
+        out = self._executor({"INPUT_IDS": padded}, rows=rows)
+        return {"EMBEDDING": out["EMBEDDING"]}
+
+
+class RingPrefillLlamaModel(Model):
+    """Long-context llama prefill served through ring attention.
+
+    Proves the :func:`client_tpu.parallel.ring_attention` kernel end to
+    end through the server: INPUT_IDS [-1] INT32 -> LOGITS [vocab] (the
+    last real token's next-token logits). The sequence dimension shards
+    over the mesh's ``sp`` axis, so attention runs as blockwise
+    ring-rotated online softmax (Liu et al., 2023) across devices —
+    the dense single-device prefill is the numerical reference.
+
+    Prompts pad to a power-of-two bucket (divisible by the sp extent);
+    causal attention guarantees the padded tail cannot influence the
+    real last position, whose logits are what this model returns.
+    """
+
+    max_batch_size = 4
+    platform = "jax"
+    backend = "jax"
+    mesh = {
+        "axes": {"dp": 1, "tp": 1, "sp": 2},
+        "inputs": {"INPUT_IDS": [None, "sp"]},
+        "outputs": {"LOGITS": [None, None]},
+    }
+    inputs = [{"name": "INPUT_IDS", "datatype": "INT32", "shape": [-1]}]
+
+    def __init__(self, name: str = "llama_ring", config=None, params=None):
+        import jax.numpy as jnp
+
+        from client_tpu.models import llama
+
+        self.name = name
+        self._config = config or llama.LlamaConfig.tiny(
+            max_seq_len=256, dtype=jnp.float32
+        )
+        self._params = params
+        self.outputs = [
+            {
+                "name": "LOGITS",
+                "datatype": "FP32",
+                "shape": [self._config.vocab_size],
+            }
+        ]
+        self.mesh_plan = None
+        self._executor = None
+
+    def warmup(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from client_tpu.models import llama
+        from client_tpu.parallel import ShardedExecutor, plan_for_model
+
+        plan = plan_for_model(self)
+        if self._params is None:
+            self._params = llama.init_params(
+                jax.random.PRNGKey(0), self._config
+            )
+        config = self._config
+        params = jax.device_put(self._params, plan.replicated())
+
+        def _last_logits(p, tokens, last_index):
+            # mesh with sp > 1 routes attention through ring_attention
+            logits = llama.forward(p, tokens, config, mesh=plan.mesh)
+            return jnp.take(logits, last_index, axis=1)
+
+        fwd = jax.jit(
+            _last_logits, out_shardings=plan.output_shardings["LOGITS"]
+        )
+        executor = ShardedExecutor(
+            plan,
+            lambda arrays: {
+                "LOGITS": fwd(
+                    params, arrays["INPUT_IDS"], arrays["LAST_INDEX"]
+                )
+            },
+        )
+        executor(
+            {
+                "INPUT_IDS": np.zeros([1, 8], dtype=np.int32),
+                "LAST_INDEX": np.int32(7),
+            },
+            rows=1,
+        )
+        self.mesh_plan = plan
+        self._executor = executor
+
+    def execute(self, inputs, parameters):
+        from client_tpu.server.models import pad_batch_bucket
+
+        if "INPUT_IDS" not in inputs:
+            raise InferenceServerException(
+                f"model '{self.name}' expects input INPUT_IDS"
+            )
+        ids = np.asarray(inputs["INPUT_IDS"], dtype=np.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        rows, length = ids.shape
+        if length < 1:
+            # LAST_INDEX would be -1 (a wrapped pad position): reject
+            # instead of returning logits computed at padding
+            raise InferenceServerException(
+                f"model '{self.name}' requires a non-empty prompt"
+            )
+        if length > self._config.max_seq_len:
+            raise InferenceServerException(
+                f"sequence length {length} exceeds max "
+                f"{self._config.max_seq_len}"
+            )
+        # power-of-two bucket: bounds retraces AND is divisible by the
+        # sp extent (max_seq_len is itself a power of two)
+        bucket = min(
+            pad_batch_bucket(length, minimum=8), self._config.max_seq_len
+        )
+        if bucket != length:
+            padded = np.zeros([rows, bucket], dtype=np.int32)
+            padded[:, :length] = ids
+        else:
+            padded = ids
+        out = self._executor(
+            {"INPUT_IDS": padded, "LAST_INDEX": np.int32(length - 1)},
+            rows=rows,
+        )
+        return {"LOGITS": out["LOGITS"]}
+
+
 class LlmDecodeModel(Model):
     """Decoupled LLM decode: INPUT_IDS -> one OUTPUT_IDS token per response.
 
@@ -309,3 +536,10 @@ def register_zoo_models(repository, small: bool = True) -> None:
             else bert.BertConfig()
         )
     )
+    # Sharded serving (client_tpu.parallel): a tensor-parallel encoder
+    # over a dp*tp mesh and a ring-attention long-context prefill over
+    # sp. On a host with too few devices they register UNAVAILABLE with
+    # a "load failed: mesh requires N devices, host has M" reason
+    # instead of blocking startup.
+    repository.add_model(ShardedTextEncoderModel())
+    repository.add_model(RingPrefillLlamaModel())

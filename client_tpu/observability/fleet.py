@@ -172,12 +172,16 @@ def replica_stats(
     delta_count = b["count"] - a["count"]
     if delta_count > 0:
         stats.avg_request_us = (b["sum"] - a["sum"]) / delta_count * 1e6
-    # duty from the monotone busy counter over the window
+    # duty from the monotone busy counter over the window; the family is
+    # labeled per device, so sum and divide by the device count (a
+    # fully-busy 4-device mesh replica reads 1.0, not 4.0)
     busy_a = gauge_values(first.get("tpu_device_compute_ns_total"))
     busy_b = gauge_values(last.get("tpu_device_compute_ns_total"))
     if busy_a and busy_b and window_s > 0:
         stats.duty = min(
-            1.0, max(0.0, busy_b[0] - busy_a[0]) / (window_s * 1e9)
+            1.0,
+            max(0.0, sum(busy_b) - sum(busy_a))
+            / (window_s * 1e9 * max(len(busy_b), 1)),
         )
     # live rolling p99 (preferred: it reflects "now", not the lifetime)
     rolling_match = {"window": rolling_window, "quantile": "0.99"}

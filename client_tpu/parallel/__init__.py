@@ -8,6 +8,11 @@ SPMD machinery lives here:
 - :func:`create_mesh` — build a ``jax.sharding.Mesh`` over dp/tp/sp axes;
 - :mod:`client_tpu.parallel.ring_attention` — ring attention over the
   sequence-parallel axis (long-context prefill);
+- :mod:`client_tpu.parallel.sharding` — the declare-and-validate layer
+  serving models use (``model.mesh`` dict -> :class:`MeshSpec` ->
+  :class:`MeshPlan` with per-tensor ``NamedSharding``\\ s);
+- :mod:`client_tpu.parallel.executor` — :class:`ShardedExecutor`, the
+  device_put/run/gather seam the server executes sharded models through;
 - spec helpers for parameter/activation sharding.
 """
 
@@ -18,6 +23,14 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from client_tpu.parallel.ring_attention import ring_attention  # noqa: F401
+from client_tpu.parallel.sharding import (  # noqa: F401
+    MeshDeclarationError,
+    MeshPlan,
+    MeshSpec,
+    MeshUnavailableError,
+    plan_for_model,
+)
+from client_tpu.parallel.executor import ShardedExecutor  # noqa: F401
 
 DP_AXIS = "dp"  # data parallel (batch)
 TP_AXIS = "tp"  # tensor parallel (heads / hidden)

@@ -1,0 +1,153 @@
+"""ShardedExecutor: the device-placement half of sharded serving.
+
+One instance per loaded sharded model (built in ``warmup()`` next to the
+jit-compiled callable). Per execution it:
+
+1. pads each input's leading (batch) dim to the mesh's divisibility
+   requirement (a batch of 1 on a ``dp=2`` mesh pads to 2 — the padded
+   rows compute garbage the gather step slices back off);
+2. ``jax.device_put``\\ s each input onto its declared ``NamedSharding``
+   (undeclared inputs replicate over the mesh), so the compiled callable
+   never pays an implicit host->device transfer inside the traced
+   program;
+3. runs the jit-compiled sharded callable under the mesh;
+4. gathers the outputs back to host numpy with one batched
+   ``jax.device_get`` (addressable-shard reads) and trims the padding.
+
+Above this seam a sharded model is indistinguishable from a plain one:
+``execute()`` still maps name->ndarray to name->ndarray, so every
+ServerCore execution path (batcher, direct, single-async, decoupled)
+serves it unchanged.
+
+Phase timings (device_put / compute / gather) accumulate on the executor
+— the numbers PERF.md's device_put/gather-cost note and the
+``debug_state()`` devices block report. The clock is injectable
+(``clock_ns``), matching the repo's clock-lint rules for this package.
+"""
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from client_tpu.parallel.sharding import MeshPlan
+
+
+class ShardedExecutor:
+    """Runs ``fn`` (a dict->dict jitted callable) under a resolved
+    :class:`~client_tpu.parallel.sharding.MeshPlan`.
+
+    Parameters
+    ----------
+    plan:
+        The resolved mesh + per-tensor shardings.
+    fn:
+        ``fn(inputs: Dict[str, jax.Array]) -> Dict[str, jax.Array]`` —
+        typically a closure over device-placed params, jit-compiled by
+        the model's ``warmup()``.
+    clock_ns:
+        Injectable monotonic clock (fake-clock tests).
+    """
+
+    def __init__(
+        self,
+        plan: MeshPlan,
+        fn: Callable[[Dict[str, Any]], Dict[str, Any]],
+        clock_ns: Callable[[], int] = time.monotonic_ns,
+    ):
+        self.plan = plan
+        self._fn = fn
+        self._clock_ns = clock_ns
+        self._lock = threading.Lock()
+        self._executions = 0
+        self._device_put_ns = 0
+        self._compute_ns = 0
+        self._gather_ns = 0
+
+    # -- placement ----------------------------------------------------------
+
+    def _place(self, inputs: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """device_put every input onto its declared sharding (replicated
+        when undeclared), padding batch dims to the mesh multiple."""
+        import jax
+
+        plan = self.plan
+        placed: Dict[str, Any] = {}
+        replicated = None
+        for name, array in inputs.items():
+            sharding = plan.input_shardings.get(name)
+            if sharding is None:
+                if replicated is None:
+                    replicated = plan.replicated()
+                sharding = replicated
+            else:
+                multiple = plan.batch_multiple(name)
+                if multiple > 1 and array.shape[0] % multiple:
+                    pad = multiple - array.shape[0] % multiple
+                    array = np.concatenate(
+                        [
+                            array,
+                            np.zeros(
+                                (pad,) + array.shape[1:], dtype=array.dtype
+                            ),
+                        ]
+                    )
+            placed[name] = jax.device_put(array, sharding)
+        return placed
+
+    # -- execution ----------------------------------------------------------
+
+    def __call__(
+        self, inputs: Dict[str, np.ndarray], rows: Optional[int] = None
+    ) -> Dict[str, np.ndarray]:
+        """One sharded execution. ``rows`` (default: the leading dim of
+        the first input) is the true batch size outputs are trimmed to
+        after the gather — padding added by :meth:`_place` never reaches
+        the wire."""
+        import jax
+
+        if rows is None:
+            rows = next(
+                (int(a.shape[0]) for a in inputs.values() if a.ndim), 0
+            )
+        t0 = self._clock_ns()
+        placed = self._place(inputs)
+        t1 = self._clock_ns()
+        with self.plan.mesh:
+            raw = self._fn(placed)
+        raw = jax.block_until_ready(raw)
+        t2 = self._clock_ns()
+        host = jax.device_get(raw)
+        outputs: Dict[str, np.ndarray] = {}
+        for name, value in host.items():
+            array = np.asarray(value)
+            if (
+                rows
+                and array.ndim
+                and name in self.plan.output_shardings
+                and array.shape[0] > rows
+            ):
+                array = array[:rows]
+            outputs[name] = array
+        t3 = self._clock_ns()
+        with self._lock:
+            self._executions += 1
+            self._device_put_ns += t1 - t0
+            self._compute_ns += t2 - t1
+            self._gather_ns += t3 - t2
+        return outputs
+
+    # -- introspection ------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Cumulative phase accounting: how much of the sharded path's
+        wall time is placement vs compute vs readback (the
+        device_put/gather-cost methodology in PERF.md)."""
+        with self._lock:
+            return {
+                "executions": self._executions,
+                "device_put_ns": self._device_put_ns,
+                "compute_ns": self._compute_ns,
+                "gather_ns": self._gather_ns,
+            }

@@ -1,0 +1,261 @@
+"""Declare-and-validate layer for sharded (multi-device) serving.
+
+A repository model opts into multi-device execution by declaring a mesh
+on the class (the serving twin of the ``param_specs`` convention the
+model zoo already follows):
+
+    class MyModel(Model):
+        mesh = {
+            "axes": {"dp": 2, "tp": 2},           # ordered; dp*tp devices
+            "inputs": {"INPUT_IDS": ["dp", None]},  # PartitionSpec per input
+            "outputs": {"EMBEDDING": ["dp", None]},
+        }
+
+At load/warmup time the declaration is parsed into a :class:`MeshSpec`
+(pure validation, no devices touched) and resolved against
+``jax.devices()`` into a :class:`MeshPlan` — a live ``jax.sharding.Mesh``
+plus ``NamedSharding`` per declared input/output. Resolution failures are
+*load* failures with operator-grade reasons ("mesh requires 4 devices,
+host has 1"), surfaced through the repository index per the lifecycle
+semantics (state UNAVAILABLE, reason ``load failed: ...``) instead of a
+500 at first infer.
+
+Spec entries follow ``jax.sharding.PartitionSpec``: each element of an
+input/output spec is ``None`` (replicated dim), an axis name, or a list
+of axis names (a dim sharded over multiple mesh axes).
+"""
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+SpecEntry = Any  # None | str | tuple of str (post-validation)
+
+
+class MeshDeclarationError(ValueError):
+    """The model's ``mesh`` declaration is malformed (a config bug —
+    distinct from :class:`MeshUnavailableError`, which is a property of
+    the host the model landed on)."""
+
+
+class MeshUnavailableError(ValueError):
+    """The declared mesh cannot be built on this host. ``str(exc)`` is
+    the canonical operator-facing reason (``"mesh requires N devices,
+    host has M"``) that rides into the repository index verbatim."""
+
+
+def _validate_spec(
+    name: str, spec: Any, axes: Dict[str, int], kind: str
+) -> Tuple[SpecEntry, ...]:
+    """One input/output PartitionSpec declaration -> normalized tuple."""
+    if not isinstance(spec, (list, tuple)):
+        raise MeshDeclarationError(
+            f"mesh {kind} spec for '{name}' must be a list of dims "
+            f"(got {type(spec).__name__})"
+        )
+    normalized: List[SpecEntry] = []
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            normalized.append(None)
+            continue
+        parts = entry if isinstance(entry, (list, tuple)) else (entry,)
+        for axis in parts:
+            if not isinstance(axis, str) or axis not in axes:
+                raise MeshDeclarationError(
+                    f"mesh {kind} spec for '{name}' dim {dim} names "
+                    f"unknown axis {axis!r} (declared axes: "
+                    f"{sorted(axes)})"
+                )
+        normalized.append(
+            tuple(parts) if isinstance(entry, (list, tuple)) else entry
+        )
+    return tuple(normalized)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """A validated mesh declaration (no devices touched yet).
+
+    ``axes`` preserves declaration order — it becomes the mesh's axis
+    order, so the declaring model controls which axis rides the
+    fastest ICI links (innermost last, per ``create_mesh``'s convention).
+    """
+
+    axes: Tuple[Tuple[str, int], ...]
+    inputs: Dict[str, Tuple[SpecEntry, ...]]
+    outputs: Dict[str, Tuple[SpecEntry, ...]]
+
+    @property
+    def device_count(self) -> int:
+        n = 1
+        for _name, size in self.axes:
+            n *= size
+        return n
+
+    @property
+    def axis_sizes(self) -> Dict[str, int]:
+        return dict(self.axes)
+
+    @staticmethod
+    def parse(declaration: Any) -> "MeshSpec":
+        """Validate a raw ``model.mesh`` dict; raises
+        :class:`MeshDeclarationError` with the first problem found."""
+        if not isinstance(declaration, dict):
+            raise MeshDeclarationError(
+                f"mesh declaration must be a dict, got "
+                f"{type(declaration).__name__}"
+            )
+        axes_raw = declaration.get("axes")
+        if not isinstance(axes_raw, dict) or not axes_raw:
+            raise MeshDeclarationError(
+                "mesh declaration needs a non-empty 'axes' dict "
+                '(e.g. {"axes": {"dp": 2, "tp": 2}})'
+            )
+        axes: List[Tuple[str, int]] = []
+        for name, size in axes_raw.items():
+            if not isinstance(name, str) or not name:
+                raise MeshDeclarationError(
+                    f"mesh axis names must be strings, got {name!r}"
+                )
+            if isinstance(size, bool) or not isinstance(size, int) or size < 1:
+                raise MeshDeclarationError(
+                    f"mesh axis '{name}' size must be a positive int, "
+                    f"got {size!r}"
+                )
+            axes.append((name, size))
+        axis_sizes = dict(axes)
+        unknown = set(declaration) - {"axes", "inputs", "outputs"}
+        if unknown:
+            raise MeshDeclarationError(
+                f"unknown mesh declaration key(s): {sorted(unknown)}"
+            )
+        inputs = {
+            name: _validate_spec(name, spec, axis_sizes, "input")
+            for name, spec in (declaration.get("inputs") or {}).items()
+        }
+        outputs = {
+            name: _validate_spec(name, spec, axis_sizes, "output")
+            for name, spec in (declaration.get("outputs") or {}).items()
+        }
+        return MeshSpec(axes=tuple(axes), inputs=inputs, outputs=outputs)
+
+
+@dataclasses.dataclass
+class MeshPlan:
+    """A :class:`MeshSpec` resolved against live devices: the mesh, the
+    per-tensor ``NamedSharding``s, and the topology description the
+    metadata/debug surfaces serve."""
+
+    spec: MeshSpec
+    mesh: Any  # jax.sharding.Mesh
+    devices: Tuple[Any, ...]  # the jax devices backing the mesh
+    input_shardings: Dict[str, Any]  # name -> NamedSharding
+    output_shardings: Dict[str, Any]
+
+    @property
+    def device_labels(self) -> Tuple[str, ...]:
+        """Stable per-device metric/debug labels (the jax device ids)."""
+        return tuple(str(d.id) for d in self.devices)
+
+    def replicated(self):
+        """NamedSharding replicating a tensor over the whole mesh."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def sharding(self, *spec_entries):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh, PartitionSpec(*spec_entries))
+
+    def batch_multiple(self, name: str) -> int:
+        """The divisibility requirement the executor pads an input's
+        leading (batch) dim to: the product of axis sizes sharding dim 0
+        (1 when dim 0 is replicated or the input is undeclared)."""
+        spec = self.spec.inputs.get(name)
+        if not spec or spec[0] is None:
+            return 1
+        parts = spec[0] if isinstance(spec[0], tuple) else (spec[0],)
+        sizes = self.spec.axis_sizes
+        multiple = 1
+        for axis in parts:
+            multiple *= sizes[axis]
+        return multiple
+
+    def describe(self) -> Dict[str, Any]:
+        """The topology block metadata/debug surfaces serve: axes, the
+        device ids the model occupies, and the declared shardings."""
+
+        def _spec_doc(spec: Tuple[SpecEntry, ...]) -> List[Any]:
+            return [
+                list(entry) if isinstance(entry, tuple) else entry
+                for entry in spec
+            ]
+
+        return {
+            "axes": {name: size for name, size in self.spec.axes},
+            "device_count": len(self.devices),
+            "devices": [d.id for d in self.devices],
+            "inputs": {
+                name: _spec_doc(spec)
+                for name, spec in self.spec.inputs.items()
+            },
+            "outputs": {
+                name: _spec_doc(spec)
+                for name, spec in self.spec.outputs.items()
+            },
+        }
+
+
+def resolve(spec: MeshSpec, devices: Optional[Sequence] = None) -> MeshPlan:
+    """Build the live :class:`MeshPlan` for ``spec`` over ``devices``
+    (default ``jax.devices()``). Raises :class:`MeshUnavailableError`
+    with the canonical reason when the host has too few devices."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    if devices is None:
+        devices = jax.devices()
+    needed = spec.device_count
+    if len(devices) < needed:
+        raise MeshUnavailableError(
+            f"mesh requires {needed} devices, host has {len(devices)}"
+        )
+    used = tuple(devices[:needed])
+    names = tuple(name for name, _size in spec.axes)
+    sizes = tuple(size for _name, size in spec.axes)
+    mesh = Mesh(np.asarray(used).reshape(sizes), names)
+
+    def _sharding(entries: Tuple[SpecEntry, ...]) -> NamedSharding:
+        return NamedSharding(mesh, PartitionSpec(*entries))
+
+    return MeshPlan(
+        spec=spec,
+        mesh=mesh,
+        devices=used,
+        input_shardings={
+            name: _sharding(entries) for name, entries in spec.inputs.items()
+        },
+        output_shardings={
+            name: _sharding(entries) for name, entries in spec.outputs.items()
+        },
+    )
+
+
+def plan_for_model(model, devices: Optional[Sequence] = None) -> Optional[MeshPlan]:
+    """Resolve a repository model's ``mesh`` declaration (None when the
+    model declares none). Raises :class:`InferenceServerException` —
+    which the repository load path records as ``load failed: <reason>``
+    — on a malformed declaration or an unsatisfiable mesh, so a model
+    that cannot execute is UNAVAILABLE at load time, never a 500 at
+    first infer."""
+    declaration = getattr(model, "mesh", None)
+    if declaration is None:
+        return None
+    from client_tpu.utils import InferenceServerException
+
+    try:
+        spec = MeshSpec.parse(declaration)
+        return resolve(spec, devices)
+    except (MeshDeclarationError, MeshUnavailableError) as e:
+        raise InferenceServerException(str(e)) from e

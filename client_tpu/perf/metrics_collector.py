@@ -174,28 +174,50 @@ class MetricsCollector:
         # an unweighted mean of per-interval duties would let a 20 ms
         # bracket interval outvote a 1 s load interval. The overall
         # first->last busy/wall ratio IS the time-weighted mean; the
-        # per-interval series still supplies the peak.
+        # per-interval series still supplies the peak. The counter is
+        # labeled per device (sharded models credit every mesh device);
+        # the aggregate divides by the device count so a fully-busy
+        # 4-device mesh reads 100%, not 400%.
         duties: List[float] = []
         first_busy: Optional[Tuple[int, float]] = None
         prev: Optional[Tuple[int, float]] = None
+        n_devices = 1
+        first_by_device: Dict[str, Tuple[int, float]] = {}
+        last_by_device: Dict[str, Tuple[int, float]] = {}
         for t_ns, families in self.snapshots:
-            busy = gauge_values(families.get("tpu_device_compute_ns_total"))
+            family = families.get("tpu_device_compute_ns_total")
+            busy = gauge_values(family)
             if not busy:
                 continue
+            n_devices = max(n_devices, len(busy))
+            for sample in family.samples:
+                device = sample.labels.get("device", "")
+                if device not in first_by_device:
+                    first_by_device[device] = (t_ns, sample.value)
+                last_by_device[device] = (t_ns, sample.value)
+            total = sum(busy)
             if prev is not None and t_ns > prev[0]:
-                delta = max(0.0, busy[0] - prev[1])
-                duties.append(min(1.0, delta / (t_ns - prev[0])))
+                delta = max(0.0, total - prev[1])
+                duties.append(
+                    min(1.0, delta / ((t_ns - prev[0]) * n_devices))
+                )
             if first_busy is None:
-                first_busy = (t_ns, busy[0])
-            prev = (t_ns, busy[0])
+                first_busy = (t_ns, total)
+            prev = (t_ns, total)
         if duties:
             out.duty_max = max(duties)
             if prev[0] > first_busy[0]:
                 out.duty_avg = min(
                     1.0,
                     max(0.0, prev[1] - first_busy[1])
-                    / (prev[0] - first_busy[0]),
+                    / ((prev[0] - first_busy[0]) * n_devices),
                 )
+            for device, (t0, v0) in first_by_device.items():
+                t1, v1 = last_by_device[device]
+                if t1 > t0:
+                    out.device_duty[device] = min(
+                        1.0, max(0.0, v1 - v0) / (t1 - t0)
+                    )
         else:
             # endpoint without the counter: fall back to the gauge samples
             # (server-computed per-scrape duties; unweighted by necessity)

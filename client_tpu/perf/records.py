@@ -136,9 +136,16 @@ class ServerMetricsSummary:
     scrape_count: int = 0
     scrape_errors: int = 0
     window_s: float = 0.0
-    # TPU duty cycle over the scrape intervals (fractions in [0, 1])
+    # TPU duty cycle over the scrape intervals (fractions in [0, 1]);
+    # multi-device hosts report the per-device mean (each device's own
+    # busy delta over the window)
     duty_avg: float = 0.0
     duty_max: float = 0.0
+    # per-device duty over the run window (device label -> fraction),
+    # from tpu_device_compute_ns_total{device} first->last deltas; >1
+    # entry means a mesh-sharded (or multi-model multi-device) server,
+    # and the spread is the per-chip skew
+    device_duty: Dict[str, float] = dataclasses.field(default_factory=dict)
     # peak sum of tpu_memory_used_bytes across devices (0 = not exported)
     memory_peak_bytes: float = 0.0
     # per-request averages from the server-side histograms (microseconds)

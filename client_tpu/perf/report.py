@@ -172,6 +172,17 @@ def format_server_metrics(summary: ServerMetricsSummary) -> str:
         f"  TPU duty cycle: avg {summary.duty_avg * 100:.1f}%, "
         f"max {summary.duty_max * 100:.1f}%"
     )
+    if len(summary.device_duty) > 1:
+        # per-chip view (mesh-sharded servers): each device's own busy
+        # delta over the window, plus the spread as the skew signal
+        per = ", ".join(
+            f"dev{device}: {duty * 100:.1f}%"
+            for device, duty in sorted(summary.device_duty.items())
+        )
+        values = list(summary.device_duty.values())
+        low, high = min(values), max(values)
+        skew = f" (skew {high / low:.2f}x)" if low > 0 else ""
+        lines.append(f"  Per-device duty: {per}{skew}")
     if summary.memory_peak_bytes:
         lines.append(
             f"  TPU memory: peak {_format_bytes(summary.memory_peak_bytes)} "

@@ -179,6 +179,10 @@ def _model_config(core: ServerCore, request):
         proto.dynamic_batching.SetInParent()
     if "sequence_batching" in cfg:
         proto.sequence_batching.SetInParent()
+    # Free-form config parameters (the "mesh" topology document for
+    # sharded models — the gRPC face of the HTTP metadata devices block).
+    for key, value in cfg.get("parameters", {}).items():
+        proto.parameters[key].string_value = value.get("string_value", "")
     if "ensemble_scheduling" in cfg:
         for step in cfg["ensemble_scheduling"].get("step", []):
             entry = proto.ensemble_scheduling.step.add(

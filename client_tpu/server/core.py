@@ -62,6 +62,12 @@ SERVER_EXTENSIONS = [
     # tpu_rolling_latency_seconds / tpu_slo_* gauge families); advertised
     # by both front-ends' server-metadata responses
     "live_telemetry",
+    # mesh-sharded multi-device execution (client_tpu.parallel): models
+    # declare a mesh + per-tensor shardings, the server resolves and
+    # executes them, topology rides server metadata (HTTP), the model
+    # config parameters map (both protocols), and /v2/debug/state; per-
+    # device busy-ns exports as tpu_device_compute_ns_total{device}
+    "sharding",
 ]
 
 
@@ -823,6 +829,12 @@ class ServerCore:
         # number of concurrent scrapers see one consistent time base.
         self._busy_lock = threading.Lock()
         self._device_busy_ns = 0
+        # per-device split of the same counter: sharded models credit
+        # every device of their mesh, plain models their default device —
+        # the source of tpu_device_compute_ns_total{device} and the
+        # per-chip duty/skew view
+        self._device_busy: Dict[str, int] = {}
+        self._default_device_label: Optional[str] = None
         from client_tpu.server.metrics import ServerMetrics
 
         self.metrics = ServerMetrics(self)
@@ -1141,16 +1153,56 @@ class ServerCore:
     def add_busy_ns(self, model: Model, duration_ns: int) -> None:
         """Credit one device execution's nanoseconds to the busy counter.
         Host-placed models (device == "cpu") never count — they execute on
-        the host and must not report the TPU as busy."""
+        the host and must not report the TPU as busy.
+
+        The same duration also books per device: a sharded model's SPMD
+        program runs on every device of its mesh in lockstep, so each
+        mesh device is credited the execution's wall time; unsharded
+        models credit their (single) default device. This is the one
+        seam all four execution paths already pass through, so per-device
+        accounting needs no per-path wiring."""
         if getattr(model, "device", "") == "cpu":
             return
+        labels = self._device_labels_for(model)
         with self._busy_lock:
             self._device_busy_ns += duration_ns
+            busy = self._device_busy
+            for label in labels:
+                busy[label] = busy.get(label, 0) + duration_ns
+
+    def _device_labels_for(self, model: Model) -> tuple:
+        """The metric labels of the devices this model executes on
+        (cached on the model object; a reload rebuilds it)."""
+        labels = getattr(model, "_ctpu_device_labels", None)
+        if labels is None:
+            plan = getattr(model, "mesh_plan", None)
+            if plan is not None:
+                labels = plan.device_labels
+            else:
+                labels = (self._default_device_label_value(),)
+            model._ctpu_device_labels = labels
+        return labels
+
+    def _default_device_label_value(self) -> str:
+        if self._default_device_label is None:
+            try:
+                import jax
+
+                self._default_device_label = str(jax.devices()[0].id)
+            except Exception:  # noqa: BLE001 - no backend available
+                self._default_device_label = "0"
+        return self._default_device_label
 
     @property
     def device_busy_ns_total(self) -> int:
         with self._busy_lock:
             return self._device_busy_ns
+
+    def device_busy_by_device(self) -> Dict[str, int]:
+        """Cumulative busy nanoseconds per device label (monotone; empty
+        until the first device execution)."""
+        with self._busy_lock:
+            return dict(self._device_busy)
 
     def _batch_meta(self, model: Model) -> _BatchMeta:
         """Per-model batching caches, shared by both batching paths.
@@ -1288,6 +1340,61 @@ class ServerCore:
             result.append(snap)
         return {"model_stats": result}
 
+    # -- device / mesh topology ----------------------------------------------
+
+    def device_topology(self) -> Dict[str, Any]:
+        """The ``devices`` block server metadata and ``debug_state()``
+        serve: host platform + device inventory, and for every loaded
+        model that resolved a mesh, which devices it occupies and how
+        its tensors shard (plus the executor's cumulative
+        device_put/compute/gather accounting when the model exposes
+        one)."""
+        try:
+            import jax
+
+            devices = jax.devices()
+            info: Dict[str, Any] = {
+                "platform": devices[0].platform if devices else "unknown",
+                "device_count": len(devices),
+                "devices": [
+                    {
+                        "id": d.id,
+                        "kind": getattr(d, "device_kind", "") or d.platform,
+                    }
+                    for d in devices
+                ],
+            }
+        except Exception as e:  # noqa: BLE001 - no backend available
+            info = {
+                "platform": "unavailable",
+                "device_count": 0,
+                "devices": [],
+                "error": str(e),
+            }
+        models: Dict[str, Any] = {}
+        for entry in self.repository.index():
+            model = self.repository.peek(entry["name"])
+            if model is None:
+                continue
+            plan = getattr(model, "mesh_plan", None)
+            if plan is not None:
+                doc = plan.describe()
+                executor = getattr(model, "_executor", None)
+                snapshot = getattr(executor, "snapshot", None)
+                if snapshot is not None:
+                    doc["executor"] = snapshot()
+                models[entry["name"]] = doc
+            elif isinstance(getattr(model, "mesh", None), dict):
+                # declared but unresolved (e.g. load failed: mesh
+                # requires N devices) — show what was asked for
+                models[entry["name"]] = {
+                    "axes": dict(model.mesh.get("axes", {})),
+                    "resolved": False,
+                    "reason": entry.get("reason", ""),
+                }
+        info["models"] = models
+        return info
+
     # -- live-state introspection (GET /v2/debug/state) ----------------------
 
     def debug_state(self) -> Dict[str, Any]:
@@ -1314,6 +1421,10 @@ class ServerCore:
                 "ready": self.ready,
             },
             "lifecycle": self.lifecycle.snapshot(),
+            # device inventory + per-model mesh occupancy (which devices
+            # a loaded sharded model runs on, and its executor's
+            # cumulative device_put/compute/gather split)
+            "devices": self.device_topology(),
             "queues": queues,
             "rate_limiter": self.rate_limiter.snapshot(),
             "models": self.repository.index(),

@@ -262,6 +262,11 @@ class HttpServer:
                 "name": SERVER_NAME,
                 "version": SERVER_VERSION,
                 "extensions": SERVER_EXTENSIONS,
+                # device/mesh topology (the "sharding" extension): host
+                # platform + device inventory and every loaded model's
+                # mesh occupancy (gRPC clients read the same document
+                # from the model config's "mesh" parameter)
+                "devices": self.core.device_topology(),
             }
         )
 

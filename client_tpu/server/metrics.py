@@ -21,7 +21,8 @@ tpu_queue_rejected_total             counter    admission rejections {model,reas
 tpu_queue_depth                      gauge      queued requests {model,level}
 tpu_frontend_request_errors          counter    requests rejected pre-core
 tpu_duty_cycle                       gauge      busy-ns counter, scrape delta
-tpu_device_compute_ns_total          counter    ServerCore busy-ns counter
+tpu_device_compute_ns_total          counter    ServerCore busy-ns {device}
+tpu_device_memory_bytes              gauge      jax memory_stats() {device}
 tpu_memory_used_bytes (+limit/util)  gauge      jax device memory_stats()
 tpu_inference_count (+duration_ns,   counter    statistics extension mirror
   fail_count)                                   (pre-registry wire names)
@@ -204,8 +205,19 @@ class ServerMetrics:
         )
         self.device_compute_ns = Counter(
             "tpu_device_compute_ns_total",
-            "Cumulative nanoseconds of device model execution (monotone; "
-            "derive duty cycle from deltas of this counter).",
+            "Cumulative nanoseconds of device model execution, per device "
+            "(monotone; derive per-device duty cycle from deltas). A "
+            "sharded model's SPMD execution credits every device of its "
+            "mesh; unsharded models credit their default device.",
+            ("device",),
+            registry=registry,
+        )
+        self.device_memory = Gauge(
+            "tpu_device_memory_bytes",
+            "Device memory in use per device (jax memory_stats "
+            "bytes_in_use; 0 when the backend reports no accounting, "
+            "e.g. the CPU mesh).",
+            ("device",),
             registry=registry,
         )
         self.memory_used = Gauge(
@@ -496,7 +508,20 @@ class ServerMetrics:
         if now_ns > prev_ns:
             duty = min(1.0, max(0, busy_ns - prev_busy) / (now_ns - prev_ns))
         self.duty_cycle.set(duty)
-        self.device_compute_ns.labels().set(busy_ns)
+        # per-device split of the same monotone counter (sharded models
+        # credit every mesh device); before any device execution the
+        # default device exports 0 so the family always renders
+        by_device = getattr(self.core, "device_busy_by_device", None)
+        per_device = by_device() if callable(by_device) else {}
+        if not per_device:
+            # pre-execution: export the default device's label (the same
+            # one add_busy_ns will credit) so no stale "0" child lingers
+            # on hosts whose first device id is nonzero
+            default = getattr(self.core, "_default_device_label_value", None)
+            label = default() if callable(default) else "0"
+            per_device = {label: busy_ns}
+        for device, ns in per_device.items():
+            self.device_compute_ns.labels(device).set(ns)
         # rolling quantiles + SLO burn gauges reflect the window at
         # scrape time, not the hot path (one O(buckets) merge per model)
         self.telemetry.collect(
@@ -521,6 +546,12 @@ class ServerMetrics:
             used = mstats.get("bytes_in_use")
             limit = mstats.get("bytes_limit") or mstats.get(
                 "bytes_reservable_limit"
+            )
+            # per-device memory family (device-id labels, matching
+            # tpu_device_compute_ns_total): 0 when the backend has no
+            # accounting so every device still reports a sample
+            self.device_memory.labels(str(getattr(device, "id", i))).set(
+                float(used) if used is not None else 0.0
             )
             if used is not None:
                 self.memory_used.labels(str(i)).set(used)

@@ -40,6 +40,24 @@ class ModelUnavailableError(InferenceServerException):
         super().__init__(msg, status="UNAVAILABLE")
 
 
+def _mesh_capacity_failure(exc: Optional[BaseException]) -> bool:
+    """True when a load failure is a mesh-capacity problem ("mesh
+    requires N devices, host has M") anywhere in the cause chain — a
+    property of the host, not a broken model, so it must not degrade
+    whole-server readiness the way corrupt weights do."""
+    try:
+        from client_tpu.parallel.sharding import MeshUnavailableError
+    except Exception:  # noqa: BLE001 - parallel layer optional at import
+        return False
+    seen = set()
+    while exc is not None and id(exc) not in seen:
+        if isinstance(exc, MeshUnavailableError):
+            return True
+        seen.add(id(exc))
+        exc = exc.__cause__
+    return False
+
+
 class Model:
     """Base class for served models.
 
@@ -94,6 +112,16 @@ class Model:
     default_priority_level: int = 0
     queue_policy: Optional[Dict[str, Any]] = None
     rate_limiter: Optional[Dict[str, Any]] = None
+    # Sharded execution (client_tpu.parallel.sharding): a mesh
+    # declaration {"axes": {"dp": 2, "tp": 2}, "inputs": {name: spec},
+    # "outputs": {name: spec}} resolved against jax.devices() at
+    # load/warmup time into a Mesh + per-tensor NamedShardings. Models
+    # that resolve one publish the live plan as ``mesh_plan`` (used by
+    # debug_state()'s devices block and per-device busy accounting). A
+    # host with too few devices surfaces the model as UNAVAILABLE with
+    # reason "load failed: mesh requires N devices, host has M".
+    mesh: Optional[Dict[str, Any]] = None
+    mesh_plan: Optional[Any] = None
 
     def metadata(self) -> Dict[str, Any]:
         return {
@@ -187,6 +215,23 @@ class Model:
                 "step": [dict(s) for s in
                          self.ensemble_scheduling.get("step", [])]
             }
+        if isinstance(self.mesh, dict):
+            # Mesh topology rides the config's parameters map (Triton
+            # ModelParameter wire shape: {"string_value": ...}) so BOTH
+            # protocols expose it — the gRPC ServerMetadataResponse has
+            # no free-form field, the ModelConfig parameters map does.
+            # A resolved plan reports the live topology (device ids
+            # included); an unresolved declaration reports what was
+            # asked for.
+            plan = self.mesh_plan
+            payload = (
+                plan.describe()
+                if plan is not None
+                else {"axes": dict(self.mesh.get("axes", {})), "resolved": False}
+            )
+            config["parameters"] = {
+                "mesh": {"string_value": json.dumps(payload)}
+            }
         return config
 
     def labels(self, output_name: str) -> Optional[List[str]]:
@@ -245,6 +290,10 @@ class ModelRepository:
         # per-name load/unload generation: async unload finalization and
         # batcher eviction only apply when no load() happened in between
         self._epoch: Dict[str, int] = {}
+        # names whose "load failed" is a host-capacity (mesh) problem:
+        # excluded from degraded() so one oversized mesh never pulls the
+        # whole replica out of its load balancer
+        self._capacity_failed: set = set()
         self._lock = threading.Lock()
         self._repository_path = repository_path
 
@@ -252,14 +301,42 @@ class ModelRepository:
         # lock held by caller
         self._state[name] = state
         self._reason[name] = reason
+        if state == STATE_READY:
+            self._capacity_failed.discard(name)
+
+    def _classify_failure(self, name: str, capacity: bool) -> None:
+        # lock held by caller. Membership must track the LATEST failure:
+        # a capacity miss followed by a real load bug (corrupt weights)
+        # must degrade, and vice versa.
+        if capacity:
+            self._capacity_failed.add(name)
+        else:
+            self._capacity_failed.discard(name)
 
     def add_model(self, model: Model, ready: bool = True) -> None:
-        model.warmup()
+        """Register a programmatic model. A warmup failure does NOT
+        raise: the model registers as UNAVAILABLE with reason
+        ``load failed: <why>`` — the same index semantics a failed
+        directory load gets — so one unloadable model (e.g. a sharded
+        model whose mesh needs more devices than the host has) degrades
+        to a clean per-model 503 instead of blocking server startup.
+        A later programmatic ``load()`` re-runs warmup and recovers it."""
+        failure: Optional[str] = None
+        capacity = False
+        try:
+            model.warmup()
+        except Exception as e:  # noqa: BLE001 - surfaced via the index
+            failure = f"load failed: {e}"
+            capacity = _mesh_capacity_failure(e)
         with self._lock:
             self._models[model.name] = model
-            self._set_state(
-                model.name, STATE_READY if ready else STATE_UNAVAILABLE
-            )
+            if failure is not None:
+                self._set_state(model.name, STATE_UNAVAILABLE, failure)
+                self._classify_failure(model.name, capacity)
+            else:
+                self._set_state(
+                    model.name, STATE_READY if ready else STATE_UNAVAILABLE
+                )
             self._epoch[model.name] = self._epoch.get(model.name, 0) + 1
 
     def peek(self, name: str) -> Optional[Model]:
@@ -304,7 +381,10 @@ class ModelRepository:
             for name in self._models:
                 if self._state.get(name) == STATE_LOADING:
                     return True
-                if self._reason.get(name, "").startswith("load failed"):
+                if (
+                    self._reason.get(name, "").startswith("load failed")
+                    and name not in self._capacity_failed
+                ):
                     return True
         return False
 
@@ -359,6 +439,9 @@ class ModelRepository:
                         self._set_state(
                             name, STATE_UNAVAILABLE, f"load failed: {e}"
                         )
+                        self._classify_failure(
+                            name, _mesh_capacity_failure(e)
+                        )
                 raise InferenceServerException(
                     f"failed to load '{name}': {e}"
                 ) from e
@@ -406,6 +489,7 @@ class ModelRepository:
                     self._set_state(
                         name, STATE_UNAVAILABLE, f"load failed: {e}"
                     )
+                    self._classify_failure(name, _mesh_capacity_failure(e))
                 else:
                     # never-loaded name: no registry entry to degrade
                     self._state.pop(name, None)

@@ -157,7 +157,10 @@ TpuMetrics MetricsManager::Typed() {
       if (s.max > out.hbm_utilization.max) out.hbm_utilization = s;
       out.any = true;
     } else if (KeyIs(key, "tpu_device_compute_ns_total")) {
-      out.device_compute_ns_delta = s.max - s.min;
+      // the family is labeled per device since the sharded-serving
+      // change: one map key per {device=...} series, so accumulate the
+      // per-device rises (single-device servers behave as before)
+      out.device_compute_ns_delta += s.max - s.min;
       out.any = true;
     }
   }

@@ -1,0 +1,172 @@
+"""Sharded north-star bench row (the subprocess half of bench.py).
+
+JAX freezes its device count at first backend init, so the bench parent
+process — which initialized on the host's default (single-device)
+platform — cannot build a mesh. bench.py runs this script in a
+subprocess with ``JAX_PLATFORMS=cpu
+XLA_FLAGS=--xla_force_host_platform_device_count=8`` instead; it spins
+an :class:`InProcessServer` serving ONLY the tensor-parallel
+``text_encoder_tp`` model (dp=2 x tp=2 CPU mesh), drives it over
+loopback gRPC, and prints ONE JSON line:
+
+    {"config": ..., "infer_per_sec": ..., "p50_us": ..., "device_count":
+     8, "mesh": {"dp": 2, "tp": 2}, "mesh_devices": 4,
+     "busy_devices": 4, "device_put_us_per_exec": ..., ...}
+
+``busy_devices`` counts mesh devices whose
+``tpu_device_compute_ns_total{device}`` rose during the run — the
+acceptance signal that every chip of the mesh did work. On a platform
+that refuses the forced device count the line is ``{"error": ...}`` and
+bench.py drops the row (the headline is never at risk).
+
+Standalone: ``JAX_PLATFORMS=cpu
+XLA_FLAGS=--xla_force_host_platform_device_count=8 python
+tools/bench_sharded.py``.
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+CONCURRENCY = int(os.environ.get("BENCH_SHARDED_CONCURRENCY", "8"))
+WARMUP_S = float(os.environ.get("BENCH_SHARDED_WARMUP_S", "1"))
+MEASURE_S = float(os.environ.get("BENCH_SHARDED_MEASURE_S", "4"))
+
+
+def _drive(grpc_url: str) -> dict:
+    """Loopback gRPC load at CONCURRENCY; returns throughput + p50/p99."""
+    import numpy as np
+
+    import client_tpu.grpc.aio as grpcclient
+
+    ids = np.arange(1, 25, dtype=np.int32).reshape(1, 24)
+
+    async def run():
+        async with grpcclient.InferenceServerClient(grpc_url) as client:
+            def make_inputs():
+                inp = grpcclient.InferInput("INPUT_IDS", [1, 24], "INT32")
+                inp.set_data_from_numpy(ids)
+                return [inp]
+
+            latencies = []
+            count = 0
+            stop_at = 0.0
+
+            async def worker():
+                nonlocal count
+                inputs = make_inputs()
+                while time.monotonic() < stop_at:
+                    t0 = time.monotonic_ns()
+                    await client.infer("text_encoder_tp", inputs)
+                    t1 = time.monotonic_ns()
+                    if time.monotonic() < stop_at:
+                        latencies.append(t1 - t0)
+                        count += 1
+
+            stop_at = time.monotonic() + WARMUP_S
+            await asyncio.gather(*[worker() for _ in range(CONCURRENCY)])
+            latencies.clear()
+            count = 0
+            start = time.monotonic()
+            stop_at = start + MEASURE_S
+            await asyncio.gather(*[worker() for _ in range(CONCURRENCY)])
+            elapsed = time.monotonic() - start
+            latencies.sort()
+
+            def pct(q):
+                if not latencies:
+                    return 0.0
+                return latencies[
+                    min(len(latencies) - 1, int(q * len(latencies)))
+                ] / 1e3
+
+            return {
+                "infer_per_sec": round(count / elapsed, 2),
+                "p50_us": round(pct(0.50), 1),
+                "p99_us": round(pct(0.99), 1),
+                "count": count,
+            }
+
+    return asyncio.run(run())
+
+
+def main() -> int:
+    import jax
+
+    device_count = jax.device_count()
+    if device_count < 2:
+        print(
+            json.dumps(
+                {
+                    "error": (
+                        f"platform refused a multi-device mesh: "
+                        f"{device_count} device(s) under XLA_FLAGS="
+                        f"{os.environ.get('XLA_FLAGS', '')!r}"
+                    )
+                }
+            )
+        )
+        return 1
+
+    from client_tpu.models.serving import ShardedTextEncoderModel
+    from client_tpu.server.core import ServerCore
+    from client_tpu.server.model_repository import ModelRepository
+    from client_tpu.testing import InProcessServer
+
+    repository = ModelRepository()
+    core = ServerCore(repository)
+    repository.add_model(ShardedTextEncoderModel())
+    entry = {m["name"]: m for m in repository.index()}["text_encoder_tp"]
+    if entry["state"] != "READY":
+        print(json.dumps({"error": f"model not ready: {entry['reason']}"}))
+        return 1
+    model = repository.get("text_encoder_tp")
+    plan = model.mesh_plan
+
+    with InProcessServer(
+        core=core, http=False, builtin_models=False, host="127.0.0.1"
+    ) as server:
+        busy_before = core.device_busy_by_device()
+        row = _drive(server.grpc_url)
+        busy_after = core.device_busy_by_device()
+        executor = model._executor.snapshot()
+
+    mesh_devices = plan.device_labels
+    busy_devices = sum(
+        1
+        for device in mesh_devices
+        if busy_after.get(device, 0) > busy_before.get(device, 0)
+    )
+    executions = max(1, executor["executions"])
+    row.update(
+        {
+            "config": (
+                f"text_encoder_tp (tiny bert fp32, dp=2 x tp=2 CPU mesh), "
+                f"gRPC, concurrency {CONCURRENCY}"
+            ),
+            "device_count": device_count,
+            "mesh": plan.describe()["axes"],
+            "mesh_devices": len(mesh_devices),
+            "busy_devices": busy_devices,
+            # device_put/gather cost per sharded execution (PERF.md
+            # methodology): the placement tax the mesh pays per call
+            "device_put_us_per_exec": round(
+                executor["device_put_ns"] / executions / 1e3, 1
+            ),
+            "gather_us_per_exec": round(
+                executor["gather_ns"] / executions / 1e3, 1
+            ),
+        }
+    )
+    print(json.dumps(row))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

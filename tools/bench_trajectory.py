@@ -75,14 +75,15 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
     stdout gets — it is readable as fixed columns)."""
     lines = [
         "| run | infer/sec | p50 (us) | ratio_vs_inproc | server CPU "
-        "(us/req) | dominant stage | rolling p99 (us) | llm tok/s |",
-        "|---|---|---|---|---|---|---|---|",
+        "(us/req) | dominant stage | rolling p99 (us) | llm tok/s | "
+        "sharded inf/s |",
+        "|---|---|---|---|---|---|---|---|---|",
     ]
     for run in runs:
         parsed = run["parsed"]
         if parsed is None:
             lines.append(
-                f"| r{run['run']:02d} | (bench failed) | | | | | | |"
+                f"| r{run['run']:02d} | (bench failed) | | | | | | | |"
             )
             continue
 
@@ -99,6 +100,15 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
             and isinstance(llm.get("tokens_per_sec"), (int, float))
             else "-"
         )
+        # BENCH_r10+: the sharded north-star row (tools/bench_sharded.py
+        # over a 2+-device CPU mesh in this sandbox)
+        sharded = parsed.get("sharded")
+        sharded_s = (
+            f"{sharded['infer_per_sec']:.1f}"
+            if isinstance(sharded, dict)
+            and isinstance(sharded.get("infer_per_sec"), (int, float))
+            else "-"
+        )
         lines.append(
             f"| r{run['run']:02d} "
             f"| {_num('value', '{:.1f}')} "
@@ -107,7 +117,8 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
             f"| {_num('server_cpu_us_per_req', '{:.1f}')} "
             f"| {_dominant_stage(parsed)} "
             f"| {_num('rolling_30s_p99_us', '{:.1f}')} "
-            f"| {tok_s} |"
+            f"| {tok_s} "
+            f"| {sharded_s} |"
         )
     return "\n".join(lines)
 

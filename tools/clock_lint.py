@@ -28,6 +28,9 @@ TARGET_DIRS = (
     # run on the injected clock_ns (tests drive them with fake clocks)
     os.path.join("client_tpu", "llm"),
     os.path.join("client_tpu", "observability"),
+    # the sharded executor's device_put/compute/gather phase accounting
+    # reads its injected clock_ns only
+    os.path.join("client_tpu", "parallel"),
     os.path.join("client_tpu", "resilience"),
     os.path.join("client_tpu", "scheduling"),
 )

@@ -16,7 +16,11 @@ the live-telemetry SLO/rolling-window gauges):
   non-base-unit time suffix (``_ns``/``_us``/``_ms``, bare or before
   ``_total``);
 - fraction-valued names (``_utilization``/``_cycle``/``_fraction``/
-  ``_percent`` endings) must end in ``_ratio`` instead.
+  ``_percent`` endings) must end in ``_ratio`` instead;
+- label names are lowercase snake_case, and the per-device dimension is
+  spelled ``device`` — not ``dev``/``device_id``/``chip``/``core_id`` —
+  so every per-device family (``tpu_device_compute_ns_total``,
+  ``tpu_device_memory_bytes``, the memory gauges) joins on one label.
 
 ``GRANDFATHERED`` freezes the pre-lint wire names (Triton-parity and
 pre-registry mirrors that existing scrape configs depend on). The set is
@@ -66,6 +70,30 @@ _UNITLESS_TIME_SUFFIXES = ("_duration", "_latency", "_time")
 _NON_BASE_TIME = ("_ns", "_us", "_ms", "_ns_total", "_us_total", "_ms_total")
 # dimensionless-fraction endings that should be _ratio
 _FRACTION_SUFFIXES = ("_utilization", "_cycle", "_fraction", "_percent")
+
+# label-name conventions: lowercase snake_case, and one canonical
+# spelling for the per-device dimension
+_LABEL_PATTERN = re.compile(r"^[a-z][a-z0-9_]*$")
+_DEVICE_LABEL_ALIASES = frozenset(
+    {"dev", "device_id", "device_index", "chip", "chip_id", "core_id"}
+)
+
+
+def check_labels(name: str, labels: List[str]) -> List[str]:
+    """Convention findings for one family's label names."""
+    problems = []
+    for label in labels:
+        if not _LABEL_PATTERN.match(label):
+            problems.append(
+                f"family '{name}' label '{label}' must be lowercase "
+                "snake_case"
+            )
+        if label in _DEVICE_LABEL_ALIASES:
+            problems.append(
+                f"family '{name}' label '{label}' must be spelled "
+                "'device' (one per-device join key across families)"
+            )
+    return problems
 
 
 def _repo_root() -> str:
@@ -131,6 +159,19 @@ def check_source(source: str, filename: str) -> List[Tuple[int, str]]:
             continue
         for message in check_family(first.value, ctor):
             findings.append((node.lineno, message))
+        # label names: the third positional argument when it is a
+        # literal tuple/list of strings (the registry's labels arg)
+        if len(node.args) >= 3 and isinstance(
+            node.args[2], (ast.Tuple, ast.List)
+        ):
+            labels = [
+                elt.value
+                for elt in node.args[2].elts
+                if isinstance(elt, ast.Constant)
+                and isinstance(elt.value, str)
+            ]
+            for message in check_labels(first.value, labels):
+                findings.append((node.lineno, message))
     return findings
 
 
