@@ -119,6 +119,7 @@ class InferenceServerClient(InferenceServerClientBase):
         urls=None,
         endpoint_cooldown_s: float = 1.0,
         logger=None,
+        stream_mode: bool = False,
     ):
         """``url`` may be a single ``host:port``, a comma list, or an
         :class:`~client_tpu.lifecycle.EndpointPool`; ``urls=[...]`` names
@@ -126,9 +127,22 @@ class InferenceServerClient(InferenceServerClientBase):
         unary RPCs target a sticky primary and fail over — immediately,
         no backoff sleep — when an endpoint answers UNAVAILABLE or the
         connection dies; recovering endpoints must pass a ``ServerReady``
-        probe first. Streams bind to the endpoint current at open."""
+        probe first. Streams bind to the endpoint current at open.
+
+        ``stream_mode=True`` routes every unary :meth:`infer` over one
+        long-lived multiplexed ``ModelStreamInfer`` stream (correlation
+        ids, concurrent server-side execution), amortizing per-RPC setup
+        — the small-request fast path. With a ``retry_policy`` the
+        stream reconnects on UNAVAILABLE (PR-1 stream machinery).
+        Requests carrying explicit ``request_id`` must keep them unique
+        while in flight."""
         super().__init__()
         self._verbose = verbose
+        self._stream_mode = stream_mode
+        self._mux = None
+        import threading as _threading
+
+        self._mux_init_lock = _threading.Lock()
         self._pool = EndpointPool.resolve(
             url, urls, cooldown_s=endpoint_cooldown_s, logger=logger
         )
@@ -321,9 +335,55 @@ class InferenceServerClient(InferenceServerClientBase):
             description=f"gRPC {name}",
         )
 
+    def _mux_infer(self, request, client_timeout, trace, idempotent=True):
+        """One multiplexed-stream infer under the retry/breaker rules,
+        with per-request endpoint-pool telemetry."""
+        if self._mux is None:
+            from client_tpu.grpc._mux import SyncStreamMultiplexer
+
+            # double-checked under a lock: two threads' first infers
+            # must not each open (and one leak) a stream
+            with self._mux_init_lock:
+                if self._mux is None:
+                    self._mux = SyncStreamMultiplexer(self)
+        mux = self._mux
+        pool = self._pool
+
+        def _send(attempt_timeout):
+            mux._ensure_open()
+            endpoint = mux.endpoint
+            started = pool.begin(endpoint)
+            try:
+                value = mux.infer(request, client_timeout=attempt_timeout)
+            except InferenceServerException as e:
+                pool.finish(endpoint, started, ok=False)
+                if status_is_unavailable(e.status()):
+                    pool.observe(endpoint, token=e.status())
+                    if pool.has_alternative(endpoint):
+                        e.retry_backoff_cap_s = 0.0
+                raise
+            except BaseException:
+                pool.finish(endpoint, started, ok=False)
+                raise
+            pool.finish(endpoint, started, ok=True)
+            pool.observe(endpoint, ok=True)
+            return value
+
+        return run_with_resilience(
+            trace.wrap_attempt(_send),
+            retry_policy=self._retry_policy,
+            circuit_breaker=self._circuit_breaker,
+            budget_s=client_timeout,
+            idempotent=idempotent,
+            description="gRPC mux ModelInfer",
+        )
+
     def close(self) -> None:
         """Close every endpoint channel (stops any active stream first)."""
         self.stop_stream()
+        if self._mux is not None:
+            mux, self._mux = self._mux, None
+            mux.close()
         for channel in self._channels.values():
             channel.close()
 
@@ -692,6 +752,27 @@ class InferenceServerClient(InferenceServerClientBase):
                     timeout=timeout,
                     parameters=parameters,
                 )
+            if (
+                self._stream_mode
+                and headers is None
+                and compression_algorithm is None
+                # a sampled traceparent must ride per-request metadata,
+                # which the long-lived stream cannot carry: traced
+                # requests take the unary path so W3C propagation works
+                and not trace.traceparent
+            ):
+                # persistent multiplexed stream: amortizes per-RPC setup;
+                # per-request headers/compression need the unary path
+                response = self._mux_infer(
+                    request,
+                    client_timeout,
+                    trace,
+                    idempotent=sequence_is_idempotent(sequence_id),
+                )
+                with trace.stage("deserialize"):
+                    result = InferResult(response)
+                trace.finish()
+                return result
             if trace.traceparent:
                 headers = {
                     **(headers or {}),
@@ -755,6 +836,30 @@ class InferenceServerClient(InferenceServerClientBase):
         trace = start_trace(
             self._tracer, "infer", surface="grpc", model=request.model_name
         )
+        if (
+            self._stream_mode
+            and headers is None
+            and compression_algorithm is None
+            and not trace.traceparent
+        ):
+            # prepared requests are shared/reused: the mux mutates the
+            # correlation id, so send a clone
+            clone = service_pb2.ModelInferRequest()
+            clone.CopyFrom(request)
+            try:
+                response = self._mux_infer(
+                    clone,
+                    client_timeout,
+                    trace,
+                    idempotent=not _is_sequence_request(request),
+                )
+                with trace.stage("deserialize"):
+                    result = InferResult(response)
+            except BaseException as e:
+                trace.finish(error=e)
+                raise
+            trace.finish()
+            return result
         if trace.traceparent:
             headers = {
                 **(headers or {}),

@@ -179,14 +179,15 @@ class InferStream:
         for rid in lost:
             label = f"request '{rid}'" if rid else "a request"
             try:
-                self._callback(
-                    None,
-                    InferenceServerException(
-                        f"{label} was in flight when the stream "
-                        "disconnected; it was not retried",
-                        status="StatusCode.UNAVAILABLE",
-                    ),
+                error = InferenceServerException(
+                    f"{label} was in flight when the stream "
+                    "disconnected; it was not retried",
+                    status="StatusCode.UNAVAILABLE",
                 )
+                # correlation hook for multiplexed-unary consumers
+                # (client_tpu.grpc._mux): which request this error kills
+                error.request_id = rid
+                self._callback(None, error)
             except Exception:  # noqa: BLE001 - user callback raised
                 if self._verbose:
                     print(f"stream callback raised while failing {label}")
@@ -232,10 +233,13 @@ class InferStream:
                             f"{response.error_message or 'ok'}"
                         )
                     if response.error_message:
-                        self._callback(
-                            None,
-                            InferenceServerException(response.error_message),
+                        error = InferenceServerException(
+                            response.error_message
                         )
+                        # in-band errors echo the request id (when the
+                        # client sent one): carry it for mux correlation
+                        error.request_id = response.infer_response.id
+                        self._callback(None, error)
                     else:
                         self._callback(
                             InferResult(response.infer_response), None

@@ -1,0 +1,499 @@
+"""Persistent multiplexed inference streams (client side).
+
+Unary ``infer()`` over gRPC pays per-RPC machinery — method resolution,
+header blocks, a fresh HTTP/2 stream, a completion queue round-trip —
+per request. Stream mode (``InferenceServerClient(stream_mode=True)``)
+amortizes all of it: every unary infer rides ONE long-lived
+``ModelStreamInfer`` bidi stream as a message pair, correlated by
+request id. The server executes multiplexed requests concurrently (the
+``multiplex`` request parameter opts each request out of the stream's
+in-order guarantee) and responses resolve per-request futures as they
+arrive, in any order.
+
+* :class:`AioStreamMultiplexer` — asyncio clients. Requests are
+  serialized by the protobuf-free builder in
+  :mod:`client_tpu.grpc._wire` (head + tensor-metadata blocks are
+  memoized per signature, so the steady state appends raw tensor bytes
+  to cached templates); shapes the fast builder declines fall back to
+  the proto request builder.
+* :class:`SyncStreamMultiplexer` — blocking clients, built on
+  :class:`~client_tpu.grpc._infer_stream.InferStream`, which brings the
+  PR-1 reconnect machinery: a stream torn down with UNAVAILABLE reopens
+  under the client's retry policy, in-flight requests surface as
+  retryable errors (never silently replayed), and queued-unsent
+  requests carry over.
+
+Request ids: callers may pass their own ``request_id`` (must be unique
+among in-flight requests); otherwise the mux stamps ``mx<N>``.
+"""
+
+import asyncio
+import threading
+from typing import Any, Dict, Optional
+
+import grpc
+
+from client_tpu.grpc._generated import grpc_service_pb2 as pb
+from client_tpu.grpc._utils import (
+    get_inference_request,
+    rpc_error_to_exception,
+)
+from client_tpu.utils import InferenceServerException
+
+_STREAM_METHOD = "/inference.GRPCInferenceService/ModelStreamInfer"
+
+# bounded like the server codec's template caches
+_CACHE_MAX = 256
+
+
+def _derive_status(message: str) -> Optional[str]:
+    """Status for an in-band stream error. The wire frame carries only
+    the message text — without a derived status, a drain rejection or
+    queue-full that is RETRYABLE on the unary path (gRPC UNAVAILABLE /
+    RESOURCE_EXHAUSTED) would be terminal under stream mode and never
+    trigger pool failover. Mirrors the server's message patterns
+    (server._grpc_codec.status_code_for) for the retry-relevant codes."""
+    lowered = message.lower()
+    if "queue" in lowered and "full" in lowered:
+        return "StatusCode.RESOURCE_EXHAUSTED"
+    if "timed out in queue" in lowered:
+        return "StatusCode.DEADLINE_EXCEEDED"
+    if (
+        "not ready" in lowered
+        or "unavailable" in lowered
+        or "draining" in lowered
+        or "not accepting new inference" in lowered
+    ):
+        return "StatusCode.UNAVAILABLE"
+    return None
+
+
+def _inband_error(message: str) -> InferenceServerException:
+    return InferenceServerException(message, status=_derive_status(message))
+
+
+class _FastRequestBuilder:
+    """Protobuf-free ModelInferRequest serializer with memoized
+    head/metadata blocks (the client mirror of the server's encode
+    templates). ``build`` returns None for shapes it does not cover —
+    the caller falls back to the proto builder."""
+
+    __slots__ = ("_wire", "_head_cache", "_meta_cache")
+
+    def __init__(self):
+        from client_tpu.grpc import _wire
+
+        self._wire = _wire
+        self._head_cache: Dict[Any, bytes] = {}
+        self._meta_cache: Dict[Any, bytes] = {}
+
+    def build(
+        self,
+        model_name: str,
+        inputs,
+        model_version: str,
+        request_id: str,
+        outputs,
+        parameters: Optional[Dict[str, Any]],
+    ) -> Optional[bytes]:
+        wire = self._wire
+        raws = []
+        sig = []
+        for inp in inputs:
+            raw = inp._get_raw_content()
+            if raw is None:
+                return None  # shared-memory/typed-contents input
+            raws.append(raw)
+            sig.append((inp.name(), inp.datatype(), tuple(inp.shape())))
+        out_names = ()
+        if outputs:
+            for out in outputs:
+                tensor = out._get_tensor()
+                if tensor.parameters:
+                    return None  # classification / shm-ref outputs
+            out_names = tuple(out._get_tensor().name for out in outputs)
+        head_key = (model_name, model_version)
+        head = self._head_cache.get(head_key)
+        if head is None:
+            if len(self._head_cache) >= _CACHE_MAX:
+                self._head_cache.clear()
+            head = self._head_cache[head_key] = wire.encode_head(*head_key)
+        meta_key = (tuple(sig), out_names)
+        meta = self._meta_cache.get(meta_key)
+        if meta is None:
+            if len(self._meta_cache) >= _CACHE_MAX:
+                self._meta_cache.clear()
+            meta = self._meta_cache[meta_key] = wire.encode_input_meta_block(
+                sig, out_names
+            )
+        buf = bytearray(head)
+        if request_id:
+            rid = request_id.encode("utf-8")
+            buf.append(0x1A)
+            wire.write_varint(buf, len(rid))
+            buf += rid
+        if parameters:
+            wire._encode_params_map(buf, 0x22, parameters)
+        buf += meta
+        for raw in raws:
+            buf.append(0x3A)
+            wire.write_varint(buf, len(raw))
+            buf += raw
+        return bytes(buf)
+
+
+def _proto_request_bytes(
+    model_name,
+    inputs,
+    model_version,
+    request_id,
+    outputs,
+    parameters,
+    priority,
+    timeout,
+    sequence_id,
+    sequence_start,
+    sequence_end,
+) -> bytes:
+    """Fallback: proto request builder + the mux correlation fields."""
+    request = get_inference_request(
+        model_name,
+        inputs,
+        model_version=model_version,
+        request_id=request_id,
+        outputs=outputs,
+        sequence_id=sequence_id,
+        sequence_start=sequence_start,
+        sequence_end=sequence_end,
+        priority=priority,
+        timeout=timeout,
+        parameters=parameters,
+    )
+    request.parameters["multiplex"].bool_param = True
+    return request.SerializeToString()
+
+
+class AioStreamMultiplexer:
+    """One long-lived bidi stream multiplexing unary infers (asyncio).
+
+    Opened lazily on first ``infer``; a dead stream (UNAVAILABLE, server
+    restart) fails its in-flight futures with a retryable error and the
+    next ``infer`` opens a fresh stream — combined with the client's
+    retry policy this is reconnect-on-UNAVAILABLE at the request level.
+    """
+
+    def __init__(self, client):
+        self._client = client
+        self._builder = _FastRequestBuilder()
+        self._call = None
+        self._reader: Optional[asyncio.Task] = None
+        self._pending: Dict[str, asyncio.Future] = {}
+        self._counter = 0
+        self._write_lock = asyncio.Lock()
+        self._methods: Dict[str, Any] = {}
+        self.endpoint = None  # pool endpoint pinned at open
+
+    # -- stream lifecycle ----------------------------------------------------
+
+    def _method_for(self, url: str):
+        method = self._methods.get(url)
+        if method is None:
+            channel = self._client._channel_for(url)
+            method = self._methods[url] = channel.stream_stream(
+                _STREAM_METHOD,
+                request_serializer=None,
+                response_deserializer=pb.ModelStreamInferResponse.FromString,
+            )
+        return method
+
+    def _ensure_open(self) -> None:
+        if self._call is not None:
+            return
+        endpoint = self._client._pool.pick()
+        self.endpoint = endpoint
+        call = self._method_for(endpoint.url)(
+            metadata=self._client._metadata(None)
+        )
+        self._call = call
+        self._reader = asyncio.ensure_future(self._read_loop(call))
+
+    async def _read_loop(self, call) -> None:
+        try:
+            while True:
+                response = await call.read()
+                if response is grpc.aio.EOF:
+                    self._fail_pending(
+                        InferenceServerException(
+                            "multiplexed stream closed by the server",
+                            status="StatusCode.UNAVAILABLE",
+                        )
+                    )
+                    return
+                inner = response.infer_response
+                if response.error_message and not inner.id:
+                    # an error the server could not correlate (the bytes
+                    # never decoded): no single waiter owns it — fail
+                    # everything retryably rather than hang one forever
+                    self._fail_pending(_inband_error(response.error_message))
+                    continue
+                future = self._pending.pop(inner.id, None)
+                if future is None or future.done():
+                    continue
+                if response.error_message:
+                    future.set_exception(
+                        _inband_error(response.error_message)
+                    )
+                else:
+                    future.set_result(inner)
+        except asyncio.CancelledError:
+            self._fail_pending(
+                InferenceServerException(
+                    "multiplexed stream closed",
+                    status="StatusCode.CANCELLED",
+                )
+            )
+            raise
+        except grpc.RpcError as e:
+            self._fail_pending(rpc_error_to_exception(e))
+        except Exception as e:  # noqa: BLE001 - surface to waiters
+            self._fail_pending(InferenceServerException(str(e)))
+        finally:
+            if self._call is call:
+                self._call = None
+                self._reader = None
+
+    def _fail_pending(self, error: InferenceServerException) -> None:
+        pending, self._pending = self._pending, {}
+        for future in pending.values():
+            if not future.done():
+                future.set_exception(error)
+
+    # -- request path --------------------------------------------------------
+
+    def next_id(self) -> str:
+        self._counter += 1
+        return f"mx{self._counter}"
+
+    async def infer(
+        self,
+        model_name: str = "",
+        inputs=(),
+        model_version: str = "",
+        request_id: str = "",
+        outputs=None,
+        parameters: Optional[Dict[str, Any]] = None,
+        priority: int = 0,
+        timeout: Optional[int] = None,
+        sequence_id=0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+        client_timeout: Optional[float] = None,
+        prepared_request=None,
+    ) -> pb.ModelInferResponse:
+        if prepared_request is not None:
+            # prepared requests are shared/reused: serialize a clone so
+            # the correlation id never races concurrent senders
+            clone = pb.ModelInferRequest()
+            clone.CopyFrom(prepared_request)
+            rid = clone.id or self.next_id()
+            clone.id = rid
+            clone.parameters["multiplex"].bool_param = True
+            return await self._send(
+                rid, clone.SerializeToString(), client_timeout
+            )
+        rid = request_id or self.next_id()
+        data = None
+        if not sequence_id and priority == 0 and timeout is None:
+            params = {"multiplex": True}
+            if parameters:
+                params.update(parameters)
+                params["multiplex"] = True
+            data = self._builder.build(
+                model_name, inputs, model_version, rid, outputs, params
+            )
+        if data is None:
+            data = _proto_request_bytes(
+                model_name,
+                inputs,
+                model_version,
+                rid,
+                outputs,
+                parameters,
+                priority,
+                timeout,
+                sequence_id,
+                sequence_start,
+                sequence_end,
+            )
+        return await self._send(rid, data, client_timeout)
+
+    async def _send(
+        self, rid: str, data: bytes, client_timeout: Optional[float]
+    ) -> pb.ModelInferResponse:
+        self._ensure_open()
+        call = self._call
+        future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = future
+        try:
+            async with self._write_lock:
+                await call.write(data)
+        except BaseException as e:
+            self._pending.pop(rid, None)
+            if isinstance(e, grpc.RpcError):
+                raise rpc_error_to_exception(e) from None
+            raise
+        try:
+            if client_timeout is not None:
+                return await asyncio.wait_for(future, client_timeout)
+            return await future
+        except asyncio.TimeoutError:
+            self._pending.pop(rid, None)
+            raise InferenceServerException(
+                f"timeout waiting for multiplexed response to '{rid}'"
+            ) from None
+
+    async def close(self) -> None:
+        call, self._call = self._call, None
+        reader, self._reader = self._reader, None
+        if call is not None:
+            call.cancel()
+        if reader is not None:
+            reader.cancel()
+            try:
+                await reader
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        self._fail_pending(
+            InferenceServerException(
+                "multiplexed stream closed",
+                status="StatusCode.CANCELLED",
+            )
+        )
+
+
+class _Slot:
+    __slots__ = ("event", "response", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.response = None
+        self.error: Optional[Exception] = None
+
+
+class SyncStreamMultiplexer:
+    """One long-lived bidi stream multiplexing unary infers (blocking).
+
+    Built on :class:`InferStream`, so the PR-1 resilience applies: with
+    a client retry policy, an UNAVAILABLE teardown reconnects with
+    backoff, surfacing in-flight requests as retryable errors.
+    """
+
+    def __init__(self, client):
+        self._client = client
+        self._lock = threading.Lock()
+        self._pending: Dict[str, _Slot] = {}
+        self._counter = 0
+        self._stream = None
+        self.endpoint = None
+
+    def _open_call(self, request_iterator, timeout=None):
+        endpoint = self._client._pool.pick()
+        self.endpoint = endpoint
+        return self._client._stub_for(endpoint.url).ModelStreamInfer(
+            request_iterator,
+            metadata=self._client._metadata(None),
+            timeout=timeout,
+        )
+
+    def _ensure_open(self) -> None:
+        from client_tpu.grpc._infer_stream import InferStream
+
+        with self._lock:
+            if self._stream is not None and self._stream.is_active():
+                return
+            stream = InferStream(
+                self._on_response,
+                retry_policy=self._client._retry_policy,
+            )
+            stream.init_handler(
+                self._open_call(stream.request_iterator),
+                reconnect=self._open_call,
+            )
+            self._stream = stream
+
+    def _on_response(self, result, error) -> None:
+        if error is not None:
+            if error.status() is None:
+                # in-band frames carry only message text: restore the
+                # retry-relevant status so resilience/failover still work
+                derived = _derive_status(error.message())
+                if derived is not None:
+                    restored = InferenceServerException(
+                        error.message(), status=derived
+                    )
+                    restored.request_id = getattr(error, "request_id", "")
+                    error = restored
+            rid = getattr(error, "request_id", "") or ""
+            if rid:
+                with self._lock:
+                    slot = self._pending.pop(rid, None)
+                slots = [slot] if slot is not None else []
+            else:
+                # stream-level failure with no id: every waiter fails
+                with self._lock:
+                    slots = list(self._pending.values())
+                    self._pending.clear()
+            for slot in slots:
+                slot.error = error
+                slot.event.set()
+            return
+        response = result.get_response()
+        with self._lock:
+            slot = self._pending.pop(response.id, None)
+        if slot is not None:
+            slot.response = response
+            slot.event.set()
+
+    def infer(self, request, client_timeout: Optional[float] = None):
+        """Send one prepared ModelInferRequest over the stream and block
+        for its correlated response. Mutates ``request.id`` (when empty)
+        and stamps the ``multiplex`` parameter."""
+        self._ensure_open()
+        slot = _Slot()
+        with self._lock:
+            if not request.id:
+                self._counter += 1
+                request.id = f"mx{self._counter}"
+            request.parameters["multiplex"].bool_param = True
+            self._pending[request.id] = slot
+        try:
+            self._stream.enqueue_request(request)
+        except BaseException:
+            with self._lock:
+                self._pending.pop(request.id, None)
+            raise
+        deadline = client_timeout if client_timeout is not None else 3600.0
+        if not slot.event.wait(deadline):
+            with self._lock:
+                self._pending.pop(request.id, None)
+            raise InferenceServerException(
+                f"timeout waiting for multiplexed response to "
+                f"'{request.id}'"
+            )
+        if slot.error is not None:
+            raise slot.error
+        return slot.response
+
+    def close(self) -> None:
+        with self._lock:
+            stream, self._stream = self._stream, None
+            slots = list(self._pending.values())
+            self._pending.clear()
+        if stream is not None:
+            stream.close(cancel_requests=True)
+        error = InferenceServerException(
+            "multiplexed stream closed", status="StatusCode.CANCELLED"
+        )
+        for slot in slots:
+            slot.error = error
+            slot.event.set()

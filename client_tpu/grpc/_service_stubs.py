@@ -129,6 +129,15 @@ for _name in _METHODS:
 
 
 def add_GRPCInferenceServiceServicer_to_server(servicer, server):
+    """Register a servicer.
+
+    A servicer that sets ``raw_infer_bytes = True`` receives the two
+    inference methods (ModelInfer / ModelStreamInfer) as RAW serialized
+    bytes and must return serialized response bytes — the protobuf-free
+    wire fast path (client_tpu.grpc._wire). Every other method keeps the
+    proto (de)serializers.
+    """
+    raw_infer = bool(getattr(servicer, "raw_infer_bytes", False))
     handlers = {}
     for name, (kind, req, resp) in _METHODS.items():
         make = (
@@ -136,11 +145,18 @@ def add_GRPCInferenceServiceServicer_to_server(servicer, server):
             if kind == "uu"
             else grpc.stream_stream_rpc_method_handler
         )
-        handlers[name] = make(
-            getattr(servicer, name),
-            request_deserializer=req.FromString,
-            response_serializer=resp.SerializeToString,
-        )
+        if raw_infer and name in ("ModelInfer", "ModelStreamInfer"):
+            handlers[name] = make(
+                getattr(servicer, name),
+                request_deserializer=None,
+                response_serializer=None,
+            )
+        else:
+            handlers[name] = make(
+                getattr(servicer, name),
+                request_deserializer=req.FromString,
+                response_serializer=resp.SerializeToString,
+            )
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(_SERVICE, handlers),)
     )

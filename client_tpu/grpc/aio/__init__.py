@@ -74,6 +74,7 @@ class InferenceServerClient(InferenceServerClientBase):
         urls=None,
         endpoint_cooldown_s: float = 1.0,
         logger=None,
+        stream_mode: bool = False,
     ):
         """``url`` may be a single ``host:port``, a comma list, or an
         :class:`~client_tpu.lifecycle.EndpointPool`; ``urls=[...]`` names
@@ -82,9 +83,17 @@ class InferenceServerClient(InferenceServerClientBase):
         no backoff sleep — when an endpoint answers UNAVAILABLE or the
         connection dies; recovering endpoints must pass a ``ServerReady``
         probe first. ``stream_infer`` binds to the endpoint current at
-        stream open."""
+        stream open.
+
+        ``stream_mode=True`` routes every unary :meth:`infer` over one
+        long-lived multiplexed ``ModelStreamInfer`` stream (correlation
+        ids, concurrent server-side execution), amortizing per-RPC setup
+        — the small-request fast path. Requests with explicit
+        ``request_id`` must keep them unique while in flight."""
         super().__init__()
         self._verbose = verbose
+        self._stream_mode = stream_mode
+        self._mux = None
         self._pool = EndpointPool.resolve(
             url, urls, cooldown_s=endpoint_cooldown_s, logger=logger
         )
@@ -272,7 +281,58 @@ class InferenceServerClient(InferenceServerClientBase):
             description=f"gRPC {name}",
         )
 
+    async def _mux_infer(
+        self,
+        trace,
+        client_timeout,
+        idempotent: bool,
+        **kwargs,
+    ):
+        """One multiplexed-stream infer under the retry/breaker rules,
+        with per-request endpoint-pool telemetry (the stream pins its
+        endpoint at open; every request brackets it)."""
+        if self._mux is None:
+            from client_tpu.grpc._mux import AioStreamMultiplexer
+
+            self._mux = AioStreamMultiplexer(self)
+        mux = self._mux
+        pool = self._pool
+
+        async def _send(attempt_timeout):
+            mux._ensure_open()
+            endpoint = mux.endpoint
+            started = pool.begin(endpoint)
+            try:
+                value = await mux.infer(
+                    client_timeout=attempt_timeout, **kwargs
+                )
+            except InferenceServerException as e:
+                pool.finish(endpoint, started, ok=False)
+                if status_is_unavailable(e.status()):
+                    pool.observe(endpoint, token=e.status())
+                    if pool.has_alternative(endpoint):
+                        e.retry_backoff_cap_s = 0.0
+                raise
+            except BaseException:
+                pool.finish(endpoint, started, ok=False)
+                raise
+            pool.finish(endpoint, started, ok=True)
+            pool.observe(endpoint, ok=True)
+            return value
+
+        return await run_with_resilience_async(
+            trace.wrap_attempt_async(_send),
+            retry_policy=self._retry_policy,
+            circuit_breaker=self._circuit_breaker,
+            budget_s=client_timeout,
+            idempotent=idempotent,
+            description="gRPC mux ModelInfer",
+        )
+
     async def close(self) -> None:
+        if self._mux is not None:
+            mux, self._mux = self._mux, None
+            await mux.close()
         for channel in self._channels.values():
             await channel.close()
 
@@ -556,6 +616,29 @@ class InferenceServerClient(InferenceServerClientBase):
         trace = start_trace(
             self._tracer, "infer", surface="grpc", model=request.model_name
         )
+        if (
+            self._stream_mode
+            and headers is None
+            and compression_algorithm is None
+            # a sampled traceparent must ride per-request metadata, which
+            # the long-lived stream cannot carry: traced requests take
+            # the unary path so W3C propagation keeps working
+            and not trace.traceparent
+        ):
+            try:
+                response = await self._mux_infer(
+                    trace,
+                    client_timeout,
+                    not _is_sequence_request(request),
+                    prepared_request=request,
+                )
+                with trace.stage("deserialize"):
+                    result = InferResult(response)
+            except BaseException as e:
+                trace.finish(error=e)
+                raise
+            trace.finish()
+            return result
         if trace.traceparent:
             headers = {
                 **(headers or {}),
@@ -599,6 +682,42 @@ class InferenceServerClient(InferenceServerClientBase):
         trace = start_trace(
             self._tracer, "infer", surface="grpc", model=model_name
         )
+        if (
+            self._stream_mode
+            and headers is None
+            and compression_algorithm is None
+            # a sampled traceparent must ride per-request metadata, which
+            # the long-lived stream cannot carry: traced requests take
+            # the unary path so W3C propagation keeps working
+            and not trace.traceparent
+        ):
+            # persistent multiplexed stream: serialization happens inside
+            # the mux (protobuf-free builder); per-request headers and
+            # compression need the unary path
+            try:
+                response = await self._mux_infer(
+                    trace,
+                    client_timeout,
+                    sequence_is_idempotent(sequence_id),
+                    model_name=model_name,
+                    inputs=inputs,
+                    model_version=model_version,
+                    request_id=request_id,
+                    outputs=outputs,
+                    parameters=parameters,
+                    priority=priority,
+                    timeout=timeout,
+                    sequence_id=sequence_id,
+                    sequence_start=sequence_start,
+                    sequence_end=sequence_end,
+                )
+                with trace.stage("deserialize"):
+                    result = InferResult(response)
+            except BaseException as e:
+                trace.finish(error=e)
+                raise
+            trace.finish()
+            return result
         try:
             with trace.stage("serialize"):
                 request = get_inference_request(

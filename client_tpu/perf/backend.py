@@ -334,16 +334,19 @@ class GrpcPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
         circuit_breaker=None,
         tracer=None,
         logger=None,
+        stream_mode: bool = False,
     ):
         from client_tpu.grpc import aio as grpcclient
 
         self._mod = grpcclient
+        self._stream_mode = stream_mode
         self._client = grpcclient.InferenceServerClient(
             url,
             retry_policy=retry_policy,
             circuit_breaker=circuit_breaker,
             tracer=tracer,
             logger=logger,
+            stream_mode=stream_mode,
         )
         self._init_prepared()
 
@@ -408,7 +411,10 @@ class GrpcPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
         timeout_us=None,
         cache_token=None,
     ):
-        if cache_token is not None:
+        if cache_token is not None and not self._stream_mode:
+            # stream mode skips the prepared-proto cache: the mux's
+            # protobuf-free builder memoizes templates itself, and a
+            # shared prepared proto would race the per-send correlation id
             request = self._get_or_build_prepared(
                 cache_token,
                 lambda: self._client.prepare_request(

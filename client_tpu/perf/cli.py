@@ -155,6 +155,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="name:d1,d2,... override for dynamic input shapes",
     )
     parser.add_argument("--streaming", action="store_true")
+    parser.add_argument(
+        "--stream-mode",
+        action="store_true",
+        help="push unary infers over one persistent multiplexed "
+        "ModelStreamInfer stream (gRPC only): correlation ids, "
+        "concurrent server-side execution, per-RPC setup amortized",
+    )
     parser.add_argument("--sequence-length", type=int, default=0)
     parser.add_argument("--num-of-sequences", type=int, default=4)
     parser.add_argument("-f", "--filename", default=None, help="CSV output")
@@ -514,6 +521,15 @@ async def run(args) -> int:
             backend_kwargs["tracer"] = tracer
         if run_logger is not None:
             backend_kwargs["logger"] = run_logger
+        if args.stream_mode:
+            if args.protocol != "grpc":
+                print(
+                    "error: --stream-mode needs the gRPC protocol "
+                    "(-i grpc)",
+                    file=sys.stderr,
+                )
+                return 2
+            backend_kwargs["stream_mode"] = True
         backend = create_backend(args.protocol, args.url, **backend_kwargs)
     if args.streaming and not backend.supports_streaming:
         if args.service_kind in ("tfserving", "torchserve"):

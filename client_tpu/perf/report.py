@@ -289,6 +289,36 @@ def format_wire_gap(
     return "\n".join(lines)
 
 
+def format_shm_delta(
+    shm_infer_per_sec: float,
+    native_infer_per_sec: float,
+    tensor_bytes: int = 0,
+    label: str = "shm",
+) -> str:
+    """The shm-vs-inline verdict as a named number.
+
+    BENCH_r05 buried an inversion (tpu-shm slower than inline gRPC at
+    small tensor sizes) in an unlabeled JSON field for four rounds; this
+    renders the delta explicitly and FLAGS the loss, so a shm path that
+    stops paying for itself is a headline, not an easter egg.
+    """
+    if shm_infer_per_sec <= 0 or native_infer_per_sec <= 0:
+        return ""
+    ratio = shm_infer_per_sec / native_infer_per_sec
+    delta_pct = (ratio - 1.0) * 100.0
+    size = f" at {tensor_bytes} B/tensor" if tensor_bytes else ""
+    line = (
+        f"{label} vs inline wire{size}: {shm_infer_per_sec:.0f} vs "
+        f"{native_infer_per_sec:.0f} infer/sec ({delta_pct:+.1f}%)"
+    )
+    if ratio < 1.0:
+        line += (
+            f"  ** {label.upper()} LOSES at this tensor size — the "
+            "copy savings do not cover its per-request overhead **"
+        )
+    return line
+
+
 def format_client_metrics(
     snapshot: Optional[Dict[str, Any]],
     endpoints: Optional[Dict[str, Any]] = None,

@@ -447,3 +447,314 @@ def handle_method_bytes(core: ServerCore, method: str, payload: bytes) -> bytes:
     except Exception as e:  # noqa: BLE001 - malformed wire bytes
         raise RpcError(GRPC_INTERNAL, f"failed to parse {method} request: {e}")
     return handle_method(core, method, request).SerializeToString()
+
+
+# -- protobuf-free ModelInfer fast path ---------------------------------------
+#
+# The common small-request shape (raw tensor contents, no per-tensor
+# parameters, no typed contents) decodes and encodes through the
+# hand-rolled wire scanner in client_tpu.grpc._wire — no protobuf objects
+# on the hot path. Anything else falls back to the proto codec above, so
+# the wire contract never forks; parity is guarded byte-exactly by the
+# corpus in tests/test_shm_ring.py.
+
+
+class ScratchBuffer:
+    """A reusable, *bounded* bytearray for wire encoding.
+
+    One oversized response must not pin its peak for the connection's
+    lifetime: after an encode that grew the buffer past ``cap_bytes``
+    the buffer is released and a fresh default-sized one allocated on
+    next use (satellite: bounded per-connection scratch)."""
+
+    DEFAULT_BYTES = 1 << 16
+
+    __slots__ = ("cap_bytes", "_buf", "high_water")
+
+    def __init__(self, cap_bytes: int = 4 << 20):
+        self.cap_bytes = cap_bytes
+        self._buf = bytearray()
+        self.high_water = 0
+
+    def take(self) -> bytearray:
+        """The cleared scratch (hold only across one synchronous encode)."""
+        buf = self._buf
+        del buf[:]
+        return buf
+
+    def seal(self, buf: bytearray) -> bytes:
+        """Snapshot the encoded bytes and apply the shrink policy."""
+        data = bytes(buf)
+        if len(buf) > self.high_water:
+            self.high_water = len(buf)
+        if len(buf) > self.cap_bytes:
+            self._buf = bytearray()
+        return data
+
+    @property
+    def capacity(self) -> int:
+        """Currently retained backing capacity (for the bound test)."""
+        return len(self._buf) if self._buf is not None else 0
+
+
+class FastInferCodec:
+    """Per-front-end ModelInfer codec: fast path + proto fallback.
+
+    One instance per servicer/pump (its scratch is reused across
+    requests and must not be shared across threads). Books
+    ``tpu_codec_fastpath_total{outcome}`` per decode and falls back to
+    the proto codec for any shape the scanner declines.
+    """
+
+    _CACHE_MAX = 512  # bounded like the scanner's prefix cache
+
+    def __init__(self, core: ServerCore, scratch_cap_bytes: int = 4 << 20):
+        import numpy as np
+
+        from client_tpu.grpc import _wire
+        from client_tpu.server import core as core_mod
+        from client_tpu.utils import (
+            deserialize_bytes_tensor,
+            num_elements,
+            serialize_byte_tensor,
+            triton_to_np_dtype,
+        )
+
+        self._wire = _wire
+        self._np = np
+        self._CoreRequest = core_mod.CoreRequest
+        self._CoreTensor = core_mod.CoreTensor
+        self._CoreRequestedOutput = core_mod.CoreRequestedOutput
+        self._deserialize_bytes = deserialize_bytes_tensor
+        self._serialize_bytes = serialize_byte_tensor
+        self._num_elements = num_elements
+        self._triton_to_np = triton_to_np_dtype
+        self.core = core
+        self.scratch = ScratchBuffer(scratch_cap_bytes)
+        self._scanner = _wire.RequestScanner()
+        self._metrics = core.metrics
+        # encode-side templates: serialized fields 1-2 per (model,
+        # version) and the concatenated outputs-meta block per tensor
+        # signature — responses under load share both
+        self._head_cache: Dict[Tuple[str, str], bytes] = {}
+        self._meta_cache: Dict[Any, bytes] = {}
+
+    # -- decode --------------------------------------------------------------
+
+    def _prepare(self, template):
+        """Per-template decode plan, computed once per cached prefix:
+        (name, datatype, shape, np dtype or None-for-BYTES or
+        False-for-unknown, expected bytes) per input, plus the shared
+        CoreRequestedOutput objects (read-only downstream)."""
+        plan = []
+        for name, datatype, shape in template.inputs:
+            if datatype == "BYTES":
+                plan.append((name, datatype, shape, None, -1))
+                continue
+            np_dtype = self._triton_to_np(datatype)
+            if np_dtype is None:
+                plan.append((name, datatype, shape, False, 0))
+            else:
+                expected = self._num_elements(shape) * np_dtype.itemsize
+                plan.append((name, datatype, shape, np_dtype, expected))
+        outputs = [
+            self._CoreRequestedOutput(name=n) for n in template.output_names
+        ]
+        template.prepared = (plan, outputs)
+        return template.prepared
+
+    def decode_request(self, data):
+        """Serialized ModelInferRequest bytes -> CoreRequest, or None
+        when the request is outside the fast shape (caller parses with
+        the proto codec). Raises InferenceServerException on invalid
+        tensor framing (same messages as ``ServerCore.decode_input``)."""
+        wire = self._wire
+        try:
+            scanned = self._scanner.scan(data)
+        except wire.WireError:
+            scanned = None
+        if scanned is None:
+            self._metrics.observe_codec("fallback")
+            return None
+        template, request_id, extra_params, raws = scanned
+        self._metrics.observe_codec("hit")
+        prepared = template.prepared
+        if prepared is None:
+            prepared = self._prepare(template)
+        plan, req_outputs = prepared
+        n_raw = len(raws)
+        if n_raw != len(plan):
+            if n_raw < len(plan):
+                raise InferenceServerException(
+                    f"input '{plan[n_raw][0]}' has no data (inline, JSON, "
+                    "or shared memory)"
+                )
+            raise InferenceServerException(
+                f"raw_input_contents has {n_raw} entries but only "
+                f"{len(plan)} non-shared-memory inputs consumed them"
+            )
+        np = self._np
+        CoreTensor = self._CoreTensor
+        inputs = []
+        for i, (name, datatype, shape, np_dtype, expected) in enumerate(plan):
+            raw = raws[i]
+            if np_dtype is None:
+                arr = self._deserialize_bytes(bytes(raw)).reshape(shape)
+            elif np_dtype is False:
+                raise InferenceServerException(
+                    f"unsupported datatype '{datatype}' for input '{name}'"
+                )
+            else:
+                if len(raw) != expected:
+                    raise InferenceServerException(
+                        f"input '{name}' expected {expected} bytes for "
+                        f"shape {shape} and datatype {datatype}, got "
+                        f"{len(raw)}"
+                    )
+                arr = np.frombuffer(raw, dtype=np_dtype).reshape(shape)
+            inputs.append(CoreTensor(name, datatype, shape, arr))
+        # the template is shared across requests: copy before the
+        # ring/scheduling layers pop entries out of it; excised
+        # per-request params (ring slot/seq) merge back in
+        if template.parameters:
+            parameters = dict(template.parameters)
+            if extra_params:
+                parameters.update(extra_params)
+        else:
+            parameters = dict(extra_params) if extra_params else {}
+        return self._CoreRequest(
+            model_name=template.model_name,
+            model_version=template.model_version,
+            id=request_id,
+            inputs=inputs,
+            outputs=list(req_outputs) if req_outputs else [],
+            parameters=parameters,
+        )
+
+    # -- encode --------------------------------------------------------------
+
+    def _response_parts(self, core_response):
+        """CoreResponse -> (outputs meta, raw contents) for the wire
+        builder; mirrors build_proto_response field for field."""
+        import numpy as np
+
+        from client_tpu.utils import serialize_byte_tensor
+
+        outputs = []
+        raws = []
+        shm_outputs = core_response.shm_outputs
+        for t in core_response.outputs:
+            shm = shm_outputs.get(t.name)
+            if shm is not None:
+                region, size, offset = shm
+                params = {
+                    "shared_memory_region": region,
+                    "shared_memory_byte_size": int(size),
+                }
+                if offset:
+                    params["shared_memory_offset"] = int(offset)
+                outputs.append((t.name, t.datatype, t.shape, params))
+                raws.append(b"")
+            elif t.datatype == "BYTES":
+                outputs.append((t.name, t.datatype, t.shape, None))
+                raws.append(serialize_byte_tensor(t.data).tobytes())
+            else:
+                data = t.data
+                if type(data) is not np.ndarray or not data.flags.c_contiguous:
+                    data = np.ascontiguousarray(data)
+                outputs.append((t.name, t.datatype, t.shape, None))
+                raws.append(data.data.cast("B") if data.ndim else data.tobytes())
+        return outputs, raws
+
+    def encode_response(self, core_response) -> bytes:
+        """CoreResponse -> serialized ModelInferResponse bytes (never
+        fails over the wire: shapes the hand encoder declines fall back
+        to the proto builder)."""
+        np = self._np
+        serialize_byte_tensor = self._serialize_bytes
+        wire = self._wire
+        try:
+            if core_response.shm_outputs:
+                # per-output parameter maps: rare path, build in full
+                buf = self.scratch.take()
+                outputs, raws = self._response_parts(core_response)
+                wire.encode_infer_response(
+                    buf,
+                    core_response.model_name,
+                    core_response.model_version,
+                    core_response.id,
+                    core_response.parameters,
+                    outputs,
+                    raws,
+                )
+                return self.scratch.seal(buf)
+            buf = self.scratch.take()
+            head_key = (core_response.model_name, core_response.model_version)
+            head = self._head_cache.get(head_key)
+            if head is None:
+                if len(self._head_cache) >= self._CACHE_MAX:
+                    self._head_cache.clear()
+                head = self._head_cache[head_key] = wire.encode_head(
+                    *head_key
+                )
+            buf += head
+            if core_response.id:
+                rid = core_response.id.encode("utf-8")
+                buf.append(0x1A)
+                wire.write_varint(buf, len(rid))
+                buf += rid
+            if core_response.parameters:
+                wire._encode_params_map(buf, 0x22, core_response.parameters)
+            tensors = core_response.outputs
+            meta_key = tuple(
+                (t.name, t.datatype, tuple(t.shape)) for t in tensors
+            )
+            meta = self._meta_cache.get(meta_key)
+            if meta is None:
+                if len(self._meta_cache) >= self._CACHE_MAX:
+                    self._meta_cache.clear()
+                meta = self._meta_cache[meta_key] = (
+                    wire.encode_output_meta_block(meta_key)
+                )
+            buf += meta
+            for t in tensors:
+                if t.datatype == "BYTES":
+                    raw = serialize_byte_tensor(t.data).tobytes()
+                else:
+                    data = t.data
+                    if (
+                        type(data) is not np.ndarray
+                        or not data.flags.c_contiguous
+                    ):
+                        data = np.ascontiguousarray(data)
+                    raw = (
+                        data.data.cast("B") if data.ndim else data.tobytes()
+                    )
+                buf.append(0x32)
+                wire.write_varint(buf, len(raw))
+                buf += raw
+            return self.scratch.seal(buf)
+        except Exception:  # noqa: BLE001 - parity net: proto must agree
+            self._metrics.observe_codec("encode_fallback")
+            from client_tpu.server.grpc_server import build_proto_response
+
+            return build_proto_response(core_response).SerializeToString()
+
+    def encode_stream_response(self, core_response) -> bytes:
+        """CoreResponse -> serialized ModelStreamInferResponse bytes."""
+        body = self.encode_response(core_response)
+        buf = self.scratch.take()
+        self._wire.encode_stream_response(buf, body)
+        return self.scratch.seal(buf)
+
+    def encode_stream_error(self, message: str, request_id: str = "") -> bytes:
+        """In-band stream error frame (error_message + id-only response)."""
+        inner = bytearray()
+        self._wire.encode_infer_response(
+            inner, "", "", request_id, None, (), ()
+        )
+        buf = self.scratch.take()
+        self._wire.encode_stream_response(
+            buf, bytes(inner), error_message=message
+        )
+        return self.scratch.seal(buf)

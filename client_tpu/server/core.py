@@ -105,6 +105,10 @@ class CoreRequest:
     # in monotonic ns (None = no deadline)
     priority_level: int = 0
     deadline_ns: Optional[int] = None
+    # shm-ring ticket (server.shm_ring.RingTicket) attached by the
+    # front-end when the request sourced its inputs from a ring slot;
+    # the front-end routes the response back through ticket.complete()
+    shm_ring: Optional[Any] = None
 
 
 def _trace_id_of(request) -> str:
@@ -838,6 +842,11 @@ class ServerCore:
         from client_tpu.server.metrics import ServerMetrics
 
         self.metrics = ServerMetrics(self)
+        # Fixed-layout shm rings over registered regions (server.shm_ring):
+        # validated lazily per registration, cached per region object.
+        from client_tpu.server.shm_ring import RingRegistry
+
+        self.shm_rings = RingRegistry(self.shm, metrics=self.metrics)
         # Per-stage thread-CPU accounting (observability.profiling):
         # default-off; while disabled every stage event is one attribute
         # check. Enabled via POST /v2/debug/profiling (the perf

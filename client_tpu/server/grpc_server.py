@@ -22,6 +22,7 @@ from client_tpu.grpc._service_stubs import (
     add_GRPCInferenceServiceServicer_to_server,
 )
 from client_tpu.server import _grpc_codec as codec
+from client_tpu.server import shm_ring
 from client_tpu.server.core import (
     CoreRequest,
     CoreRequestedOutput,
@@ -173,10 +174,25 @@ def _delegated(method_name: str):
     return handler
 
 
+class _ChaosAbort(Exception):
+    """Internal marker: a drawn chaos fate must abort the stream."""
+
+
 class _Servicer(GRPCInferenceServiceServicer):
+    # Inference methods are registered with identity (de)serializers:
+    # handlers get serialized bytes and return serialized bytes, so the
+    # protobuf-free fast codec can skip proto objects on the hot path.
+    raw_infer_bytes = True
+
+    # Bounds frames buffered between the per-request executors and the
+    # stream writer: a slow-reading client back-pressures the tasks
+    # instead of growing server memory.
+    _STREAM_QUEUE_FRAMES = 128
+
     def __init__(self, core: ServerCore, chaos=None):
         self.core = core
         self.chaos = chaos
+        self.codec = codec.FastInferCodec(core)
 
     async def _chaos_gate(self, context, method: str) -> None:
         """Fault injection (ChaosPolicy): added latency plus injected
@@ -195,144 +211,310 @@ class _Servicer(GRPCInferenceServiceServicer):
 
     # -- inference -----------------------------------------------------------
 
-    def _begin_trace(self, context, request):
+    def _begin_trace(self, context, core_request):
         """Trace sampling + W3C traceparent extraction from the call
         metadata (the gRPC face of the HTTP header)."""
         metadata = dict(context.invocation_metadata() or ())
         return self.core.trace_manager.begin(
-            request.model_name,
-            model_version=request.model_version,
+            core_request.model_name,
+            model_version=core_request.model_version,
             traceparent=metadata.get("traceparent"),
-            request_id=request.id,
+            request_id=core_request.id,
         )
 
-    async def ModelInfer(self, request, context):
+    def _decode_infer(self, data: bytes) -> CoreRequest:
+        """Serialized ModelInferRequest -> CoreRequest: protobuf-free
+        fast path first, proto codec for anything it declines. Resolves
+        shm-ring parameters (inputs then view the ring slot)."""
+        core_request = self.codec.decode_request(data)
+        if core_request is None:
+            try:
+                request = pb.ModelInferRequest.FromString(data)
+            except Exception as e:  # noqa: BLE001 - malformed wire bytes
+                raise InferenceServerException(
+                    f"failed to parse ModelInferRequest: {e}"
+                ) from None
+            core_request = build_core_request(self.core, request)
+        shm_ring.attach(self.core, core_request)
+        return core_request
+
+    def _encode_infer(self, core_request, core_response) -> bytes:
+        """CoreResponse -> serialized ModelInferResponse bytes; ring
+        responses divert their tensors into the slot first (part of the
+        encode stage: it replaces wire serialization)."""
+        if core_request.shm_ring is not None:
+            core_response = core_request.shm_ring.complete(core_response)
+        return self.codec.encode_response(core_response)
+
+    async def ModelInfer(self, data, context):
         await self._chaos_gate(context, "ModelInfer")
-        trace = self._begin_trace(context, request)
-        prof = self.core.profiling
+        core = self.core
+        prof = core.profiling
         # one take() covers this request's decode AND encode brackets
         measured = prof.take()
+        trace = None
+        core_request = None
         try:
-            # drain fast path: UNAVAILABLE before paying decode cost
-            # (outside the inner try: a drain rejection is booked on its
-            # own counter, not as a malformed-request frontend error)
-            self.core.reject_if_draining(request.model_name)
             try:
                 if measured:
                     decode_cpu0 = prof.cpu_now()
-                    core_request = build_core_request(self.core, request)
+                    core_request = self._decode_infer(data)
                     prof.account(
                         "frontend_decode", prof.cpu_now() - decode_cpu0
                     )
                 else:
-                    core_request = build_core_request(self.core, request)
+                    core_request = self._decode_infer(data)
             except InferenceServerException:
                 # rejected before reaching the engine: the statistics
                 # extension never sees it, the front-end counter does
                 # (same family the HTTP front-end books, protocol label
                 # apart — the shared registry keeps both faces consistent)
-                self.core.metrics.observe_frontend_error("grpc")
+                core.metrics.observe_frontend_error("grpc")
                 raise
+            # drain-aware rejection books on its own counter, after the
+            # (now cheap) decode told us the model name
+            core.reject_if_draining(core_request.model_name)
+            trace = self._begin_trace(context, core_request)
             core_request.trace = trace
-            core_response = await self.core.infer(core_request)
+            core_response = await core.infer(core_request)
+            # encode inside the try: a ring pack failure (slot too small
+            # for the response) must map to a clean gRPC error, never an
+            # unhandled exception after the handler "succeeded"
+            if measured:
+                encode_cpu0 = prof.cpu_now()
+                payload = self._encode_infer(core_request, core_response)
+                prof.account("encode", prof.cpu_now() - encode_cpu0)
+            else:
+                payload = self._encode_infer(core_request, core_response)
         except InferenceServerException as e:
+            if core_request is not None and core_request.shm_ring is not None:
+                core_request.shm_ring.fail()
             if trace is not None:
                 trace.end(error=e.message())
-            log = self.core.logger
+            log = core.logger
             if log.verbose_hot:
                 log.verbose(
                     "request",
-                    model=request.model_name,
+                    model=core_request.model_name if core_request else "",
                     protocol="grpc",
                     status="error",
                     error=e.message(),
                 )
             await context.abort(_status_for(e.message(), e), e.message())
         except BaseException as e:
+            if core_request is not None and core_request.shm_ring is not None:
+                core_request.shm_ring.fail()
             if trace is not None:
                 trace.end(error=str(e))
             raise
         if trace is not None:
             trace.end()
-        log = self.core.logger
+        log = core.logger
         if log.verbose_hot:
             log.verbose(
                 "request",
-                model=request.model_name,
+                model=core_request.model_name,
                 protocol="grpc",
                 status="ok",
-                request_id=request.id,
+                request_id=core_request.id,
             )
-        if measured:
-            encode_cpu0 = prof.cpu_now()
-            response = build_proto_response(core_response)
-            prof.account("encode", prof.cpu_now() - encode_cpu0)
-            return response
-        return build_proto_response(core_response)
+        return payload
 
     async def ModelStreamInfer(self, request_iterator, context):
-        async for request in request_iterator:
-            # an injected fault aborts the whole stream with UNAVAILABLE
-            # (connection-loss semantics), not a per-request error reply
-            await self._chaos_gate(context, "ModelStreamInfer")
-            trace = self._begin_trace(context, request)
-            prof = self.core.profiling
-            try:
-                # drain-aware: rejected stream requests surface as clean
-                # in-band errors, never cancelled streams
-                self.core.reject_if_draining(request.model_name)
-                try:
-                    if prof.take():
-                        decode_cpu0 = prof.cpu_now()
-                        core_request = build_core_request(self.core, request)
-                        prof.account(
-                            "frontend_decode", prof.cpu_now() - decode_cpu0
-                        )
-                    else:
-                        core_request = build_core_request(self.core, request)
-                except InferenceServerException:
-                    self.core.metrics.observe_frontend_error("grpc")
-                    raise
-                core_request.trace = trace
-                async for core_response in self.core.infer_decoupled(
-                    core_request
-                ):
-                    if prof.take():
-                        encode_cpu0 = prof.cpu_now()
-                        wire_response = build_proto_response(core_response)
-                        prof.account("encode", prof.cpu_now() - encode_cpu0)
-                    else:
-                        wire_response = build_proto_response(core_response)
-                    yield pb.ModelStreamInferResponse(
-                        infer_response=wire_response
+        """Bidirectional inference stream.
+
+        Requests are processed IN ORDER by default (existing decoupled
+        semantics). A request carrying the ``multiplex`` parameter (the
+        clients' persistent-stream mode) executes as its own task, so
+        many unary infers share one stream without serializing on each
+        other — responses interleave and are correlated by request id.
+        """
+        core = self.core
+        prof = core.profiling
+        out_q: "asyncio.Queue" = asyncio.Queue(self._STREAM_QUEUE_FRAMES)
+        DONE = object()
+        ABORT = object()
+        tasks = set()
+
+        async def emit(core_request, core_response) -> None:
+            if prof.take():
+                encode_cpu0 = prof.cpu_now()
+                frame = self.codec.encode_stream_response(
+                    core_request.shm_ring.complete(core_response)
+                    if core_request.shm_ring is not None
+                    else core_response
+                )
+                prof.account("encode", prof.cpu_now() - encode_cpu0)
+            else:
+                if core_request.shm_ring is not None:
+                    core_response = core_request.shm_ring.complete(
+                        core_response
                     )
+                frame = self.codec.encode_stream_response(core_response)
+            await out_q.put(frame)
+
+        async def run_one(core_request, trace) -> None:
+            try:
+                core_request.trace = trace
+                if core_request.shm_ring is not None:
+                    # ring slots hold exactly one response; decoupled
+                    # models reject ring requests via the unary path
+                    await emit(core_request, await core.infer(core_request))
+                else:
+                    async for core_response in core.infer_decoupled(
+                        core_request
+                    ):
+                        await emit(core_request, core_response)
             except InferenceServerException as e:
+                if core_request.shm_ring is not None:
+                    core_request.shm_ring.fail()
                 if trace is not None:
                     trace.end(error=e.message())
-                    trace = None
-                log = self.core.logger
+                log = core.logger
                 if log.verbose_hot:
                     log.verbose(
                         "request",
-                        model=request.model_name,
+                        model=core_request.model_name,
                         protocol="grpc",
                         status="error",
                         error=e.message(),
                         streaming=True,
                     )
-                error = pb.ModelStreamInferResponse(
-                    error_message=e.message(),
-                    infer_response=pb.ModelInferResponse(id=request.id),
+                await out_q.put(
+                    self.codec.encode_stream_error(
+                        e.message(), core_request.id
+                    )
                 )
-                yield error
+                return
             except BaseException as e:
-                # stream teardown (client cancel) or a non-ISE failure:
-                # the trace record must still be exported
+                if core_request.shm_ring is not None:
+                    core_request.shm_ring.fail()
                 if trace is not None:
                     trace.end(error=str(e) or type(e).__name__)
                 raise
             if trace is not None:
                 trace.end()
+
+        async def run_task(core_request, trace) -> None:
+            try:
+                await run_one(core_request, trace)
+            except asyncio.CancelledError:
+                # stream teardown cancelled us: the writer is gone, do
+                # not block on the (possibly full) frame queue
+                raise
+            except BaseException as e:  # noqa: BLE001 - surfaced to writer
+                try:
+                    out_q.put_nowait((ABORT, e))
+                except asyncio.QueueFull:
+                    # a live writer will drain the queue; a dead writer
+                    # cancels this task out of the blocking put
+                    await out_q.put((ABORT, e))
+
+        async def reader() -> None:
+            try:
+                async for data in request_iterator:
+                    # an injected fault aborts the whole stream with
+                    # UNAVAILABLE (connection-loss semantics); the abort
+                    # itself happens on the writer coroutine below
+                    if self.chaos is not None and self.chaos.applies_to(
+                        "ModelStreamInfer"
+                    ):
+                        if self.chaos.latency_s:
+                            await asyncio.sleep(self.chaos.latency_s)
+                        fate = self.chaos.draw()
+                        if fate is not None:
+                            self.chaos.record(fate)
+                            await out_q.put((ABORT, _ChaosAbort()))
+                            return
+                    trace = None
+                    core_request = None
+                    try:
+                        try:
+                            if prof.take():
+                                decode_cpu0 = prof.cpu_now()
+                                core_request = self._decode_infer(data)
+                                prof.account(
+                                    "frontend_decode",
+                                    prof.cpu_now() - decode_cpu0,
+                                )
+                            else:
+                                core_request = self._decode_infer(data)
+                        except InferenceServerException:
+                            core.metrics.observe_frontend_error("grpc")
+                            raise
+                        # drain-aware: rejected stream requests surface
+                        # as clean in-band errors, never cancelled streams
+                        core.reject_if_draining(core_request.model_name)
+                        trace = self._begin_trace(context, core_request)
+                    except InferenceServerException as e:
+                        if (
+                            core_request is not None
+                            and core_request.shm_ring is not None
+                        ):
+                            # rejection after attach: release the slot or
+                            # the in-use gauge leaks
+                            core_request.shm_ring.fail()
+                        if trace is not None:
+                            trace.end(error=e.message())
+                        log = core.logger
+                        if log.verbose_hot:
+                            log.verbose(
+                                "request",
+                                protocol="grpc",
+                                status="error",
+                                error=e.message(),
+                                streaming=True,
+                            )
+                        # echo the request id so multiplexed clients can
+                        # correlate the failure to ITS request ("" only
+                        # when the bytes never decoded)
+                        await out_q.put(
+                            self.codec.encode_stream_error(
+                                e.message(),
+                                core_request.id
+                                if core_request is not None
+                                else "",
+                            )
+                        )
+                        continue
+                    if core_request.parameters.pop("multiplex", False):
+                        task = asyncio.ensure_future(
+                            run_task(core_request, trace)
+                        )
+                        tasks.add(task)
+                        task.add_done_callback(tasks.discard)
+                    else:
+                        await run_one(core_request, trace)
+                if tasks:
+                    await asyncio.gather(*tasks, return_exceptions=True)
+            except asyncio.CancelledError:
+                # writer teardown cancelled us: never block on the
+                # (possibly full, no-longer-drained) frame queue
+                raise
+            except BaseException as e:  # noqa: BLE001 - surfaced to writer
+                await out_q.put((ABORT, e))
+                return
+            await out_q.put(DONE)
+
+        reader_task = asyncio.ensure_future(reader())
+        try:
+            while True:
+                item = await out_q.get()
+                if item is DONE:
+                    break
+                if type(item) is tuple and item[0] is ABORT:
+                    error = item[1]
+                    if isinstance(error, _ChaosAbort):
+                        await context.abort(
+                            grpc.StatusCode.UNAVAILABLE,
+                            "chaos: injected unavailability",
+                        )
+                    raise error
+                yield item
+        finally:
+            reader_task.cancel()
+            for task in list(tasks):
+                task.cancel()
 
 
 # Bind every non-inference method to the shared codec implementation.

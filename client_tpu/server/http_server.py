@@ -17,6 +17,7 @@ import numpy as np
 from aiohttp import web
 
 from client_tpu.observability import TRACEPARENT_HEADER
+from client_tpu.server import shm_ring
 
 # Back-compat alias: /metrics label escaping lived here before the
 # registry (client_tpu.observability.metrics) owned the exposition format.
@@ -670,13 +671,26 @@ class HttpServer:
             core_request.trace = trace
             if trace is not None:
                 trace.request_id = core_request.id
-            core_response = await self.core.infer(core_request)
+            try:
+                core_response = await self.core.infer(core_request)
+            except BaseException:
+                if core_request.shm_ring is not None:
+                    core_request.shm_ring.fail()
+                raise
             accept = request.headers.get("Accept-Encoding", "")
             if measured:
                 encode_cpu0 = prof.cpu_now()
+                if core_request.shm_ring is not None:
+                    core_response = core_request.shm_ring.complete(
+                        core_response
+                    )
                 response = self._build_response(payload, core_response, accept)
                 prof.account("encode", prof.cpu_now() - encode_cpu0)
             else:
+                if core_request.shm_ring is not None:
+                    core_response = core_request.shm_ring.complete(
+                        core_response
+                    )
                 response = self._build_response(payload, core_response, accept)
         except BaseException as e:
             if trace is not None:
@@ -764,6 +778,9 @@ class HttpServer:
                     shm_offset=int(params.get("shared_memory_offset", 0)),
                 )
             )
+        # shm-ring requests (shm_ring_region/slot/seq parameters): inputs
+        # come from the ring slot, the response goes back into it
+        shm_ring.attach(self.core, request)
         return request
 
     def _build_response(self, payload, core_response, accept: str) -> web.Response:

@@ -197,6 +197,27 @@ class ServerMetrics:
             ("protocol",),
             registry=registry,
         )
+        self.codec_fastpath = Counter(
+            "tpu_codec_fastpath_total",
+            "ModelInfer wire-codec fast-path outcomes: 'hit' requests "
+            "decoded by the protobuf-free scanner, 'fallback' requests "
+            "outside the fast shape (parsed by the proto codec), "
+            "'encode_fallback' responses the hand-rolled encoder "
+            "declined.",
+            ("outcome",),
+            registry=registry,
+        )
+        self._codec_children = {
+            outcome: self.codec_fastpath.labels(outcome)
+            for outcome in ("hit", "fallback", "encode_fallback")
+        }
+        self.shm_ring_slots = Gauge(
+            "tpu_shm_ring_slots_in_use",
+            "Ring slots currently owned by the server (request read, "
+            "response not yet written), per registered ring region.",
+            ("region",),
+            registry=registry,
+        )
         self.duty_cycle = Gauge(
             "tpu_duty_cycle",
             "Fraction of wall time the device spent executing models since "
@@ -421,6 +442,27 @@ class ServerMetrics:
         if child is None:
             child = self._stage_children[stage] = self.stage_cpu.labels(stage)
         child.observe(cpu_ns / count / 1e9, count)
+
+    def observe_codec(self, outcome: str) -> None:
+        """Book one wire-codec fast-path outcome (children precached —
+        this rides the per-request decode path)."""
+        child = self._codec_children.get(outcome)
+        if child is None:
+            child = self._codec_children[outcome] = self.codec_fastpath.labels(
+                outcome
+            )
+        child.inc()
+
+    def set_ring_slots(self, region: str, value: int) -> None:
+        """Publish a ring region's in-flight slot count (exact at every
+        read/complete transition, not sampled at scrape time)."""
+        self.shm_ring_slots.labels(region).set(value)
+
+    def remove_ring_region(self, region: str) -> None:
+        """Drop an unregistered ring's gauge child — ring names rotate
+        per client run, so pruning keeps /metrics cardinality bounded by
+        the LIVE ring set, not history."""
+        self.shm_ring_slots.remove(region)
 
     def observe_rejection(self, model: str, reason: str) -> None:
         """Book one admission-control rejection (queue_full / timeout)."""

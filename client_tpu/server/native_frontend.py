@@ -32,6 +32,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from client_tpu.server import _grpc_codec as codec
+from client_tpu.server import shm_ring as ring_codec
 from client_tpu.server.core import (
     CoreRequest,
     CoreRequestedOutput,
@@ -282,6 +283,9 @@ class NativeGrpcFrontend:
                         name=name, classification=int(classification)
                     )
                 )
+        # shm-ring requests: inputs view the ring slot, the response
+        # goes back into it (ticket on request.shm_ring)
+        ring_codec.attach(self._core, request)
         return request
 
 
@@ -321,14 +325,31 @@ class NativeGrpcFrontend:
             results = self._core.infer_direct(requests)
             encode_cpu0 = prof.cpu_now() if measured else 0
             log = self._core.logger
-            for handle, result in zip(handles, results):
+            for handle, request, result in zip(handles, requests, results):
                 if isinstance(result, Exception):
                     # Execution errors are the server/model's fault:
                     # INTERNAL (matching the event-loop unary path).
+                    if request.shm_ring is not None:
+                        request.shm_ring.fail()
                     completions.append(
                         self._error_completion(handle, result)
                     )
                 else:
+                    if request.shm_ring is not None:
+                        try:
+                            result = request.shm_ring.complete(result)
+                        except Exception as e:  # noqa: BLE001 - per-request
+                            # a response that doesn't fit its slot fails
+                            # THIS request cleanly; co-batched requests
+                            # still complete
+                            completions.append(
+                                self._error_completion(
+                                    handle,
+                                    e,
+                                    default=codec.GRPC_INVALID_ARGUMENT,
+                                )
+                            )
+                            continue
                     if log.verbose_hot:
                         log.verbose(
                             "request",
@@ -479,11 +500,21 @@ class NativeGrpcFrontend:
         """
         held: Optional[CoreResponse] = None
         try:
+            if request.shm_ring is not None:
+                # ring slots hold exactly one response: unary execution,
+                # tensors diverted into the slot, slim ack on the wire
+                response = await self._core.infer(request)
+                self._complete_response(
+                    handle, request.shm_ring.complete(response), final=True
+                )
+                return
             async for response in self._core.infer_decoupled(request):
                 if held is not None:
                     self._complete_response(handle, held, final=False)
                 held = response
         except asyncio.CancelledError:
+            if request.shm_ring is not None:
+                request.shm_ring.fail()
             if not self._core.lifecycle.accepting:
                 # torn down by a drain deadline, not by the peer: the
                 # client gets a clean retryable UNAVAILABLE, never a
@@ -498,11 +529,15 @@ class NativeGrpcFrontend:
                 self._complete_error(handle, "request cancelled", 1)
             raise
         except InferenceServerException as e:
+            if request.shm_ring is not None:
+                request.shm_ring.fail()
             self._complete_error(
                 handle, e.message(), codec.status_code_for(e.message(), exc=e)
             )
             return
         except Exception as e:  # noqa: BLE001
+            if request.shm_ring is not None:
+                request.shm_ring.fail()
             self._complete_error(handle, str(e), codec.GRPC_INTERNAL)
             return
         if held is not None:

@@ -194,6 +194,13 @@ class SharedMemoryManager:
                     }
             return result
 
+    def region(self, name: str) -> Optional[_Region]:
+        """The live region object for ``name`` (None when unregistered).
+        Identity is stable per registration — the shm-ring registry keys
+        its cache on it so re-registration invalidates cleanly."""
+        with self._lock:
+            return self._regions.get(name)
+
     def read(self, name: str, offset: int, byte_size: int) -> memoryview:
         with self._lock:
             region = self._regions.get(name)

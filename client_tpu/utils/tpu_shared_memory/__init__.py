@@ -259,3 +259,11 @@ def destroy_shared_memory_region(shm_handle: TpuSharedMemoryRegion) -> None:
     with _allocated_lock:
         _allocated_regions.pop(shm_handle.name(), None)
     shm_handle._destroy()
+
+
+# Fixed-layout slot ring over one region (PR-11 small-tensor fast path);
+# imported late: ring.py pulls helpers from this module at call time.
+from client_tpu.utils.tpu_shared_memory.ring import (  # noqa: E402
+    ShmRing,
+    ShmRingError,
+)

@@ -63,6 +63,11 @@ def pytest_configure(config):
         "XLA_FLAGS=--xla_force_host_platform_device_count=8 when this "
         "process's backend initialized single-device",
     )
+    config.addinivalue_line(
+        "markers",
+        "wirefast: PR-11 wire fast path (protobuf-free codec, shm ring, "
+        "multiplexed streams) — select with -m wirefast",
+    )
     # Clock-injection lint: observability/resilience must never call
     # time.*() clocks directly (their tests run on fake clocks). Failing
     # at session start beats a flaky sleep-based test later.

@@ -123,30 +123,89 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def _harness_family(parsed: Dict[str, Any]) -> str:
+    """Coarse harness family of a run's headline number. perf_analyzer
+    (C++ client, native front-end) and the python-grpc fallback measure
+    DIFFERENT stacks — r05's 13.5k/s (C++) vs a python-harness run are
+    not the same experiment, and gating one against the other would
+    flag every harness change as a 90% 'regression'."""
+    metric = str(parsed.get("metric", "")) + str(parsed.get("harness", ""))
+    return "cpp" if "perf_analyzer" in metric else "python"
+
+
 def check_regression(
     runs: List[Dict[str, Any]], threshold: float = DEFAULT_THRESHOLD
 ) -> Optional[str]:
-    """An error string when the newest successful run's throughput sits
-    more than ``threshold`` below the best prior successful run; None
-    when the trajectory is healthy (or has fewer than two data points)."""
-    measured = [
-        (r["run"], r["parsed"]["value"])
-        for r in runs
-        if r["parsed"] is not None
-        and isinstance(r["parsed"].get("value"), (int, float))
-    ]
-    if len(measured) < 2:
+    """An error string when any guarded row of the newest successful run
+    sits more than ``threshold`` below the best prior successful run;
+    None when the trajectory is healthy (or has no comparable prior).
+
+    Guarded rows (ROADMAP item 3 asks for all three):
+      * headline ``value`` — compared only against prior runs of the
+        SAME harness family (see :func:`_harness_family`);
+      * ``sharded.infer_per_sec`` (BENCH_r10+);
+      * ``llm_generate.tokens_per_sec`` (BENCH_r09+).
+    """
+    ok = [r for r in runs if r["parsed"] is not None]
+    if len(ok) < 2:
         return None
-    latest_run, latest = measured[-1]
-    best_run, best = max(measured[:-1], key=lambda kv: kv[1])
-    if latest < best * (1.0 - threshold):
-        return (
-            f"throughput regression: r{latest_run:02d} at {latest:.1f} "
-            f"infer/sec is {(1 - latest / best) * 100:.1f}% below the best "
-            f"prior run (r{best_run:02d} at {best:.1f}); the guard allows "
-            f"{threshold * 100:.0f}%"
-        )
-    return None
+    latest = ok[-1]["parsed"]
+    latest_run = ok[-1]["run"]
+    problems = []
+
+    def _guard(label, unit, latest_value, prior_pairs):
+        if not isinstance(latest_value, (int, float)) or not prior_pairs:
+            return
+        best_run, best = max(prior_pairs, key=lambda kv: kv[1])
+        if latest_value < best * (1.0 - threshold):
+            problems.append(
+                f"{label} regression: r{latest_run:02d} at "
+                f"{latest_value:.1f} {unit} is "
+                f"{(1 - latest_value / best) * 100:.1f}% below the best "
+                f"prior run (r{best_run:02d} at {best:.1f}); the guard "
+                f"allows {threshold * 100:.0f}%"
+            )
+
+    family = _harness_family(latest)
+    _guard(
+        "throughput",
+        "infer/sec",
+        latest.get("value"),
+        [
+            (r["run"], r["parsed"]["value"])
+            for r in ok[:-1]
+            if isinstance(r["parsed"].get("value"), (int, float))
+            and _harness_family(r["parsed"]) == family
+        ],
+    )
+
+    def _nested(parsed, row, key):
+        inner = parsed.get(row)
+        value = inner.get(key) if isinstance(inner, dict) else None
+        return value if isinstance(value, (int, float)) else None
+
+    _guard(
+        "sharded",
+        "infer/sec",
+        _nested(latest, "sharded", "infer_per_sec"),
+        [
+            (r["run"], _nested(r["parsed"], "sharded", "infer_per_sec"))
+            for r in ok[:-1]
+            if _nested(r["parsed"], "sharded", "infer_per_sec") is not None
+        ],
+    )
+    _guard(
+        "llm_generate",
+        "tok/s",
+        _nested(latest, "llm_generate", "tokens_per_sec"),
+        [
+            (r["run"], _nested(r["parsed"], "llm_generate", "tokens_per_sec"))
+            for r in ok[:-1]
+            if _nested(r["parsed"], "llm_generate", "tokens_per_sec")
+            is not None
+        ],
+    )
+    return "; ".join(problems) if problems else None
 
 
 def refresh_perf_md(table: str, perf_path: Optional[str] = None) -> bool:

@@ -41,10 +41,16 @@ TARGET_DIRS = (
 # these must stay injected even if the directory list ever changes);
 # findings are deduplicated against the directory walk
 TARGET_FILES = (
+    # PR-11 wire fast path: the codec/ring/mux hot paths must never grow
+    # an untestable clock read (their tests run on fake/event clocks)
+    os.path.join("client_tpu", "grpc", "_mux.py"),
+    os.path.join("client_tpu", "grpc", "_wire.py"),
     os.path.join("client_tpu", "observability", "logging.py"),
     os.path.join("client_tpu", "observability", "profiling.py"),
     os.path.join("client_tpu", "observability", "recorder.py"),
     os.path.join("client_tpu", "perf", "metrics_collector.py"),
+    os.path.join("client_tpu", "server", "shm_ring.py"),
+    os.path.join("client_tpu", "utils", "tpu_shared_memory", "ring.py"),
 )
 
 # time-module clock functions whose direct call defeats injection
