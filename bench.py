@@ -462,8 +462,12 @@ def _bench_sharded() -> dict:
     mesh, served through loopback gRPC. JAX's device count is frozen at
     first backend init — this process already initialized single-device
     — so the row runs in a subprocess (tools/bench_sharded.py) under
-    ``XLA_FLAGS=--xla_force_host_platform_device_count=8``. Never
-    raises; failures degrade to {} so the headline is never lost."""
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8``. Best of two
+    passes, like the headline: a single pass of this subprocess-heavy
+    row measured a >2x spread on the shared bench host (PERF.md PR-12
+    noise note), and the recorded artifact should not penalize the
+    build for a scheduler hiccup. Never raises; failures degrade to {}
+    so the headline is never lost."""
     script = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "tools",
         "bench_sharded.py",
@@ -471,36 +475,91 @@ def _bench_sharded() -> dict:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+
+    def one_pass() -> dict:
+        try:
+            out = subprocess.run(
+                [sys.executable, script],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=600,
+            )
+            for line in out.stdout.splitlines():
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        row = json.loads(line)
+                    except ValueError:
+                        continue  # stray non-JSON brace line, keep going
+                    if "infer_per_sec" not in row and "error" not in row:
+                        continue  # stray structured-log line, not the row
+                    if "error" in row:
+                        print(
+                            f"bench: sharded row failed: {row['error']}",
+                            file=sys.stderr,
+                        )
+                        return {}
+                    return row
+            print(
+                f"bench: sharded row produced no JSON (rc {out.returncode})",
+                file=sys.stderr,
+            )
+        except Exception as e:  # noqa: BLE001 - row is best-effort
+            print(f"bench: sharded row failed: {e}", file=sys.stderr)
+        return {}
+
+    best: dict = {}
+    for _ in range(2):
+        row = one_pass()
+        if row and (
+            not best or row["infer_per_sec"] > best["infer_per_sec"]
+        ):
+            best = row
+    return best
+
+
+def _bench_fleet() -> dict:
+    """The multi-replica scale-out row (ROADMAP item 1 / BENCH_r12+):
+    N=3 subprocess replicas vs N=1 serving the accelerator-bound
+    ``device_sim`` model, aggregate infer/sec per routing policy with
+    the fleet report's skew verdict per policy (tools/bench_fleet.py).
+    Subprocesses, not threads: in-process replicas would share one GIL
+    and fabricate a flat scaling curve. Never raises; failures degrade
+    to {} so the headline is never lost."""
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools",
+        "bench_fleet.py",
+    )
     try:
         out = subprocess.run(
             [sys.executable, script],
-            env=env,
             capture_output=True,
             text=True,
             timeout=600,
         )
         for line in out.stdout.splitlines():
             line = line.strip()
-            if line.startswith("{"):
-                try:
-                    row = json.loads(line)
-                except ValueError:
-                    continue  # stray non-JSON brace line, keep scanning
-                if "infer_per_sec" not in row and "error" not in row:
-                    continue  # stray structured-log line, not the row
-                if "error" in row:
-                    print(
-                        f"bench: sharded row failed: {row['error']}",
-                        file=sys.stderr,
-                    )
-                    return {}
+            if not line.startswith("{"):
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if "error" in row:
+                print(
+                    f"bench: fleet row failed: {row['error']}",
+                    file=sys.stderr,
+                )
+                return {}
+            if "best_infer_per_sec" in row:
                 return row
         print(
-            f"bench: sharded row produced no JSON (rc {out.returncode})",
+            f"bench: fleet row produced no JSON (rc {out.returncode})",
             file=sys.stderr,
         )
     except Exception as e:  # noqa: BLE001 - row is best-effort
-        print(f"bench: sharded row failed: {e}", file=sys.stderr)
+        print(f"bench: fleet row failed: {e}", file=sys.stderr)
     return {}
 
 
@@ -738,6 +797,10 @@ def main() -> int:
     # the host's cores and understate both rows).
     sharded = {} if os.environ.get("BENCH_NO_SHARDED") else _bench_sharded()
 
+    # Fleet scale-out row: also after the main server closed (N replica
+    # subprocesses + a driver want the whole host).
+    fleet = {} if os.environ.get("BENCH_NO_FLEET") else _bench_fleet()
+
     value = round(result["throughput"], 2)
     line = {
         "metric": (
@@ -847,6 +910,8 @@ def main() -> int:
         line["llm_generate"] = llm_generate
     if sharded:
         line["sharded"] = sharded
+    if fleet:
+        line["fleet"] = fleet
     # CPU attribution of the client/server split for the headline run
     # (PERF.md explains how this bounds ratio_vs_inproc on few-core hosts).
     count = result.get("count", 0)

@@ -9,6 +9,7 @@ size capped at INT32_MAX both directions.
 """
 
 import dataclasses
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import grpc
@@ -25,9 +26,17 @@ from client_tpu.grpc._service_stubs import GRPCInferenceServiceStub
 from client_tpu.grpc._utils import (
     get_inference_request,
     is_sequence_request as _is_sequence_request,
+    request_is_hedgeable,
+    request_routing_key,
     rpc_error_to_exception,
 )
-from client_tpu.lifecycle import EndpointPool, status_is_unavailable
+from client_tpu.lifecycle import (
+    EndpointPool,
+    failover_retry_policy,
+    grpc_status_is_endpoint_outage,
+    resolve_hedge_policy,
+    status_is_unavailable,
+)
 from client_tpu.observability.trace import (
     NOOP_TRACE,
     TRACEPARENT_HEADER,
@@ -120,14 +129,25 @@ class InferenceServerClient(InferenceServerClientBase):
         endpoint_cooldown_s: float = 1.0,
         logger=None,
         stream_mode: bool = False,
+        routing_policy=None,
+        hedge_policy=None,
     ):
         """``url`` may be a single ``host:port``, a comma list, or an
         :class:`~client_tpu.lifecycle.EndpointPool`; ``urls=[...]`` names
         replica endpoints. One channel per endpoint (created lazily);
-        unary RPCs target a sticky primary and fail over — immediately,
-        no backoff sleep — when an endpoint answers UNAVAILABLE or the
-        connection dies; recovering endpoints must pass a ``ServerReady``
-        probe first. Streams bind to the endpoint current at open.
+        unary RPCs route per ``routing_policy`` — sticky primary by
+        default, or ``round_robin`` / ``least_outstanding`` / ``p2c`` /
+        ``consistent_hash`` (affinity on the ``routing_key`` request
+        parameter) — and fail over, immediately, no backoff sleep, when
+        an endpoint answers UNAVAILABLE or the connection dies;
+        recovering endpoints must pass a ``ServerReady`` probe first.
+        Streams bind to the endpoint current at open. ``hedge_policy``
+        (seconds, ``"p95"``, or a
+        :class:`~client_tpu.lifecycle.HedgePolicy`) arms tail hedging on
+        idempotent ModelInfer calls (gRPC futures under the hood): first
+        response wins, the loser is cancelled and never double-counted
+        in pool telemetry or retries; shm-ring/shared-memory requests
+        never hedge.
 
         ``stream_mode=True`` routes every unary :meth:`infer` over one
         long-lived multiplexed ``ModelStreamInfer`` stream (correlation
@@ -140,18 +160,17 @@ class InferenceServerClient(InferenceServerClientBase):
         self._verbose = verbose
         self._stream_mode = stream_mode
         self._mux = None
-        import threading as _threading
-
-        self._mux_init_lock = _threading.Lock()
+        self._mux_init_lock = threading.Lock()
         self._pool = EndpointPool.resolve(
-            url, urls, cooldown_s=endpoint_cooldown_s, logger=logger
+            url,
+            urls,
+            cooldown_s=endpoint_cooldown_s,
+            logger=logger,
+            routing_policy=routing_policy,
         )
+        self._hedge = resolve_hedge_policy(hedge_policy)
         if self._pool.size > 1 and retry_policy is None:
-            retry_policy = RetryPolicy(
-                max_attempts=2 * self._pool.size,
-                initial_backoff_s=0.02,
-                max_backoff_s=0.5,
-            )
+            retry_policy = failover_retry_policy(self._pool.size)
         self._retry_policy = retry_policy
         self._circuit_breaker = circuit_breaker
         self._tracer = tracer
@@ -203,6 +222,9 @@ class InferenceServerClient(InferenceServerClientBase):
         self._channel = self._channel_for(self._pool.urls[0])
         self._client_stub = self._stub_for(self._pool.urls[0])
         self._stream: Optional[InferStream] = None
+        # the endpoint the decoupled stream is pinned to (stream traffic
+        # is counted per stream, not per request)
+        self._stream_endpoint = None
 
     def _channel_for(self, url: str) -> grpc.Channel:
         channel = self._channels.get(url)
@@ -236,22 +258,29 @@ class InferenceServerClient(InferenceServerClientBase):
         except grpc.RpcError:
             return False
 
-    def _pick_endpoint(self, budget_s: Optional[float] = None):
+    def _pick_endpoint(
+        self,
+        budget_s: Optional[float] = None,
+        exclude=None,
+        key=None,
+    ):
         """Pool choice for the next attempt; recovering endpoints pass a
-        ServerReady probe first, budgeted against the attempt timeout."""
+        ServerReady probe first, budgeted against the attempt timeout.
+        ``exclude`` asks for an endpoint other than the one given (the
+        hedge path); ``key`` is the consistent-hash routing key."""
         pool = self._pool
         probe_timeout = 1.0
         if budget_s:
             probe_timeout = min(1.0, max(0.05, budget_s / pool.size))
         for _ in range(pool.size):
-            endpoint = pool.pick()
+            endpoint = pool.pick(key=key, exclude=exclude)
             if not pool.needs_probe(endpoint):
                 return endpoint
             if self._probe_endpoint(endpoint, timeout=probe_timeout):
                 pool.mark_up(endpoint)
                 return endpoint
             pool.mark_down(endpoint)
-        return pool.pick()
+        return pool.pick(key=key, exclude=exclude)
 
     # -- plumbing -----------------------------------------------------------
 
@@ -270,6 +299,8 @@ class InferenceServerClient(InferenceServerClientBase):
         idempotent=True,
         probe=False,
         trace=NOOP_TRACE,
+        routing_key=None,
+        hedgeable=True,
     ):
         """One RPC under the retry/deadline/breaker rules.
 
@@ -279,7 +310,10 @@ class InferenceServerClient(InferenceServerClientBase):
         breaker accounting (a probe reports current state; its failures
         during a restart must not poison a shared breaker). An active
         ``trace`` records one "request" span per attempt (the blocking
-        stub cannot split send from wait).
+        stub cannot split send from wait). ``routing_key`` feeds
+        consistent-hash affinity; ``hedgeable`` (with the client's hedge
+        policy armed and ``idempotent``) runs the attempt through the
+        futures-based hedge orchestration.
         """
         if self._verbose:
             print(f"gRPC {name}: {{{str(request)[:200]}}}")
@@ -297,34 +331,62 @@ class InferenceServerClient(InferenceServerClientBase):
                 raise rpc_error_to_exception(e) from None
         pool = self._pool
 
-        def _send(attempt_timeout):
-            endpoint = self._pick_endpoint(attempt_timeout)
-            started = pool.begin(endpoint)
-            try:
-                value = getattr(self._stub_for(endpoint.url), name)(
+        def _classify_failure(endpoint, rpc_error):
+            exc = rpc_error_to_exception(rpc_error)
+            if grpc_status_is_endpoint_outage(exc.status()):
+                # draining/dead endpoint — or a server that CANCELLED an
+                # accepted RPC mid-shutdown (a local cancel raises
+                # FutureCancelledError, never an RpcError): bench it;
+                # with an alternative, skip the backoff and fail over NOW
+                pool.observe(endpoint, token="StatusCode.UNAVAILABLE")
+                if pool.has_alternative(endpoint):
+                    exc.retry_backoff_cap_s = 0.0
+            return exc
+
+        hedge = self._hedge if (hedgeable and idempotent) else None
+        if hedge is not None:
+
+            def _send(attempt_timeout):
+                return self._hedged_send(
+                    name,
                     request,
-                    metadata=metadata,
-                    timeout=attempt_timeout,
-                    compression=compression,
+                    metadata,
+                    compression,
+                    attempt_timeout,
+                    routing_key,
+                    _classify_failure,
                 )
-            except grpc.RpcError as e:
-                pool.finish(endpoint, started, ok=False)
-                exc = rpc_error_to_exception(e)
-                if status_is_unavailable(exc.status()):
-                    # draining/dead endpoint: bench it; with an
-                    # alternative, skip the backoff and fail over NOW
-                    pool.observe(endpoint, token=exc.status())
-                    if pool.has_alternative(endpoint):
-                        exc.retry_backoff_cap_s = 0.0
-                raise exc from None
-            except BaseException:
-                # an unwrapped error: close the bracket so the
-                # outstanding gauge never leaks
-                pool.finish(endpoint, started, ok=False)
-                raise
-            pool.finish(endpoint, started, ok=True)
-            pool.observe(endpoint, ok=True)
-            return value
+
+        else:
+
+            def _send(attempt_timeout):
+                endpoint = self._pick_endpoint(
+                    attempt_timeout, key=routing_key
+                )
+                started = pool.begin(endpoint)
+                try:
+                    value = getattr(self._stub_for(endpoint.url), name)(
+                        request,
+                        metadata=metadata,
+                        timeout=attempt_timeout,
+                        compression=compression,
+                    )
+                except grpc.RpcError as e:
+                    exc = _classify_failure(endpoint, e)
+                    # the token keeps client-fault codes out of
+                    # consecutive-error ejection
+                    pool.finish(
+                        endpoint, started, ok=False, token=exc.status()
+                    )
+                    raise exc from None
+                except BaseException:
+                    # an unwrapped error: close the bracket so the
+                    # outstanding gauge never leaks
+                    pool.finish(endpoint, started, ok=False)
+                    raise
+                pool.finish(endpoint, started, ok=True)
+                pool.observe(endpoint, ok=True)
+                return value
 
         return run_with_resilience(
             trace.wrap_attempt(_send),
@@ -334,6 +396,154 @@ class InferenceServerClient(InferenceServerClientBase):
             idempotent=idempotent,
             description=f"gRPC {name}",
         )
+
+    def _hedged_send(
+        self,
+        name,
+        request,
+        metadata,
+        compression,
+        attempt_timeout,
+        routing_key,
+        classify_failure,
+    ):
+        """One hedged attempt over gRPC futures (the blocking twin of
+        :func:`client_tpu.lifecycle.hedged_send_async`): launch the
+        primary, and past the hedge delay one duplicate on a different
+        endpoint; first success wins, the loser is cancelled with its
+        pool bracket closed as ``cancelled`` (neither an error nor a
+        latency sample — never double-counted). Exactly one outcome (the
+        winner's, or the primary's when both fail) reaches the retry
+        loop. Any unexpected failure mid-orchestration (a channel closed
+        under us, a pick raising) cancels every launched future and
+        closes its bracket before propagating — the outstanding gauge
+        must never leak."""
+        pool = self._pool
+        hedge = self._hedge
+        settled = threading.Event()
+        entries = []
+
+        def _launch(endpoint, timeout=attempt_timeout):
+            started = pool.begin(endpoint)
+            try:
+                future = getattr(
+                    self._stub_for(endpoint.url), name
+                ).future(
+                    request,
+                    metadata=metadata,
+                    timeout=timeout,
+                    compression=compression,
+                )
+            except BaseException:
+                pool.finish(endpoint, started, ok=False)
+                raise
+            future.add_done_callback(lambda _f: settled.set())
+            entry = {
+                "future": future,
+                "endpoint": endpoint,
+                "started": started,
+                "closed": False,
+            }
+            entries.append(entry)
+            return entry
+
+        def _close(entry, ok=False, cancelled=False, token=None):
+            if entry["closed"]:
+                return 0.0
+            entry["closed"] = True
+            return pool.finish(
+                entry["endpoint"], entry["started"],
+                ok=ok, cancelled=cancelled, token=token,
+            )
+
+        def _outcome(future):
+            """("ok", response) | ("err", rpc_error) | ("cancelled", None)."""
+            try:
+                exc = future.exception()
+            except (grpc.FutureCancelledError, grpc.FutureTimeoutError):
+                return ("cancelled", None)
+            if exc is not None:
+                return ("err", exc)
+            return ("ok", future.result())
+
+        try:
+            primary = _launch(
+                self._pick_endpoint(attempt_timeout, key=routing_key)
+            )
+            delay = hedge.current_delay_s()
+            if delay is not None:
+                if attempt_timeout:
+                    delay = min(delay, attempt_timeout)
+                if not settled.wait(delay):
+                    # the hedge rides what REMAINS of the attempt budget
+                    # (~delay has elapsed); its own full attempt_timeout
+                    # would overrun the caller's deadline by the delay
+                    hedge_timeout = (
+                        max(0.001, attempt_timeout - delay)
+                        if attempt_timeout
+                        else None
+                    )
+                    other = self._pick_endpoint(
+                        hedge_timeout,
+                        exclude=primary["endpoint"],
+                        key=routing_key,
+                    )
+                    if other is not None and other is not primary["endpoint"]:
+                        pool.note_hedge()
+                        _launch(other, hedge_timeout)
+            winner = None
+            while winner is None:
+                settled.clear()
+                done = [e for e in entries if e["future"].done()]
+                for entry in done:
+                    if _outcome(entry["future"])[0] == "ok":
+                        winner = entry
+                        break
+                if winner is not None or len(done) == len(entries):
+                    break
+                settled.wait(attempt_timeout if attempt_timeout else 3600.0)
+            for entry in entries:
+                if entry is winner:
+                    continue
+                entry["future"].cancel()
+                kind, payload = _outcome(entry["future"])
+                if winner is None and entry is primary:
+                    continue  # the primary's failure is settled below
+                if kind == "err":
+                    # the loser genuinely failed before cancellation: a
+                    # real endpoint error, booked as one (but its outcome
+                    # never reaches the retry loop)
+                    exc = classify_failure(entry["endpoint"], payload)
+                    _close(entry, ok=False, token=exc.status())
+                else:
+                    # cancelled (or succeeded after losing): says nothing
+                    # we need — close the bracket without booking anything
+                    _close(entry, cancelled=True)
+            if winner is not None:
+                latency_s = _close(winner, ok=True)
+                hedge.record(latency_s)
+                pool.observe(winner["endpoint"], ok=True)
+                if winner is not primary:
+                    pool.note_hedge_win()
+                return winner["future"].result()
+            # both attempts failed: the primary's outcome speaks for it
+            kind, payload = _outcome(primary["future"])
+            if kind == "err":
+                exc = classify_failure(primary["endpoint"], payload)
+                _close(primary, ok=False, token=exc.status())
+                raise exc from None
+            _close(primary, ok=False)
+            raise InferenceServerException(
+                f"gRPC {name} was cancelled", status="CANCELLED"
+            )
+        finally:
+            # unexpected escape (channel closed mid-orchestration, pick
+            # raising): no launched attempt may keep running with an open
+            # bracket
+            for entry in entries:
+                if not entry["closed"]:
+                    entry["future"].cancel()
+                    _close(entry, cancelled=True)
 
     def _mux_infer(self, request, client_timeout, trace, idempotent=True):
         """One multiplexed-stream infer under the retry/breaker rules,
@@ -786,6 +996,8 @@ class InferenceServerClient(InferenceServerClientBase):
                 compression_algorithm=compression_algorithm,
                 idempotent=sequence_is_idempotent(sequence_id),
                 trace=trace,
+                routing_key=self._request_routing_key(request),
+                hedgeable=self._request_hedgeable(request),
             )
             with trace.stage("deserialize"):
                 result = InferResult(response)
@@ -794,6 +1006,18 @@ class InferenceServerClient(InferenceServerClientBase):
             raise
         trace.finish()
         return result
+
+    def _request_routing_key(self, request):
+        """The consistent-hash key of a built request, read from the
+        policy's key parameter (zero work unless such a policy is on)."""
+        return request_routing_key(request, self._pool.key_parameter)
+
+    def _request_hedgeable(self, request) -> bool:
+        """Requests referencing single-writer buffers (shm-ring tickets,
+        shared-memory regions) never hedge — shared classification in
+        :func:`client_tpu.grpc._utils.request_is_hedgeable` (checked
+        only while hedging is armed)."""
+        return self._hedge is None or request_is_hedgeable(request)
 
     @staticmethod
     def prepare_request(
@@ -874,6 +1098,8 @@ class InferenceServerClient(InferenceServerClientBase):
                 compression_algorithm=compression_algorithm,
                 idempotent=not _is_sequence_request(request),
                 trace=trace,
+                routing_key=self._request_routing_key(request),
+                hedgeable=self._request_hedgeable(request),
             )
             with trace.stage("deserialize"):
                 result = InferResult(response)
@@ -1004,8 +1230,16 @@ class InferenceServerClient(InferenceServerClientBase):
         def _open(request_iterator, timeout=stream_timeout):
             # bound to the pool's CURRENT endpoint at each (re)open, so a
             # reconnect after UNAVAILABLE also fails over to a healthy
-            # replica instead of re-dialing the dead one
-            return self._stub_for(self._pool.pick().url).ModelStreamInfer(
+            # replica instead of re-dialing the dead one. The pin moves
+            # with it: stream traffic is counted per STREAM (decoupled
+            # requests have no per-request bracket) and excluded from the
+            # routing policies' load signals.
+            endpoint = self._pool.pick()
+            if self._stream_endpoint is not None:
+                self._pool.unpin_stream(self._stream_endpoint)
+            self._stream_endpoint = endpoint
+            self._pool.pin_stream(endpoint)
+            return self._stub_for(endpoint.url).ModelStreamInfer(
                 request_iterator,
                 metadata=metadata,
                 timeout=timeout,
@@ -1068,6 +1302,9 @@ class InferenceServerClient(InferenceServerClientBase):
         if self._stream is not None:
             self._stream.close(cancel_requests=cancel_requests)
             self._stream = None
+        if self._stream_endpoint is not None:
+            self._pool.unpin_stream(self._stream_endpoint)
+            self._stream_endpoint = None
 
 
 def _grpc_compression(algorithm: Optional[str]):

@@ -29,6 +29,36 @@ def rpc_error_to_exception(rpc_error: grpc.RpcError) -> InferenceServerException
     )
 
 
+def request_routing_key(request, key_parameter: Optional[str]):
+    """The consistent-hash routing key of a built ModelInferRequest,
+    read from the policy's key parameter (both gRPC clients; zero work
+    when no keyed policy is installed — pass key_parameter=None)."""
+    if key_parameter is None:
+        return None
+    if key_parameter in request.parameters:
+        value = request.parameters[key_parameter]
+        return value.string_param or value.int64_param
+    return None
+
+
+def request_is_hedgeable(request) -> bool:
+    """False when a ModelInferRequest references a single-writer buffer
+    — an shm-ring ticket or a shared-memory region on any input/output:
+    two servers racing to fill one client-owned buffer would corrupt
+    whichever response loses, so such requests never hedge. One helper
+    so both gRPC clients classify identically (call only while hedging
+    is armed)."""
+    if "shm_ring_region" in request.parameters:
+        return False
+    for output in request.outputs:
+        if "shared_memory_region" in output.parameters:
+            return False
+    for tensor in request.inputs:
+        if "shared_memory_region" in tensor.parameters:
+            return False
+    return True
+
+
 def is_sequence_request(request) -> bool:
     """True when a prepared ModelInferRequest carries sequence state
     (such requests are non-idempotent and must never be auto-retried)."""

@@ -28,9 +28,18 @@ from client_tpu.grpc._service_stubs import GRPCInferenceServiceStub
 from client_tpu.grpc._utils import (
     get_inference_request,
     is_sequence_request as _is_sequence_request,
+    request_is_hedgeable,
+    request_routing_key,
     rpc_error_to_exception,
 )
-from client_tpu.lifecycle import EndpointPool, status_is_unavailable
+from client_tpu.lifecycle import (
+    EndpointPool,
+    failover_retry_policy,
+    grpc_status_is_endpoint_outage,
+    hedged_send_async,
+    resolve_hedge_policy,
+    status_is_unavailable,
+)
 from client_tpu.observability.trace import (
     NOOP_TRACE,
     TRACEPARENT_HEADER,
@@ -75,34 +84,49 @@ class InferenceServerClient(InferenceServerClientBase):
         endpoint_cooldown_s: float = 1.0,
         logger=None,
         stream_mode: bool = False,
+        routing_policy=None,
+        hedge_policy=None,
     ):
         """``url`` may be a single ``host:port``, a comma list, or an
         :class:`~client_tpu.lifecycle.EndpointPool`; ``urls=[...]`` names
         replica endpoints. One channel per endpoint (created lazily);
-        unary RPCs target a sticky primary and fail over — immediately,
-        no backoff sleep — when an endpoint answers UNAVAILABLE or the
-        connection dies; recovering endpoints must pass a ``ServerReady``
-        probe first. ``stream_infer`` binds to the endpoint current at
-        stream open.
+        unary RPCs route per ``routing_policy`` — sticky primary by
+        default, or ``round_robin`` / ``least_outstanding`` / ``p2c`` /
+        ``consistent_hash`` (affinity on the ``routing_key`` request
+        parameter) — and fail over, immediately, no backoff sleep, when
+        an endpoint answers UNAVAILABLE or the connection dies;
+        recovering endpoints must pass a ``ServerReady`` probe first.
+        ``stream_infer`` binds to the endpoint current at stream open.
+
+        ``hedge_policy`` (seconds, ``"p95"``, or a
+        :class:`~client_tpu.lifecycle.HedgePolicy`) arms request
+        hedging: an idempotent infer that outlives the hedge delay
+        launches one duplicate on a different endpoint, first response
+        wins, the loser is cancelled without touching telemetry or retry
+        counts. Sequence requests and requests carrying shm-ring tickets
+        never hedge.
 
         ``stream_mode=True`` routes every unary :meth:`infer` over one
         long-lived multiplexed ``ModelStreamInfer`` stream (correlation
         ids, concurrent server-side execution), amortizing per-RPC setup
         — the small-request fast path. Requests with explicit
-        ``request_id`` must keep them unique while in flight."""
+        ``request_id`` must keep them unique while in flight. The stream
+        pins one endpoint, so routing policies and hedging apply only at
+        (re)open, not per request."""
         super().__init__()
         self._verbose = verbose
         self._stream_mode = stream_mode
         self._mux = None
         self._pool = EndpointPool.resolve(
-            url, urls, cooldown_s=endpoint_cooldown_s, logger=logger
+            url,
+            urls,
+            cooldown_s=endpoint_cooldown_s,
+            logger=logger,
+            routing_policy=routing_policy,
         )
+        self._hedge = resolve_hedge_policy(hedge_policy)
         if self._pool.size > 1 and retry_policy is None:
-            retry_policy = RetryPolicy(
-                max_attempts=2 * self._pool.size,
-                initial_backoff_s=0.02,
-                max_backoff_s=0.5,
-            )
+            retry_policy = failover_retry_policy(self._pool.size)
         self._retry_policy = retry_policy
         self._circuit_breaker = circuit_breaker
         self._tracer = tracer
@@ -150,6 +174,9 @@ class InferenceServerClient(InferenceServerClientBase):
             self._credentials = None
         self._channels: Dict[str, grpc.aio.Channel] = {}
         self._stubs: Dict[str, GRPCInferenceServiceStub] = {}
+        # live stream_infer iterators whose endpoint pin is still open
+        # (close() releases any a caller abandoned without cancelling)
+        self._pinned_stream_iterators = set()
         # primary-bound aliases (stream_infer uses them)
         self._channel = self._channel_for(self._pool.urls[0])
         self._client_stub = self._stub_for(self._pool.urls[0])
@@ -186,22 +213,29 @@ class InferenceServerClient(InferenceServerClientBase):
         except grpc.RpcError:
             return False
 
-    async def _pick_endpoint(self, budget_s: Optional[float] = None):
+    async def _pick_endpoint(
+        self,
+        budget_s: Optional[float] = None,
+        exclude=None,
+        key=None,
+    ):
         """Pool choice for the next attempt; recovering endpoints pass a
-        ServerReady probe first, budgeted against the attempt timeout."""
+        ServerReady probe first, budgeted against the attempt timeout.
+        ``exclude`` asks for an endpoint other than the one given (the
+        hedge path); ``key`` is the consistent-hash routing key."""
         pool = self._pool
         probe_timeout = 1.0
         if budget_s:
             probe_timeout = min(1.0, max(0.05, budget_s / pool.size))
         for _ in range(pool.size):
-            endpoint = pool.pick()
+            endpoint = pool.pick(key=key, exclude=exclude)
             if not pool.needs_probe(endpoint):
                 return endpoint
             if await self._probe_endpoint(endpoint, timeout=probe_timeout):
                 pool.mark_up(endpoint)
                 return endpoint
             pool.mark_down(endpoint)
-        return pool.pick()
+        return pool.pick(key=key, exclude=exclude)
 
     def _metadata(self, headers: Optional[Dict[str, str]]):
         request = Request(headers or {})
@@ -218,6 +252,8 @@ class InferenceServerClient(InferenceServerClientBase):
         idempotent=True,
         probe=False,
         trace=NOOP_TRACE,
+        routing_key=None,
+        hedgeable=True,
     ):
         """One RPC under the retry/deadline/breaker rules.
 
@@ -227,6 +263,9 @@ class InferenceServerClient(InferenceServerClientBase):
         breaker accounting (a probe reports current state; its failures
         during a restart must not poison a shared breaker). An active
         ``trace`` records one "request" span per attempt.
+        ``routing_key`` feeds consistent-hash affinity; ``hedgeable``
+        (with the client's hedge policy armed and ``idempotent``) lets
+        the attempt launch a tail hedge on a second endpoint.
         """
         metadata = self._metadata(headers)
         if probe:
@@ -243,9 +282,9 @@ class InferenceServerClient(InferenceServerClientBase):
                 raise rpc_error_to_exception(e) from None
         pool = self._pool
 
-        async def _send(attempt_timeout):
-            endpoint = await self._pick_endpoint(attempt_timeout)
-            started = pool.begin(endpoint)
+        async def _raw_send(endpoint, attempt_timeout):
+            # one attempt against a SPECIFIC endpoint; pool begin/finish
+            # bracketing belongs to the caller (plain or hedged)
             try:
                 value = await getattr(self._stub_for(endpoint.url), name)(
                     request,
@@ -254,23 +293,64 @@ class InferenceServerClient(InferenceServerClientBase):
                     compression=compression,
                 )
             except grpc.RpcError as e:
-                pool.finish(endpoint, started, ok=False)
                 exc = rpc_error_to_exception(e)
-                if status_is_unavailable(exc.status()):
-                    # draining/dead endpoint: bench it; with an
-                    # alternative, skip the backoff and fail over NOW
-                    pool.observe(endpoint, token=exc.status())
+                if grpc_status_is_endpoint_outage(exc.status()):
+                    # draining/dead endpoint — or a server that CANCELLED
+                    # an accepted RPC mid-shutdown (local cancellation
+                    # raises CancelledError, never an RpcError): bench
+                    # it; with an alternative, skip the backoff and fail
+                    # over NOW
+                    pool.observe(
+                        endpoint, token="StatusCode.UNAVAILABLE"
+                    )
                     if pool.has_alternative(endpoint):
                         exc.retry_backoff_cap_s = 0.0
                 raise exc from None
-            except BaseException:
-                # cancellation or an unwrapped error: close the bracket
-                # so the outstanding gauge never leaks
-                pool.finish(endpoint, started, ok=False)
-                raise
-            pool.finish(endpoint, started, ok=True)
             pool.observe(endpoint, ok=True)
             return value
+
+        hedge = self._hedge if (hedgeable and idempotent) else None
+        if hedge is not None:
+
+            async def _send(attempt_timeout):
+                return await hedged_send_async(
+                    pool,
+                    hedge,
+                    lambda budget, exclude: self._pick_endpoint(
+                        budget, exclude=exclude, key=routing_key
+                    ),
+                    _raw_send,
+                    attempt_timeout,
+                )
+
+        else:
+
+            async def _send(attempt_timeout):
+                endpoint = await self._pick_endpoint(
+                    attempt_timeout, key=routing_key
+                )
+                started = pool.begin(endpoint)
+                try:
+                    value = await _raw_send(endpoint, attempt_timeout)
+                except asyncio.CancelledError:
+                    # cancellation says nothing about the endpoint: close
+                    # the bracket without booking an error
+                    pool.finish(endpoint, started, ok=False, cancelled=True)
+                    raise
+                except InferenceServerException as e:
+                    # the token keeps client-fault codes (INVALID_ARGUMENT
+                    # and kin) out of consecutive-error ejection
+                    pool.finish(
+                        endpoint, started, ok=False, token=e.status()
+                    )
+                    raise
+                except BaseException:
+                    # an unwrapped failure: close the bracket so the
+                    # outstanding gauge never leaks
+                    pool.finish(endpoint, started, ok=False)
+                    raise
+                pool.finish(endpoint, started, ok=True)
+                return value
 
         return await run_with_resilience_async(
             trace.wrap_attempt_async(_send),
@@ -333,6 +413,11 @@ class InferenceServerClient(InferenceServerClientBase):
         if self._mux is not None:
             mux, self._mux = self._mux, None
             await mux.close()
+        # release pins of stream iterators the caller abandoned without
+        # cancelling — the snapshot's pinned_streams must not outlive
+        # the client that counted them
+        for iterator in list(self._pinned_stream_iterators):
+            iterator._unpin()
         for channel in self._channels.values():
             await channel.close()
 
@@ -653,6 +738,8 @@ class InferenceServerClient(InferenceServerClientBase):
                 compression=_grpc_compression(compression_algorithm),
                 idempotent=not _is_sequence_request(request),
                 trace=trace,
+                routing_key=self._request_routing_key(request),
+                hedgeable=self._request_hedgeable(request),
             )
             with trace.stage("deserialize"):
                 result = InferResult(response)
@@ -661,6 +748,18 @@ class InferenceServerClient(InferenceServerClientBase):
             raise
         trace.finish()
         return result
+
+    def _request_routing_key(self, request):
+        """The consistent-hash key of a built request, read from the
+        policy's key parameter (zero work unless such a policy is on)."""
+        return request_routing_key(request, self._pool.key_parameter)
+
+    def _request_hedgeable(self, request) -> bool:
+        """Requests referencing single-writer buffers (shm-ring tickets,
+        shared-memory regions) never hedge — shared classification in
+        :func:`client_tpu.grpc._utils.request_is_hedgeable` (checked
+        only while hedging is armed)."""
+        return self._hedge is None or request_is_hedgeable(request)
 
     async def infer(
         self,
@@ -746,6 +845,8 @@ class InferenceServerClient(InferenceServerClientBase):
                 compression=_grpc_compression(compression_algorithm),
                 idempotent=sequence_is_idempotent(sequence_id),
                 trace=trace,
+                routing_key=self._request_routing_key(request),
+                hedgeable=self._request_hedgeable(request),
             )
             with trace.stage("deserialize"):
                 result = InferResult(response)
@@ -786,22 +887,41 @@ class InferenceServerClient(InferenceServerClientBase):
                 yield request
 
         # bound to the pool's current endpoint at open (draining/dead
-        # endpoints are routed around; the stream then stays on it)
-        call = self._stub_for(self._pool.pick().url).ModelStreamInfer(
+        # endpoints are routed around; the stream then stays on it).
+        # Stream traffic is counted as a PINNED STREAM on the endpoint,
+        # not per request: a decoupled request may produce N responses,
+        # so there is no per-request begin/finish to bracket — routing
+        # policies deliberately exclude pinned-stream load from their
+        # signals (snapshot() surfaces the pin count for visibility).
+        pool = self._pool
+        endpoint = pool.pick()
+        call = self._stub_for(endpoint.url).ModelStreamInfer(
             _request_iterator(),
             metadata=self._metadata(headers),
             timeout=stream_timeout,
             compression=_grpc_compression(compression_algorithm),
         )
+        pool.pin_stream(endpoint)
+        registry = self._pinned_stream_iterators
 
         class _ResponseIterator:
             """Async iterator of (result, error); cancellable."""
 
             def __init__(self, grpc_call):
                 self._call = grpc_call
+                self._pinned = True
+                registry.add(self)
+
+            def _unpin(self):
+                if self._pinned:
+                    self._pinned = False
+                    pool.unpin_stream(endpoint)
+                    registry.discard(self)
 
             def cancel(self) -> bool:
-                return self._call.cancel()
+                cancelled = self._call.cancel()
+                self._unpin()
+                return cancelled
 
             def __aiter__(self):
                 return self
@@ -810,10 +930,13 @@ class InferenceServerClient(InferenceServerClientBase):
                 try:
                     response = await self._call.read()
                 except asyncio.CancelledError:
+                    self._unpin()
                     raise StopAsyncIteration from None
                 except grpc.RpcError as e:
+                    self._unpin()
                     raise rpc_error_to_exception(e) from None
                 if response == grpc.aio.EOF:
+                    self._unpin()
                     raise StopAsyncIteration
                 if response.error_message:
                     return None, InferenceServerException(

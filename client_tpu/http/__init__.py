@@ -135,11 +135,16 @@ class InferenceServerClient:
         urls=None,
         endpoint_cooldown_s: float = 1.0,
         logger=None,
+        routing_policy=None,
+        hedge_policy=None,
     ):
         """``url`` may be a single ``host:port``, a comma list, or an
         :class:`~client_tpu.lifecycle.EndpointPool`; ``urls=[...]`` names
-        replica endpoints for health-checked failover (see the aio
-        client's docs — this veneer passes both straight through)."""
+        replica endpoints for health-checked failover, ``routing_policy``
+        selects among them (round_robin / least_outstanding / p2c /
+        consistent_hash) and ``hedge_policy`` arms tail hedging (see the
+        aio client's docs — this veneer passes all of it straight
+        through)."""
         self._runner = EventLoopRunner(name=f"client-tpu-http[{url}]")
         self._aio_client = _aio.InferenceServerClient(
             url,
@@ -155,6 +160,8 @@ class InferenceServerClient:
             urls=urls,
             endpoint_cooldown_s=endpoint_cooldown_s,
             logger=logger,
+            routing_policy=routing_policy,
+            hedge_policy=hedge_policy,
         )
 
     # plugin registry delegates to the aio client so headers flow through it

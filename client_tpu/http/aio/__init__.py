@@ -32,7 +32,13 @@ from client_tpu.http._utils import (
     raise_if_error,
     retry_after_seconds,
 )
-from client_tpu.lifecycle import EndpointPool, status_is_unavailable
+from client_tpu.lifecycle import (
+    EndpointPool,
+    failover_retry_policy,
+    hedged_send_async,
+    resolve_hedge_policy,
+    status_is_unavailable,
+)
 from client_tpu.observability.trace import (
     NOOP_TRACE,
     TRACEPARENT_HEADER,
@@ -96,6 +102,18 @@ class InferenceServerClient(InferenceServerClientBase):
         (serialize, per-attempt send/wait, deserialize) and propagates a
         W3C ``traceparent`` header the server front-ends extract. Off by
         default (no spans, no header).
+    routing_policy:
+        None (sticky primary) or ``round_robin`` / ``least_outstanding``
+        / ``p2c`` / ``consistent_hash`` (affinity on the ``routing_key``
+        request parameter) — selection over the pool's live
+        per-endpoint outstanding/EWMA signals.
+    hedge_policy:
+        Arms request hedging for idempotent requests: seconds (fixed
+        trigger), ``"p95"`` (latency-derived), or a
+        :class:`~client_tpu.lifecycle.HedgePolicy`. First response wins;
+        the losing attempt is cancelled and never double-counted in
+        pool telemetry or retries. Requests referencing shared-memory
+        regions or shm-ring tickets never hedge.
     """
 
     def __init__(
@@ -113,12 +131,19 @@ class InferenceServerClient(InferenceServerClientBase):
         urls=None,
         endpoint_cooldown_s: float = 1.0,
         logger=None,
+        routing_policy=None,
+        hedge_policy=None,
     ):
         super().__init__()
         scheme = "https" if ssl else "http"
         self._pool = EndpointPool.resolve(
-            url, urls, cooldown_s=endpoint_cooldown_s, logger=logger
+            url,
+            urls,
+            cooldown_s=endpoint_cooldown_s,
+            logger=logger,
+            routing_policy=routing_policy,
         )
+        self._hedge = resolve_hedge_policy(hedge_policy)
         for endpoint_url in self._pool.urls:
             if "://" in endpoint_url:
                 raise InferenceServerException(
@@ -129,11 +154,7 @@ class InferenceServerClient(InferenceServerClientBase):
             # Failover needs attempts to spend: give multi-endpoint
             # clients a small retry budget (the backoff is skipped
             # entirely when another endpoint is available).
-            retry_policy = RetryPolicy(
-                max_attempts=2 * self._pool.size,
-                initial_backoff_s=0.02,
-                max_backoff_s=0.5,
-            )
+            retry_policy = failover_retry_policy(self._pool.size)
         self._verbose = verbose
         self._ssl_context = ssl_context
         self._timeout = aiohttp.ClientTimeout(
@@ -243,25 +264,36 @@ class InferenceServerClient(InferenceServerClientBase):
             return False
         return status == 200
 
-    async def _pick_endpoint(self, budget_s: Optional[float] = None):
+    async def _pick_endpoint(
+        self,
+        budget_s: Optional[float] = None,
+        exclude=None,
+        key=None,
+    ):
         """The pool's choice for the next attempt; endpoints coming back
         from a down period must pass a readiness probe first (a draining
         server answers its health endpoint long before it serves).
         Probes are budgeted against ``budget_s`` (the remaining attempt
-        timeout) so they can never blow the caller's deadline."""
+        timeout) so they can never blow the caller's deadline.
+        ``exclude`` asks for an endpoint other than the one given (the
+        hedge path); ``key`` is the consistent-hash routing key."""
         pool = self._pool
         probe_timeout = 1.0
         if budget_s:
             probe_timeout = min(1.0, max(0.05, budget_s / pool.size))
         for _ in range(pool.size):
-            endpoint = pool.pick()
+            endpoint = pool.pick(key=key, exclude=exclude)
             if not pool.needs_probe(endpoint):
                 return endpoint
             if await self._probe_endpoint(endpoint, timeout=probe_timeout):
                 pool.mark_up(endpoint)
                 return endpoint
             pool.mark_down(endpoint)
-        return pool.pick()
+        return pool.pick(key=key, exclude=exclude)
+
+    @staticmethod
+    def _result_ok(result) -> bool:
+        return str(result[0]).startswith("2")
 
     async def _execute(
         self,
@@ -274,6 +306,8 @@ class InferenceServerClient(InferenceServerClientBase):
         idempotent=True,
         probe=False,
         trace=NOOP_TRACE,
+        routing_key=None,
+        hedgeable=True,
     ) -> tuple:
         suffix = f"/{path}{build_query_string(query_params)}"
         prepared_headers = self._prepare_headers(headers)
@@ -286,21 +320,21 @@ class InferenceServerClient(InferenceServerClientBase):
                 method, url, data, prepared_headers, timeout
             )
         pool = self._pool
+        hedge = self._hedge if (hedgeable and idempotent) else None
 
-        async def _attempt(attempt_timeout):
-            endpoint = await self._pick_endpoint(attempt_timeout)
+        async def _raw(endpoint, attempt_timeout, attempt_trace):
+            # one attempt against a SPECIFIC endpoint; the pool
+            # begin/finish bracket belongs to the caller
             url = self._endpoint_base(endpoint) + suffix
             if self._verbose:
                 size = f" ({len(data)} bytes)" if data else ""
                 print(f"{method} {url}{size}")
-            started = pool.begin(endpoint)
             try:
                 result = await self._request_once(
                     method, url, data, prepared_headers, attempt_timeout,
-                    trace=trace,
+                    trace=attempt_trace,
                 )
             except InferenceServerException as e:
-                pool.finish(endpoint, started, ok=False)
                 if e.status() == CONNECTION_ERROR_STATUS:
                     # dead endpoint: bench it; with an alternative
                     # available the retry loop skips the backoff sleep
@@ -308,13 +342,7 @@ class InferenceServerClient(InferenceServerClientBase):
                     if pool.has_alternative(endpoint):
                         e.retry_backoff_cap_s = 0.0
                 raise
-            except BaseException:
-                # cancellation or an unwrapped error: close the bracket
-                # so the outstanding gauge never leaks
-                pool.finish(endpoint, started, ok=False)
-                raise
             token = str(result[0])
-            pool.finish(endpoint, started, ok=token.startswith("2"))
             if status_is_unavailable(token):
                 # draining server: bench it for its own Retry-After hint
                 pool.observe(
@@ -325,6 +353,61 @@ class InferenceServerClient(InferenceServerClientBase):
             else:
                 pool.observe(endpoint, ok=True)
             return result
+
+        if hedge is not None:
+
+            async def _attempt(attempt_timeout):
+                # two racing attempts would interleave send/wait spans on
+                # one trace; the hedged pair records none (wrap_attempt
+                # still records the enclosing "request" span)
+                return await hedged_send_async(
+                    pool,
+                    hedge,
+                    lambda budget, exclude: self._pick_endpoint(
+                        budget, exclude=exclude, key=routing_key
+                    ),
+                    lambda endpoint, attempt_timeout: _raw(
+                        endpoint, attempt_timeout, NOOP_TRACE
+                    ),
+                    attempt_timeout,
+                    value_ok=self._result_ok,
+                    value_token=lambda result: str(result[0]),
+                )
+
+        else:
+
+            async def _attempt(attempt_timeout):
+                endpoint = await self._pick_endpoint(
+                    attempt_timeout, key=routing_key
+                )
+                started = pool.begin(endpoint)
+                try:
+                    result = await _raw(endpoint, attempt_timeout, trace)
+                except asyncio.CancelledError:
+                    # cancellation says nothing about the endpoint: close
+                    # the bracket without booking an error
+                    pool.finish(endpoint, started, ok=False, cancelled=True)
+                    raise
+                except InferenceServerException as e:
+                    pool.finish(
+                        endpoint, started, ok=False, token=e.status()
+                    )
+                    raise
+                except BaseException:
+                    # an unwrapped failure: close the bracket so the
+                    # outstanding gauge never leaks
+                    pool.finish(endpoint, started, ok=False)
+                    raise
+                ok = self._result_ok(result)
+                pool.finish(
+                    endpoint,
+                    started,
+                    ok=ok,
+                    # a 4xx is an error but proves the endpoint healthy:
+                    # the token keeps it out of consecutive-error ejection
+                    token=None if ok else str(result[0]),
+                )
+                return result
 
         status, rbody, rheaders = await run_with_resilience_async(
             _attempt,
@@ -357,7 +440,7 @@ class InferenceServerClient(InferenceServerClientBase):
 
     async def _post(
         self, path, body: bytes, headers, query_params, timeout=None,
-        idempotent=True, trace=NOOP_TRACE,
+        idempotent=True, trace=NOOP_TRACE, routing_key=None, hedgeable=True,
     ) -> tuple:
         return await self._execute(
             "POST",
@@ -368,6 +451,8 @@ class InferenceServerClient(InferenceServerClientBase):
             timeout=timeout,
             idempotent=idempotent,
             trace=trace,
+            routing_key=routing_key,
+            hedgeable=hedgeable,
         )
 
     async def _get_json(self, path, headers, query_params) -> Dict[str, Any]:
@@ -701,6 +786,7 @@ class InferenceServerClient(InferenceServerClientBase):
         query_params: Optional[Dict[str, Any]] = None,
         client_timeout: Optional[float] = None,
         idempotent: bool = True,
+        routing_key=None,
     ) -> InferResult:
         """Send a body built by :meth:`generate_request_body` (reusable —
         deterministic request bodies can be built once and resent; the
@@ -710,11 +796,22 @@ class InferenceServerClient(InferenceServerClientBase):
         Pass ``idempotent=False`` when the prepared body carries sequence
         state so a configured retry policy never auto-retries it; as a
         safety net, bodies whose JSON header names a ``sequence_id`` are
-        detected and demoted to non-idempotent automatically."""
+        detected and demoted to non-idempotent automatically. The same
+        header scan keeps shared-memory bodies out of request hedging
+        (single-writer buffers must not race a duplicate).
+        ``routing_key`` feeds consistent-hash affinity (prepared bodies
+        are opaque here, so the key is the caller's to supply)."""
         if idempotent and self._retry_policy is not None:
             header = body[:json_size] if json_size is not None else body
             if b'"sequence_id"' in header:
                 idempotent = False
+        hedgeable = True
+        if self._hedge is not None:
+            header = body[:json_size] if json_size is not None else body
+            hedgeable = (
+                b"shared_memory_region" not in header
+                and b"shm_ring_region" not in header
+            )
         extra_headers = dict(headers) if headers else {}
         if json_size is not None:
             extra_headers[HEADER_CONTENT_LENGTH] = str(json_size)
@@ -732,6 +829,8 @@ class InferenceServerClient(InferenceServerClientBase):
                 timeout=client_timeout,
                 idempotent=idempotent,
                 trace=trace,
+                routing_key=routing_key,
+                hedgeable=hedgeable,
             )
             with trace.stage("deserialize"):
                 raise_if_error(status, rbody)
@@ -810,6 +909,25 @@ class InferenceServerClient(InferenceServerClientBase):
             if trace.traceparent:
                 extra_headers[TRACEPARENT_HEADER] = trace.traceparent
 
+            routing_key = None
+            key_param = self._pool.key_parameter
+            if key_param is not None and parameters:
+                routing_key = parameters.get(key_param)
+            hedgeable = True
+            if self._hedge is not None:
+                # shm-ring tickets (and any shared-memory region ref) are
+                # single-writer buffers: a hedged duplicate would race
+                hedgeable = not (
+                    (parameters and "shm_ring_region" in parameters)
+                    or any(
+                        inp._parameters.get("shared_memory_region")
+                        for inp in inputs
+                    )
+                    or any(
+                        out._parameters.get("shared_memory_region")
+                        for out in (outputs or ())
+                    )
+                )
             status, rbody, rheaders = await self._post(
                 model_infer_uri(model_name, model_version),
                 body,
@@ -818,6 +936,8 @@ class InferenceServerClient(InferenceServerClientBase):
                 timeout=client_timeout,
                 idempotent=sequence_is_idempotent(sequence_id),
                 trace=trace,
+                routing_key=routing_key,
+                hedgeable=hedgeable,
             )
             with trace.stage("deserialize"):
                 raise_if_error(status, rbody)

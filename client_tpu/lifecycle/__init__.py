@@ -38,22 +38,50 @@ from client_tpu.lifecycle.drain import (
     DrainController,
     ServerDrainingError,
 )
+from client_tpu.lifecycle.hedge import (
+    HedgePolicy,
+    hedged_send_async,
+    resolve_hedge_policy,
+)
 from client_tpu.lifecycle.pool import (
     UNAVAILABLE_TOKENS,
     Endpoint,
     EndpointPool,
+    failover_retry_policy,
+    grpc_status_is_endpoint_outage,
     status_is_unavailable,
+)
+from client_tpu.lifecycle.routing import (
+    ROUTING_POLICY_NAMES,
+    ConsistentHashPolicy,
+    LeastOutstandingPolicy,
+    PowerOfTwoPolicy,
+    RoundRobinPolicy,
+    RoutingPolicy,
+    resolve_routing_policy,
 )
 
 __all__ = [
     "DRAINING",
+    "ROUTING_POLICY_NAMES",
     "SERVING",
     "STATE_VALUES",
     "STOPPED",
     "UNAVAILABLE_TOKENS",
+    "ConsistentHashPolicy",
     "DrainController",
     "Endpoint",
     "EndpointPool",
+    "HedgePolicy",
+    "LeastOutstandingPolicy",
+    "PowerOfTwoPolicy",
+    "RoundRobinPolicy",
+    "RoutingPolicy",
     "ServerDrainingError",
+    "failover_retry_policy",
+    "grpc_status_is_endpoint_outage",
+    "hedged_send_async",
+    "resolve_hedge_policy",
+    "resolve_routing_policy",
     "status_is_unavailable",
 ]

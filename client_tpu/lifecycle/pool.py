@@ -8,20 +8,32 @@ recovering endpoint :meth:`needs_probe`), so one implementation serves
 all four surfaces and tests drive it with a fake clock
 (``tools/clock_lint.py`` covers this package).
 
-Routing is sticky-primary with failover: :meth:`pick` returns the current
-primary until a request against it fails with an unavailability signal
-(connect error, HTTP 503, gRPC UNAVAILABLE — a draining or dead server),
-at which point the endpoint is marked down for ``cooldown_s`` (or the
-server's own ``Retry-After`` hint) and the primary advances. Per-endpoint
-:class:`~client_tpu.resilience.CircuitBreaker` instances (optional) are
-consulted by :meth:`pick` and fed by :meth:`observe`, so a flapping
-endpoint fails fast instead of eating a timeout per attempt.
+Routing defaults to sticky-primary with failover: :meth:`pick` returns
+the current primary until a request against it fails with an
+unavailability signal (connect error, HTTP 503, gRPC UNAVAILABLE — a
+draining or dead server), at which point the endpoint is marked down for
+``cooldown_s`` (or the server's own ``Retry-After`` hint) and the primary
+advances. A :class:`~client_tpu.lifecycle.routing.RoutingPolicy`
+(``routing_policy=``) replaces the sticky scan with load-aware selection
+— round-robin, least-outstanding, power-of-two-choices on the live
+outstanding/EWMA signals, or consistent-hash affinity on a request key.
+Per-endpoint :class:`~client_tpu.resilience.CircuitBreaker` instances
+(optional) are consulted by :meth:`pick` and fed by :meth:`observe`, so a
+flapping endpoint fails fast instead of eating a timeout per attempt.
+
+On top of the reactive down/cooldown machine the pool runs **outlier
+ejection**: an endpoint that fails ``eject_consecutive_errors`` attempts
+in a row, or whose EWMA latency drifts past ``eject_ewma_factor`` x the
+median of its peers, is ejected for ``ejection_cooldown_s`` and must pass
+the same readiness re-probe a benched endpoint does before carrying
+traffic again. Ejection never removes the last healthy endpoint.
 """
 
 import threading
 import time
 from typing import Callable, List, Optional, Sequence, Union
 
+from client_tpu.lifecycle.routing import resolve_routing_policy
 from client_tpu.resilience import CONNECTION_ERROR_STATUS
 
 # Status tokens that mean "this endpoint cannot serve right now" — route
@@ -38,10 +50,73 @@ def status_is_unavailable(token: Optional[str]) -> bool:
     return token.rsplit(".", 1)[-1] in UNAVAILABLE_TOKENS
 
 
+def grpc_status_is_endpoint_outage(token: Optional[str]) -> bool:
+    """The unary-gRPC superset of :func:`status_is_unavailable`: a wire
+    ``CANCELLED`` on a unary call means the SERVER cancelled an accepted
+    RPC — the shutdown race a draining replica can lose (observed: the
+    grpc.aio front-end's stop(grace) window). A locally-cancelled call
+    never produces this token (asyncio raises ``CancelledError``, the
+    sync future raises ``FutureCancelledError`` — neither is an
+    RpcError), so on the unary paths CANCELLED is an endpoint-level
+    outage signal, routed around like UNAVAILABLE."""
+    if status_is_unavailable(token):
+        return True
+    return bool(token) and token.rsplit(".", 1)[-1] == "CANCELLED"
+
+
+def failover_retry_policy(pool_size: int):
+    """The retry policy multi-endpoint clients install by default when
+    the caller supplied none: a small budget (failover needs attempts to
+    spend; the backoff is capped to zero when another endpoint is
+    available), with ``CANCELLED`` added to the retryable gRPC codes —
+    see :func:`grpc_status_is_endpoint_outage` for why a wire CANCELLED
+    is a replica-shutdown signal, and note it is only reachable from an
+    actual RpcError, never from local cancellation."""
+    from client_tpu.resilience import (
+        DEFAULT_RETRYABLE_GRPC_CODES,
+        RetryPolicy,
+    )
+
+    return RetryPolicy(
+        max_attempts=2 * pool_size,
+        initial_backoff_s=0.02,
+        max_backoff_s=0.5,
+        retryable_grpc=frozenset(
+            DEFAULT_RETRYABLE_GRPC_CODES | {"CANCELLED"}
+        ),
+    )
+
+
 # EWMA smoothing for the per-endpoint latency estimate: ~the last 20
 # requests dominate, old incidents decay instead of poisoning the mean
 # forever (the "least-EWMA-latency" routing policy input).
 EWMA_ALPHA = 0.1
+
+# Status tokens that mean "the endpoint answered and rejected the
+# REQUEST" — the caller's fault, not the endpoint's health. These never
+# count toward consecutive-error ejection (mirrors the resilience
+# layer's client-fault classification; 429 is excluded on purpose — a
+# shedding server is under pressure, which IS a health signal).
+_CLIENT_FAULT_GRPC = frozenset(
+    {
+        "INVALID_ARGUMENT",
+        "NOT_FOUND",
+        "ALREADY_EXISTS",
+        "PERMISSION_DENIED",
+        "UNAUTHENTICATED",
+        "FAILED_PRECONDITION",
+        "OUT_OF_RANGE",
+        "UNIMPLEMENTED",
+    }
+)
+
+
+def _token_is_client_fault(token: str) -> bool:
+    tail = token.rsplit(".", 1)[-1]
+    if tail.isdigit():
+        code = int(tail)
+        return 400 <= code < 500 and code != 429
+    return tail in _CLIENT_FAULT_GRPC
 
 
 class Endpoint:
@@ -59,19 +134,26 @@ class Endpoint:
         "url",
         "circuit_breaker",
         "down_until",
+        "ejected_until",
         "was_down",
         "failures",
         "successes",
         "outstanding",
         "ewma_latency_s",
         "errors",
+        "consecutive_errors",
+        "ejections",
         "reroutes",
+        "pinned_streams",
     )
 
     def __init__(self, url: str, circuit_breaker=None):
         self.url = url
         self.circuit_breaker = circuit_breaker
         self.down_until = 0.0
+        # outlier ejection benches an endpoint on its own clock, composing
+        # with (not replacing) the mark_down cooldown
+        self.ejected_until = 0.0
         # once an endpoint has been marked down, its first use after the
         # cooldown should be a readiness probe, not a real request
         self.was_down = False
@@ -81,7 +163,28 @@ class Endpoint:
         self.outstanding = 0
         self.ewma_latency_s = 0.0
         self.errors = 0
+        self.consecutive_errors = 0
+        self.ejections = 0
         self.reroutes = 0
+        # open bidirectional streams pinned to this endpoint (counted at
+        # open/close, NOT per request — decoupled streams may produce N
+        # responses per request so a per-request bracket is ill-defined;
+        # routing policies deliberately exclude this from their load
+        # signals and it is surfaced for visibility only)
+        self.pinned_streams = 0
+
+    def state(self, now: float) -> str:
+        """The endpoint's health state at ``now``: ``up`` (serving),
+        ``down`` (benched by an unavailability signal), ``ejected``
+        (benched by outlier ejection), or ``probe`` (cooldown elapsed,
+        awaiting a readiness re-probe before real traffic)."""
+        if self.ejected_until and now < self.ejected_until:
+            return "ejected"
+        if self.down_until and now < self.down_until:
+            return "down"
+        if self.was_down:
+            return "probe"
+        return "up"
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return f"Endpoint({self.url!r}, down_until={self.down_until})"
@@ -104,14 +207,28 @@ class EndpointPool:
         :class:`~client_tpu.resilience.CircuitBreaker`; when set,
         :meth:`pick` skips endpoints whose breaker is open and
         :meth:`observe` feeds each endpoint's breaker.
+    routing_policy:
+        None (sticky-primary, the default), a policy name
+        (``round_robin`` / ``least_outstanding`` / ``p2c`` /
+        ``consistent_hash``), or a
+        :class:`~client_tpu.lifecycle.routing.RoutingPolicy` instance.
+    eject_consecutive_errors / eject_ewma_factor / ejection_cooldown_s:
+        Outlier ejection: ``eject_consecutive_errors`` failed attempts
+        in a row (0 disables), or an EWMA latency above
+        ``eject_ewma_factor`` x the median of the other endpoints'
+        EWMAs (0 disables; needs >= 3 endpoints with latency data),
+        eject the endpoint for ``ejection_cooldown_s`` — it re-enters
+        through the same readiness re-probe as a benched endpoint.
+        Ejection never removes the last healthy endpoint.
     clock:
         Injectable monotonic-seconds clock (fake-clock tests).
     logger:
         Optional :class:`~client_tpu.observability.StructuredLogger`.
         When set, failover state changes emit structured events
-        (``endpoint_down`` / ``endpoint_recovered``); when None — the
-        default — each site is a single None-check (the same zero-cost
-        pattern as the resilience layer's attempt-event log).
+        (``endpoint_down`` / ``endpoint_ejected`` /
+        ``endpoint_recovered``); when None — the default — each site is
+        a single None-check (the same zero-cost pattern as the
+        resilience layer's attempt-event log).
     """
 
     def __init__(
@@ -119,6 +236,10 @@ class EndpointPool:
         urls: Union[str, Sequence[str]],
         cooldown_s: float = 1.0,
         breaker_factory: Optional[Callable[[], object]] = None,
+        routing_policy=None,
+        eject_consecutive_errors: int = 5,
+        eject_ewma_factor: float = 4.0,
+        ejection_cooldown_s: float = 5.0,
         clock: Callable[[], float] = time.monotonic,
         logger=None,
     ):
@@ -128,6 +249,9 @@ class EndpointPool:
         if not urls:
             raise ValueError("EndpointPool needs at least one url")
         self.cooldown_s = cooldown_s
+        self.eject_consecutive_errors = eject_consecutive_errors
+        self.eject_ewma_factor = eject_ewma_factor
+        self.ejection_cooldown_s = ejection_cooldown_s
         self._clock = clock
         self._logger = logger
         self._lock = threading.Lock()
@@ -135,9 +259,41 @@ class EndpointPool:
             Endpoint(u, breaker_factory() if breaker_factory else None)
             for u in urls
         ]
+        self._routing_policy = None
+        self._install_policy(resolve_routing_policy(routing_policy))
         self._primary = 0
         # times the primary moved off a failed endpoint (observability)
         self.failovers = 0
+        # outlier ejections across the pool (observability)
+        self.ejections = 0
+        # hedged attempts launched / won by the hedge (fed by the hedge
+        # orchestration; exposed as tpu_client_hedges_total downstream)
+        self.hedges = 0
+        self.hedge_wins = 0
+
+    def _install_policy(self, policy) -> None:
+        # consistent-hash rings must cover the FULL membership (health
+        # filters at lookup); priming here — before any endpoint can be
+        # benched — is what keeps key->endpoint stable across recoveries
+        if policy is not None and hasattr(policy, "prime"):
+            policy.prime([ep.url for ep in self._endpoints])
+        self._routing_policy = policy
+
+    @property
+    def routing_policy(self):
+        return self._routing_policy
+
+    @routing_policy.setter
+    def routing_policy(self, spec) -> None:
+        self._install_policy(resolve_routing_policy(spec))
+
+    @property
+    def key_parameter(self) -> Optional[str]:
+        """The request-parameter name the active policy keys affinity on
+        (None unless a consistent-hash policy is installed) — client
+        surfaces skip the per-request lookup entirely when None."""
+        policy = self._routing_policy
+        return policy.key_parameter if policy is not None else None
 
     @classmethod
     def resolve(
@@ -148,9 +304,13 @@ class EndpointPool:
     ) -> "EndpointPool":
         """The one spot every client constructor funnels through:
         ``url`` may be a host:port, a comma list, or an EndpointPool
-        instance (returned as-is — shareable across clients); ``urls``
+        instance (returned as-is — shareable across clients, though an
+        explicit ``routing_policy`` is installed onto it); ``urls``
         wins when given."""
         if isinstance(url, EndpointPool):
+            policy = kwargs.get("routing_policy")
+            if policy is not None:
+                url.routing_policy = policy
             return url
         if urls:
             return cls(urls, **kwargs)
@@ -180,25 +340,54 @@ class EndpointPool:
     def _up(self, ep: Endpoint, now: float) -> bool:
         if ep.down_until and now < ep.down_until:
             return False
+        if ep.ejected_until and now < ep.ejected_until:
+            return False
         if ep.circuit_breaker is not None and not ep.circuit_breaker.allow():
             return False
         return True
 
+    @staticmethod
+    def _benched_until(ep: Endpoint) -> float:
+        return max(ep.down_until, ep.ejected_until)
+
     # -- selection -----------------------------------------------------------
 
-    def pick(self) -> Endpoint:
-        """The endpoint the next request should target: the sticky
-        primary when healthy, else the next healthy endpoint in rotation.
-        When every endpoint is down, returns the one whose cooldown ends
-        soonest — callers still try it (the server may be back early)."""
+    def pick(self, key=None, exclude: Optional[Endpoint] = None) -> Endpoint:
+        """The endpoint the next request should target. With a routing
+        policy installed, the policy selects among the currently healthy
+        endpoints (on their live outstanding/EWMA signals, or on ``key``
+        for consistent-hash affinity); without one — or when a keyed
+        policy gets no key — the sticky-primary scan applies. ``exclude``
+        removes one endpoint from consideration (the hedge path asks for
+        somewhere *different*). When every endpoint is down, returns the
+        one whose cooldown ends soonest — callers still try it (the
+        server may be back early)."""
         with self._lock:
             now = self._clock()
             n = len(self._endpoints)
+            policy = self._routing_policy
+            if policy is not None:
+                candidates = [
+                    ep
+                    for ep in self._endpoints
+                    if ep is not exclude and self._up(ep, now)
+                ]
+                if candidates:
+                    choice = policy.select(candidates, key)
+                    if choice is not None:
+                        return choice
             for offset in range(n):
                 ep = self._endpoints[(self._primary + offset) % n]
-                if self._up(ep, now):
+                if ep is not exclude and self._up(ep, now):
                     return ep
-            return min(self._endpoints, key=lambda e: e.down_until)
+            if exclude is not None:
+                # nothing else healthy: the excluded endpoint (if up) is
+                # all there is — callers detect the identity and skip
+                # hedging rather than duplicate onto the same endpoint
+                for ep in self._endpoints:
+                    if self._up(ep, now):
+                        return ep
+            return min(self._endpoints, key=self._benched_until)
 
     def has_alternative(self, ep: Optional[Endpoint]) -> bool:
         """True when a request that just failed on ``ep`` (None: on
@@ -212,13 +401,14 @@ class EndpointPool:
             )
 
     def needs_probe(self, ep: Endpoint) -> bool:
-        """True when ``ep`` is coming back from a down period and should
-        pass a readiness probe before carrying real traffic. Single-
-        endpoint pools never probe — there is no alternative to protect."""
+        """True when ``ep`` is coming back from a down/ejected period and
+        should pass a readiness probe before carrying real traffic.
+        Single-endpoint pools never probe — there is no alternative to
+        protect."""
         if len(self._endpoints) == 1:
             return False
         with self._lock:
-            return ep.was_down and self._clock() >= ep.down_until
+            return ep.was_down and self._clock() >= self._benched_until(ep)
 
     # -- per-endpoint telemetry ----------------------------------------------
 
@@ -232,45 +422,183 @@ class EndpointPool:
             ep.outstanding += 1
         return self._clock()
 
-    def finish(self, ep: Endpoint, started: float, ok: bool) -> None:
+    def finish(
+        self,
+        ep: Endpoint,
+        started: float,
+        ok: bool,
+        cancelled: bool = False,
+        token: Optional[str] = None,
+    ) -> float:
         """Close the begin/finish bracket: drop the outstanding count,
         fold a successful attempt's latency into the EWMA, count an
-        error. Endpoint-health signals (503/UNAVAILABLE benching) stay
-        with :meth:`observe` — a 400 is an error here but says nothing
-        about endpoint health there."""
+        error; returns the attempt latency in seconds (the hedge trigger
+        feeds on it). ``cancelled=True`` (a hedge loser, or a locally
+        cancelled attempt) books neither a latency sample nor an error —
+        cancellation says nothing about the endpoint.
+
+        Ejection triggers live here: ``eject_consecutive_errors``
+        failures in a row, or — on a success — an EWMA that drifted past
+        ``eject_ewma_factor`` x the median of the peers' EWMAs (the
+        slow-replica outlier: it answers, just too late to wait for).
+        ``token`` (the failed attempt's status, when the caller has one)
+        keeps *client-fault* responses — 4xx, INVALID_ARGUMENT and kin —
+        out of the consecutive-error count entirely: the endpoint
+        answered, which proves it healthy, so such a response RESETS the
+        streak rather than feeding it (a workload of consistently
+        rejected requests must never eject a healthy replica).
+        Endpoint-health *benching* signals (503/UNAVAILABLE) stay with
+        :meth:`observe`."""
         latency_s = self._clock() - started
+        event = None
         with self._lock:
             if ep.outstanding > 0:
                 ep.outstanding -= 1
+            if cancelled:
+                return latency_s
             if ok:
+                ep.consecutive_errors = 0
                 if ep.ewma_latency_s:
                     ep.ewma_latency_s += EWMA_ALPHA * (
                         latency_s - ep.ewma_latency_s
                     )
                 else:
                     ep.ewma_latency_s = latency_s
+                event = self._maybe_eject_outlier(ep)
             else:
                 ep.errors += 1
+                if token is not None and _token_is_client_fault(token):
+                    ep.consecutive_errors = 0
+                else:
+                    ep.consecutive_errors += 1
+                    if (
+                        self.eject_consecutive_errors
+                        and ep.consecutive_errors
+                        >= self.eject_consecutive_errors
+                    ):
+                        event = self._eject(ep, "consecutive_errors")
+        if event is not None and self._logger is not None:
+            self._logger.warning("endpoint_ejected", **event)
+        return latency_s
+
+    def _maybe_eject_outlier(self, ep: Endpoint):
+        """EWMA-vs-peer-median ejection check (pool lock held). Needs at
+        least two peers with latency data — below that, "slower than the
+        median" is just "the two replicas differ"."""
+        if not self.eject_ewma_factor or len(self._endpoints) < 3:
+            return None
+        if not ep.ewma_latency_s:
+            return None
+        if ep.successes < 10:
+            # a cold endpoint's EWMA is one sample deep — a warmup/jit
+            # spike would read as an "outlier" and eject a healthy
+            # replica before its estimate has decayed toward reality
+            return None
+        peers = sorted(
+            other.ewma_latency_s
+            for other in self._endpoints
+            if other is not ep and other.ewma_latency_s > 0
+        )
+        if len(peers) < 2:
+            return None
+        median = peers[len(peers) // 2]
+        if median <= 0 or ep.ewma_latency_s <= self.eject_ewma_factor * median:
+            return None
+        return self._eject(ep, "ewma_outlier")
+
+    def _eject(self, ep: Endpoint, reason: str):
+        """Take ``ep`` out of rotation for the ejection cooldown (pool
+        lock held). Returns the structured-log event, or None when the
+        ejection was refused (it would have removed the last healthy
+        endpoint). Re-entry goes through the same readiness re-probe a
+        benched endpoint takes."""
+        now = self._clock()
+        if ep.ejected_until and now < ep.ejected_until:
+            return None  # already ejected; don't inflate the counters
+        if not any(
+            other is not ep and self._up(other, now)
+            for other in self._endpoints
+        ):
+            return None
+        ep.ejected_until = now + self.ejection_cooldown_s
+        ep.was_down = True
+        ep.consecutive_errors = 0
+        ep.ejections += 1
+        self.ejections += 1
+        n = len(self._endpoints)
+        if n > 1 and self._endpoints[self._primary] is ep:
+            for offset in range(1, n):
+                candidate = (self._primary + offset) % n
+                if self._up(self._endpoints[candidate], now):
+                    self._primary = candidate
+                    self.failovers += 1
+                    ep.reroutes += 1
+                    break
+        return {
+            "endpoint": ep.url,
+            "reason": reason,
+            "cooldown_s": round(self.ejection_cooldown_s, 3),
+            "ejections": ep.ejections,
+        }
+
+    # -- hedging bookkeeping -------------------------------------------------
+
+    def note_hedge(self) -> None:
+        """One hedge attempt launched (tpu_client_hedges_total)."""
+        with self._lock:
+            self.hedges += 1
+
+    def note_hedge_win(self) -> None:
+        """The hedge attempt answered before the primary did."""
+        with self._lock:
+            self.hedge_wins += 1
+
+    # -- pinned streams ------------------------------------------------------
+
+    def pin_stream(self, ep: Endpoint) -> None:
+        """One bidirectional stream opened against ``ep``. Stream traffic
+        is counted at the STREAM granularity (decoupled models produce N
+        responses per request, so a per-request bracket is ill-defined)
+        and is deliberately excluded from the routing policies' load
+        signals — it is surfaced in :meth:`snapshot` for visibility."""
+        with self._lock:
+            ep.pinned_streams += 1
+
+    def unpin_stream(self, ep: Endpoint) -> None:
+        with self._lock:
+            if ep.pinned_streams > 0:
+                ep.pinned_streams -= 1
 
     def snapshot(self) -> dict:
         """The pool's live telemetry in one consistent read: per-endpoint
-        outstanding/EWMA/counters plus the pool-level failover count —
-        what the perf report's "Client metrics" section prints and what
-        the scale-out routing policies will consume."""
+        outstanding/EWMA/counters plus the pool-level failover, ejection
+        and hedge counts — what the perf report's "Client metrics"
+        section prints and what the routing policies consume. Each
+        endpoint carries its health ``state`` (``up`` / ``down`` /
+        ``ejected`` / ``probe``) so an ejected endpoint is never mistaken
+        for a healthy idle one."""
+        policy = self._routing_policy
         with self._lock:
             now = self._clock()
             return {
                 "primary": self._endpoints[self._primary].url,
+                "policy": policy.name if policy is not None else "sticky",
                 "failovers": self.failovers,
+                "ejections": self.ejections,
+                "hedges": self.hedges,
+                "hedge_wins": self.hedge_wins,
                 "endpoints": [
                     {
                         "url": ep.url,
+                        "state": ep.state(now),
                         "outstanding": ep.outstanding,
                         "ewma_latency_us": round(ep.ewma_latency_s * 1e6, 1),
                         "successes": ep.successes,
                         "errors": ep.errors,
                         "marked_down": ep.failures,
+                        "ejections": ep.ejections,
                         "reroutes": ep.reroutes,
+                        "pinned_streams": ep.pinned_streams,
                         "down": bool(ep.down_until and now < ep.down_until),
                     }
                     for ep in self._endpoints
@@ -313,7 +641,9 @@ class EndpointPool:
         with self._lock:
             recovered = ep.was_down
             ep.down_until = 0.0
+            ep.ejected_until = 0.0
             ep.was_down = False
+            ep.consecutive_errors = 0
         if recovered and self._logger is not None:
             self._logger.info("endpoint_recovered", endpoint=ep.url)
 
@@ -330,8 +660,16 @@ class EndpointPool:
         better than our default) or ``cooldown_s``. Other tokens (4xx,
         model errors) say nothing about endpoint health."""
         if ok:
-            self.mark_up(ep)
-            ep.successes += 1
+            with self._lock:
+                actively_ejected = bool(
+                    ep.ejected_until and self._clock() < ep.ejected_until
+                )
+                ep.successes += 1
+            if not actively_ejected:
+                # a success from an endpoint we EJECTED (an in-flight
+                # straggler draining out) must not override the
+                # deliberate bench — re-entry is the re-probe path's call
+                self.mark_up(ep)
             if ep.circuit_breaker is not None:
                 ep.circuit_breaker.record_success()
             return

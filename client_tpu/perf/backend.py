@@ -217,6 +217,8 @@ class HttpPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
         circuit_breaker=None,
         tracer=None,
         logger=None,
+        routing_policy=None,
+        hedge_policy=None,
     ):
         from client_tpu.http import aio as httpclient
 
@@ -228,6 +230,8 @@ class HttpPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
             circuit_breaker=circuit_breaker,
             tracer=tracer,
             logger=logger,
+            routing_policy=routing_policy,
+            hedge_policy=hedge_policy,
         )
         self._init_prepared()
 
@@ -335,6 +339,8 @@ class GrpcPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
         tracer=None,
         logger=None,
         stream_mode: bool = False,
+        routing_policy=None,
+        hedge_policy=None,
     ):
         from client_tpu.grpc import aio as grpcclient
 
@@ -347,6 +353,8 @@ class GrpcPerfBackend(_PreparedRequestCacheMixin, PerfBackend):
             tracer=tracer,
             logger=logger,
             stream_mode=stream_mode,
+            routing_policy=routing_policy,
+            hedge_policy=hedge_policy,
         )
         self._init_prepared()
 

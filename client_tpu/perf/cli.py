@@ -234,6 +234,57 @@ def build_parser() -> argparse.ArgumentParser:
         "vs rerouted requests (kserve http/grpc only)",
     )
     parser.add_argument(
+        "--routing-policy",
+        default=None,
+        choices=[
+            "sticky",
+            "round-robin",
+            "round_robin",
+            "least-outstanding",
+            "least_outstanding",
+            "p2c",
+            "consistent-hash",
+            "consistent_hash",
+        ],
+        help="endpoint-selection policy for multi-endpoint runs "
+        "(-u comma list or --fleet): sticky primary (default), "
+        "round-robin, least-outstanding, p2c (power of two choices on "
+        "the live outstanding/EWMA signals), or consistent-hash "
+        "(affinity on the 'routing_key' request parameter — pair with "
+        "--request-parameter routing_key:<key>:string)",
+    )
+    parser.add_argument(
+        "--hedge-after-s",
+        type=float,
+        default=None,
+        metavar="S",
+        help="arm request hedging: an idempotent request that outlives "
+        "S seconds launches one duplicate on another endpoint; first "
+        "response wins, the loser is cancelled. 0 derives the trigger "
+        "from the observed p95 instead of a fixed delay. Incompatible "
+        "with --shared-memory (single-writer regions must not race)",
+    )
+    def _positive_fleet(value: str) -> int:
+        count = int(value)
+        if count < 1:
+            raise argparse.ArgumentTypeError(
+                f"--fleet must be >= 1 replicas, got {count}"
+            )
+        return count
+
+    parser.add_argument(
+        "--fleet",
+        type=_positive_fleet,
+        default=None,
+        metavar="N",
+        help="launch N in-process server replicas and run the "
+        "measurement against the whole fleet: -u is overridden with the "
+        "replica list, --metrics-url fleet collection is wired "
+        "automatically, and --rolling-restart cycles REPLICAS through "
+        "the real drain() path instead of model unload/load (kserve "
+        "http/grpc only)",
+    )
+    parser.add_argument(
         "--stage-breakdown",
         action="store_true",
         help="trace every request client-side (observability spans) and "
@@ -459,6 +510,30 @@ async def run(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.fleet and args.service_kind != "kserve":
+        print(
+            "error: --fleet needs the kserve http/grpc clients "
+            "(EndpointPool routing)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.hedge_after_s is not None and args.shared_memory != "none":
+        print(
+            "error: --hedge-after-s is incompatible with --shared-memory "
+            "(shared regions are single-writer; a hedged duplicate would "
+            "race the winner's output)",
+            file=sys.stderr,
+        )
+        return 2
+    if (
+        args.routing_policy or args.hedge_after_s is not None
+    ) and args.service_kind != "kserve":
+        print(
+            "error: --routing-policy/--hedge-after-s need the kserve "
+            "http/grpc clients (EndpointPool routing)",
+            file=sys.stderr,
+        )
+        return 2
     if args.dump_slow_requests and args.service_kind != "kserve":
         print(
             "error: --dump-slow-requests needs the kserve http/grpc "
@@ -466,6 +541,23 @@ async def run(args) -> int:
             file=sys.stderr,
         )
         return 2
+    fleet_runner = None
+    if args.fleet:
+        # Launch the replica fleet FIRST so the url/metrics wiring below
+        # sees the real addresses. One process, N event loops: fine for
+        # robustness/chaos runs; use subprocess replicas
+        # (tools/bench_fleet.py) when measuring aggregate scaling.
+        from client_tpu.perf.fleet_runner import FleetRunner
+
+        fleet_runner = FleetRunner(args.fleet, grpc="aio").start()
+        args.url = ",".join(fleet_runner.urls(args.protocol))
+        if not args.metrics_url:
+            args.metrics_url = ",".join(fleet_runner.metrics_urls)
+        args.collect_metrics = True
+        if args.verbose:
+            print(
+                f"fleet: {args.fleet} in-process replicas at {args.url}"
+            )
     trace_exporter = None
     tracer = None
     collector = None
@@ -521,6 +613,10 @@ async def run(args) -> int:
             backend_kwargs["tracer"] = tracer
         if run_logger is not None:
             backend_kwargs["logger"] = run_logger
+        if args.routing_policy:
+            backend_kwargs["routing_policy"] = args.routing_policy
+        if args.hedge_after_s is not None:
+            backend_kwargs["hedge_policy"] = args.hedge_after_s
         if args.stream_mode:
             if args.protocol != "grpc":
                 print(
@@ -528,6 +624,8 @@ async def run(args) -> int:
                     "(-i grpc)",
                     file=sys.stderr,
                 )
+                if fleet_runner is not None:
+                    fleet_runner.stop()
                 return 2
             backend_kwargs["stream_mode"] = True
         backend = create_backend(args.protocol, args.url, **backend_kwargs)
@@ -540,12 +638,16 @@ async def run(args) -> int:
         print(f"error: --streaming is not supported by {hint}",
               file=sys.stderr)
         await backend.close()
+        if fleet_runner is not None:
+            fleet_runner.stop()
         return 2
     try:
         await backend.connect()
     except InferenceServerException as e:
         print(f"error: backend connect: {e}", file=sys.stderr)
         await backend.close()
+        if fleet_runner is not None:
+            fleet_runner.stop()
         return 1
     shm_plane = None
     try:
@@ -727,17 +829,33 @@ async def run(args) -> int:
                 print(f"rank {args.rank}/{args.world_size} ready")
 
         if args.rolling_restart:
-            from client_tpu.perf.load_manager import RollingRestartDriver
+            if fleet_runner is not None:
+                # fleet mode restarts whole REPLICAS through the real
+                # drain() path, not just one model's unload/load
+                from client_tpu.perf.fleet_runner import FleetRestartDriver
 
-            restart_driver = RollingRestartDriver(
-                backend, args.model_name, args.rolling_restart
-            )
-            restart_driver.start()
-            if args.verbose:
-                print(
-                    f"rolling restart: cycling unload/load of "
-                    f"'{args.model_name}' every {args.rolling_restart:g}s"
+                restart_driver = FleetRestartDriver(
+                    fleet_runner, args.rolling_restart
                 )
+                restart_driver.start()
+                if args.verbose:
+                    print(
+                        f"rolling restart: drain/restart of one of "
+                        f"{fleet_runner.size} replicas every "
+                        f"{args.rolling_restart:g}s"
+                    )
+            else:
+                from client_tpu.perf.load_manager import RollingRestartDriver
+
+                restart_driver = RollingRestartDriver(
+                    backend, args.model_name, args.rolling_restart
+                )
+                restart_driver.start()
+                if args.verbose:
+                    print(
+                        f"rolling restart: cycling unload/load of "
+                        f"'{args.model_name}' every {args.rolling_restart:g}s"
+                    )
 
         if args.flamegraph_out:
             # Sample the server mid-measurement: started HERE — after
@@ -1012,6 +1130,15 @@ async def run(args) -> int:
             }
             if restart_driver is not None:
                 summary_doc["rolling_restart_cycles"] = restart_driver.cycles
+            if pool_snapshot is not None:
+                # routing/hedging/ejection outcome of the run (the
+                # client-side fleet counters; tpu_client_hedges_total)
+                summary_doc["routing_policy"] = pool_snapshot.get("policy")
+                summary_doc["hedges"] = pool_snapshot.get("hedges", 0)
+                summary_doc["hedge_wins"] = pool_snapshot.get(
+                    "hedge_wins", 0
+                )
+                summary_doc["ejections"] = pool_snapshot.get("ejections", 0)
             if best.status.per_priority_latency_us:
                 summary_doc["per_priority_p99_us"] = {
                     str(p): entry.get(99, 0)
@@ -1071,6 +1198,9 @@ async def run(args) -> int:
         if shm_plane is not None:
             await shm_plane.cleanup()
         await backend.close()
+        if fleet_runner is not None:
+            # off the loop: replica teardown joins server threads
+            await asyncio.to_thread(fleet_runner.stop)
         if trace_exporter is not None:
             trace_exporter.close()
         if run_logger is not None:

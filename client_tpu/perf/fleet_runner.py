@@ -1,0 +1,354 @@
+"""Launch N server replicas as one service — the fleet you can run.
+
+Everything upstream of this module already exists: the clients'
+:class:`~client_tpu.lifecycle.EndpointPool` routes/hedges across
+replicas, the perf harness scrapes and merges N ``/metrics`` endpoints
+(``--metrics-url a,b,c``), and ``InProcessServer`` drains before it
+stops. This module closes the loop with a runner that actually *owns* N
+replicas:
+
+* :class:`FleetRunner` — N :class:`~client_tpu.testing.InProcessServer`
+  replicas in one process (threaded event loops, like the lifecycle
+  tests), each with its own ServerCore/repository. Used by the perf
+  harness's ``--fleet N`` flag and the chaos tests.
+  :meth:`restart_replica` cycles one replica through the REAL
+  ``drain()`` path — readiness flips false, in-flight work finishes,
+  front-ends close — then restarts it at the SAME ports so pools keep
+  probing the same address.
+* :class:`FleetRestartDriver` — the fleet flavor of the harness's
+  ``--rolling-restart``: while a measurement runs, cycle replicas
+  through drain -> restart round-robin (one at a time, never two).
+* ``python -m client_tpu.perf.fleet_runner --serve`` — one replica as a
+  subprocess (its own GIL and CPU budget; ``tools/bench_fleet.py``
+  spawns N of these so aggregate throughput can actually scale past one
+  interpreter). Prints a JSON line with the bound ports, serves until
+  SIGTERM, drains on the way out.
+* :class:`DeviceBoundModel` — a host-free stand-in for an
+  accelerator-bound model: each batched execution *waits* (the device
+  would be computing; the host is idle), so one replica's capacity is
+  ``max_batch_size / step_time`` regardless of host CPU — the workload
+  shape where replicas add capacity and routing policy quality shows.
+"""
+
+import argparse
+import json
+import signal
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from client_tpu.server.model_repository import Model
+
+
+class DeviceBoundModel(Model):
+    """Simulated accelerator-bound model: OUTPUT0 = INPUT0, after one
+    device-step delay per batched execution.
+
+    ``time.sleep`` in the execution thread releases the GIL — exactly
+    the profile of a host waiting on a device step — so a replica's
+    throughput is capacity-bound (``max_batch_size / step_s`` per
+    replica), not host-CPU-bound. The batcher serializes executions per
+    model, which is the single-device-queue semantics real serving has.
+    """
+
+    platform = "custom"
+    backend = "custom"
+    device = "cpu"
+    inputs = [{"name": "INPUT0", "datatype": "INT32", "shape": [4]}]
+    outputs = [{"name": "OUTPUT0", "datatype": "INT32", "shape": [4]}]
+
+    def __init__(
+        self,
+        name: str = "device_sim",
+        step_s: float = 0.02,
+        max_batch_size: int = 4,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.name = name
+        self.step_s = step_s
+        self.max_batch_size = max_batch_size
+        self._sleep = sleep
+
+    def warmup(self) -> None:
+        pass
+
+    def execute(self, inputs, parameters):
+        a = inputs.get("INPUT0")
+        if a is None:
+            raise ValueError(f"model '{self.name}' expects INPUT0")
+        self._sleep(self.step_s)
+        return {"OUTPUT0": np.asarray(a)}
+
+
+class FleetRunner:
+    """N in-process server replicas behind one url list.
+
+    Parameters
+    ----------
+    size:
+        Replica count.
+    http / grpc / host / builtin_models / chaos / drain_timeout_s:
+        Passed to each replica's
+        :class:`~client_tpu.testing.InProcessServer`.
+    model_factories:
+        Optional callables, each returning a fresh
+        :class:`~client_tpu.server.model_repository.Model` to register
+        on a replica's repository (called per replica AND per restart —
+        repositories are per-replica, so instances must not be shared).
+    """
+
+    def __init__(
+        self,
+        size: int,
+        http: bool = True,
+        grpc="aio",
+        host: str = "127.0.0.1",
+        builtin_models: bool = True,
+        chaos=None,
+        drain_timeout_s: float = 5.0,
+        model_factories: Optional[Sequence[Callable[[], Model]]] = None,
+    ):
+        if size < 1:
+            raise ValueError("fleet size must be >= 1")
+        self.size = size
+        self._http = http
+        self._grpc = grpc
+        self._host = host
+        self._builtin_models = builtin_models
+        self._chaos = chaos
+        self._drain_timeout_s = drain_timeout_s
+        self._model_factories = list(model_factories or ())
+        self.replicas: List = []
+        self._lock = threading.Lock()
+        self._stopped = False
+        self.restarts = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _new_server(self, http_port: int = 0, grpc_port: int = 0):
+        from client_tpu.testing import InProcessServer
+
+        server = InProcessServer(
+            http=self._http,
+            grpc=self._grpc,
+            host=self._host,
+            builtin_models=self._builtin_models,
+            chaos=self._chaos,
+            http_port=http_port,
+            grpc_port=grpc_port,
+            drain_timeout_s=self._drain_timeout_s,
+        )
+        for factory in self._model_factories:
+            server.core.repository.add_model(factory())
+        return server
+
+    def start(self) -> "FleetRunner":
+        try:
+            for _ in range(self.size):
+                self.replicas.append(self._new_server().start())
+        except BaseException:
+            self.stop()
+            raise
+        return self
+
+    def stop(self) -> None:
+        # under the same lock restart_replica holds: a restart mid-drain
+        # (e.g. left running by a cancelled FleetRestartDriver task)
+        # finishes its swap first, so its replacement is in the list and
+        # gets stopped here instead of leaking on a daemon thread
+        with self._lock:
+            self._stopped = True
+            replicas, self.replicas = self.replicas, []
+        for server in replicas:
+            try:
+                server.stop()
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                pass
+
+    def __enter__(self) -> "FleetRunner":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- addressing ----------------------------------------------------------
+
+    @property
+    def http_urls(self) -> List[str]:
+        return [server.http_url for server in self.replicas]
+
+    @property
+    def grpc_urls(self) -> List[str]:
+        return [server.grpc_url for server in self.replicas]
+
+    def urls(self, protocol: str) -> List[str]:
+        return self.grpc_urls if protocol == "grpc" else self.http_urls
+
+    @property
+    def metrics_urls(self) -> List[str]:
+        """Every replica's /metrics endpoint (the HTTP front-end)."""
+        return self.http_urls
+
+    # -- chaos ---------------------------------------------------------------
+
+    def restart_replica(
+        self, index: int, drain_timeout_s: Optional[float] = None
+    ) -> None:
+        """Cycle one replica through the real lifecycle: ``drain()``
+        (readiness false, in-flight and queued work finishes, leftovers
+        fail cleanly), front-ends down, then a fresh replica at the SAME
+        http/grpc ports — the address every client pool keeps probing.
+        Serialized under a lock: a rolling restart is one replica at a
+        time by definition (and :meth:`stop` takes the same lock, so a
+        restart racing shutdown either completes its swap — and the
+        replacement is stopped with the rest — or sees the stopped flag
+        and does nothing)."""
+        with self._lock:
+            if self._stopped:
+                return
+            old = self.replicas[index]
+            http_port, grpc_port = old.http_port, old.grpc_port
+            old.stop(
+                drain_timeout_s
+                if drain_timeout_s is not None
+                else self._drain_timeout_s
+            )
+            replacement = self._new_server(
+                http_port=http_port or 0, grpc_port=grpc_port or 0
+            )
+            self.replicas[index] = replacement.start()
+            self.restarts += 1
+
+    def stop_replica(self, index: int) -> None:
+        """Drain and stop one replica WITHOUT restarting it (the
+        kill-a-replica chaos scenario; the pool should route around the
+        dead address with zero client-observed failures)."""
+        with self._lock:
+            if self._stopped:
+                return
+            self.replicas[index].stop()
+
+
+class FleetRestartDriver:
+    """``--rolling-restart`` over a live fleet: every ``period_s``
+    seconds, drain -> restart the next replica round-robin while the
+    measurement runs. The harness report's dropped/rerouted split then
+    answers whether the fleet rode through it."""
+
+    def __init__(self, fleet: FleetRunner, period_s: float):
+        self.fleet = fleet
+        self.period_s = period_s
+        self.cycles = 0
+        self.errors: List[str] = []
+        self._task = None
+        self._stopped = False
+
+    def start(self) -> None:
+        import asyncio
+
+        self._task = asyncio.ensure_future(self._run())
+
+    async def _run(self) -> None:
+        import asyncio
+
+        index = 0
+        while True:
+            await asyncio.sleep(self.period_s)
+            try:
+                # restart blocks on the drain + port rebind: off the loop
+                await asyncio.to_thread(
+                    self.fleet.restart_replica, index % self.fleet.size
+                )
+                index += 1
+                self.cycles += 1
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 - chaos must not kill the run
+                if len(self.errors) < 8:
+                    self.errors.append(str(e))
+
+    async def stop(self) -> None:
+        import asyncio
+
+        if self._stopped:
+            return
+        self._stopped = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._task = None
+
+
+# ---------------------------------------------------------------------------
+# subprocess replica mode (tools/bench_fleet.py spawns N of these)
+
+
+def _serve_one(args) -> int:
+    factories: List[Callable[[], Model]] = []
+    if args.device_sim:
+        step_ms, _, batch = args.device_sim.partition(":")
+        step_s = float(step_ms) / 1000.0
+        max_batch = int(batch) if batch else 4
+
+        def factory() -> Model:
+            return DeviceBoundModel(step_s=step_s, max_batch_size=max_batch)
+
+        factories.append(factory)
+    fleet = FleetRunner(
+        1,
+        host=args.host,
+        grpc="aio",
+        builtin_models=not args.no_builtin_models,
+        drain_timeout_s=args.drain_timeout,
+        model_factories=factories,
+    )
+    fleet.replicas.append(
+        fleet._new_server(
+            http_port=args.http_port, grpc_port=args.grpc_port
+        ).start()
+    )
+    server = fleet.replicas[0]
+    print(
+        json.dumps(
+            {"http_port": server.http_port, "grpc_port": server.grpc_port}
+        ),
+        flush=True,
+    )
+    stop = threading.Event()
+    for signum in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(signum, lambda *_: stop.set())
+    stop.wait()
+    fleet.stop()
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m client_tpu.perf.fleet_runner",
+        description="serve ONE fleet replica as a subprocess (prints a "
+        "JSON ports line, drains on SIGTERM)",
+    )
+    parser.add_argument("--serve", action="store_true", required=True)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--http-port", type=int, default=0)
+    parser.add_argument("--grpc-port", type=int, default=0)
+    parser.add_argument("--drain-timeout", type=float, default=5.0)
+    parser.add_argument(
+        "--device-sim",
+        default=None,
+        metavar="STEP_MS[:BATCH]",
+        help="register a DeviceBoundModel ('device_sim'): simulated "
+        "device-step milliseconds and max batch size",
+    )
+    parser.add_argument("--no-builtin-models", action="store_true")
+    args = parser.parse_args(argv)
+    return _serve_one(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

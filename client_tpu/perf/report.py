@@ -353,21 +353,34 @@ def format_client_metrics(
     if endpoints is not None and endpoints.get("endpoints"):
         rows = endpoints["endpoints"]
         noun = "endpoint" if len(rows) == 1 else "endpoints"
-        lines.append(
-            f"  Endpoint pool ({len(rows)} {noun}, primary "
+        pool_line = (
+            f"  Endpoint pool ({len(rows)} {noun}, policy "
+            f"{endpoints.get('policy', 'sticky')}, primary "
             f"{endpoints.get('primary', '?')}, "
-            f"{endpoints.get('failovers', 0)} failovers):"
+            f"{endpoints.get('failovers', 0)} failovers, "
+            f"{endpoints.get('ejections', 0)} ejections):"
         )
+        lines.append(pool_line)
         lines.append(
-            f"    {'url':<28} {'outst':>5} {'ewma_us':>10} {'ok':>8} "
-            f"{'err':>5} {'down':>5} {'reroutes':>8}"
+            f"    {'url':<28} {'state':>7} {'outst':>5} {'ewma_us':>10} "
+            f"{'ok':>8} {'err':>5} {'reroutes':>8}"
         )
         for row in rows:
-            state = "DOWN" if row.get("down") else "up"
+            # 'state' distinguishes an ejected/benched endpoint from a
+            # healthy idle one (both would read outst=0 otherwise)
+            state = row.get("state") or (
+                "down" if row.get("down") else "up"
+            )
             lines.append(
-                f"    {row['url']:<28} {row['outstanding']:>5} "
+                f"    {row['url']:<28} {state:>7} {row['outstanding']:>5} "
                 f"{row['ewma_latency_us']:>10.1f} {row['successes']:>8} "
-                f"{row['errors']:>5} {state:>5} {row['reroutes']:>8}"
+                f"{row['errors']:>5} {row['reroutes']:>8}"
+            )
+        if endpoints.get("hedges"):
+            lines.append(
+                f"  Hedging: {endpoints['hedges']} hedges launched "
+                f"(tpu_client_hedges_total), "
+                f"{endpoints.get('hedge_wins', 0)} won the race"
             )
     if len(lines) == 1:
         lines.append("  (no client telemetry recorded)")

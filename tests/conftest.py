@@ -68,6 +68,11 @@ def pytest_configure(config):
         "wirefast: PR-11 wire fast path (protobuf-free codec, shm ring, "
         "multiplexed streams) — select with -m wirefast",
     )
+    config.addinivalue_line(
+        "markers",
+        "fleet: PR-12 multi-replica fleet runtime (routing policies, "
+        "hedging, FleetRunner chaos) — select with -m fleet",
+    )
     # Clock-injection lint: observability/resilience must never call
     # time.*() clocks directly (their tests run on fake clocks). Failing
     # at session start beats a flaky sleep-based test later.

@@ -725,7 +725,10 @@ def test_client_metrics_section_formats_pool_snapshot():
         ],
     }
     text = format_client_metrics(None, endpoints=pool)
-    assert "Endpoint pool (1 endpoint, primary a:1, 2 failovers)" in text
+    assert (
+        "Endpoint pool (1 endpoint, policy sticky, primary a:1, "
+        "2 failovers, 0 ejections)" in text
+    )
     assert "120.5" in text
     tracer_snapshot = {
         "request_count": 4, "error_count": 1, "retry_count": 2,

@@ -1,0 +1,215 @@
+"""Fleet bench row: N=1 vs N=3 replicas, aggregate infer/sec per policy.
+
+Spawns each replica as its own SUBPROCESS (``python -m
+client_tpu.perf.fleet_runner --serve``) so every replica owns its own
+interpreter/GIL — in-process replica threads would serialize on one GIL
+and fabricate a flat scaling curve. The workload is the
+``device_sim`` model (a simulated accelerator-bound step: the host
+sleeps while the "device" computes), so one replica's capacity is
+``max_batch / step`` and adding replicas adds capacity — the regime
+where routing-policy quality is measurable. The host-CPU-bound regime
+is tracked separately by the headline add_sub row.
+
+For each policy the driver reports aggregate infer/sec AND the fleet
+report's skew verdict (every replica's /metrics scraped and merged, the
+same path ``--metrics-url a,b,c`` takes in the harness).
+
+Prints ONE JSON line; bench.py embeds it as the ``fleet`` row and
+``tools/bench_trajectory.py`` guards ``fleet.best_infer_per_sec``.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+STEP_MS = float(os.environ.get("BENCH_FLEET_STEP_MS", "40"))
+MAX_BATCH = int(os.environ.get("BENCH_FLEET_BATCH", "4"))
+CONCURRENCY = int(os.environ.get("BENCH_FLEET_CONCURRENCY", "24"))
+WARMUP_S = float(os.environ.get("BENCH_FLEET_WARMUP_S", "1.0"))
+MEASURE_S = float(os.environ.get("BENCH_FLEET_MEASURE_S", "3.0"))
+FLEET_SIZE = int(os.environ.get("BENCH_FLEET_SIZE", "3"))
+
+POLICIES = ("round_robin", "least_outstanding", "p2c", "consistent_hash")
+
+
+class Replica:
+    """One subprocess replica (own interpreter, own cores)."""
+
+    def __init__(self):
+        self.proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "client_tpu.perf.fleet_runner",
+                "--serve",
+                "--no-builtin-models",
+                "--device-sim",
+                f"{STEP_MS:g}:{MAX_BATCH}",
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+        )
+        # scan for the ports line rather than trusting line 1: an
+        # imported library's stray stdout notice must not kill the row
+        ports = None
+        for _ in range(50):
+            line = self.proc.stdout.readline()
+            if not line:
+                break
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    candidate = json.loads(line)
+                except ValueError:
+                    continue
+                if "http_port" in candidate and "grpc_port" in candidate:
+                    ports = candidate
+                    break
+        if ports is None:
+            raise RuntimeError("replica subprocess printed no ports line")
+        self.http_port = ports["http_port"]
+        self.grpc_port = ports["grpc_port"]
+
+    @property
+    def grpc_url(self) -> str:
+        return f"127.0.0.1:{self.grpc_port}"
+
+    @property
+    def http_url(self) -> str:
+        return f"127.0.0.1:{self.http_port}"
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+async def _drive(
+    urls: List[str],
+    policy: Optional[str],
+    metrics_urls: Optional[List[str]] = None,
+) -> Dict:
+    """One measured pass: CONCURRENCY workers over the url list under
+    ``policy``; optionally scrape every replica for the skew verdict."""
+    import numpy as np
+
+    import client_tpu.grpc.aio as grpcclient
+
+    data = np.ones([1, 4], dtype=np.int32)
+    fleet_collector = None
+    if metrics_urls:
+        from client_tpu.perf.metrics_collector import FleetCollector
+
+        fleet_collector = FleetCollector(
+            metrics_urls, interval_s=0.5, model_name="device_sim"
+        )
+    async with grpcclient.InferenceServerClient(
+        ",".join(urls), routing_policy=policy
+    ) as client:
+        count = 0
+        stop_at = 0.0
+
+        async def worker(index: int):
+            nonlocal count
+            tensor = grpcclient.InferInput("INPUT0", [1, 4], "INT32")
+            tensor.set_data_from_numpy(data)
+            # consistent-hash needs a key: one per worker spreads the
+            # key space over the ring (each worker stays pinned — the
+            # affinity semantics)
+            parameters = (
+                {"routing_key": f"worker-{index}"}
+                if policy == "consistent_hash"
+                else None
+            )
+            while time.monotonic() < stop_at:
+                await client.infer(
+                    "device_sim", [tensor], parameters=parameters
+                )
+                if time.monotonic() < stop_at:
+                    count += 1
+
+        stop_at = time.monotonic() + WARMUP_S
+        await asyncio.gather(
+            *[worker(i) for i in range(CONCURRENCY)]
+        )
+        if fleet_collector is not None:
+            await fleet_collector.start()
+        count = 0
+        start = time.monotonic()
+        stop_at = start + MEASURE_S
+        await asyncio.gather(
+            *[worker(i) for i in range(CONCURRENCY)]
+        )
+        # completions past stop_at are not counted, so the denominator is
+        # the measurement window — not wall time including the in-flight
+        # drain tail gather() waits out (that bias would feed straight
+        # into the trajectory gate)
+        row: Dict = {"infer_per_sec": round(count / MEASURE_S, 2)}
+        if fleet_collector is not None:
+            await fleet_collector.stop()
+            summary = fleet_collector.fleet_summary()
+            skew = summary.skew or {}
+            if skew:
+                row["skew"] = {
+                    "ratio": skew.get("ratio"),
+                    "flagged": skew.get("flagged"),
+                    "source": skew.get("source"),
+                }
+        snapshot = client.endpoint_snapshot()
+        row["per_endpoint_ok"] = [
+            endpoint["successes"] for endpoint in snapshot["endpoints"]
+        ]
+        return row
+
+
+def main() -> int:
+    replicas: List[Replica] = []
+    result: Dict = {
+        "config": (
+            f"device_sim (simulated {STEP_MS:g} ms device step, batch "
+            f"{MAX_BATCH}) — {FLEET_SIZE} subprocess replicas vs 1, "
+            f"grpc.aio, concurrency {CONCURRENCY}"
+        ),
+        "replicas": FLEET_SIZE,
+    }
+    try:
+        for _ in range(FLEET_SIZE):
+            replicas.append(Replica())
+        single = asyncio.run(_drive([replicas[0].grpc_url], None))
+        result["n1_infer_per_sec"] = single["infer_per_sec"]
+        urls = [replica.grpc_url for replica in replicas]
+        metrics_urls = [replica.http_url for replica in replicas]
+        policies: Dict[str, Dict] = {}
+        best = 0.0
+        for policy in POLICIES:
+            row = asyncio.run(_drive(urls, policy, metrics_urls))
+            policies[policy] = row
+            best = max(best, row["infer_per_sec"])
+        result["policies"] = policies
+        result["best_infer_per_sec"] = round(best, 2)
+        if single["infer_per_sec"] > 0:
+            result["scale_vs_n1"] = round(best / single["infer_per_sec"], 2)
+    except Exception as e:  # noqa: BLE001 - the row is best-effort
+        result = {"error": f"{type(e).__name__}: {e}"}
+    finally:
+        for replica in replicas:
+            replica.stop()
+    print(json.dumps(result))
+    return 0 if "error" not in result else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -76,14 +76,14 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
     lines = [
         "| run | infer/sec | p50 (us) | ratio_vs_inproc | server CPU "
         "(us/req) | dominant stage | rolling p99 (us) | llm tok/s | "
-        "sharded inf/s |",
-        "|---|---|---|---|---|---|---|---|---|",
+        "sharded inf/s | fleet inf/s |",
+        "|---|---|---|---|---|---|---|---|---|---|",
     ]
     for run in runs:
         parsed = run["parsed"]
         if parsed is None:
             lines.append(
-                f"| r{run['run']:02d} | (bench failed) | | | | | | | |"
+                f"| r{run['run']:02d} | (bench failed) | | | | | | | | |"
             )
             continue
 
@@ -109,6 +109,15 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
             and isinstance(sharded.get("infer_per_sec"), (int, float))
             else "-"
         )
+        # BENCH_r12+: best-policy aggregate of the N=3 fleet row
+        # (tools/bench_fleet.py — device-bound model, subprocess replicas)
+        fleet = parsed.get("fleet")
+        fleet_s = (
+            f"{fleet['best_infer_per_sec']:.1f}"
+            if isinstance(fleet, dict)
+            and isinstance(fleet.get("best_infer_per_sec"), (int, float))
+            else "-"
+        )
         lines.append(
             f"| r{run['run']:02d} "
             f"| {_num('value', '{:.1f}')} "
@@ -118,7 +127,8 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
             f"| {_dominant_stage(parsed)} "
             f"| {_num('rolling_30s_p99_us', '{:.1f}')} "
             f"| {tok_s} "
-            f"| {sharded_s} |"
+            f"| {sharded_s} "
+            f"| {fleet_s} |"
         )
     return "\n".join(lines)
 
@@ -140,11 +150,14 @@ def check_regression(
     sits more than ``threshold`` below the best prior successful run;
     None when the trajectory is healthy (or has no comparable prior).
 
-    Guarded rows (ROADMAP item 3 asks for all three):
+    Guarded rows:
       * headline ``value`` — compared only against prior runs of the
         SAME harness family (see :func:`_harness_family`);
       * ``sharded.infer_per_sec`` (BENCH_r10+);
-      * ``llm_generate.tokens_per_sec`` (BENCH_r09+).
+      * ``llm_generate.tokens_per_sec`` (BENCH_r09+);
+      * ``fleet.best_infer_per_sec`` (BENCH_r12+) — the fleet row runs
+        one harness family (python grpc.aio over subprocess replicas),
+        so within-family comparison is automatic.
     """
     ok = [r for r in runs if r["parsed"] is not None]
     if len(ok) < 2:
@@ -202,6 +215,17 @@ def check_regression(
             (r["run"], _nested(r["parsed"], "llm_generate", "tokens_per_sec"))
             for r in ok[:-1]
             if _nested(r["parsed"], "llm_generate", "tokens_per_sec")
+            is not None
+        ],
+    )
+    _guard(
+        "fleet",
+        "infer/sec",
+        _nested(latest, "fleet", "best_infer_per_sec"),
+        [
+            (r["run"], _nested(r["parsed"], "fleet", "best_infer_per_sec"))
+            for r in ok[:-1]
+            if _nested(r["parsed"], "fleet", "best_infer_per_sec")
             is not None
         ],
     )

@@ -45,6 +45,12 @@ TARGET_FILES = (
     # an untestable clock read (their tests run on fake/event clocks)
     os.path.join("client_tpu", "grpc", "_mux.py"),
     os.path.join("client_tpu", "grpc", "_wire.py"),
+    # PR-12 fleet runtime: routing selection and the hedge trigger are
+    # pinned explicitly (the lifecycle directory walk covers them today,
+    # but these two must stay clock-injected even if the list changes —
+    # policy tests and the hedge window run entirely on fed-in numbers)
+    os.path.join("client_tpu", "lifecycle", "hedge.py"),
+    os.path.join("client_tpu", "lifecycle", "routing.py"),
     os.path.join("client_tpu", "observability", "logging.py"),
     os.path.join("client_tpu", "observability", "profiling.py"),
     os.path.join("client_tpu", "observability", "recorder.py"),
