@@ -456,6 +456,210 @@ def _bench_llm_generate(server) -> dict:
     return result
 
 
+def _bench_llm_decode_kernel() -> dict:
+    """The repo's first KERNEL row (ROADMAP item 2 / BENCH_r13+): the
+    ragged paged-attention decode step, stand-in vs fused, measured
+    directly on the jitted device callables at a fixed batch/context
+    grid — no wire, no scheduler, just the compute the engine pays per
+    decode step. The stand-in runs at the full page-table width (how the
+    engine called it through PR-13); the fused variant runs at the
+    engine's ragged power-of-two bucket, so the speedup column is the
+    end-to-end per-step win of PR-14's kernel + bucketing. A second
+    section measures what copy-on-write prefix sharing buys at the
+    engine level: TTFT with a shared-prefix hit vs cold, and peak
+    blocks_in_use for a shared-prefix workload vs the same traffic with
+    sharing disabled. Never raises; failures degrade to {}."""
+    import asyncio
+    import time
+
+    result: dict = {}
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from client_tpu.models import llama
+        from client_tpu.models import paged_attention as pa
+
+        config = llama.LlamaConfig.tiny(max_seq_len=512, dtype=jnp.float32)
+        params = llama.init_params(jax.random.PRNGKey(0), config)
+        block_size = 16
+        max_blocks = config.max_seq_len // block_size  # 32
+        num_blocks = 1 + 8 * max_blocks
+
+        standin = jax.jit(
+            lambda t, p, pt, pg: llama.decode_step_paged(
+                params, t, p, pt, pg, config
+            )
+        )
+        fused = jax.jit(
+            lambda t, p, pt, pg: llama.decode_step_paged_attn(
+                params, t, p, pt, pg, config,
+                pa.paged_attention_fused_xla,
+            )
+        )
+
+        def time_fn(fn, args, iters=20):
+            out = fn(*args)
+            jax.block_until_ready(out[0])  # compile outside timing
+            t0 = time.monotonic()
+            for _ in range(iters):
+                out = fn(*args)
+            jax.block_until_ready(out[0])
+            return (time.monotonic() - t0) / iters
+
+        cells = []
+        for b, ctx in ((4, 64), (8, 128), (8, 256)):
+            pages = llama.init_kv_pages(config, num_blocks, block_size)
+            blocks_per_seq = (ctx + 1 + block_size - 1) // block_size
+            tables = np.zeros([b, max_blocks], dtype=np.int32)
+            next_free = 1
+            for i in range(b):
+                tables[i, :blocks_per_seq] = range(
+                    next_free, next_free + blocks_per_seq
+                )
+                next_free += blocks_per_seq
+            tokens = np.arange(1, b + 1, dtype=np.int32)
+            positions = np.full([b], ctx, dtype=np.int32)
+            from client_tpu.llm.engine import block_bucket
+
+            nb = min(block_bucket(blocks_per_seq), max_blocks)
+            standin_s = time_fn(standin, (tokens, positions, tables, pages))
+            fused_s = time_fn(
+                fused, (tokens, positions, tables[:, :nb], pages)
+            )
+            cells.append(
+                {
+                    "batch": b,
+                    "context": ctx,
+                    "standin_tokens_per_sec": round(b / standin_s, 1),
+                    "fused_tokens_per_sec": round(b / fused_s, 1),
+                    "speedup": round(standin_s / fused_s, 2),
+                }
+            )
+        speedups = [c["speedup"] for c in cells]
+        result = {
+            "kernel": "fused_xla",
+            "grid": cells,
+            "fused_tokens_per_sec": max(
+                c["fused_tokens_per_sec"] for c in cells
+            ),
+            "speedup_min": min(speedups),
+            "speedup_max": max(speedups),
+        }
+
+        # -- prefix-sharing section: TTFT + blocks_in_use, sharing A/B --
+        from client_tpu.llm import EngineConfig
+        from client_tpu.llm.serving import LlmEngineModel
+
+        tiny = llama.LlamaConfig.tiny(max_seq_len=64, dtype=jnp.float32)
+        tiny_params = llama.init_params(jax.random.PRNGKey(0), tiny)
+        prefix = [((7 * i) % 90) + 3 for i in range(32)]  # 4 full blocks @ 8
+
+        def run_workload(prefix_sharing):
+            model = LlmEngineModel(
+                config=tiny,
+                params=tiny_params,
+                engine_config=EngineConfig(
+                    block_size=8,
+                    num_blocks=1 + 8 * 8,
+                    max_active=8,
+                    max_queue=32,
+                    max_seq_len=64,
+                    prefix_sharing=prefix_sharing,
+                ),
+            )
+            model.warmup()
+            try:
+                engine = model.engine
+
+                async def generate(prompt, max_tokens, ttft_box=None):
+                    seq = engine.submit(list(prompt), max_tokens=max_tokens)
+                    t0 = time.monotonic()
+                    first = True
+                    async for _token, final in seq:
+                        if first and ttft_box is not None:
+                            ttft_box.append(time.monotonic() - t0)
+                        first = False
+                        if final:
+                            break
+
+                async def drive():
+                    peak = 0
+
+                    async def watch():
+                        nonlocal peak
+                        while True:
+                            peak = max(
+                                peak, engine.stats()["kv_blocks_in_use"]
+                            )
+                            await asyncio.sleep(0)
+
+                    # holder publishes the prefix and stays live for the
+                    # whole run; one unmeasured sharer warms the
+                    # suffix-prefill compile so TTFT timings below are
+                    # pure execution on both sides
+                    holder = engine.submit(prefix + [99, 98], max_tokens=24)
+                    await holder.__anext__()
+                    await generate(prefix + [55], 2)
+                    await generate([40] + prefix[1:] + [41], 2)  # cold warm
+                    ttft_cold, ttft_hit = [], []
+                    # serial measurements: cold prompts (first token
+                    # differs -> no match) vs shared-prefix hits
+                    for i in range(4):
+                        await generate(
+                            [50 + i] + prefix[1:] + [30 + i], 2, ttft_cold
+                        )
+                        await generate(prefix + [60 + i], 2, ttft_hit)
+                    # concurrent phase for the blocks_in_use peak
+                    watcher = asyncio.ensure_future(watch())
+                    try:
+                        await asyncio.gather(
+                            *[
+                                generate(prefix + [70 + i], 6)
+                                for i in range(6)
+                            ]
+                        )
+                    finally:
+                        watcher.cancel()
+                    engine.release(holder)
+                    for _ in range(200):
+                        if engine.stats()["kv_blocks_in_use"] == 0:
+                            break
+                        await asyncio.sleep(0.01)
+                    stats = engine.stats()
+                    return (
+                        sum(ttft_cold) / len(ttft_cold),
+                        sum(ttft_hit) / len(ttft_hit),
+                        peak,
+                        stats["prefix_cache_hits"],
+                        stats["prefix_block_demand"],
+                    )
+
+                return asyncio.run(drive())
+            finally:
+                model.shutdown()
+
+        cold_ms, hit_ms, peak_sharing, hits, demanded = run_workload(True)
+        _, _, peak_baseline, _, _ = run_workload(False)
+        result["prefix_sharing"] = {
+            "ttft_cold_ms": round(cold_ms * 1e3, 2),
+            "ttft_hit_ms": round(hit_ms * 1e3, 2),
+            "ttft_speedup": round(cold_ms / hit_ms, 2) if hit_ms else 0.0,
+            "blocks_in_use_peak": peak_sharing,
+            "blocks_in_use_peak_no_sharing": peak_baseline,
+            "blocks_ratio": (
+                round(peak_sharing / peak_baseline, 3)
+                if peak_baseline
+                else 0.0
+            ),
+            "prefix_hit_rate": round(hits / max(1, demanded), 3),
+        }
+    except Exception as e:  # noqa: BLE001 - row is best-effort
+        print(f"bench: llm_decode_kernel row failed: {e}", file=sys.stderr)
+    return result
+
+
 def _bench_sharded() -> dict:
     """The sharded north-star row (ROADMAP item 1 / BENCH_r10+): the
     tensor-parallel ``text_encoder_tp`` model over a dp=2 x tp=2 CPU
@@ -801,6 +1005,13 @@ def main() -> int:
     # subprocesses + a driver want the whole host).
     fleet = {} if os.environ.get("BENCH_NO_FLEET") else _bench_fleet()
 
+    # Kernel microbench (BENCH_r13+): stand-in vs fused ragged
+    # paged-attention decode + the prefix-sharing TTFT/blocks deltas.
+    # In-process jax; runs after the servers so it owns the cores.
+    llm_decode_kernel = (
+        {} if os.environ.get("BENCH_NO_LLM") else _bench_llm_decode_kernel()
+    )
+
     value = round(result["throughput"], 2)
     line = {
         "metric": (
@@ -908,6 +1119,8 @@ def main() -> int:
         line["northstar"] = northstar
     if llm_generate:
         line["llm_generate"] = llm_generate
+    if llm_decode_kernel:
+        line["llm_decode_kernel"] = llm_decode_kernel
     if sharded:
         line["sharded"] = sharded
     if fleet:

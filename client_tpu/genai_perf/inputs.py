@@ -164,19 +164,41 @@ def create_llm_inputs(
     dataset_path: Optional[str] = None,
     dataset_format: str = "auto",
     prompts: Optional[List[str]] = None,
+    shared_prefix_tokens: int = 0,
 ) -> Dict:
     """Write a perf-harness input-data JSON of LLM requests.
 
     Prompts are synthetic by default; with ``dataset_path`` they come from
     a local dataset export instead (OpenOrca/CNN_DailyMail/plain schemas,
-    cycled when shorter than ``num_prompts``). Returns the generated
-    document (also written to ``path``).
+    cycled when shorter than ``num_prompts``). ``shared_prefix_tokens``
+    prepends ONE fixed synthetic prefix of that many tokens to every
+    prompt (a shared system prompt), and stamps each request with a
+    ``routing_key`` parameter derived from the prefix content — the key
+    ``--routing-policy consistent_hash`` pins on, so a fleet routes every
+    sharer to the replica whose KV-block index already holds the prefix.
+    Returns the generated document (also written to ``path``).
     """
+    import hashlib
+
     rng = random.Random(seed)
     tokenizer = tokenizer or SyntheticTokenizer()
     dataset = prompts
     if dataset is None and dataset_path:
         dataset = load_dataset_prompts(dataset_path, dataset_format)
+    prefix_ids: List[int] = []
+    prefix_text = ""
+    routing_key = None
+    if shared_prefix_tokens > 0:
+        # a dedicated rng: the prefix is identical across runs of equal
+        # (seed, shared_prefix_tokens) regardless of num_prompts
+        prefix_text = synthesize_prompt(
+            random.Random(f"{seed}-shared-prefix"), shared_prefix_tokens, 0.0
+        )
+        prefix_ids = tokenizer.encode(prefix_text)[:shared_prefix_tokens]
+        routing_key = "prefix-" + hashlib.md5(
+            ",".join(map(str, prefix_ids)).encode(),
+            usedforsecurity=False,
+        ).hexdigest()[:16]
     entries: List[Dict] = []
     for i in range(num_prompts):
         if dataset is not None:
@@ -185,12 +207,18 @@ def create_llm_inputs(
             prompt = synthesize_prompt(
                 rng, input_tokens_mean, input_tokens_stddev
             )
+        if prefix_text and output_format != "kserve-ids":
+            prompt = prefix_text + " " + prompt
         if output_format == "kserve-ids":
             # length follows the sampled distribution — no clipping to the
             # mean, or above-mean prefill lengths would never occur
             ids = tokenizer.encode(prompt)
             if not ids:
                 ids = [1]
+            if prefix_ids:
+                # token-exact shared prefix: every request's leading
+                # blocks chain-hash identically in the engine's index
+                ids = prefix_ids + ids
             entry = {input_name: {"content": ids, "shape": [len(ids)]}}
         elif output_format == "kserve-text":
             entry = {input_name: {"content": [prompt], "shape": [1]}}
@@ -217,12 +245,20 @@ def create_llm_inputs(
                     1,
                     int(rng.gauss(output_tokens_mean, output_tokens_stddev)),
                 )
-            entries.append(
-                {"payload": {"content": [json.dumps(body)], "shape": [1]}}
-            )
+            entry = {"payload": {"content": [json.dumps(body)], "shape": [1]}}
+            if routing_key is not None:
+                # stamped on every format for a uniform input document;
+                # note the harness only accepts --routing-policy on the
+                # kserve http/grpc clients today, so the affinity
+                # pairing is live on kserve-* and inert (forward-compat
+                # data) on openai payloads
+                entry["parameters"] = {"routing_key": routing_key}
+            entries.append(entry)
             continue
         else:
             raise ValueError(f"unknown output format '{output_format}'")
+        if routing_key is not None:
+            entry["parameters"] = {"routing_key": routing_key}
         if output_tokens_mean is not None:
             # per-request sampled output length, carried as a request
             # parameter via the input-data "parameters" key (role of the
@@ -231,7 +267,7 @@ def create_llm_inputs(
             max_tokens = max(
                 1, int(rng.gauss(output_tokens_mean, output_tokens_stddev))
             )
-            entry["parameters"] = {"max_tokens": max_tokens}
+            entry.setdefault("parameters", {})["max_tokens"] = max_tokens
         entries.append(entry)
     doc = {"data": entries}
     if path:

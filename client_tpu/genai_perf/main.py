@@ -172,6 +172,22 @@ def build_parser() -> argparse.ArgumentParser:
         help="record schema of --input-dataset",
     )
     parser.add_argument("--num-prompts", type=int, default=50)
+    parser.add_argument(
+        "--shared-prefix-tokens", type=int, default=0,
+        help="prepend ONE fixed synthetic prefix of N tokens to every "
+        "prompt (a shared system prompt) and stamp each request with a "
+        "prefix-derived 'routing_key' parameter — the copy-on-write "
+        "prefix-sharing workload; pair with --routing-policy "
+        "consistent_hash so a fleet pins sharers to one replica's KV "
+        "index",
+    )
+    parser.add_argument(
+        "--routing-policy", default=None,
+        help="perf-harness passthrough: endpoint-pool routing policy "
+        "(round_robin/least_outstanding/p2c/consistent_hash) for "
+        "multi-replica -u host1,host2 runs; kserve endpoint types only "
+        "(the harness rejects it for the openai client)",
+    )
     parser.add_argument("--synthetic-input-tokens-mean", type=int, default=64)
     parser.add_argument(
         "--synthetic-input-tokens-stddev", type=float, default=0.0
@@ -330,6 +346,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         dataset_path=args.input_dataset,
         dataset_format=args.dataset_format,
         prompts=hub_prompts,
+        shared_prefix_tokens=args.shared_prefix_tokens,
     )
     log.info("profiling model %s at %s", args.model, args.url)
 
@@ -351,6 +368,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         perf_args.append("--streaming")
     # output lengths are embedded per request in the generated input data
     # ("parameters" key), so no global max_tokens request parameter here
+    if args.routing_policy:
+        perf_args += ["--routing-policy", args.routing_policy]
     if args.request_rate is not None:
         perf_args += ["--request-rate-range", str(args.request_rate)]
     else:

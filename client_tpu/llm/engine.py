@@ -56,7 +56,9 @@ class EngineConfig:
     buckets); ``max_queue`` bounds the waiting room (0 = unbounded);
     ``max_seq_len`` is the model's context limit (prompt + max_tokens
     validated against it at submit); ``priority_levels`` sizes the
-    waiting queue's priority lanes.
+    waiting queue's priority lanes; ``prefix_sharing`` turns the
+    copy-on-write prompt-block index on (default) or off (the A/B
+    baseline for the sharing bench).
     """
 
     __slots__ = (
@@ -68,6 +70,7 @@ class EngineConfig:
         "priority_levels",
         "default_max_tokens",
         "prefill_bucket_min",
+        "prefix_sharing",
     )
 
     def __init__(
@@ -80,6 +83,7 @@ class EngineConfig:
         priority_levels: int = 3,
         default_max_tokens: int = 16,
         prefill_bucket_min: int = 8,
+        prefix_sharing: bool = True,
     ):
         self.block_size = int(block_size)
         self.num_blocks = int(num_blocks)
@@ -89,6 +93,7 @@ class EngineConfig:
         self.priority_levels = max(1, int(priority_levels))
         self.default_max_tokens = int(default_max_tokens)
         self.prefill_bucket_min = int(prefill_bucket_min)
+        self.prefix_sharing = bool(prefix_sharing)
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -100,6 +105,20 @@ _RUNNING = "running"
 _DONE = "done"
 
 
+def block_bucket(n: int) -> int:
+    """Page-table width bucket: powers of two up to 8 blocks, multiples
+    of 8 beyond. Finer than pure powers of two at the top (a 17-block
+    context pays for 24, not 32) while still bounding the compiled
+    program count to O(max_blocks / 8 + 3)."""
+    n = max(1, int(n))
+    if n <= 8:
+        bucket = 1
+        while bucket < n:
+            bucket *= 2
+        return bucket
+    return ((n + 7) // 8) * 8
+
+
 def _int_param(name: str, value: Any) -> int:
     """Coerce a wire request parameter; malformed values are a client
     error (400/INVALID_ARGUMENT), never an internal 500."""
@@ -109,6 +128,21 @@ def _int_param(name: str, value: Any) -> int:
         raise InferenceServerException(
             f"request parameter {name!r} must be an integer, got {value!r}"
         ) from None
+
+
+def _float_param(name: str, value: Any) -> float:
+    """Like :func:`_int_param` for float-valued wire parameters."""
+    try:
+        result = float(value)
+    except (TypeError, ValueError):
+        raise InferenceServerException(
+            f"request parameter {name!r} must be a number, got {value!r}"
+        ) from None
+    if result != result or result in (float("inf"), float("-inf")):
+        raise InferenceServerException(
+            f"request parameter {name!r} must be finite, got {value!r}"
+        )
+    return result
 
 
 class Sequence:
@@ -134,12 +168,18 @@ class Sequence:
         "position",
         "cancelled",
         "preemptions",
+        "temperature",
+        "top_k",
+        "seed",
+        "block_hashes",
+        "shared_blocks",
         "_out",
         "_engine",
     )
 
     def __init__(self, seq_id, prompt, max_tokens, priority_level,
-                 deadline_ns, timeout_us, max_blocks: int, engine):
+                 deadline_ns, timeout_us, max_blocks: int, engine,
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0):
         self.seq_id = seq_id
         self.prompt: List[int] = prompt
         self.generated: List[int] = []
@@ -154,6 +194,18 @@ class Sequence:
         self.position = 0
         self.cancelled = False
         self.preemptions = 0
+        # sampling: temperature <= 0 is greedy; the PRNG key chain is
+        # (seed, index-of-generated-token), so a preempt-and-resume
+        # replays the exact same draws it would have made uninterrupted
+        self.temperature = temperature
+        self.top_k = top_k
+        self.seed = seed
+        # chained content hashes of the prompt's FULL blocks (computed
+        # once at submit; matched against / published to the allocator's
+        # shared index at every admission, including resumes)
+        self.block_hashes: List[bytes] = []
+        # leading blocks this sequence references but must never write
+        self.shared_blocks = 0
         self._out: asyncio.Queue = asyncio.Queue()
         self._engine = engine
 
@@ -192,13 +244,17 @@ class Sequence:
 class LlmEngine:
     """The continuous-batching engine; see the module docstring.
 
-    ``prefill_fn(tokens[1, L], page_table[max_blocks], pages, last_index)
-    -> (logits[1, V], pages)`` and ``decode_fn(tokens[B], positions[B],
-    page_tables[B, max_blocks], pages) -> (logits[B, V], pages)`` are the
-    injected (jitted) device callables; ``pages`` is opaque to the
-    engine. ``metrics`` implements the ServerMetrics LLM hooks
-    (set_kv_blocks / set_llm_sequences / observe_llm_step /
-    observe_llm_preemption / observe_rejection); None disables export.
+    ``prefill_fn(tokens[1, L], page_table[max_blocks], pages, last_index,
+    start_index) -> (logits[1, V], pages)`` (``tokens`` holds ONLY the
+    unshared suffix ``context[start_index:]``; ``last_index`` is its
+    local last-token index; ``start_index`` is 0 when nothing matched)
+    and ``decode_fn(tokens[B], positions[B], page_tables[B, NB], pages)
+    -> (logits[B, V], pages)`` (``NB`` is the engine's ragged block
+    bucket — any width up to ``max_blocks_per_seq``) are the injected
+    (jitted) device callables; ``pages`` is opaque to the engine.
+    ``metrics`` implements the ServerMetrics LLM hooks (set_kv_blocks /
+    set_llm_sequences / observe_llm_step / observe_llm_preemption /
+    observe_prefix_hits / observe_rejection); None disables export.
     """
 
     def __init__(
@@ -242,6 +298,11 @@ class LlmEngine:
         self.completed = 0
         self.cancelled_count = 0
         self.expired = 0
+        # full prompt blocks demanded across admissions — with
+        # allocator.prefix_hits this yields the true prefix hit rate
+        # (hits / demand), since the allocator only ever sees the
+        # pre-matched hash slice
+        self.prefix_block_demand = 0
 
     # -- submission / cancellation (serving-loop only) -----------------------
 
@@ -284,10 +345,26 @@ class LlmEngine:
                 f"prompt ({len(prompt)}) + max_tokens ({max_tokens}) "
                 f"exceeds max sequence length {config.max_seq_len}"
             )
-        if self.allocator.blocks_for(total) > self.allocator.capacity:
+        block_hashes = (
+            self.allocator.chain_hashes(prompt)
+            if config.prefix_sharing
+            else []
+        )
+        # capacity fast-fail against POST-MATCH demand: blocks the shared
+        # index already holds are referenced, not allocated, so a prompt
+        # mostly covered by a live shared prefix must not be 400'd for a
+        # worst-case block count it will never request (the index can
+        # shrink before admission — then the request queues like any
+        # other too-big-for-now work instead of failing)
+        matched_now = min(
+            self.allocator.match_count(block_hashes),
+            self._match_cap(len(prompt)),
+        )
+        if self.allocator.blocks_for(total) - matched_now > self.allocator.capacity:
             raise InferenceServerException(
                 f"request needs {self.allocator.blocks_for(total)} KV "
-                f"blocks but the pool holds {self.allocator.capacity}"
+                f"blocks ({matched_now} shared) but the pool holds "
+                f"{self.allocator.capacity}"
             )
         # parse the remaining wire parameters BEFORE the queue-full
         # check: a malformed request is a 400, not a 429
@@ -301,6 +378,27 @@ class LlmEngine:
             "timeout_us",
             parameters.get("timeout_us", parameters.get("timeout", 0)) or 0,
         )
+        temperature = _float_param(
+            "temperature", parameters.get("temperature", 0.0) or 0.0
+        )
+        if temperature < 0.0:
+            raise InferenceServerException(
+                f"request parameter 'temperature' must be >= 0, "
+                f"got {temperature}"
+            )
+        top_k = _int_param("top_k", parameters.get("top_k", 0) or 0)
+        if top_k < 0:
+            raise InferenceServerException(
+                f"request parameter 'top_k' must be >= 0, got {top_k}"
+            )
+        seed = _int_param("seed", parameters.get("seed", 0) or 0)
+        if seed < 0:
+            # np.random.default_rng rejects negative entropy — validate
+            # here so a bad seed is a 400, not an engine-fatal crash at
+            # first sample
+            raise InferenceServerException(
+                f"request parameter 'seed' must be >= 0, got {seed}"
+            )
         if config.max_queue and len(self._waiting) >= config.max_queue:
             error = QueueFullError(self.model_name, config.max_queue)
             if self.metrics is not None:
@@ -318,7 +416,11 @@ class LlmEngine:
             timeout_us,
             config.max_blocks_per_seq,
             self,
+            temperature=temperature,
+            top_k=top_k,
+            seed=seed,
         )
+        seq.block_hashes = block_hashes
         self._waiting.push(seq, level=level, deadline_ns=deadline_ns)
         self._ensure_task()
         self._publish()
@@ -402,6 +504,7 @@ class LlmEngine:
             "waiting_sequences": len(self._waiting),
             "kv_blocks_in_use": self.allocator.blocks_in_use,
             "kv_blocks_total": self.allocator.capacity,
+            "kv_blocks_shared": self.allocator.blocks_shared,
             "block_size": self.allocator.block_size,
             "steps": self.steps,
             "tokens_generated": self.tokens_generated,
@@ -409,6 +512,9 @@ class LlmEngine:
             "completed": self.completed,
             "cancelled": self.cancelled_count,
             "expired": self.expired,
+            "prefix_cache_hits": self.allocator.prefix_hits,
+            "prefix_cache_queries": self.allocator.prefix_queries,
+            "prefix_block_demand": self.prefix_block_demand,
         }
 
     # -- step loop -----------------------------------------------------------
@@ -496,12 +602,22 @@ class LlmEngine:
                     seq.state = _DONE
             self._running = [s for s in self._running if not s.cancelled]
 
+    def _match_cap(self, context_len: int) -> int:
+        """Most shared blocks a context of this length may reference: at
+        least ONE token (the last) must always be recomputed, because the
+        first sampled token needs its logits — an all-block-aligned full
+        match would otherwise leave nothing to prefill."""
+        return max(0, (context_len - 1) // self.allocator.block_size)
+
     async def _admit(self) -> None:
         """Prefill waiting sequences into the running batch, in
         (priority, arrival) order, while the block pool and the
         ``max_active`` bound allow. The first blocker stops admission —
         a full cache queues behind it rather than skipping ahead (no
-        starvation of large prompts)."""
+        starvation of large prompts). Prompt blocks already in the shared
+        index are referenced instead of allocated (capacity math counts
+        NEW blocks only) and their prefill is skipped: TTFT is one
+        partial prefill of the unshared suffix."""
         allocator = self.allocator
         for item in self._waiting.scan():
             seq: Sequence = item.value
@@ -511,14 +627,39 @@ class LlmEngine:
             # +1: the first decode step writes the freshly-sampled
             # token's K/V at position len(context)
             need = allocator.blocks_for(len(context) + 1)
-            if need > allocator.free_blocks:
+            cap = self._match_cap(len(context))
+            usable = min(
+                allocator.match_count(seq.block_hashes), cap, len(seq.block_hashes)
+            )
+            if need - usable > allocator.capacity:
+                # admitted on the strength of a shared prefix that has
+                # since been reclaimed (its sharers finished): the
+                # residual demand can never be satisfied — fail cleanly
+                # instead of blocking the admission queue forever
+                self._waiting.remove([item])
+                error = CacheCapacityError(
+                    f"request needs {need - usable} KV blocks but the "
+                    f"pool holds {allocator.capacity} (a previously "
+                    f"shared prefix is no longer resident)"
+                )
+                if self.metrics is not None:
+                    self.metrics.observe_rejection(
+                        self.model_name, "kv_capacity"
+                    )
+                seq.fail(error)
+                continue
+            if need - usable > allocator.free_blocks:
                 break
             self._waiting.remove([item])
             if seq.cancelled:
                 seq.state = _DONE
                 continue
-            blocks = allocator.allocate(seq.seq_id, need)
+            self.prefix_block_demand += len(seq.block_hashes)
+            blocks, matched = allocator.allocate_shared(
+                seq.seq_id, need, seq.block_hashes[:usable]
+            )
             seq.blocks = blocks
+            seq.shared_blocks = matched
             seq.page_table[:] = TRASH_BLOCK
             seq.page_table[: len(blocks)] = blocks
             # visible to _fail_all while the prefill await is in flight:
@@ -527,8 +668,18 @@ class LlmEngine:
             # device failure it must still be set when the _run handlers
             # reclaim it; only a successful prefill clears it here.
             self._admitting = seq
-            token = await self._prefill_one(seq, context)
+            logits = await self._prefill_one(
+                seq, context, matched * allocator.block_size
+            )
+            # the sequence's full prompt blocks (matched + just
+            # prefilled) now hold valid K/V — publish them for the next
+            # identical prefix
+            if self.config.prefix_sharing:
+                allocator.publish(seq.seq_id, seq.block_hashes)
             self._admitting = None
+            if matched and self.metrics is not None:
+                self.metrics.observe_prefix_hits(self.model_name, matched)
+            token = self._sample(seq, logits)
             seq.generated.append(token)
             seq.last_token = token
             seq.position = len(context)
@@ -543,17 +694,22 @@ class LlmEngine:
                 seq.state = _RUNNING
                 self._running.append(seq)
 
-    async def _prefill_one(self, seq: Sequence, context: List[int]):
+    async def _prefill_one(self, seq: Sequence, context: List[int],
+                           start: int) -> np.ndarray:
+        """Prefill ``context[start:]`` (``start`` = matched shared
+        blocks, always block-aligned and < len(context)) and return the
+        last real token's logits row."""
         from client_tpu.server.models import pad_batch_bucket
 
+        suffix = context[start:]
         bucket = min(
             pad_batch_bucket(
-                len(context), minimum=self.config.prefill_bucket_min
+                len(suffix), minimum=self.config.prefill_bucket_min
             ),
             self.config.max_seq_len,
         )
         tokens = np.zeros([1, bucket], dtype=np.int32)
-        tokens[0, : len(context)] = context
+        tokens[0, : len(suffix)] = suffix
         # A failing device call is ENGINE-fatal, not sequence-fatal: the
         # inputs were engine-constructed (request validation happened at
         # submit) and the donated page pool may be gone — let it
@@ -564,9 +720,28 @@ class LlmEngine:
             tokens,
             seq.page_table,
             self._pages,
-            len(context) - 1,
+            len(suffix) - 1,
+            start,
         )
-        return int(np.asarray(logits)[0].argmax())
+        return np.asarray(logits)[0]
+
+    def _sample(self, seq: Sequence, logits: np.ndarray) -> int:
+        """Next token from a logits row: greedy unless the sequence asked
+        for temperature sampling. The PRNG key is (seed, n) where n is
+        the index of the token being sampled — pure function of the
+        sequence's history length, so a preempted-and-resumed generation
+        draws exactly what the uninterrupted one would have."""
+        if seq.temperature <= 0.0:
+            return int(np.asarray(logits).argmax())
+        scaled = np.asarray(logits, dtype=np.float64) / seq.temperature
+        if seq.top_k and seq.top_k < scaled.shape[-1]:
+            kth = np.partition(scaled, -seq.top_k)[-seq.top_k]
+            scaled = np.where(scaled < kth, -np.inf, scaled)
+        scaled = scaled - scaled.max()
+        probs = np.exp(scaled)
+        probs /= probs.sum()
+        rng = np.random.default_rng((seq.seed, len(seq.generated)))
+        return int(rng.choice(scaled.shape[-1], p=probs))
 
     def _pick_victim(self) -> Optional[Sequence]:
         """Preemption victim: lowest priority (highest level number)
@@ -585,6 +760,7 @@ class LlmEngine:
         decode regenerates the identical cache)."""
         self.allocator.free(victim.seq_id)
         victim.blocks = []
+        victim.shared_blocks = 0
         victim.page_table[:] = TRASH_BLOCK
         victim.state = _WAITING
         victim.preemptions += 1
@@ -621,6 +797,27 @@ class LlmEngine:
                     seq.blocks.append(block)
                     seq.page_table[len(seq.blocks) - 1] = block
                 except CacheCapacityError:
+                    if allocator.blocks_for(
+                        seq.position + 1
+                    ) > allocator.capacity:
+                        # the whole pool could not hold this context:
+                        # possible only for a request admitted against a
+                        # shared prefix (post-match demand fit; gross
+                        # footprint never can). Fail it BEFORE picking a
+                        # victim — preempting peers for a request that
+                        # can never fit would drain the whole batch
+                        # first, and preempt-and-retry on itself would
+                        # loop forever.
+                        allocator.free(seq.seq_id)
+                        self._running.remove(seq)
+                        seq.fail(
+                            CacheCapacityError(
+                                f"context ({seq.position + 1} tokens) "
+                                f"outgrew the KV pool "
+                                f"({allocator.capacity} blocks)"
+                            )
+                        )
+                        break
                     victim = self._pick_victim()
                     self._preempt(victim)
                     if victim is seq:
@@ -630,25 +827,42 @@ class LlmEngine:
             return
         n = len(batch)
         bucket = pad_batch_bucket(n)
+        # ragged page-table width: the decode kernel's attention cost is
+        # proportional to the table width it sees, so slice it to a
+        # bucket of the LONGEST live sequence instead of always paying
+        # max_seq_len (bounded recompiles; see block_bucket)
+        nb = min(
+            block_bucket(max(len(seq.blocks) for seq in batch)),
+            self.config.max_blocks_per_seq,
+        )
         tokens = np.zeros([bucket], dtype=np.int32)
         positions = np.zeros([bucket], dtype=np.int32)
-        page_tables = np.zeros(
-            [bucket, self.config.max_blocks_per_seq], dtype=np.int32
-        )
+        page_tables = np.zeros([bucket, nb], dtype=np.int32)
         for i, seq in enumerate(batch):
             tokens[i] = seq.last_token
             positions[i] = seq.position
-            page_tables[i] = seq.page_table
+            page_tables[i] = seq.page_table[:nb]
+            # COW invariant: the block this lane is about to write must
+            # be exclusively owned (shared prefix blocks are read-only;
+            # growth always lands in fresh blocks). A violation means
+            # allocator state is corrupt — engine-fatal, not a lane skip.
+            write_block = seq.position // allocator.block_size
+            if allocator.refcount(seq.blocks[write_block]) != 1:
+                raise InferenceServerException(
+                    f"COW violation: sequence {seq.seq_id} would write "
+                    f"block {seq.blocks[write_block]} with refcount "
+                    f"{allocator.refcount(seq.blocks[write_block])}"
+                )
         logits, self._pages = await self._run_device(
             self._decode, tokens, positions, page_tables, self._pages
         )
-        next_tokens = np.asarray(logits)[:n].argmax(axis=-1)
+        logits_rows = np.asarray(logits)[:n]
         self.steps += 1
         emitted = 0
-        for seq, token in zip(batch, next_tokens):
+        for seq, row in zip(batch, logits_rows):
             if seq.cancelled:
                 continue  # pruned (and freed) next iteration
-            token = int(token)
+            token = self._sample(seq, row)
             seq.generated.append(token)
             seq.last_token = token
             seq.position += 1
@@ -678,6 +892,7 @@ class LlmEngine:
             self.model_name,
             self.allocator.blocks_in_use,
             self.allocator.capacity,
+            self.allocator.blocks_shared,
         )
         self.metrics.set_llm_sequences(
             self.model_name, len(self._running), len(self._waiting)

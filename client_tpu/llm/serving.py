@@ -10,6 +10,7 @@ token instead of N serial steps. Served through all streaming surfaces
 decoupled model.
 """
 
+import os
 from typing import Any, AsyncIterator, Dict, Optional
 
 import numpy as np
@@ -64,12 +65,84 @@ class LlmEngineModel(Model):
         self.engine_config = engine_config
         self._params = params
         self.engine: Optional[LlmEngine] = None
+        # which ragged paged-attention implementation warmup selected
+        # ("pallas" / "pallas_interpret" / "fused_xla" / "standin");
+        # reported in the model config's parameters map
+        self.decode_kernel: Optional[str] = None
         self._core = None
+
+    def _build_device_fns(self, params, config, engine_config, attn, donate):
+        """The engine's three jitted device callables for one attention
+        implementation: (prefill, decode). ``prefill`` routes start==0
+        (no shared prefix) through the untouched full-prompt path and
+        block-aligned suffixes through ``prefill_suffix_into_pages`` with
+        a STATIC power-of-two prefix-gather bucket (bounded recompiles,
+        one program per (suffix bucket, prefix bucket) pair)."""
+        import jax
+
+        from client_tpu.models import llama
+
+        donate_kw = {"donate_argnums": (2,)} if donate else {}
+        prefill_full = jax.jit(
+            lambda tokens, page_table, pages, last_index: (
+                llama.prefill_into_pages(
+                    params, tokens, page_table, pages, last_index, config
+                )
+            ),
+            **donate_kw,
+        )
+        prefill_suffix = jax.jit(
+            lambda tokens, page_table, pages, last_index, start_index, prefix_blocks: (  # noqa: E501
+                llama.prefill_suffix_into_pages(
+                    params, tokens, page_table, pages, last_index,
+                    start_index, prefix_blocks, config,
+                )
+            ),
+            static_argnums=(5,),
+            **donate_kw,
+        )
+        block_size = engine_config.block_size
+
+        def prefill(tokens, page_table, pages, last_index, start_index):
+            if not start_index:
+                return prefill_full(tokens, page_table, pages, last_index)
+            from client_tpu.llm.engine import block_bucket
+
+            needed = start_index // block_size
+            prefix_blocks = min(
+                block_bucket(needed), engine_config.max_blocks_per_seq
+            )
+            return prefill_suffix(
+                tokens, page_table, pages, last_index,
+                np.int32(start_index), prefix_blocks,
+            )
+
+        donate_kw = {"donate_argnums": (3,)} if donate else {}
+        if attn is None:
+            decode = jax.jit(
+                lambda tokens, positions, page_tables, pages: (
+                    llama.decode_step_paged(
+                        params, tokens, positions, page_tables, pages, config
+                    )
+                ),
+                **donate_kw,
+            )
+        else:
+            decode = jax.jit(
+                lambda tokens, positions, page_tables, pages: (
+                    llama.decode_step_paged_attn(
+                        params, tokens, positions, page_tables, pages,
+                        config, attn,
+                    )
+                ),
+                **donate_kw,
+            )
+        return prefill, decode
 
     def warmup(self) -> None:
         import jax
 
-        from client_tpu.models import llama
+        from client_tpu.models import llama, paged_attention
 
         config = self._config
         if self._params is None:
@@ -82,42 +155,79 @@ class LlmEngineModel(Model):
         # step); the CPU backend does not implement donation and warns,
         # so only donate on real accelerators.
         donate = jax.default_backend() != "cpu"
-        prefill = jax.jit(
-            lambda tokens, page_table, pages, last_index: (
-                llama.prefill_into_pages(
-                    params, tokens, page_table, pages, last_index, config
-                )
-            ),
-            donate_argnums=(2,) if donate else (),
+        # kernel selection: env override > platform preference, probed by
+        # actually compiling+running the smallest shapes — a backend that
+        # cannot serve this host falls down the chain at WARMUP, never at
+        # request time. The survivor is reported in the model config.
+        preferred, _ = paged_attention.resolve_decode_attention(
+            os.environ.get("CLIENT_TPU_LLM_KERNEL"), jax.default_backend()
         )
-        decode = jax.jit(
-            lambda tokens, positions, page_tables, pages: (
-                llama.decode_step_paged(
-                    params, tokens, positions, page_tables, pages, config
-                )
-            ),
-            donate_argnums=(3,) if donate else (),
-        )
-        pages = llama.init_kv_pages(
-            config, engine_config.num_blocks, engine_config.block_size
-        )
-        # compile the smallest shapes up front (page table all-zeros =
-        # every write lands in the reserved trash block)
+        candidates = [preferred]
+        for fallback in ("fused_xla", "standin"):
+            if fallback not in candidates:
+                candidates.append(fallback)
         max_blocks = engine_config.max_blocks_per_seq
         table = np.zeros([max_blocks], dtype=np.int32)
-        logits, pages = prefill(
-            np.zeros([1, engine_config.prefill_bucket_min], dtype=np.int32),
-            table,
-            pages,
-            engine_config.prefill_bucket_min - 1,
-        )
-        logits, pages = decode(
-            np.zeros([1], dtype=np.int32),
-            np.zeros([1], dtype=np.int32),
-            table[None, :],
-            pages,
-        )
-        jax.block_until_ready(logits)
+        last_error: Optional[Exception] = None
+        prefill = decode = pages = None
+        for name in candidates:
+            attn = (
+                None if name == "standin"
+                else paged_attention.get_attention_impl(name)
+            )
+            try:
+                prefill, decode = self._build_device_fns(
+                    params, config, engine_config, attn, donate
+                )
+                # fresh pool per attempt: a candidate that failed after
+                # donation may have consumed the previous buffers
+                pages = llama.init_kv_pages(
+                    config, engine_config.num_blocks, engine_config.block_size
+                )
+                # probe the shapes the engine actually serves (page
+                # table all-zeros = every write lands in the reserved
+                # trash block): full prefill at the smallest bucket, the
+                # ragged decode at block buckets 1 AND multi-block (a
+                # kernel whose tiling only breaks at wider widths must
+                # fall down the chain HERE, not engine-fatally at
+                # request time), and — when sharing is on — one suffix
+                # prefill so the shared-prefix path is both validated
+                # and pre-compiled before the first hit.
+                probe_tokens = np.zeros(
+                    [1, engine_config.prefill_bucket_min], dtype=np.int32
+                )
+                logits, pages = prefill(
+                    probe_tokens,
+                    table,
+                    pages,
+                    engine_config.prefill_bucket_min - 1,
+                    0,
+                )
+                if engine_config.prefix_sharing and max_blocks > 1:
+                    logits, pages = prefill(
+                        probe_tokens,
+                        table,
+                        pages,
+                        engine_config.prefill_bucket_min - 1,
+                        engine_config.block_size,
+                    )
+                for nb in {1, min(8, max_blocks)}:
+                    logits, pages = decode(
+                        np.zeros([1], dtype=np.int32),
+                        np.zeros([1], dtype=np.int32),
+                        table[None, :nb],
+                        pages,
+                    )
+                jax.block_until_ready(logits)
+                self.decode_kernel = name
+                break
+            except Exception as e:  # noqa: BLE001 - fall down the chain
+                last_error = e
+                prefill = decode = pages = None
+        if decode is None:
+            raise InferenceServerException(
+                f"no paged-attention kernel usable on this host: {last_error}"
+            ) from last_error
         # a reload replaces the engine wholesale: fresh pool, clean
         # accounting (the old engine's streams were drained by the
         # lifecycle layer before the swap)
@@ -131,6 +241,23 @@ class LlmEngineModel(Model):
             model_name=self.name,
         )
         self._core = None  # rebind metrics/executor after a reload
+
+    def config(self) -> Dict[str, Any]:
+        """Model config with the warmup-selected decode kernel and the
+        prefix-sharing mode in the parameters map (Triton ModelParameter
+        wire shape — both protocols surface it, like the mesh topology
+        does for sharded models)."""
+        doc = super().config()
+        parameters = doc.setdefault("parameters", {})
+        parameters["decode_kernel"] = {
+            "string_value": self.decode_kernel or "uninitialized"
+        }
+        parameters["prefix_sharing"] = {
+            "string_value": (
+                "cow" if self.engine_config.prefix_sharing else "off"
+            )
+        }
+        return doc
 
     def shutdown(self) -> None:
         """Stop the engine's step loop (``ServerCore.close`` hook)."""

@@ -518,6 +518,130 @@ def decode_step_paged(
     return logits.astype(jnp.float32), new_pages
 
 
+def decode_step_paged_attn(
+    params, tokens, positions, page_tables, pages, config: LlamaConfig, attn
+):
+    """:func:`decode_step_paged` with the attention read delegated to a
+    ragged paged-attention kernel (``models/paged_attention.py``).
+
+    Same contract as the stand-in, with one extra degree of freedom: the
+    page-table width ``page_tables.shape[1]`` may be any bucket the
+    caller chooses — the engine slices it to the live batch's longest
+    sequence, so attention cost follows actual context instead of
+    ``max_seq_len``.  ``attn(q[B, H, D], k_pages, v_pages, page_tables,
+    positions) -> [B, H, D]`` is one of the implementations selected at
+    warmup (Pallas on TPU, fused XLA elsewhere)."""
+    b = tokens.shape[0]
+    block_size = pages[0][0].shape[1]
+    pos2 = positions[:, None]  # [B, 1]
+    phys = page_tables[jnp.arange(b), positions // block_size]  # [B]
+    off = positions % block_size
+    x = params["embed"][tokens][:, None, :].astype(config.dtype)
+    new_pages = []
+    for layer, (k_pages, v_pages) in zip(params["layers"], pages):
+        normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q = jnp.einsum("bld,dhk->blhk", normed, layer["wq"])
+        k = jnp.einsum("bld,dhk->blhk", normed, layer["wk"])
+        v = jnp.einsum("bld,dhk->blhk", normed, layer["wv"])
+        q = _rope(q, pos2, config.rope_theta)
+        k = _rope(k, pos2, config.rope_theta)
+        # scatter this step's K/V, THEN attend: the current position's
+        # entry must be visible to its own attention
+        k_pages = k_pages.at[phys, off].set(k[:, 0])
+        v_pages = v_pages.at[phys, off].set(v[:, 0])
+        new_pages.append((k_pages, v_pages))
+        out = attn(q[:, 0], k_pages, v_pages, page_tables, positions)
+        x = x + jnp.einsum("bhk,hkd->bd", out, layer["wo"])[:, None, :]
+        x = x + _mlp_block(layer, rms_norm(x, layer["mlp_norm"], config.norm_eps))
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = jnp.einsum("bd,dv->bv", x[:, 0], params["lm_head"])
+    return logits.astype(jnp.float32), new_pages
+
+
+def prefill_suffix_into_pages(
+    params, tokens, page_table, pages, last_index, start_index,
+    prefix_blocks: int, config: LlamaConfig
+):
+    """Prefill ONLY a prompt's unshared suffix, attending to its shared
+    prefix through the block pool (the compute half of copy-on-write
+    prefix sharing: matched blocks are read, never recomputed, never
+    written).
+
+    ``tokens`` [1, L] holds the suffix (``context[start_index:]``) padded
+    to the bucket length L; ``last_index`` is the suffix-LOCAL index of
+    the real last token and ``start_index`` the absolute position of
+    ``tokens[0, 0]`` (both traced scalars; ``start_index`` is always
+    block-aligned — prefix matches are whole blocks).  ``prefix_blocks``
+    is STATIC (a power-of-two bucket >= ``start_index // block_size``):
+    it fixes the gather width for the shared-prefix context, and slack
+    blocks in the bucket are masked by absolute position, so gathering a
+    slot the suffix scatter just wrote (or the trash block) can never
+    leak into attention.  Returns (logits_of_last_token [1, V],
+    new_pages); only blocks at index >= ``start_index // block_size``
+    are written — shared blocks stay untouched, which is the engine's
+    COW invariant."""
+    b, l = tokens.shape
+    block_size = pages[0][0].shape[1]
+    kv_heads = config.n_kv_heads
+    pos = jnp.arange(l)
+    abs_pos = start_index + pos  # [L] absolute positions of the suffix
+    valid_w = pos <= last_index
+    phys_w = jnp.where(valid_w, page_table[abs_pos // block_size], 0)
+    off_w = jnp.where(valid_w, abs_pos % block_size, 0)
+    s0 = prefix_blocks * block_size
+    # key-validity masks: prefix slot s is real iff s < start_index
+    # (bucket slack and trash land above it); suffix key j needs
+    # causality within the suffix and j <= last_index (padding tail)
+    prefix_valid = (jnp.arange(s0) < start_index)[None, :]  # [1, s0]
+    suffix_valid = (pos[:, None] >= pos[None, :]) & (
+        pos[None, :] <= last_index
+    )  # [L, L]
+    mask = jnp.concatenate(
+        [jnp.broadcast_to(prefix_valid, (l, s0)), suffix_valid], axis=1
+    )  # [L, s0+L]
+    x = params["embed"][tokens].astype(config.dtype)
+    new_pages = []
+    g = config.n_heads // kv_heads
+    for layer, (k_pages, v_pages) in zip(params["layers"], pages):
+        normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q = jnp.einsum("bld,dhk->blhk", normed, layer["wq"])
+        k = jnp.einsum("bld,dhk->blhk", normed, layer["wk"])
+        v = jnp.einsum("bld,dhk->blhk", normed, layer["wv"])
+        q = _rope(q, abs_pos[None, :], config.rope_theta)
+        k = _rope(k, abs_pos[None, :], config.rope_theta)
+        k_pages = k_pages.at[phys_w, off_w].set(k[0])
+        v_pages = v_pages.at[phys_w, off_w].set(v[0])
+        new_pages.append((k_pages, v_pages))
+        k_pref = k_pages[page_table[:prefix_blocks]].reshape(
+            1, s0, kv_heads, config.head_dim
+        )
+        v_pref = v_pages[page_table[:prefix_blocks]].reshape(
+            1, s0, kv_heads, config.head_dim
+        )
+        k_all = jnp.concatenate([k_pref.astype(k.dtype), k], axis=1)
+        v_all = jnp.concatenate([v_pref.astype(v.dtype), v], axis=1)
+        qg = q.reshape(b, l, kv_heads, g, config.head_dim)
+        scores = jnp.einsum(
+            "blkgd,bskd->bkgls", qg, k_all,
+            preferred_element_type=jnp.float32,
+        ) / np.sqrt(config.head_dim)
+        scores = jnp.where(mask[None, None, None], scores, -1e30)
+        weights = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum(
+            "bkgls,bskd->blkgd", weights, v_all.astype(weights.dtype)
+        ).reshape(b, l, config.n_heads, config.head_dim).astype(x.dtype)
+        x = x + jnp.einsum("blhk,hkd->bld", out, layer["wo"])
+        x = x + _mlp_block(layer, rms_norm(x, layer["mlp_norm"], config.norm_eps))
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    last = jnp.take_along_axis(
+        x, jnp.full((b, 1, 1), last_index, dtype=jnp.int32).repeat(
+            x.shape[-1], axis=-1
+        ), axis=1,
+    )[:, 0]
+    logits = jnp.einsum("bd,dv->bv", last, params["lm_head"])
+    return logits.astype(jnp.float32), new_pages
+
+
 def generate(
     params,
     prompt_tokens: jnp.ndarray,

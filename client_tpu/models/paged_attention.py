@@ -1,0 +1,270 @@
+"""Ragged paged-attention decode kernels (the compute half of ROADMAP item 2).
+
+The continuous-batching engine stores every live sequence's KV cache as a
+page table over ONE physical block pool (``models/llama.py``
+``init_kv_pages``).  The decode step's attention must therefore read a
+*ragged* set of pages per sequence — each sequence attends over however
+many blocks it has actually earned.  This module provides the three
+implementations of that read, in ascending order of fusion ("Ragged Paged
+Attention", PAPERS.md arxiv 2604.15464, is the blueprint):
+
+- ``standin``: the PR-9 XLA gather/scatter stand-in — gathers every
+  sequence's pages into a contiguous ``[B, S, KV, D]`` view, materializes
+  the grouped-query head repeat, and runs a validity-masked softmax over
+  the FULL padded width.  Kept as the bench baseline.
+- ``fused_xla``: one fused XLA call that skips the ``repeat_kv``
+  materialization entirely (grouped-query einsum over the gathered pages)
+  and works on whatever page-table width the caller passes — the engine
+  buckets that width to the live batch's longest sequence, so compute
+  scales with actual context instead of ``max_seq_len``.  This is the
+  fallback wherever Pallas is unavailable.
+- ``pallas``: a flash-style Pallas kernel.  The grid walks
+  ``(sequence, block)``; the page table and positions ride scalar
+  prefetch so each grid step's BlockSpec ``index_map`` streams exactly
+  ONE physical block from the pool into VMEM — no ``[B, S]`` gather ever
+  materializes.  Online-softmax scratch (running max / denominator /
+  accumulator) carries across the block axis.  ``pallas_interpret`` runs
+  the same kernel under the Pallas interpreter for CPU parity tests.
+
+Selection happens once at model warmup (``llm/serving.py``): real TPU
+hosts probe the Pallas kernel, everything else takes ``fused_xla``, and
+the chosen backend is reported in the model's config parameters.  All
+implementations share one contract::
+
+    attn(q[B, H, D], k_pages[N, bs, KV, D], v_pages[N, bs, KV, D],
+         page_tables[B, NB], positions[B]) -> out[B, H, D]
+
+with slot validity ``block*bs + offset <= positions[b]`` (the freshly
+scattered token attends to itself) and physical block 0 reserved as the
+trash block whose slots are always masked by that rule.
+"""
+
+import functools
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+#: names accepted by :func:`resolve_decode_attention`, best first
+KERNELS = ("pallas", "pallas_interpret", "fused_xla", "standin")
+
+
+# ---------------------------------------------------------------------------
+# stand-in (PR-9 baseline): gather + repeat_kv + full-width masked softmax
+# ---------------------------------------------------------------------------
+
+
+def paged_attention_standin(q, k_pages, v_pages, page_tables, positions):
+    """The gather/scatter stand-in, lifted to the shared attention
+    contract (numerically identical to the inline attention of
+    ``llama.decode_step_paged``)."""
+    b, h, d = q.shape
+    _, bs, kv, _ = k_pages.shape
+    n_rep = h // kv
+    s = page_tables.shape[1] * bs
+    k_ctx = k_pages[page_tables].reshape(b, s, kv, d)
+    v_ctx = v_pages[page_tables].reshape(b, s, kv, d)
+    # the materialized head repeat the fused variants avoid
+    k_rep = jnp.broadcast_to(
+        k_ctx[:, :, :, None, :], (b, s, kv, n_rep, d)
+    ).reshape(b, s, h, d)
+    v_rep = jnp.broadcast_to(
+        v_ctx[:, :, :, None, :], (b, s, kv, n_rep, d)
+    ).reshape(b, s, h, d)
+    qh = q[:, None, :, :].transpose(0, 2, 1, 3)  # [B, H, 1, D]
+    kh = k_rep.transpose(0, 2, 1, 3)  # [B, H, S, D]
+    vh = v_rep.transpose(0, 2, 1, 3)
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", qh, kh, preferred_element_type=jnp.float32
+    ) / (d ** 0.5)
+    valid = jnp.arange(s)[None, :] <= positions[:, None]  # [B, S]
+    scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", weights, vh.astype(weights.dtype))
+    return out[:, :, 0, :].astype(q.dtype)  # [B, H, D]
+
+
+# ---------------------------------------------------------------------------
+# fused XLA variant: grouped-query einsum, no repeat materialization
+# ---------------------------------------------------------------------------
+
+
+def paged_attention_fused_xla(q, k_pages, v_pages, page_tables, positions):
+    """One fused XLA computation over the gathered pages.
+
+    Head layout matches ``_repeat_kv`` (head ``k*g + r`` reads kv head
+    ``k``), so ``q.reshape(b, kv, g, d)`` lines queries up with their kv
+    group and the score/weighted-sum einsums contract directly against
+    the un-repeated context — the ``[B, S, H, D]`` repeat never exists,
+    and S is whatever (bucketed) width the caller's page table has. The
+    gathered context is transposed to ``[B, KV, S, D]`` up front: both
+    contractions then run as plain batched matmuls over adjacent
+    (batch, kv) dims, which measures ~25% faster than contracting the
+    ``[B, S, KV, D]`` gather layout in place (PERF.md PR-14)."""
+    b, h, d = q.shape
+    _, bs, kv, _ = k_pages.shape
+    g = h // kv
+    s = page_tables.shape[1] * bs
+    k_ctx = k_pages[page_tables].reshape(b, s, kv, d).transpose(0, 2, 1, 3)
+    v_ctx = v_pages[page_tables].reshape(b, s, kv, d).transpose(0, 2, 1, 3)
+    qg = q.reshape(b, kv, g, d)
+    scores = jnp.einsum(
+        "bkgd,bksd->bkgs", qg, k_ctx, preferred_element_type=jnp.float32
+    ) / (d ** 0.5)
+    valid = jnp.arange(s)[None, :] <= positions[:, None]  # [B, S]
+    scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgs,bksd->bkgd", weights, v_ctx.astype(weights.dtype))
+    return out.reshape(b, h, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel: per-block streaming + online softmax
+# ---------------------------------------------------------------------------
+
+
+def _rpa_kernel(block_size, n_rep, scale,
+                tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                m_ref, l_ref, acc_ref):
+    """Grid step (b, j): fold physical block ``tbl[b, j]`` of sequence
+    ``b`` into its online-softmax state.  Scratch (running max ``m``,
+    denominator ``l``, accumulator ``acc``) persists across the block
+    axis; the first block initializes it, the last normalizes out."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32)  # [H, D]
+    k = jnp.repeat(k_ref[0].astype(jnp.float32), n_rep, axis=1)  # [bs, H, D]
+    v = jnp.repeat(v_ref[0].astype(jnp.float32), n_rep, axis=1)
+    s = jnp.einsum("hd,thd->ht", q, k) * scale  # [H, bs]
+    # slot validity: absolute slot index <= this sequence's position
+    # (covers ragged tails, padding lanes, and the trash block alike)
+    slot = j * block_size + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_size), 1
+    )
+    valid = slot <= pos_ref[b]
+    s = jnp.where(valid, s, NEG_INF)
+    m_prev = m_ref[:]
+    m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+    p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+    alpha = jnp.exp(m_prev - m_new)
+    l_ref[:] = l_ref[:] * alpha + p.sum(axis=1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + jnp.einsum("ht,thd->hd", p, v)
+    m_ref[:] = m_new
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
+
+
+try:  # Pallas is part of jax but platform support varies
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_IMPORT_ERROR: Optional[Exception] = None
+except Exception as e:  # noqa: BLE001 - degrade to the XLA variants
+    pl = None
+    pltpu = None
+    _PALLAS_IMPORT_ERROR = e
+
+
+def paged_attention_pallas(q, k_pages, v_pages, page_tables, positions,
+                           *, interpret: bool = False):
+    """Flash-style ragged paged attention as a Pallas kernel.
+
+    ``page_tables``/``positions`` are scalar-prefetched so the BlockSpec
+    index maps can stream block ``page_tables[b, j]`` (ONE physical
+    block, ``[bs, KV, D]``) into VMEM per grid step — sequence ``b``
+    never touches pages it does not own, and no contiguous per-sequence
+    view is ever materialized in HBM."""
+    if pl is None:  # pragma: no cover - import-gated host
+        raise RuntimeError(f"pallas unavailable: {_PALLAS_IMPORT_ERROR}")
+    b, h, d = q.shape
+    _, bs, kv, _ = k_pages.shape
+    nb = page_tables.shape[1]
+    kernel = functools.partial(
+        _rpa_kernel, bs, h // kv, 1.0 / (d ** 0.5)
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nb),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda i, j, tbl, pos: (i, 0, 0)),
+            pl.BlockSpec(
+                (1, bs, kv, d), lambda i, j, tbl, pos: (tbl[i, j], 0, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, bs, kv, d), lambda i, j, tbl, pos: (tbl[i, j], 0, 0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda i, j, tbl, pos: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 1), jnp.float32),  # running max
+            pltpu.VMEM((h, 1), jnp.float32),  # running denominator
+            pltpu.VMEM((h, d), jnp.float32),  # weighted-value accumulator
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(page_tables, positions, q, k_pages, v_pages)
+
+
+def paged_attention_pallas_interpret(q, k_pages, v_pages, page_tables,
+                                     positions):
+    """The Pallas kernel under the interpreter — CPU-runnable for parity
+    tests and for forcing the kernel path off-TPU."""
+    return paged_attention_pallas(
+        q, k_pages, v_pages, page_tables, positions, interpret=True
+    )
+
+
+# ---------------------------------------------------------------------------
+# selection
+# ---------------------------------------------------------------------------
+
+_IMPLS = {
+    "standin": paged_attention_standin,
+    "fused_xla": paged_attention_fused_xla,
+    "pallas": paged_attention_pallas,
+    "pallas_interpret": paged_attention_pallas_interpret,
+}
+
+
+def get_attention_impl(name: str) -> Callable:
+    try:
+        return _IMPLS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown paged-attention kernel '{name}' "
+            f"(choose from {', '.join(KERNELS)})"
+        ) from None
+
+
+def resolve_decode_attention(
+    requested: Optional[str], platform: str
+) -> Tuple[str, Callable]:
+    """Pick the decode attention for ``platform`` (a
+    ``jax.default_backend()`` string).
+
+    ``requested`` (the ``CLIENT_TPU_LLM_KERNEL`` env override) forces a
+    specific implementation; otherwise real TPU hosts get the Pallas
+    kernel and everything else the fused XLA variant.  Callers probe the
+    returned callable at warmup and fall back down :data:`KERNELS` on
+    failure, so this only encodes the *preference*."""
+    if requested:
+        return requested, get_attention_impl(requested)
+    if platform == "tpu" and pl is not None:
+        return "pallas", paged_attention_pallas
+    return "fused_xla", paged_attention_fused_xla

@@ -333,6 +333,21 @@ class ServerMetrics:
             model,
             registry=registry,
         )
+        self.kv_blocks_shared = Gauge(
+            "tpu_kv_blocks_shared",
+            "Physical KV blocks referenced by more than one live "
+            "sequence (copy-on-write prefix sharing).",
+            model,
+            registry=registry,
+        )
+        self.prefix_cache_hits = Counter(
+            "tpu_prefix_cache_hits_total",
+            "Prompt blocks served from the shared prefix index instead "
+            "of being prefilled (each hit skips one block of prefill "
+            "compute and memory).",
+            model,
+            registry=registry,
+        )
         self.llm_active_sequences = Gauge(
             "tpu_llm_active_sequences",
             "Sequences in the engine's running decode batch.",
@@ -481,12 +496,20 @@ class ServerMetrics:
 
     # -- LLM engine hooks (client_tpu.llm.engine) ---------------------------
 
-    def set_kv_blocks(self, model: str, in_use: int, total: int) -> None:
+    def set_kv_blocks(
+        self, model: str, in_use: int, total: int, shared: int = 0
+    ) -> None:
         """Publish the paged KV-cache occupancy (the engine calls this on
         every allocation-state change, not at scrape time, so the gauge
         is exact the moment a sequence completes or is cancelled)."""
         self.kv_blocks_in_use.labels(model).set(in_use)
         self.kv_blocks_total.labels(model).set(total)
+        self.kv_blocks_shared.labels(model).set(shared)
+
+    def observe_prefix_hits(self, model: str, blocks: int = 1) -> None:
+        """Book prompt blocks matched in the shared prefix index (their
+        prefill was skipped)."""
+        self.prefix_cache_hits.labels(model).inc(blocks)
 
     def set_llm_sequences(self, model: str, active: int, waiting: int) -> None:
         self.llm_active_sequences.labels(model).set(active)

@@ -12,7 +12,7 @@ streamed back one SSE chunk per generated token.
 
 import json
 import time
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import numpy as np
 from aiohttp import web
@@ -78,10 +78,14 @@ class OpenAiFrontend:
         ]
         return web.json_response({"object": "list", "data": models})
 
-    def _decode_stream(self, model_name: str, prompt_ids, max_tokens: int):
+    def _decode_stream(self, model_name: str, prompt_ids, max_tokens: int,
+                       sampling: Optional[Dict[str, Any]] = None):
         """Async iterator of generated token ids from the decoupled model."""
         from client_tpu.server.core import CoreRequest, CoreTensor
 
+        parameters: Dict[str, Any] = {"max_tokens": max_tokens}
+        if sampling:
+            parameters.update(sampling)
         request = CoreRequest(
             model_name=model_name,
             model_version="",
@@ -94,7 +98,7 @@ class OpenAiFrontend:
                     data=np.asarray(prompt_ids, dtype=np.int32),
                 )
             ],
-            parameters={"max_tokens": max_tokens},
+            parameters=parameters,
         )
         return self.core.infer_decoupled(request)
 
@@ -133,6 +137,40 @@ class OpenAiFrontend:
                     "max_tokens",
                 )
             max_tokens = raw_max
+        # Sampling controls (OpenAI body fields -> engine request
+        # parameters): temperature 0 stays greedy; seed makes a sampled
+        # generation reproducible (per-token PRNG chain, replayed across
+        # engine preemption). Validated here for clean 400s.
+        sampling: Dict[str, Any] = {}
+        raw_temperature = body.get("temperature", None)
+        if raw_temperature is not None:
+            if isinstance(raw_temperature, bool) or not isinstance(
+                raw_temperature, (int, float)
+            ) or raw_temperature < 0:
+                return _invalid_request(
+                    f"temperature must be a non-negative number, got "
+                    f"{raw_temperature!r}",
+                    "temperature",
+                )
+            sampling["temperature"] = float(raw_temperature)
+        raw_seed = body.get("seed", None)
+        if raw_seed is not None:
+            if isinstance(raw_seed, bool) or not isinstance(raw_seed, int):
+                return _invalid_request(
+                    f"seed must be an integer, got {raw_seed!r}", "seed"
+                )
+            sampling["seed"] = raw_seed
+        raw_top_k = body.get("top_k", None)
+        if raw_top_k is not None:
+            if isinstance(raw_top_k, bool) or not isinstance(
+                raw_top_k, int
+            ) or raw_top_k < 0:
+                return _invalid_request(
+                    f"top_k must be a non-negative integer, got "
+                    f"{raw_top_k!r}",
+                    "top_k",
+                )
+            sampling["top_k"] = raw_top_k
         stream = bool(body.get("stream", False))
         self._counter += 1
         completion_id = f"chatcmpl-{self._counter}"
@@ -169,7 +207,9 @@ class OpenAiFrontend:
                 {"error": {"message": e.message()}}, status=404
             )
         try:
-            iterator = self._decode_stream(model_name, prompt_ids, max_tokens)
+            iterator = self._decode_stream(
+                model_name, prompt_ids, max_tokens, sampling
+            )
             if stream:
                 # Pull the FIRST response before committing the SSE 200:
                 # submit-time rejections (context exceeds the model's

@@ -90,9 +90,9 @@ def _stub_engine(clock, **overrides):
     deterministic next token (sum of context mod VOCAB via the carried
     token), pages are an opaque token-independent object."""
 
-    def prefill(tokens, page_table, pages, last_index):
+    def prefill(tokens, page_table, pages, last_index, start):
         logits = np.zeros([1, VOCAB], dtype=np.float32)
-        logits[0, int(tokens.sum()) % VOCAB] = 1.0
+        logits[0, (int(tokens.sum()) + start) % VOCAB] = 1.0
         return logits, pages
 
     def decode(tokens, positions, page_tables, pages):
@@ -370,7 +370,7 @@ def test_close_mid_prefill_reclaims_and_unblocks_consumer():
     release_prefill = threading.Event()
     entered_prefill = threading.Event()
 
-    def prefill(tokens, page_table, pages, last_index):
+    def prefill(tokens, page_table, pages, last_index, start):
         entered_prefill.set()
         release_prefill.wait(timeout=30)
         logits = np.zeros([1, VOCAB], dtype=np.float32)
@@ -693,6 +693,10 @@ def test_engine_metrics_exported(llm_server, llm_model):
     assert value_of('tpu_kv_blocks_total{model="llm_engine"}') == float(
         llm_model.engine.allocator.capacity
     )
+    # PR-14 sharing families ride the same registry (zero at idle; the
+    # short-prompt workload here has no full prompt blocks to share)
+    assert value_of('tpu_kv_blocks_shared{model="llm_engine"}') == 0.0
+    assert "tpu_prefix_cache_hits_total" in text
     assert value_of('tpu_llm_active_sequences{model="llm_engine"}') == 0.0
     assert value_of('tpu_llm_generated_tokens_total{model="llm_engine"}') > 0
     assert value_of('tpu_llm_step_batch_size_count{model="llm_engine"}') > 0
@@ -744,6 +748,48 @@ def test_openai_max_tokens_validation(llm_server):
     status, doc = post({**base, "max_tokens": 4})
     assert status == 200
     assert doc["usage"]["completion_tokens"] == 4
+
+
+def test_openai_sampling_params_reach_engine(llm_server):
+    """PR-14 satellite: temperature/seed/top_k in the OpenAI body reach
+    the engine — equal seeds reproduce the completion, malformed values
+    are clean 400s."""
+    import urllib.error
+
+    def post(body):
+        request = urllib.request.Request(
+            f"http://127.0.0.1:{llm_server.http_port}/v1/chat/completions",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(request) as response:
+                return response.status, json.loads(response.read().decode())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read().decode())
+
+    base = {
+        "model": "llm_engine",
+        "messages": [{"role": "user", "content": "sample me"}],
+        "max_tokens": 8,
+        "temperature": 1.0,
+        "top_k": 16,
+        "seed": 11,
+    }
+    status, first = post(base)
+    assert status == 200
+    status, second = post(base)
+    assert status == 200
+    assert (
+        first["choices"][0]["message"]["content"]
+        == second["choices"][0]["message"]["content"]
+    )
+    for field, bad in (("temperature", -1), ("temperature", "hot"),
+                       ("seed", 1.5), ("top_k", -2)):
+        status, doc = post({**base, field: bad})
+        assert status == 400, f"{field}={bad!r} -> {status}"
+        assert doc["error"]["param"] == field
 
 
 def test_genai_perf_drives_engine_end_to_end(llm_server, tmp_path, capsys):

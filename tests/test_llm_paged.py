@@ -1,0 +1,760 @@
+"""PR-14: ragged paged-attention kernels + copy-on-write prefix sharing.
+
+Four tiers:
+
+- COW allocator units (no jax): refcount accounting, chained content
+  hashes, shared-block reclaim discipline, publish/unpublish;
+- kernel parity (jax): every attention implementation (fused XLA,
+  Pallas-interpret, stand-in) within 1e-5 of the stand-in's math on
+  random ragged page layouts AND on full tiny-llama decode logits, plus
+  suffix-prefill-vs-full-prefill parity;
+- engine-level sharing on the float32 tiny llama: shared-prefix
+  generations EXACTLY match the dense ``llama.generate`` oracle, blocks
+  in use stay well below the no-sharing demand, shared blocks are never
+  mutated while referenced, preempt-and-resume under sharing stays
+  correct, refcount==0 reclaims within one iteration;
+- sampled decoding determinism (stub, fake clock): seeded temperature /
+  top-k streams reproduce per seed and replay identically across
+  preemption, and the admission capacity math counts new blocks only.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from client_tpu.llm import (
+    BlockAllocator,
+    CacheCapacityError,
+    EngineConfig,
+    LlmEngine,
+)
+from client_tpu.utils import InferenceServerException
+
+pytestmark = pytest.mark.llm
+
+MS = 1_000_000  # ns
+
+
+# ---------------------------------------------------------------------------
+# COW allocator units
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_shared_refcounts_and_reclaim():
+    alloc = BlockAllocator(num_blocks=17, block_size=4)
+    prompt = list(range(12))  # 3 full blocks
+    hashes = alloc.chain_hashes(prompt)
+    assert len(hashes) == 3
+    # same tokens -> same chain; different first block -> full divergence
+    assert alloc.chain_hashes(prompt) == hashes
+    other = alloc.chain_hashes([99] + prompt[1:])
+    assert other[0] != hashes[0] and other[2] != hashes[2]
+
+    a, matched = alloc.allocate_shared("a", 4, hashes)
+    assert matched == 0  # nothing published yet
+    assert alloc.publish("a", hashes) == 3
+    assert alloc.match_count(hashes) == 3
+    assert alloc.blocks_shared == 0  # published but single-referenced
+
+    b, matched = alloc.allocate_shared("b", 4, hashes)
+    assert matched == 3
+    assert b[:3] == a[:3]  # physically the SAME blocks
+    assert b[3] != a[3]
+    assert alloc.blocks_shared == 3
+    assert alloc.blocks_in_use == 5  # 4 + 4 - 3 shared
+    assert alloc.prefix_hits == 3
+
+    # freeing the publisher must NOT reclaim blocks b still references
+    assert alloc.free("a") == 1  # only a's exclusive tail block
+    assert alloc.blocks_shared == 0
+    assert alloc.match_count(hashes) == 3  # still indexed (b holds them)
+    for phys in b[:3]:
+        assert alloc.refcount(phys) == 1
+    # last reference: reclaimed AND unpublished
+    assert alloc.free("b") == 4
+    assert alloc.blocks_in_use == 0
+    assert alloc.match_count(hashes) == 0
+    assert alloc.free_blocks == alloc.capacity
+
+
+def test_allocator_extend_never_returns_a_shared_block():
+    alloc = BlockAllocator(num_blocks=9, block_size=4)
+    hashes = alloc.chain_hashes(list(range(8)))
+    a, _ = alloc.allocate_shared("a", 2, hashes)
+    alloc.publish("a", hashes)
+    b, matched = alloc.allocate_shared("b", 3, hashes)
+    assert matched == 2
+    grown = alloc.extend("b")
+    assert grown not in a  # fresh, exclusively owned
+    assert alloc.refcount(grown) == 1
+
+
+def test_allocator_all_or_nothing_takes_no_references():
+    alloc = BlockAllocator(num_blocks=5, block_size=4)  # capacity 4
+    hashes = alloc.chain_hashes(list(range(8)))
+    a, _ = alloc.allocate_shared("a", 3, hashes)
+    alloc.publish("a", hashes)
+    before = [alloc.refcount(p) for p in a]
+    with pytest.raises(CacheCapacityError):
+        # 2 matched + 4 fresh needed, only 1 free
+        alloc.allocate_shared("b", 6, hashes)
+    assert [alloc.refcount(p) for p in a] == before
+    assert alloc.blocks_shared == 0
+
+
+def test_allocator_publish_skips_already_indexed():
+    alloc = BlockAllocator(num_blocks=9, block_size=4)
+    prompt = list(range(8))
+    hashes = alloc.chain_hashes(prompt)
+    a, _ = alloc.allocate_shared("a", 2, hashes)
+    assert alloc.publish("a", hashes) == 2
+    # a second sequence that prefilled the same prompt itself (admitted
+    # before the first published) publishes nothing new
+    b = alloc.allocate("b", 2)
+    assert alloc.publish("b", hashes) == 0
+    assert alloc.match_count(hashes) == 2
+    alloc.free("a")
+    alloc.free("b")
+    assert alloc.blocks_in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# kernel parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    import jax
+    import jax.numpy as jnp
+
+    from client_tpu.models import llama
+
+    config = llama.LlamaConfig.tiny(max_seq_len=64, dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), config)
+    return config, params
+
+
+def _random_paged_state(rng, b, kv, d, bs, nb, num_blocks):
+    """Random pages + a ragged set of page tables/positions."""
+    k_pages = rng.normal(size=(num_blocks, bs, kv, d)).astype(np.float32)
+    v_pages = rng.normal(size=(num_blocks, bs, kv, d)).astype(np.float32)
+    tables = np.zeros((b, nb), dtype=np.int32)
+    positions = np.zeros((b,), dtype=np.int32)
+    free = list(range(1, num_blocks))
+    for i in range(b):
+        n_ctx = int(rng.integers(1, nb * bs))
+        positions[i] = n_ctx - 1
+        n_blocks = (n_ctx + bs - 1) // bs
+        for j in range(n_blocks):
+            tables[i, j] = free.pop()
+    return k_pages, v_pages, tables, positions
+
+
+@pytest.mark.parametrize("b,nb", [(1, 2), (3, 4), (8, 4)])
+def test_attention_impls_agree_on_ragged_layouts(b, nb):
+    """fused XLA and Pallas(interpret) within 1e-5 of the stand-in on
+    random pages with ragged per-sequence fill."""
+    from client_tpu.models import paged_attention as pa
+
+    kv, g, d, bs = 2, 2, 16, 8
+    h = kv * g
+    rng = np.random.default_rng(b * 100 + nb)
+    k_pages, v_pages, tables, positions = _random_paged_state(
+        rng, b, kv, d, bs, nb, num_blocks=1 + b * nb
+    )
+    q = rng.normal(size=(b, h, d)).astype(np.float32)
+    ref = np.asarray(
+        pa.paged_attention_standin(q, k_pages, v_pages, tables, positions)
+    )
+    for name in ("fused_xla", "pallas_interpret"):
+        out = np.asarray(
+            pa.get_attention_impl(name)(q, k_pages, v_pages, tables, positions)
+        )
+        assert np.abs(out - ref).max() <= 1e-5, name
+
+
+def test_decode_step_kernels_match_standin_on_tiny_llama(tiny_llama):
+    """Full decode-step logits parity (<=1e-5) vs the stand-in, including
+    at the engine's ragged (narrower) page-table width."""
+    from client_tpu.models import llama
+    from client_tpu.models import paged_attention as pa
+
+    config, params = tiny_llama
+    bs, max_blocks = 8, 8
+    contexts = [[5, 9, 17, 3, 8], [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11], [7]]
+    pages = llama.init_kv_pages(config, 33, bs)
+    tables = np.zeros((len(contexts), max_blocks), dtype=np.int32)
+    next_free = 1
+    for i, ctx in enumerate(contexts):
+        n_blocks = (len(ctx) + 1 + bs - 1) // bs
+        tables[i, :n_blocks] = range(next_free, next_free + n_blocks)
+        next_free += n_blocks
+        toks = np.zeros([1, 16], dtype=np.int32)
+        toks[0, : len(ctx)] = ctx
+        _, pages = llama.prefill_into_pages(
+            params, toks, tables[i], pages, len(ctx) - 1, config
+        )
+    tokens = np.array([11, 12, 13], dtype=np.int32)
+    positions = np.array([len(c) for c in contexts], dtype=np.int32)
+    ref, _ = llama.decode_step_paged(
+        params, tokens, positions, tables, pages, config
+    )
+    ref = np.asarray(ref)
+    for name in ("standin", "fused_xla", "pallas_interpret"):
+        out, _ = llama.decode_step_paged_attn(
+            params, tokens, positions, tables, pages, config,
+            pa.get_attention_impl(name),
+        )
+        assert np.abs(np.asarray(out) - ref).max() <= 1e-5, name
+    # ragged width: 2 blocks cover the longest context (11+1 tokens)
+    out, _ = llama.decode_step_paged_attn(
+        params, tokens, positions, tables[:, :2], pages, config,
+        pa.paged_attention_fused_xla,
+    )
+    assert np.abs(np.asarray(out) - ref).max() <= 1e-5
+
+
+def test_suffix_prefill_matches_full_prefill(tiny_llama):
+    """Prefilling only the unshared suffix against prefix pages must
+    reproduce the full prefill's logits AND its written page content —
+    including with an oversized (bucketed) static prefix width."""
+    from client_tpu.models import llama
+
+    config, params = tiny_llama
+    bs = 8
+    ctx = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]  # 12 tokens, start at 8
+    table = np.zeros([8], dtype=np.int32)
+    table[:2] = [1, 2]
+    toks = np.zeros([1, 16], dtype=np.int32)
+    toks[0, :12] = ctx
+    full_logits, full_pages = llama.prefill_into_pages(
+        params, toks, table, llama.init_kv_pages(config, 33, bs), 11, config
+    )
+    prefix_toks = np.zeros([1, 8], dtype=np.int32)
+    prefix_toks[0, :8] = ctx[:8]
+    _, pages = llama.prefill_into_pages(
+        params, prefix_toks, table, llama.init_kv_pages(config, 33, bs),
+        7, config,
+    )
+    suffix = np.zeros([1, 8], dtype=np.int32)
+    suffix[0, :4] = ctx[8:]
+    for prefix_blocks in (1, 2):  # exact and bucket-padded static width
+        logits, out_pages = llama.prefill_suffix_into_pages(
+            params, suffix, table, pages, 3, 8, prefix_blocks, config
+        )
+        assert np.abs(
+            np.asarray(logits) - np.asarray(full_logits)
+        ).max() <= 1e-5
+        for (fk, fv), (sk, sv) in zip(full_pages, out_pages):
+            assert np.abs(np.asarray(fk[1:3]) - np.asarray(sk[1:3])).max() <= 1e-5
+            assert np.abs(np.asarray(fv[1:3]) - np.asarray(sv[1:3])).max() <= 1e-5
+
+
+# ---------------------------------------------------------------------------
+# engine-level sharing on the tiny llama
+# ---------------------------------------------------------------------------
+
+PREFIX = [9, 3, 7, 1, 5, 2, 8, 4, 6, 1, 2, 3, 4, 5, 6, 7]  # 2 full blocks @ 8
+
+
+@pytest.fixture(scope="module")
+def shared_model(tiny_llama):
+    """A warmed float32 tiny-llama engine model, prefix sharing ON."""
+    from client_tpu.llm.serving import LlmEngineModel
+
+    config, params = tiny_llama
+    model = LlmEngineModel(
+        config=config,
+        params=params,
+        engine_config=EngineConfig(
+            block_size=8,
+            num_blocks=1 + 8 * 8,
+            max_active=8,
+            max_queue=32,
+            max_seq_len=64,
+        ),
+    )
+    model.warmup()
+    yield model
+    model.shutdown()
+
+
+def _dense_reference(model, prompt, max_tokens):
+    from client_tpu.models import llama
+
+    return np.asarray(
+        llama.generate(
+            model._params,
+            np.array([prompt], dtype=np.int32),
+            model._config,
+            max_tokens,
+        )
+    )[0].tolist()
+
+
+async def _model_generate(model, prompt, max_tokens, parameters=None):
+    params = {"max_tokens": max_tokens}
+    params.update(parameters or {})
+    out = []
+    async for response in model.execute_decoupled(
+        {"INPUT_IDS": np.array(prompt, dtype=np.int32)}, params
+    ):
+        out.append(int(response["OUTPUT_IDS"][0]))
+        if response["__final__"]:
+            break
+    return out
+
+
+def test_warmup_selects_and_reports_kernel(shared_model):
+    """Off-TPU the probe lands on fused_xla (or a forced override), and
+    the choice rides the model config's parameters map."""
+    assert shared_model.decode_kernel in (
+        "pallas", "pallas_interpret", "fused_xla", "standin"
+    )
+    doc = shared_model.config()
+    assert doc["parameters"]["decode_kernel"]["string_value"] == (
+        shared_model.decode_kernel
+    )
+    assert doc["parameters"]["prefix_sharing"]["string_value"] == "cow"
+
+
+def test_shared_prefix_generations_match_dense_and_share_blocks(shared_model):
+    """The acceptance test: concurrent shared-prefix generations EXACTLY
+    match the dense oracle, hit the prefix index, and keep peak
+    blocks_in_use well below the no-sharing demand."""
+    prompts = [PREFIX + [10 + i, 20 + i] for i in range(6)]
+    refs = [_dense_reference(shared_model, p, 10) for p in prompts]
+    engine = shared_model.engine
+    hits_before = engine.allocator.prefix_hits
+
+    async def run():
+        peak = 0
+
+        async def watch():
+            nonlocal peak
+            while True:
+                peak = max(peak, engine.stats()["kv_blocks_in_use"])
+                await asyncio.sleep(0)
+
+        watcher = asyncio.ensure_future(watch())
+        try:
+            results = await asyncio.gather(
+                *[_model_generate(shared_model, p, 10) for p in prompts]
+            )
+        finally:
+            watcher.cancel()
+        return results, peak
+
+    results, peak = asyncio.run(run())
+    for prompt, got, expected in zip(prompts, results, refs):
+        assert got == expected, f"prompt {prompt} diverged"
+    stats = engine.stats()
+    assert stats["kv_blocks_in_use"] == 0
+    # 5 of 6 requests match the 2-block prefix (the first publishes)
+    assert engine.allocator.prefix_hits - hits_before >= 8
+    # no-sharing demand: 6 sequences x blocks_for(18 + 10 + 1) = 4 -> 24;
+    # sharing peaks at 2 shared + 6 exclusive tails + transient = ~10
+    no_sharing_demand = 6 * engine.allocator.blocks_for(len(PREFIX) + 2 + 10 + 1)
+    assert peak <= 0.6 * no_sharing_demand, (
+        f"peak {peak} not well below no-sharing demand {no_sharing_demand}"
+    )
+
+
+def test_shared_blocks_never_mutated_while_referenced(shared_model):
+    """COW invariant at the page level: the bytes of a shared prefix
+    block must be bit-identical before and after another sharer's whole
+    generation (which writes its own suffix and decode blocks)."""
+    engine = shared_model.engine
+
+    async def run():
+        holder = engine.submit(PREFIX + [42, 43], max_tokens=20)
+        token, final = await holder.__anext__()
+        assert not final
+        shared_phys = list(engine.allocator.owned(holder.seq_id))[:2]
+        assert all(engine.allocator.refcount(p) == 1 for p in shared_phys)
+
+        def snapshot():
+            return [
+                (
+                    np.asarray(layer_pages[0][phys]).copy(),
+                    np.asarray(layer_pages[1][phys]).copy(),
+                )
+                for layer_pages in engine._pages
+                for phys in shared_phys
+            ]
+
+        before = snapshot()
+        other = await _model_generate(shared_model, PREFIX + [77, 78], 12)
+        assert len(other) == 12
+        # the second sharer referenced (not copied) the prefix blocks
+        assert engine.allocator.prefix_hits > 0
+        after = snapshot()
+        for (bk, bv), (ak, av) in zip(before, after):
+            np.testing.assert_array_equal(bk, ak)
+            np.testing.assert_array_equal(bv, av)
+        engine.release(holder)
+        for _ in range(100):
+            if engine.stats()["kv_blocks_in_use"] == 0:
+                break
+            await asyncio.sleep(0)
+        assert engine.stats()["kv_blocks_in_use"] == 0
+
+    asyncio.run(run())
+
+
+def test_sharing_survives_preemption_pressure(tiny_llama):
+    """A pool far smaller than the gross working set: sharing + dry-pool
+    preemption + resume must still reproduce the dense oracle exactly and
+    reclaim every block."""
+    from client_tpu.llm.serving import LlmEngineModel
+
+    config, params = tiny_llama
+    model = LlmEngineModel(
+        config=config,
+        params=params,
+        engine_config=EngineConfig(
+            block_size=8,
+            num_blocks=9,  # 8 allocatable blocks << the gross working set
+            max_active=8,
+            max_queue=16,
+            max_seq_len=64,
+        ),
+    )
+    model.warmup()
+    try:
+        prompts = [PREFIX + [30 + i] for i in range(4)]
+        refs = [_dense_reference(model, p, 14) for p in prompts]
+
+        async def run():
+            results = await asyncio.gather(
+                *[_model_generate(model, p, 14) for p in prompts]
+            )
+            for prompt, got, expected in zip(prompts, results, refs):
+                assert got == expected, f"prompt {prompt} diverged"
+            stats = model.engine.stats()
+            assert stats["preemptions"] > 0
+            assert stats["prefix_cache_hits"] > 0
+            assert stats["kv_blocks_in_use"] == 0
+
+        asyncio.run(run())
+    finally:
+        model.shutdown()
+
+
+def test_sampled_generation_through_model_is_seed_deterministic(shared_model):
+    """Temperature sampling through the real model: same seed -> same
+    stream, different seed -> (with overwhelming probability on 10
+    draws) a different stream; greedy default unchanged."""
+    prompt = PREFIX + [11, 13]
+
+    async def run():
+        sampled1 = await _model_generate(
+            shared_model, prompt, 10,
+            {"temperature": 1.0, "seed": 7, "top_k": 16},
+        )
+        sampled2 = await _model_generate(
+            shared_model, prompt, 10,
+            {"temperature": 1.0, "seed": 7, "top_k": 16},
+        )
+        sampled3 = await _model_generate(
+            shared_model, prompt, 10,
+            {"temperature": 1.0, "seed": 8, "top_k": 16},
+        )
+        greedy = await _model_generate(shared_model, prompt, 10)
+        return sampled1, sampled2, sampled3, greedy
+
+    s1, s2, s3, greedy = asyncio.run(run())
+    assert s1 == s2
+    assert s1 != s3
+    assert greedy == _dense_reference(shared_model, prompt, 10)
+    with pytest.raises(InferenceServerException, match="temperature"):
+        shared_model.engine.submit(
+            [1, 2], max_tokens=2, parameters={"temperature": "hot"}
+        )
+    with pytest.raises(InferenceServerException, match="temperature"):
+        shared_model.engine.submit(
+            [1, 2], max_tokens=2, parameters={"temperature": -0.5}
+        )
+    with pytest.raises(InferenceServerException, match="top_k"):
+        shared_model.engine.submit(
+            [1, 2], max_tokens=2, parameters={"top_k": -3}
+        )
+    # a negative seed would crash np.random.default_rng inside the step
+    # loop (engine-fatal) — it must be a submit-time 400 instead
+    with pytest.raises(InferenceServerException, match="seed"):
+        shared_model.engine.submit(
+            [1, 2], max_tokens=2,
+            parameters={"temperature": 1.0, "seed": -4},
+        )
+
+
+# ---------------------------------------------------------------------------
+# scheduler-level sharing + sampling units (stub model, fake clock)
+# ---------------------------------------------------------------------------
+
+VOCAB = 32
+
+
+class _FakeClock:
+    def __init__(self):
+        self.now = 0
+
+    def __call__(self):
+        return self.now
+
+
+def _consistent_stub_engine(clock, **overrides):
+    """Stub whose prefill and decode agree: the logits for the token at
+    absolute position p with value t are one-hot at (t + p) % VOCAB (plus
+    a small spread so temperature sampling has real choices). Prefill
+    receives only the suffix, so it reconstructs (t, p) from last_index
+    and the absolute start — exactly the sharing contract."""
+
+    def logits_row(token, position):
+        row = np.linspace(0.0, 1.0, VOCAB, dtype=np.float32)
+        row[(int(token) + int(position)) % VOCAB] = 3.0
+        return row
+
+    def prefill(tokens, page_table, pages, last_index, start):
+        row = logits_row(tokens[0, last_index], start + last_index)
+        return row[None, :], pages
+
+    def decode(tokens, positions, page_tables, pages):
+        n = tokens.shape[0]
+        out = np.zeros([n, VOCAB], dtype=np.float32)
+        for i in range(n):
+            out[i] = logits_row(tokens[i], positions[i])
+        return out, pages
+
+    defaults = dict(
+        block_size=4, num_blocks=33, max_active=4, max_queue=8,
+        max_seq_len=128,
+    )
+    defaults.update(overrides)
+    return LlmEngine(
+        prefill,
+        decode,
+        pages=object(),
+        engine_config=EngineConfig(**defaults),
+        model_name="stub",
+        clock_ns=clock,
+    )
+
+
+async def _collect(seq):
+    out = []
+    async for token, final in seq:
+        out.append(token)
+        if final:
+            break
+    return out
+
+
+def test_sampled_stream_replays_across_preemption():
+    """The per-token PRNG chain (seed, n) makes a preempted-and-resumed
+    sampled generation identical to an unpressured one."""
+    prompt = [1, 2, 3]
+    params = {"temperature": 1.0, "seed": 42, "top_k": 8}
+
+    def run_with(num_blocks):
+        clock = _FakeClock()
+
+        async def go():
+            engine = _consistent_stub_engine(
+                clock, num_blocks=num_blocks, max_seq_len=32
+            )
+            seqs = [
+                engine.submit(prompt, max_tokens=10, parameters=params),
+                engine.submit([4, 5, 6], max_tokens=10,
+                              parameters={"temperature": 1.0, "seed": 9}),
+            ]
+            results = await asyncio.gather(*[_collect(s) for s in seqs])
+            stats = engine.stats()
+            assert stats["kv_blocks_in_use"] == 0
+            engine.close()
+            return results, stats["preemptions"]
+
+        return asyncio.run(go())
+
+    roomy, preempt_roomy = run_with(num_blocks=33)
+    tight, preempt_tight = run_with(num_blocks=5)  # 4 blocks: forced preemption
+    assert preempt_roomy == 0
+    assert preempt_tight > 0
+    assert roomy == tight
+    # distinct seeds diverged (spread logits: near-uniform draws)
+    assert roomy[0] != roomy[1]
+
+
+def test_admission_counts_new_blocks_only():
+    """The capacity-check satellite: with a live shared prefix, waiting
+    sequences admit against their POST-MATCH demand — the same workload
+    without sharing admits strictly fewer concurrently."""
+    prefix = list(range(32))  # 8 full blocks @ block_size 4
+
+    def run(prefix_sharing):
+        clock = _FakeClock()
+
+        async def go():
+            # capacity 16: one sharer owns 8 prefix + ~2 blocks; each
+            # additional sharer needs only ~2 fresh blocks when sharing
+            engine = _consistent_stub_engine(
+                clock, num_blocks=17, max_active=6, max_queue=16,
+                prefix_sharing=prefix_sharing,
+            )
+            seqs = [
+                engine.submit(prefix + [100 + i, 200 + i], max_tokens=6)
+                for i in range(4)
+            ]
+            peak_active = 0
+
+            async def watch():
+                nonlocal peak_active
+                while True:
+                    peak_active = max(
+                        peak_active, engine.stats()["active_sequences"]
+                    )
+                    await asyncio.sleep(0)
+
+            watcher = asyncio.ensure_future(watch())
+            try:
+                results = await asyncio.gather(*[_collect(s) for s in seqs])
+            finally:
+                watcher.cancel()
+            assert all(len(r) == 6 for r in results)
+            assert engine.stats()["kv_blocks_in_use"] == 0
+            engine.close()
+            return peak_active
+
+        return asyncio.run(go())
+
+    # gross demand per sequence: blocks_for(34 + 6 + 1) = 11 of 16 -> at
+    # most ONE admitted at a time without sharing; with sharing all four
+    # fit concurrently (8 shared + 4 x ~3 exclusive)
+    assert run(prefix_sharing=False) <= 1
+    assert run(prefix_sharing=True) >= 3
+
+
+def test_submit_accepts_post_match_demand_and_fails_cleanly_when_gone():
+    """submit() recomputes the capacity fast-fail against post-match
+    demand (a prompt mostly covered by a live shared prefix is not
+    rejected for its gross block count); if the sharers vanish before
+    admission, the engine fails the request with a clean
+    RESOURCE_EXHAUSTED instead of wedging the admission queue."""
+    clock = _FakeClock()
+
+    async def go():
+        # capacity 8 blocks @ 4 tokens
+        engine = _consistent_stub_engine(
+            clock, num_blocks=9, max_active=4, max_queue=8, max_seq_len=128
+        )
+        prefix = list(range(24))  # 6 full blocks
+        holder = engine.submit(prefix, max_tokens=8)
+        await holder.__anext__()  # admitted: 6 prefix blocks published
+        # gross demand 40 tokens -> 10 blocks > capacity 8, but 5 blocks
+        # ride the live shared prefix: post-match demand 5 <= 8
+        big = engine.submit(prefix + list(range(50, 58)), max_tokens=8)
+        # without the fix this submit raises InferenceServerException
+        assert big is not None
+        # now release the holder BEFORE big is admitted (its blocks are
+        # reclaimed and unpublished) -> big's residual demand exceeds
+        # the whole pool -> clean async capacity failure, queue unwedged
+        engine.release(holder)
+        with pytest.raises(CacheCapacityError):
+            await _collect(big)
+        # engine still serves fresh work
+        fresh = await _collect(engine.submit([1, 2, 3], max_tokens=2))
+        assert len(fresh) == 2
+        assert engine.stats()["kv_blocks_in_use"] == 0
+        engine.close()
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# genai-perf shared-prefix workload inputs
+# ---------------------------------------------------------------------------
+
+
+def test_bench_trajectory_kernel_gates(tmp_path):
+    """BENCH_r13+ gates: fused-kernel regression + speedup floor +
+    prefix hit-rate floor, and the new table columns."""
+    import json
+
+    from tools.bench_trajectory import check_regression, format_table, load_runs
+
+    def write(run, kernel_row):
+        parsed = {"value": 100.0, "harness": "python-grpc-aio"}
+        if kernel_row:
+            parsed["llm_decode_kernel"] = kernel_row
+        (tmp_path / f"BENCH_r{run:02d}.json").write_text(
+            json.dumps({"rc": 0, "parsed": parsed})
+        )
+
+    healthy = {
+        "fused_tokens_per_sec": 4000.0,
+        "speedup_min": 1.2,
+        "prefix_sharing": {"prefix_hit_rate": 0.6},
+    }
+    write(1, healthy)
+    write(2, healthy)
+    runs = load_runs(str(tmp_path))
+    assert check_regression(runs) is None
+    table = format_table(runs)
+    assert "kernel tok/s" in table and "prefix hit" in table
+    assert "4000" in table and "0.60" in table
+
+    # >10% fused throughput drop is flagged
+    write(3, {**healthy, "fused_tokens_per_sec": 3000.0})
+    problem = check_regression(load_runs(str(tmp_path)))
+    assert problem and "llm_decode_kernel" in problem
+
+    # fused slower than the stand-in on any cell is flagged
+    write(4, {**healthy, "speedup_min": 0.9})
+    problem = check_regression(load_runs(str(tmp_path)))
+    assert problem and "speedup floor" in problem
+
+    # a zero hit rate on the shared-prefix workload is flagged
+    write(5, {**healthy, "prefix_sharing": {"prefix_hit_rate": 0.0}})
+    problem = check_regression(load_runs(str(tmp_path)))
+    assert problem and "prefix sharing floor" in problem
+
+    # back to healthy: clean again
+    write(6, healthy)
+    assert check_regression(load_runs(str(tmp_path))) is None
+
+
+def test_create_llm_inputs_shared_prefix_and_routing_key(tmp_path):
+    from client_tpu.genai_perf.inputs import create_llm_inputs
+
+    doc = create_llm_inputs(
+        str(tmp_path / "inputs.json"),
+        num_prompts=6,
+        input_tokens_mean=8,
+        output_tokens_mean=4,
+        shared_prefix_tokens=32,
+    )
+    entries = doc["data"]
+    assert len(entries) == 6
+    first_ids = entries[0]["INPUT_IDS"]["content"]
+    keys = set()
+    for entry in entries:
+        ids = entry["INPUT_IDS"]["content"]
+        assert ids[:32] == first_ids[:32]  # token-exact shared prefix
+        assert len(ids) > 32
+        assert entry["parameters"]["routing_key"].startswith("prefix-")
+        assert entry["parameters"]["max_tokens"] >= 1  # merged, not clobbered
+        keys.add(entry["parameters"]["routing_key"])
+    assert len(keys) == 1  # one affinity key per shared prefix
+    # distinct prefixes produce distinct routing keys
+    other = create_llm_inputs(
+        "", num_prompts=1, input_tokens_mean=8, output_tokens_mean=4,
+        shared_prefix_tokens=16,
+    )
+    assert other["data"][0]["parameters"]["routing_key"] not in keys
+    # no prefix -> no routing key stamped
+    plain = create_llm_inputs(
+        "", num_prompts=1, input_tokens_mean=8, output_tokens_mean=4
+    )
+    assert "routing_key" not in plain["data"][0].get("parameters", {})

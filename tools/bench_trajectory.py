@@ -76,14 +76,14 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
     lines = [
         "| run | infer/sec | p50 (us) | ratio_vs_inproc | server CPU "
         "(us/req) | dominant stage | rolling p99 (us) | llm tok/s | "
-        "sharded inf/s | fleet inf/s |",
-        "|---|---|---|---|---|---|---|---|---|---|",
+        "sharded inf/s | fleet inf/s | kernel tok/s | prefix hit |",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for run in runs:
         parsed = run["parsed"]
         if parsed is None:
             lines.append(
-                f"| r{run['run']:02d} | (bench failed) | | | | | | | | |"
+                f"| r{run['run']:02d} | (bench failed) | | | | | | | | | | |"
             )
             continue
 
@@ -118,6 +118,25 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
             and isinstance(fleet.get("best_infer_per_sec"), (int, float))
             else "-"
         )
+        # BENCH_r13+: the fused ragged paged-attention decode microbench
+        # (best tokens/sec across the batch/context grid) and the
+        # shared-prefix workload's block hit rate
+        kernel = parsed.get("llm_decode_kernel")
+        kernel_s = (
+            f"{kernel['fused_tokens_per_sec']:.0f}"
+            if isinstance(kernel, dict)
+            and isinstance(kernel.get("fused_tokens_per_sec"), (int, float))
+            else "-"
+        )
+        sharing = (
+            kernel.get("prefix_sharing") if isinstance(kernel, dict) else None
+        )
+        hit_s = (
+            f"{sharing['prefix_hit_rate']:.2f}"
+            if isinstance(sharing, dict)
+            and isinstance(sharing.get("prefix_hit_rate"), (int, float))
+            else "-"
+        )
         lines.append(
             f"| r{run['run']:02d} "
             f"| {_num('value', '{:.1f}')} "
@@ -128,7 +147,9 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
             f"| {_num('rolling_30s_p99_us', '{:.1f}')} "
             f"| {tok_s} "
             f"| {sharded_s} "
-            f"| {fleet_s} |"
+            f"| {fleet_s} "
+            f"| {kernel_s} "
+            f"| {hit_s} |"
         )
     return "\n".join(lines)
 
@@ -229,6 +250,47 @@ def check_regression(
             is not None
         ],
     )
+    # BENCH_r13+: the kernel microbench (in-process jitted decode step,
+    # one harness family by construction) and two absolute floors — the
+    # fused kernel must not lose to the stand-in it replaced, and the
+    # shared-prefix workload must keep actually hitting the index.
+    _guard(
+        "llm_decode_kernel",
+        "tok/s",
+        _nested(latest, "llm_decode_kernel", "fused_tokens_per_sec"),
+        [
+            (
+                r["run"],
+                _nested(
+                    r["parsed"], "llm_decode_kernel", "fused_tokens_per_sec"
+                ),
+            )
+            for r in ok[:-1]
+            if _nested(r["parsed"], "llm_decode_kernel", "fused_tokens_per_sec")
+            is not None
+        ],
+    )
+    speedup_min = _nested(latest, "llm_decode_kernel", "speedup_min")
+    if speedup_min is not None and speedup_min < 1.0:
+        problems.append(
+            f"llm_decode_kernel speedup floor: r{latest_run:02d}'s fused "
+            f"kernel is SLOWER than the gather/scatter stand-in on at "
+            f"least one grid cell (min speedup {speedup_min:.2f}x < 1.0x)"
+        )
+    kernel_row = latest.get("llm_decode_kernel")
+    sharing = (
+        kernel_row.get("prefix_sharing")
+        if isinstance(kernel_row, dict)
+        else None
+    )
+    if isinstance(sharing, dict):
+        hit_rate = sharing.get("prefix_hit_rate")
+        if isinstance(hit_rate, (int, float)) and hit_rate <= 0.0:
+            problems.append(
+                f"prefix sharing floor: r{latest_run:02d}'s shared-prefix "
+                f"workload recorded a zero block hit rate — the COW index "
+                f"is not matching"
+            )
     return "; ".join(problems) if problems else None
 
 
