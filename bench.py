@@ -451,8 +451,135 @@ def _bench_llm_generate(server) -> dict:
             "llm_engine (tiny llama, continuous batching + paged KV), "
             "streaming gRPC, concurrency 8"
         )
+        result["speculation"] = _bench_llm_speculation(server)
     except Exception as e:  # noqa: BLE001 - row is best-effort
         print(f"bench: llm_generate row failed: {e}", file=sys.stderr)
+    return result
+
+
+def _bench_llm_speculation(server) -> dict:
+    """Speculative-decoding A/B (ROADMAP item 2 / BENCH_r14+): the SAME
+    genai-perf workload against one speculation-enabled engine model
+    with the per-request switch off, then on.  Two proposer cells:
+    ``draft`` (self-speculation — the draft shares the target's weights,
+    measuring the multi-query verify machinery's ceiling) and ``ngram``
+    (prompt lookup — zero extra compute, acceptance is whatever the
+    workload's repetitiveness earns).  The gated headline is the draft
+    cell's tokens/step: every verify step emits at least one token, so a
+    value below 1.0 can only mean broken accounting — the same style of
+    structural floor as the PR-14 kernel speedup gate.  Never raises."""
+    import tempfile
+
+    result: dict = {}
+    try:
+        from client_tpu.genai_perf.main import main as genai_main
+        from client_tpu.genai_perf.metrics import LLMProfileDataParser
+        from client_tpu.llm.serving import LlmEngineModel
+
+        repository = server.core.repository
+        for mode, name, spec in (
+            (
+                "draft",
+                "llm_engine_spec_draft",
+                {"mode": "draft", "k": 3, "draft": "self"},
+            ),
+            ("ngram", "llm_engine_spec_ngram",
+             {"mode": "ngram", "k": 3, "ngram": 2}),
+        ):
+            try:
+                model = repository.get(name)
+            except Exception:  # noqa: BLE001 - not registered yet
+                model = LlmEngineModel(name=name, speculation=spec)
+                repository.add_model(model)
+                model = repository.get(name)
+            cell: dict = {"k": 3}
+            # unmeasured warmup of BOTH paths first: the plain and the
+            # multi-query decode programs compile on first use, and a
+            # cold "off" phase vs a warm "on" phase (or vice versa)
+            # would corrupt the A/B with compile time
+            for phase in ("off", "on"):
+                with tempfile.TemporaryDirectory(
+                    prefix="bench_llm_spec_warm_"
+                ) as artifact_dir:
+                    genai_main(
+                        [
+                            "-m", name,
+                            "-u", server.grpc_url,
+                            "--num-prompts", "6",
+                            "--synthetic-input-tokens-mean", "32",
+                            "--output-tokens-mean", "24",
+                            "--concurrency", "6",
+                            "--measurement-interval", "800",
+                            "--stability-percentage", "50",
+                            "--max-trials", "1",
+                            "--speculation", phase,
+                            "--artifact-dir", artifact_dir,
+                        ]
+                    )
+            for phase in ("off", "on"):
+                # two attempts: deep into a long bench run grpcio's
+                # process-global aio poller occasionally breaks down
+                # with EAGAIN and a window records zero requests (the
+                # same upstream flake tests/test_llm_engine.py retries)
+                for attempt in range(2):
+                    stats0 = model.engine.stats()
+                    with tempfile.TemporaryDirectory(
+                        prefix="bench_llm_spec_"
+                    ) as artifact_dir:
+                        code = genai_main(
+                            [
+                                "-m", name,
+                                "-u", server.grpc_url,
+                                "--num-prompts", "12",
+                                "--synthetic-input-tokens-mean", "32",
+                                "--output-tokens-mean", "24",
+                                "--concurrency", "6",
+                                "--measurement-interval", "3000",
+                                "--stability-percentage", "70",
+                                "--max-trials", "2",
+                                "--speculation", phase,
+                                "--artifact-dir", artifact_dir,
+                            ]
+                        )
+                        if code != 0:
+                            raise RuntimeError(f"genai-perf rc {code}")
+                        metrics = LLMProfileDataParser(
+                            os.path.join(artifact_dir, "profile_export.json")
+                        ).parse()
+                    if metrics.request_count:
+                        break
+                stats1 = model.engine.stats()
+                lane_steps = stats1["lane_steps"] - stats0["lane_steps"]
+                step_tokens = stats1["step_tokens"] - stats0["step_tokens"]
+                proposed = stats1["spec_proposed"] - stats0["spec_proposed"]
+                accepted = stats1["spec_accepted"] - stats0["spec_accepted"]
+                cell[f"tokens_per_sec_{phase}"] = round(
+                    metrics.output_token_throughput, 2
+                )
+                cell[f"itl_avg_ms_{phase}"] = round(
+                    metrics.statistics()["inter_token_latency"].avg / 1e6, 3
+                )
+                if phase == "on":
+                    cell["tokens_per_step"] = round(
+                        step_tokens / max(1, lane_steps), 3
+                    )
+                    cell["acceptance_rate"] = round(
+                        accepted / max(1, proposed), 3
+                    )
+            if cell.get("tokens_per_sec_off") and cell.get(
+                "tokens_per_sec_on"
+            ):
+                cell["speedup"] = round(
+                    cell["tokens_per_sec_on"] / cell["tokens_per_sec_off"], 2
+                )
+            result[mode] = cell
+        # the gated headline: the draft cell's verified tokens/step and
+        # its acceptance rate (bench_trajectory floors tokens_per_step
+        # at 1.0)
+        result["tokens_per_step"] = result["draft"]["tokens_per_step"]
+        result["acceptance_rate"] = result["draft"]["acceptance_rate"]
+    except Exception as e:  # noqa: BLE001 - cell is best-effort
+        print(f"bench: llm speculation cell failed: {e}", file=sys.stderr)
     return result
 
 

@@ -165,6 +165,7 @@ def create_llm_inputs(
     dataset_format: str = "auto",
     prompts: Optional[List[str]] = None,
     shared_prefix_tokens: int = 0,
+    speculation: Optional[str] = None,
 ) -> Dict:
     """Write a perf-harness input-data JSON of LLM requests.
 
@@ -176,7 +177,11 @@ def create_llm_inputs(
     ``routing_key`` parameter derived from the prefix content — the key
     ``--routing-policy consistent_hash`` pins on, so a fleet routes every
     sharer to the replica whose KV-block index already holds the prefix.
-    Returns the generated document (also written to ``path``).
+    ``speculation`` ("on"/"off") stamps the engine's per-request
+    speculative-decoding switch on every entry — the A/B lever that runs
+    the SAME workload against one speculation-enabled model with and
+    without drafting. Returns the generated document (also written to
+    ``path``).
     """
     import hashlib
 
@@ -246,19 +251,23 @@ def create_llm_inputs(
                     int(rng.gauss(output_tokens_mean, output_tokens_stddev)),
                 )
             entry = {"payload": {"content": [json.dumps(body)], "shape": [1]}}
+            if speculation is not None:
+                entry.setdefault("parameters", {})["speculation"] = speculation
             if routing_key is not None:
                 # stamped on every format for a uniform input document;
                 # note the harness only accepts --routing-policy on the
                 # kserve http/grpc clients today, so the affinity
                 # pairing is live on kserve-* and inert (forward-compat
                 # data) on openai payloads
-                entry["parameters"] = {"routing_key": routing_key}
+                entry.setdefault("parameters", {})["routing_key"] = routing_key
             entries.append(entry)
             continue
         else:
             raise ValueError(f"unknown output format '{output_format}'")
         if routing_key is not None:
             entry["parameters"] = {"routing_key": routing_key}
+        if speculation is not None:
+            entry.setdefault("parameters", {})["speculation"] = speculation
         if output_tokens_mean is not None:
             # per-request sampled output length, carried as a request
             # parameter via the input-data "parameters" key (role of the

@@ -182,6 +182,13 @@ def build_parser() -> argparse.ArgumentParser:
         "index",
     )
     parser.add_argument(
+        "--speculation", default=None, choices=["on", "off"],
+        help="stamp the engine's per-request speculative-decoding "
+        "switch on every generated request — A/B the same workload "
+        "against one speculation-enabled model (kserve endpoints; the "
+        "server default is 'on' for models that declare speculation)",
+    )
+    parser.add_argument(
         "--routing-policy", default=None,
         help="perf-harness passthrough: endpoint-pool routing policy "
         "(round_robin/least_outstanding/p2c/consistent_hash) for "
@@ -239,13 +246,19 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
-def json_summary_line(metrics) -> dict:
+def json_summary_line(metrics, spec_delta: Optional[dict] = None) -> dict:
     """The --json-summary document: headline LLM metrics in stable units
-    (times in ms; ns internals never leak into the machine output)."""
+    (times in ms; ns internals never leak into the machine output).
+
+    ``spec_delta`` (the engine's speculation-counter delta over this
+    run, from :func:`fetch_spec_stats` before/after) adds the
+    speculative-decoding headlines: ``tokens_per_step`` (decode-step
+    emissions per lane-step; 1.0 when speculation is off/absent) and
+    ``spec_acceptance_rate`` (accepted / verified drafts)."""
     stats = metrics.statistics()
     ttft = stats["time_to_first_token"]
     itl = stats["inter_token_latency"]
-    return {
+    doc = {
         "ttft_avg_ms": round(ttft.avg / 1e6, 3),
         "ttft_p99_ms": round(ttft.p99 / 1e6, 3),
         "itl_avg_ms": round(itl.avg / 1e6, 3),
@@ -257,6 +270,55 @@ def json_summary_line(metrics) -> dict:
             stats["num_output_tokens"].avg, 2
         ),
     }
+    if spec_delta is not None:
+        doc["tokens_per_step"] = round(
+            spec_delta["step_tokens"] / max(1, spec_delta["lane_steps"]), 3
+        )
+        doc["spec_acceptance_rate"] = round(
+            spec_delta["spec_accepted"] / max(1, spec_delta["spec_proposed"]),
+            3,
+        )
+    return doc
+
+
+def fetch_spec_stats(url: str, model: str) -> Optional[dict]:
+    """The engine's live speculation counters, via the model config's
+    ``speculation_stats`` parameter over gRPC (the one schemaless wire
+    channel — the proto statistics schema is frozen). None when the
+    server/model does not expose them (non-engine model, speculation
+    off, unreachable), so callers degrade to the plain summary."""
+    import json
+
+    try:
+        from client_tpu.grpc import InferenceServerClient
+
+        client = InferenceServerClient(url)
+        try:
+            config = client.get_model_config(
+                model, as_json=True, client_timeout=10
+            )
+        finally:
+            client.close()
+        raw = config["config"]["parameters"]["speculation_stats"][
+            "string_value"
+        ]
+        return json.loads(raw)
+    except Exception:  # noqa: BLE001 - the summary must never fail on this
+        return None
+
+
+def spec_stats_delta(
+    before: Optional[dict], after: Optional[dict]
+) -> Optional[dict]:
+    """Counter deltas over one measured run (both snapshots required —
+    a mid-flight model reload resets counters, surfacing as negative
+    deltas, which also degrade to None)."""
+    if before is None or after is None:
+        return None
+    delta = {key: after[key] - before[key] for key in after if key in before}
+    if any(value < 0 for value in delta.values()):
+        return None
+    return delta
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -347,8 +409,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         dataset_format=args.dataset_format,
         prompts=hub_prompts,
         shared_prefix_tokens=args.shared_prefix_tokens,
+        speculation=args.speculation,
     )
     log.info("profiling model %s at %s", args.model, args.url)
+
+    # Speculation A/B bookkeeping: snapshot the engine's speculation
+    # counters around the run so the summary reports tokens-per-step and
+    # acceptance over EXACTLY this workload (kserve/gRPC only — the
+    # openai client has no model-config surface to read them from).
+    spec_before = None if openai else fetch_spec_stats(args.url, args.model)
 
     # Build the perf-harness invocation (reference wrapper.Profiler role).
     perf_args = [
@@ -381,13 +450,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     if code != 0:
         return code
 
+    spec_delta = (
+        None
+        if openai
+        else spec_stats_delta(
+            spec_before, fetch_spec_stats(args.url, args.model)
+        )
+    )
     metrics = LLMProfileDataParser(export_path).parse()
     print()
     print(console_table(metrics))
     if args.json_summary:
         import json as _json
 
-        print(_json.dumps(json_summary_line(metrics)))
+        print(_json.dumps(json_summary_line(metrics, spec_delta)))
     from client_tpu.genai_perf.tokenizer import tokenizer_provenance
 
     export_csv(metrics, os.path.join(artifact_dir, "llm_metrics.csv"))

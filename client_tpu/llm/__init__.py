@@ -7,6 +7,9 @@
   streaming handles.
 - :mod:`client_tpu.llm.serving` — the ``llm_engine`` repository model
   serving the engine through the decoupled gRPC and OpenAI SSE paths.
+- :mod:`client_tpu.llm.speculation` — draft proposers (n-gram prompt
+  lookup, draft-model rollout) for speculative decoding; the engine
+  verifies their candidates in one multi-query paged-attention call.
 
 Clock-injected throughout (tools/clock_lint.py covers this package).
 """
@@ -17,12 +20,20 @@ from client_tpu.llm.kv_cache import (
     BlockAllocator,
     CacheCapacityError,
 )
+from client_tpu.llm.speculation import (
+    DraftModelProposer,
+    NgramProposer,
+    build_proposer,
+)
 
 __all__ = [
     "BlockAllocator",
     "CacheCapacityError",
+    "DraftModelProposer",
     "EngineConfig",
     "LlmEngine",
+    "NgramProposer",
     "Sequence",
     "TRASH_BLOCK",
+    "build_proposer",
 ]

@@ -25,6 +25,12 @@ paged-attention model functions (``models/llama.py``):
   them through ``ServerCore.infer_decoupled`` so each decode step emits
   one response per active sequence on the decoupled gRPC stream and the
   OpenAI SSE front-end.
+- **speculative decoding** (``llm/speculation.py``): when the model opts
+  in, each step drafts up to K candidate tokens per sequence and the
+  target verifies all K+1 positions in ONE multi-query paged-attention
+  call; accepted tokens stream as multiple queue entries per step.  The
+  emitted stream is token-for-token identical to plain decoding (greedy
+  and seeded sampling both) — see :meth:`LlmEngine._spec_decode`.
 
 Single-owner concurrency: every public method runs on the serving event
 loop (the decoupled path executes models there); device calls hop to the
@@ -58,7 +64,10 @@ class EngineConfig:
     validated against it at submit); ``priority_levels`` sizes the
     waiting queue's priority lanes; ``prefix_sharing`` turns the
     copy-on-write prompt-block index on (default) or off (the A/B
-    baseline for the sharing bench).
+    baseline for the sharing bench); ``spec_k`` is the speculative
+    lookahead — the most draft tokens one verify step may carry per
+    sequence (0 disables speculation; admission counts the worst-case
+    ``K+1`` growth for speculation-enabled sequences).
     """
 
     __slots__ = (
@@ -71,6 +80,7 @@ class EngineConfig:
         "default_max_tokens",
         "prefill_bucket_min",
         "prefix_sharing",
+        "spec_k",
     )
 
     def __init__(
@@ -84,6 +94,7 @@ class EngineConfig:
         default_max_tokens: int = 16,
         prefill_bucket_min: int = 8,
         prefix_sharing: bool = True,
+        spec_k: int = 0,
     ):
         self.block_size = int(block_size)
         self.num_blocks = int(num_blocks)
@@ -94,6 +105,7 @@ class EngineConfig:
         self.default_max_tokens = int(default_max_tokens)
         self.prefill_bucket_min = int(prefill_bucket_min)
         self.prefix_sharing = bool(prefix_sharing)
+        self.spec_k = max(0, int(spec_k))
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -128,6 +140,24 @@ def _int_param(name: str, value: Any) -> int:
         raise InferenceServerException(
             f"request parameter {name!r} must be an integer, got {value!r}"
         ) from None
+
+
+def _spec_param(value: Any) -> bool:
+    """The per-request ``speculation`` parameter: ``on`` (default) /
+    ``off`` — the genai-perf A/B switch. Anything else is a 400."""
+    if value is None or value == "":
+        return True
+    if isinstance(value, bool):
+        return value
+    token = str(value).strip().lower()
+    if token in ("on", "true", "1"):
+        return True
+    if token in ("off", "false", "0"):
+        return False
+    raise InferenceServerException(
+        f"request parameter 'speculation' must be 'on' or 'off', "
+        f"got {value!r}"
+    )
 
 
 def _float_param(name: str, value: Any) -> float:
@@ -173,13 +203,15 @@ class Sequence:
         "seed",
         "block_hashes",
         "shared_blocks",
+        "spec_enabled",
         "_out",
         "_engine",
     )
 
     def __init__(self, seq_id, prompt, max_tokens, priority_level,
                  deadline_ns, timeout_us, max_blocks: int, engine,
-                 temperature: float = 0.0, top_k: int = 0, seed: int = 0):
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 spec_enabled: bool = True):
         self.seq_id = seq_id
         self.prompt: List[int] = prompt
         self.generated: List[int] = []
@@ -200,6 +232,9 @@ class Sequence:
         self.temperature = temperature
         self.top_k = top_k
         self.seed = seed
+        # per-request speculation opt-out (the harness A/B switch); only
+        # meaningful on an engine configured with spec_k > 0
+        self.spec_enabled = spec_enabled
         # chained content hashes of the prompt's FULL blocks (computed
         # once at submit; matched against / published to the allocator's
         # shared index at every admission, including resumes)
@@ -254,7 +289,24 @@ class LlmEngine:
     (jitted) device callables; ``pages`` is opaque to the engine.
     ``metrics`` implements the ServerMetrics LLM hooks (set_kv_blocks /
     set_llm_sequences / observe_llm_step / observe_llm_preemption /
-    observe_prefix_hits / observe_rejection); None disables export.
+    observe_prefix_hits / observe_rejection / observe_llm_speculation);
+    None disables export.
+
+    Speculative decoding (``engine_config.spec_k > 0`` plus both
+    ``decode_multi_fn`` and ``proposer``): each step first asks the
+    proposer for up to K draft tokens per running sequence, then runs
+    ``decode_multi_fn(tokens[B, T], positions[B, T], lengths[B],
+    page_tables[B, NB], pages) -> (logits[B, T, V], pages)`` — ONE
+    ragged verify call for all lanes — and walks each lane's logits
+    with the same (seed, token_index) PRNG chain plain decoding uses,
+    emitting sampled tokens while they match the drafts.  The emitted
+    stream is therefore token-for-token identical to non-speculative
+    decoding; speculation only changes how many tokens one device call
+    yields.  Draft K/V lands in the sequence's exclusively-owned tail
+    blocks only (the COW write assertion covers the whole speculative
+    range) and lookahead blocks are rolled back to the plain-decode
+    footprint after every verify step, so between steps a speculative
+    engine holds exactly the blocks a non-speculative one would.
     """
 
     def __init__(
@@ -268,6 +320,8 @@ class LlmEngine:
         executor: Any = None,
         logger: Any = None,
         clock_ns: Callable[[], int] = time.monotonic_ns,
+        decode_multi_fn: Optional[Callable] = None,
+        proposer: Any = None,
     ):
         self.config = engine_config
         self.model_name = model_name
@@ -279,6 +333,15 @@ class LlmEngine:
         self._clock_ns = clock_ns
         self._prefill = prefill_fn
         self._decode = decode_fn
+        self._decode_multi = decode_multi_fn
+        self._proposer = proposer
+        # speculation requires all three legs; a partial wiring (k but
+        # no verify fn, or vice versa) silently runs plain decode
+        self._speculative = (
+            engine_config.spec_k > 0
+            and decode_multi_fn is not None
+            and proposer is not None
+        )
         self._pages = pages
         self._executor = executor
         self._waiting = PriorityQueue(levels=engine_config.priority_levels)
@@ -298,6 +361,18 @@ class LlmEngine:
         self.completed = 0
         self.cancelled_count = 0
         self.expired = 0
+        # decode-step emissions only (prefill first-tokens excluded) and
+        # the lane-steps that produced them (one per live lane per
+        # step): step_tokens / lane_steps is the tokens-per-step A/B
+        # headline — exactly 1.0 for a non-speculative engine by
+        # construction
+        self.step_tokens = 0
+        self.lane_steps = 0
+        # speculation accounting: drafts verified, drafts accepted, and
+        # how many steps ran the multi-query verify path
+        self.spec_steps = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         # full prompt blocks demanded across admissions — with
         # allocator.prefix_hits this yields the true prefix hit rate
         # (hits / demand), since the allocator only ever sees the
@@ -391,6 +466,7 @@ class LlmEngine:
             raise InferenceServerException(
                 f"request parameter 'top_k' must be >= 0, got {top_k}"
             )
+        spec_enabled = _spec_param(parameters.get("speculation"))
         seed = _int_param("seed", parameters.get("seed", 0) or 0)
         if seed < 0:
             # np.random.default_rng rejects negative entropy — validate
@@ -419,6 +495,7 @@ class LlmEngine:
             temperature=temperature,
             top_k=top_k,
             seed=seed,
+            spec_enabled=spec_enabled,
         )
         seq.block_hashes = block_hashes
         self._waiting.push(seq, level=level, deadline_ns=deadline_ns)
@@ -515,6 +592,19 @@ class LlmEngine:
             "prefix_cache_hits": self.allocator.prefix_hits,
             "prefix_cache_queries": self.allocator.prefix_queries,
             "prefix_block_demand": self.prefix_block_demand,
+            # speculation: tokens_per_step is the decode-only ratio (1.0
+            # exactly for a non-speculative engine); acceptance is over
+            # drafts actually verified, not merely proposed
+            "speculative": self._speculative,
+            "step_tokens": self.step_tokens,
+            "lane_steps": self.lane_steps,
+            "tokens_per_step": self.step_tokens / max(1, self.lane_steps),
+            "spec_steps": self.spec_steps,
+            "spec_proposed": self.spec_proposed,
+            "spec_accepted": self.spec_accepted,
+            "spec_acceptance_rate": (
+                self.spec_accepted / max(1, self.spec_proposed)
+            ),
         }
 
     # -- step loop -----------------------------------------------------------
@@ -625,8 +715,18 @@ class LlmEngine:
                 break
             context = seq.context
             # +1: the first decode step writes the freshly-sampled
-            # token's K/V at position len(context)
-            need = allocator.blocks_for(len(context) + 1)
+            # token's K/V at position len(context). Speculation adds its
+            # worst-case lookahead on top (the first verify step writes
+            # up to K draft positions beyond that), clamped by the
+            # sequence's own context ceiling — draft writes never pass
+            # position prompt+max_tokens-2, so total capacity math is
+            # unchanged and the admission demand stays exact.
+            need = allocator.blocks_for(
+                min(
+                    len(seq.prompt) + seq.max_tokens,
+                    len(context) + 1 + self._spec_k_for(seq),
+                )
+            )
             cap = self._match_cap(len(context))
             usable = min(
                 allocator.match_count(seq.block_hashes), cap, len(seq.block_hashes)
@@ -726,22 +826,64 @@ class LlmEngine:
         return np.asarray(logits)[0]
 
     def _sample(self, seq: Sequence, logits: np.ndarray) -> int:
-        """Next token from a logits row: greedy unless the sequence asked
-        for temperature sampling. The PRNG key is (seed, n) where n is
-        the index of the token being sampled — pure function of the
-        sequence's history length, so a preempted-and-resumed generation
-        draws exactly what the uninterrupted one would have."""
-        if seq.temperature <= 0.0:
-            return int(np.asarray(logits).argmax())
-        scaled = np.asarray(logits, dtype=np.float64) / seq.temperature
-        if seq.top_k and seq.top_k < scaled.shape[-1]:
-            kth = np.partition(scaled, -seq.top_k)[-seq.top_k]
-            scaled = np.where(scaled < kth, -np.inf, scaled)
-        scaled = scaled - scaled.max()
-        probs = np.exp(scaled)
-        probs /= probs.sum()
-        rng = np.random.default_rng((seq.seed, len(seq.generated)))
-        return int(rng.choice(scaled.shape[-1], p=probs))
+        """Next token from a logits row (the single-row prefill path);
+        delegates to the batched sampler with this row's PRNG index."""
+        return self._sample_rows([(seq, logits, len(seq.generated))])[0]
+
+    def _sample_rows(self, items) -> List[int]:
+        """Sample one token per ``(seq, logits_row, gen_index)`` item in
+        ONE vectorized pass — the full-batch decode step and the K+1
+        rows of a speculative verify all share it.
+
+        The softmax/top-k pipeline runs batched in float64 (elementwise
+        ops and per-row reductions, so each row's bits match the scalar
+        pipeline exactly), but every row's DRAW still comes from its own
+        ``np.random.default_rng((seed, gen_index))`` — the PRNG key is a
+        pure function of the token's index in the generation, never of
+        batch composition or speculation outcome, which is what makes
+        preemption replay and spec-on/spec-off streams token-identical
+        (tests pin the streams bit-exactly against the scalar path)."""
+        n = len(items)
+        out = [0] * n
+        greedy = [i for i in range(n) if items[i][0].temperature <= 0.0]
+        sampled = [i for i in range(n) if items[i][0].temperature > 0.0]
+        if greedy:
+            rows = np.stack([np.asarray(items[i][1]) for i in greedy])
+            for i, pick in zip(greedy, rows.argmax(axis=-1)):
+                out[i] = int(pick)
+        if sampled:
+            rows = np.stack(
+                [np.asarray(items[i][1]) for i in sampled]
+            ).astype(np.float64)
+            temps = np.array(
+                [items[i][0].temperature for i in sampled], dtype=np.float64
+            )
+            scaled = rows / temps[:, None]
+            vocab = scaled.shape[-1]
+            for j, i in enumerate(sampled):
+                top_k = items[i][0].top_k
+                if top_k and top_k < vocab:
+                    kth = np.partition(scaled[j], -top_k)[-top_k]
+                    scaled[j] = np.where(scaled[j] < kth, -np.inf, scaled[j])
+            scaled -= scaled.max(axis=-1, keepdims=True)
+            probs = np.exp(scaled)
+            probs /= probs.sum(axis=-1, keepdims=True)
+            for j, i in enumerate(sampled):
+                seq, _, gen_index = items[i]
+                rng = np.random.default_rng((seq.seed, gen_index))
+                out[i] = int(rng.choice(vocab, p=probs[j]))
+        return out
+
+    def _spec_k_for(self, seq: Sequence) -> int:
+        """Draft tokens a verify step may carry for this sequence NOW:
+        the engine's lookahead, clamped so speculation never writes K/V
+        past position ``prompt + max_tokens - 2`` (the last token of a
+        generation needs no lookahead, which also keeps total capacity
+        math identical to the non-speculative engine's)."""
+        if not self._speculative or not seq.spec_enabled:
+            return 0
+        remaining = seq.max_tokens - len(seq.generated)
+        return max(0, min(self.config.spec_k, remaining - 1))
 
     def _pick_victim(self) -> Optional[Sequence]:
         """Preemption victim: lowest priority (highest level number)
@@ -825,6 +967,21 @@ class LlmEngine:
         batch = self._running
         if not batch:
             return
+        if self._speculative:
+            drafts = await self._propose(batch)
+            if any(drafts):
+                await self._spec_decode(batch, drafts)
+            else:
+                await self._plain_decode(batch)
+        else:
+            await self._plain_decode(batch)
+        self._running = [s for s in self._running if s.state == _RUNNING]
+
+    async def _plain_decode(self, batch: List[Sequence]) -> None:
+        """The non-speculative decode body: one token per live lane."""
+        from client_tpu.server.models import pad_batch_bucket
+
+        allocator = self.allocator
         n = len(batch)
         bucket = pad_batch_bucket(n)
         # ragged page-table width: the decode kernel's attention cost is
@@ -858,27 +1015,227 @@ class LlmEngine:
         )
         logits_rows = np.asarray(logits)[:n]
         self.steps += 1
+        live = [
+            (seq, row) for seq, row in zip(batch, logits_rows)
+            if not seq.cancelled  # pruned (and freed) next iteration
+        ]
+        picks = self._sample_rows(
+            [(seq, row, len(seq.generated)) for seq, row in live]
+        )
+        self.lane_steps += len(live)
         emitted = 0
-        for seq, row in zip(batch, logits_rows):
-            if seq.cancelled:
-                continue  # pruned (and freed) next iteration
-            token = self._sample(seq, row)
-            seq.generated.append(token)
-            seq.last_token = token
-            seq.position += 1
-            self.tokens_generated += 1
+        for (seq, _), token in zip(live, picks):
+            self._emit_step_token(seq, token)
             emitted += 1
-            final = len(seq.generated) >= seq.max_tokens
-            seq.emit(token, final)
-            if final:
-                self._finish(seq)
         if self.metrics is not None:
             # emitted (not n): cancelled lanes decoded but streamed
             # nothing, and the exported counter must agree with stats()
             self.metrics.observe_llm_step(self.model_name, n)
             if emitted:
                 self.metrics.observe_llm_tokens(self.model_name, emitted)
-        self._running = [s for s in self._running if s.state == _RUNNING]
+
+    def _emit_step_token(self, seq: Sequence, token: int) -> bool:
+        """Book ONE decode-step emission (plain and speculative paths
+        share this accounting — the tokens_per_step headline depends on
+        both booking identically). Returns True when the sequence just
+        finished."""
+        seq.generated.append(token)
+        seq.last_token = token
+        seq.position += 1
+        self.tokens_generated += 1
+        self.step_tokens += 1
+        final = len(seq.generated) >= seq.max_tokens
+        seq.emit(token, final)
+        if final:
+            self._finish(seq)
+        return final
+
+    # -- speculative decode (draft-propose + batched paged-verify) -----------
+
+    async def _propose(self, batch: List[Sequence]) -> List[List[int]]:
+        """One draft proposal per running lane (empty = no speculation
+        for that lane this step: opted out, final token pending, or the
+        proposer found nothing). Proposer failures degrade that lane to
+        plain decode — a broken draft model must never take down the
+        engine, whose own page state it cannot touch."""
+        lanes = [
+            (self._spec_k_for(seq), seq.context if not seq.cancelled else [])
+            for seq in batch
+        ]
+        # submit all lanes before awaiting any: the proposals are
+        # independent, so with an executor the draft computations overlap
+        # instead of serializing B round-trips ahead of the verify call
+        results = await asyncio.gather(
+            *[
+                self._run_device(self._proposer.propose, context, k)
+                for k, context in lanes
+                if k >= 1 and context
+            ],
+            return_exceptions=True,
+        )
+        drafts: List[List[int]] = []
+        it = iter(results)
+        for k, context in lanes:
+            if k < 1 or not context:
+                drafts.append([])
+                continue
+            proposal = next(it)
+            if isinstance(proposal, BaseException):
+                # a broken draft model must never take down the engine,
+                # whose own page state it cannot touch
+                if self.logger is not None:
+                    self.logger.warning(
+                        "llm_spec_proposer_failed",
+                        model=self.model_name,
+                        error=str(proposal),
+                        rate_key=("llm_spec_proposer_failed", self.model_name),
+                    )
+                proposal = []
+            drafts.append([int(t) for t in proposal][:k])
+        return drafts
+
+    async def _spec_decode(
+        self, batch: List[Sequence], drafts: List[List[int]]
+    ) -> None:
+        """One speculative step: verify every lane's draft tokens (plus
+        its mandatory next position) in ONE multi-query decode call,
+        then emit the longest sampled prefix that agrees with the
+        drafts. Every emitted token is sampled from target logits with
+        the same (seed, index) key chain as plain decode, so the stream
+        is identical — acceptance only decides how FAR one step gets."""
+        from client_tpu.server.models import pad_batch_bucket
+
+        allocator = self.allocator
+        block_size = allocator.block_size
+        # opportunistic lookahead blocks: draft K/V needs coverage up to
+        # position+k. A dry pool SHRINKS the lane's speculative window to
+        # the blocks it already owns instead of preempting a peer —
+        # speculation is an optimization and must never evict real work.
+        k_effs: List[int] = []
+        for seq, proposal in zip(batch, drafts):
+            k_eff = min(len(proposal), self._spec_k_for(seq))
+            while (
+                k_eff > 0
+                and (seq.position + k_eff) // block_size >= len(seq.blocks)
+            ):
+                try:
+                    block = allocator.extend(seq.seq_id)
+                    seq.blocks.append(block)
+                    seq.page_table[len(seq.blocks) - 1] = block
+                except CacheCapacityError:
+                    k_eff = len(seq.blocks) * block_size - 1 - seq.position
+            k_effs.append(max(0, k_eff))
+        n = len(batch)
+        k_max = max(k_effs)
+        if k_max == 0:
+            # every lane degraded (dry pool shrank all windows to zero):
+            # this step is just a plain one
+            await self._plain_decode(batch)
+            return
+        bucket = pad_batch_bucket(n)
+        t_width = min(pad_batch_bucket(k_max + 1), self.config.spec_k + 1)
+        nb = min(
+            block_bucket(max(len(seq.blocks) for seq in batch)),
+            self.config.max_blocks_per_seq,
+        )
+        tokens = np.zeros([bucket, t_width], dtype=np.int32)
+        positions = np.zeros([bucket, t_width], dtype=np.int32)
+        lengths = np.zeros([bucket], dtype=np.int32)
+        page_tables = np.zeros([bucket, nb], dtype=np.int32)
+        row_offsets = np.arange(t_width)
+        for i, (seq, proposal, k_eff) in enumerate(
+            zip(batch, drafts, k_effs)
+        ):
+            tokens[i, 0] = seq.last_token
+            tokens[i, 1:1 + k_eff] = proposal[:k_eff]
+            # padding rows clamp to the last real position: their writes
+            # are masked off by `lengths`, and clamping keeps every page
+            # lookup inside the lane's own table
+            positions[i] = seq.position + np.minimum(row_offsets, k_eff)
+            lengths[i] = k_eff + 1
+            page_tables[i] = seq.page_table[:nb]
+            # COW invariant over the WHOLE speculative write range: the
+            # verify scatters K/V at position..position+k_eff, and none
+            # of those blocks may be shared. Engine-fatal on violation,
+            # exactly like the plain step's single-position assertion.
+            for wb in range(
+                seq.position // block_size,
+                (seq.position + k_eff) // block_size + 1,
+            ):
+                if allocator.refcount(seq.blocks[wb]) != 1:
+                    raise InferenceServerException(
+                        f"COW violation: sequence {seq.seq_id} would "
+                        f"speculatively write block {seq.blocks[wb]} "
+                        f"with refcount "
+                        f"{allocator.refcount(seq.blocks[wb])}"
+                    )
+        logits, self._pages = await self._run_device(
+            self._decode_multi, tokens, positions, lengths, page_tables,
+            self._pages,
+        )
+        logits_rows = np.asarray(logits)
+        self.steps += 1
+        self.spec_steps += 1
+        # batched sampling across every candidate row of every live lane
+        # (the verify consumes the vectorized sampler wholesale): rows
+        # sampled past a lane's first mismatch are simply discarded —
+        # each draw is keyed by (seed, index) alone, so sampling a row
+        # never perturbs any later draw
+        items = []
+        spans = []
+        for lane, (seq, k_eff) in enumerate(zip(batch, k_effs)):
+            if seq.cancelled:
+                spans.append((0, 0))
+                continue
+            start = len(items)
+            n0 = len(seq.generated)
+            items.extend(
+                (seq, logits_rows[lane, t], n0 + t)
+                for t in range(k_eff + 1)
+            )
+            spans.append((start, k_eff + 1))
+        picks = self._sample_rows(items) if items else []
+        self.lane_steps += sum(1 for _, count in spans if count)
+        emitted_total = 0
+        proposed_total = 0
+        accepted_total = 0
+        lane_tokens: List[int] = []  # per-lane emissions (histogram feed)
+        for seq, proposal, k_eff, (start, count) in zip(
+            batch, drafts, k_effs, spans
+        ):
+            if count == 0:
+                continue  # cancelled: decoded but streams nothing
+            proposed_total += k_eff
+            emitted = 0
+            for t in range(count):
+                token = picks[start + t]
+                matched = t < k_eff and token == proposal[t]
+                if matched:
+                    accepted_total += 1
+                emitted += 1
+                if self._emit_step_token(seq, token) or not matched:
+                    break
+            emitted_total += emitted
+            lane_tokens.append(emitted)
+            # rejected-draft rollback: blocks claimed for lookahead that
+            # the accepted prefix did not reach go straight back to the
+            # pool, restoring the plain-decode footprint (truncate raises
+            # engine-fatally if a rolled-back block were shared)
+            if seq.state == _RUNNING:
+                keep = allocator.blocks_for(seq.position + 1)
+                if len(seq.blocks) > keep:
+                    allocator.truncate(seq.seq_id, keep)
+                    seq.page_table[keep:len(seq.blocks)] = TRASH_BLOCK
+                    del seq.blocks[keep:]
+        self.spec_proposed += proposed_total
+        self.spec_accepted += accepted_total
+        if self.metrics is not None:
+            self.metrics.observe_llm_step(self.model_name, n)
+            if emitted_total:
+                self.metrics.observe_llm_tokens(self.model_name, emitted_total)
+            self.metrics.observe_llm_speculation(
+                self.model_name, proposed_total, accepted_total, lane_tokens
+            )
 
     def _finish(self, seq: Sequence) -> None:
         self.allocator.free(seq.seq_id)

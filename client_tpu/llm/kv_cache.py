@@ -213,6 +213,37 @@ class BlockAllocator:
         self._owned[seq_id].append(block)
         return block
 
+    def truncate(self, seq_id, keep: int) -> int:
+        """Give back a sequence's TRAILING blocks beyond its first
+        ``keep`` (speculative-decode rollback: lookahead blocks claimed
+        for draft-token writes that verification then rejected).
+
+        Only ever legal on exclusively-owned tail blocks — growth never
+        lands in shared storage, so a truncated block with ``refcount !=
+        1`` (or a published hash) means the allocator's COW discipline
+        was violated upstream: that raises instead of freeing, the same
+        engine-fatal posture as the step loop's write assertion.
+        Returns the number of blocks reclaimed."""
+        blocks = self._owned.get(seq_id)
+        if blocks is None:
+            raise CacheCapacityError(f"sequence {seq_id!r} owns no blocks")
+        keep = max(0, int(keep))
+        if keep >= len(blocks):
+            return 0
+        tail = blocks[keep:]
+        for phys in tail:
+            if self._ref.get(phys, 0) != 1 or phys in self._hash_of:
+                raise InferenceServerException(
+                    f"COW violation: speculative rollback of block "
+                    f"{phys} (refcount {self._ref.get(phys, 0)}, "
+                    f"published={phys in self._hash_of})"
+                )
+        for phys in reversed(tail):
+            del self._ref[phys]
+            self._free.append(phys)
+        del blocks[keep:]
+        return len(tail)
+
     def free(self, seq_id) -> int:
         """Drop a sequence's references (idempotent); returns the number
         of blocks actually RECLAIMED into the pool. A block another

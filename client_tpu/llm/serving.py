@@ -36,16 +36,30 @@ class LlmEngineModel(Model):
     inputs = [{"name": "INPUT_IDS", "datatype": "INT32", "shape": [-1]}]
     outputs = [{"name": "OUTPUT_IDS", "datatype": "INT32", "shape": [1]}]
 
+    #: speculative-decoding opt-in (repository model attr): None = off,
+    #: else ``{"mode": "draft" | "ngram", "k": N, ...}`` — the knobs of
+    #: :func:`client_tpu.llm.speculation.build_proposer`
+    speculation: Optional[Dict[str, Any]] = None
+
     def __init__(
         self,
         name: str = "llm_engine",
         config=None,
         params=None,
         engine_config: Optional[EngineConfig] = None,
+        speculation: Optional[Dict[str, Any]] = None,
+        draft_config=None,
+        draft_params=None,
     ):
         from client_tpu.models import llama
 
         self.name = name
+        if speculation is not None:
+            self.speculation = dict(speculation)
+        elif type(self).speculation is not None:
+            self.speculation = dict(type(self).speculation)
+        self._draft_config = draft_config
+        self._draft_params = draft_params
         self._config = config or llama.LlamaConfig.tiny(max_seq_len=512)
         if engine_config is None:
             # default pool: 8 full-length sequences' worth of blocks —
@@ -62,6 +76,10 @@ class LlmEngineModel(Model):
                 max_queue=64,
                 max_seq_len=self._config.max_seq_len,
             )
+        # admission math must see the speculative lookahead the engine
+        # will actually use (worst-case K+1 growth per sequence)
+        if self.speculation is not None:
+            engine_config.spec_k = max(1, int(self.speculation.get("k", 4)))
         self.engine_config = engine_config
         self._params = params
         self.engine: Optional[LlmEngine] = None
@@ -71,13 +89,17 @@ class LlmEngineModel(Model):
         self.decode_kernel: Optional[str] = None
         self._core = None
 
-    def _build_device_fns(self, params, config, engine_config, attn, donate):
-        """The engine's three jitted device callables for one attention
-        implementation: (prefill, decode). ``prefill`` routes start==0
-        (no shared prefix) through the untouched full-prompt path and
-        block-aligned suffixes through ``prefill_suffix_into_pages`` with
-        a STATIC power-of-two prefix-gather bucket (bounded recompiles,
-        one program per (suffix bucket, prefix bucket) pair)."""
+    def _build_device_fns(self, params, config, engine_config, attn,
+                          attn_mq, donate):
+        """The engine's jitted device callables for one attention
+        implementation: (prefill, decode, decode_multi). ``prefill``
+        routes start==0 (no shared prefix) through the untouched
+        full-prompt path and block-aligned suffixes through
+        ``prefill_suffix_into_pages`` with a STATIC power-of-two
+        prefix-gather bucket (bounded recompiles, one program per
+        (suffix bucket, prefix bucket) pair). ``decode_multi`` (the
+        speculative verify step; None when the model does not opt in)
+        rides the multi-query twin of the same attention kernel."""
         import jax
 
         from client_tpu.models import llama
@@ -137,7 +159,19 @@ class LlmEngineModel(Model):
                 ),
                 **donate_kw,
             )
-        return prefill, decode
+        decode_multi = None
+        if attn_mq is not None:
+            donate_kw = {"donate_argnums": (4,)} if donate else {}
+            decode_multi = jax.jit(
+                lambda tokens, positions, lengths, page_tables, pages: (
+                    llama.decode_step_paged_multi(
+                        params, tokens, positions, lengths, page_tables,
+                        pages, config, attn_mq,
+                    )
+                ),
+                **donate_kw,
+            )
+        return prefill, decode, decode_multi
 
     def warmup(self) -> None:
         import jax
@@ -169,15 +203,24 @@ class LlmEngineModel(Model):
         max_blocks = engine_config.max_blocks_per_seq
         table = np.zeros([max_blocks], dtype=np.int32)
         last_error: Optional[Exception] = None
-        prefill = decode = pages = None
+        prefill = decode = decode_multi = pages = None
         for name in candidates:
             attn = (
                 None if name == "standin"
                 else paged_attention.get_attention_impl(name)
             )
+            # speculative verify rides the SAME kernel choice: every
+            # implementation has a multi-query twin, and a kernel whose
+            # mq variant cannot compile falls down the chain as a whole
+            # (decode and verify must agree numerically)
+            attn_mq = (
+                paged_attention.get_attention_impl_mq(name)
+                if self.speculation is not None
+                else None
+            )
             try:
-                prefill, decode = self._build_device_fns(
-                    params, config, engine_config, attn, donate
+                prefill, decode, decode_multi = self._build_device_fns(
+                    params, config, engine_config, attn, attn_mq, donate
                 )
                 # fresh pool per attempt: a candidate that failed after
                 # donation may have consumed the previous buffers
@@ -218,16 +261,46 @@ class LlmEngineModel(Model):
                         table[None, :nb],
                         pages,
                     )
+                if decode_multi is not None:
+                    # probe the verify shape too (T=2: one real token +
+                    # one draft) — all writes land in the trash block
+                    logits, pages = decode_multi(
+                        np.zeros([1, 2], dtype=np.int32),
+                        np.zeros([1, 2], dtype=np.int32),
+                        np.zeros([1], dtype=np.int32),
+                        table[None, :1],
+                        pages,
+                    )
                 jax.block_until_ready(logits)
                 self.decode_kernel = name
                 break
             except Exception as e:  # noqa: BLE001 - fall down the chain
                 last_error = e
-                prefill = decode = pages = None
+                prefill = decode = decode_multi = pages = None
         if decode is None:
             raise InferenceServerException(
                 f"no paged-attention kernel usable on this host: {last_error}"
             ) from last_error
+        proposer = None
+        if self.speculation is not None:
+            from client_tpu.llm.speculation import build_proposer
+
+            draft_params, draft_config = self._draft_params, self._draft_config
+            if self.speculation.get("draft") == "self":
+                # the draft IS the target (self-speculation): the
+                # near-100%-acceptance regime that measures the verify
+                # machinery's ceiling — proposals cost a full target
+                # forward, so this is a bench/diagnostic mode, not a
+                # production speedup config
+                draft_params, draft_config = params, config
+            # a malformed speculation declaration fails HERE (warmup is
+            # the model-load error surface), never at request time
+            proposer = build_proposer(
+                self.speculation,
+                target_config=config,
+                draft_params=draft_params,
+                draft_config=draft_config,
+            )
         # a reload replaces the engine wholesale: fresh pool, clean
         # accounting (the old engine's streams were drained by the
         # lifecycle layer before the swap)
@@ -239,14 +312,26 @@ class LlmEngineModel(Model):
             pages,
             engine_config,
             model_name=self.name,
+            decode_multi_fn=decode_multi,
+            proposer=proposer,
         )
         self._core = None  # rebind metrics/executor after a reload
 
     def config(self) -> Dict[str, Any]:
-        """Model config with the warmup-selected decode kernel and the
-        prefix-sharing mode in the parameters map (Triton ModelParameter
-        wire shape — both protocols surface it, like the mesh topology
-        does for sharded models)."""
+        """Model config with the warmup-selected decode kernel, the
+        prefix-sharing mode, and the speculation declaration in the
+        parameters map (Triton ModelParameter wire shape — both
+        protocols surface it, like the mesh topology does for sharded
+        models).
+
+        ``speculation_stats`` carries the engine's LIVE speculation
+        counters as a JSON string: the proto statistics schema is
+        frozen, so the config parameters map is the one schemaless
+        channel a remote harness (genai-perf ``--json-summary``) can
+        delta before/after a run to report tokens-per-step and
+        acceptance rate over exactly that run."""
+        import json
+
         doc = super().config()
         parameters = doc.setdefault("parameters", {})
         parameters["decode_kernel"] = {
@@ -257,6 +342,32 @@ class LlmEngineModel(Model):
                 "cow" if self.engine_config.prefix_sharing else "off"
             )
         }
+        if self.speculation is None:
+            parameters["speculation"] = {"string_value": "off"}
+        else:
+            parameters["speculation"] = {
+                "string_value": json.dumps(
+                    self.speculation, sort_keys=True
+                )
+            }
+            if self.engine is not None:
+                stats = self.engine.stats()
+                parameters["speculation_stats"] = {
+                    "string_value": json.dumps(
+                        {
+                            key: stats[key]
+                            for key in (
+                                "steps",
+                                "lane_steps",
+                                "step_tokens",
+                                "spec_steps",
+                                "spec_proposed",
+                                "spec_accepted",
+                            )
+                        },
+                        sort_keys=True,
+                    )
+                }
         return doc
 
     def shutdown(self) -> None:

@@ -1,0 +1,220 @@
+"""Draft proposers for speculative decoding (ROADMAP item 2, PR-15).
+
+Speculative decoding splits a decode step in two: a cheap PROPOSER
+guesses up to K candidate tokens per running sequence, and the target
+model VERIFIES all K+1 positions in one multi-query paged-attention call
+(``models/llama.py`` ``decode_step_paged_multi``).  The engine then
+walks the verified logits with the same seeded per-token PRNG chain it
+uses for plain decoding, accepting a draft token only when it equals the
+token the target would have sampled — so the emitted stream is
+token-for-token identical to non-speculative decoding (greedy AND
+seeded sampling), and the only thing speculation changes is how many
+tokens one device call yields.
+
+Two proposers, selected per model via the ``speculation`` attr
+(``{"mode": "draft" | "ngram", "k": N, ...}``):
+
+- :class:`NgramProposer` — prompt-lookup decoding: find the most recent
+  earlier occurrence of the context's trailing n-gram and propose the
+  tokens that followed it.  Zero extra compute, no second model; wins on
+  repetitive continuations (summarization/extraction-style traffic and
+  greedy decode loops).
+- :class:`DraftModelProposer` — a small draft llama sharing the target's
+  tokenizer/vocab rolls K greedy tokens over a dense cache of the full
+  context (one jitted call, ``lax.scan`` inside — no Python decode
+  loop).  Wins when continuations are model-predictable rather than
+  textually repetitive; acceptance tracks how well the draft
+  approximates the target.
+
+Proposers are pure functions of the context — they keep NO state across
+steps, so preemption/resume replays identically and a rejected proposal
+leaves nothing to roll back on the proposer side.  No clocks anywhere
+(tools/clock_lint.py pins this module).
+"""
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+
+class NgramProposer:
+    """Prompt-lookup proposer: match the trailing n-gram, copy what
+    followed its most recent earlier occurrence.
+
+    ``ngram`` is the longest suffix tried first; shorter suffixes (down
+    to ``min_ngram``) are tried only when the longer one has no earlier
+    occurrence — a longer match is better evidence the continuation
+    repeats.  Pure host-side list scanning; contexts are bounded by the
+    engine's ``max_seq_len``.
+    """
+
+    name = "ngram"
+
+    def __init__(self, k: int, ngram: int = 3, min_ngram: int = 1):
+        if k < 1:
+            raise ValueError(f"speculation k must be >= 1, got {k}")
+        if ngram < 1 or min_ngram < 1 or min_ngram > ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= ngram, got {min_ngram}..{ngram}"
+            )
+        self.k = int(k)
+        self.ngram = int(ngram)
+        self.min_ngram = int(min_ngram)
+
+    def propose(self, context: Sequence[int], k: int) -> List[int]:
+        """Up to ``k`` candidate continuations of ``context`` (possibly
+        fewer, possibly empty — the engine treats a short proposal as a
+        smaller speculative step, never an error)."""
+        k = min(int(k), self.k)
+        context = list(context)
+        n_ctx = len(context)
+        if k < 1 or n_ctx < self.min_ngram + 1:
+            return []
+        for n in range(min(self.ngram, n_ctx - 1), self.min_ngram - 1, -1):
+            suffix = context[n_ctx - n:]
+            # rightmost earlier occurrence: recent repetition predicts
+            # the immediate continuation better than distant repetition
+            for start in range(n_ctx - n - 1, -1, -1):
+                if context[start:start + n] == suffix:
+                    follow = context[start + n:start + n + k]
+                    if follow:
+                        return [int(t) for t in follow]
+        return []
+
+
+class DraftModelProposer:
+    """Greedy K-token rollout of a draft llama over the full context.
+
+    The draft shares the target's vocabulary (its proposals are token
+    ids the target can verify directly) and runs DENSE — its own scratch
+    KV cache per call, never touching the paged pool, so a rejected
+    proposal has no draft-side state to unwind.  The jitted rollout is
+    cached per (padded context bucket, k) pair; buckets are powers of
+    two, so the compiled-program count stays logarithmic in context
+    length.
+    """
+
+    name = "draft"
+
+    def __init__(self, params: Any, config: Any, k: int):
+        if k < 1:
+            raise ValueError(f"speculation k must be >= 1, got {k}")
+        self.k = int(k)
+        self._params = params
+        self._config = config
+        self._fns = {}  # k -> jitted rollout (recompiles per bucket)
+
+    def _rollout_fn(self, k: int):
+        fn = self._fns.get(k)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        from client_tpu.models import llama
+
+        params, config = self._params, self._config
+
+        def rollout(tokens, last_index):
+            cache = llama.init_kv_cache(
+                config, 1, tokens.shape[1] + k
+            )
+            logits, cache = llama.prefill_with_cache(
+                params, tokens, cache, config, last_index=last_index
+            )
+            first = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [1]
+
+            def step(carry, _):
+                token, position, cache = carry
+                lo, cache = llama.decode_step(
+                    params, token, position, cache, config
+                )
+                nxt = jnp.argmax(lo, axis=-1).astype(jnp.int32)
+                return (nxt, position + 1, cache), token
+
+            (_, _, _), toks = jax.lax.scan(
+                step,
+                (first, last_index + jnp.int32(1), cache),
+                None,
+                length=k,
+            )
+            return toks[:, 0]  # [k]
+
+        fn = jax.jit(rollout)
+        self._fns[k] = fn
+        return fn
+
+    def propose(self, context: Sequence[int], k: int) -> List[int]:
+        from client_tpu.server.models import pad_batch_bucket
+
+        k = min(int(k), self.k)
+        context = list(context)
+        if k < 1 or not context:
+            return []
+        # the dense rollout covers the WHOLE context (absolute positions
+        # == cache indices); a context too close to the draft's limit
+        # shrinks the proposal rather than overflowing the scratch cache
+        k = min(k, self._config.max_seq_len - len(context))
+        if k < 1:
+            return []
+        bucket = min(
+            pad_batch_bucket(len(context), minimum=8),
+            self._config.max_seq_len,
+        )
+        tokens = np.zeros([1, bucket], dtype=np.int32)
+        tokens[0, : len(context)] = context
+        out = self._rollout_fn(k)(tokens, len(context) - 1)
+        return [int(t) for t in np.asarray(out)]
+
+
+def build_proposer(
+    speculation: dict,
+    target_config: Any = None,
+    draft_params: Any = None,
+    draft_config: Any = None,
+) -> Optional[Any]:
+    """Construct the proposer a model's ``speculation`` attrs describe.
+
+    ``{"mode": "ngram", "k": N, "ngram": M}`` needs nothing else;
+    ``{"mode": "draft", "k": N}`` uses ``draft_params``/``draft_config``
+    when given, else initializes a fresh half-depth twin of the target
+    config (same vocab — proposals must be verifiable token ids).
+    Raises ``ValueError`` on an unknown mode or a malformed k, so a
+    typo'd model declaration fails at warmup, not at request time.
+    """
+    mode = str(speculation.get("mode", "ngram"))
+    k = int(speculation.get("k", 4))
+    if mode == "ngram":
+        return NgramProposer(
+            k,
+            ngram=int(speculation.get("ngram", 3)),
+            min_ngram=int(speculation.get("min_ngram", 1)),
+        )
+    if mode == "draft":
+        if draft_params is None:
+            import dataclasses
+
+            import jax
+
+            from client_tpu.models import llama
+
+            if draft_config is None:
+                draft_config = dataclasses.replace(
+                    target_config,
+                    n_layers=max(1, target_config.n_layers // 2),
+                )
+            if draft_config.vocab_size != target_config.vocab_size:
+                raise ValueError(
+                    "draft model must share the target vocabulary "
+                    f"({draft_config.vocab_size} != "
+                    f"{target_config.vocab_size})"
+                )
+            draft_params = llama.init_params(
+                jax.random.PRNGKey(1), draft_config
+            )
+        elif draft_config is None:
+            raise ValueError("draft_params given without draft_config")
+        return DraftModelProposer(draft_params, draft_config, k)
+    raise ValueError(
+        f"unknown speculation mode {mode!r} (choose 'draft' or 'ngram')"
+    )

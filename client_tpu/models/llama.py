@@ -558,6 +558,60 @@ def decode_step_paged_attn(
     return logits.astype(jnp.float32), new_pages
 
 
+def decode_step_paged_multi(
+    params, tokens, positions, lengths, page_tables, pages,
+    config: LlamaConfig, attn_mq
+):
+    """Speculative-verify decode step: K+1 query positions per sequence
+    in ONE ragged paged-attention call (the batched-verify half of
+    draft-propose speculative decoding).
+
+    ``tokens`` [B, T] (row 0 = each sequence's last real token, rows
+    ``1..`` its draft candidates), ``positions`` [B, T] the absolute
+    context position of every row, ``lengths`` [B] how many leading rows
+    of each lane are real — rows at index >= ``lengths[b]`` are padding:
+    their K/V writes are redirected to the trash block and their logits
+    are garbage the caller discards.  All T rows' K/V are scattered
+    BEFORE the attention read, and the multi-query kernel's per-position
+    validity mask (``slot <= positions[b, t]``) is what gives row ``t``
+    exactly its own speculative prefix — so the T logits rows equal T
+    sequential :func:`decode_step_paged` calls feeding the draft tokens
+    one at a time.  Returns (logits [B, T, V], new_pages).
+    """
+    b, t = tokens.shape
+    block_size = pages[0][0].shape[1]
+    row_valid = jnp.arange(t)[None, :] < lengths[:, None]  # [B, T]
+    phys = jnp.where(
+        row_valid,
+        jnp.take_along_axis(
+            page_tables, positions // block_size, axis=1
+        ),
+        0,
+    )  # [B, T]
+    off = jnp.where(row_valid, positions % block_size, 0)
+    x = params["embed"][tokens].astype(config.dtype)  # [B, T, D]
+    new_pages = []
+    for layer, (k_pages, v_pages) in zip(params["layers"], pages):
+        normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q = jnp.einsum("btd,dhk->bthk", normed, layer["wq"])
+        k = jnp.einsum("btd,dhk->bthk", normed, layer["wk"])
+        v = jnp.einsum("btd,dhk->bthk", normed, layer["wv"])
+        q = _rope(q, positions, config.rope_theta)
+        k = _rope(k, positions, config.rope_theta)
+        # scatter every verify row's K/V, THEN attend: row t's prefix
+        # rows 0..t-1 must be visible to its attention (the per-position
+        # validity mask keeps rows t+1.. invisible)
+        k_pages = k_pages.at[phys, off].set(k)
+        v_pages = v_pages.at[phys, off].set(v)
+        new_pages.append((k_pages, v_pages))
+        out = attn_mq(q, k_pages, v_pages, page_tables, positions)
+        x = x + jnp.einsum("bthk,hkd->btd", out, layer["wo"])
+        x = x + _mlp_block(layer, rms_norm(x, layer["mlp_norm"], config.norm_eps))
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"])
+    return logits.astype(jnp.float32), new_pages
+
+
 def prefill_suffix_into_pages(
     params, tokens, page_table, pages, last_index, start_index,
     prefix_blocks: int, config: LlamaConfig

@@ -37,6 +37,25 @@ implementations share one contract::
 with slot validity ``block*bs + offset <= positions[b]`` (the freshly
 scattered token attends to itself) and physical block 0 reserved as the
 trash block whose slots are always masked by that rule.
+
+Speculative decoding (PR-15) adds a MULTI-QUERY variant of the same
+contract: the verify step of draft-propose/paged-verify asks the target
+model for logits at K+1 positions per sequence in ONE call, so each
+implementation grows an ``*_mq`` twin::
+
+    attn_mq(q[B, T, H, D], k_pages[N, bs, KV, D], v_pages[N, bs, KV, D],
+            page_tables[B, NB], positions[B, T]) -> out[B, T, H, D]
+
+where query row ``t`` of sequence ``b`` sits at absolute position
+``positions[b, t]`` and slot validity generalizes PER POSITION:
+``block*bs + offset <= positions[b, t]``.  That one mask is the whole
+verification trick — row ``t`` sees exactly its own speculative prefix
+(rows ``0..t`` were scattered at ``positions[b, 0..t]`` before the
+read), never the draft tokens after it, so the K+1 logits rows are
+bit-for-bit what K+1 sequential decode steps would have produced.
+Padding rows (``t`` beyond a lane's draft length) produce garbage the
+caller discards, exactly like padding lanes do in the single-query
+contract.
 """
 
 import functools
@@ -86,6 +105,37 @@ def paged_attention_standin(q, k_pages, v_pages, page_tables, positions):
     return out[:, :, 0, :].astype(q.dtype)  # [B, H, D]
 
 
+def paged_attention_standin_mq(q, k_pages, v_pages, page_tables, positions):
+    """Multi-query stand-in: gather + repeat_kv + a ``[B, T, S]`` mask.
+
+    The oracle the fused/Pallas mq variants are pinned against — kept as
+    dumb as possible (materialized head repeat, full-width softmax)."""
+    b, t, h, d = q.shape
+    _, bs, kv, _ = k_pages.shape
+    n_rep = h // kv
+    s = page_tables.shape[1] * bs
+    k_ctx = k_pages[page_tables].reshape(b, s, kv, d)
+    v_ctx = v_pages[page_tables].reshape(b, s, kv, d)
+    k_rep = jnp.broadcast_to(
+        k_ctx[:, :, :, None, :], (b, s, kv, n_rep, d)
+    ).reshape(b, s, h, d)
+    v_rep = jnp.broadcast_to(
+        v_ctx[:, :, :, None, :], (b, s, kv, n_rep, d)
+    ).reshape(b, s, h, d)
+    qh = q.transpose(0, 2, 1, 3)  # [B, H, T, D]
+    kh = k_rep.transpose(0, 2, 1, 3)  # [B, H, S, D]
+    vh = v_rep.transpose(0, 2, 1, 3)
+    scores = jnp.einsum(
+        "bhtd,bhkd->bhtk", qh, kh, preferred_element_type=jnp.float32
+    ) / (d ** 0.5)
+    # per-position validity: query row t sees slot s iff s <= pos[b, t]
+    valid = jnp.arange(s)[None, None, :] <= positions[:, :, None]  # [B, T, S]
+    scores = jnp.where(valid[:, None, :, :], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhtk,bhkd->bhtd", weights, vh.astype(weights.dtype))
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # [B, T, H, D]
+
+
 # ---------------------------------------------------------------------------
 # fused XLA variant: grouped-query einsum, no repeat materialization
 # ---------------------------------------------------------------------------
@@ -118,6 +168,33 @@ def paged_attention_fused_xla(q, k_pages, v_pages, page_tables, positions):
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgs,bksd->bkgd", weights, v_ctx.astype(weights.dtype))
     return out.reshape(b, h, d).astype(q.dtype)
+
+
+def paged_attention_fused_xla_mq(q, k_pages, v_pages, page_tables, positions):
+    """Multi-query fused XLA variant (the verify-step workhorse off-TPU).
+
+    Same layout choices as :func:`paged_attention_fused_xla` — gathered
+    context transposed to ``[B, KV, S, D]``, queries regrouped to their
+    kv head — with the query-position axis ``T`` riding along both
+    einsums, so one call scores all K+1 verify positions against the
+    same gathered pages instead of gathering K+1 times."""
+    b, t, h, d = q.shape
+    _, bs, kv, _ = k_pages.shape
+    g = h // kv
+    s = page_tables.shape[1] * bs
+    k_ctx = k_pages[page_tables].reshape(b, s, kv, d).transpose(0, 2, 1, 3)
+    v_ctx = v_pages[page_tables].reshape(b, s, kv, d).transpose(0, 2, 1, 3)
+    qg = q.reshape(b, t, kv, g, d)
+    scores = jnp.einsum(
+        "btkgd,bksd->bkgts", qg, k_ctx, preferred_element_type=jnp.float32
+    ) / (d ** 0.5)
+    valid = jnp.arange(s)[None, None, :] <= positions[:, :, None]  # [B, T, S]
+    scores = jnp.where(valid[:, None, None, :, :], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bkgts,bksd->btkgd", weights, v_ctx.astype(weights.dtype)
+    )
+    return out.reshape(b, t, h, d).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -230,6 +307,100 @@ def paged_attention_pallas_interpret(q, k_pages, v_pages, page_tables,
     )
 
 
+def _rpa_kernel_mq(block_size, n_rep, scale,
+                   tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref):
+    """Multi-query grid step (b, j): fold physical block ``tbl[b, j]``
+    into the online-softmax state of ALL T query rows of sequence ``b``
+    at once.  Identical structure to :func:`_rpa_kernel` with a leading
+    query-position axis on q/scratch and a PER-ROW validity threshold
+    (``pos_ref[b, t]``) instead of one per sequence."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32)  # [T, H, D]
+    k = jnp.repeat(k_ref[0].astype(jnp.float32), n_rep, axis=1)  # [bs, H, D]
+    v = jnp.repeat(v_ref[0].astype(jnp.float32), n_rep, axis=1)
+    s = jnp.einsum("thd,uhd->thu", q, k) * scale  # [T, H, bs]
+    # per-row slot validity: absolute slot index <= this ROW's position
+    slot = j * block_size + jax.lax.broadcasted_iota(
+        jnp.int32, (1, 1, block_size), 2
+    )  # [1, 1, bs]
+    valid = slot <= pos_ref[b][:, None, None]  # [T, 1, bs]
+    s = jnp.where(valid, s, NEG_INF)
+    m_prev = m_ref[:]
+    m_new = jnp.maximum(m_prev, s.max(axis=2, keepdims=True))
+    p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+    alpha = jnp.exp(m_prev - m_new)
+    l_ref[:] = l_ref[:] * alpha + p.sum(axis=2, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + jnp.einsum("thu,uhd->thd", p, v)
+    m_ref[:] = m_new
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
+
+
+def paged_attention_pallas_mq(q, k_pages, v_pages, page_tables, positions,
+                              *, interpret: bool = False):
+    """Flash-style multi-query ragged paged attention (Pallas).
+
+    Streams one physical block per grid step exactly like the
+    single-query kernel; the T verify rows of a sequence share each
+    streamed block (the whole point of batched verification — the pages
+    cross HBM->VMEM once for all K+1 positions)."""
+    if pl is None:  # pragma: no cover - import-gated host
+        raise RuntimeError(f"pallas unavailable: {_PALLAS_IMPORT_ERROR}")
+    b, t, h, d = q.shape
+    _, bs, kv, _ = k_pages.shape
+    nb = page_tables.shape[1]
+    kernel = functools.partial(
+        _rpa_kernel_mq, bs, h // kv, 1.0 / (d ** 0.5)
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nb),
+        in_specs=[
+            pl.BlockSpec((1, t, h, d), lambda i, j, tbl, pos: (i, 0, 0, 0)),
+            pl.BlockSpec(
+                (1, bs, kv, d), lambda i, j, tbl, pos: (tbl[i, j], 0, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, bs, kv, d), lambda i, j, tbl, pos: (tbl[i, j], 0, 0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, t, h, d), lambda i, j, tbl, pos: (i, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((t, h, 1), jnp.float32),  # running max
+            pltpu.VMEM((t, h, 1), jnp.float32),  # running denominator
+            pltpu.VMEM((t, h, d), jnp.float32),  # weighted-value accumulator
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(page_tables, positions, q, k_pages, v_pages)
+
+
+def paged_attention_pallas_interpret_mq(q, k_pages, v_pages, page_tables,
+                                        positions):
+    """The multi-query Pallas kernel under the interpreter."""
+    return paged_attention_pallas_mq(
+        q, k_pages, v_pages, page_tables, positions, interpret=True
+    )
+
+
 # ---------------------------------------------------------------------------
 # selection
 # ---------------------------------------------------------------------------
@@ -241,10 +412,30 @@ _IMPLS = {
     "pallas_interpret": paged_attention_pallas_interpret,
 }
 
+# every kernel name has a multi-query twin so the speculative verify
+# path rides whatever implementation warmup selected for plain decode
+_IMPLS_MQ = {
+    "standin": paged_attention_standin_mq,
+    "fused_xla": paged_attention_fused_xla_mq,
+    "pallas": paged_attention_pallas_mq,
+    "pallas_interpret": paged_attention_pallas_interpret_mq,
+}
+
 
 def get_attention_impl(name: str) -> Callable:
     try:
         return _IMPLS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown paged-attention kernel '{name}' "
+            f"(choose from {', '.join(KERNELS)})"
+        ) from None
+
+
+def get_attention_impl_mq(name: str) -> Callable:
+    """The multi-query (speculative verify) twin of ``name``."""
+    try:
+        return _IMPLS_MQ[name]
     except KeyError:
         raise ValueError(
             f"unknown paged-attention kernel '{name}' "

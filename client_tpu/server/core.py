@@ -1422,6 +1422,23 @@ class ServerCore:
                 },
                 "max_queue_size": batcher.policy.max_queue_size,
             }
+        # LLM engines: live continuous-batching/speculation counters per
+        # engine-backed model (kv blocks, tokens-per-step, acceptance
+        # rate) — the same document engine.stats() returns, so the debug
+        # surface and the tests read one source of truth
+        llm: Dict[str, Any] = {}
+        for entry in self.repository.index():
+            try:
+                model = self.repository.peek(entry["name"])
+            except Exception:  # noqa: BLE001 - introspection best-effort
+                continue
+            engine = getattr(model, "engine", None)
+            stats = getattr(engine, "stats", None)
+            if callable(stats):
+                try:
+                    llm[entry["name"]] = stats()
+                except Exception:  # noqa: BLE001 - a broken engine must
+                    continue  # not take down the debug surface
         return {
             "server": {
                 "name": SERVER_NAME,
@@ -1429,6 +1446,7 @@ class ServerCore:
                 "live": self.live,
                 "ready": self.ready,
             },
+            "llm": llm,
             "lifecycle": self.lifecycle.snapshot(),
             # device inventory + per-model mesh occupancy (which devices
             # a loaded sharded model runs on, and its executor's

@@ -66,6 +66,10 @@ DURATION_BUCKETS_S = (
     0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 BATCH_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+# Tokens per speculative verify step per sequence: 1 (nothing accepted)
+# up through deep-lookahead acceptance; draft windows beyond 16 are
+# past the point of diminishing returns for any measured workload.
+SPEC_TOKENS_BUCKETS = (1, 2, 3, 4, 6, 8, 12, 16)
 # Thread-CPU per stage per request: sub-microsecond codec touches through
 # multi-millisecond model compute.
 STAGE_CPU_BUCKETS_S = (
@@ -382,6 +386,33 @@ class ServerMetrics:
             model,
             registry=registry,
         )
+        # Speculative decoding (PR-15): proposed/accepted drive the
+        # acceptance rate, and the per-sequence tokens-per-verify-step
+        # distribution is the direct read of how much each multi-query
+        # call bought (1 = nothing accepted, K+1 = the whole draft).
+        self.llm_spec_proposed = Counter(
+            "tpu_llm_spec_proposed_total",
+            "Draft tokens submitted to speculative verification "
+            "(post-clamp: only candidates a verify step actually "
+            "carried).",
+            model,
+            registry=registry,
+        )
+        self.llm_spec_accepted = Counter(
+            "tpu_llm_spec_accepted_total",
+            "Draft tokens accepted by speculative verification (each "
+            "one a decode step the engine did not have to run).",
+            model,
+            registry=registry,
+        )
+        self.llm_spec_tokens_per_step = Histogram(
+            "tpu_llm_spec_tokens_per_step",
+            "Tokens one sequence emitted per speculative verify step "
+            "(accepted drafts + the sampled correction/bonus token).",
+            model,
+            buckets=SPEC_TOKENS_BUCKETS,
+            registry=registry,
+        )
         self._duty_lock = threading.Lock()
         # First scrape reports utilization since server start — not 0.0
         # (the pre-registry handler's first-scrape blind spot).
@@ -528,6 +559,20 @@ class ServerMetrics:
 
     def observe_llm_preemption(self, model: str) -> None:
         self.llm_preemptions.labels(model).inc()
+
+    def observe_llm_speculation(
+        self, model: str, proposed: int, accepted: int, lane_tokens
+    ) -> None:
+        """Book one speculative verify step: drafts verified/accepted
+        across the batch, plus each live lane's emitted-token count for
+        the tokens-per-step histogram."""
+        if proposed:
+            self.llm_spec_proposed.labels(model).inc(proposed)
+        if accepted:
+            self.llm_spec_accepted.labels(model).inc(accepted)
+        child = self.llm_spec_tokens_per_step.labels(model)
+        for tokens in lane_tokens:
+            child.observe(tokens)
 
     def pending_inc(self, model: str, count: int = 1) -> None:
         self.pending_requests.labels(model).inc(count)

@@ -76,14 +76,15 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
     lines = [
         "| run | infer/sec | p50 (us) | ratio_vs_inproc | server CPU "
         "(us/req) | dominant stage | rolling p99 (us) | llm tok/s | "
-        "sharded inf/s | fleet inf/s | kernel tok/s | prefix hit |",
-        "|---|---|---|---|---|---|---|---|---|---|---|---|",
+        "sharded inf/s | fleet inf/s | kernel tok/s | prefix hit | "
+        "spec tok/step |",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for run in runs:
         parsed = run["parsed"]
         if parsed is None:
             lines.append(
-                f"| r{run['run']:02d} | (bench failed) | | | | | | | | | | |"
+                f"| r{run['run']:02d} | (bench failed) | | | | | | | | | | | |"
             )
             continue
 
@@ -137,6 +138,18 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
             and isinstance(sharing.get("prefix_hit_rate"), (int, float))
             else "-"
         )
+        # BENCH_r14+: the speculative-decoding A/B's verified
+        # tokens-per-step (draft cell; 1.0 would mean speculation bought
+        # nothing over the plain engine it wraps)
+        spec = (
+            llm.get("speculation") if isinstance(llm, dict) else None
+        )
+        spec_s = (
+            f"{spec['tokens_per_step']:.2f}"
+            if isinstance(spec, dict)
+            and isinstance(spec.get("tokens_per_step"), (int, float))
+            else "-"
+        )
         lines.append(
             f"| r{run['run']:02d} "
             f"| {_num('value', '{:.1f}')} "
@@ -149,7 +162,8 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
             f"| {sharded_s} "
             f"| {fleet_s} "
             f"| {kernel_s} "
-            f"| {hit_s} |"
+            f"| {hit_s} "
+            f"| {spec_s} |"
         )
     return "\n".join(lines)
 
@@ -178,7 +192,10 @@ def check_regression(
       * ``llm_generate.tokens_per_sec`` (BENCH_r09+);
       * ``fleet.best_infer_per_sec`` (BENCH_r12+) — the fleet row runs
         one harness family (python grpc.aio over subprocess replicas),
-        so within-family comparison is automatic.
+        so within-family comparison is automatic;
+      * ``llm_generate.speculation.tokens_per_step`` (BENCH_r14+) —
+        floored at 1.0 (speculation may never lose to the plain engine
+        it wraps).
     """
     ok = [r for r in runs if r["parsed"] is not None]
     if len(ok) < 2:
@@ -277,6 +294,20 @@ def check_regression(
             f"kernel is SLOWER than the gather/scatter stand-in on at "
             f"least one grid cell (min speedup {speedup_min:.2f}x < 1.0x)"
         )
+    # BENCH_r14+: speculation may never lose to the plain engine it
+    # wraps — every verify step emits at least one token, so a recorded
+    # tokens/step below 1.0 means the accounting (or the engine) broke,
+    # mirroring the kernel speedup floor above.
+    llm_row = latest.get("llm_generate")
+    spec = llm_row.get("speculation") if isinstance(llm_row, dict) else None
+    if isinstance(spec, dict):
+        spec_tps = spec.get("tokens_per_step")
+        if isinstance(spec_tps, (int, float)) and spec_tps < 1.0:
+            problems.append(
+                f"speculation floor: r{latest_run:02d}'s speculative A/B "
+                f"recorded {spec_tps:.2f} tokens/step < 1.0 — speculation "
+                f"must never lose to the baseline it wraps"
+            )
     kernel_row = latest.get("llm_decode_kernel")
     sharing = (
         kernel_row.get("prefix_sharing")

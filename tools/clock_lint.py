@@ -51,6 +51,10 @@ TARGET_FILES = (
     # policy tests and the hedge window run entirely on fed-in numbers)
     os.path.join("client_tpu", "lifecycle", "hedge.py"),
     os.path.join("client_tpu", "lifecycle", "routing.py"),
+    # PR-15 speculative decoding: proposers must stay pure functions of
+    # the context (replay across preemption depends on it) — pinned even
+    # though the llm/ directory walk covers the file today
+    os.path.join("client_tpu", "llm", "speculation.py"),
     os.path.join("client_tpu", "observability", "logging.py"),
     os.path.join("client_tpu", "observability", "profiling.py"),
     os.path.join("client_tpu", "observability", "recorder.py"),
