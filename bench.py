@@ -516,13 +516,11 @@ def _bench_llm_speculation(server) -> dict:
                             "--artifact-dir", artifact_dir,
                         ]
                     )
+            from client_tpu.testing import retry_grpc_poller_flake
+
             for phase in ("off", "on"):
-                # two attempts: deep into a long bench run grpcio's
-                # process-global aio poller occasionally breaks down
-                # with EAGAIN and a window records zero requests (the
-                # same upstream flake tests/test_llm_engine.py retries)
-                for attempt in range(2):
-                    stats0 = model.engine.stats()
+                def _one_pass(phase=phase):
+                    stats_before = model.engine.stats()
                     with tempfile.TemporaryDirectory(
                         prefix="bench_llm_spec_"
                     ) as artifact_dir:
@@ -543,11 +541,15 @@ def _bench_llm_speculation(server) -> dict:
                         )
                         if code != 0:
                             raise RuntimeError(f"genai-perf rc {code}")
-                        metrics = LLMProfileDataParser(
+                        return stats_before, LLMProfileDataParser(
                             os.path.join(artifact_dir, "profile_export.json")
                         ).parse()
-                    if metrics.request_count:
-                        break
+
+                # a window recording zero requests is the grpcio
+                # process-global poller flake the shared shim retries
+                stats0, metrics = retry_grpc_poller_flake(
+                    _one_pass, lambda result: bool(result[1].request_count)
+                )
                 stats1 = model.engine.stats()
                 lane_steps = stats1["lane_steps"] - stats0["lane_steps"]
                 step_tokens = stats1["step_tokens"] - stats0["step_tokens"]

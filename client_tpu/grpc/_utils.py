@@ -16,17 +16,35 @@ def raise_error(msg: str) -> None:
 
 
 def rpc_error_to_exception(rpc_error: grpc.RpcError) -> InferenceServerException:
-    """Map a grpc.RpcError to the client exception type."""
+    """Map a grpc.RpcError to the client exception type.
+
+    A ``retry-after`` entry in the trailing metadata (seconds — what a
+    shedding router or draining server attaches, the gRPC face of the
+    HTTP ``Retry-After`` header) rides along as ``retry_after_s`` so the
+    retry loop's server-hint backoff floor engages."""
+    retry_after_s = None
     try:
         code = rpc_error.code()
         status = str(code) if code is not None else None
         details = rpc_error.details()
+        trailing = rpc_error.trailing_metadata()
+        if trailing:
+            for key, value in trailing:
+                if key == "retry-after":
+                    try:
+                        retry_after_s = max(0.0, float(value))
+                    except (TypeError, ValueError):
+                        pass
+                    break
     except Exception:
         status = None
         details = str(rpc_error)
-    return InferenceServerException(
+    error = InferenceServerException(
         details or "gRPC request failed", status=status
     )
+    if retry_after_s is not None:
+        error.retry_after_s = retry_after_s
+    return error
 
 
 def request_routing_key(request, key_parameter: Optional[str]):

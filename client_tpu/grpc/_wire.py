@@ -612,3 +612,136 @@ def decode_infer_request(data):
     wrapper over :class:`RequestScanner` — there is exactly one parser.
     Returns (template, request_id, extra_params, raw views) or None."""
     return RequestScanner(cache_max=1).scan(bytes(data))
+
+
+# -- router splice helpers ----------------------------------------------------
+#
+# The router tier forwards serialized ModelInfer bytes without ever
+# materializing a proto: it rewrites exactly ONE field — the top-level
+# ``id`` (field 3 on BOTH ModelInferRequest and ModelInferResponse, the
+# correlation key of the multiplexed backend streams) — with a tag walk
+# plus bytes slices. Field order is irrelevant to protobuf decoding and
+# the server-side RequestScanner excises ``id`` from its cache key, so a
+# spliced request still rides the backend's fast path.
+
+
+def _skip_wire_value(buf, pos: int, wiretype: int) -> int:
+    """Advance past one field's value (generic walk: the response side
+    may carry fields this module doesn't model)."""
+    if wiretype == 0:  # varint
+        _, pos = read_varint(buf, pos)
+        return pos
+    if wiretype == 1:  # fixed64
+        return pos + 8
+    if wiretype == 2:  # length-delimited
+        n, pos = read_varint(buf, pos)
+        return pos + n
+    if wiretype == 5:  # fixed32
+        return pos + 4
+    raise WireError(f"unsupported wire type {wiretype}")
+
+
+def _id_spans(data) -> Tuple[str, List[Tuple[int, int]]]:
+    """(decoded id, [(start, stop) of every top-level field-3 entry])
+    via one generic top-level walk; last entry wins (protobuf merge)."""
+    pos = 0
+    end = len(data)
+    message_id = ""
+    spans: List[Tuple[int, int]] = []
+    while pos < end:
+        start = pos
+        tag, pos = read_varint(data, pos)
+        field, wiretype = tag >> 3, tag & 0x7
+        if field == 3 and wiretype == 2:
+            n, pos = read_varint(data, pos)
+            try:
+                message_id = bytes(data[pos : pos + n]).decode("utf-8")
+            except UnicodeDecodeError:
+                raise WireError("non-UTF-8 id field") from None
+            pos += n
+            spans.append((start, pos))
+        else:
+            pos = _skip_wire_value(data, pos, wiretype)
+    if pos != end:
+        raise WireError("truncated message")
+    return message_id, spans
+
+
+def read_message_id(data) -> str:
+    """The top-level ``id`` of serialized ModelInferRequest/Response
+    bytes (same schema slot both directions — one reader serves the
+    router's correlation on requests and responses alike)."""
+    message_id, _spans = _id_spans(data)
+    return message_id
+
+
+def splice_message_id(data, new_id: str) -> Tuple[bytes, str]:
+    """Serialized ModelInfer{Request,Response} bytes with the top-level
+    ``id`` replaced by ``new_id``; returns (spliced bytes, original id).
+    No other byte is touched — the rewrite is a prepended id field plus
+    the excision of the old spans (prepending keeps metadata ahead of
+    the raw contents, so the backend scanner's prefix split still
+    applies)."""
+    original, spans = _id_spans(data)
+    out = bytearray()
+    _encode_string_field(out, _TAG_ID, new_id)
+    cursor = 0
+    for start, stop in spans:
+        out += data[cursor:start]
+        cursor = stop
+    out += data[cursor:]
+    return bytes(out), original
+
+
+def splice_forward_request(data, new_id: str) -> Tuple[bytes, str]:
+    """The router's request rewrite in one pass: correlation ``id`` :=
+    ``new_id`` and a ``multiplex`` parameter prepended (so the backend
+    executes it as its own task on the shared persistent stream instead
+    of serializing the stream). Returns (forwarded bytes, original id).
+    A client-sent ``multiplex`` entry, if any, appears later in the map
+    and wins under protobuf merge — the router never overrides it."""
+    original, spans = _id_spans(data)
+    out = bytearray()
+    _encode_string_field(out, _TAG_ID, new_id)
+    _encode_params_map(out, _TAG_PARAMS, {"multiplex": True})
+    cursor = 0
+    for start, stop in spans:
+        out += data[cursor:start]
+        cursor = stop
+    out += data[cursor:]
+    return bytes(out), original
+
+
+def split_stream_frame(data) -> Tuple[str, Any]:
+    """Split serialized ModelStreamInferResponse bytes into
+    (error_message, infer_response bytes view) without a proto parse —
+    the router's per-frame cost on the response path. The server emits
+    exactly one ``infer_response`` per frame; were several present the
+    last complete submessage wins (protobuf merge approximation that
+    cannot occur with our own server)."""
+    pos = 0
+    end = len(data)
+    error_message = ""
+    response: Any = b""
+    mv = None
+    while pos < end:
+        tag, pos = read_varint(data, pos)
+        field, wiretype = tag >> 3, tag & 0x7
+        if field == 1 and wiretype == 2:  # error_message
+            n, pos = read_varint(data, pos)
+            try:
+                error_message = bytes(data[pos : pos + n]).decode("utf-8")
+            except UnicodeDecodeError:
+                raise WireError("non-UTF-8 error_message") from None
+            pos += n
+        elif field == 2 and wiretype == 2:  # infer_response
+            n, pos = read_varint(data, pos)
+            if mv is None:
+                mv = memoryview(data)
+            response = mv[pos : pos + n]
+            pos += n
+        else:
+            pos = _skip_wire_value(data, pos, wiretype)
+    if pos != end:
+        raise WireError("truncated stream frame")
+    return error_message, response

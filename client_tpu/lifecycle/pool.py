@@ -254,6 +254,7 @@ class EndpointPool:
         self.ejection_cooldown_s = ejection_cooldown_s
         self._clock = clock
         self._logger = logger
+        self._breaker_factory = breaker_factory
         self._lock = threading.Lock()
         self._endpoints: List[Endpoint] = [
             Endpoint(u, breaker_factory() if breaker_factory else None)
@@ -318,6 +319,57 @@ class EndpointPool:
             raise ValueError("either url or urls is required")
         return cls(url, **kwargs)
 
+    # -- membership ----------------------------------------------------------
+    #
+    # Client pools are fixed at construction, but the router tier's pool
+    # follows the autoscaler: replicas join as they launch and leave as
+    # they drain. Both mutations re-prime any keyed policy's ring over
+    # the FULL new membership (some keys move on a membership change —
+    # that is inherent to consistent hashing, and the vnode ring bounds
+    # how many).
+
+    def add_endpoint(self, url: str) -> Endpoint:
+        """Add one endpoint to the pool (idempotent: an existing url
+        returns its live endpoint untouched, telemetry intact)."""
+        with self._lock:
+            for ep in self._endpoints:
+                if ep.url == url:
+                    return ep
+            ep = Endpoint(
+                url,
+                self._breaker_factory() if self._breaker_factory else None,
+            )
+            self._endpoints.append(ep)
+            policy = self._routing_policy
+            if policy is not None and hasattr(policy, "prime"):
+                policy.prime([e.url for e in self._endpoints])
+        if self._logger is not None:
+            self._logger.info("endpoint_added", endpoint=url)
+        return ep
+
+    def remove_endpoint(self, url: str) -> bool:
+        """Remove one endpoint from rotation (a draining replica: the
+        autoscaler stops routing to it BEFORE the drain starts, so
+        in-flight work finishes and nothing new lands on it). Refuses to
+        empty the pool. Returns True when a member was removed."""
+        with self._lock:
+            for index, ep in enumerate(self._endpoints):
+                if ep.url == url:
+                    break
+            else:
+                return False
+            if len(self._endpoints) == 1:
+                return False
+            del self._endpoints[index]
+            if self._primary >= len(self._endpoints):
+                self._primary = 0
+            policy = self._routing_policy
+            if policy is not None and hasattr(policy, "prime"):
+                policy.prime([e.url for e in self._endpoints])
+        if self._logger is not None:
+            self._logger.info("endpoint_removed", endpoint=url)
+        return True
+
     # -- introspection -------------------------------------------------------
 
     @property
@@ -352,25 +404,36 @@ class EndpointPool:
 
     # -- selection -----------------------------------------------------------
 
-    def pick(self, key=None, exclude: Optional[Endpoint] = None) -> Endpoint:
+    def pick(
+        self,
+        key=None,
+        exclude: Optional[Endpoint] = None,
+        allow=None,
+    ) -> Endpoint:
         """The endpoint the next request should target. With a routing
         policy installed, the policy selects among the currently healthy
         endpoints (on their live outstanding/EWMA signals, or on ``key``
         for consistent-hash affinity); without one — or when a keyed
         policy gets no key — the sticky-primary scan applies. ``exclude``
         removes one endpoint from consideration (the hedge path asks for
-        somewhere *different*). When every endpoint is down, returns the
-        one whose cooldown ends soonest — callers still try it (the
-        server may be back early)."""
+        somewhere *different*); ``allow`` (a url set, or None for all)
+        restricts selection to a subset — the router's model→replica
+        table picks only among replicas that serve the request's model.
+        When every endpoint is down, returns the one whose cooldown ends
+        soonest — callers still try it (the server may be back early)."""
         with self._lock:
             now = self._clock()
             n = len(self._endpoints)
             policy = self._routing_policy
+
+            def eligible(ep):
+                return allow is None or ep.url in allow
+
             if policy is not None:
                 candidates = [
                     ep
                     for ep in self._endpoints
-                    if ep is not exclude and self._up(ep, now)
+                    if ep is not exclude and eligible(ep) and self._up(ep, now)
                 ]
                 if candidates:
                     choice = policy.select(candidates, key)
@@ -378,16 +441,17 @@ class EndpointPool:
                         return choice
             for offset in range(n):
                 ep = self._endpoints[(self._primary + offset) % n]
-                if ep is not exclude and self._up(ep, now):
+                if ep is not exclude and eligible(ep) and self._up(ep, now):
                     return ep
             if exclude is not None:
                 # nothing else healthy: the excluded endpoint (if up) is
                 # all there is — callers detect the identity and skip
                 # hedging rather than duplicate onto the same endpoint
                 for ep in self._endpoints:
-                    if self._up(ep, now):
+                    if eligible(ep) and self._up(ep, now):
                         return ep
-            return min(self._endpoints, key=self._benched_until)
+            allowed = [ep for ep in self._endpoints if eligible(ep)]
+            return min(allowed or self._endpoints, key=self._benched_until)
 
     def has_alternative(self, ep: Optional[Endpoint]) -> bool:
         """True when a request that just failed on ``ep`` (None: on

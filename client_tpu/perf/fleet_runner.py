@@ -32,6 +32,7 @@ replicas:
 
 import argparse
 import json
+import os
 import signal
 import threading
 import time
@@ -65,11 +66,22 @@ class DeviceBoundModel(Model):
         step_s: float = 0.02,
         max_batch_size: int = 4,
         sleep: Callable[[float], None] = time.sleep,
+        slo: Optional[dict] = None,
     ):
         self.name = name
         self.step_s = step_s
         self.max_batch_size = max_batch_size
         self._sleep = sleep
+        # one device queue per model instance: unbatched requests bypass
+        # the serial batcher and run on the server's thread pool, so
+        # without this lock a replica would be 32-way concurrent and
+        # never saturate (the SLO burn signal feeds on real queueing)
+        self._device_lock = threading.Lock()
+        if slo is not None:
+            # e.g. {"latency_target_ms": 60, "window_s": 3}: the server's
+            # LiveTelemetry picks this up on first traffic, which is what
+            # the SLO autoscaler's burn-rate signal feeds on
+            self.slo = dict(slo)
 
     def warmup(self) -> None:
         pass
@@ -78,7 +90,8 @@ class DeviceBoundModel(Model):
         a = inputs.get("INPUT0")
         if a is None:
             raise ValueError(f"model '{self.name}' expects INPUT0")
-        self._sleep(self.step_s)
+        with self._device_lock:
+            self._sleep(self.step_s)
         return {"OUTPUT0": np.asarray(a)}
 
 
@@ -230,6 +243,37 @@ class FleetRunner:
                 return
             self.replicas[index].stop()
 
+    # -- elasticity (the autoscaler's two verbs) -----------------------------
+
+    def add_replica(self):
+        """Launch one more replica under live traffic; returns the
+        started :class:`~client_tpu.testing.InProcessServer` so the
+        caller (the autoscaler) can announce its addresses to the
+        router. ``size`` tracks live membership."""
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError("fleet is stopped")
+            server = self._new_server().start()
+            self.replicas.append(server)
+            self.size = len(self.replicas)
+            return server
+
+    def remove_replica(self, index: int = -1):
+        """Drain and retire one replica (default: the newest). Refuses
+        to empty the fleet. The caller must pull the replica's addresses
+        from any router FIRST — drain only finishes in-flights; it
+        cannot protect requests routed to it afterwards. Returns the
+        stopped server (its ports identify which addresses left)."""
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError("fleet is stopped")
+            if len(self.replicas) <= 1:
+                raise ValueError("refusing to remove the last replica")
+            server = self.replicas.pop(index)
+            self.size = len(self.replicas)
+        server.stop()
+        return server
+
 
 class FleetRestartDriver:
     """``--rolling-restart`` over a live fleet: every ``period_s``
@@ -284,8 +328,187 @@ class FleetRestartDriver:
             self._task = None
 
 
+class Autoscaler:
+    """SLO-burn-driven fleet sizing: the control loop that closes the
+    router tier.
+
+    The signal is ``tpu_slo_latency_burn_rate`` — the same number the
+    alerting surface exports: how fast the fleet is spending its latency
+    error budget (1.0 = exactly on target). Each tick reads the MAX burn
+    across live replicas (the autoscaler's job is the worst replica's
+    overload, not the average), then applies hysteresis: ``high_ticks``
+    consecutive ticks at/above ``burn_high`` add a replica (up to
+    ``max_replicas``); ``low_ticks`` consecutive ticks at/below
+    ``burn_low`` drain one (down to ``min_replicas``). Asymmetric on
+    purpose — scaling out is cheap and urgent, scaling in is neither.
+
+    Scale events keep the router in the loop so they stay
+    client-invisible: on scale-out the replica starts FIRST, then
+    ``on_scale_out(server)`` announces it (the router routes to it once
+    its readiness probe passes); on scale-in ``on_scale_in(server)``
+    pulls the addresses from routing BEFORE the drain, so no new request
+    can target the leaving replica while it finishes its in-flights.
+
+    :meth:`observe` is the pure decision function (unit-testable with no
+    fleet at all); :meth:`tick` is one read-decide-act cycle;
+    :meth:`start` runs ticks on a daemon thread every ``interval_s``.
+    """
+
+    def __init__(
+        self,
+        fleet: FleetRunner,
+        min_replicas: int = 1,
+        max_replicas: int = 4,
+        burn_high: float = 1.0,
+        burn_low: float = 0.1,
+        high_ticks: int = 2,
+        low_ticks: int = 6,
+        interval_s: float = 0.5,
+        model_name: str = "device_sim",
+        burn_signal: Optional[Callable[[], float]] = None,
+        on_scale_out: Optional[Callable] = None,
+        on_scale_in: Optional[Callable] = None,
+        logger=None,
+    ):
+        if min_replicas < 1 or max_replicas < min_replicas:
+            raise ValueError("need 1 <= min_replicas <= max_replicas")
+        self.fleet = fleet
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.burn_high = burn_high
+        self.burn_low = burn_low
+        self.high_ticks = high_ticks
+        self.low_ticks = low_ticks
+        self.interval_s = interval_s
+        self.model_name = model_name
+        self._burn_signal = burn_signal
+        self.on_scale_out = on_scale_out
+        self.on_scale_in = on_scale_in
+        self._logger = logger
+        self._high = 0
+        self._low = 0
+        self.events: List[dict] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- signal --------------------------------------------------------------
+
+    def current_burn(self) -> float:
+        """Max ``burn_rate`` across live replicas (0.0 while telemetry
+        is still warming — never scale on an absent signal)."""
+        if self._burn_signal is not None:
+            return self._burn_signal()
+        burns = []
+        for server in list(self.fleet.replicas):
+            try:
+                status = server.core.metrics.telemetry.slo_status(
+                    self.model_name
+                )
+            except Exception:  # noqa: BLE001 - replica mid-restart
+                continue
+            if status:
+                burns.append(float(status.get("burn_rate", 0.0)))
+        return max(burns, default=0.0)
+
+    # -- decision (pure) -----------------------------------------------------
+
+    def observe(self, burn: float) -> str:
+        """Fold one burn sample into the hysteresis counters; returns
+        the decision: ``"scale_out"`` / ``"scale_in"`` / ``"hold"``."""
+        size = self.fleet.size
+        if burn >= self.burn_high:
+            self._high += 1
+            self._low = 0
+            if self._high >= self.high_ticks and size < self.max_replicas:
+                self._high = 0
+                return "scale_out"
+        elif burn <= self.burn_low:
+            self._low += 1
+            self._high = 0
+            if self._low >= self.low_ticks and size > self.min_replicas:
+                self._low = 0
+                return "scale_in"
+        else:
+            self._high = 0
+            self._low = 0
+        return "hold"
+
+    # -- actuation -----------------------------------------------------------
+
+    def tick(self) -> str:
+        burn = self.current_burn()
+        decision = self.observe(burn)
+        if decision == "scale_out":
+            server = self.fleet.add_replica()
+            if self.on_scale_out is not None:
+                self.on_scale_out(server)
+        elif decision == "scale_in":
+            # routing first, then drain: remove_replica's drain protects
+            # in-flights, the router removal protects everything after
+            server = self.fleet.replicas[-1]
+            if self.on_scale_in is not None:
+                self.on_scale_in(server)
+            self.fleet.remove_replica(-1)
+        if decision != "hold":
+            event = {
+                "decision": decision,
+                "burn": round(burn, 3),
+                "size": self.fleet.size,
+            }
+            self.events.append(event)
+            if self._logger is not None:
+                self._logger.info("autoscale", **event)
+        return decision
+
+    def start(self) -> "Autoscaler":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="fleet-autoscaler", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 - scaling must not die
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+
 # ---------------------------------------------------------------------------
 # subprocess replica mode (tools/bench_fleet.py spawns N of these)
+
+
+def write_ports_file(path: str, ports: dict) -> None:
+    """Publish a serving subprocess's bound ports as one JSON document,
+    atomically (write-temp + rename): a reader polling the path sees
+    either nothing or the complete document, never a partial write.
+    Replaces stdout scanning — ports travel as a file handoff that
+    survives whatever else the child prints."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(ports, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_ports_file(path: str) -> Optional[dict]:
+    """The reader half: None until the file exists and parses (the
+    write is atomic, so a parse failure just means 'not yet')."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
 
 
 def _serve_one(args) -> int:
@@ -313,12 +536,10 @@ def _serve_one(args) -> int:
         ).start()
     )
     server = fleet.replicas[0]
-    print(
-        json.dumps(
-            {"http_port": server.http_port, "grpc_port": server.grpc_port}
-        ),
-        flush=True,
-    )
+    ports = {"http_port": server.http_port, "grpc_port": server.grpc_port}
+    if args.ports_file:
+        write_ports_file(args.ports_file, ports)
+    print(json.dumps(ports), flush=True)
     stop = threading.Event()
     for signum in (signal.SIGTERM, signal.SIGINT):
         signal.signal(signum, lambda *_: stop.set())
@@ -338,6 +559,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--http-port", type=int, default=0)
     parser.add_argument("--grpc-port", type=int, default=0)
     parser.add_argument("--drain-timeout", type=float, default=5.0)
+    parser.add_argument(
+        "--ports-file",
+        default=None,
+        metavar="PATH",
+        help="also write the bound-ports JSON to PATH (atomic write; "
+        "spawners poll the file instead of scanning stdout)",
+    )
     parser.add_argument(
         "--device-sim",
         default=None,

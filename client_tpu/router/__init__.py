@@ -1,0 +1,20 @@
+"""Router tier: a stateless, protocol-preserving fleet front door.
+
+Clients keep speaking KServe v2 (HTTP or gRPC) to ONE address; the
+router classifies each request with the protobuf-free wire scanner,
+routes it over live per-backend telemetry (routing policies, outlier
+ejection, consistent-hash affinity — the PR-7 client fleet layer run
+server-side), splices only the correlation id, and forwards raw bytes
+on persistent multiplexed backend streams. Overload sheds
+default-priority traffic with ``Retry-After``; the SLO autoscaler
+(:mod:`client_tpu.perf.fleet_runner`) grows and drains the replica set
+behind it without a client ever noticing.
+"""
+
+from client_tpu.router.backends import BackendLink, ReadinessProber  # noqa: F401
+from client_tpu.router.core import (  # noqa: F401
+    ModelTable,
+    RouterCore,
+    RouterOverloadError,
+)
+from client_tpu.router.server import RouterServer  # noqa: F401

@@ -2,6 +2,7 @@
 
 import os
 
+from client_tpu.testing.flake import retry_grpc_poller_flake  # noqa: F401
 from client_tpu.testing.inprocess import InProcessServer  # noqa: F401
 
 

@@ -1,0 +1,43 @@
+"""Retry shim for grpcio's process-global aio poller flake.
+
+Deep into a long test or bench session, grpcio's process-global aio
+poller occasionally breaks down with EAGAIN (upstream flake, observed as
+a driver run that completes with ZERO successful requests while the
+server is demonstrably healthy). The affected call sites — the
+genai-perf e2e test and the bench.py LLM cells — all carried their own
+copy of the same two-attempt loop; this is the one shared
+implementation. A genuine regression fails every attempt, so the retry
+cannot mask one.
+"""
+
+from typing import Callable, TypeVar
+
+T = TypeVar("T")
+
+# Why two: one retry is enough to ride over a single poller breakdown,
+# and every extra attempt doubles how long a REAL regression takes to
+# fail. No caller has ever needed a third.
+DEFAULT_ATTEMPTS = 2
+
+
+def retry_grpc_poller_flake(
+    run: Callable[[], T],
+    succeeded: Callable[[T], bool],
+    attempts: int = DEFAULT_ATTEMPTS,
+) -> T:
+    """Run ``run()`` up to ``attempts`` times until ``succeeded(result)``.
+
+    ``run`` performs one full driver pass (it may raise — exceptions
+    propagate immediately, only the zero-requests flake signature is
+    retried); ``succeeded`` classifies its result. The LAST result is
+    returned either way so callers assert on it and fail with the real
+    evidence when every attempt came up empty.
+    """
+    if attempts < 1:
+        raise ValueError("attempts must be >= 1")
+    result = run()
+    for _ in range(attempts - 1):
+        if succeeded(result):
+            break
+        result = run()
+    return result

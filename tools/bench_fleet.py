@@ -14,8 +14,14 @@ For each policy the driver reports aggregate infer/sec AND the fleet
 report's skew verdict (every replica's /metrics scraped and merged, the
 same path ``--metrics-url a,b,c`` takes in the harness).
 
+The row also measures the PR-16 router tier: the same fleet behind one
+``python -m client_tpu.router --serve`` front door, reported as
+``router_infer_per_sec`` plus ``proxy_tax_ratio`` (best direct policy ÷
+through-router — the cost of the extra hop).
+
 Prints ONE JSON line; bench.py embeds it as the ``fleet`` row and
-``tools/bench_trajectory.py`` guards ``fleet.best_infer_per_sec``.
+``tools/bench_trajectory.py`` guards ``fleet.best_infer_per_sec`` and
+gates ``fleet.proxy_tax_ratio``.
 """
 
 import asyncio
@@ -24,12 +30,15 @@ import os
 import signal
 import subprocess
 import sys
+import tempfile
 import time
 from typing import Dict, List, Optional
 
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
+
+from client_tpu.perf.fleet_runner import read_ports_file  # noqa: E402
 
 STEP_MS = float(os.environ.get("BENCH_FLEET_STEP_MS", "40"))
 MAX_BATCH = int(os.environ.get("BENCH_FLEET_BATCH", "4"))
@@ -41,10 +50,29 @@ FLEET_SIZE = int(os.environ.get("BENCH_FLEET_SIZE", "3"))
 POLICIES = ("round_robin", "least_outstanding", "p2c", "consistent_hash")
 
 
+def _await_ports_file(proc, path: str, wait_s: float = 30.0) -> Dict:
+    """Poll ``path`` until the serving subprocess writes its ports JSON
+    (atomic rename, so a read never sees a partial file). Dies fast if
+    the process exits first instead of burning the full wait."""
+    deadline = time.monotonic() + wait_s
+    while time.monotonic() < deadline:
+        ports = read_ports_file(path)
+        if ports is not None:
+            return ports
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"serving subprocess exited rc={proc.returncode} before "
+                f"writing {path}"
+            )
+        time.sleep(0.05)
+    raise RuntimeError(f"no ports file at {path} after {wait_s:g}s")
+
+
 class Replica:
     """One subprocess replica (own interpreter, own cores)."""
 
-    def __init__(self):
+    def __init__(self, ports_dir: str, index: int):
+        self.ports_file = os.path.join(ports_dir, f"replica{index}.json")
         self.proc = subprocess.Popen(
             [
                 sys.executable,
@@ -54,29 +82,16 @@ class Replica:
                 "--no-builtin-models",
                 "--device-sim",
                 f"{STEP_MS:g}:{MAX_BATCH}",
+                "--ports-file",
+                self.ports_file,
             ],
-            stdout=subprocess.PIPE,
+            stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL,
-            text=True,
         )
-        # scan for the ports line rather than trusting line 1: an
-        # imported library's stray stdout notice must not kill the row
-        ports = None
-        for _ in range(50):
-            line = self.proc.stdout.readline()
-            if not line:
-                break
-            line = line.strip()
-            if line.startswith("{"):
-                try:
-                    candidate = json.loads(line)
-                except ValueError:
-                    continue
-                if "http_port" in candidate and "grpc_port" in candidate:
-                    ports = candidate
-                    break
-        if ports is None:
-            raise RuntimeError("replica subprocess printed no ports line")
+        # the ports-file handoff (atomic rename) replaced stdout
+        # scanning: a library's stray stdout notice can't kill the row,
+        # and the router subprocess chains on the very same files
+        ports = _await_ports_file(self.proc, self.ports_file)
         self.http_port = ports["http_port"]
         self.grpc_port = ports["grpc_port"]
 
@@ -95,6 +110,31 @@ class Replica:
                 self.proc.wait(timeout=15)
             except subprocess.TimeoutExpired:
                 self.proc.kill()
+
+
+class Router(Replica):
+    """One router subprocess fronting the fleet (PR-16 front door),
+    discovered through the same ports-file handoff — the router chains
+    directly on the replicas' own ports files."""
+
+    def __init__(self, ports_dir: str, replicas: List[Replica]):
+        self.ports_file = os.path.join(ports_dir, "router.json")
+        argv = [
+            sys.executable,
+            "-m",
+            "client_tpu.router",
+            "--serve",
+            "--ports-file",
+            self.ports_file,
+        ]
+        for replica in replicas:
+            argv += ["--replica-ports-file", replica.ports_file]
+        self.proc = subprocess.Popen(
+            argv, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL
+        )
+        ports = _await_ports_file(self.proc, self.ports_file)
+        self.http_port = ports["http_port"]
+        self.grpc_port = ports["grpc_port"]
 
 
 async def _drive(
@@ -185,26 +225,41 @@ def main() -> int:
         ),
         "replicas": FLEET_SIZE,
     }
+    router: Optional[Router] = None
     try:
-        for _ in range(FLEET_SIZE):
-            replicas.append(Replica())
-        single = asyncio.run(_drive([replicas[0].grpc_url], None))
-        result["n1_infer_per_sec"] = single["infer_per_sec"]
-        urls = [replica.grpc_url for replica in replicas]
-        metrics_urls = [replica.http_url for replica in replicas]
-        policies: Dict[str, Dict] = {}
-        best = 0.0
-        for policy in POLICIES:
-            row = asyncio.run(_drive(urls, policy, metrics_urls))
-            policies[policy] = row
-            best = max(best, row["infer_per_sec"])
-        result["policies"] = policies
-        result["best_infer_per_sec"] = round(best, 2)
-        if single["infer_per_sec"] > 0:
-            result["scale_vs_n1"] = round(best / single["infer_per_sec"], 2)
+        with tempfile.TemporaryDirectory(prefix="bench_fleet_") as ports_dir:
+            for index in range(FLEET_SIZE):
+                replicas.append(Replica(ports_dir, index))
+            single = asyncio.run(_drive([replicas[0].grpc_url], None))
+            result["n1_infer_per_sec"] = single["infer_per_sec"]
+            urls = [replica.grpc_url for replica in replicas]
+            metrics_urls = [replica.http_url for replica in replicas]
+            policies: Dict[str, Dict] = {}
+            best = 0.0
+            for policy in POLICIES:
+                row = asyncio.run(_drive(urls, policy, metrics_urls))
+                policies[policy] = row
+                best = max(best, row["infer_per_sec"])
+            result["policies"] = policies
+            result["best_infer_per_sec"] = round(best, 2)
+            if single["infer_per_sec"] > 0:
+                result["scale_vs_n1"] = round(
+                    best / single["infer_per_sec"], 2
+                )
+            # router-vs-direct: the same fleet through the one-address
+            # front door; the tax is the proxy hop's throughput cost
+            router = Router(ports_dir, replicas)
+            through = asyncio.run(_drive([router.grpc_url], None))
+            result["router_infer_per_sec"] = through["infer_per_sec"]
+            if through["infer_per_sec"] > 0:
+                result["proxy_tax_ratio"] = round(
+                    best / through["infer_per_sec"], 2
+                )
     except Exception as e:  # noqa: BLE001 - the row is best-effort
         result = {"error": f"{type(e).__name__}: {e}"}
     finally:
+        if router is not None:
+            router.stop()
         for replica in replicas:
             replica.stop()
     print(json.dumps(result))

@@ -30,6 +30,11 @@ END_MARK = "<!-- bench-trajectory:end -->"
 
 DEFAULT_THRESHOLD = 0.10
 
+# BENCH_r16+: absolute ceiling on fleet.proxy_tax_ratio (direct ÷
+# through-router throughput). Measured ~1.0x on this host; 2.5x means
+# the router went from splicing bytes to doing real per-request work.
+PROXY_TAX_CEILING = 2.5
+
 
 def _repo_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -76,15 +81,15 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
     lines = [
         "| run | infer/sec | p50 (us) | ratio_vs_inproc | server CPU "
         "(us/req) | dominant stage | rolling p99 (us) | llm tok/s | "
-        "sharded inf/s | fleet inf/s | kernel tok/s | prefix hit | "
-        "spec tok/step |",
-        "|---|---|---|---|---|---|---|---|---|---|---|---|---|",
+        "sharded inf/s | fleet inf/s | proxy tax | kernel tok/s | "
+        "prefix hit | spec tok/step |",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for run in runs:
         parsed = run["parsed"]
         if parsed is None:
             lines.append(
-                f"| r{run['run']:02d} | (bench failed) | | | | | | | | | | | |"
+                f"| r{run['run']:02d} | (bench failed) | | | | | | | | | | | | |"
             )
             continue
 
@@ -117,6 +122,14 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
             f"{fleet['best_infer_per_sec']:.1f}"
             if isinstance(fleet, dict)
             and isinstance(fleet.get("best_infer_per_sec"), (int, float))
+            else "-"
+        )
+        # BENCH_r16+: the router tier's proxy tax (best direct policy ÷
+        # through-router aggregate; 1.0 = the front door is free)
+        tax_s = (
+            f"{fleet['proxy_tax_ratio']:.2f}x"
+            if isinstance(fleet, dict)
+            and isinstance(fleet.get("proxy_tax_ratio"), (int, float))
             else "-"
         )
         # BENCH_r13+: the fused ragged paged-attention decode microbench
@@ -161,6 +174,7 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
             f"| {tok_s} "
             f"| {sharded_s} "
             f"| {fleet_s} "
+            f"| {tax_s} "
             f"| {kernel_s} "
             f"| {hit_s} "
             f"| {spec_s} |"
@@ -193,6 +207,10 @@ def check_regression(
       * ``fleet.best_infer_per_sec`` (BENCH_r12+) — the fleet row runs
         one harness family (python grpc.aio over subprocess replicas),
         so within-family comparison is automatic;
+      * ``fleet.router_infer_per_sec`` (BENCH_r16+) — the same fleet
+        through the router subprocess, plus an absolute ceiling on
+        ``fleet.proxy_tax_ratio`` (the front door may never cost more
+        than ``PROXY_TAX_CEILING`` of the direct fleet's throughput);
       * ``llm_generate.speculation.tokens_per_step`` (BENCH_r14+) —
         floored at 1.0 (speculation may never lose to the plain engine
         it wraps).
@@ -267,6 +285,30 @@ def check_regression(
             is not None
         ],
     )
+    # BENCH_r16+: the router tier. Relative guard on through-router
+    # throughput (same harness family as the fleet row) plus an absolute
+    # ceiling on the proxy tax — a hop that costs more than 2.5x of the
+    # direct fleet means the splice/mux fast path regressed to
+    # re-serialization territory regardless of what prior runs recorded.
+    _guard(
+        "router",
+        "infer/sec",
+        _nested(latest, "fleet", "router_infer_per_sec"),
+        [
+            (r["run"], _nested(r["parsed"], "fleet", "router_infer_per_sec"))
+            for r in ok[:-1]
+            if _nested(r["parsed"], "fleet", "router_infer_per_sec")
+            is not None
+        ],
+    )
+    proxy_tax = _nested(latest, "fleet", "proxy_tax_ratio")
+    if proxy_tax is not None and proxy_tax > PROXY_TAX_CEILING:
+        problems.append(
+            f"proxy tax ceiling: r{latest_run:02d} routed the fleet at "
+            f"{proxy_tax:.2f}x the through-router cost (ceiling "
+            f"{PROXY_TAX_CEILING:.1f}x) — the router's raw-bytes forward "
+            f"path is no longer cheap"
+        )
     # BENCH_r13+: the kernel microbench (in-process jitted decode step,
     # one harness family by construction) and two absolute floors — the
     # fused kernel must not lose to the stand-in it replaced, and the

@@ -32,6 +32,9 @@ TARGET_DIRS = (
     # reads its injected clock_ns only
     os.path.join("client_tpu", "parallel"),
     os.path.join("client_tpu", "resilience"),
+    # PR-16 router tier: proxy latency, probe cadence, and admission
+    # hints all run on the injected pool clock — fake-clock testable
+    os.path.join("client_tpu", "router"),
     os.path.join("client_tpu", "scheduling"),
 )
 

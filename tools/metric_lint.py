@@ -48,6 +48,11 @@ TARGET_FILES = (
     os.path.join("client_tpu", "server", "_grpc_codec.py"),
 )
 
+# whole packages whose every module is linted (the router tier owns its
+# own MetricsRegistry — its /metrics surface follows the same
+# conventions as the server's)
+TARGET_DIRS = (os.path.join("client_tpu", "router"),)
+
 FAMILY_CONSTRUCTORS = frozenset({"Counter", "Gauge", "Histogram"})
 
 NAME_PATTERN = re.compile(r"^tpu_[a-z0-9_]+$")
@@ -195,6 +200,20 @@ def run_metric_lint(repo_root: str = None) -> List[str]:
             source = f.read()
         for lineno, message in check_source(source, path):
             problems.append(f"{target}:{lineno}: {message}")
+    for target in TARGET_DIRS:
+        base = os.path.join(root, target)
+        for dirpath, _dirs, files in os.walk(base):
+            if "__pycache__" in dirpath:
+                continue
+            for name in sorted(files):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+                for lineno, message in check_source(source, path):
+                    rel = os.path.relpath(path, root)
+                    problems.append(f"{rel}:{lineno}: {message}")
     return problems
 
 
