@@ -852,6 +852,70 @@ def _bench_sharded() -> dict:
     return best
 
 
+def _bench_pod() -> dict:
+    """The pod-scale serving row (ROADMAP item 1 / BENCH_r19+): a
+    2-process fake pod (coordinator + worker over jax.distributed, each
+    capped to 2 virtual CPU devices) serving the tp=4 tiny llama vs a
+    1-process unsharded oracle of the same model — tok/s, infer/sec,
+    greedy token parity, and the per-process duty split
+    (tools/bench_pod.py). Subprocess-launched like the sharded row: the
+    pod members must own their device caps from first backend init.
+    Best of two passes (the row spawns 3 jax processes and is at least
+    as scheduler-noisy as the sharded row). Never raises; failures
+    degrade to {} so the headline is never lost."""
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools",
+        "bench_pod.py",
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # the parent (oracle) side runs single-device; the pod members get
+    # their own 2-device caps from PodLauncher
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+    def one_pass() -> dict:
+        try:
+            out = subprocess.run(
+                [sys.executable, script],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=600,
+            )
+            for line in out.stdout.splitlines():
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        row = json.loads(line)
+                    except ValueError:
+                        continue  # stray non-JSON brace line, keep going
+                    if "tokens_per_sec" not in row and "error" not in row:
+                        continue  # structured-log line, not the row
+                    if "error" in row:
+                        print(
+                            f"bench: pod row failed: {row['error']}",
+                            file=sys.stderr,
+                        )
+                        return {}
+                    return row
+            print(
+                f"bench: pod row produced no JSON (rc {out.returncode})",
+                file=sys.stderr,
+            )
+        except Exception as e:  # noqa: BLE001 - row is best-effort
+            print(f"bench: pod row failed: {e}", file=sys.stderr)
+        return {}
+
+    best: dict = {}
+    for _ in range(2):
+        row = one_pass()
+        if row and (
+            not best or row["tokens_per_sec"] > best["tokens_per_sec"]
+        ):
+            best = row
+    return best
+
+
 def _bench_fleet() -> dict:
     """The multi-replica scale-out row (ROADMAP item 1 / BENCH_r12+):
     N=3 subprocess replicas vs N=1 serving the accelerator-bound
@@ -1134,6 +1198,11 @@ def main() -> int:
     # subprocesses + a driver want the whole host).
     fleet = {} if os.environ.get("BENCH_NO_FLEET") else _bench_fleet()
 
+    # Pod serving row: a coordinator/worker jax.distributed pair plus
+    # the in-process oracle — wants the whole host too, so it runs
+    # after the fleet row, never alongside it.
+    pod = {} if os.environ.get("BENCH_NO_POD") else _bench_pod()
+
     # Kernel microbench (BENCH_r13+): stand-in vs fused ragged
     # paged-attention decode + the prefix-sharing TTFT/blocks deltas.
     # In-process jax; runs after the servers so it owns the cores.
@@ -1254,6 +1323,8 @@ def main() -> int:
         line["sharded"] = sharded
     if fleet:
         line["fleet"] = fleet
+    if pod:
+        line["pod"] = pod
     # CPU attribution of the client/server split for the headline run
     # (PERF.md explains how this bounds ratio_vs_inproc on few-core hosts).
     count = result.get("count", 0)

@@ -397,8 +397,12 @@ class LlmEngine:
         paged cache exists for.
         """
         if self._closed:
+            # UNAVAILABLE: a closed engine (shutdown, device failure, or
+            # a lost pod worker) is a retryable replica-level condition —
+            # the fleet's failover machinery routes around it
             raise InferenceServerException(
-                f"llm engine for '{self.model_name}' is closed"
+                f"llm engine for '{self.model_name}' is closed",
+                status="UNAVAILABLE",
             )
         parameters = parameters or {}
         config = self.config
@@ -659,8 +663,17 @@ class LlmEngine:
             if self.logger is not None:
                 self.logger.error("llm_engine_loop_failed", exc=e,
                                   model=self.model_name)
+            # preserve the inner status so a lost pod worker
+            # (UNAVAILABLE) stays retryable through the engine's
+            # fail-everything path instead of collapsing to a bare 500
+            status = (
+                e.status() if isinstance(e, InferenceServerException)
+                else None
+            )
             self._fail_all(
-                InferenceServerException(f"llm engine step failed: {e}")
+                InferenceServerException(
+                    f"llm engine step failed: {e}", status=status
+                )
             )
             # A failed device call may have consumed donated buffers (the
             # page pool is donated to the jitted step off-CPU), so the

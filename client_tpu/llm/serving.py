@@ -50,10 +50,21 @@ class LlmEngineModel(Model):
         speculation: Optional[Dict[str, Any]] = None,
         draft_config=None,
         draft_params=None,
+        tp: int = 1,
     ):
         from client_tpu.models import llama
 
         self.name = name
+        # tensor-parallel width: tp > 1 shards params and the paged KV
+        # pool over a "tp" mesh axis resolved against the GLOBAL device
+        # list — on a pod this is how one engine spans processes
+        self.tp = int(tp)
+        self.mesh_plan = None
+        # pod hook: wraps (prefill, decode, decode_multi) JUST BEFORE the
+        # engine is built — after the warmup probes, which every pod
+        # member must run unwrapped and in lockstep (the wrapper is where
+        # the coordinator broadcasts each step on the bus)
+        self.device_fn_wrapper = None
         if speculation is not None:
             self.speculation = dict(speculation)
         elif type(self).speculation is not None:
@@ -99,35 +110,73 @@ class LlmEngineModel(Model):
         prefix-gather bucket (bounded recompiles, one program per
         (suffix bucket, prefix bucket) pair). ``decode_multi`` (the
         speculative verify step; None when the model does not opt in)
-        rides the multi-query twin of the same attention kernel."""
+        rides the multi-query twin of the same attention kernel.
+
+        Under a tp mesh plan (``self.mesh_plan``) the same callables are
+        built sharded: host args are placed as REPLICATED global arrays
+        (on a pod, ``jax.device_put`` cannot reach other processes'
+        devices — ``place_global`` can), logits are pinned replicated so
+        every process can read them locally, and the page pool keeps its
+        kv-head sharding end to end."""
         import jax
 
         from client_tpu.models import llama
 
-        donate_kw = {"donate_argnums": (2,)} if donate else {}
+        plan = self.mesh_plan
+        jit_out = {}
+        rep = None
+        if plan is not None:
+            from client_tpu.parallel import TP_AXIS
+
+            rep = plan.replicated()
+            pages_sharding = plan.sharding(None, None, TP_AXIS, None)
+            jit_out = {"out_shardings": (rep, pages_sharding)}
+
+        def _host(value, dtype=np.int32):
+            array = np.asarray(value, dtype=dtype)
+            if plan is None:
+                return array
+            from client_tpu.parallel.executor import place_global
+
+            return place_global(array, rep)
+
+        # params ride as an explicit jit argument (not a closure): a
+        # process-spanning param pytree cannot be closed over — jax
+        # forbids baking non-addressable arrays into the jaxpr as
+        # constants — and the argument form is identical for the
+        # single-process case
+        donate_kw = {"donate_argnums": (3,)} if donate else {}
         prefill_full = jax.jit(
-            lambda tokens, page_table, pages, last_index: (
+            lambda params_, tokens, page_table, pages, last_index: (
                 llama.prefill_into_pages(
-                    params, tokens, page_table, pages, last_index, config
+                    params_, tokens, page_table, pages, last_index, config
                 )
             ),
             **donate_kw,
+            **jit_out,
         )
         prefill_suffix = jax.jit(
-            lambda tokens, page_table, pages, last_index, start_index, prefix_blocks: (  # noqa: E501
+            lambda params_, tokens, page_table, pages, last_index, start_index, prefix_blocks: (  # noqa: E501
                 llama.prefill_suffix_into_pages(
-                    params, tokens, page_table, pages, last_index,
+                    params_, tokens, page_table, pages, last_index,
                     start_index, prefix_blocks, config,
                 )
             ),
-            static_argnums=(5,),
+            static_argnums=(6,),
             **donate_kw,
+            **jit_out,
         )
         block_size = engine_config.block_size
 
         def prefill(tokens, page_table, pages, last_index, start_index):
+            tokens = _host(tokens)
+            page_table = _host(page_table)
+            last = (
+                _host(np.int32(last_index)) if plan is not None
+                else last_index
+            )
             if not start_index:
-                return prefill_full(tokens, page_table, pages, last_index)
+                return prefill_full(params, tokens, page_table, pages, last)
             from client_tpu.llm.engine import block_bucket
 
             needed = start_index // block_size
@@ -135,43 +184,114 @@ class LlmEngineModel(Model):
                 block_bucket(needed), engine_config.max_blocks_per_seq
             )
             return prefill_suffix(
-                tokens, page_table, pages, last_index,
-                np.int32(start_index), prefix_blocks,
+                params, tokens, page_table, pages, last,
+                _host(np.int32(start_index)), prefix_blocks,
             )
 
-        donate_kw = {"donate_argnums": (3,)} if donate else {}
+        donate_kw = {"donate_argnums": (4,)} if donate else {}
         if attn is None:
-            decode = jax.jit(
-                lambda tokens, positions, page_tables, pages: (
+            decode_jit = jax.jit(
+                lambda params_, tokens, positions, page_tables, pages: (
                     llama.decode_step_paged(
-                        params, tokens, positions, page_tables, pages, config
+                        params_, tokens, positions, page_tables, pages, config
                     )
                 ),
                 **donate_kw,
+                **jit_out,
             )
         else:
-            decode = jax.jit(
-                lambda tokens, positions, page_tables, pages: (
+            decode_jit = jax.jit(
+                lambda params_, tokens, positions, page_tables, pages: (
                     llama.decode_step_paged_attn(
-                        params, tokens, positions, page_tables, pages,
+                        params_, tokens, positions, page_tables, pages,
                         config, attn,
                     )
                 ),
                 **donate_kw,
+                **jit_out,
             )
+
+        def decode(tokens, positions, page_tables, pages):
+            return decode_jit(
+                params, _host(tokens), _host(positions),
+                _host(page_tables), pages,
+            )
+
         decode_multi = None
         if attn_mq is not None:
-            donate_kw = {"donate_argnums": (4,)} if donate else {}
-            decode_multi = jax.jit(
-                lambda tokens, positions, lengths, page_tables, pages: (
+            donate_kw = {"donate_argnums": (5,)} if donate else {}
+            decode_multi_jit = jax.jit(
+                lambda params_, tokens, positions, lengths, page_tables, pages: (  # noqa: E501
                     llama.decode_step_paged_multi(
-                        params, tokens, positions, lengths, page_tables,
+                        params_, tokens, positions, lengths, page_tables,
                         pages, config, attn_mq,
                     )
                 ),
                 **donate_kw,
+                **jit_out,
             )
+
+            def decode_multi(tokens, positions, lengths, page_tables, pages):
+                return decode_multi_jit(
+                    params, _host(tokens), _host(positions), _host(lengths),
+                    _host(page_tables), pages,
+                )
+
         return prefill, decode, decode_multi
+
+    def _resolve_tp_plan(self, config):
+        """Validate + resolve the ``{"tp": N}`` mesh for this model.
+        Raises :class:`InferenceServerException` (a load failure) when
+        the head counts don't divide or the devices aren't there."""
+        from client_tpu.parallel import TP_AXIS, sharding as mesh_sharding
+
+        if config.n_heads % self.tp or config.n_kv_heads % self.tp:
+            raise InferenceServerException(
+                f"tp={self.tp} must divide n_heads={config.n_heads} and "
+                f"n_kv_heads={config.n_kv_heads}"
+            )
+        try:
+            spec = mesh_sharding.MeshSpec.parse({"axes": {TP_AXIS: self.tp}})
+            return mesh_sharding.resolve(spec)
+        except (
+            mesh_sharding.MeshDeclarationError,
+            mesh_sharding.MeshUnavailableError,
+        ) as e:
+            raise InferenceServerException(str(e)) from e
+
+    def _shard_params(self, params, config, plan):
+        """Place the param pytree onto the tp mesh per
+        ``llama.param_specs`` (global placement: works whether or not
+        the mesh spans processes)."""
+        import jax
+        from jax.sharding import PartitionSpec
+
+        from client_tpu.models import llama
+        from client_tpu.parallel.executor import place_global
+
+        shardings = jax.tree_util.tree_map(
+            lambda entries: plan.sharding(*entries),
+            llama.param_specs(config),
+            is_leaf=lambda node: isinstance(node, PartitionSpec),
+        )
+        return jax.tree_util.tree_map(
+            lambda leaf, sharding: place_global(np.asarray(leaf), sharding),
+            params,
+            shardings,
+        )
+
+    def _shard_pages(self, pages, plan):
+        """Shard every layer's (k_pages, v_pages) pool on the kv-head
+        axis — the tp partitioning of the paged cache itself."""
+        import jax
+
+        from client_tpu.parallel import TP_AXIS
+        from client_tpu.parallel.executor import place_global
+
+        sharding = plan.sharding(None, None, TP_AXIS, None)
+        return jax.tree_util.tree_map(
+            lambda pool: place_global(np.asarray(pool), sharding), pages
+        )
 
     def warmup(self) -> None:
         import jax
@@ -183,6 +303,17 @@ class LlmEngineModel(Model):
             self._params = llama.init_params(jax.random.PRNGKey(0), config)
         engine_config = self.engine_config
         params = self._params
+        plan = None
+        if self.tp > 1:
+            # resolve the tp mesh against the GLOBAL device list (on a
+            # pod that is every member's devices) and shard the params
+            # along llama.param_specs; failures here are load failures
+            # with operator-grade reasons, never a 500 at first infer
+            plan = self._resolve_tp_plan(config)
+            self.mesh_plan = plan
+            params = self._shard_params(params, config, plan)
+        else:
+            self.mesh_plan = None
 
         # Buffer donation lets XLA update the block pool in place (the
         # pool is the whole point — ONE physical cache, not a copy per
@@ -218,6 +349,17 @@ class LlmEngineModel(Model):
                 if self.speculation is not None
                 else None
             )
+            # under tp the kernel runs per-shard via shard_map (GSPMD
+            # cannot partition a pallas_call; for the XLA variants the
+            # wrap pins the no-communication head partitioning). The
+            # standin path (attn=None, inline attention) is left to
+            # GSPMD propagation — it is plain XLA throughout.
+            if plan is not None and attn is not None:
+                attn = paged_attention.make_tp_attention(attn, plan.mesh)
+            if plan is not None and attn_mq is not None:
+                attn_mq = paged_attention.make_tp_attention(
+                    attn_mq, plan.mesh, multi_query=True
+                )
             try:
                 prefill, decode, decode_multi = self._build_device_fns(
                     params, config, engine_config, attn, attn_mq, donate
@@ -227,6 +369,8 @@ class LlmEngineModel(Model):
                 pages = llama.init_kv_pages(
                     config, engine_config.num_blocks, engine_config.block_size
                 )
+                if plan is not None:
+                    pages = self._shard_pages(pages, plan)
                 # probe the shapes the engine actually serves (page
                 # table all-zeros = every write lands in the reserved
                 # trash block): full prefill at the smallest bucket, the
@@ -301,6 +445,17 @@ class LlmEngineModel(Model):
                 draft_params=draft_params,
                 draft_config=draft_config,
             )
+        # followers (pod workers) drive these directly off the bus; the
+        # tuple is captured BEFORE any wrapper so a worker's handlers
+        # never re-broadcast
+        self._device_fns = (prefill, decode, decode_multi)
+        if self.device_fn_wrapper is not None:
+            # pod coordinator hook: wrap AFTER the probes (which every
+            # member ran unwrapped, in lockstep) so only real engine
+            # steps ride the bus
+            prefill, decode, decode_multi = self.device_fn_wrapper(
+                prefill, decode, decode_multi
+            )
         # a reload replaces the engine wholesale: fresh pool, clean
         # accounting (the old engine's streams were drained by the
         # lifecycle layer before the swap)
@@ -337,6 +492,7 @@ class LlmEngineModel(Model):
         parameters["decode_kernel"] = {
             "string_value": self.decode_kernel or "uninitialized"
         }
+        parameters["tp"] = {"string_value": str(self.tp)}
         parameters["prefix_sharing"] = {
             "string_value": (
                 "cow" if self.engine_config.prefix_sharing else "off"

@@ -443,6 +443,47 @@ def get_attention_impl_mq(name: str) -> Callable:
         ) from None
 
 
+def make_tp_attention(
+    attn: Callable, mesh, tp_axis: str = "tp", multi_query: bool = False
+) -> Callable:
+    """Wrap an attention impl so it runs per-shard under a ``tp`` mesh.
+
+    Tensor-parallel paged decode shards BOTH q (on the query-head axis)
+    and the K/V page pools (on the kv-head axis) over ``tp_axis``. Every
+    impl's math is already self-contained per kv-head group — the group
+    size ``n_rep = H/KV`` is preserved under an even head split — so the
+    per-shard call needs no collectives at all: shard ``i`` computes the
+    attention output for its own heads against its own page shard, and
+    the output stays head-sharded for the downstream (row-sharded) wo
+    projection.
+
+    The wrap exists because GSPMD cannot partition a ``pallas_call`` (it
+    would replicate the whole pool per device); ``shard_map`` hands each
+    device its local block, which also pins the XLA variants to the
+    no-communication partitioning instead of trusting sharding
+    propagation to find it. Page tables and positions are replicated
+    (they index POOL ROWS, which are not sharded — the head axis is).
+    ``check_rep=False``: the impls are opaque to the replication checker.
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec
+
+    q_spec = (
+        PartitionSpec(None, None, tp_axis, None)
+        if multi_query
+        else PartitionSpec(None, tp_axis, None)
+    )
+    pages_spec = PartitionSpec(None, None, tp_axis, None)
+    replicated = PartitionSpec()
+    return shard_map(
+        attn,
+        mesh=mesh,
+        in_specs=(q_spec, pages_spec, pages_spec, replicated, replicated),
+        out_specs=q_spec,
+        check_rep=False,
+    )
+
+
 def resolve_decode_attention(
     requested: Optional[str], platform: str
 ) -> Tuple[str, Callable]:

@@ -34,6 +34,37 @@ import numpy as np
 from client_tpu.parallel.sharding import MeshPlan
 
 
+def place_global(array: Any, sharding: Any) -> Any:
+    """Place a host array onto a sharding that may span processes.
+
+    ``jax.device_put`` only accepts fully-addressable shardings; on a
+    process-spanning mesh each process instead builds the global array
+    from the shards it owns (``make_array_from_callback`` — every pod
+    member calls this with the SAME host value, which is exactly the
+    lockstep contract the step bus enforces)."""
+    import jax
+
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(array, sharding)
+    array = np.asarray(array)
+    return jax.make_array_from_callback(
+        array.shape, sharding, lambda index: array[index]
+    )
+
+
+def gather_global(value: Any) -> np.ndarray:
+    """Read a device array back to host numpy, whether or not every
+    shard is addressable from this process. Non-addressable arrays ride
+    ``process_allgather`` (a collective — every pod member must call)."""
+    import jax
+
+    if getattr(value, "is_fully_addressable", True):
+        return np.asarray(jax.device_get(value))
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(value, tiled=True))
+
+
 class ShardedExecutor:
     """Runs ``fn`` (a dict->dict jitted callable) under a resolved
     :class:`~client_tpu.parallel.sharding.MeshPlan`.
@@ -70,8 +101,6 @@ class ShardedExecutor:
     def _place(self, inputs: Dict[str, np.ndarray]) -> Dict[str, Any]:
         """device_put every input onto its declared sharding (replicated
         when undeclared), padding batch dims to the mesh multiple."""
-        import jax
-
         plan = self.plan
         placed: Dict[str, Any] = {}
         replicated = None
@@ -93,7 +122,7 @@ class ShardedExecutor:
                             ),
                         ]
                     )
-            placed[name] = jax.device_put(array, sharding)
+            placed[name] = place_global(array, sharding)
         return placed
 
     # -- execution ----------------------------------------------------------
@@ -118,10 +147,9 @@ class ShardedExecutor:
             raw = self._fn(placed)
         raw = jax.block_until_ready(raw)
         t2 = self._clock_ns()
-        host = jax.device_get(raw)
         outputs: Dict[str, np.ndarray] = {}
-        for name, value in host.items():
-            array = np.asarray(value)
+        for name, value in raw.items():
+            array = gather_global(value)
             if (
                 rows
                 and array.ndim

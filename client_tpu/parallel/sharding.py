@@ -150,6 +150,17 @@ class MeshPlan:
     devices: Tuple[Any, ...]  # the jax devices backing the mesh
     input_shardings: Dict[str, Any]  # name -> NamedSharding
     output_shardings: Dict[str, Any]
+    #: pod topology: how many OS processes the mesh's devices span (1 for
+    #: every pre-pod mesh) and how many of its devices this process holds
+    process_count: int = 1
+    local_device_count: int = -1  # -1: single-process, all devices local
+
+    @property
+    def spans_processes(self) -> bool:
+        """True when the mesh crosses process boundaries — collectives
+        ride jax.distributed and per-process shards are non-addressable
+        from any one member."""
+        return self.process_count > 1
 
     @property
     def device_labels(self) -> Tuple[str, ...]:
@@ -191,10 +202,16 @@ class MeshPlan:
                 for entry in spec
             ]
 
+        local = self.local_device_count
+        if local < 0:
+            local = len(self.devices)
         return {
             "axes": {name: size for name, size in self.spec.axes},
             "device_count": len(self.devices),
             "devices": [d.id for d in self.devices],
+            "process_count": self.process_count,
+            "local_device_count": local,
+            "spans_processes": self.spans_processes,
             "inputs": {
                 name: _spec_doc(spec)
                 for name, spec in self.spec.inputs.items()
@@ -215,12 +232,23 @@ def resolve(spec: MeshSpec, devices: Optional[Sequence] = None) -> MeshPlan:
     from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
     if devices is None:
-        devices = jax.devices()
+        devices = jax.devices()  # GLOBAL device list under jax.distributed
     needed = spec.device_count
     if len(devices) < needed:
-        raise MeshUnavailableError(
-            f"mesh requires {needed} devices, host has {len(devices)}"
-        )
+        # canonical single-process reason (pinned by tests/operators);
+        # pod members append their topology so "host has 2" is readable
+        # as "2 of the pod's devices live here"
+        msg = f"mesh requires {needed} devices, host has {len(devices)}"
+        try:
+            process_count = int(jax.process_count())
+        except Exception:  # noqa: BLE001 - backend not initialized
+            process_count = 1
+        if process_count > 1:
+            msg += (
+                f" (pod of {process_count} processes, "
+                f"{len(jax.local_devices())} devices local to this one)"
+            )
+        raise MeshUnavailableError(msg)
     used = tuple(devices[:needed])
     names = tuple(name for name, _size in spec.axes)
     sizes = tuple(size for _name, size in spec.axes)
@@ -229,6 +257,16 @@ def resolve(spec: MeshSpec, devices: Optional[Sequence] = None) -> MeshPlan:
     def _sharding(entries: Tuple[SpecEntry, ...]) -> NamedSharding:
         return NamedSharding(mesh, PartitionSpec(*entries))
 
+    # pod topology of the devices actually used: a mesh spans processes
+    # exactly when its device slice does, regardless of the host's total
+    try:
+        this_process = int(jax.process_index())
+    except Exception:  # noqa: BLE001 - backend not initialized
+        this_process = 0
+    owners = {getattr(d, "process_index", 0) for d in used}
+    local_count = sum(
+        1 for d in used if getattr(d, "process_index", 0) == this_process
+    )
     return MeshPlan(
         spec=spec,
         mesh=mesh,
@@ -239,6 +277,8 @@ def resolve(spec: MeshSpec, devices: Optional[Sequence] = None) -> MeshPlan:
         output_shardings={
             name: _sharding(entries) for name, entries in spec.outputs.items()
         },
+        process_count=max(1, len(owners)),
+        local_device_count=local_count,
     )
 
 

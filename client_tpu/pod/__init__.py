@@ -1,0 +1,55 @@
+"""Pod-scale serving: the multi-process mesh runtime (ROADMAP item 1).
+
+Every mesh in the repo used to live inside one process. This package is
+the coordinator/worker runtime that spans one ``jax.sharding.Mesh``
+across N processes, so a model too large for any single process's
+devices serves as ONE replica:
+
+- :mod:`client_tpu.pod.runtime` — ``PodConfig``/``initialize``: the
+  ``jax.distributed`` bootstrap (coordinator address + process
+  index/count), CPU fake-pod collectives (gloo) included;
+- :mod:`client_tpu.pod.launcher` — ``PodLauncher``: spawns the N
+  processes and hands each its pod identity via environment, mirroring
+  ``fleet_runner``'s subprocess machinery (ports-file handoff, SIGTERM
+  drain, SIGKILL chaos);
+- :mod:`client_tpu.pod.bus` — ``StepBus``/``StepFollower``: the
+  coordinator broadcasts every device-call descriptor to the workers so
+  all processes enter each SPMD computation in lockstep; a dead worker
+  surfaces as a retryable UNAVAILABLE at the next broadcast, never a
+  collective hang;
+- :mod:`client_tpu.pod.worker` — the serving entrypoint
+  (``python -m client_tpu.pod.worker``): process 0 serves gRPC/HTTP
+  front-ends over a tp-sharded :class:`~client_tpu.llm.serving.LlmEngineModel`,
+  processes 1..N-1 follow the bus.
+
+The sharding seam itself (process-spanning ``MeshPlan``, tp-sharded
+paged KV pool, ``shard_map``-wrapped attention kernels) lives where the
+single-process versions already live: ``client_tpu/parallel`` and the
+model/serving layers.
+"""
+
+from client_tpu.pod.bus import (  # noqa: F401
+    PodWorkerLostError,
+    StepBus,
+    StepFollower,
+)
+from client_tpu.pod.launcher import PodLauncher  # noqa: F401
+from client_tpu.pod.runtime import (  # noqa: F401
+    PodConfig,
+    PodConfigError,
+    PodRuntime,
+    initialize,
+    pod_info,
+)
+
+__all__ = [
+    "PodConfig",
+    "PodConfigError",
+    "PodRuntime",
+    "PodLauncher",
+    "PodWorkerLostError",
+    "StepBus",
+    "StepFollower",
+    "initialize",
+    "pod_info",
+]

@@ -1,0 +1,328 @@
+"""Step-dispatch bus: how the pod coordinator keeps workers in lockstep.
+
+Multi-process SPMD has one iron rule: every process must enter every
+collective-bearing computation, in the same order, with the same shapes.
+The serving front-ends run only on process 0, so the coordinator owns
+the request stream and BROADCASTS each device-call descriptor (op name +
+host-side args) to the workers before launching its own copy; each
+worker's follower loop executes the same call against its local shard
+state. The physical KV pool and the parameters never ride the bus —
+each process holds its own (identically initialized) shards; only the
+small per-step host arrays (token ids, positions, page tables) travel.
+
+The wire is a plain length-prefixed TCP frame (JSON header + raw array
+bytes) between processes the launcher spawned on one host — the
+jax.distributed coordination service underneath is already gRPC, and
+the serving traffic into the pod is gRPC; this bus is the thin dispatch
+lane between them.
+
+Failure semantics (the reason acks exist): workers ack RECEIPT of every
+descriptor before executing it. The coordinator requires all acks —
+with a bounded timeout — before entering the computation itself, so a
+dead worker surfaces as :class:`PodWorkerLostError` (a retryable
+UNAVAILABLE) at the broadcast, never as a gloo collective hanging on a
+peer that will never arrive. Acks carry the worker's cumulative
+device-busy nanoseconds, which is where the per-process duty split in
+the bench/fleet report comes from.
+"""
+
+import json
+import socket
+import struct
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from client_tpu.utils import InferenceServerException
+
+#: sentinel op the coordinator broadcasts at shutdown
+STOP_OP = "__stop__"
+
+_LEN = struct.Struct(">I")
+
+
+class PodWorkerLostError(InferenceServerException):
+    """A pod worker died or stopped acking: the pod cannot run its next
+    SPMD step. Retryable UNAVAILABLE — the fleet's retry/failover
+    machinery treats it like any dead replica."""
+
+    def __init__(self, msg: str):
+        super().__init__(msg, status="UNAVAILABLE")
+
+
+# ---------------------------------------------------------------------------
+# framing: [4-byte len][json header][raw array bytes...]
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    remaining = n
+    while remaining:
+        chunk = sock.recv(min(remaining, 1 << 20))
+        if not chunk:
+            raise ConnectionError("bus peer closed the connection")
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return _recv_exact(sock, length)
+
+
+def encode_step(op: str, args: Tuple[Any, ...]) -> bytes:
+    """One step descriptor: op name + host args (numpy arrays and
+    scalars). Arrays travel as raw bytes after the JSON header."""
+    descriptors: List[Dict[str, Any]] = []
+    buffers: List[bytes] = []
+    for arg in args:
+        if arg is None:
+            descriptors.append({"kind": "none"})
+        elif isinstance(arg, (bool, np.bool_)):
+            descriptors.append({"kind": "bool", "value": bool(arg)})
+        elif isinstance(arg, (int, np.integer)):
+            descriptors.append({"kind": "int", "value": int(arg)})
+        elif isinstance(arg, (float, np.floating)):
+            descriptors.append({"kind": "float", "value": float(arg)})
+        elif isinstance(arg, str):
+            descriptors.append({"kind": "str", "value": arg})
+        else:
+            array = np.ascontiguousarray(arg)
+            raw = array.tobytes()
+            descriptors.append(
+                {
+                    "kind": "array",
+                    "dtype": array.dtype.str,
+                    "shape": list(array.shape),
+                    "nbytes": len(raw),
+                }
+            )
+            buffers.append(raw)
+    header = json.dumps({"op": op, "args": descriptors}).encode("utf-8")
+    return _LEN.pack(len(header)) + header + b"".join(buffers)
+
+
+def decode_step(payload: bytes) -> Tuple[str, Tuple[Any, ...]]:
+    (header_len,) = _LEN.unpack(payload[: _LEN.size])
+    offset = _LEN.size + header_len
+    header = json.loads(payload[_LEN.size:offset].decode("utf-8"))
+    args: List[Any] = []
+    for descriptor in header["args"]:
+        kind = descriptor["kind"]
+        if kind == "none":
+            args.append(None)
+        elif kind in ("int", "float", "bool", "str"):
+            args.append(descriptor["value"])
+        else:
+            nbytes = descriptor["nbytes"]
+            array = np.frombuffer(
+                payload, dtype=np.dtype(descriptor["dtype"]),
+                count=int(np.prod(descriptor["shape"], dtype=np.int64)),
+                offset=offset,
+            ).reshape(descriptor["shape"])
+            offset += nbytes
+            args.append(array)
+    return header["op"], tuple(args)
+
+
+# ---------------------------------------------------------------------------
+# coordinator side
+
+
+class StepBus:
+    """Coordinator half: accept one connection per worker, broadcast
+    step descriptors, and require receipt acks before each SPMD launch.
+
+    ``clock`` is injectable per the repo's clock-lint rules; socket
+    deadlines use fixed ``settimeout`` values derived from it only for
+    accounting, never for control flow the tests cannot fake.
+    """
+
+    def __init__(
+        self,
+        num_workers: int,
+        address: Optional[str] = None,
+        ack_timeout_s: float = 20.0,
+        accept_timeout_s: float = 120.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.num_workers = num_workers
+        self.ack_timeout_s = ack_timeout_s
+        self.accept_timeout_s = accept_timeout_s
+        self._clock = clock
+        host, port = "127.0.0.1", 0
+        if address:
+            host, _, port_s = address.rpartition(":")
+            port = int(port_s)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(num_workers)
+        self._workers: Dict[int, socket.socket] = {}
+        self._busy_ns: Dict[int, int] = {}
+        self.steps = 0
+
+    @property
+    def address(self) -> str:
+        host, port = self._listener.getsockname()
+        return f"{host}:{port}"
+
+    def accept_workers(self) -> None:
+        """Block until every worker has connected and said hello (its
+        process index). Bounded by ``accept_timeout_s`` per worker."""
+        self._listener.settimeout(self.accept_timeout_s)
+        while len(self._workers) < self.num_workers:
+            try:
+                conn, _addr = self._listener.accept()
+            except socket.timeout:
+                raise PodWorkerLostError(
+                    f"pod bus: only {len(self._workers)}/{self.num_workers} "
+                    f"workers connected within {self.accept_timeout_s}s"
+                ) from None
+            conn.settimeout(self.ack_timeout_s)
+            hello = json.loads(_recv_frame(conn).decode("utf-8"))
+            index = int(hello["process_index"])
+            self._workers[index] = conn
+            self._busy_ns[index] = 0
+
+    def broadcast(self, op: str, args: Tuple[Any, ...] = ()) -> None:
+        """Send one step descriptor to every worker and collect receipt
+        acks. Raises :class:`PodWorkerLostError` — BEFORE the caller
+        enters the collective — when any worker is gone."""
+        payload = encode_step(op, args)
+        for index, conn in list(self._workers.items()):
+            try:
+                _send_frame(conn, payload)
+            except OSError as e:
+                self._drop(index)
+                raise PodWorkerLostError(
+                    f"pod worker {index} unreachable at step broadcast: {e}"
+                ) from e
+        for index, conn in list(self._workers.items()):
+            try:
+                ack = json.loads(_recv_frame(conn).decode("utf-8"))
+            except (OSError, ValueError, ConnectionError) as e:
+                self._drop(index)
+                raise PodWorkerLostError(
+                    f"pod worker {index} did not ack step '{op}': {e}"
+                ) from e
+            self._busy_ns[index] = int(ack.get("busy_ns", 0))
+        self.steps += 1
+
+    def _drop(self, index: int) -> None:
+        """Forget a dead worker (its socket closed) so
+        :meth:`alive_workers` — and the liveness gauges fed from it —
+        reflect the loss immediately."""
+        conn = self._workers.pop(index, None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def worker_busy_ns(self) -> Dict[int, int]:
+        """Cumulative device-busy nanoseconds per worker, as of each
+        worker's most recent ack (one step stale by construction)."""
+        return dict(self._busy_ns)
+
+    def alive_workers(self) -> List[int]:
+        return sorted(self._workers)
+
+    def stop(self) -> None:
+        """Best-effort shutdown broadcast, then close every socket."""
+        payload = encode_step(STOP_OP, ())
+        for conn in self._workers.values():
+            try:
+                _send_frame(conn, payload)
+            except OSError:
+                pass
+        for conn in self._workers.values():
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._workers.clear()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# worker side
+
+
+class StepFollower:
+    """Worker half: connect to the coordinator's bus, then execute every
+    broadcast step descriptor through the handler table, acking receipt
+    (with cumulative busy time) before each execution."""
+
+    def __init__(
+        self,
+        address: str,
+        process_index: int,
+        connect_timeout_s: float = 120.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.process_index = process_index
+        self._clock = clock
+        host, _, port_s = address.rpartition(":")
+        deadline = clock() + connect_timeout_s
+        last_error: Optional[Exception] = None
+        self._sock: Optional[socket.socket] = None
+        while clock() < deadline:
+            try:
+                self._sock = socket.create_connection(
+                    (host, int(port_s)), timeout=5.0
+                )
+                break
+            except OSError as e:
+                last_error = e
+                time.sleep(0.05)
+        if self._sock is None:
+            raise ConnectionError(
+                f"pod bus: worker {process_index} could not reach "
+                f"coordinator at {address}: {last_error}"
+            )
+        self._sock.settimeout(None)  # steps arrive whenever requests do
+        _send_frame(
+            self._sock,
+            json.dumps({"process_index": process_index}).encode("utf-8"),
+        )
+        self.busy_ns = 0
+        self.steps = 0
+
+    def follow(self, handlers: Dict[str, Callable[..., None]]) -> str:
+        """Run the follower loop until the coordinator broadcasts
+        ``__stop__`` or closes the connection. Returns the reason the
+        loop ended (``"stop"`` or ``"coordinator_gone"``)."""
+        while True:
+            try:
+                op, args = decode_step(_recv_frame(self._sock))
+            except (OSError, ConnectionError):
+                return "coordinator_gone"
+            ack = json.dumps({"busy_ns": self.busy_ns}).encode("utf-8")
+            try:
+                _send_frame(self._sock, ack)
+            except OSError:
+                return "coordinator_gone"
+            if op == STOP_OP:
+                return "stop"
+            t0 = self._clock()
+            handlers[op](*args)
+            self.busy_ns += int((self._clock() - t0) * 1e9)
+            self.steps += 1
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None

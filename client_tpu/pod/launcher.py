@@ -1,0 +1,190 @@
+"""Spawn the pod: N OS processes, one mesh.
+
+``PodLauncher`` mirrors the subprocess machinery ``fleet_runner`` uses
+for replica processes — free ports picked by binding port 0, identity
+handed to children via environment, readiness published through the
+atomic ports-file handoff, SIGTERM drain with a SIGKILL backstop — but
+where the fleet spawns N *independent* replicas, the launcher spawns N
+processes that assemble into ONE replica: every child gets the same
+coordinator address and process count, its own process index, and (for
+the CPU fake pod) an ``XLA_FLAGS`` device cap so no single process can
+hold the whole mesh. That cap is the point of the CI story: a 2-process
+launch serves a model that the per-process device budget makes
+unservable by either process alone.
+
+``kill(i)`` (SIGKILL, no warning) exists for the chaos tests: a worker
+killed mid-stream must surface at the coordinator as a retryable
+UNAVAILABLE via the step bus, never as a hung collective.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from client_tpu.perf.fleet_runner import read_ports_file
+from client_tpu.pod.runtime import PodConfig
+
+
+def _free_port(host: str = "127.0.0.1") -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+class PodLauncher:
+    """Spawn and supervise the pod's member processes.
+
+    By default each child runs ``python -m client_tpu.pod.worker`` (the
+    serving entrypoint); tests substitute their own module/argv to run
+    arbitrary lockstep programs under the same identity handoff.
+    """
+
+    def __init__(
+        self,
+        process_count: int = 2,
+        devices_per_process: int = 2,
+        module: str = "client_tpu.pod.worker",
+        extra_args: Sequence[str] = (),
+        env_extra: Optional[Dict[str, str]] = None,
+        with_bus: bool = True,
+        host: str = "127.0.0.1",
+        init_timeout_s: float = 60.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if process_count < 1:
+            raise ValueError(f"process_count must be >= 1, got {process_count}")
+        self.process_count = process_count
+        self.devices_per_process = devices_per_process
+        self.module = module
+        self.extra_args = list(extra_args)
+        self.env_extra = dict(env_extra or {})
+        self.host = host
+        self.init_timeout_s = init_timeout_s
+        self._clock = clock
+        self.coordinator_address = f"{host}:{_free_port(host)}"
+        self.bus_address = f"{host}:{_free_port(host)}" if with_bus else None
+        self._workdir = tempfile.mkdtemp(prefix="client_tpu_pod_")
+        self.ports_file = os.path.join(self._workdir, "pod_ports.json")
+        self.procs: List[subprocess.Popen] = []
+        self._logs: List[str] = []
+
+    def config_for(self, process_index: int) -> PodConfig:
+        return PodConfig(
+            coordinator_address=self.coordinator_address,
+            process_index=process_index,
+            process_count=self.process_count,
+            local_devices=self.devices_per_process,
+            bus_address=self.bus_address,
+            init_timeout_s=self.init_timeout_s,
+        )
+
+    def _child_env(self, process_index: int) -> Dict[str, str]:
+        env = dict(os.environ)
+        env.update(self.config_for(process_index).env())
+        # the fake pod runs on CPU with an artificial per-process device
+        # budget — the cap must be in place before the child's first jax
+        # backend touch, hence XLA_FLAGS rather than a runtime knob
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            "--xla_force_host_platform_device_count="
+            f"{self.devices_per_process}"
+        )
+        env["CLIENT_TPU_POD_PORTS_FILE"] = self.ports_file
+        # the worker module must import regardless of the parent's cwd
+        # (a caller in /tmp launches children that still need this repo
+        # on their path)
+        root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        path = env.get("PYTHONPATH", "")
+        if root not in path.split(os.pathsep):
+            env["PYTHONPATH"] = (
+                root + (os.pathsep + path if path else "")
+            )
+        env.update(self.env_extra)
+        return env
+
+    def launch(self) -> "PodLauncher":
+        argv = [sys.executable, "-m", self.module, *self.extra_args]
+        for index in range(self.process_count):
+            log_path = os.path.join(self._workdir, f"pod_proc{index}.log")
+            self._logs.append(log_path)
+            with open(log_path, "wb") as log:
+                proc = subprocess.Popen(
+                    argv,
+                    env=self._child_env(index),
+                    stdout=log,
+                    stderr=subprocess.STDOUT,
+                    cwd=os.getcwd(),
+                )
+            self.procs.append(proc)
+        return self
+
+    def wait_ready(self, timeout_s: float = 180.0) -> dict:
+        """Poll the ports file written by process 0 once its servers are
+        up; raises with the tail of every process log when the pod dies
+        or stalls instead."""
+        deadline = self._clock() + timeout_s
+        while self._clock() < deadline:
+            ports = read_ports_file(self.ports_file)
+            if ports is not None:
+                return ports
+            for index, proc in enumerate(self.procs):
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"pod process {index} exited rc={proc.returncode} "
+                        f"before the pod came up\n{self.log_tail()}"
+                    )
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"pod not ready within {timeout_s}s\n{self.log_tail()}"
+        )
+
+    def poll(self) -> List[Optional[int]]:
+        return [proc.poll() for proc in self.procs]
+
+    def kill(self, process_index: int) -> None:
+        """SIGKILL one member (chaos path) — no drain, no goodbye."""
+        proc = self.procs[process_index]
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    def stop(self, timeout_s: float = 30.0) -> List[Optional[int]]:
+        """SIGTERM everyone, wait, SIGKILL stragglers. Returns final
+        return codes."""
+        for proc in self.procs:
+            if proc.poll() is None:
+                try:
+                    proc.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        for proc in self.procs:
+            try:
+                proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+        return [proc.returncode for proc in self.procs]
+
+    def log_tail(self, chars: int = 2000) -> str:
+        """Last ``chars`` of every member's log — the evidence block the
+        tests attach to skips and failures."""
+        parts = []
+        for index, path in enumerate(self._logs):
+            try:
+                with open(path, "r", encoding="utf-8", errors="replace") as f:
+                    text = f.read()
+            except OSError:
+                text = "<no log>"
+            parts.append(f"--- pod proc {index} log tail ---\n{text[-chars:]}")
+        return "\n".join(parts)

@@ -1,0 +1,311 @@
+"""Pod serving entrypoint: ``python -m client_tpu.pod.worker``.
+
+Every pod member runs this module with its identity in the environment
+(the launcher's handoff). All members walk the SAME bootstrap in
+lockstep — join ``jax.distributed``, build one tp-sharded
+:class:`~client_tpu.llm.serving.LlmEngineModel` over the GLOBAL device
+list, run warmup (whose probe device calls are collectives every member
+must enter) — and then split:
+
+- **process 0 (coordinator)** opens the step bus, installs the
+  bus-broadcast ``device_fn_wrapper`` (each engine device call is
+  broadcast to the workers BEFORE the coordinator executes its own
+  copy), registers the model, and serves the ordinary HTTP/gRPC
+  front-ends. To the fleet this process IS the pod: one replica, one
+  model row, with per-member liveness/duty exported as
+  ``tpu_pod_process_up`` / ``tpu_pod_process_duty_ratio``.
+- **processes 1..N-1 (workers)** run the follower loop: execute every
+  broadcast step against their local shards and ack with cumulative
+  busy time. They serve no requests and export no metrics of their own.
+
+The model itself deliberately stays the repo's tiny llama (float32 so
+tp parity holds to 1e-5): the pod machinery is about WHERE the mesh
+lives, not model scale.
+"""
+
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from client_tpu.pod.bus import StepBus, StepFollower
+from client_tpu.pod.runtime import PodConfig, PodRuntime, initialize
+
+ENV_PORTS_FILE = "CLIENT_TPU_POD_PORTS_FILE"
+ENV_MODEL_NAME = "CLIENT_TPU_POD_MODEL_NAME"
+ENV_MAX_SEQ_LEN = "CLIENT_TPU_POD_MAX_SEQ_LEN"
+
+
+def build_model(runtime: PodRuntime):
+    """The pod's model: tiny llama (float32 for tp parity), tp spanning
+    the ENTIRE global mesh — which is what makes it unservable by any
+    one device-capped member alone."""
+    import jax.numpy as jnp
+
+    from client_tpu.llm.serving import LlmEngineModel
+    from client_tpu.models import llama
+
+    name = os.environ.get(ENV_MODEL_NAME, "llm_pod")
+    max_seq_len = int(os.environ.get(ENV_MAX_SEQ_LEN, "256"))
+    config = llama.LlamaConfig.tiny(
+        max_seq_len=max_seq_len, dtype=jnp.float32
+    )
+    return LlmEngineModel(
+        name, config=config, tp=runtime.global_device_count
+    )
+
+
+class _Duty:
+    """Coordinator-side busy-time accumulator (its own device calls —
+    workers report theirs through step acks)."""
+
+    def __init__(self, clock_ns: Callable[[], int] = time.monotonic_ns):
+        self._clock_ns = clock_ns
+        self.start_ns = clock_ns()
+        self.busy_ns = 0
+        self._lock = threading.Lock()
+
+    def add(self, ns: int) -> None:
+        with self._lock:
+            self.busy_ns += ns
+
+    def ratio(self) -> float:
+        wall = max(1, self._clock_ns() - self.start_ns)
+        with self._lock:
+            return self.busy_ns / wall
+
+
+def make_bus_wrapper(
+    bus: StepBus,
+    duty: _Duty,
+    clock_ns: Callable[[], int] = time.monotonic_ns,
+):
+    """The coordinator's ``device_fn_wrapper``: broadcast each step's
+    host args on the bus, then run the local copy. The broadcast-first
+    order is the no-hang guarantee — a dead worker raises a retryable
+    UNAVAILABLE here, before this process enters the collective."""
+    import jax
+
+    def wrapper(prefill, decode, decode_multi):
+        def timed(fn, *args):
+            t0 = clock_ns()
+            out = fn(*args)
+            jax.block_until_ready(out)
+            duty.add(clock_ns() - t0)
+            return out
+
+        def wrapped_prefill(tokens, page_table, pages, last_index,
+                            start_index):
+            bus.broadcast(
+                "prefill",
+                (
+                    np.asarray(tokens, np.int32),
+                    np.asarray(page_table, np.int32),
+                    int(last_index),
+                    int(start_index),
+                ),
+            )
+            return timed(
+                prefill, tokens, page_table, pages, last_index, start_index
+            )
+
+        def wrapped_decode(tokens, positions, page_tables, pages):
+            bus.broadcast(
+                "decode",
+                (
+                    np.asarray(tokens, np.int32),
+                    np.asarray(positions, np.int32),
+                    np.asarray(page_tables, np.int32),
+                ),
+            )
+            return timed(decode, tokens, positions, page_tables, pages)
+
+        wrapped_multi = None
+        if decode_multi is not None:
+            def wrapped_multi(tokens, positions, lengths, page_tables,
+                              pages):
+                bus.broadcast(
+                    "decode_multi",
+                    (
+                        np.asarray(tokens, np.int32),
+                        np.asarray(positions, np.int32),
+                        np.asarray(lengths, np.int32),
+                        np.asarray(page_tables, np.int32),
+                    ),
+                )
+                return timed(
+                    decode_multi, tokens, positions, lengths, page_tables,
+                    pages,
+                )
+
+        return wrapped_prefill, wrapped_decode, wrapped_multi
+
+    return wrapper
+
+
+def follower_handlers(model) -> Dict[str, Callable[..., None]]:
+    """A worker's step handler table: each op re-runs the corresponding
+    UNWRAPPED device fn against this process's page-pool shards. The
+    block_until_ready keeps the ack's busy-time honest (and this member
+    from queueing unboundedly far behind the coordinator)."""
+    import jax
+
+    prefill, decode, decode_multi = model._device_fns
+    state = {"pages": model.engine._pages}
+
+    def on_prefill(tokens, page_table, last_index, start_index):
+        logits, state["pages"] = prefill(
+            tokens, page_table, state["pages"],
+            int(last_index), int(start_index),
+        )
+        jax.block_until_ready(logits)
+
+    def on_decode(tokens, positions, page_tables):
+        logits, state["pages"] = decode(
+            tokens, positions, page_tables, state["pages"]
+        )
+        jax.block_until_ready(logits)
+
+    handlers = {"prefill": on_prefill, "decode": on_decode}
+    if decode_multi is not None:
+        def on_decode_multi(tokens, positions, lengths, page_tables):
+            logits, state["pages"] = decode_multi(
+                tokens, positions, lengths, page_tables, state["pages"]
+            )
+            jax.block_until_ready(logits)
+
+        handlers["decode_multi"] = on_decode_multi
+    return handlers
+
+
+def _start_pod_reporter(
+    metrics,
+    bus: Optional[StepBus],
+    duty: _Duty,
+    runtime: PodRuntime,
+    stop: threading.Event,
+) -> threading.Thread:
+    """Refresh the per-member liveness/duty gauges once a second from
+    the bus's ack bookkeeping."""
+
+    def run() -> None:
+        while not stop.wait(1.0):
+            metrics.set_pod_process(0, True, duty.ratio())
+            if bus is None:
+                continue
+            wall = max(1, duty._clock_ns() - duty.start_ns)
+            busy = bus.worker_busy_ns()
+            alive = set(bus.alive_workers())
+            for index in range(1, runtime.process_count):
+                metrics.set_pod_process(
+                    index, index in alive, busy.get(index, 0) / wall
+                )
+
+    thread = threading.Thread(target=run, name="pod-reporter", daemon=True)
+    thread.start()
+    return thread
+
+
+def _serve_coordinator(model, config: PodConfig, runtime: PodRuntime) -> int:
+    from client_tpu.perf.fleet_runner import write_ports_file
+    from client_tpu.server.core import ServerCore
+    from client_tpu.server.model_repository import ModelRepository
+    from client_tpu.testing.inprocess import InProcessServer
+
+    bus = None
+    duty = _Duty()
+    if config.process_count > 1:
+        bus = StepBus(
+            num_workers=config.process_count - 1, address=config.bus_address
+        )
+        model.device_fn_wrapper = make_bus_wrapper(bus, duty)
+    # lockstep point: every member runs warmup's probe collectives now
+    model.warmup()
+    if bus is not None:
+        bus.accept_workers()
+    # the repository re-runs warmup on add_model/load — a second probe
+    # sequence here would run collectives the workers don't mirror, so
+    # the already-warm model's warmup is pinned to a no-op
+    model.warmup = lambda: None  # type: ignore[method-assign]
+    repository = ModelRepository()
+    core = ServerCore(repository)
+    repository.add_model(model)
+    server = InProcessServer(
+        core=core, builtin_models=False, grpc="aio"
+    ).start()
+    stop = threading.Event()
+    metrics = core.metrics
+    metrics.set_pod_process(0, True, 0.0)
+    if bus is not None:
+        for index in range(1, runtime.process_count):
+            metrics.set_pod_process(index, True, 0.0)
+    _start_pod_reporter(metrics, bus, duty, runtime, stop)
+    ports_path = os.environ.get(ENV_PORTS_FILE)
+    if ports_path:
+        write_ports_file(
+            ports_path,
+            {
+                "http_port": server.http_port,
+                "grpc_port": server.grpc_port,
+                "model": model.name,
+                "process_count": runtime.process_count,
+                "global_device_count": runtime.global_device_count,
+                "local_device_count": runtime.local_device_count,
+            },
+        )
+    print(
+        f"pod coordinator up: {runtime.process_count} processes, "
+        f"{runtime.global_device_count} global devices, "
+        f"http={server.http_port} grpc={server.grpc_port}",
+        flush=True,
+    )
+    signal.signal(signal.SIGTERM, lambda *_args: stop.set())
+    signal.signal(signal.SIGINT, lambda *_args: stop.set())
+    stop.wait()
+    if bus is not None:
+        bus.stop()
+    server.stop()
+    return 0
+
+
+def _follow_worker(model, config: PodConfig) -> int:
+    # lockstep point: mirrors the coordinator's warmup collectives
+    model.warmup()
+    follower = StepFollower(config.bus_address, config.process_index)
+    print(
+        f"pod worker {config.process_index} following "
+        f"{config.bus_address}",
+        flush=True,
+    )
+    reason = follower.follow(follower_handlers(model))
+    print(f"pod worker {config.process_index} done: {reason}", flush=True)
+    follower.close()
+    return 0
+
+
+def main() -> int:
+    config = PodConfig.from_env()
+    if config is None:
+        print(
+            "not a pod member: CLIENT_TPU_POD_COORDINATOR is unset "
+            "(use client_tpu.pod.PodLauncher)",
+            file=sys.stderr,
+        )
+        return 2
+    runtime = initialize(config)
+    print(f"pod member up: {runtime.describe()}", flush=True)
+    model = build_model(runtime)
+    if config.is_coordinator:
+        return _serve_coordinator(model, config, runtime)
+    if not config.bus_address:
+        print("pod worker needs a bus address", file=sys.stderr)
+        return 2
+    return _follow_worker(model, config)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1362,13 +1362,21 @@ class ServerCore:
             import jax
 
             devices = jax.devices()
+            from client_tpu.pod.runtime import pod_info
+
+            # under jax.distributed the device list is GLOBAL — stamp
+            # which process this report comes from so a pod member's
+            # topology is distinguishable from a single-process replica
+            # (and per-device, which member owns it)
             info: Dict[str, Any] = {
                 "platform": devices[0].platform if devices else "unknown",
                 "device_count": len(devices),
+                **pod_info(),
                 "devices": [
                     {
                         "id": d.id,
                         "kind": getattr(d, "device_kind", "") or d.platform,
+                        "process": getattr(d, "process_index", 0),
                     }
                     for d in devices
                 ],

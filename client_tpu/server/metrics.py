@@ -413,6 +413,25 @@ class ServerMetrics:
             buckets=SPEC_TOKENS_BUCKETS,
             registry=registry,
         )
+        # Pod-scale serving: one row per pod member process. Exported by
+        # the coordinator (the only member running front-ends) from step
+        # bus acks — workers have no metrics endpoint of their own.
+        self.pod_process_up = Gauge(
+            "tpu_pod_process_up",
+            "Pod member liveness: 1 while the process acks step "
+            "broadcasts (process 0 is the coordinator itself), 0 once "
+            "the bus declares it lost.",
+            ("process",),
+            registry=registry,
+        )
+        self.pod_process_duty = Gauge(
+            "tpu_pod_process_duty_ratio",
+            "Fraction of wall time each pod member spent executing "
+            "device steps since the pod came up (workers report "
+            "cumulative busy nanoseconds in their step acks).",
+            ("process",),
+            registry=registry,
+        )
         self._duty_lock = threading.Lock()
         # First scrape reports utilization since server start — not 0.0
         # (the pre-registry handler's first-scrape blind spot).
@@ -545,6 +564,12 @@ class ServerMetrics:
     def set_llm_sequences(self, model: str, active: int, waiting: int) -> None:
         self.llm_active_sequences.labels(model).set(active)
         self.llm_waiting_sequences.labels(model).set(waiting)
+
+    def set_pod_process(self, process: int, up: bool, duty: float) -> None:
+        """One pod member's liveness + duty split (coordinator-side)."""
+        label = str(process)
+        self.pod_process_up.labels(label).set(1 if up else 0)
+        self.pod_process_duty.labels(label).set(max(0.0, min(1.0, duty)))
 
     def observe_llm_step(self, model: str, batch_size: int) -> None:
         """Book one continuous-batching decode step (per-step batch-size

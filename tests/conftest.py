@@ -70,6 +70,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "pod: PR-19 multi-process pod (jax.distributed) tests; the "
+        "pod_runtime fixture re-execs the test as a coordinator/worker "
+        "subprocess pair, each device-capped so only the assembled pod "
+        "holds the full mesh — select with -m pod",
+    )
+    config.addinivalue_line(
+        "markers",
         "fleet: PR-12 multi-replica fleet runtime (routing policies, "
         "hedging, FleetRunner chaos) — select with -m fleet",
     )
@@ -189,6 +196,117 @@ def sharded_devices(request):
         f"re-exec'd sharded subprocess failed (rc {proc.returncode}):\n"
         f"{tail}"
     )
+
+
+POD_REEXEC_ENV = "CLIENT_TPU_POD_TEST_REEXEC"
+
+
+@pytest.fixture
+def pod_runtime(request):
+    """A live 2-process pod for ``@pytest.mark.pod`` tests.
+
+    Mirrors ``sharded_devices``, but where that fixture re-execs ONE
+    subprocess with a wider device count, this one re-execs the test as
+    a coordinator/worker PAIR: each member gets the pod identity
+    environment (:class:`client_tpu.pod.runtime.PodConfig`) plus a
+    2-device ``XLA_FLAGS`` cap, joins ``jax.distributed`` inside the
+    fixture, and runs the test body against the assembled 4-device
+    global mesh — a mesh neither member's capped backend could hold
+    alone. Both members run the SAME test body (SPMD: every process must
+    enter every collective).
+
+    Verdict plumbing matches ``sharded_devices``: both members passing
+    skips here with the evidence; any failure fails here with both log
+    tails. When the platform refuses ``jax.distributed`` on CPU the
+    member skips with the refusal as evidence and this invocation
+    surfaces that skip rather than a pass.
+    """
+    import subprocess
+
+    if os.environ.get(POD_REEXEC_ENV):
+        from client_tpu.pod.runtime import PodConfig, initialize
+
+        config = PodConfig.from_env()
+        if config is None:
+            pytest.fail(
+                "pod re-exec env set but no pod identity handed down"
+            )
+        try:
+            return initialize(config)
+        except RuntimeError as e:
+            pytest.skip(f"platform refuses jax.distributed on CPU: {e}")
+    from client_tpu.pod.launcher import _free_port
+    from client_tpu.pod.runtime import PodConfig
+
+    process_count, devices_per_process = 2, 2
+    coordinator = f"127.0.0.1:{_free_port()}"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    for index in range(process_count):
+        env = dict(os.environ)
+        env.update(
+            PodConfig(
+                coordinator_address=coordinator,
+                process_index=index,
+                process_count=process_count,
+                local_devices=devices_per_process,
+            ).env()
+        )
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            "--xla_force_host_platform_device_count="
+            f"{devices_per_process}"
+        )
+        env[POD_REEXEC_ENV] = "1"
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "pytest",
+                    "-q",
+                    "-rs",  # print skip reasons: the refusal evidence
+                    "-p",
+                    "no:cacheprovider",
+                    request.node.nodeid,
+                ],
+                cwd=repo_root,
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outputs, rcs = [], []
+    for proc in procs:
+        try:
+            out, _ = proc.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate()
+        outputs.append(out or "")
+        rcs.append(proc.returncode)
+    if all(rc == 0 for rc in rcs):
+        refusal = next(
+            (
+                line.strip()
+                for out in outputs
+                for line in out.splitlines()
+                if "platform refuses jax.distributed" in line
+            ),
+            None,
+        )
+        if refusal:
+            pytest.skip(f"pod member skipped: {refusal}")
+        pytest.skip(
+            "single-process backend here; PASSED in the re-exec'd "
+            "2-process pod subprocess pair"
+        )
+    tails = "\n".join(
+        f"--- pod member {index} (rc {rc}) ---\n{out[-2000:]}"
+        for index, (rc, out) in enumerate(zip(rcs, outputs))
+    )
+    pytest.fail(f"re-exec'd pod subprocess pair failed:\n{tails}")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,220 @@
+"""Pod serving bench row (the subprocess half of bench.py's "pod" row).
+
+A 2-process fake pod — :class:`client_tpu.pod.PodLauncher` spawning a
+coordinator + worker, each capped to 2 virtual CPU devices — serves the
+tp=4 float32 tiny-llama over real gRPC: a model whose 4-device mesh
+NEITHER capped member could hold alone. The same streaming workload then
+runs against a 1-process unsharded oracle served in THIS process, and
+the row reports both sides plus the pod's per-process duty split (from
+``tpu_pod_process_duty_ratio``) so the fleet view stays one model row
+with visible member utilization. ONE JSON line on stdout:
+
+    {"config": ..., "infer_per_sec": ..., "tokens_per_sec": ...,
+     "oracle_infer_per_sec": ..., "oracle_tokens_per_sec": ...,
+     "pod_vs_oracle": ..., "token_parity": true, "process_count": 2,
+     "global_device_count": 4, "duty": {"0": ..., "1": ...}}
+
+Methodology caveat (PERF.md): CPU gloo collectives plus a loopback TCP
+step bus are NOT an ICI fabric. This row measures the pod dispatch
+path's correctness and overhead — on this sandbox the pod is EXPECTED
+to trail the single-process oracle; the acceptance signal is parity
+tokens and a sane duty split, not speedup. Failures print
+``{"error": ...}`` and bench.py drops the row.
+
+Standalone: ``python tools/bench_pod.py``.
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+REQUESTS = int(os.environ.get("BENCH_POD_REQUESTS", "24"))
+CONCURRENCY = int(os.environ.get("BENCH_POD_CONCURRENCY", "4"))
+MAX_TOKENS = int(os.environ.get("BENCH_POD_MAX_TOKENS", "16"))
+
+PARITY_PROMPT = [5, 9, 17, 3]
+PARITY_TOKENS = 8
+
+
+def _prompt(index: int):
+    # distinct tails so prefix sharing doesn't collapse the workload
+    return [5, 9, 17, (index % 200) + 1]
+
+
+async def _stream_one(client, grpcclient, model_name, prompt, max_tokens):
+    tensor = grpcclient.InferInput("INPUT_IDS", [len(prompt)], "INT32")
+    import numpy as np
+
+    tensor.set_data_from_numpy(np.array(prompt, dtype=np.int32))
+
+    async def requests():
+        yield {
+            "model_name": model_name,
+            "inputs": [tensor],
+            "parameters": {"max_tokens": max_tokens},
+        }
+
+    tokens = []
+    async for result, error in client.stream_infer(requests()):
+        if error is not None:
+            raise RuntimeError(f"stream error: {error}")
+        tokens.append(int(result.as_numpy("OUTPUT_IDS")[0]))
+    return tokens
+
+
+def _drive(grpc_port: int, model_name: str) -> dict:
+    """REQUESTS streaming generations at CONCURRENCY; infer/sec, tok/s,
+    p50 per-stream latency."""
+    import client_tpu.grpc.aio as grpcclient
+
+    async def run():
+        async with grpcclient.InferenceServerClient(
+            f"127.0.0.1:{grpc_port}"
+        ) as client:
+            # warmup pass: touch every compile bucket before timing
+            await _stream_one(
+                client, grpcclient, model_name, _prompt(0), MAX_TOKENS
+            )
+            pending = list(range(REQUESTS))
+            latencies = []
+            tokens_out = 0
+
+            async def worker():
+                nonlocal tokens_out
+                while pending:
+                    index = pending.pop()
+                    t0 = time.monotonic_ns()
+                    tokens = await _stream_one(
+                        client, grpcclient, model_name, _prompt(index),
+                        MAX_TOKENS,
+                    )
+                    latencies.append(time.monotonic_ns() - t0)
+                    tokens_out += len(tokens)
+
+            start = time.monotonic()
+            await asyncio.gather(*[worker() for _ in range(CONCURRENCY)])
+            elapsed = max(1e-9, time.monotonic() - start)
+            latencies.sort()
+            p50 = latencies[len(latencies) // 2] / 1e6 if latencies else 0.0
+            return {
+                "infer_per_sec": round(REQUESTS / elapsed, 2),
+                "tokens_per_sec": round(tokens_out / elapsed, 2),
+                "p50_ms": round(p50, 1),
+            }
+
+    return asyncio.run(run())
+
+
+def _parity_tokens(grpc_port: int, model_name: str):
+    import client_tpu.grpc.aio as grpcclient
+
+    async def run():
+        async with grpcclient.InferenceServerClient(
+            f"127.0.0.1:{grpc_port}"
+        ) as client:
+            return await _stream_one(
+                client, grpcclient, model_name, PARITY_PROMPT, PARITY_TOKENS
+            )
+
+    return asyncio.run(run())
+
+
+def _pod_duty(http_port: int) -> dict:
+    """Per-process duty ratios from the coordinator's /metrics."""
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{http_port}/metrics", timeout=30
+    ) as response:
+        text = response.read().decode()
+    duty = {}
+    for line in text.splitlines():
+        if line.startswith("tpu_pod_process_duty_ratio{process="):
+            label = line.split('"')[1]
+            duty[label] = round(float(line.split()[-1]), 4)
+    return duty
+
+
+def main() -> int:
+    import jax.numpy as jnp
+
+    from client_tpu.llm.serving import LlmEngineModel
+    from client_tpu.models import llama
+    from client_tpu.pod.launcher import PodLauncher
+    from client_tpu.server.core import ServerCore
+    from client_tpu.server.model_repository import ModelRepository
+    from client_tpu.testing import InProcessServer
+
+    # --- 1-process oracle: same model family the pod worker serves,
+    # unsharded, in this (single-device) process
+    config = llama.LlamaConfig.tiny(max_seq_len=256, dtype=jnp.float32)
+    repository = ModelRepository()
+    core = ServerCore(repository)
+    repository.add_model(LlmEngineModel("llm_pod", config=config))
+    with InProcessServer(
+        core=core, builtin_models=False, host="127.0.0.1", grpc="aio"
+    ) as server:
+        oracle_parity = _parity_tokens(server.grpc_port, "llm_pod")
+        oracle = _drive(server.grpc_port, "llm_pod")
+
+    # --- the 2-process pod serving the tp=4 twin of the same model
+    launcher = PodLauncher(process_count=2, devices_per_process=2)
+    launcher.launch()
+    try:
+        ports = launcher.wait_ready(timeout_s=240.0)
+        pod_parity = _parity_tokens(ports["grpc_port"], ports["model"])
+        row = _drive(ports["grpc_port"], ports["model"])
+        duty = _pod_duty(ports["http_port"])
+        row.update(
+            {
+                "config": (
+                    f"llm_pod (tiny llama fp32, tp=4 over a 2-process "
+                    f"fake pod, 2 CPU devices each), streaming gRPC, "
+                    f"{REQUESTS} x {MAX_TOKENS} tokens, concurrency "
+                    f"{CONCURRENCY}"
+                ),
+                "oracle_infer_per_sec": oracle["infer_per_sec"],
+                "oracle_tokens_per_sec": oracle["tokens_per_sec"],
+                "pod_vs_oracle": round(
+                    row["tokens_per_sec"]
+                    / max(1e-9, oracle["tokens_per_sec"]),
+                    3,
+                ),
+                "token_parity": pod_parity == oracle_parity,
+                "process_count": ports["process_count"],
+                "global_device_count": ports["global_device_count"],
+                "local_device_count": ports["local_device_count"],
+                "duty": duty,
+            }
+        )
+        if not row["token_parity"]:
+            print(
+                json.dumps(
+                    {
+                        "error": (
+                            f"pod tokens diverged from the oracle: "
+                            f"{pod_parity} vs {oracle_parity}"
+                        )
+                    }
+                )
+            )
+            return 1
+        print(json.dumps(row))
+        return 0
+    finally:
+        launcher.stop()
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except SystemExit:
+        raise
+    except Exception as e:  # noqa: BLE001 - the row is best-effort
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+        raise SystemExit(1)

@@ -81,15 +81,15 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
     lines = [
         "| run | infer/sec | p50 (us) | ratio_vs_inproc | server CPU "
         "(us/req) | dominant stage | rolling p99 (us) | llm tok/s | "
-        "sharded inf/s | fleet inf/s | proxy tax | kernel tok/s | "
-        "prefix hit | spec tok/step |",
-        "|---|---|---|---|---|---|---|---|---|---|---|---|---|---|",
+        "sharded inf/s | fleet inf/s | proxy tax | pod tok/s | "
+        "kernel tok/s | prefix hit | spec tok/step |",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for run in runs:
         parsed = run["parsed"]
         if parsed is None:
             lines.append(
-                f"| r{run['run']:02d} | (bench failed) | | | | | | | | | | | | |"
+                f"| r{run['run']:02d} | (bench failed) | | | | | | | | | | | | | |"
             )
             continue
 
@@ -130,6 +130,16 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
             f"{fleet['proxy_tax_ratio']:.2f}x"
             if isinstance(fleet, dict)
             and isinstance(fleet.get("proxy_tax_ratio"), (int, float))
+            else "-"
+        )
+        # BENCH_r19+: the pod serving row (tools/bench_pod.py — a
+        # 2-process jax.distributed pair serving the tp=4 model vs the
+        # 1-process oracle; the cell is the pod side's streamed tok/s)
+        pod = parsed.get("pod")
+        pod_s = (
+            f"{pod['tokens_per_sec']:.1f}"
+            if isinstance(pod, dict)
+            and isinstance(pod.get("tokens_per_sec"), (int, float))
             else "-"
         )
         # BENCH_r13+: the fused ragged paged-attention decode microbench
@@ -175,6 +185,7 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
             f"| {sharded_s} "
             f"| {fleet_s} "
             f"| {tax_s} "
+            f"| {pod_s} "
             f"| {kernel_s} "
             f"| {hit_s} "
             f"| {spec_s} |"
@@ -213,7 +224,11 @@ def check_regression(
         than ``PROXY_TAX_CEILING`` of the direct fleet's throughput);
       * ``llm_generate.speculation.tokens_per_step`` (BENCH_r14+) —
         floored at 1.0 (speculation may never lose to the plain engine
-        it wraps).
+        it wraps);
+      * ``pod.tokens_per_sec`` (BENCH_r19+) — the 2-process pod serving
+        row is one harness family by construction (subprocess pair +
+        streaming grpc.aio driver), so within-family comparison is
+        automatic.
     """
     ok = [r for r in runs if r["parsed"] is not None]
     if len(ok) < 2:
@@ -299,6 +314,21 @@ def check_regression(
             for r in ok[:-1]
             if _nested(r["parsed"], "fleet", "router_infer_per_sec")
             is not None
+        ],
+    )
+    # BENCH_r19+: the pod serving row. Relative guard only — on this
+    # sandbox the pod trails the 1-process oracle by design (CPU gloo
+    # collectives + a TCP step bus are not ICI), so the floor is "don't
+    # lose pod throughput the arc already recorded", not "beat the
+    # oracle".
+    _guard(
+        "pod",
+        "tok/s",
+        _nested(latest, "pod", "tokens_per_sec"),
+        [
+            (r["run"], _nested(r["parsed"], "pod", "tokens_per_sec"))
+            for r in ok[:-1]
+            if _nested(r["parsed"], "pod", "tokens_per_sec") is not None
         ],
     )
     proxy_tax = _nested(latest, "fleet", "proxy_tax_ratio")

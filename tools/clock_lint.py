@@ -31,6 +31,9 @@ TARGET_DIRS = (
     # the sharded executor's device_put/compute/gather phase accounting
     # reads its injected clock_ns only
     os.path.join("client_tpu", "parallel"),
+    # PR-19 pod runtime: step-bus duty accounting and launcher readiness
+    # polling run on injected clock/clock_ns defaults only
+    os.path.join("client_tpu", "pod"),
     os.path.join("client_tpu", "resilience"),
     # PR-16 router tier: proxy latency, probe cadence, and admission
     # hints all run on the injected pool clock — fake-clock testable

@@ -51,7 +51,12 @@ TARGET_FILES = (
 # whole packages whose every module is linted (the router tier owns its
 # own MetricsRegistry — its /metrics surface follows the same
 # conventions as the server's)
-TARGET_DIRS = (os.path.join("client_tpu", "router"),)
+TARGET_DIRS = (
+    # PR-19 pod runtime: any family it grows (tpu_pod_*) must follow
+    # the frozen conventions
+    os.path.join("client_tpu", "pod"),
+    os.path.join("client_tpu", "router"),
+)
 
 FAMILY_CONSTRUCTORS = frozenset({"Counter", "Gauge", "Histogram"})
 
