@@ -916,6 +916,56 @@ def _bench_pod() -> dict:
     return best
 
 
+def _bench_recovery() -> dict:
+    """The self-healing chaos row (BENCH_r20+): SIGKILL a member of the
+    2-process fake pod mid-generation and measure the supervised
+    recovery — client-observed MTTR (kill to the resumed stream's next
+    token) with token parity against an uninterrupted oracle as the
+    acceptance signal (tools/bench_recovery.py). One pass, not best-of:
+    MTTR is a latency we want honestly, and the row already costs a
+    pod launch + a full recovery. Never raises; failures degrade to {}
+    so the headline is never lost."""
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools",
+        "bench_recovery.py",
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    try:
+        out = subprocess.run(
+            [sys.executable, script],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=600,
+        )
+        for line in out.stdout.splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if "mttr_s" not in row and "error" not in row:
+                continue  # structured-log line, not the row
+            if "error" in row:
+                print(
+                    f"bench: recovery row failed: {row['error']}",
+                    file=sys.stderr,
+                )
+                return {}
+            return row
+        print(
+            f"bench: recovery row produced no JSON (rc {out.returncode})",
+            file=sys.stderr,
+        )
+    except Exception as e:  # noqa: BLE001 - row is best-effort
+        print(f"bench: recovery row failed: {e}", file=sys.stderr)
+    return {}
+
+
 def _bench_fleet() -> dict:
     """The multi-replica scale-out row (ROADMAP item 1 / BENCH_r12+):
     N=3 subprocess replicas vs N=1 serving the accelerator-bound
@@ -1203,6 +1253,13 @@ def main() -> int:
     # after the fleet row, never alongside it.
     pod = {} if os.environ.get("BENCH_NO_POD") else _bench_pod()
 
+    # Recovery chaos row: another pod launch (plus a SIGKILL and a
+    # supervised respawn) — after the pod row for the same
+    # whole-host reason.
+    recovery = (
+        {} if os.environ.get("BENCH_NO_RECOVERY") else _bench_recovery()
+    )
+
     # Kernel microbench (BENCH_r13+): stand-in vs fused ragged
     # paged-attention decode + the prefix-sharing TTFT/blocks deltas.
     # In-process jax; runs after the servers so it owns the cores.
@@ -1325,6 +1382,8 @@ def main() -> int:
         line["fleet"] = fleet
     if pod:
         line["pod"] = pod
+    if recovery:
+        line["recovery"] = recovery
     # CPU attribution of the client/server split for the headline run
     # (PERF.md explains how this bounds ratio_vs_inproc on few-core hosts).
     count = result.get("count", 0)

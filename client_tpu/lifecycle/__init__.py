@@ -32,6 +32,7 @@ so the lifecycle test suite runs on fake clocks.
 
 from client_tpu.lifecycle.drain import (
     DRAINING,
+    RECOVERING,
     SERVING,
     STATE_VALUES,
     STOPPED,
@@ -63,6 +64,7 @@ from client_tpu.lifecycle.routing import (
 
 __all__ = [
     "DRAINING",
+    "RECOVERING",
     "ROUTING_POLICY_NAMES",
     "SERVING",
     "STATE_VALUES",

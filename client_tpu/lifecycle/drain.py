@@ -31,9 +31,15 @@ from client_tpu.scheduling import SchedulingError
 SERVING = "serving"
 DRAINING = "draining"
 STOPPED = "stopped"
+# Not a DrainController state: overlaid on the tpu_server_state gauge by
+# the metrics collector while any loaded model's engine is mid-reload
+# (self-healing PR 20) — the lifecycle itself stays SERVING so probes
+# keep the replica in rotation for its healthy models.
+RECOVERING = "recovering"
 
-# tpu_server_state gauge encoding (monotone along the lifecycle)
-STATE_VALUES = {SERVING: 0, DRAINING: 1, STOPPED: 2}
+# tpu_server_state gauge encoding (monotone along the lifecycle;
+# RECOVERING sits outside the monotone drain arc)
+STATE_VALUES = {SERVING: 0, DRAINING: 1, STOPPED: 2, RECOVERING: 3}
 
 
 class ServerDrainingError(SchedulingError):

@@ -14,7 +14,13 @@
 Clock-injected throughout (tools/clock_lint.py covers this package).
 """
 
-from client_tpu.llm.engine import EngineConfig, LlmEngine, Sequence
+from client_tpu.llm.engine import (
+    EngineConfig,
+    EngineRecoveringError,
+    LlmEngine,
+    Sequence,
+)
+from client_tpu.llm.recovery import EngineRecovery
 from client_tpu.llm.kv_cache import (
     TRASH_BLOCK,
     BlockAllocator,
@@ -31,6 +37,8 @@ __all__ = [
     "CacheCapacityError",
     "DraftModelProposer",
     "EngineConfig",
+    "EngineRecovery",
+    "EngineRecoveringError",
     "LlmEngine",
     "NgramProposer",
     "Sequence",

@@ -50,8 +50,28 @@ from client_tpu.scheduling import (
     PriorityQueue,
     QueueFullError,
     QueueTimeoutError,
+    SchedulingError,
 )
 from client_tpu.utils import InferenceServerException
+
+
+class EngineRecoveringError(SchedulingError):
+    """The engine hit a fatal device failure and a background reload is
+    in flight — the request is retryable, and ``Retry-After`` tells the
+    client when the reload is expected to have finished.  Distinct from
+    the closed-until-manual-reload UNAVAILABLE: this one promises the
+    server is actively healing itself."""
+
+    http_status = 503
+    grpc_code = "UNAVAILABLE"
+    reason = "recovering"
+
+    def __init__(self, model_name: str, retry_after_s: float = 1.0):
+        super().__init__(
+            f"llm engine for '{model_name}' is recovering from a device "
+            f"failure; retry shortly",
+            retry_after_s=retry_after_s,
+        )
 
 
 class EngineConfig:
@@ -160,6 +180,24 @@ def _spec_param(value: Any) -> bool:
     )
 
 
+def _recovery_param(value: Any) -> bool:
+    """The per-request ``recovery`` parameter: ``resume`` (default)
+    replays the sequence through an engine reload; ``fail`` opts out —
+    the client would rather see a retryable error than a transparently
+    resumed stream.  Anything else is a 400."""
+    if value is None or value == "":
+        return True
+    token = str(value).strip().lower()
+    if token == "resume":
+        return True
+    if token == "fail":
+        return False
+    raise InferenceServerException(
+        f"request parameter 'recovery' must be 'resume' or 'fail', "
+        f"got {value!r}"
+    )
+
+
 def _float_param(name: str, value: Any) -> float:
     """Like :func:`_int_param` for float-valued wire parameters."""
     try:
@@ -204,6 +242,7 @@ class Sequence:
         "block_hashes",
         "shared_blocks",
         "spec_enabled",
+        "recovery_resume",
         "_out",
         "_engine",
     )
@@ -211,7 +250,7 @@ class Sequence:
     def __init__(self, seq_id, prompt, max_tokens, priority_level,
                  deadline_ns, timeout_us, max_blocks: int, engine,
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
-                 spec_enabled: bool = True):
+                 spec_enabled: bool = True, recovery_resume: bool = True):
         self.seq_id = seq_id
         self.prompt: List[int] = prompt
         self.generated: List[int] = []
@@ -235,6 +274,10 @@ class Sequence:
         # per-request speculation opt-out (the harness A/B switch); only
         # meaningful on an engine configured with spec_k > 0
         self.spec_enabled = spec_enabled
+        # engine-fatal policy: True replays this sequence through a
+        # reload (the PRNG chain keyed on (seed, token-index) makes the
+        # resumed stream token-identical), False fails it immediately
+        self.recovery_resume = recovery_resume
         # chained content hashes of the prompt's FULL blocks (computed
         # once at submit; matched against / published to the allocator's
         # shared index at every admission, including resumes)
@@ -354,6 +397,16 @@ class LlmEngine:
         self._task: Optional[asyncio.Task] = None
         self._wake: Optional[asyncio.Event] = None
         self._closed = False
+        # engine-fatal recovery: when a supervisor wired on_fatal, a
+        # fatal step failure QUARANTINES the engine (recovering=True,
+        # submits 503 with Retry-After=retry_after_s) instead of failing
+        # the waiting room — resumable sequences park in _survivors until
+        # a reloaded engine adopt()s them
+        self.on_fatal: Optional[Callable[[BaseException], None]] = None
+        self.recovering = False
+        self.retry_after_s = 1.0
+        self.last_failure: Optional[BaseException] = None
+        self._survivors: List[Sequence] = []
         # cumulative counters (also mirrored to the metrics registry)
         self.steps = 0
         self.tokens_generated = 0
@@ -397,6 +450,13 @@ class LlmEngine:
         paged cache exists for.
         """
         if self._closed:
+            if self.recovering:
+                # quarantined with a reload in flight: same UNAVAILABLE
+                # wire face, but with Retry-After so clients back off for
+                # roughly one reload instead of hammering the 503
+                raise EngineRecoveringError(
+                    self.model_name, retry_after_s=self.retry_after_s
+                )
             # UNAVAILABLE: a closed engine (shutdown, device failure, or
             # a lost pod worker) is a retryable replica-level condition —
             # the fleet's failover machinery routes around it
@@ -471,6 +531,7 @@ class LlmEngine:
                 f"request parameter 'top_k' must be >= 0, got {top_k}"
             )
         spec_enabled = _spec_param(parameters.get("speculation"))
+        recovery_resume = _recovery_param(parameters.get("recovery"))
         seed = _int_param("seed", parameters.get("seed", 0) or 0)
         if seed < 0:
             # np.random.default_rng rejects negative entropy — validate
@@ -500,6 +561,7 @@ class LlmEngine:
             top_k=top_k,
             seed=seed,
             spec_enabled=spec_enabled,
+            recovery_resume=recovery_resume,
         )
         seq.block_hashes = block_hashes
         self._waiting.push(seq, level=level, deadline_ns=deadline_ns)
@@ -577,12 +639,160 @@ class LlmEngine:
         self._waiting.remove(items)
         self._publish()
 
+    # -- engine-fatal quarantine & recovery ----------------------------------
+
+    def _quarantine(self, exc: BaseException) -> None:
+        """Handle a fatal step-loop failure.
+
+        A failed device call may have consumed donated buffers (the page
+        pool is donated to the jitted step off-CPU), so the engine cannot
+        safely serve against ``self._pages`` anymore — it stops taking
+        work either way.  Without a supervisor (``on_fatal`` unset) this
+        is the PR-9 behavior: fail everything, refuse new work until a
+        manual ``warmup()``.  With one, live sequences that opted into
+        resume park in ``_survivors`` (their consumers stay blocked on
+        their token queues — nothing is failed, nothing streams) and the
+        supervisor's reload eventually :meth:`adopt`\\ s them onto a fresh
+        engine; everything else fails with the preserved status."""
+        if self.logger is not None:
+            self.logger.error("llm_engine_loop_failed", exc=exc,
+                              model=self.model_name)
+        # preserve the inner status so a lost pod worker (UNAVAILABLE)
+        # stays retryable instead of collapsing to a bare 500
+        status = (
+            exc.status() if isinstance(exc, InferenceServerException)
+            else None
+        )
+        error = InferenceServerException(
+            f"llm engine step failed: {exc}", status=status
+        )
+        self.last_failure = exc
+        self._closed = True
+        resumable = self.on_fatal is not None
+        survivors: List[Sequence] = []
+
+        def triage(seq: Sequence) -> None:
+            self.allocator.free(seq.seq_id)
+            seq.blocks = []
+            seq.shared_blocks = 0
+            seq.page_table[:] = TRASH_BLOCK
+            if seq.cancelled or seq.state == _DONE:
+                seq.state = _DONE
+            elif resumable and seq.recovery_resume:
+                seq.state = _WAITING
+                survivors.append(seq)
+            else:
+                seq.fail(error)
+
+        if self._admitting is not None:
+            triage(self._admitting)
+            self._admitting = None
+        for seq in self._running:
+            triage(seq)
+        self._running.clear()
+        items = self._waiting.scan()
+        for item in items:
+            triage(item.value)
+        self._waiting.remove(items)
+        self._survivors = survivors
+        self.recovering = resumable
+        self._publish()
+        if resumable:
+            try:
+                self.on_fatal(exc)
+            except Exception as hook_exc:  # noqa: BLE001 - no rescue -> fail
+                if self.logger is not None:
+                    self.logger.error("llm_engine_recovery_hook_failed",
+                                      exc=hook_exc, model=self.model_name)
+                self.recovering = False
+                for seq in self._survivors:
+                    seq.fail(error)
+                self._survivors = []
+
+    def quarantine(self, reason: str = "externally induced") -> None:
+        """Force the engine-fatal path from OUTSIDE the step loop (the
+        pod coordinator quarantines the engine before tearing down a
+        broken mesh; chaos tests induce failures with it).  Thread-safe
+        via the same loop-hop :meth:`close` uses; a direct call only
+        when no loop/task is live."""
+        error = InferenceServerException(
+            f"llm engine for '{self.model_name}' failed: {reason}",
+            status="UNAVAILABLE",
+        )
+        task = self._task
+        if task is not None and not task.done():
+            loop = task.get_loop()
+            try:
+                on_loop = asyncio.get_running_loop() is loop
+            except RuntimeError:
+                on_loop = False
+            if not on_loop and not loop.is_closed():
+                try:
+                    loop.call_soon_threadsafe(self._quarantine_on_loop, error)
+                    return
+                except RuntimeError:
+                    pass  # loop closed between the check and the call
+        self._quarantine_on_loop(error)
+
+    def _quarantine_on_loop(self, error: BaseException) -> None:
+        if self._closed:
+            return
+        if self._task is not None:
+            try:
+                self._task.cancel()
+            except RuntimeError:
+                pass  # owning loop already closed
+            self._task = None
+        self._quarantine(error)
+
+    def detach_survivors(self) -> List[Sequence]:
+        """Hand the quarantined sequences to whoever will adopt them
+        onto the replacement engine (clears the local list — exactly one
+        recovery owns each survivor)."""
+        survivors, self._survivors = self._survivors, []
+        return survivors
+
+    def fail_survivors(self, error: BaseException) -> None:
+        """Recovery gave up: fail anything still parked and drop the
+        recovering promise so submits report plain closed."""
+        self.recovering = False
+        for seq in self.detach_survivors():
+            seq.fail(error)
+
+    def adopt(self, survivors: List[Sequence]) -> None:
+        """Re-queue sequences that survived a predecessor engine's
+        quarantine (serving-loop only, like :meth:`submit`).
+
+        Each survivor re-enters the waiting room exactly like a
+        preempted sequence: its ``context`` (prompt + tokens already
+        streamed) re-prefills in one call and decoding resumes on the
+        same (seed, token-index) PRNG chain, so the resumed stream is
+        token-identical to an uninterrupted one.  Sequences that already
+        streamed tokens requeue WITHOUT a deadline (matching
+        ``_preempt`` — their first tokens are live downstream; expiring
+        them now would break streams the engine already committed to)."""
+        for seq in survivors:
+            if seq.cancelled or seq.state == _DONE:
+                continue
+            # adopted ids must not collide with this engine's own counter
+            self._seq_counter = max(self._seq_counter, seq.seq_id)
+            seq._engine = self
+            seq.state = _WAITING
+            deadline_ns = seq.deadline_ns if not seq.generated else None
+            self._waiting.push(
+                seq, level=seq.priority_level, deadline_ns=deadline_ns
+            )
+        self._ensure_task()
+        self._publish()
+
     # -- introspection -------------------------------------------------------
 
     def stats(self) -> Dict[str, Any]:
         return {
             "active_sequences": len(self._running),
             "waiting_sequences": len(self._waiting),
+            "recovering": self.recovering,
+            "recovery_survivors": len(self._survivors),
             "kv_blocks_in_use": self.allocator.blocks_in_use,
             "kv_blocks_total": self.allocator.capacity,
             "kv_blocks_shared": self.allocator.blocks_shared,
@@ -660,27 +870,7 @@ class LlmEngine:
             )
             raise
         except Exception as e:  # noqa: BLE001 - engine must not die silently
-            if self.logger is not None:
-                self.logger.error("llm_engine_loop_failed", exc=e,
-                                  model=self.model_name)
-            # preserve the inner status so a lost pod worker
-            # (UNAVAILABLE) stays retryable through the engine's
-            # fail-everything path instead of collapsing to a bare 500
-            status = (
-                e.status() if isinstance(e, InferenceServerException)
-                else None
-            )
-            self._fail_all(
-                InferenceServerException(
-                    f"llm engine step failed: {e}", status=status
-                )
-            )
-            # A failed device call may have consumed donated buffers (the
-            # page pool is donated to the jitted step off-CPU), so the
-            # engine cannot safely serve against self._pages anymore:
-            # refuse new work until warmup() rebuilds it instead of
-            # failing every future batch against dead device state.
-            self._closed = True
+            self._quarantine(e)
 
     def _prune(self) -> None:
         """Drop cancelled sequences and expire waiting deadlines."""

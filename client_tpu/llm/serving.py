@@ -41,6 +41,19 @@ class LlmEngineModel(Model):
     #: :func:`client_tpu.llm.speculation.build_proposer`
     speculation: Optional[Dict[str, Any]] = None
 
+    #: engine-fatal auto-recovery (tier 1 of the self-healing stack):
+    #: when True, warmup wires an :class:`~client_tpu.llm.recovery.
+    #: EngineRecovery` controller onto the engine so a fatal device
+    #: failure triggers a bounded-retry background reload instead of
+    #: closed-until-manual-reload.  The pod coordinator turns this off
+    #: and supervises recovery itself (an engine fatal there usually
+    #: means the MESH is broken, which a solo reload cannot fix).
+    auto_recovery: bool = True
+
+    #: knobs forwarded to the EngineRecovery constructor (repository
+    #: model attr, e.g. ``{"max_attempts": 5, "retry_after_s": 2.0}``)
+    recovery_options: Optional[Dict[str, Any]] = None
+
     def __init__(
         self,
         name: str = "llm_engine",
@@ -99,6 +112,9 @@ class LlmEngineModel(Model):
         # reported in the model config's parameters map
         self.decode_kernel: Optional[str] = None
         self._core = None
+        # one recovery controller per model instance, created lazily by
+        # the first warmup and re-attached across engine swaps
+        self._recovery = None
 
     def _build_device_fns(self, params, config, engine_config, attn,
                           attn_mq, donate):
@@ -471,6 +487,39 @@ class LlmEngineModel(Model):
             proposer=proposer,
         )
         self._core = None  # rebind metrics/executor after a reload
+        self._wire_recovery()
+
+    def _wire_recovery(self) -> None:
+        """Attach the auto-recovery controller to the (possibly brand
+        new) engine.  The controller itself re-attaches after ITS
+        reloads; this covers the initial warmup and manual reloads."""
+        if not self.auto_recovery:
+            return
+        if self._recovery is None:
+            from client_tpu.llm.recovery import EngineRecovery
+
+            self._recovery = EngineRecovery(
+                self, **dict(self.recovery_options or {})
+            )
+        self._recovery.attach(self.engine)
+
+    def reload(self) -> None:
+        """Rebuild device state from scratch: fresh KV pool, re-probed
+        kernels, a new engine.  Calls :meth:`warmup` through the CLASS
+        so the pod coordinator's instance-level warmup pin (the lockstep
+        no-op) never swallows a real reload."""
+        type(self).warmup(self)
+
+    @property
+    def recovering(self) -> bool:
+        """True while a background engine reload is in flight (surfaced
+        in ``debug_state()`` and the ``tpu_server_state`` overlay)."""
+        from client_tpu.llm import recovery
+
+        return (
+            self._recovery is not None
+            and self._recovery.state == recovery.RECOVERING
+        )
 
     def config(self) -> Dict[str, Any]:
         """Model config with the warmup-selected decode kernel, the

@@ -137,6 +137,7 @@ class FleetRunner:
         self._lock = threading.Lock()
         self._stopped = False
         self.restarts = 0
+        self.replacements = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -242,6 +243,29 @@ class FleetRunner:
             if self._stopped:
                 return
             self.replicas[index].stop()
+
+    def replace_replica(self, index: int):
+        """Replace one liveness-dead replica with a fresh one at NEW
+        ports (a hung replica may still hold its old sockets, and its
+        exit code — e.g. a pod whose supervised recovery failed — says
+        the address is not coming back). Distinct from
+        :meth:`restart_replica`: no drain is attempted, the replica is
+        already gone; the caller must have pulled its addresses from
+        routing FIRST. Returns the started replacement."""
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError("fleet is stopped")
+            dead = self.replicas[index]
+            replacement = self._new_server().start()
+            self.replicas[index] = replacement
+            self.replacements += 1
+        try:
+            # best-effort teardown of whatever is left of the old one;
+            # zero drain budget — nothing routable is in-flight there
+            dead.stop(0.0)
+        except Exception:  # noqa: BLE001 - it was dead to begin with
+            pass
+        return replacement
 
     # -- elasticity (the autoscaler's two verbs) -----------------------------
 
@@ -349,6 +373,19 @@ class Autoscaler:
     pulls the addresses from routing BEFORE the drain, so no new request
     can target the leaving replica while it finishes its in-flights.
 
+    **Liveness replacement** (the fleet tier of the self-healing stack)
+    rides the same tick: a replica whose readiness probe has been down
+    for ``dead_ticks`` consecutive ticks is declared dead and REPLACED —
+    its addresses pulled from routing first (``on_scale_in``), a fresh
+    replica started and announced (``on_scale_out``), the corpse stopped
+    with zero drain budget. This is deliberately a different verb from
+    burn scaling: burn says the fleet is the wrong SIZE, a dead liveness
+    probe says one MEMBER is gone (a crashed pod coordinator, a replica
+    whose supervised recovery failed and exited) — shrinking would
+    compound the outage. ``dead_ticks`` is the hysteresis that keeps an
+    ordinary drain-for-restart (readiness intentionally false for a few
+    ticks) from triggering a replacement.
+
     :meth:`observe` is the pure decision function (unit-testable with no
     fleet at all); :meth:`tick` is one read-decide-act cycle;
     :meth:`start` runs ticks on a daemon thread every ``interval_s``.
@@ -366,9 +403,12 @@ class Autoscaler:
         interval_s: float = 0.5,
         model_name: str = "device_sim",
         burn_signal: Optional[Callable[[], float]] = None,
+        liveness_signal: Optional[Callable[[], List[bool]]] = None,
+        dead_ticks: int = 4,
         on_scale_out: Optional[Callable] = None,
         on_scale_in: Optional[Callable] = None,
         logger=None,
+        clock: Callable[[], float] = time.monotonic,
     ):
         if min_replicas < 1 or max_replicas < min_replicas:
             raise ValueError("need 1 <= min_replicas <= max_replicas")
@@ -382,11 +422,17 @@ class Autoscaler:
         self.interval_s = interval_s
         self.model_name = model_name
         self._burn_signal = burn_signal
+        self._liveness_signal = liveness_signal
+        self.dead_ticks = dead_ticks
         self.on_scale_out = on_scale_out
         self.on_scale_in = on_scale_in
         self._logger = logger
+        self._clock = clock
         self._high = 0
         self._low = 0
+        # id(server) -> consecutive not-ready ticks (keyed by identity,
+        # not index: burn scaling shifts indices under the counters)
+        self._down: dict = {}
         self.events: List[dict] = []
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -409,6 +455,44 @@ class Autoscaler:
             if status:
                 burns.append(float(status.get("burn_rate", 0.0)))
         return max(burns, default=0.0)
+
+    def current_liveness(self) -> List[bool]:
+        """Per-replica readiness, positionally aligned with
+        ``fleet.replicas``. The in-process default reads each replica's
+        ``core.ready`` (exactly what the HTTP ``/v2/health/ready`` probe
+        serves); subprocess fleets inject ``liveness_signal`` instead."""
+        if self._liveness_signal is not None:
+            return list(self._liveness_signal())
+        alive = []
+        for server in list(self.fleet.replicas):
+            try:
+                alive.append(bool(server.core.ready))
+            except Exception:  # noqa: BLE001 - a dead replica IS the signal
+                alive.append(False)
+        return alive
+
+    def check_liveness(self) -> Optional[int]:
+        """Fold one liveness sample into the per-replica down counters;
+        returns the index of a replica down ``dead_ticks`` consecutive
+        ticks (lowest such index), or ``None``."""
+        replicas = list(self.fleet.replicas)
+        alive = self.current_liveness()
+        seen = set()
+        victim = None
+        for index, server in enumerate(replicas):
+            key = id(server)
+            seen.add(key)
+            if index < len(alive) and alive[index]:
+                self._down.pop(key, None)
+                continue
+            count = self._down.get(key, 0) + 1
+            self._down[key] = count
+            if victim is None and count >= self.dead_ticks:
+                victim = index
+        for key in list(self._down):
+            if key not in seen:
+                del self._down[key]
+        return victim
 
     # -- decision (pure) -----------------------------------------------------
 
@@ -435,7 +519,42 @@ class Autoscaler:
 
     # -- actuation -----------------------------------------------------------
 
+    def replace_dead(self, index: int) -> None:
+        """Actuate one liveness replacement: routing out first (the
+        address is already failing every request sent to it), fresh
+        replica in, announce it, book the MTTR on the replacement's own
+        metrics registry (the fleet scrape merges per-replica
+        registries, so the sample is visible fleet-wide)."""
+        started = self._clock()
+        dead = self.fleet.replicas[index]
+        if self.on_scale_in is not None:
+            self.on_scale_in(dead)
+        replacement = self.fleet.replace_replica(index)
+        if self.on_scale_out is not None:
+            self.on_scale_out(replacement)
+        self._down.pop(id(dead), None)
+        duration = self._clock() - started
+        try:
+            replacement.core.metrics.observe_recovery(
+                "fleet", "success", duration
+            )
+        except Exception:  # noqa: BLE001 - booking must not fail recovery
+            pass
+        event = {
+            "decision": "replace",
+            "index": index,
+            "size": self.fleet.size,
+            "duration_s": round(duration, 3),
+        }
+        self.events.append(event)
+        if self._logger is not None:
+            self._logger.info("autoscale", **event)
+
     def tick(self) -> str:
+        victim = self.check_liveness()
+        if victim is not None:
+            self.replace_dead(victim)
+            return "replace"
         burn = self.current_burn()
         decision = self.observe(burn)
         if decision == "scale_out":

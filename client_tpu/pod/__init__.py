@@ -40,16 +40,20 @@ from client_tpu.pod.runtime import (  # noqa: F401
     PodRuntime,
     initialize,
     pod_info,
+    reinitialize,
 )
+from client_tpu.pod.supervisor import PodSupervisor  # noqa: F401
 
 __all__ = [
     "PodConfig",
     "PodConfigError",
     "PodRuntime",
     "PodLauncher",
+    "PodSupervisor",
     "PodWorkerLostError",
     "StepBus",
     "StepFollower",
     "initialize",
     "pod_info",
+    "reinitialize",
 ]

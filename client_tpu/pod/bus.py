@@ -38,6 +38,11 @@ from client_tpu.utils import InferenceServerException
 
 #: sentinel op the coordinator broadcasts at shutdown
 STOP_OP = "__stop__"
+#: sentinel op the coordinator broadcasts when the pod is re-assembling
+#: after a member loss: args carry (new_coordinator_address, epoch);
+#: surviving workers leave the follower loop, re-join jax.distributed at
+#: the new address, and reconnect to a fresh bus
+REINIT_OP = "__reinit__"
 
 _LEN = struct.Struct(">I")
 
@@ -45,10 +50,17 @@ _LEN = struct.Struct(">I")
 class PodWorkerLostError(InferenceServerException):
     """A pod worker died or stopped acking: the pod cannot run its next
     SPMD step. Retryable UNAVAILABLE — the fleet's retry/failover
-    machinery treats it like any dead replica."""
+    machinery treats it like any dead replica.
 
-    def __init__(self, msg: str):
+    ``reason`` separates the two ways a worker goes missing —
+    ``"worker_lost"`` (socket dead: the process exited) and
+    ``"ack_timeout"`` (socket alive but silent past the ack deadline: a
+    hung process). The supervisor treats both identically (respawn), but
+    operators debugging a wedge need to know which one fired."""
+
+    def __init__(self, msg: str, reason: str = "worker_lost"):
         super().__init__(msg, status="UNAVAILABLE")
+        self.reason = reason
 
 
 # ---------------------------------------------------------------------------
@@ -207,6 +219,18 @@ class StepBus:
         for index, conn in list(self._workers.items()):
             try:
                 ack = json.loads(_recv_frame(conn).decode("utf-8"))
+            except socket.timeout:
+                # the ack deadline: a HUNG worker (socket open, nothing
+                # arriving) must be indistinguishable from a killed one —
+                # without this bound the step loop stalls forever on a
+                # wedged peer (socket.timeout is an OSError, so catch it
+                # first to keep its distinct reason)
+                self._drop(index)
+                raise PodWorkerLostError(
+                    f"pod worker {index} did not ack step '{op}' within "
+                    f"{self.ack_timeout_s}s",
+                    reason="ack_timeout",
+                ) from None
             except (OSError, ValueError, ConnectionError) as e:
                 self._drop(index)
                 raise PodWorkerLostError(
@@ -214,6 +238,31 @@ class StepBus:
                 ) from e
             self._busy_ns[index] = int(ack.get("busy_ns", 0))
         self.steps += 1
+
+    def broadcast_surviving(
+        self, op: str, args: Tuple[Any, ...] = ()
+    ) -> List[int]:
+        """Best-effort broadcast: deliver to every worker still
+        connected, silently dropping the ones that fail instead of
+        raising. Returns the indices that acked. The recovery path uses
+        this for ``__reinit__`` — the dead member must not keep the
+        survivors from learning where the pod re-assembles."""
+        payload = encode_step(op, args)
+        for index, conn in list(self._workers.items()):
+            try:
+                _send_frame(conn, payload)
+            except OSError:
+                self._drop(index)
+        acked: List[int] = []
+        for index, conn in list(self._workers.items()):
+            try:
+                ack = json.loads(_recv_frame(conn).decode("utf-8"))
+            except (OSError, ValueError, ConnectionError):
+                self._drop(index)
+                continue
+            self._busy_ns[index] = int(ack.get("busy_ns", 0))
+            acked.append(index)
+        return sorted(acked)
 
     def _drop(self, index: int) -> None:
         """Forget a dead worker (its socket closed) so
@@ -297,11 +346,17 @@ class StepFollower:
         )
         self.busy_ns = 0
         self.steps = 0
+        #: (new_coordinator_address, epoch) from the most recent
+        #: ``__reinit__`` broadcast — how the worker's outer loop learns
+        #: where the re-assembling pod lives
+        self.reinit_args: Optional[Tuple[Any, ...]] = None
 
     def follow(self, handlers: Dict[str, Callable[..., None]]) -> str:
         """Run the follower loop until the coordinator broadcasts
-        ``__stop__`` or closes the connection. Returns the reason the
-        loop ended (``"stop"`` or ``"coordinator_gone"``)."""
+        ``__stop__`` / ``__reinit__`` or closes the connection. Returns
+        the reason the loop ended (``"stop"``, ``"reinit"`` — with
+        :attr:`reinit_args` holding the new coordinator address and
+        epoch — or ``"coordinator_gone"``)."""
         while True:
             try:
                 op, args = decode_step(_recv_frame(self._sock))
@@ -314,6 +369,9 @@ class StepFollower:
                 return "coordinator_gone"
             if op == STOP_OP:
                 return "stop"
+            if op == REINIT_OP:
+                self.reinit_args = args
+                return "reinit"
             t0 = self._clock()
             handlers[op](*args)
             self.busy_ns += int((self._clock() - t0) * 1e9)

@@ -71,6 +71,9 @@ class PodLauncher:
         self.bus_address = f"{host}:{_free_port(host)}" if with_bus else None
         self._workdir = tempfile.mkdtemp(prefix="client_tpu_pod_")
         self.ports_file = os.path.join(self._workdir, "pod_ports.json")
+        # supervisor -> coordinator recovery-plan handoff (see
+        # client_tpu.pod.supervisor.PodSupervisor)
+        self.control_file = os.path.join(self._workdir, "pod_control.json")
         self.procs: List[subprocess.Popen] = []
         self._logs: List[str] = []
 
@@ -96,6 +99,7 @@ class PodLauncher:
             f"{self.devices_per_process}"
         )
         env["CLIENT_TPU_POD_PORTS_FILE"] = self.ports_file
+        env["CLIENT_TPU_POD_CONTROL_FILE"] = self.control_file
         # the worker module must import regardless of the parent's cwd
         # (a caller in /tmp launches children that still need this repo
         # on their path)
@@ -148,6 +152,29 @@ class PodLauncher:
 
     def poll(self) -> List[Optional[int]]:
         return [proc.poll() for proc in self.procs]
+
+    def respawn(self, process_index: int) -> None:
+        """Replace one DEAD member with a fresh process under the same
+        identity, using the launcher's CURRENT ``coordinator_address``
+        (the supervisor moves it to the re-assembled pod's address
+        before respawning). The replacement appends to the member's log
+        file so chaos evidence keeps both lives."""
+        old = self.procs[process_index]
+        if old.poll() is None:
+            raise RuntimeError(
+                f"pod process {process_index} is still running; "
+                f"respawn only replaces dead members"
+            )
+        argv = [sys.executable, "-m", self.module, *self.extra_args]
+        with open(self._logs[process_index], "ab") as log:
+            proc = subprocess.Popen(
+                argv,
+                env=self._child_env(process_index),
+                stdout=log,
+                stderr=subprocess.STDOUT,
+                cwd=os.getcwd(),
+            )
+        self.procs[process_index] = proc
 
     def kill(self, process_index: int) -> None:
         """SIGKILL one member (chaos path) — no drain, no goodbye."""

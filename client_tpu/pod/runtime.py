@@ -16,11 +16,27 @@ TPU pods get their collectives from the platform and ignore that knob.
 
 ``initialize`` must run BEFORE the first jax backend touch: jax freezes
 its device count (and its distributed-ness) at first backend init.
+
+Self-healing (PR 20): the distributed runtime is constructed MANUALLY
+(service + client via ``xla_extension``) rather than through
+``jax.distributed.initialize``, for one reason — survivability.  The
+stock client installs a missed-heartbeat callback that LOG(FATAL)s the
+whole process the moment a peer dies, and its destructor runs a
+shutdown barrier that can never complete against a dead peer (also
+fatal).  Building the pieces ourselves lets us (a) swap in a benign
+heartbeat callback so a dead peer is an *event*, not a process abort,
+and (b) :func:`abandon` a broken runtime by stashing the old
+service/client (their destructors must never run) and wiping the
+backend caches, after which :func:`reinitialize` assembles a fresh pod
+at a NEW coordinator address across survivors + replacement.  This is
+validated for the CPU/gloo fake pod this repo's CI runs; real TPU
+re-slicing has platform steps this module does not attempt.
 """
 
 import dataclasses
 import os
-from typing import Any, Dict, Mapping, Optional
+import sys
+from typing import Any, Dict, List, Mapping, Optional, Tuple
 
 #: environment handoff keys (launcher -> worker / fixture -> re-exec)
 ENV_COORDINATOR = "CLIENT_TPU_POD_COORDINATOR"
@@ -137,6 +153,72 @@ class PodRuntime:
         }
 
 
+# Abandoned distributed runtimes: (service, client) pairs whose
+# destructors must NEVER run — a client destructor runs a shutdown
+# barrier, and against a dead peer that barrier LOG(FATAL)s the
+# surviving process. Leaking one socket pair per recovery is the price
+# of staying alive; recoveries are rare by definition.
+_ABANDONED: List[Tuple[Any, Any]] = []
+
+
+def _heartbeat_logger(process_index: int):
+    """The client's missed-heartbeat callback. The stock one aborts the
+    process; ours records the event and keeps serving — the supervisor
+    (watching the step bus) owns the recovery decision, not the
+    coordination-service heartbeat."""
+
+    def on_missed(status) -> None:
+        try:
+            print(
+                f"[pod proc {process_index}] coordination heartbeat "
+                f"missed: {status}",
+                file=sys.stderr,
+                flush=True,
+            )
+        except Exception:  # noqa: BLE001 - a logger must never raise here
+            pass
+
+    return on_missed
+
+
+def _pod_init(
+    address: str,
+    process_index: int,
+    process_count: int,
+    timeout_s: float,
+) -> None:
+    """Construct the distributed runtime by hand and install it as
+    jax's global distributed state (see the module docstring for why
+    not ``jax.distributed.initialize``). Process 0 additionally hosts
+    the coordination service, bound on every interface at the
+    address's port."""
+    from jax._src import distributed
+    from jax._src.lib import xla_extension
+
+    state = distributed.global_state
+    if process_index == 0:
+        bind = "[::]:" + address.rsplit(":", 1)[1]
+        state.service = xla_extension.get_distributed_runtime_service(
+            bind,
+            process_count,
+            heartbeat_interval=10,
+            max_missing_heartbeats=10,
+        )
+    client = xla_extension.get_distributed_runtime_client(
+        address,
+        process_index,
+        init_timeout=int(timeout_s),
+        shutdown_on_destruction=False,
+        missed_heartbeat_callback=_heartbeat_logger(process_index),
+        use_compression=True,
+    )
+    client.connect()
+    state.client = client
+    state.process_id = process_index
+    state.num_processes = process_count
+    state.coordinator_address = address
+
+
 def initialize(config: PodConfig, platform: Optional[str] = None) -> PodRuntime:
     """Join the pod: bring up ``jax.distributed`` for this process.
 
@@ -145,7 +227,7 @@ def initialize(config: PodConfig, platform: Optional[str] = None) -> PodRuntime:
     collectives backend is selected so cross-process ``psum``/gather
     work on the fake pod; TPU pods take the platform default.
 
-    Raises ``RuntimeError`` (from jax) when the pod cannot assemble
+    Raises ``RuntimeError`` (from xla) when the pod cannot assemble
     within ``config.init_timeout_s`` — callers surface that as a load
     failure, not a hang.
     """
@@ -158,11 +240,11 @@ def initialize(config: PodConfig, platform: Optional[str] = None) -> PodRuntime:
         # the CPU fake pod needs a real collectives implementation; the
         # default ("none") refuses multi-process meshes outright
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    jax.distributed.initialize(
-        coordinator_address=config.coordinator_address,
-        num_processes=config.process_count,
-        process_id=config.process_index,
-        initialization_timeout=int(config.init_timeout_s),
+    _pod_init(
+        config.coordinator_address,
+        config.process_index,
+        config.process_count,
+        config.init_timeout_s,
     )
     return PodRuntime(
         config=config,
@@ -171,6 +253,40 @@ def initialize(config: PodConfig, platform: Optional[str] = None) -> PodRuntime:
         global_device_count=len(jax.devices()),
         local_device_count=len(jax.local_devices()),
     )
+
+
+def abandon() -> None:
+    """Walk away from a broken distributed runtime without dying.
+
+    Stashes the live service/client (so neither destructor — each fatal
+    against a dead peer — ever runs), clears jax's compilation caches
+    and live backends, and leaves the process ready for
+    :func:`reinitialize`. Deliberately NOT ``jax.distributed.shutdown``:
+    its barrier hangs-then-aborts when any peer is already dead, which
+    is exactly the situation recovery starts from."""
+    import jax
+    from jax._src import distributed, xla_bridge
+
+    state = distributed.global_state
+    if state.service is not None or state.client is not None:
+        _ABANDONED.append((state.service, state.client))
+    state.service = None
+    state.client = None
+    jax.clear_caches()
+    xla_bridge._clear_backends()
+
+
+def reinitialize(config: PodConfig, platform: Optional[str] = None) -> PodRuntime:
+    """Abandon the current runtime and assemble a fresh pod.
+
+    ``config`` carries the NEW coordinator address (the old port may
+    still be held by the abandoned service) and the member's identity in
+    the new assembly. Sequencing matters: the coordinator must be inside
+    ``reinitialize`` (new service bound) before a replacement process
+    calls :func:`initialize` — a client whose RegisterTask times out
+    aborts its process rather than raising."""
+    abandon()
+    return initialize(config, platform=platform)
 
 
 def pod_info() -> Dict[str, int]:

@@ -1,0 +1,201 @@
+"""Pod supervision: member death -> coordinated restart, with MTTR.
+
+Tier 2 of the self-healing stack.  :class:`PodSupervisor` runs in the
+LAUNCHER process (the only process holding the members' ``Popen``
+handles) and watches for dead members.  Detection is two-sided by
+design: the parent sees a SIGKILLed member instantly via ``poll()``,
+while a *hung* member only surfaces inside the coordinator — as the
+step bus's ack deadline (``PodWorkerLostError(reason="ack_timeout")``)
+quarantining the engine.  Either way the pod cannot run another SPMD
+step until the member is replaced and ``jax.distributed`` re-assembled,
+which is what :meth:`recover` orchestrates:
+
+1. pick a NEW coordinator address (the abandoned service may still hold
+   the old port) and bump the recovery epoch,
+2. write the plan (epoch, new address, lost member) to the launcher's
+   control file and SIGUSR1 the coordinator,
+3. wait for the coordinator's ``.started.<epoch>`` marker — the cue
+   that its replacement coordination service is coming up, so a freshly
+   spawned member won't fatally time out registering against nothing,
+4. respawn the dead member with the new coordinator address,
+5. poll the ports file until the coordinator republishes it stamped
+   with the new epoch — the pod is serving again; the elapsed time is
+   the MTTR sample recorded in :attr:`events`.
+
+The coordinator itself (member 0) is NOT recoverable from here — it
+holds the engine state and the front-end sockets; its death is a
+replica death, which the fleet tier (``perf/fleet_runner.Autoscaler``
+liveness replacement) handles by replacing the whole pod.
+
+Clock/sleep are injected per the repo's clock-lint rules.
+"""
+
+import os
+import signal
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from client_tpu.perf.fleet_runner import read_ports_file, write_ports_file
+from client_tpu.pod.launcher import PodLauncher, _free_port
+
+
+class PodSupervisor:
+    """Watches a :class:`PodLauncher`'s members and replaces dead ones.
+
+    ``deadline_s`` bounds one recovery end to end (the chaos acceptance
+    criterion: the pod must serve again within it).  ``on_event`` (when
+    set) is called with each recovery event dict as it completes.
+    """
+
+    def __init__(
+        self,
+        launcher: PodLauncher,
+        poll_interval_s: float = 0.25,
+        deadline_s: float = 240.0,
+        on_event: Optional[Callable[[Dict[str, Any]], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.launcher = launcher
+        self.poll_interval_s = poll_interval_s
+        self.deadline_s = deadline_s
+        self.on_event = on_event
+        self._clock = clock
+        self._sleep = sleep
+        self.epoch = 0
+        self.events: List[Dict[str, Any]] = []
+        self.coordinator_lost = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- watch loop ----------------------------------------------------------
+
+    def start(self) -> "PodSupervisor":
+        self._thread = threading.Thread(
+            target=self._run, name="pod-supervisor", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            dead = self.check_once()
+            if dead is None:
+                continue
+            if dead == 0:
+                # the coordinator died: not recoverable in-pod (engine
+                # state and front-end sockets died with it) — surface
+                # for the fleet tier and stand down
+                self.coordinator_lost = True
+                self._record(
+                    member=0, epoch=self.epoch, outcome="coordinator_lost",
+                    duration_s=0.0,
+                )
+                return
+            self.recover(dead)
+
+    def check_once(self) -> Optional[int]:
+        """The lowest dead member index, or None while all are alive."""
+        for index, rc in enumerate(self.launcher.poll()):
+            if rc is not None:
+                return index
+        return None
+
+    # -- coordinated restart -------------------------------------------------
+
+    def recover(self, member: int) -> Dict[str, Any]:
+        """Run one coordinated restart for a dead non-coordinator
+        member; returns (and records) the recovery event with its MTTR.
+        Failure is an event with ``outcome="failed"``, never a raise —
+        the watch loop (and the fleet tier above it) decides what a
+        failed pod recovery escalates to."""
+        started = self._clock()
+        self.epoch += 1
+        epoch = self.epoch
+        host = self.launcher.host
+        new_address = f"{host}:{_free_port(host)}"
+        write_ports_file(
+            self.launcher.control_file,
+            {
+                "epoch": epoch,
+                "coordinator_address": new_address,
+                "member": member,
+            },
+        )
+        coordinator = self.launcher.procs[0]
+        try:
+            coordinator.send_signal(signal.SIGUSR1)
+        except OSError:
+            self.coordinator_lost = True
+            return self._record(
+                member=member, epoch=epoch, outcome="coordinator_lost",
+                duration_s=self._clock() - started,
+            )
+        deadline = started + self.deadline_s
+        marker = self.launcher.control_file + f".started.{epoch}"
+        while self._clock() < deadline and not os.path.exists(marker):
+            if coordinator.poll() is not None:
+                self.coordinator_lost = True
+                return self._record(
+                    member=member, epoch=epoch, outcome="coordinator_lost",
+                    duration_s=self._clock() - started,
+                )
+            self._sleep(0.05)
+        if not os.path.exists(marker):
+            return self._record(
+                member=member, epoch=epoch, outcome="failed",
+                duration_s=self._clock() - started,
+                detail="coordinator never acknowledged the recovery plan",
+            )
+        # the replacement joins the NEW assembly: move the launcher's
+        # coordinator address so _child_env hands it the right target
+        self.launcher.coordinator_address = new_address
+        self.launcher.respawn(member)
+        while self._clock() < deadline:
+            ports = read_ports_file(self.launcher.ports_file)
+            if ports is not None and int(ports.get("epoch", -1)) == epoch:
+                return self._record(
+                    member=member, epoch=epoch, outcome="success",
+                    duration_s=self._clock() - started,
+                )
+            if self.launcher.procs[member].poll() is not None:
+                return self._record(
+                    member=member, epoch=epoch, outcome="failed",
+                    duration_s=self._clock() - started,
+                    detail=f"replacement member {member} exited rc="
+                    f"{self.launcher.procs[member].returncode}",
+                )
+            self._sleep(0.05)
+        return self._record(
+            member=member, epoch=epoch, outcome="failed",
+            duration_s=self._clock() - started,
+            detail="pod did not republish ports within the deadline",
+        )
+
+    def _record(self, **event: Any) -> Dict[str, Any]:
+        self.events.append(event)
+        if self.on_event is not None:
+            try:
+                self.on_event(dict(event))
+            except Exception:  # noqa: BLE001 - observer must not break us
+                pass
+        return event
+
+    # -- introspection -------------------------------------------------------
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "epoch": self.epoch,
+            "events": list(self.events),
+            "coordinator_lost": self.coordinator_lost,
+            "mttr_s": [
+                e["duration_s"] for e in self.events
+                if e.get("outcome") == "success"
+            ],
+        }

@@ -23,6 +23,8 @@ tp parity holds to 1e-5): the pod machinery is about WHERE the mesh
 lives, not model scale.
 """
 
+import dataclasses
+import json
 import os
 import signal
 import sys
@@ -32,12 +34,22 @@ from typing import Callable, Dict, Optional
 
 import numpy as np
 
-from client_tpu.pod.bus import StepBus, StepFollower
-from client_tpu.pod.runtime import PodConfig, PodRuntime, initialize
+from client_tpu.pod.bus import REINIT_OP, StepBus, StepFollower
+from client_tpu.pod.runtime import (
+    PodConfig,
+    PodRuntime,
+    initialize,
+    reinitialize,
+)
+from client_tpu.utils import InferenceServerException
 
 ENV_PORTS_FILE = "CLIENT_TPU_POD_PORTS_FILE"
 ENV_MODEL_NAME = "CLIENT_TPU_POD_MODEL_NAME"
 ENV_MAX_SEQ_LEN = "CLIENT_TPU_POD_MAX_SEQ_LEN"
+#: supervisor -> coordinator recovery-plan handoff (JSON file; the
+#: supervisor writes {"epoch", "coordinator_address", "member"} and
+#: sends SIGUSR1 — see client_tpu.pod.supervisor)
+ENV_CONTROL_FILE = "CLIENT_TPU_POD_CONTROL_FILE"
 
 
 def build_model(runtime: PodRuntime):
@@ -54,9 +66,15 @@ def build_model(runtime: PodRuntime):
     config = llama.LlamaConfig.tiny(
         max_seq_len=max_seq_len, dtype=jnp.float32
     )
-    return LlmEngineModel(
+    model = LlmEngineModel(
         name, config=config, tp=runtime.global_device_count
     )
+    # pod supervision owns recovery here: a solo engine reload cannot
+    # fix a broken MESH, and the coordinator's recovery procedure
+    # (member respawn + jax.distributed re-init + lockstep re-warmup)
+    # replaces the tier-1 controller wholesale
+    model.auto_recovery = False
+    return model
 
 
 class _Duty:
@@ -184,17 +202,18 @@ def follower_handlers(model) -> Dict[str, Callable[..., None]]:
 
 def _start_pod_reporter(
     metrics,
-    bus: Optional[StepBus],
     duty: _Duty,
-    runtime: PodRuntime,
+    get_state: Callable[[], tuple],
     stop: threading.Event,
 ) -> threading.Thread:
     """Refresh the per-member liveness/duty gauges once a second from
-    the bus's ack bookkeeping."""
+    the bus's ack bookkeeping. ``get_state`` returns the CURRENT
+    (bus, runtime) pair — a recovery swaps both out underneath."""
 
     def run() -> None:
         while not stop.wait(1.0):
             metrics.set_pod_process(0, True, duty.ratio())
+            bus, runtime = get_state()
             if bus is None:
                 continue
             wall = max(1, duty._clock_ns() - duty.start_ns)
@@ -210,8 +229,219 @@ def _start_pod_reporter(
     return thread
 
 
-def _serve_coordinator(model, config: PodConfig, runtime: PodRuntime) -> int:
+#: How long parked survivors wait for a recovery plan to claim them
+#: before the coordinator gives up on rescue.  The supervisor claims
+#: them within ~1s of a member death (0.2s poll + plan write + SIGUSR1),
+#: so this only fires on an UNsupervised pod — where waiting any longer
+#: just turns the quarantine into the hung stream it exists to prevent.
+RESCUE_DEADLINE_ENV = "TPU_POD_RESCUE_DEADLINE_S"
+_RESCUE_DEADLINE_S = 15.0
+
+
+def _wire_pod_fatal_hook(engine, holder: dict, quarantined: threading.Event,
+                         retry_after_s: float = 2.0,
+                         loop=None,
+                         clock: Callable[[], float] = time.monotonic) -> None:
+    """Make the engine quarantine-not-fail on a fatal: survivors park in
+    ``holder["survivors"]`` until the recovered engine adopts them, and
+    submits answer 503 + Retry-After while the pod re-assembles.
+
+    The park is deadline-bounded ("hung ≡ killed" applies to rescues
+    too): if no recovery plan claims the survivors — ``_recover_pod``
+    sets ``holder["rescued"]`` the moment it starts — within the rescue
+    deadline, they fail with a clean retryable UNAVAILABLE and the
+    engine drops its recovering promise, instead of holding client
+    streams open for a supervisor that does not exist."""
+    engine.retry_after_s = retry_after_s
+    holder.setdefault("lock", threading.Lock())
+    rescued = threading.Event()
+    holder["rescued"] = rescued
+    deadline_s = float(
+        os.environ.get(RESCUE_DEADLINE_ENV, "") or _RESCUE_DEADLINE_S
+    )
+
+    def abandon(exc: BaseException, started: float) -> None:
+        if rescued.wait(deadline_s):
+            return
+        with holder["lock"]:
+            if rescued.is_set():
+                return  # a recovery claimed them between wait and lock
+            orphans = list(holder["survivors"])
+            holder["survivors"][:] = []
+        fail = InferenceServerException(
+            f"pod quarantined ({exc}) and no recovery plan arrived "
+            f"within {deadline_s:.0f}s; resubmit",
+            status="UNAVAILABLE",
+        )
+
+        def finish() -> None:
+            engine.recovering = False
+            for seq in orphans:
+                seq.fail(fail)
+
+        delivered = False
+        if loop is not None and not loop.is_closed():
+            try:
+                loop.call_soon_threadsafe(finish)
+                delivered = True
+            except RuntimeError:
+                pass  # loop closed between the check and the call
+        if not delivered:
+            finish()
+        metrics = getattr(engine, "metrics", None)
+        if metrics is not None:
+            metrics.observe_recovery("pod", "abandoned", clock() - started)
+        print(
+            f"pod rescue abandoned: {len(orphans)} parked sequences "
+            f"failed after {deadline_s:.0f}s without a recovery plan",
+            file=sys.stderr, flush=True,
+        )
+
+    def on_fatal(exc: BaseException) -> None:
+        holder["survivors"].extend(engine.detach_survivors())
+        quarantined.set()
+        threading.Thread(
+            target=abandon, args=(exc, clock()),
+            name="pod-rescue-deadline", daemon=True,
+        ).start()
+
+    engine.on_fatal = on_fatal
+
+
+def _write_ports(server, model, runtime: PodRuntime, epoch: int) -> None:
     from client_tpu.perf.fleet_runner import write_ports_file
+
+    ports_path = os.environ.get(ENV_PORTS_FILE)
+    if ports_path:
+        write_ports_file(
+            ports_path,
+            {
+                "http_port": server.http_port,
+                "grpc_port": server.grpc_port,
+                "model": model.name,
+                "process_count": runtime.process_count,
+                "global_device_count": runtime.global_device_count,
+                "local_device_count": runtime.local_device_count,
+                "epoch": epoch,
+            },
+        )
+
+
+def _recover_pod(model, core, server, state: dict, quarantined:
+                 threading.Event, clock: Callable[[], float]) -> bool:
+    """The coordinator's half of a supervised pod recovery.
+
+    The supervisor wrote the plan (new coordinator address + epoch) to
+    the control file and signalled SIGUSR1.  Sequencing is load-bearing
+    (see pod/runtime.py): quarantine → tell survivors where to re-join →
+    tear down the old bus → *marker file* (the supervisor's cue to spawn
+    the replacement, which must not call initialize before our new
+    service exists) → re-init jax.distributed → lockstep re-warmup →
+    accept everyone on a fresh bus → adopt the parked survivors.
+    Returns False when recovery failed (the pod should exit and let the
+    fleet tier replace the whole replica)."""
+    from client_tpu.pod.bus import PodWorkerLostError  # noqa: F401
+
+    config: PodConfig = state["config"]
+    runtime: PodRuntime = state["runtime"]
+    bus: Optional[StepBus] = state["bus"]
+    duty: _Duty = state["duty"]
+    metrics = core.metrics
+    started = clock()
+    control_path = os.environ.get(ENV_CONTROL_FILE, "")
+    holder = state["holder"]
+    # claim the parked survivors FIRST: the fatal hook's rescue-deadline
+    # timer fails whatever is still unclaimed when it expires, and this
+    # recovery now owns them
+    with holder.setdefault("lock", threading.Lock()):
+        rescued = holder.get("rescued")
+        if rescued is not None:
+            rescued.set()
+    try:
+        with open(control_path, "r", encoding="utf-8") as f:
+            plan = json.load(f)
+        epoch = int(plan["epoch"])
+        new_address = str(plan["coordinator_address"])
+        lost = int(plan.get("member", -1))
+        print(
+            f"pod recovery epoch {epoch}: member {lost} lost, "
+            f"re-assembling at {new_address}",
+            flush=True,
+        )
+        core.lifecycle.begin_drain()
+        engine = model.engine
+        if engine is not None and not engine._closed:
+            # idle-pod loss: nothing tripped the step loop, so force the
+            # quarantine (parks nothing if nothing was running)
+            engine.quarantine(f"pod member {lost} lost")
+        if not quarantined.wait(timeout=10.0):
+            raise RuntimeError("engine did not quarantine within 10s")
+        quarantined.clear()
+        if bus is not None:
+            # survivors ack, leave their follower loops, and head for
+            # the new assembly; the dead member is silently dropped
+            bus.broadcast_surviving(REINIT_OP, (new_address, epoch))
+            bus.stop()
+        # the supervisor's cue: our new coordination service is about to
+        # bind, so the replacement process may now be spawned (it takes
+        # a full interpreter+jax start to reach initialize — far longer
+        # than our service bind)
+        with open(control_path + f".started.{epoch}", "w",
+                  encoding="utf-8") as f:
+            f.write(str(epoch))
+        new_config = dataclasses.replace(
+            config, coordinator_address=new_address
+        )
+        runtime = reinitialize(new_config)
+        state["config"] = new_config
+        state["runtime"] = runtime
+        # the old backend's arrays (params, KV pages) died with the old
+        # runtime; dropping the cached params makes reload() re-init
+        # them from the same PRNGKey(0) — bit-identical, which is what
+        # keeps resumed streams token-identical across the respawn
+        model._params = None
+        with holder["lock"]:
+            survivors = list(holder["survivors"])
+            holder["survivors"][:] = []
+        new_bus = None
+        if new_config.process_count > 1:
+            new_bus = StepBus(
+                num_workers=new_config.process_count - 1,
+                address=new_config.bus_address,
+            )
+            model.device_fn_wrapper = make_bus_wrapper(new_bus, duty)
+        state["bus"] = new_bus
+        # lockstep point: survivors + replacement mirror these probes
+        model.reload()
+        if new_bus is not None:
+            new_bus.accept_workers()
+        model.bind_core(core)
+        _wire_pod_fatal_hook(model.engine, holder, quarantined,
+                             loop=server._loop)
+        if survivors:
+            server._loop.call_soon_threadsafe(model.engine.adopt, survivors)
+        # the replaced member's gauge children would otherwise linger at
+        # their last pre-kill values forever; prune + re-seed
+        for index in range(runtime.process_count):
+            metrics.prune_pod_process(index)
+            metrics.set_pod_process(index, True, 0.0)
+        _write_ports(server, model, runtime, epoch)
+        core.lifecycle.resume()
+        duration = clock() - started
+        metrics.observe_recovery("pod", "success", duration)
+        print(
+            f"pod recovery epoch {epoch} complete in {duration:.2f}s "
+            f"({len(survivors)} sequences resumed)",
+            flush=True,
+        )
+        return True
+    except Exception as e:  # noqa: BLE001 - recovery is best-effort
+        metrics.observe_recovery("pod", "failed", clock() - started)
+        print(f"pod recovery failed: {e!r}", file=sys.stderr, flush=True)
+        return False
+
+
+def _serve_coordinator(model, config: PodConfig, runtime: PodRuntime) -> int:
     from client_tpu.server.core import ServerCore
     from client_tpu.server.model_repository import ModelRepository
     from client_tpu.testing.inprocess import InProcessServer
@@ -229,7 +459,8 @@ def _serve_coordinator(model, config: PodConfig, runtime: PodRuntime) -> int:
         bus.accept_workers()
     # the repository re-runs warmup on add_model/load — a second probe
     # sequence here would run collectives the workers don't mirror, so
-    # the already-warm model's warmup is pinned to a no-op
+    # the already-warm model's warmup is pinned to a no-op (reload()
+    # goes through the class, bypassing this pin on purpose)
     model.warmup = lambda: None  # type: ignore[method-assign]
     repository = ModelRepository()
     core = ServerCore(repository)
@@ -243,48 +474,101 @@ def _serve_coordinator(model, config: PodConfig, runtime: PodRuntime) -> int:
     if bus is not None:
         for index in range(1, runtime.process_count):
             metrics.set_pod_process(index, True, 0.0)
-    _start_pod_reporter(metrics, bus, duty, runtime, stop)
-    ports_path = os.environ.get(ENV_PORTS_FILE)
-    if ports_path:
-        write_ports_file(
-            ports_path,
-            {
-                "http_port": server.http_port,
-                "grpc_port": server.grpc_port,
-                "model": model.name,
-                "process_count": runtime.process_count,
-                "global_device_count": runtime.global_device_count,
-                "local_device_count": runtime.local_device_count,
-            },
-        )
+    # supervised-recovery state: the fatal hook parks surviving
+    # sequences; SIGUSR1 runs the recovery plan from the control file
+    holder = {"survivors": []}
+    quarantined = threading.Event()
+    _wire_pod_fatal_hook(model.engine, holder, quarantined,
+                         loop=server._loop)
+    state = {
+        "config": config, "runtime": runtime, "bus": bus, "duty": duty,
+        "holder": holder,
+    }
+    reporter_state = lambda: (state["bus"], state["runtime"])  # noqa: E731
+    _start_pod_reporter(metrics, duty, reporter_state, stop)
+    _write_ports(server, model, runtime, epoch=0)
     print(
         f"pod coordinator up: {runtime.process_count} processes, "
         f"{runtime.global_device_count} global devices, "
         f"http={server.http_port} grpc={server.grpc_port}",
         flush=True,
     )
-    signal.signal(signal.SIGTERM, lambda *_args: stop.set())
-    signal.signal(signal.SIGINT, lambda *_args: stop.set())
-    stop.wait()
-    if bus is not None:
-        bus.stop()
+    wake = threading.Event()
+    flags = {"stop": False, "recover": False}
+
+    def on_stop(*_args) -> None:
+        flags["stop"] = True
+        wake.set()
+
+    def on_recover(*_args) -> None:
+        flags["recover"] = True
+        wake.set()
+
+    signal.signal(signal.SIGTERM, on_stop)
+    signal.signal(signal.SIGINT, on_stop)
+    signal.signal(signal.SIGUSR1, on_recover)
+    rc = 0
+    while True:
+        wake.wait()
+        wake.clear()
+        if flags["stop"]:
+            break
+        if flags["recover"]:
+            flags["recover"] = False
+            if not _recover_pod(model, core, server, state, quarantined,
+                                clock=time.monotonic):
+                rc = 3
+                break
+    stop.set()
+    if state["bus"] is not None:
+        state["bus"].stop()
+    # pod shutdown: drop every member's gauge children so a scrape of a
+    # half-stopped coordinator never shows stale liveness
+    for index in range(state["runtime"].process_count):
+        metrics.prune_pod_process(index)
     server.stop()
-    return 0
+    return rc
 
 
 def _follow_worker(model, config: PodConfig) -> int:
     # lockstep point: mirrors the coordinator's warmup collectives
     model.warmup()
     follower = StepFollower(config.bus_address, config.process_index)
-    print(
-        f"pod worker {config.process_index} following "
-        f"{config.bus_address}",
-        flush=True,
-    )
-    reason = follower.follow(follower_handlers(model))
-    print(f"pod worker {config.process_index} done: {reason}", flush=True)
-    follower.close()
-    return 0
+    while True:
+        print(
+            f"pod worker {config.process_index} following "
+            f"{config.bus_address}",
+            flush=True,
+        )
+        reason = follower.follow(follower_handlers(model))
+        if reason != "reinit":
+            print(
+                f"pod worker {config.process_index} done: {reason}",
+                flush=True,
+            )
+            follower.close()
+            return 0
+        # a surviving member's half of a supervised recovery: the
+        # coordinator told us where the NEW assembly lives; mirror its
+        # sequence — abandon the broken runtime, re-join at the new
+        # address, rebuild the model (old backend arrays died with the
+        # old runtime), re-enter the lockstep warmup probes, and rejoin
+        # the bus (whose connect retries cover the coordinator's
+        # re-warmup window)
+        new_address, epoch = follower.reinit_args
+        follower.close()
+        print(
+            f"pod worker {config.process_index} re-joining epoch {epoch} "
+            f"at {new_address}",
+            flush=True,
+        )
+        config = dataclasses.replace(
+            config, coordinator_address=str(new_address)
+        )
+        runtime = reinitialize(config)
+        model = build_model(runtime)
+        model.warmup()
+        follower = StepFollower(config.bus_address, config.process_index)
 
 
 def main() -> int:

@@ -928,6 +928,22 @@ class ServerCore:
             and not self.repository.degraded()
         )
 
+    @property
+    def recovering(self) -> bool:
+        """True while any loaded model's engine reload is in flight
+        (surfaced in ``debug_state()`` and overlaid on the
+        ``tpu_server_state`` gauge; readiness is NOT dropped — the
+        replica keeps serving its healthy models and answers the
+        quarantined one with retryable 503s)."""
+        for entry in self.repository.index():
+            try:
+                model = self.repository.peek(entry["name"])
+            except Exception:  # noqa: BLE001 - introspection best-effort
+                continue
+            if getattr(model, "recovering", False):
+                return True
+        return False
+
     def _lifecycle_admit(self, model_name: str, trace=None) -> None:
         """Drain gate + in-flight tracking for one request; books the
         rejection counter and the trace event when draining."""
@@ -1444,7 +1460,11 @@ class ServerCore:
             stats = getattr(engine, "stats", None)
             if callable(stats):
                 try:
-                    llm[entry["name"]] = stats()
+                    doc = stats()
+                    controller = getattr(model, "_recovery", None)
+                    if controller is not None:
+                        doc["recovery"] = controller.describe()
+                    llm[entry["name"]] = doc
                 except Exception:  # noqa: BLE001 - a broken engine must
                     continue  # not take down the debug surface
         return {
@@ -1453,6 +1473,7 @@ class ServerCore:
                 "version": SERVER_VERSION,
                 "live": self.live,
                 "ready": self.ready,
+                "recovering": self.recovering,
             },
             "llm": llm,
             "lifecycle": self.lifecycle.snapshot(),

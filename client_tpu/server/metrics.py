@@ -191,7 +191,26 @@ class ServerMetrics:
         self.server_state = Gauge(
             "tpu_server_state",
             "Lifecycle state of the server (0 = serving, 1 = draining, "
-            "2 = stopped).",
+            "2 = stopped, 3 = recovering — an engine reload is in "
+            "flight while the lifecycle itself keeps serving).",
+            registry=registry,
+        )
+        # self-healing (PR 20): one counter/histogram pair covers every
+        # supervision tier — tier="engine" (auto reload), "pod" (member
+        # respawn + mesh re-init), "fleet" (replica replacement)
+        self.recovery_total = Counter(
+            "tpu_recovery_total",
+            "Completed automatic recoveries by supervision tier "
+            "(engine / pod / fleet) and outcome (success / failed).",
+            ("tier", "outcome"),
+            registry=registry,
+        )
+        self.recovery_seconds = Histogram(
+            "tpu_recovery_seconds",
+            "Detected-failure-to-serving-again duration (MTTR) per "
+            "completed recovery, by supervision tier.",
+            ("tier",),
+            buckets=DURATION_BUCKETS_S,
             registry=registry,
         )
         self.frontend_errors = Counter(
@@ -571,6 +590,21 @@ class ServerMetrics:
         self.pod_process_up.labels(label).set(1 if up else 0)
         self.pod_process_duty.labels(label).set(max(0.0, min(1.0, duty)))
 
+    def prune_pod_process(self, process: int) -> None:
+        """Drop one pod member's gauge children (the member was replaced
+        or the pod shut down) — without this, a respawned member's stale
+        twin lingers at its last value forever, exactly the SLO-gauge
+        leak PR 8 fixed."""
+        label = str(process)
+        self.pod_process_up.remove(label)
+        self.pod_process_duty.remove(label)
+
+    def observe_recovery(self, tier: str, outcome: str, seconds: float) -> None:
+        """Book one completed automatic recovery (any supervision tier);
+        ``seconds`` is detection-to-serving-again — the MTTR sample."""
+        self.recovery_total.labels(tier, outcome).inc()
+        self.recovery_seconds.labels(tier).observe(max(0.0, seconds))
+
     def observe_llm_step(self, model: str, batch_size: int) -> None:
         """Book one continuous-batching decode step (per-step batch-size
         distribution; tokens are booked separately via
@@ -629,10 +663,16 @@ class ServerMetrics:
             self.legacy_fail_count.labels(name).set(inference["fail"]["count"])
         lifecycle = getattr(self.core, "lifecycle", None)
         if lifecycle is not None:
-            from client_tpu.lifecycle import STATE_VALUES
+            from client_tpu.lifecycle import RECOVERING, SERVING, STATE_VALUES
 
+            state = lifecycle.state
+            if state == SERVING and getattr(self.core, "recovering", False):
+                # self-healing overlay: an engine reload in flight while
+                # the lifecycle keeps serving — operators watching the
+                # gauge see the recovery window, probes see ready
+                state = RECOVERING
             self.server_state.set(
-                float(STATE_VALUES.get(lifecycle.state, 0))
+                float(STATE_VALUES.get(state, 0))
             )
         busy_ns = self.core.device_busy_ns_total
         now_ns = self._clock_ns()

@@ -1,0 +1,206 @@
+"""Recovery chaos bench row (the MTTR half of bench.py's "recovery" row).
+
+The same 2-process fake pod as tools/bench_pod.py — coordinator + worker
+over jax.distributed, 2 virtual CPU devices each — serves a long greedy
+stream; mid-generation the bench SIGKILLs the worker and lets the
+:class:`client_tpu.pod.PodSupervisor` run the coordinated restart
+(member respawn, jax.distributed re-init at a fresh coordinator address,
+lockstep re-warmup, seeded replay of the surviving sequence). The row
+reports the measured MTTR and whether the RESUMED stream finished
+token-identical to a single-process oracle that was never interrupted.
+ONE JSON line on stdout:
+
+    {"config": ..., "mttr_s": ..., "supervisor_mttr_s": ...,
+     "interrupted_at_token": ..., "resume_tokens": ...,
+     "resumed_token_parity": true, "epoch": 1}
+
+``mttr_s`` is client-observed: SIGKILL to the first token the resumed
+stream emitted afterwards. ``supervisor_mttr_s`` is the supervisor's own
+event duration (respawn-to-ready). Parity is the acceptance signal — a
+fast recovery that resumes the WRONG tokens is a failure, and the row
+degrades to ``{"error": ...}`` so bench.py drops it.
+
+Methodology caveat (PERF.md): subprocess respawn plus a gloo re-init on
+loopback is NOT a real pod re-slice; treat MTTR as the supervision
+pipeline's overhead floor, not a TPU fleet number.
+
+Standalone: ``python tools/bench_recovery.py``.
+"""
+
+import asyncio
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+PARITY_PROMPT = [5, 9, 17, 3]
+RESUME_TOKENS = int(os.environ.get("BENCH_RECOVERY_TOKENS", "48"))
+KILL_AFTER_TOKENS = int(os.environ.get("BENCH_RECOVERY_KILL_AFTER", "4"))
+DEADLINE_S = float(os.environ.get("BENCH_RECOVERY_DEADLINE_S", "240"))
+
+
+def _oracle_tokens():
+    """Uninterrupted single-process reference for the pod's model."""
+    import jax.numpy as jnp
+
+    from client_tpu.llm.serving import LlmEngineModel
+    from client_tpu.models import llama
+
+    config = llama.LlamaConfig.tiny(max_seq_len=256, dtype=jnp.float32)
+    model = LlmEngineModel("oracle", config=config)
+    model.warmup()
+    try:
+
+        async def run():
+            out = []
+            async for response in model.execute_decoupled(
+                {
+                    "INPUT_IDS": __import__("numpy").array(
+                        PARITY_PROMPT, dtype="int32"
+                    )
+                },
+                {"max_tokens": RESUME_TOKENS},
+            ):
+                out.append(int(response["OUTPUT_IDS"][0]))
+                if response["__final__"]:
+                    break
+            return out
+
+        return asyncio.run(run())
+    finally:
+        model.shutdown()
+
+
+async def _stream_into(grpc_port, model_name, sink):
+    import numpy as np
+
+    import client_tpu.grpc.aio as grpcclient
+
+    async with grpcclient.InferenceServerClient(
+        f"127.0.0.1:{grpc_port}"
+    ) as client:
+
+        async def requests():
+            tensor = grpcclient.InferInput(
+                "INPUT_IDS", [len(PARITY_PROMPT)], "INT32"
+            )
+            tensor.set_data_from_numpy(
+                np.array(PARITY_PROMPT, dtype=np.int32)
+            )
+            yield {
+                "model_name": model_name,
+                "inputs": [tensor],
+                "parameters": {"max_tokens": RESUME_TOKENS},
+            }
+
+        async for result, error in client.stream_infer(requests()):
+            if error is not None:
+                raise RuntimeError(f"stream error: {error}")
+            sink.append((int(result.as_numpy("OUTPUT_IDS")[0]), time.monotonic()))
+
+
+def main() -> int:
+    from client_tpu.pod.launcher import PodLauncher
+    from client_tpu.pod.supervisor import PodSupervisor
+
+    oracle = _oracle_tokens()
+
+    launcher = PodLauncher(process_count=2, devices_per_process=2)
+    launcher.launch()
+    supervisor = None
+    try:
+        ports = launcher.wait_ready(timeout_s=DEADLINE_S)
+        supervisor = PodSupervisor(
+            launcher, poll_interval_s=0.2, deadline_s=DEADLINE_S
+        ).start()
+
+        stamped = []
+        failure = {}
+
+        def drive():
+            try:
+                asyncio.run(
+                    asyncio.wait_for(
+                        _stream_into(
+                            ports["grpc_port"], ports["model"], stamped
+                        ),
+                        timeout=DEADLINE_S + 60,
+                    )
+                )
+            except Exception as e:  # noqa: BLE001 - reported in the row
+                failure["error"] = f"{type(e).__name__}: {e}"
+
+        client = threading.Thread(target=drive, daemon=True)
+        client.start()
+        deadline = time.monotonic() + DEADLINE_S
+        while len(stamped) < KILL_AFTER_TOKENS:
+            if time.monotonic() > deadline:
+                raise RuntimeError("stream never reached the kill point")
+            time.sleep(0.005)
+        interrupted_at = len(stamped)
+        killed_at = time.monotonic()
+        launcher.kill(1)
+
+        client.join(timeout=DEADLINE_S + 90)
+        if client.is_alive():
+            raise RuntimeError("resumed stream never finished")
+        if failure:
+            raise RuntimeError(
+                f"stream failed across the recovery: {failure['error']}"
+            )
+        tokens = [token for token, _stamp in stamped]
+        if tokens != oracle:
+            print(
+                json.dumps(
+                    {
+                        "error": (
+                            f"resumed stream diverged from the oracle: "
+                            f"{tokens} vs {oracle}"
+                        )
+                    }
+                )
+            )
+            return 1
+        # client-observed MTTR: kill to the first post-kill token
+        resumed = [s for _t, s in stamped[interrupted_at:] if s > killed_at]
+        mttr = (resumed[0] - killed_at) if resumed else 0.0
+        events = [
+            e for e in supervisor.events if e.get("outcome") == "success"
+        ]
+        row = {
+            "config": (
+                f"SIGKILL pod member 1 of 2 after {interrupted_at} of "
+                f"{RESUME_TOKENS} streamed tokens; supervisor respawn + "
+                f"jax.distributed re-init + seeded replay (CPU gloo "
+                f"sandbox)"
+            ),
+            "mttr_s": round(mttr, 2),
+            "supervisor_mttr_s": (
+                round(events[0]["duration_s"], 2) if events else None
+            ),
+            "interrupted_at_token": interrupted_at,
+            "resume_tokens": RESUME_TOKENS,
+            "resumed_token_parity": True,
+            "epoch": supervisor.epoch,
+        }
+        print(json.dumps(row))
+        return 0
+    finally:
+        if supervisor is not None:
+            supervisor.stop()
+        launcher.stop()
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except SystemExit:
+        raise
+    except Exception as e:  # noqa: BLE001 - the row is best-effort
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+        raise SystemExit(1)

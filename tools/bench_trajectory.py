@@ -35,6 +35,13 @@ DEFAULT_THRESHOLD = 0.10
 # the router went from splicing bytes to doing real per-request work.
 PROXY_TAX_CEILING = 2.5
 
+# BENCH_r20+: the recovery row's MTTR is lower-is-better and noisy on a
+# contended sandbox (a pod launch + gloo re-init dominates), so the
+# guard is a multiplier of the best prior run rather than the 10%
+# throughput threshold: doubling the arc's best MTTR means the
+# supervision pipeline grew a real stall, not scheduler jitter.
+RECOVERY_MTTR_HEADROOM = 2.0
+
 
 def _repo_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -82,14 +89,14 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
         "| run | infer/sec | p50 (us) | ratio_vs_inproc | server CPU "
         "(us/req) | dominant stage | rolling p99 (us) | llm tok/s | "
         "sharded inf/s | fleet inf/s | proxy tax | pod tok/s | "
-        "kernel tok/s | prefix hit | spec tok/step |",
-        "|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|",
+        "recovery MTTR | kernel tok/s | prefix hit | spec tok/step |",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for run in runs:
         parsed = run["parsed"]
         if parsed is None:
             lines.append(
-                f"| r{run['run']:02d} | (bench failed) | | | | | | | | | | | | | |"
+                f"| r{run['run']:02d} | (bench failed) | | | | | | | | | | | | | | |"
             )
             continue
 
@@ -142,6 +149,16 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
             and isinstance(pod.get("tokens_per_sec"), (int, float))
             else "-"
         )
+        # BENCH_r20+: the self-healing chaos row (tools/bench_recovery.py
+        # — SIGKILL a pod member mid-generation; the cell is the
+        # client-observed MTTR, kill to the resumed stream's next token)
+        recovery = parsed.get("recovery")
+        mttr_s = (
+            f"{recovery['mttr_s']:.1f}s"
+            if isinstance(recovery, dict)
+            and isinstance(recovery.get("mttr_s"), (int, float))
+            else "-"
+        )
         # BENCH_r13+: the fused ragged paged-attention decode microbench
         # (best tokens/sec across the batch/context grid) and the
         # shared-prefix workload's block hit rate
@@ -186,6 +203,7 @@ def format_table(runs: List[Dict[str, Any]]) -> str:
             f"| {fleet_s} "
             f"| {tax_s} "
             f"| {pod_s} "
+            f"| {mttr_s} "
             f"| {kernel_s} "
             f"| {hit_s} "
             f"| {spec_s} |"
@@ -228,7 +246,11 @@ def check_regression(
       * ``pod.tokens_per_sec`` (BENCH_r19+) — the 2-process pod serving
         row is one harness family by construction (subprocess pair +
         streaming grpc.aio driver), so within-family comparison is
-        automatic.
+        automatic;
+      * ``recovery.mttr_s`` (BENCH_r20+) — INVERTED (lower is better):
+        the newest MTTR may not exceed ``RECOVERY_MTTR_HEADROOM`` times
+        the best (lowest) prior, and a recorded parity failure is an
+        absolute stop regardless of speed.
     """
     ok = [r for r in runs if r["parsed"] is not None]
     if len(ok) < 2:
@@ -331,6 +353,33 @@ def check_regression(
             if _nested(r["parsed"], "pod", "tokens_per_sec") is not None
         ],
     )
+    # BENCH_r20+: the self-healing chaos row. MTTR is lower-is-better,
+    # so the relative guard inverts: the newest run may not take more
+    # than RECOVERY_MTTR_HEADROOM times the best prior recovery.
+    latest_mttr = _nested(latest, "recovery", "mttr_s")
+    prior_mttrs = [
+        (r["run"], _nested(r["parsed"], "recovery", "mttr_s"))
+        for r in ok[:-1]
+        if _nested(r["parsed"], "recovery", "mttr_s") is not None
+    ]
+    if latest_mttr is not None and prior_mttrs:
+        best_run, best_mttr = min(prior_mttrs, key=lambda kv: kv[1])
+        if best_mttr > 0 and latest_mttr > best_mttr * RECOVERY_MTTR_HEADROOM:
+            problems.append(
+                f"recovery MTTR regression: r{latest_run:02d} healed the "
+                f"pod in {latest_mttr:.1f}s, over "
+                f"{RECOVERY_MTTR_HEADROOM:.1f}x the best prior run "
+                f"(r{best_run:02d} at {best_mttr:.1f}s)"
+            )
+    recovery_row = latest.get("recovery")
+    if isinstance(recovery_row, dict) and recovery_row.get(
+        "resumed_token_parity"
+    ) is False:
+        problems.append(
+            f"recovery parity floor: r{latest_run:02d}'s resumed stream "
+            f"diverged from the uninterrupted oracle — a fast recovery "
+            f"that replays the wrong tokens is a correctness failure"
+        )
     proxy_tax = _nested(latest, "fleet", "proxy_tax_ratio")
     if proxy_tax is not None and proxy_tax > PROXY_TAX_CEILING:
         problems.append(
